@@ -1,0 +1,73 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: /root/reference, see SURVEY.md).
+
+Execution substrate: JAX/XLA/PJRT. Eager mode is a traceable autograd tape
+over jax.Arrays; the jit path compiles whole train steps to single XLA
+programs; distribution is GSPMD mesh sharding over ICI/DCN.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Paddle float32 semantics: real fp32 matmuls (the TPU perf path is bf16 via
+# paddle_tpu.amp, whose operands are bf16 and unaffected by this setting).
+# Overridable via paddle_tpu.set_flags({'matmul_precision': ...}).
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+# Paddle dtype parity: int64 is the default index dtype and float64 exists.
+# Creation ops still default to float32 (the TPU compute dtype), so models
+# never see accidental f64 compute.
+_jax.config.update("jax_enable_x64", True)
+
+from . import autograd, dtypes, ops
+from .autograd import enable_grad, grad, no_grad, set_grad_enabled
+from .dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    int8, int16, int32, int64, uint8,
+)
+from .generator import default_generator, get_rng_state, seed, set_rng_state
+from .ops import *  # noqa: F401,F403
+from .tensor import Parameter, Tensor, to_tensor
+
+# Submodules assembled as they land (nn, optimizer, io, jit, distributed, ...)
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import amp  # noqa: E402
+from . import distributed  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import incubate  # noqa: E402
+from . import device  # noqa: E402
+from . import framework  # noqa: E402
+from .framework.io import load, save  # noqa: E402
+
+__version__ = "0.1.0"
+
+disable_static = lambda: None  # eager is the default and only imperative mode
+enable_static = None  # static graph API is served by paddle_tpu.jit
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def set_device(device: str):
+    from .device import set_device as _impl
+
+    return _impl(device)
+
+
+def get_device() -> str:
+    from .device import get_device as _impl
+
+    return _impl()

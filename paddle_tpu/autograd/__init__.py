@@ -1,0 +1,24 @@
+from .engine import (
+    GradNode,
+    apply_op,
+    backward,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "GradNode",
+    "apply_op",
+    "backward",
+    "grad",
+    "is_grad_enabled",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]

@@ -1,0 +1,80 @@
+"""PyLayer: user-defined autograd ops.
+
+TPU-native counterpart of the reference's PyLayer (``paddle/fluid/eager/pylayer/``,
+python API python/paddle/autograd/py_layer.py): user supplies static
+``forward``/``backward``; forward runs on raw payload arrays, a GradNode is
+recorded whose vjp calls the user's backward. Used by recompute
+(activation checkpointing) among others.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .engine import is_grad_enabled, make_node_for_outputs
+
+
+class PyLayerContext:
+    """reference: PyLayerContext (saved tensors between fwd and bwd)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass and define ``forward(ctx, *args)`` / ``backward(ctx, *grads)``.
+
+    Both receive/return Tensors. reference: paddle.autograd.PyLayer.
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        is_tuple = isinstance(outs, (tuple, list))
+        outs_seq = tuple(outs) if is_tuple else (outs,)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not needs_grad:
+            return outs
+
+        out_tensors = tuple(
+            Tensor(o._value if isinstance(o, Tensor) else o, stop_gradient=False)
+            for o in outs_seq
+        )
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            grad_ins = cls.backward(ctx, *[Tensor(c) for c in cts])
+            if not isinstance(grad_ins, (tuple, list)):
+                grad_ins = (grad_ins,)
+            results = []
+            gi = iter(grad_ins)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    results.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(results)
+
+        make_node_for_outputs(vjp_fn, tensor_inputs, out_tensors, name=cls.__name__,
+                              out_tuple=is_tuple)
+        return out_tensors if is_tuple else out_tensors[0]

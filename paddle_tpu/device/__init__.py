@@ -1,0 +1,54 @@
+"""Device management (reference: python/paddle/device/ — set_device,
+synchronize, device queries). On TPU, placement is owned by jax/XLA and
+shardings; this module provides the paddle-shaped façade."""
+from __future__ import annotations
+
+import jax
+
+_current_device = None
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count(device_type=None) -> int:
+    if device_type in (None, "tpu"):
+        try:
+            return len(jax.devices("tpu"))
+        except RuntimeError:
+            pass
+    try:
+        return len(jax.devices(device_type)) if device_type else len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def set_device(device: str):
+    """reference: paddle.set_device. Accepts 'tpu', 'cpu', 'tpu:0', ...
+    Sets jax's default device for subsequent array creation."""
+    global _current_device
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platform = {"gpu": "tpu", "tpu": None, "cpu": "cpu"}.get(name, name)
+    devs = jax.devices() if platform is None else jax.devices(platform)
+    jax.config.update("jax_default_device", devs[idx])
+    _current_device = device
+    return devs[idx]
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (reference:
+    paddle.device.synchronize / cudaDeviceSynchronize)."""
+    (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False

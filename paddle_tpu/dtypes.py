@@ -1,0 +1,79 @@
+"""Dtype vocabulary.
+
+TPU-native counterpart of the reference's POD dtype vocabulary
+(``paddle/phi/common/data_type.h``): here dtypes ARE jax/numpy dtypes, and we
+only provide Paddle-style names plus a couple of helpers. bfloat16 is
+first-class (it is the TPU matmul dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exported at package top level as paddle_tpu.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle legacy aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-facing dtype spec (str / np dtype / jnp dtype) to a numpy dtype-like.
+
+    Mirrors the role of ``paddle/phi/common/data_type.h`` string conversions.
+    Returns None when ``dtype`` is None.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    return np.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), np.integer)

@@ -1,0 +1,4 @@
+from . import io
+from .io import load, save
+
+__all__ = ["io", "save", "load"]

@@ -1,0 +1,65 @@
+"""paddle.save / paddle.load equivalent.
+
+reference: python/paddle/framework/io.py:646,888 — pickled nested state dicts.
+Tensors are converted to host numpy arrays on save and restored as Tensors on
+load. Sharded/async checkpointing for distributed jobs lives in
+paddle_tpu.distributed.checkpoint (Orbax-backed); this is the single-host
+paddle-compatible format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle wrapper distinguishing tensors from plain ndarrays."""
+
+    __slots__ = ("array", "stop_gradient")
+
+    def __init__(self, array, stop_gradient):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def _to_saveable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(jax.device_get(obj._value)), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj: Any) -> Any:
+    if isinstance(obj, _TensorPayload):
+        return Tensor(obj.array, stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saved(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """reference: paddle.save (framework/io.py:646)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    """reference: paddle.load (framework/io.py:888)."""
+    with open(path, "rb") as f:
+        return _from_saved(pickle.load(f))

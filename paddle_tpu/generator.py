@@ -1,0 +1,70 @@
+"""Random-state management.
+
+TPU-native counterpart of the reference's ``Generator`` RNG state
+(``paddle/phi/core/generator.h``): instead of a cuRAND offset counter, the
+state is a JAX PRNG key that is split on every consumption. The key lives in a
+plain attribute so the jit tracer (paddle_tpu.jit) can capture/restore it as
+part of the mutable state of a compiled step — random ops are then
+deterministic functions of the captured key, which is exactly how TPU programs
+want randomness (threefry keys compiled into the program, no host round trip).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class Generator:
+    """Holds a JAX PRNG key; ``next_key()`` splits off a fresh subkey.
+
+    The key is created LAZILY: importing paddle_tpu must never initialize the
+    device backend (on single-tenant TPU hosts, backend init claims the chip).
+    """
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = None
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
+    def next_key(self):
+        self._ensure()
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- state capture for the jit tracer ------------------------------------
+    def get_state(self):
+        self._ensure()
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+default_generator = Generator(int(os.environ.get("PADDLE_TPU_SEED", "0")))
+
+
+def seed(value: int):
+    """paddle.seed equivalent: reseed the global generator (reference:
+    python/paddle/framework/random.py)."""
+    default_generator.manual_seed(int(value))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

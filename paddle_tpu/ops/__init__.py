@@ -1,0 +1,164 @@
+"""paddle_tpu.ops — the functional op library.
+
+reference parity: the PHI kernel surface (paddle/phi/kernels/) exposed through
+python/paddle/tensor/*. At import time, ops are monkey-patched onto Tensor as
+methods and operator overloads — the counterpart of the reference's
+``eager_math_op_patch.cc`` + tensor method patching
+(python/paddle/tensor/__init__.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, random, stat
+from ._apply import binary, ensure_tensor, unary
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+__all__ = (
+    creation.__all__ + linalg.__all__ + logic.__all__ + manipulation.__all__
+    + math.__all__ + random.__all__ + stat.__all__ + ["getitem", "setitem"]
+)
+
+
+# ---------------------------------------------------------------- indexing
+def _prep_index(item):
+    """Convert Tensor indices to jax arrays, keep slices/ints/None/Ellipsis."""
+    if isinstance(item, tuple):
+        return tuple(_prep_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._value
+    if isinstance(item, (list,)):
+        return jnp.asarray(item)
+    return item
+
+
+def getitem(x, item):
+    idx = _prep_index(item)
+    return unary(lambda a: a[idx], x, name="getitem")
+
+
+def setitem(x, item, value):
+    """In-place indexed write (reference: eager __setitem__ / set_value op).
+    Routes through the tape via inplace_rebind so autograd stays correct."""
+    from ..autograd.engine import inplace_rebind
+
+    idx = _prep_index(item)
+    if isinstance(value, Tensor):
+        out = apply_op(lambda a, v: a.at[idx].set(v.astype(a.dtype)), [x, value], name="setitem")
+    else:
+        out = unary(lambda a: a.at[idx].set(jnp.asarray(value).astype(a.dtype)), x, name="setitem")
+    return inplace_rebind(x, out)
+
+
+# ----------------------------------------------- Tensor method/op patching
+def _patch_tensor():
+    import builtins
+
+    T = Tensor
+
+    # operators
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(o, s)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(o, s)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__mod__ = lambda s, o: math.remainder(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(o, s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__invert__ = lambda s: logic.bitwise_not(s)
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    # reference maps &,|,^,~ to the bitwise ops (python/paddle/tensor/__init__.py)
+    T.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+    T.__getitem__ = getitem
+    T.__setitem__ = setitem
+    T.__hash__ = lambda s: id(s)
+
+    # methods (paddle patches ~200; we patch everything in __all__ whose first
+    # arg is a tensor, under both the op name and common aliases)
+    method_sources = [creation, linalg, logic, manipulation, math, random, stat]
+    skip = {
+        "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+        "logspace", "eye", "meshgrid", "tril_indices", "triu_indices",
+        "uniform", "gaussian", "normal", "standard_normal", "randn", "rand",
+        "randint", "randperm",
+    }
+    for mod in method_sources:
+        for name in mod.__all__:
+            if name in skip or hasattr(T, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(T, name, fn)
+
+    # aliases matching paddle Tensor methods
+    T.add = math.add
+    T.add_ = math.add_
+    T.subtract = math.subtract
+    T.multiply = math.multiply
+    T.divide = math.divide
+    T.matmul = math.matmul
+    T.dim = lambda s: s.ndim
+    T.rank = lambda s: Tensor(jnp.asarray(s.ndim))
+    T.mean = math.mean
+    T.sum = math.sum
+    T.max = math.max
+    T.min = math.min
+    T.prod = math.prod
+    T.reshape = manipulation.reshape
+    T.transpose = manipulation.transpose
+    T.unsqueeze = manipulation.unsqueeze
+    T.squeeze = manipulation.squeeze
+    T.flatten = manipulation.flatten
+    T.scale = math.scale
+    T.pow = math.pow
+    T.exp = math.exp
+    T.log = math.log
+    T.sqrt = math.sqrt
+    T.rsqrt = math.rsqrt
+    T.tanh = math.tanh
+    T.sigmoid = math.sigmoid
+    T.abs = math.abs
+    T.clip = math.clip
+    T.norm = linalg.norm
+    T.argmax = math.argmax
+    T.argmin = math.argmin
+    T.cumsum = math.cumsum
+    T.topk = manipulation.topk
+    T.sort = manipulation.sort
+    T.argsort = manipulation.argsort
+    T.gather = manipulation.gather
+    T.cast = manipulation.cast
+    T.astype = manipulation.cast
+    T.expand = manipulation.expand
+    T.tile = manipulation.tile
+    T.split = manipulation.split
+    T.chunk = manipulation.chunk
+    T.concat = staticmethod(manipulation.concat)
+    T.equal = logic.equal
+    T.allclose = math.allclose
+
+
+_patch_tensor()

@@ -1,0 +1,51 @@
+"""Op-application helpers.
+
+TPU-native counterpart of the reference's PHI kernel dispatch
+(``paddle/phi/api/lib/kernel_dispatch.cc`` + ``kernel_factory.h:324``): here
+"kernel selection" collapses — every op is one pure jax function and XLA owns
+device placement/fusion. What remains is the uniform glue: normalize inputs to
+Tensors, route through the autograd tape (autograd/engine.py:apply_op), and
+keep python scalars as static attrs so they compile into the XLA program
+instead of becoming device transfers.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+
+
+def ensure_tensor(x, ref: Tensor = None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, numbers.Number) or isinstance(x, (np.ndarray, list, tuple)):
+        arr = np.asarray(x)
+        if ref is not None and arr.dtype in (np.float64, np.int64) and np.issubdtype(
+            np.asarray(ref._value).dtype if not hasattr(ref._value, "dtype") else ref._value.dtype,
+            np.inexact,
+        ):
+            arr = arr.astype(ref._value.dtype)
+        return Tensor(jnp.asarray(arr))
+    return Tensor(jnp.asarray(x))
+
+
+def unary(fn, x, attrs=None, differentiable=True, name=""):
+    x = ensure_tensor(x)
+    return apply_op(fn, [x], attrs, differentiable=differentiable, name=name or fn.__name__)
+
+
+def binary(fn, x, y, attrs=None, differentiable=True, name=""):
+    """Binary op; python scalars stay scalars (weak-typed, no promotion surprises)."""
+    if isinstance(x, Tensor) and isinstance(y, numbers.Number):
+        return apply_op(lambda a: fn(a, y, **(attrs or {})), [x], None,
+                        differentiable=differentiable, name=name or fn.__name__)
+    if isinstance(y, Tensor) and isinstance(x, numbers.Number):
+        return apply_op(lambda b: fn(x, b, **(attrs or {})), [y], None,
+                        differentiable=differentiable, name=name or fn.__name__)
+    xt = ensure_tensor(x, ref=y if isinstance(y, Tensor) else None)
+    yt = ensure_tensor(y, ref=x if isinstance(x, Tensor) else None)
+    return apply_op(fn, [xt, yt], attrs, differentiable=differentiable, name=name or fn.__name__)

@@ -1,0 +1,162 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py,
+phi kernels full/empty/arange/eye/tril/triu)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..autograd.engine import apply_op
+from ..tensor import Tensor, to_tensor
+from ._apply import ensure_tensor, unary
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, int) for v in (start, end, step)) else "float32"
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dtypes.convert_dtype(dtype or "float32"))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                     dtype=dtypes.convert_dtype(dtype or "float32"))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=dtypes.convert_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base.at[jnp.arange(a.shape[0]), jnp.arange(a.shape[0]) + offset].set(a) \
+                if offset >= 0 else base.at[jnp.arange(a.shape[0]) - offset, jnp.arange(a.shape[0])].set(a)
+        return jnp.diag(a, k=offset)
+
+    return unary(fn, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return unary(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return unary(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return unary(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.stack([r.astype(dt), c.astype(dt)]))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = jnp.triu_indices(row, k=offset, m=col if col is not None else row)
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.stack([r.astype(dt), c.astype(dt)]))
+
+
+def meshgrid(*args, name=None):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return apply_op(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")), ts, name="meshgrid")
+
+
+def assign(x, output=None):
+    """reference: paddle.assign (copy)."""
+    x = ensure_tensor(x)
+    out = unary(lambda a: a + 0 if a.dtype != jnp.bool_ else a, x, name="assign")
+    if output is not None:
+        output._set_value(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    import jax.lax
+
+    from ._apply import binary
+
+    return binary(lambda r, i: jax.lax.complex(r, i), real, imag, name="complex")

@@ -1,0 +1,218 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, phi
+linalg kernels → on TPU these lower to XLA's native decompositions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+from ._apply import binary, ensure_tensor, unary
+
+__all__ = [
+    "norm", "cholesky", "inverse", "pinv", "solve", "triangular_solve",
+    "cholesky_solve", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "matrix_power", "matrix_rank", "det", "slogdet", "lu", "lstsq", "cov",
+    "corrcoef", "histogram", "bincount", "cross", "trace", "dist", "cdist",
+]
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if axis is None and p == "fro":
+            return jnp.sqrt(jnp.sum(a * a))
+        if p == "fro":
+            return jnp.linalg.norm(a, ord=None, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                                   keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return unary(fn, x, name="norm")
+
+
+def cholesky(x, upper=False, name=None):
+    return unary(lambda a: jnp.linalg.cholesky(jnp.swapaxes(a, -1, -2) if upper else a).swapaxes(-1, -2)
+                 if upper else jnp.linalg.cholesky(a), x, name="cholesky")
+
+
+def inverse(x, name=None):
+    return unary(jnp.linalg.inv, x, name="inverse")
+
+
+def _f64_guard(fn):
+    """This jax build's f32 LAPACK svd kernel segfaults when x64 is enabled;
+    route svd-family ops through f64 and cast back (CPU-only code path —
+    decompositions are host ops on TPU too)."""
+
+    def wrapped(a, *args, **kwargs):
+        if a.dtype == jnp.float32:
+            out = fn(a.astype(jnp.float64), *args, **kwargs)
+            if isinstance(out, tuple):
+                return tuple(o.astype(jnp.float32) if o.dtype == jnp.float64 else o for o in out)
+            return out.astype(jnp.float32) if out.dtype == jnp.float64 else out
+        return fn(a, *args, **kwargs)
+
+    return wrapped
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary(_f64_guard(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian)), x, name="pinv")
+
+
+def solve(x, y, name=None):
+    return binary(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return binary(fn, x, y, name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return binary(fn, x, y, name="cholesky_solve")
+
+
+def svd(x, full_matrices=False, name=None):
+    out = apply_op(_f64_guard(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices))),
+                   [ensure_tensor(x)], name="svd")
+    return out
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda a: jnp.linalg.qr(a, mode=mode), [ensure_tensor(x)], name="qr")
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    arr = ensure_tensor(x).numpy()
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=False)), [ensure_tensor(x)], name="eigh")
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    return Tensor(jnp.asarray(np.linalg.eigvals(ensure_tensor(x).numpy())))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary(jnp.linalg.eigvalsh, x, name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return unary(lambda a: jnp.linalg.matrix_power(a, n), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return unary(_f64_guard(lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64)), x,
+                 differentiable=False, name="matrix_rank")
+
+
+def det(x, name=None):
+    return unary(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return unary(fn, x, name="slogdet")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    out = apply_op(fn, [ensure_tensor(x)], name="lu")
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out[0], out[1]
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        f64 = a.dtype == jnp.float32
+        if f64:
+            a, b = a.astype(jnp.float64), b.astype(jnp.float64)
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        if f64:
+            sol, res, sv = (v.astype(jnp.float32) for v in (sol, res, sv))
+        return sol, res, rank.astype(jnp.int64), sv
+
+    out = apply_op(fn, [ensure_tensor(x), ensure_tensor(y)], name="lstsq")
+    return out
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = ensure_tensor(fweights)._value if fweights is not None else None
+    aw = ensure_tensor(aweights)._value if aweights is not None else None
+    return unary(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+                 x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = ensure_tensor(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(x.numpy().min()), float(x.numpy().max()))
+    return unary(lambda a: jnp.histogram(a, bins=bins, range=(lo, hi))[0].astype(jnp.int64),
+                 x, differentiable=False, name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = ensure_tensor(weights)._value if weights is not None else None
+    return unary(lambda a: jnp.bincount(a.reshape(-1), weights=w, minlength=minlength),
+                 x, differentiable=False, name="bincount")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else -1
+    x = ensure_tensor(x)
+    if axis == 9:
+        for i, d in enumerate(x.shape):
+            if d == 3:
+                ax = i
+                break
+    return binary(lambda a, b: jnp.cross(a, b, axis=ax), x, y, name="cross")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x, name="trace")
+
+
+def dist(x, y, p=2, name=None):
+    return binary(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y, name="dist")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return binary(fn, x, y, name="cdist")

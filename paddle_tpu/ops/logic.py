@@ -1,0 +1,70 @@
+"""Comparison / logical / predicate ops (reference: python/paddle/tensor/logic.py).
+All non-differentiable; outputs are bool tensors."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._apply import binary, ensure_tensor, unary
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "isnan", "isinf", "isfinite", "is_empty", "isin",
+]
+
+
+def _cmp(fn, name):
+    def op(x, y, name_=None):
+        return binary(fn, x, y, differentiable=False, name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def equal_all(x, y, name=None):
+    return binary(lambda a, b: jnp.array_equal(a, b), x, y, differentiable=False, name="equal_all")
+
+
+def logical_not(x, name=None):
+    return unary(jnp.logical_not, x, differentiable=False, name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return unary(jnp.bitwise_not, x, differentiable=False, name="bitwise_not")
+
+
+def isnan(x, name=None):
+    return unary(jnp.isnan, x, differentiable=False, name="isnan")
+
+
+def isinf(x, name=None):
+    return unary(jnp.isinf, x, differentiable=False, name="isinf")
+
+
+def isfinite(x, name=None):
+    return unary(jnp.isfinite, x, differentiable=False, name="isfinite")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    t = ensure_tensor(test_x)._value
+    return unary(lambda a: jnp.isin(a, t, invert=invert), x, differentiable=False, name="isin")

@@ -1,0 +1,533 @@
+"""Shape/layout manipulation ops.
+
+reference parity: python/paddle/tensor/manipulation.py + phi kernels
+(reshape/transpose/concat/split/gather/scatter/...). All are metadata or
+gather/scatter ops that XLA handles natively; indices passed as Tensors are
+captured as nondifferentiable closure residuals.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+from ._apply import ensure_tensor, unary
+
+__all__ = [
+    "reshape", "transpose", "concat", "split", "chunk", "stack", "unstack",
+    "squeeze", "unsqueeze", "expand", "broadcast_to", "expand_as", "tile",
+    "flatten", "flip", "rot90", "roll", "gather", "gather_nd", "take_along_axis",
+    "put_along_axis", "index_select", "index_sample", "masked_select", "where",
+    "scatter", "scatter_nd_add", "slice", "strided_slice", "cast", "pad",
+    "topk", "sort", "argsort", "unique", "unique_consecutive", "searchsorted",
+    "nonzero", "repeat_interleave", "unbind", "numel", "shard_index",
+    "moveaxis", "swapaxes", "as_real", "as_complex", "view", "view_as",
+    "crop", "tensordot", "bucketize", "masked_fill", "index_put", "diagonal",
+]
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = tuple(int(s) for s in shape.numpy().reshape(-1))
+    else:
+        shape = tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+    return unary(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return unary(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return unary(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def concat(x: Sequence, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=axis), ts, name="concat")
+
+
+def stack(x: Sequence, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    out = apply_op(
+        lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)), [x], name="unstack"
+    )
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {axis} size {dim} is not divisible by num {num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis) for i in range(len(sizes)))
+
+    out = apply_op(fn, [x], name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
+    else:
+        ax = (int(axis),) if x.shape[int(axis)] == 1 else ()
+    if ax == ():
+        return unary(lambda a: a, x, name="squeeze")
+    return unary(lambda a: jnp.squeeze(a, axis=ax), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = [int(a) for a in axis.numpy().reshape(-1)]
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return unary(lambda a: jnp.expand_dims(a, ax), x, name="unsqueeze")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy().reshape(-1)]
+    shape = [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+    # paddle semantics: -1 means keep the input dim
+    nd_in, nd_out = x.ndim, len(shape)
+    full_shape = []
+    for i, s in enumerate(shape):
+        in_dim = x.shape[i - (nd_out - nd_in)] if i >= nd_out - nd_in else None
+        full_shape.append(in_dim if s == -1 else s)
+    return unary(lambda a: jnp.broadcast_to(a, tuple(full_shape)), x, name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(r) for r in repeat_times.numpy().reshape(-1)]
+    reps = tuple(int(r.item()) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return unary(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return unary(lambda a: jnp.reshape(a, tuple(new_shape)), x, name="flatten")
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return unary(lambda a: jnp.flip(a, axis=ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return unary(lambda a: jnp.roll(a, shifts, axis=axis), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    """reference: paddle.gather — select rows of ``axis`` by 1-D index."""
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return unary(lambda a: jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis),
+                 x, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+
+    def fn(a):
+        # index shape [..., k] indexes the first k dims of a
+        k = idx.shape[-1]
+        idx_tuple = tuple(idx[..., i] for i in range(k))
+        return a[idx_tuple]
+
+    return unary(fn, x, name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr = ensure_tensor(arr)
+    idx = ensure_tensor(indices)._value
+    return unary(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr = ensure_tensor(arr)
+    idx = ensure_tensor(indices)._value
+    vt = ensure_tensor(values)
+
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = tuple(
+            jnp.broadcast_to(
+                jnp.arange(idx.shape[d]).reshape((-1,) + (1,) * (idx.ndim - d - 1)), idx.shape
+            )
+            if d != axis % a.ndim
+            else idx
+            for d in range(a.ndim)
+        )
+        if reduce == "assign":
+            return a.at[dims].set(v)
+        if reduce == "add":
+            return a.at[dims].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[dims].multiply(v)
+        raise ValueError(f"unsupported reduce: {reduce}")
+
+    return apply_op(fn, [arr, vt], name="put_along_axis")
+
+
+def index_select(x, index, axis=0, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    return unary(lambda a: jnp.take(a, idx.reshape(-1), axis=axis), x, name="index_select")
+
+
+def index_sample(x, index):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    return unary(lambda a: jnp.take_along_axis(a, idx, axis=1), x, name="index_sample")
+
+
+def masked_select(x, mask, name=None):
+    """Note: output shape is data-dependent — not jittable; eager only
+    (reference kernel has the same dynamic-shape nature)."""
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask).numpy().astype(bool)
+    flat_idx = jnp.asarray(m.reshape(-1).nonzero()[0])
+    return unary(lambda a: jnp.take(a.reshape(-1), flat_idx), x, name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask)._value
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    if isinstance(v, Tensor):
+        return apply_op(lambda a, val: jnp.where(m, val.astype(a.dtype), a), [x, v], name="masked_fill")
+    return unary(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), x, name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = ensure_tensor(condition)._value
+    if x is None and y is None:
+        return nonzero(Tensor(cond), as_tuple=True)
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    return apply_op(lambda a, b: jnp.where(cond, a, b), [xt, yt], name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = ensure_tensor(x).numpy()
+    import numpy as np
+
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """reference: paddle.scatter — write ``updates`` rows at ``index`` along dim 0."""
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value.reshape(-1)
+    upd = ensure_tensor(updates)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u.astype(a.dtype))
+        return a.at[idx].set(0.0).at[idx].add(u.astype(a.dtype))
+
+    return apply_op(fn, [x, upd], name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    upd = ensure_tensor(updates)
+
+    def fn(a, u):
+        k = idx.shape[-1]
+        idx_tuple = tuple(idx[..., i] for i in range(k))
+        return a.at[idx_tuple].add(u.astype(a.dtype))
+
+    return apply_op(fn, [x, upd], name="scatter_nd_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx_tuple = tuple(ensure_tensor(i)._value for i in indices)
+    v = ensure_tensor(value)
+
+    def fn(a, val):
+        if accumulate:
+            return a.at[idx_tuple].add(val.astype(a.dtype))
+        return a.at[idx_tuple].set(val.astype(a.dtype))
+
+    return apply_op(fn, [x, v], name="index_put")
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = ensure_tensor(input)
+
+    def _v(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+
+    def fn(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            out = jax.lax.slice_in_dim(out, _v(s), min(_v(e), out.shape[ax]), axis=ax)
+        return out
+
+    return unary(fn, x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+
+    import builtins
+
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(sl)]
+
+    return unary(fn, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    offs = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    shp = [int(s) if int(s) != -1 else x.shape[i] - offs[i] for i, s in enumerate(shape)]
+    return unary(lambda a: jax.lax.dynamic_slice(a, offs, shp), x, name="crop")
+
+
+def cast(x, dtype):
+    """reference: phi CastKernel. float->float/complex casts carry gradient
+    (cast-back vjp); anything else is non-differentiable."""
+    x = ensure_tensor(x)
+    dt = dtypes.convert_dtype(dtype)
+    import numpy as np
+
+    diff = dtypes.is_floating(dt) and dtypes.is_floating(np.dtype(x.dtype))
+    return unary(lambda a: a.astype(dt), x, differentiable=diff, name="cast")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy().reshape(-1)]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW/NCL/NCDHW convention: pad applies to spatial dims, last-first order
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, 2 + n_spatial))
+        else:
+            spatial = list(range(1, 1 + n_spatial))
+        for i, dim in enumerate(spatial):
+            pairs[dim] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(a):
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return unary(fn, x, name="pad")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    def fn(a):
+        arr = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(arr, k)
+        else:
+            vals, idx = jax.lax.top_k(-arr, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    out = apply_op(fn, [x], name="topk")
+    return out[0], out[1]
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return unary(fn, x, name="sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+
+    return unary(fn, x, differentiable=False, name="argsort")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq = ensure_tensor(sorted_sequence)._value
+    v = ensure_tensor(values)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return unary(lambda a: jnp.searchsorted(seq, a, side=side).astype(dt), v,
+                 differentiable=False, name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    """Data-dependent output shape: eager only (host round-trip), like the
+    reference's UniqueKernel."""
+    import numpy as np
+
+    arr = ensure_tensor(x).numpy()
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    dt = dtypes.convert_dtype(dtype)
+    return tuple(Tensor(jnp.asarray(r if i == 0 else r.astype(dt))) for i, r in enumerate(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    import numpy as np
+
+    arr = ensure_tensor(x).numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis is not supported yet")
+    out = arr[change]
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        rets.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        rets.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        repeats = repeats._value
+    return unary(lambda a: jnp.repeat(a, repeats, axis=axis), x, name="repeat_interleave")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: paddle.shard_index (used by sharded embedding)."""
+    x = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return unary(fn, x, differentiable=False, name="shard_index")
+
+
+def as_complex(x, name=None):
+    return unary(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, name="as_complex")
+
+
+def as_real(x, name=None):
+    return unary(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x, name="as_real")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x, name="diagonal")
+
+
+def tensordot(x, y, axes=2, name=None):
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), [xt, yt], name="tensordot")

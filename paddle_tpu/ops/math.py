@@ -1,0 +1,334 @@
+"""Elementwise math, matmul, reductions.
+
+reference parity: paddle/phi/kernels/{cpu,gpu}/*_kernel.* exposed through
+python/paddle/tensor/math.py. On TPU each op is one jnp/lax call; XLA fuses
+chains of them into single kernels, so there is no fused-elementwise tier to
+hand-maintain (reference: phi/kernels/funcs elementwise machinery).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+from ._apply import binary, ensure_tensor, unary
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "matmul", "scale", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "abs", "neg", "sign", "floor", "ceil", "round",
+    "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "sigmoid", "reciprocal", "maximum",
+    "minimum", "fmax", "fmin", "clip", "sum", "mean", "max", "min", "prod",
+    "all", "any", "argmax", "argmin", "cumsum", "cumprod", "logsumexp",
+    "logcumsumexp", "einsum", "dot", "mm", "bmm", "t", "multiply_", "add_",
+    "addmm", "inner", "outer", "kron", "diff", "nanmean", "nansum", "amax",
+    "amin", "lerp", "erf", "erfinv", "stanh", "atan2", "hypot", "frac",
+    "isclose", "allclose",
+]
+
+
+# -------------------------------------------------------------- elementwise
+def add(x, y, name=None):
+    return binary(jnp.add, x, y, name="add")
+
+
+def subtract(x, y, name=None):
+    return binary(jnp.subtract, x, y, name="subtract")
+
+
+def multiply(x, y, name=None):
+    return binary(jnp.multiply, x, y, name="multiply")
+
+
+def divide(x, y, name=None):
+    return binary(jnp.divide, x, y, name="divide")
+
+
+def floor_divide(x, y, name=None):
+    return binary(jnp.floor_divide, x, y, differentiable=False, name="floor_divide")
+
+
+def remainder(x, y, name=None):
+    return binary(jnp.remainder, x, y, differentiable=False, name="remainder")
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return binary(jnp.power, x, y, name="pow")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference: phi ScaleKernel (phi/kernels/scale_kernel.h)."""
+    s, b = scale, bias
+    if bias_after_scale:
+        out = unary(lambda a: a * s + b, x, name="scale")
+    else:
+        out = unary(lambda a: (a + b) * s, x, name="scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def _unary_factory(fn, name, differentiable=True):
+    def op(x, name_=None):
+        return unary(fn, x, differentiable=differentiable, name=name)
+
+    op.__name__ = name
+    return op
+
+
+exp = _unary_factory(jnp.exp, "exp")
+expm1 = _unary_factory(jnp.expm1, "expm1")
+log = _unary_factory(jnp.log, "log")
+log2 = _unary_factory(jnp.log2, "log2")
+log10 = _unary_factory(jnp.log10, "log10")
+log1p = _unary_factory(jnp.log1p, "log1p")
+sqrt = _unary_factory(jnp.sqrt, "sqrt")
+rsqrt = _unary_factory(jax.lax.rsqrt, "rsqrt")
+square = _unary_factory(jnp.square, "square")
+abs = _unary_factory(jnp.abs, "abs")
+neg = _unary_factory(jnp.negative, "neg")
+sign = _unary_factory(jnp.sign, "sign", differentiable=False)
+floor = _unary_factory(jnp.floor, "floor", differentiable=False)
+ceil = _unary_factory(jnp.ceil, "ceil", differentiable=False)
+round = _unary_factory(jnp.round, "round", differentiable=False)
+trunc = _unary_factory(jnp.trunc, "trunc", differentiable=False)
+sin = _unary_factory(jnp.sin, "sin")
+cos = _unary_factory(jnp.cos, "cos")
+tan = _unary_factory(jnp.tan, "tan")
+asin = _unary_factory(jnp.arcsin, "asin")
+acos = _unary_factory(jnp.arccos, "acos")
+atan = _unary_factory(jnp.arctan, "atan")
+sinh = _unary_factory(jnp.sinh, "sinh")
+cosh = _unary_factory(jnp.cosh, "cosh")
+tanh = _unary_factory(jnp.tanh, "tanh")
+asinh = _unary_factory(jnp.arcsinh, "asinh")
+acosh = _unary_factory(jnp.arccosh, "acosh")
+atanh = _unary_factory(jnp.arctanh, "atanh")
+sigmoid = _unary_factory(jax.nn.sigmoid, "sigmoid")
+reciprocal = _unary_factory(jnp.reciprocal, "reciprocal")
+erf = _unary_factory(jax.lax.erf, "erf")
+erfinv = _unary_factory(jax.lax.erf_inv, "erfinv")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def frac(x, name=None):
+    return unary(lambda a: a - jnp.trunc(a), x, name="frac")
+
+
+def atan2(x, y, name=None):
+    return binary(jnp.arctan2, x, y, name="atan2")
+
+
+def hypot(x, y, name=None):
+    return binary(jnp.hypot, x, y, name="hypot")
+
+
+def maximum(x, y, name=None):
+    return binary(jnp.maximum, x, y, name="maximum")
+
+
+def minimum(x, y, name=None):
+    return binary(jnp.minimum, x, y, name="minimum")
+
+
+def fmax(x, y, name=None):
+    return binary(jnp.fmax, x, y, name="fmax")
+
+
+def fmin(x, y, name=None):
+    return binary(jnp.fmin, x, y, name="fmin")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        x, y, weight = ensure_tensor(x), ensure_tensor(y), weight
+        return apply_op(lambda a, b, w: a + w * (b - a), [x, y, weight], name="lerp")
+    return apply_op(lambda a, b: a + weight * (b - a), [ensure_tensor(x), ensure_tensor(y)], name="lerp")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return unary(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                  x, y, differentiable=False, name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                  x, y, differentiable=False, name="allclose")
+
+
+# ------------------------------------------------------------------- matmul
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """reference: phi MatmulKernel (phi/kernels/gpu/matmul_kernel.cu) /
+    MatmulInferMeta (phi/infermeta/binary.cc). Lowers to a single dot_general
+    — the MXU path; keep operands bf16 under AMP for full MXU rate."""
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return binary(fn, x, y, name="matmul")
+
+
+def dot(x, y, name=None):
+    return binary(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def t(x, name=None):
+    return unary(lambda a: a.T, x, name="t")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        [ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)],
+        name="addmm",
+    )
+
+
+def inner(x, y, name=None):
+    return binary(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return binary(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def kron(x, y, name=None):
+    return binary(jnp.kron, x, y, name="kron")
+
+
+def einsum(equation, *operands):
+    """reference: python/paddle/tensor/einsum.py — on TPU one dot_general chain."""
+    ts = [ensure_tensor(o) for o in operands]
+    return apply_op(lambda *arrs: jnp.einsum(equation, *arrs), ts, name="einsum")
+
+
+# --------------------------------------------------------------- reductions
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def _reduce_factory(fn, name, differentiable=True):
+    def op(x, axis=None, keepdim=False, name_=None):
+        ax = _norm_axis(axis)
+        return unary(lambda a: fn(a, axis=ax, keepdims=keepdim), x,
+                     differentiable=differentiable, name=name)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce_factory(jnp.sum, "sum")
+mean = _reduce_factory(jnp.mean, "mean")
+max = _reduce_factory(jnp.max, "max")
+min = _reduce_factory(jnp.min, "min")
+prod = _reduce_factory(jnp.prod, "prod")
+amax = _reduce_factory(jnp.max, "amax")
+amin = _reduce_factory(jnp.min, "amin")
+all = _reduce_factory(jnp.all, "all", differentiable=False)
+any = _reduce_factory(jnp.any, "any", differentiable=False)
+nansum = _reduce_factory(jnp.nansum, "nansum")
+nanmean = _reduce_factory(jnp.nanmean, "nanmean")
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _norm_axis(axis)
+    return unary(
+        lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(dtypes.convert_dtype(dtype)),
+        x, differentiable=False, name="argmax",
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _norm_axis(axis)
+    return unary(
+        lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(dtypes.convert_dtype(dtype)),
+        x, differentiable=False, name="argmin",
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+
+    return unary(fn, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return unary(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), x, name="cumprod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return unary(lambda a: jax.nn.logsumexp(a, axis=ax, keepdims=keepdim), x, name="logsumexp")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            return jax.lax.cumlogsumexp(flat, axis=0)
+        return jax.lax.cumlogsumexp(a, axis=int(axis))
+
+    return unary(fn, x, name="logcumsumexp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return unary(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x, name="diff")
+
+
+# --------------------------------------------------------------- inplace-ish
+def add_(x, y, name=None):
+    from ..autograd.engine import inplace_rebind
+
+    return inplace_rebind(x, add(x, y))
+
+
+def multiply_(x, y, name=None):
+    from ..autograd.engine import inplace_rebind
+
+    return inplace_rebind(x, multiply(x, y))

@@ -1,0 +1,132 @@
+"""Random ops.
+
+reference parity: python/paddle/tensor/random.py + phi RNG kernels backed by
+``Generator`` state (phi/core/generator.h). Here every op consumes a split of
+the global JAX PRNG key (paddle_tpu.generator) — stateless threefry on device,
+no host RNG round trips, and capturable as jit state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..generator import default_generator
+from ..tensor import Tensor
+from ._apply import ensure_tensor
+
+__all__ = [
+    "uniform", "uniform_", "normal", "gaussian", "standard_normal", "randn",
+    "rand", "randint", "randint_like", "randperm", "bernoulli", "poisson",
+    "multinomial", "exponential_", "rand_like", "normal_like",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else default_generator.next_key()
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(jax.random.uniform(key, _shape(shape), dt, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._set_value(uniform(x.shape, x.dtype, min, max, seed)._value)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    key = jax.random.key(seed) if seed else default_generator.next_key()
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), dt))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._value if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        key = default_generator.next_key()
+        return Tensor(m + s * jax.random.normal(key, out_shape, jnp.float32))
+    return gaussian(shape, mean, std)
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return gaussian(shape, 0.0, 1.0, dtype=dtype)
+
+
+def randn(shape, dtype="float32", name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def rand_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return rand(x.shape, dtype or x.dtype)
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    x = ensure_tensor(x)
+    return gaussian(x.shape, mean, std, dtype=x.dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.bernoulli(key, x._value).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.poisson(key, x._value).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.next_key()
+    probs = x._value / jnp.sum(x._value, axis=-1, keepdims=True)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(num_samples,) + x._value.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, x._value.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = default_generator.next_key()
+    x._set_value(jax.random.exponential(key, tuple(x.shape), x.dtype) / lam)
+    return x

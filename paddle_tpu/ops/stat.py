@@ -1,0 +1,95 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._apply import unary
+
+__all__ = ["std", "var", "median", "nanmedian", "quantile", "nanquantile", "kthvalue", "mode"]
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return unary(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return unary(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+    if mode == "avg":
+        return unary(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, name="median")
+    return unary(lambda a: jnp.quantile(a, 0.5, axis=ax, keepdims=keepdim, method="lower"),
+                 x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return unary(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    qa = jnp.asarray(q)
+    return unary(lambda a: jnp.quantile(a, qa, axis=ax, keepdims=keepdim, method=interpolation),
+                 x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    qa = jnp.asarray(q)
+    return unary(lambda a: jnp.nanquantile(a, qa, axis=ax, keepdims=keepdim, method=interpolation),
+                 x, name="nanquantile")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    from ..autograd.engine import apply_op
+    from ._apply import ensure_tensor
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    out = apply_op(fn, [ensure_tensor(x)], name="kthvalue")
+    return out[0], out[1]
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    from ..autograd.engine import apply_op
+    from ._apply import ensure_tensor
+
+    def fn(a):
+        sorted_a = jnp.sort(a, axis=axis)
+        moved = jnp.moveaxis(sorted_a, axis, -1)
+        # count occurrences of each element via pairwise comparison (fine for
+        # the small trailing dims this op sees in practice)
+        counts = jnp.sum(moved[..., :, None] == moved[..., None, :], axis=-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        orig_moved = jnp.moveaxis(a, axis, -1)
+        idx = jnp.argmax(orig_moved == vals[..., None], axis=-1).astype(jnp.int64)
+        vals_out = jnp.moveaxis(vals[..., None], -1, axis) if keepdim else vals
+        idx_out = jnp.moveaxis(idx[..., None], -1, axis) if keepdim else idx
+        return vals_out, idx_out
+
+    out = apply_op(fn, [ensure_tensor(x)], name="mode")
+    return out[0], out[1]

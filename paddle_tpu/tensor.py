@@ -1,0 +1,265 @@
+"""The eager Tensor.
+
+TPU-native counterpart of the reference's eager ``Tensor`` + ``AutogradMeta``
+(``paddle/fluid/eager/autograd_meta.h``, ``paddle/phi/core/dense_tensor.h:38``).
+
+Design: a Tensor is a thin mutable cell around an immutable ``jax.Array`` (or a
+JAX tracer, when running under ``paddle_tpu.jit``). Autograd metadata hangs off
+the cell exactly like the reference's AutogradMeta hangs off its Tensor:
+``stop_gradient`` (True by default, False for Parameters), ``grad`` (leaf
+accumulation target), and ``_grad_node`` (the producing GradNode, the tape
+edge). Because the payload may be a tracer, the whole eager engine is
+*traceable*: running the same imperative code under jax.jit compiles the full
+step into one XLA program — the TPU answer to the reference's separate
+eager/static engines.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes
+
+_uid_counter = itertools.count()
+
+# Set by paddle_tpu.jit while tracing a compiled step: records Tensor._value
+# writes so mutated state can be functionalized (returned from the jitted fn).
+_trace_recorders: list = []
+
+
+class Tensor:
+    """Eager tensor with autograd metadata (reference: eager Tensor +
+    AutogradMeta, paddle/fluid/eager/autograd_meta.h)."""
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_output_index",
+        "name",
+        "_hooks",
+        "_uid",
+        "dist_attr",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None  # GradNode that produced this tensor
+        self._output_index = 0  # which output slot of that node
+        self.name = name or f"tensor_{next(_uid_counter)}"
+        self._hooks = None
+        self._uid = next(_uid_counter)
+        self.dist_attr = None  # set by paddle_tpu.distributed.shard_tensor
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, new):
+        self._set_value(new)
+
+    def _set_value(self, new):
+        """In-place payload replacement (reference: inplace ops / ShareDataWith).
+
+        Under a jit trace this is recorded so the mutation becomes a
+        functional output of the compiled program.
+        """
+        if isinstance(new, Tensor):
+            new = new._value
+        self._value = new
+        for rec in _trace_recorders:
+            rec.record_write(self)
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def place(self) -> str:
+        v = self._value
+        if isinstance(v, jax.core.Tracer):
+            return "traced"
+        try:
+            dev = list(v.devices())[0]
+            return f"{dev.platform}:{dev.id}"
+        except Exception:
+            return "cpu"
+
+    def numel(self):
+        return self.size
+
+    # -------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """reference: paddle Tensor.backward -> egr::Backward (eager/backward.cc:423)."""
+        from .autograd import backward as _backward
+
+        _backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def register_hook(self, hook):
+        """Grad hook, run when this tensor's gradient is computed
+        (reference: eager/hooks.h)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        idx = len(self._hooks) - 1
+        tensor = self
+
+        class _Removable:
+            def remove(self):
+                tensor._hooks[idx] = None
+
+        return _Removable()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self._value))
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True)
+
+    # ------------------------------------------------------------ conversion
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from .ops import cast
+
+        return cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def clone(self) -> "Tensor":
+        from .ops import assign
+
+        return assign(self)
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._value), stop_gradient=self.stop_gradient)
+
+    def block_until_ready(self) -> "Tensor":
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+    # ------------------------------------------------------------------ repr
+    def __repr__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            return f"Tensor(traced, shape={self.shape}, dtype={self.dtype})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._value)!r})"
+        )
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __hash__(self):
+        # Identity hash: tensors are dict keys by object identity (uid can be
+        # rebound by in-place ops, see autograd.engine.inplace_rebind).
+        return id(self)
+
+    # Operator overloads are patched in by paddle_tpu.ops (monkey-patch, like
+    # the reference's eager_math_op_patch.cc).
+
+    # Make numpy coercion explicit
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+Parameter_doc = """A Parameter is a Tensor with stop_gradient=False plus an
+optimize flag (reference: python/paddle/fluid/framework.py Parameter)."""
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """reference: paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    del place  # device placement is managed by jax / shardings
+    dtype = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        value = data._value
+        if dtype is not None and value.dtype != np.dtype(dtype):
+            value = value.astype(dtype)
+        return Tensor(value, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        value = data if dtype is None else data.astype(dtype)
+        return Tensor(value, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if dtype is None and arr.dtype == np.float64:
+        # Match paddle's default fp32 (and TPU sanity): python floats -> f32;
+        # python ints stay int64 (numpy default), matching paddle.
+        arr = arr.astype(np.float32)
+    value = jnp.asarray(arr, dtype=dtype)
+    return Tensor(value, stop_gradient=stop_gradient)

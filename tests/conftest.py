@@ -1,0 +1,25 @@
+"""Test config: force an 8-virtual-device CPU platform BEFORE jax imports.
+
+This is the TPU analogue of the reference's fake_cpu_device.h pattern
+(paddle/phi/backends/custom/fake_cpu_device.h — exercising the device plug-in
+path without hardware, SURVEY.md §4): distributed/sharding logic is tested on
+a virtual 8-device CPU mesh; only bench.py touches the real TPU.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,62 @@
+"""OpTest-style harness (reference: eager_op_test.py:324, SURVEY.md §4).
+
+check_output: run the paddle_tpu op and compare against a numpy reference.
+check_grad: run the op through the eager tape, backward(), and compare the
+tape-produced gradients against (a) direct jax.grad of the same computation
+(tests the tape engine wiring) and optionally (b) central finite differences
+(tests the vjp rule itself).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def check_output(pt_fn, np_fn, inputs, atol=1e-4, rtol=1e-4):
+    """inputs: list of numpy arrays (positional)."""
+    ts = [pt.to_tensor(x) for x in inputs]
+    out = pt_fn(*ts)
+    ref = np_fn(*inputs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), np.asarray(r), atol=atol, rtol=rtol)
+
+
+def check_grad(pt_fn, inputs, atol=1e-4, rtol=1e-4, numeric=False, eps=1e-3):
+    """Compare tape grads of sum(pt_fn(*inputs)) against jax.grad reference."""
+    ts = [pt.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = pt_fn(*ts)
+    loss = out.sum() if out.ndim > 0 else out
+    loss.backward()
+    tape_grads = [t.grad.numpy() if t.grad is not None else None for t in ts]
+
+    def pure(*arrays):
+        ts2 = [pt.to_tensor(a) for a in arrays]
+        o = pt_fn(*ts2)
+        return jnp.sum(o._value)
+
+    ref_grads = jax.grad(pure, argnums=tuple(range(len(inputs))))(*[jnp.asarray(x) for x in inputs])
+    for tg, rg in zip(tape_grads, ref_grads):
+        assert tg is not None, "tape produced no grad"
+        np.testing.assert_allclose(tg, np.asarray(rg), atol=atol, rtol=rtol)
+
+    if numeric:
+        for i, x in enumerate(inputs):
+            num = np.zeros_like(x, dtype=np.float64)
+            flat = x.reshape(-1)
+            for j in range(flat.size):
+                xp, xm = x.copy().reshape(-1), x.copy().reshape(-1)
+                xp[j] += eps
+                xm[j] -= eps
+                args_p = list(inputs)
+                args_m = list(inputs)
+                args_p[i] = xp.reshape(x.shape)
+                args_m[i] = xm.reshape(x.shape)
+                fp = float(pure(*[jnp.asarray(a) for a in args_p]))
+                fm = float(pure(*[jnp.asarray(a) for a in args_m]))
+                num.reshape(-1)[j] = (fp - fm) / (2 * eps)
+            np.testing.assert_allclose(tape_grads[i], num, atol=1e-2, rtol=1e-2)

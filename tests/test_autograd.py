@@ -1,0 +1,213 @@
+"""Autograd engine tests (reference semantics: eager/backward.cc:104 —
+accumulation, retain_graph, hooks, paddle.grad, PyLayer, no_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import check_grad
+
+
+def test_simple_backward():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x      # 4
+    z = y * x + y  # 8 + 4 = 12, dz/dx = 3x^2 + 2x = 16
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 16.0)
+
+
+def test_grad_accumulation_across_backwards():
+    x = pt.to_tensor(3.0, stop_gradient=False)
+    (x * x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)
+    (x * x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)  # accumulated
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_input_fanout():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+
+def test_retain_graph():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+    with pytest.raises(RuntimeError, match="second time"):
+        y.backward()
+
+
+def test_backward_nonscalar_requires_grad_tensor():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError, match="single element"):
+        y.backward()
+    y = x * 2
+    y.backward(pt.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_stop_gradient_blocks():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = pt.to_tensor(3.0)  # stop_gradient=True
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4.0)  # only through z=y*x
+
+
+def test_no_grad():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    with pt.no_grad():
+        y = x * x
+    assert y.stop_gradient is True
+    assert y._grad_node is None
+
+
+def test_hooks():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)  # 3 * 2
+
+
+def test_paddle_grad_api():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = pt.to_tensor(3.0, stop_gradient=False)
+    z = x * x * y
+    gx, gy = pt.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), 12.0)
+    np.testing.assert_allclose(gy.numpy(), 4.0)
+    assert x.grad is None  # grad() does not touch .grad
+
+
+def test_paddle_grad_unused():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    u = pt.to_tensor(1.0, stop_gradient=False)
+    z = x * 2
+    with pytest.raises(RuntimeError, match="unused"):
+        pt.grad(z, [u])
+    (g,) = pt.grad(x * 2, [u], allow_unused=True)
+    assert g is None
+
+
+def test_inplace_add_rebind():
+    # After x.add_(y), grads flow through both the old and new value correctly
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    w = pt.to_tensor(5.0, stop_gradient=False)
+    a = x * w       # uses old x
+    x.add_(pt.to_tensor(1.0))  # x becomes 3, tape-rebound
+    b = x * 2       # uses new x: d b/d(old x) = 2
+    (a + b).backward()
+    np.testing.assert_allclose(w.grad.numpy(), 2.0)
+
+
+def test_setitem_grad():
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    v = pt.to_tensor(10.0, stop_gradient=False)
+    y = x * 2
+    y[1] = v
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+    np.testing.assert_allclose(v.grad.numpy(), 1.0)
+
+
+def test_pylayer():
+    class Double(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = pt.to_tensor(3.0, stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), 6.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+
+def test_engine_vs_jax_grad_mlp():
+    """Full small-MLP tape vs direct jax.grad."""
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(4, 8).astype(np.float32)
+    w2 = rng.randn(8, 2).astype(np.float32)
+    x = rng.randn(3, 4).astype(np.float32)
+
+    def f(wt1, wt2, xt):
+        h = pt.tanh(xt @ wt1)
+        return (h @ wt2).sum()
+
+    check_grad(f, [w1, w2, x])
+
+
+def test_deep_chain():
+    x = pt.to_tensor(1.0, stop_gradient=False)
+    y = x
+    for _ in range(200):
+        y = y * 1.01
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.01 ** 200, rtol=1e-4)
+
+
+def test_inplace_under_no_grad_keeps_trainable():
+    # code-review finding: parameter updated in-place under no_grad must stay trainable
+    w = pt.Parameter(np.ones((2,), np.float32))
+    with pt.no_grad():
+        w.add_(pt.to_tensor([0.5, 0.5]))
+    assert w.stop_gradient is False
+    (w * 2).sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [2.0, 2.0])
+
+
+def test_single_element_tuple_output_backward():
+    # code-review finding: 1-element tuple outputs must round-trip the vjp
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    (part,) = pt.split(x.reshape([1, 3]), 1, axis=0)
+    part.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 1])
+
+
+def test_split_nondivisible_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        pt.split(pt.ones([5]), 2)
+
+
+def test_bitwise_operators():
+    a = pt.to_tensor([6])
+    b = pt.to_tensor([3])
+    assert (a & b).tolist() == [2]
+    assert (a | b).tolist() == [7]
+    assert (a ^ b).tolist() == [5]

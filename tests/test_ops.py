@@ -1,0 +1,244 @@
+"""Op behavior tests against numpy references (OpTest pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(42)
+
+
+def _f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestMath:
+    def test_elementwise(self):
+        a, b = _f32(3, 4), _f32(3, 4)
+        check_output(pt.add, np.add, [a, b])
+        check_output(pt.subtract, np.subtract, [a, b])
+        check_output(pt.multiply, np.multiply, [a, b])
+        check_output(pt.divide, np.divide, [a, b + 3.0])
+        check_output(pt.maximum, np.maximum, [a, b])
+        check_output(pt.exp, np.exp, [a])
+        check_output(pt.tanh, np.tanh, [a])
+        check_output(pt.abs, np.abs, [a])
+        check_output(pt.sqrt, np.sqrt, [np.abs(a) + 0.1])
+        check_output(pt.log, np.log, [np.abs(a) + 0.1])
+        check_output(lambda x: pt.clip(x, -0.5, 0.5), lambda x: np.clip(x, -0.5, 0.5), [a])
+
+    def test_broadcasting(self):
+        a, b = _f32(3, 1, 4), _f32(2, 1)
+        check_output(pt.add, np.add, [a, b])
+        check_grad(lambda x, y: pt.add(x, y).sum(), [a, b])
+
+    def test_elementwise_grads(self):
+        a, b = _f32(3, 4), np.abs(_f32(3, 4)) + 0.5
+        check_grad(pt.multiply, [a, b])
+        check_grad(pt.divide, [a, b])
+        check_grad(pt.tanh, [a])
+        check_grad(pt.sigmoid, [a])
+        check_grad(pt.exp, [a], numeric=False)
+
+    def test_matmul(self):
+        a, b = _f32(5, 3), _f32(3, 7)
+        check_output(pt.matmul, np.matmul, [a, b])
+        check_grad(pt.matmul, [a, b])
+        # batched
+        a, b = _f32(2, 5, 3), _f32(2, 3, 7)
+        check_output(pt.matmul, np.matmul, [a, b])
+        # transpose flags
+        a, b = _f32(3, 5), _f32(3, 7)
+        check_output(
+            lambda x, y: pt.matmul(x, y, transpose_x=True),
+            lambda x, y: x.T @ y,
+            [a, b],
+        )
+
+    def test_scale(self):
+        a = _f32(3)
+        check_output(lambda x: pt.scale(x, 2.0, 1.0), lambda x: 2 * x + 1, [a])
+        check_output(
+            lambda x: pt.scale(x, 2.0, 1.0, bias_after_scale=False), lambda x: 2 * (x + 1), [a]
+        )
+
+    def test_reductions(self):
+        a = _f32(3, 4, 5)
+        check_output(pt.sum, np.sum, [a])
+        check_output(lambda x: pt.sum(x, axis=1), lambda x: x.sum(1), [a])
+        check_output(lambda x: pt.mean(x, axis=[0, 2]), lambda x: x.mean((0, 2)), [a])
+        check_output(lambda x: pt.max(x, axis=1, keepdim=True), lambda x: x.max(1, keepdims=True), [a])
+        check_output(pt.prod, np.prod, [_f32(4)])
+        check_grad(lambda x: pt.mean(x, axis=1), [a])
+        check_grad(lambda x: pt.max(x, axis=2), [a])
+
+    def test_argmax_cumsum(self):
+        a = _f32(3, 4)
+        check_output(lambda x: pt.argmax(x, axis=1), lambda x: x.argmax(1), [a])
+        check_output(lambda x: pt.cumsum(x, axis=1), lambda x: x.cumsum(1), [a])
+        check_output(pt.logsumexp, lambda x: np.log(np.exp(x).sum()), [a])
+
+    def test_einsum(self):
+        a, b = _f32(3, 4), _f32(4, 5)
+        check_output(lambda x, y: pt.einsum("ij,jk->ik", x, y), lambda x, y: x @ y, [a, b])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = _f32(2, 3, 4)
+        check_output(lambda x: pt.reshape(x, [6, 4]), lambda x: x.reshape(6, 4), [a])
+        check_output(lambda x: pt.reshape(x, [-1, 4]), lambda x: x.reshape(-1, 4), [a])
+        check_output(lambda x: pt.transpose(x, [2, 0, 1]), lambda x: x.transpose(2, 0, 1), [a])
+        check_grad(lambda x: pt.transpose(x, [1, 0, 2]), [a])
+
+    def test_concat_split_stack(self):
+        a, b = _f32(2, 3), _f32(2, 3)
+        check_output(lambda x, y: pt.concat([x, y], axis=1), lambda x, y: np.concatenate([x, y], 1), [a, b])
+        check_output(lambda x, y: pt.stack([x, y]), lambda x, y: np.stack([x, y]), [a, b])
+        parts = pt.split(pt.to_tensor(_f32(6, 2)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = pt.split(pt.to_tensor(_f32(7, 2)), [2, 4, 1], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 4, 1]
+        parts = pt.split(pt.to_tensor(_f32(7, 2)), [2, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 5]
+        check_grad(lambda x, y: pt.concat([x, y], axis=0), [a, b])
+
+    def test_squeeze_expand_tile(self):
+        a = _f32(1, 3, 1)
+        check_output(pt.squeeze, np.squeeze, [a])
+        check_output(lambda x: pt.squeeze(x, axis=0), lambda x: x.squeeze(0), [a])
+        check_output(lambda x: pt.unsqueeze(x, 0), lambda x: x[None], [_f32(3)])
+        check_output(lambda x: pt.expand(x, [4, 3]), lambda x: np.broadcast_to(x, (4, 3)), [_f32(1, 3)])
+        check_output(lambda x: pt.expand(x, [4, -1]), lambda x: np.broadcast_to(x, (4, 3)), [_f32(1, 3)])
+        check_output(lambda x: pt.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)), [_f32(2, 2)])
+
+    def test_gather_scatter(self):
+        x = _f32(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda a: pt.gather(a, pt.to_tensor(idx)), lambda a: a[idx], [x])
+        check_grad(lambda a: pt.gather(a, pt.to_tensor(idx)), [x])
+        upd = _f32(2, 3)
+        out = pt.scatter(pt.to_tensor(x), pt.to_tensor(np.array([1, 3])), pt.to_tensor(upd))
+        ref = x.copy()
+        ref[[1, 3]] = upd
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_gather_nd(self):
+        x = _f32(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        check_output(lambda a: pt.gather_nd(a, pt.to_tensor(idx)), lambda a: a[[0, 2], [1, 3]], [x])
+
+    def test_index_select_take_along(self):
+        x = _f32(4, 5)
+        idx = np.array([1, 3])
+        check_output(lambda a: pt.index_select(a, pt.to_tensor(idx), axis=1), lambda a: a[:, idx], [x])
+        ia = np.argsort(x, axis=1)
+        check_output(
+            lambda a: pt.take_along_axis(a, pt.to_tensor(ia), axis=1),
+            lambda a: np.take_along_axis(a, ia, 1),
+            [x],
+        )
+
+    def test_where_pad_flip(self):
+        a, b = _f32(3, 4), _f32(3, 4)
+        cond = a > 0
+        check_output(lambda x, y: pt.where(pt.to_tensor(cond), x, y), lambda x, y: np.where(cond, x, y), [a, b])
+        check_output(
+            lambda x: pt.pad(x, [1, 2], value=1.0),
+            lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2)], constant_values=1.0),
+            [_f32(2, 3, 4)],
+        )
+        check_output(lambda x: pt.flip(x, axis=0), lambda x: np.flip(x, 0), [a])
+        check_output(lambda x: pt.roll(x, 1, axis=1), lambda x: np.roll(x, 1, 1), [a])
+
+    def test_topk_sort(self):
+        x = _f32(3, 6)
+        vals, idx = pt.topk(pt.to_tensor(x), 2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        check_output(lambda a: pt.sort(a, axis=1), lambda a: np.sort(a, 1), [x])
+        check_output(
+            lambda a: pt.argsort(a, axis=1), lambda a: np.argsort(a, 1), [x]
+        )
+
+    def test_tril_triu_cast(self):
+        x = _f32(4, 4)
+        check_output(pt.tril, np.tril, [x])
+        check_output(pt.triu, np.triu, [x])
+        y = pt.cast(pt.to_tensor(x), "float64")
+        assert str(y.dtype) == "float64"
+
+    def test_unique_masked_select(self):
+        x = np.array([3, 1, 2, 1, 3])
+        out = pt.unique(pt.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+        m = np.array([True, False, True, False, True])
+        out = pt.masked_select(pt.to_tensor(x.astype(np.float32)), pt.to_tensor(m))
+        np.testing.assert_allclose(out.numpy(), [3, 2, 3])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a, b = _f32(3), _f32(3)
+        check_output(pt.equal, np.equal, [a, a])
+        check_output(pt.greater_than, np.greater, [a, b])
+        check_output(pt.logical_and, np.logical_and, [a > 0, b > 0])
+        assert pt.isnan(pt.to_tensor([np.nan, 1.0])).tolist() == [True, False]
+        assert pt.isfinite(pt.to_tensor([np.inf, 1.0])).tolist() == [False, True]
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        x = _f32(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        check_output(pt.norm, lambda a: np.linalg.norm(a), [x])
+        check_output(pt.det, np.linalg.det, [x], atol=1e-3, rtol=1e-3)
+        check_output(pt.inverse, np.linalg.inv, [x], atol=1e-4, rtol=1e-4)
+        check_output(pt.trace, np.trace, [x])
+        check_grad(pt.det, [x], atol=1e-2, rtol=1e-2)
+
+    def test_solve_cholesky(self):
+        a = _f32(3, 3)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        b = _f32(3, 2)
+        check_output(pt.solve, np.linalg.solve, [spd, b], atol=1e-4, rtol=1e-4)
+        check_output(pt.cholesky, np.linalg.cholesky, [spd], atol=1e-4, rtol=1e-4)
+
+    def test_svd_qr(self):
+        x = _f32(4, 3)
+        u, s, vh = pt.svd(pt.to_tensor(x))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), x, atol=1e-4)
+        q, r = pt.qr(pt.to_tensor(x))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-4)
+
+    def test_bincount_histogram(self):
+        x = np.array([0, 1, 1, 3])
+        np.testing.assert_array_equal(pt.bincount(pt.to_tensor(x)).numpy(), [1, 2, 0, 1])
+
+
+class TestRandom:
+    def test_shapes_and_ranges(self):
+        u = pt.uniform([100], min=0.0, max=1.0)
+        assert u.shape == [100]
+        assert float(u.numpy().min()) >= 0 and float(u.numpy().max()) <= 1
+        n = pt.randn([1000])
+        assert abs(float(n.numpy().mean())) < 0.2
+        r = pt.randint(0, 10, [50])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = pt.randperm(10)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
+
+    def test_seed_determinism(self):
+        pt.seed(7)
+        a = pt.randn([4]).numpy()
+        pt.seed(7)
+        b = pt.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestStat:
+    def test_std_var_median(self):
+        x = _f32(3, 5)
+        check_output(pt.var, lambda a: a.var(ddof=1), [x])
+        check_output(pt.std, lambda a: a.std(ddof=1), [x])
+        check_output(pt.median, np.median, [x])
+        check_output(lambda a: pt.quantile(a, 0.5), lambda a: np.quantile(a, 0.5), [x])

@@ -1,0 +1,117 @@
+"""Tensor basics: creation, properties, conversion, indexing, operators."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_defaults():
+    t = pt.to_tensor([1.0, 2.0, 3.0])
+    assert t.shape == [3]
+    assert str(t.dtype) == "float32"
+    assert t.stop_gradient is True
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_to_tensor_int():
+    t = pt.to_tensor([1, 2, 3])
+    assert "int" in str(t.dtype)
+    assert t.tolist() == [1, 2, 3]
+
+
+def test_dtype_cast():
+    t = pt.to_tensor([1.5, 2.5])
+    i = t.astype("int32")
+    assert str(i.dtype) == "int32"
+    b = t.astype(pt.bfloat16)
+    assert "bfloat16" in str(b.dtype)
+
+
+def test_creation_ops():
+    assert pt.zeros([2, 3]).shape == [2, 3]
+    assert pt.ones([4]).numpy().sum() == 4
+    assert pt.full([2, 2], 7).numpy()[0, 0] == 7
+    assert pt.arange(5).tolist() == [0, 1, 2, 3, 4]
+    assert pt.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(pt.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_like_ops():
+    x = pt.ones([2, 3])
+    assert pt.zeros_like(x).shape == [2, 3]
+    assert pt.full_like(x, 2.0).numpy()[0, 0] == 2.0
+
+
+def test_operators():
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a + 1).numpy(), [2, 3])
+    np.testing.assert_allclose((2 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+
+
+def test_comparison_operators():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([2.0, 2.0, 2.0])
+    assert (a < b).tolist() == [True, False, False]
+    assert (a == b).tolist() == [False, True, False]
+    assert (a >= b).tolist() == [False, True, True]
+
+
+def test_matmul_operator():
+    a = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+
+
+def test_getitem():
+    x = pt.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(x[0].numpy(), x.numpy()[0])
+    np.testing.assert_allclose(x[:, 1].numpy(), x.numpy()[:, 1])
+    np.testing.assert_allclose(x[0, 1, 2].numpy(), x.numpy()[0, 1, 2])
+    np.testing.assert_allclose(x[..., -1].numpy(), x.numpy()[..., -1])
+    idx = pt.to_tensor([0, 1])
+    np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 1]])
+
+
+def test_setitem():
+    x = pt.zeros([3, 3])
+    x[0, 0] = 5.0
+    assert x.numpy()[0, 0] == 5.0
+    x[1] = pt.ones([3])
+    np.testing.assert_allclose(x.numpy()[1], [1, 1, 1])
+
+
+def test_item_and_len():
+    assert pt.to_tensor(3.5).item() == pytest.approx(3.5)
+    assert len(pt.zeros([5, 2])) == 5
+
+
+def test_tensor_methods_patched():
+    x = pt.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    assert x.sum().ndim == 0
+    assert x.mean(axis=0).shape == [4]
+    assert x.reshape([4, 3]).shape == [4, 3]
+    assert x.transpose([1, 0]).shape == [4, 3]
+    assert x.unsqueeze(0).shape == [1, 3, 4]
+    assert x.flatten().shape == [12]
+
+
+def test_parameter():
+    p = pt.Parameter(np.zeros((2, 2), np.float32))
+    assert p.stop_gradient is False
+    assert p.trainable is True
+
+
+def test_detach_and_clone():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient is True
+    c = x.clone()
+    assert not c.stop_gradient
