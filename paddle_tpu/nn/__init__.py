@@ -1,1 +1,25 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.nn — neural network layers.
+
+reference parity: python/paddle/nn/__init__.py (layer classes exported flat,
+``functional`` as a sub-namespace, ``initializer`` sub-package).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer_base import Layer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+from .layer.activation import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from .layer import (  # noqa: F401
+    activation, common, container, conv, loss, norm, pooling, rnn, transformer,
+)

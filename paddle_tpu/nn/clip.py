@@ -1,0 +1,82 @@
+"""Gradient clipping.
+
+reference parity: python/paddle/nn/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm). The optimizer calls ``clip(params_grads)`` before the
+update — global-norm clip is one fused reduction over all grads (XLA turns it
+into a single pass over HBM).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g._value.astype(jnp.float32) ** 2))
+            factor = jnp.where(norm > self.clip_norm, self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._value * factor).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float, group_name: str = "default_group",
+                 auto_skip_clip: bool = False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        sq_sum = None
+        clippable = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            s = jnp.sum(g._value.astype(jnp.float32) ** 2)
+            sq_sum = s if sq_sum is None else sq_sum + s
+            clippable.append(id(p))
+        if sq_sum is None:
+            return params_grads
+        global_norm = jnp.sqrt(sq_sum)
+        factor = jnp.where(
+            global_norm > self.clip_norm,
+            self.clip_norm / jnp.maximum(global_norm, 1e-12),
+            1.0,
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None or id(p) not in clippable:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * factor).astype(g._value.dtype))))
+        return out

@@ -1,0 +1,241 @@
+"""Activation functionals.
+
+reference parity: python/paddle/nn/functional/activation.py backed by phi
+activation kernels (paddle/phi/kernels/activation_kernel.cc). Each is one pure
+jax.nn/jnp expression routed through the autograd tape; XLA fuses them into
+neighbouring matmuls on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._apply import unary
+
+__all__ = [
+    "celu", "elu", "gelu", "glu", "gumbel_softmax", "hardshrink", "hardsigmoid",
+    "hardswish", "hardtanh", "leaky_relu", "log_sigmoid", "log_softmax",
+    "maxout", "mish", "prelu", "relu", "relu_", "relu6", "rrelu", "selu",
+    "sigmoid", "silu", "softmax", "softmax_", "softplus", "softshrink",
+    "softsign", "swish", "tanh", "tanh_", "tanhshrink", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return unary(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    from ...autograd.engine import inplace_rebind
+
+    return inplace_rebind(x, relu(x))
+
+
+def relu6(x, name=None):
+    return unary(jax.nn.relu6, x, name="relu6")
+
+
+def sigmoid(x, name=None):
+    return unary(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return unary(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return unary(jnp.tanh, x, name="tanh")
+
+
+def tanh_(x, name=None):
+    from ...autograd.engine import inplace_rebind
+
+    return inplace_rebind(x, tanh(x))
+
+
+def tanhshrink(x, name=None):
+    return unary(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def gelu(x, approximate: bool = False, name=None):
+    return unary(lambda a: jax.nn.gelu(a, approximate=approximate), x, name="gelu")
+
+
+def silu(x, name=None):
+    return unary(jax.nn.silu, x, name="silu")
+
+
+def swish(x, name=None):
+    return unary(jax.nn.silu, x, name="swish")
+
+
+def mish(x, name=None):
+    return unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def elu(x, alpha: float = 1.0, name=None):
+    return unary(lambda a: jax.nn.elu(a, alpha=alpha), x, name="elu")
+
+
+def celu(x, alpha: float = 1.0, name=None):
+    return unary(lambda a: jax.nn.celu(a, alpha=alpha), x, name="celu")
+
+
+def selu(
+    x,
+    scale: float = 1.0507009873554804934193349852946,
+    alpha: float = 1.6732632423543772848170429916717,
+    name=None,
+):
+    return unary(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, name="selu"
+    )
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None):
+    return unary(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+                 x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format: str = "NCHW", name=None):
+    from ...ops._apply import ensure_tensor
+    from ...autograd.engine import apply_op
+
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def fn(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+
+    return apply_op(fn, [x, weight], name="prelu")
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = False, name=None):
+    if training:
+        from ...generator import default_generator
+
+        key = default_generator.next_key()
+
+        def fn(a):
+            slopes = jax.random.uniform(key, a.shape, a.dtype, minval=lower, maxval=upper)
+            return jnp.where(a >= 0, a, slopes * a)
+
+        return unary(fn, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0, name=None):
+    return unary(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold: float = 0.5, name=None):
+    return unary(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+                 x, name="hardshrink")
+
+
+def softshrink(x, threshold: float = 0.5, name=None):
+    return unary(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)).astype(a.dtype),
+        x, name="softshrink",
+    )
+
+
+def hardsigmoid(x, slope: float = 0.1666667, offset: float = 0.5, name=None):
+    return unary(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0, name=None):
+    return unary(
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta),
+        x, name="softplus",
+    )
+
+
+def softsign(x, name=None):
+    return unary(jax.nn.soft_sign, x, name="softsign")
+
+
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0, name=None):
+    return unary(lambda a: jnp.where(a > threshold, a, value).astype(a.dtype),
+                 x, name="thresholded_relu")
+
+
+def softmax(x, axis: int = -1, dtype=None, name=None):
+    from ... import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+
+    return unary(fn, x, name="softmax")
+
+
+def softmax_(x, axis: int = -1, dtype=None, name=None):
+    from ...autograd.engine import inplace_rebind
+
+    return inplace_rebind(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis: int = -1, dtype=None, name=None):
+    from ... import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return unary(fn, x, name="log_softmax")
+
+
+def glu(x, axis: int = -1, name=None):
+    return unary(lambda a: jax.nn.glu(a, axis=axis), x, name="glu")
+
+
+def maxout(x, groups: int, axis: int = 1, name=None):
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return unary(fn, x, name="maxout")
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, name=None):
+    from ...generator import default_generator
+
+    key = default_generator.next_key()
+
+    def fn(a):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, a.shape, jnp.float32, minval=1e-20, maxval=1.0)
+        )).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(
+                jnp.argmax(y, axis=axis), y.shape[axis], dtype=y.dtype, axis=axis
+            )
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return unary(fn, x, name="gumbel_softmax")

@@ -1,0 +1,130 @@
+"""Attention functionals.
+
+reference parity: FlashAttention integration
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:213, dynload/flashattn.h) and
+nn.functional.scaled_dot_product_attention. On TPU the fused kernel is a
+Pallas flash-attention (paddle_tpu/ops/pallas/flash_attention.py) used when
+running on TPU hardware; elsewhere (CPU tests) the reference jnp einsum path
+runs — same math, XLA-fused.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "flash_attn_unpadded"]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, key=None):
+    """[B, S, H, D] paddle flash-attn layout."""
+    qh = jnp.swapaxes(q, 1, 2)  # B H S D
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # B S H D
+
+
+def _use_pallas(q_value) -> bool:
+    try:
+        dev = list(q_value.devices())[0]
+        return dev.platform == "tpu"
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle flash-attn layout)."""
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    drop = dropout_p if training else 0.0
+    rng_key = None
+    if drop > 0.0:
+        from ...generator import default_generator
+
+        rng_key = default_generator.next_key()
+
+    if (
+        attn_mask is None
+        and drop == 0.0
+        and not isinstance(query._value, jax.core.Tracer)
+        and _use_pallas(query._value)
+    ):
+        from ...ops.pallas import flash_attention as fa
+
+        def fn(q, k, v):
+            return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+
+        return apply_op(fn, [query, key, value], name="flash_attention")
+
+    ins = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        ins.append(ensure_tensor(attn_mask))
+
+    def fn(q, k, v, *m):
+        mask = m[0] if has_mask else None
+        return _sdpa_ref(q, k, v, mask, drop, is_causal, None, rng_key)
+
+    return apply_op(fn, ins, name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = False,
+                    return_softmax: bool = False, fixed_seed_offset=None,
+                    rng_name: str = "", training: bool = True, name=None):
+    """reference: paddle.nn.functional.flash_attention.flash_attention
+    (phi flash_attn kernel). Returns (out, softmax_lse placeholder)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale: float = None,
+                        dropout: float = 0.0, causal: bool = False,
+                        return_softmax: bool = False, training: bool = True, name=None):
+    """Varlen flash attention (reference: flash_attn_unpadded). Implemented by
+    segment-masked dense attention: tokens are packed [total, H, D] and
+    cu_seqlens delimit sequences."""
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    cu_q = ensure_tensor(cu_seqlens_q)
+
+    def fn(q, k, v, cu):
+        total, h, d = q.shape
+        seg = jnp.cumsum(
+            jnp.zeros((total,), jnp.int32).at[cu[1:-1]].add(1)
+        )  # segment id per token
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+        same = seg[:, None] == seg[None, :]
+        if causal:
+            same = same & (jnp.arange(total)[:, None] >= jnp.arange(total)[None, :])
+        logits = jnp.where(same[None], logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    from ...tensor import Tensor
+
+    out = apply_op(fn, [query, key, value, Tensor(cu_q._value, stop_gradient=True)],
+                   name="flash_attn_unpadded")
+    return out, None

@@ -1,0 +1,421 @@
+"""Common functionals: linear, dropout, embedding, padding, interpolate…
+
+reference parity: python/paddle/nn/functional/common.py + input.py
+(one_hot/embedding) + vision.py (pixel_shuffle). The TPU notes that matter:
+``linear`` is a plain jnp.dot so XLA maps it straight onto the MXU; ``dropout``
+consumes a threefry key from the global generator so it is deterministic and
+jit-capturable; padding/resize are lax ops with static attrs.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...generator import default_generator
+from ...ops._apply import ensure_tensor, unary
+from ...tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "pad", "zeropad2d", "interpolate", "upsample", "bilinear",
+    "cosine_similarity", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "unfold", "fold", "label_smooth", "class_center_sample", "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle weight layout [in, out]
+    (reference: nn/functional/common.py linear → phi matmul+add)."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if bias is None:
+        return apply_op(lambda a, w: jnp.matmul(a, w), [x, weight], name="linear")
+    bias = ensure_tensor(bias)
+    return apply_op(lambda a, w, b: jnp.matmul(a, w) + b, [x, weight, bias], name="linear")
+
+
+def dropout(x, p: float = 0.5, axis=None, training: bool = True,
+            mode: str = "upscale_in_train", name=None):
+    """reference: nn/functional/common.py dropout (phi dropout kernel).
+    Threefry key is consumed eagerly so repeated calls differ."""
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return unary(lambda a: a * (1.0 - p), x, name="dropout_infer")
+        x = ensure_tensor(x)
+        return x
+    if p == 1.0:
+        return unary(lambda a: jnp.zeros_like(a), x, name="dropout")
+    key = default_generator.next_key()
+
+    def fn(a):
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = tuple(
+                a.shape[i] if i in axes else 1 for i in range(a.ndim)
+            )
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return unary(fn, x, name="dropout")
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True, name=None):
+    """SELU-preserving dropout (reference: common.py alpha_dropout)."""
+    if not training or p == 0.0:
+        return ensure_tensor(x)
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    key = default_generator.next_key()
+
+    def fn(arr):
+        keep = jax.random.bernoulli(key, 1.0 - p, arr.shape)
+        return (a * jnp.where(keep, arr, alpha_p) + b).astype(arr.dtype)
+
+    return unary(fn, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx: Optional[int] = None,
+              sparse: bool = False, name=None):
+    """Gather rows of weight (reference: functional/input.py embedding →
+    phi embedding kernel). padding_idx rows get zero gradient by zeroing the
+    row in the lookup table inside the differentiated fn."""
+    del sparse  # no SelectedRows on TPU; dense grads (XLA scatter-add)
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def fn(ids, w):
+        if padding_idx is not None:
+            pidx = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            w = w.at[pidx].set(0.0)
+        return jnp.take(w, ids.astype(jnp.int32), axis=0)
+
+    x_only = Tensor(x._value, stop_gradient=True)
+    return apply_op(fn, [x_only, weight], name="embedding")
+
+
+def one_hot(x, num_classes: int, name=None):
+    x = ensure_tensor(x)
+    return apply_op(
+        lambda ids: jax.nn.one_hot(ids.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        [Tensor(x._value, stop_gradient=True)], name="one_hot",
+    )
+
+
+def _norm_pad(pad, ndim, data_format):
+    """Convert paddle pad spec (per-dim low/high, innermost-first) to
+    jnp.pad config."""
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(pad)
+    cfg = [(0, 0)] * ndim
+    # paddle: pad applies to the last len(pad)//2 spatial dims, ordered from
+    # the innermost spatial dim outward when NCHW: [l, r, t, b] pads W then H
+    spatial_axes = list(range(2, ndim)) if data_format.startswith("NC") else list(range(1, ndim - 1))
+    n = len(pad) // 2
+    axes = spatial_axes[::-1][:n]
+    for i, ax in enumerate(axes):
+        cfg[ax] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    return cfg
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", name=None):
+    """reference: nn/functional/common.py pad (phi pad3d kernel)."""
+    x = ensure_tensor(x)
+    ndim = x.ndim
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * ndim:
+        # full-tensor pad spec, innermost-dim-first pairs
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(ndim)]
+    else:
+        cfg = _norm_pad(pad, ndim, data_format)
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return unary(fn, x, name="pad")
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def _resize_nearest(a, out_hw, data_format):
+    if data_format == "NCHW":
+        n, c, h, w = a.shape
+        oh, ow = out_hw
+        rows = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        cols = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        return a[:, :, rows][:, :, :, cols]
+    n, h, w, c = a.shape
+    oh, ow = out_hw
+    rows = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+    cols = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+    return a[:, rows][:, :, cols]
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, align_mode: int = 0,
+                data_format: str = "NCHW", name=None):
+    """reference: nn/functional/common.py interpolate (phi interp kernels).
+    bilinear/bicubic/trilinear ride jax.image.resize; nearest is an index
+    gather (matches paddle's floor-sampling when align_corners=False)."""
+    x = ensure_tensor(x)
+    nd = x.ndim
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:]
+        channel_last = False
+    else:
+        spatial = x.shape[1:-1]
+        channel_last = True
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in size.numpy().reshape(-1)]
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    else:
+        if isinstance(scale_factor, (numbers.Number,)):
+            scale_factor = [scale_factor] * len(spatial)
+        out_spatial = [int(math.floor(s * f)) for s, f in zip(spatial, scale_factor)]
+
+    if mode == "nearest" and nd == 4 and not align_corners:
+        return unary(lambda a: _resize_nearest(a, out_spatial, data_format), x,
+                     name="interp_nearest")
+
+    method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic",
+              "trilinear": "trilinear", "linear": "linear", "area": "linear"}[mode]
+    if method == "trilinear":
+        method = "linear"
+
+    def fn(a):
+        if channel_last:
+            out_shape = (a.shape[0],) + tuple(out_spatial) + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tuple(out_spatial)
+        if align_corners and method in ("linear", "bilinear", "bicubic"):
+            # jax.image.resize has no align_corners; emulate via
+            # scale_and_translate: want in_coord = out_coord * (in-1)/(out-1),
+            # while the kernel maps in j -> out j*scale + translation with
+            # half-pixel centers — solving gives translation = 0.5*(1-scale).
+            in_spatial = spatial
+            scale = [
+                (o - 1) / (i - 1) if i > 1 and o > 1 else 1.0
+                for i, o in zip(in_spatial, out_spatial)
+            ]
+            trans = [0.5 * (1.0 - s) for s in scale]
+            sdims = list(range(2, nd)) if not channel_last else list(range(1, nd - 1))
+            return jax.image.scale_and_translate(
+                a, out_shape, sdims,
+                jnp.array(scale, jnp.float32),
+                jnp.array(trans, jnp.float32),
+                method="bilinear" if method != "bicubic" else "bicubic",
+            ).astype(a.dtype)
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+
+    return unary(fn, x, name=f"interp_{mode}")
+
+
+def upsample(x, size=None, scale_factor=None, mode: str = "nearest",
+             align_corners: bool = False, align_mode: int = 0,
+             data_format: str = "NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear map y[b, o] = x1[b,:] W[o] x2[b,:]ᵀ (reference: common.py bilinear)."""
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def fn(a, b, w, *maybe_bias):
+        y = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            y = y + maybe_bias[0]
+        return y
+
+    ins = [x1, x2, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    return apply_op(fn, ins, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(fn, [x1, x2], name="cosine_similarity")
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12, name=None):
+    return unary(
+        lambda a: a / jnp.maximum(
+            jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p), epsilon
+        ),
+        x, name="normalize",
+    )
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return unary(fn, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return unary(fn, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return unary(fn, x, name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: common.py unfold → phi unfold kernel)."""
+    ks = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) else list(kernel_sizes)
+    st = [strides] * 2 if isinstance(strides, int) else list(strides)
+    dl = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    if isinstance(paddings, int):
+        pd = [paddings] * 4
+    elif len(paddings) == 2:
+        pd = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    else:
+        pd = list(paddings)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return unary(fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im — adjoint of unfold (reference: common.py fold)."""
+    os_ = [output_sizes] * 2 if isinstance(output_sizes, int) else list(output_sizes)
+    ks = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) else list(kernel_sizes)
+    st = [strides] * 2 if isinstance(strides, int) else list(strides)
+    dl = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    if isinstance(paddings, int):
+        pd = [paddings] * 4
+    elif len(paddings) == 2:
+        pd = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    else:
+        pd = list(paddings)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[2], pd[1]: pw - pd[3]]
+
+    return unary(fn, x, name="fold")
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        prior_dist = ensure_tensor(prior_dist)
+        return apply_op(
+            lambda l, p: (1 - epsilon) * l + epsilon * p.reshape((1,) * (l.ndim - 1) + (-1,)),
+            [label, prior_dist], name="label_smooth",
+        )
+    return unary(lambda l: (1 - epsilon) * l + epsilon / l.shape[-1], label,
+                 name="label_smooth")
+
+
+def class_center_sample(label, num_classes: int, num_samples: int, group=None):
+    """reference: common.py class_center_sample (PartialFC sampling)."""
+    label = ensure_tensor(label)
+    lbl = label._value
+    pos = jnp.unique(lbl, size=min(num_classes, int(lbl.size)), fill_value=-1)
+    pos = pos[pos >= 0]
+    n_pos = int(pos.size)
+    if n_pos >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        key = default_generator.next_key()
+        all_ids = jnp.arange(num_classes)
+        mask = jnp.isin(all_ids, pos, invert=True)
+        neg = all_ids[mask]
+        perm = jax.random.permutation(key, neg.shape[0])
+        sampled = jnp.concatenate([pos, neg[perm[: num_samples - n_pos]]])
+    sampled = jnp.sort(sampled)
+    remap = jnp.searchsorted(sampled, lbl)
+    return Tensor(remap), Tensor(sampled)

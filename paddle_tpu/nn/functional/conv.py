@@ -1,0 +1,226 @@
+"""Convolution functionals.
+
+reference parity: python/paddle/nn/functional/conv.py (phi conv kernels,
+paddle/phi/kernels/conv_kernel.h). On TPU every conv is one
+``lax.conv_general_dilated`` — XLA tiles it onto the MXU directly; there is no
+algo search (the reference's cudnn exhaustive-search/autotune machinery,
+phi/kernels/autotune/, is unnecessary here).
+
+Paddle layout conventions: input NCHW (default), weight [out_c, in_c/groups, *k].
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, strides, dilations, ksize, in_spatial):
+    """Paddle padding spec → lax padding list [(lo, hi)] * n or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            # XLA SAME semantics match paddle's SAME (pad evenly, extra at end)
+            return "SAME"
+        raise ValueError(f"unknown padding {padding}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            # [[lo, hi], ...] possibly including batch/channel dims
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        # [lo0, hi0, lo1, hi1, ...] paddle order per spatial dim
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if len(padding) == n + 2 and isinstance(padding[0], (list, tuple)):
+        return [tuple(p) for p in padding[2:]]
+    raise ValueError(f"bad padding spec {padding}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n, name):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+    ksize = weight.shape[2:]
+    pad = _norm_padding(padding, n, stride, dilation, ksize, None)
+
+    def fn(a, w, *mb):
+        # weight is paddle [out, in/g, *k] = OIHW; lax wants per rhs_spec
+        if channel_last and n == 2:
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        elif channel_last and n == 1:
+            w = jnp.transpose(w, (2, 1, 0))
+        elif channel_last and n == 3:
+            w = jnp.transpose(w, (2, 3, 4, 1, 0))
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec if not channel_last else rhs_spec, out_spec),
+            preferred_element_type=None,
+        )
+        if mb:
+            b = mb[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(ensure_tensor(bias))
+    return apply_op(fn, ins, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, data_format, output_size, n, name):
+    """Transposed conv via gradient-of-conv (lax.conv_transpose matches paddle
+    semantics with transpose_kernel for OIHW weights [in, out/g, *k])."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n) if output_padding != 0 or isinstance(output_padding, (list, tuple)) else (0,) * n
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+    ksize = weight.shape[2:]
+    pad = _norm_padding(padding, n, stride, dilation, ksize, None)
+
+    def fn(a, w, *mb):
+        # paddle conv_transpose weight layout: [in_c, out_c/groups, *k]
+        # implement as input-dilated conv with flipped kernel
+        if isinstance(pad, str):
+            pads = None  # SAME handled below
+        else:
+            pads = pad
+        k_eff = [dilation[i] * (ksize[i] - 1) + 1 for i in range(n)]
+        if pads is None:
+            in_sp = a.shape[2:] if not channel_last else a.shape[1:-1]
+            out_sp = [s * stride[i] for i, s in enumerate(in_sp)]
+            tot = [max(k_eff[i] - stride[i], 0) for i in range(n)]
+            pads = [(tot[i] // 2, tot[i] - tot[i] // 2) for i in range(n)]
+        extra = [0] * n
+        if output_size is not None:
+            # output_size acts as an output_padding: extend the high side so
+            # the transposed conv COMPUTES the extra rows (paddle semantics),
+            # rather than zero-padding them after the fact
+            target = [int(s) for s in (
+                output_size if isinstance(output_size, (list, tuple))
+                else [output_size] * n)]
+            in_sp = a.shape[2:] if not channel_last else a.shape[1:-1]
+            for i in range(n):
+                natural = ((in_sp[i] - 1) * stride[i] + k_eff[i]
+                           - pads[i][0] - pads[i][1] + opad[i])
+                extra[i] = target[i] - natural
+                if extra[i] < 0 or extra[i] >= stride[i] + dilation[i]:
+                    raise ValueError(
+                        f"invalid output_size {target[i]} for dim {i}: natural "
+                        f"size is {natural}")
+        lo_hi = [
+            (k_eff[i] - 1 - pads[i][0], k_eff[i] - 1 - pads[i][1] + opad[i] + extra[i])
+            for i in range(n)
+        ]
+        # flip spatial dims of kernel, swap in/out channels
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # paddle layout [in, out/g, *k] → lax layout [out, in/g, *k]
+            g = groups
+            wf = wf.reshape((g, w.shape[0] // g) + w.shape[1:])  # [g, in/g, out/g, *k]
+            wf = jnp.swapaxes(wf, 1, 2)  # [g, out/g, in/g, *k]
+            wf = wf.reshape((w.shape[1] * g, w.shape[0] // g) + w.shape[2:])
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)  # [out, in, *k]
+        if channel_last:
+            if n == 1:
+                wf = jnp.transpose(wf, (2, 1, 0))
+            elif n == 2:
+                wf = jnp.transpose(wf, (2, 3, 1, 0))
+            else:
+                wf = jnp.transpose(wf, (2, 3, 4, 1, 0))
+        out = lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * n, padding=lo_hi,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        )
+        if mb:
+            b = mb[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(ensure_tensor(bias))
+    return apply_op(fn, ins, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None,
+                     data_format: str = "NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, df, output_size, 1, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None,
+                     data_format: str = "NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, output_size, 2, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None,
+                     data_format: str = "NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, output_size, 3, "conv3d_transpose")

@@ -1,0 +1,480 @@
+"""Loss functionals.
+
+reference parity: python/paddle/nn/functional/loss.py (phi cross_entropy /
+bce / kldiv / … kernels). cross_entropy follows the reference's
+softmax_with_cross_entropy semantics (soft/hard labels, ignore_index,
+label smoothing) as one fused logsumexp expression — the form XLA fuses into
+the preceding matmul on TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "ctc_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "dice_loss", "npair_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  axis: int = -1, use_softmax: bool = True,
+                  label_smoothing: float = 0.0, name=None):
+    """reference: functional/loss.py cross_entropy (phi cross_entropy_with_softmax)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    has_w = weight is not None
+    ins = [input]
+    label_in = label if soft_label else Tensor(label._value, stop_gradient=True)
+    ins.append(label_in)
+    if has_w:
+        ins.append(ensure_tensor(weight))
+
+    def fn(logits, lbl, *wt):
+        ax = axis if axis >= 0 else logits.ndim + axis
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=ax)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12, None))
+        nclass = logits.shape[ax]
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if has_w:
+                w_b = jnp.sum(soft * wt[0], axis=ax)
+                loss = loss * w_b
+            return _reduce(loss, reduction)
+        idx = lbl.astype(jnp.int32)
+        if idx.ndim == logits.ndim:  # trailing 1 dim
+            idx = jnp.squeeze(idx, axis=ax)
+        valid = idx != ignore_index
+        safe_idx = jnp.where(valid, idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_idx, ax), axis=ax
+        ).squeeze(ax)
+        if label_smoothing > 0:
+            smooth_loss = -jnp.mean(logp, axis=ax)
+            loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+        else:
+            loss = -picked
+        loss = jnp.where(valid, loss, 0.0)
+        if has_w:
+            w_per = jnp.take(wt[0], safe_idx)
+            w_per = jnp.where(valid, w_per, 0.0)
+            loss = loss * w_per
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w_per), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, numeric_stable_mode: bool = True,
+                               return_softmax: bool = False, axis: int = -1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle keeps a trailing singleton dim on the hard-label path
+    from .. import functional as F
+
+    loss_keep = apply_op(lambda l: jnp.expand_dims(l, axis), [loss], name="unsqueeze") \
+        if not soft_label else loss
+    if return_softmax:
+        sm = F.softmax(logits, axis=axis)
+        return loss_keep, sm
+    return loss_keep
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean", name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    ins = [input, label]
+    has_w = weight is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+
+    def fn(p, y, *wt):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if has_w:
+            loss = loss * wt[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction: str = "mean", pos_weight=None, name=None):
+    logit = ensure_tensor(logit)
+    label = ensure_tensor(label)
+    ins = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+    if has_pw:
+        ins.append(ensure_tensor(pos_weight))
+
+    def fn(z, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean", name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    ins = [input, Tensor(label._value, stop_gradient=True)]
+    has_w = weight is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+
+    def fn(logp, idx, *wt):
+        idx = idx.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        if logp.ndim > 2:  # [N, C, d1..] -> move C last
+            lp = jnp.moveaxis(logp, 1, -1)
+        else:
+            lp = logp
+        picked = jnp.take_along_axis(lp, safe[..., None], axis=-1).squeeze(-1)
+        loss = -jnp.where(valid, picked, 0.0)
+        if has_w:
+            w_per = jnp.where(valid, jnp.take(wt[0], safe), 0.0)
+            loss = loss * w_per
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w_per), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="nll_loss")
+
+
+def mse_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction), [input, label],
+                    name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return mse_loss(input, label, reduction="none")
+
+
+def l1_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), [input, label],
+                    name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, b):
+        d = a - b
+        absd = jnp.abs(d)
+        loss = jnp.where(absd < delta, 0.5 * d * d / delta, absd - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, [input, label], name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(logp, y):
+        loss = jnp.where(y > 0, y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, [input, label], name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean", name=None):
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        [input, other, label], name="margin_ranking_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(
+        lambda a, y: _reduce(
+            jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0)), reduction
+        ),
+        [input, label], name="hinge_embedding_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean", name=None):
+    input1, input2, label = ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)
+
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, [input1, input2, label], name="cosine_embedding_loss")
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        [input, label], name="log_loss",
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    ins = [logit, label]
+    has_n = normalizer is not None
+    if has_n:
+        ins.append(ensure_tensor(normalizer))
+
+    def fn(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op(fn, [input, Tensor(label._value, stop_gradient=True)], name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    anchor, positive, labels = ensure_tensor(anchor), ensure_tensor(positive), ensure_tensor(labels)
+
+    def fn(a, p, y):
+        batch = a.shape[0]
+        sim = a @ p.T
+        y = y.reshape(-1)
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.sum(tgt * logp, axis=1).mean()
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * batch)
+        return xent + reg
+
+    return apply_op(fn, [anchor, positive, Tensor(labels._value, stop_gradient=True)],
+                    name="npair_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0, p: float = 2.0,
+                        epsilon: float = 1e-6, swap: bool = False,
+                        reduction: str = "mean", name=None):
+    input, positive, negative = map(ensure_tensor, (input, positive, negative))
+
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p + epsilon, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(fn, [input, positive, negative], name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin: float = 1.0, swap: bool = False,
+                                      reduction: str = "mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        from ...ops import minimum
+
+        dn = minimum(dn, distance_function(positive, negative))
+    dp, dn = ensure_tensor(dp), ensure_tensor(dn)
+    return apply_op(
+        lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+        [dp, dn], name="triplet_margin_with_distance_loss",
+    )
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ins = [input, label]
+    has_w = weight is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+
+    def fn(z, y, *wt):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if has_w:
+            loss = loss * wt[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, ins, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        [input, label], name="soft_margin_loss",
+    )
+
+
+def poisson_nll_loss(input, label, log_input: bool = True, full: bool = False,
+                     epsilon: float = 1e-8, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(z, y):
+        if log_input:
+            loss = jnp.exp(z) - y * z
+        else:
+            loss = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, [input, label], name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean", name=None):
+    input, label, variance = map(ensure_tensor, (input, label, variance))
+
+    def fn(mu, y, var):
+        var = jnp.clip(var, epsilon, None)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+
+    return apply_op(fn, [input, label, variance], name="gaussian_nll_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False):
+    """CTC via the standard alpha-recursion in log space, vectorized with
+    lax.scan over time (reference: functional/loss.py ctc_loss → warpctc).
+    log_probs: [T, N, C] (paddle layout); labels: [N, S] padded."""
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def fn(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lbl.shape[1]
+        # extended label sequence with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((N, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1).squeeze(1)
+        alpha0 = alpha0.at[:, 1].set(first_lbl)
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze alphas past each sequence's input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha_T, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        # loss = -log(alpha[last_blank] + alpha[last_label])
+        last = 2 * lbl_len.astype(jnp.int32)  # index of final blank
+        aN = jnp.take_along_axis(alpha_T, last[:, None], axis=1).squeeze(1)
+        aN1 = jnp.take_along_axis(
+            alpha_T, jnp.maximum(last - 1, 0)[:, None], axis=1
+        ).squeeze(1)
+        ll = jnp.logaddexp(aN, jnp.where(lbl_len > 0, aN1, neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(
+        fn,
+        [log_probs, Tensor(labels._value, stop_gradient=True),
+         Tensor(input_lengths._value, stop_gradient=True),
+         Tensor(label_lengths._value, stop_gradient=True)],
+        name="ctc_loss",
+    )

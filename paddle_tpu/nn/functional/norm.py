@@ -1,0 +1,223 @@
+"""Normalization functionals.
+
+reference parity: python/paddle/nn/functional/norm.py (phi batch_norm /
+layer_norm / instance_norm / group_norm kernels). On TPU these are pure
+jnp reductions — XLA fuses them with surrounding elementwise work; no cudnn
+BN path is needed. Running-stat mutation happens in the Layer (layer/norm.py),
+keeping these functionals pure.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+
+__all__ = [
+    "batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm",
+]
+
+
+def _stat_axes(ndim, data_format):
+    if data_format.startswith("NC"):
+        return tuple(i for i in range(ndim) if i != 1), 1
+    return tuple(range(ndim - 1)), ndim - 1
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9, epsilon: float = 1e-5,
+               data_format: str = "NCHW", use_global_stats: Optional[bool] = None,
+               name=None):
+    """Pure functional BN. In training mode, updates running stats IN-PLACE on
+    the passed tensors (reference semantics: phi batch_norm kernel writes
+    mean_out/variance_out). Stat update is done under stop_gradient."""
+    x = ensure_tensor(x)
+    running_mean = ensure_tensor(running_mean)
+    running_var = ensure_tensor(running_var)
+    axes, ch_axis = _stat_axes(x.ndim, data_format)
+    use_batch_stats = training and not use_global_stats
+
+    def shape_for(v, nd):
+        s = [1] * nd
+        s[ch_axis] = -1
+        return v.reshape(s)
+
+    if use_batch_stats:
+        xv = x._value
+        mean = jnp.mean(xv, axis=axes)
+        var = jnp.var(xv, axis=axes)
+        # update running stats (host-side mutation; recorded by jit tracer)
+        m = momentum
+        running_mean._set_value((m * running_mean._value + (1 - m) * mean).astype(running_mean._value.dtype))
+        running_var._set_value((m * running_var._value + (1 - m) * var).astype(running_var._value.dtype))
+        mean_t, var_t = Tensor(mean), Tensor(var)
+    else:
+        mean_t, var_t = running_mean, running_var
+
+    ins = [x, mean_t, var_t]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+    if has_b:
+        ins.append(ensure_tensor(bias))
+
+    def fn(a, mu, v2, *wb):
+        nd = a.ndim
+        mu_ = shape_for(jnp.asarray(mu), nd)
+        v_ = shape_for(jnp.asarray(v2), nd)
+        y = (a - mu_) * jnp.asarray(1.0 / jnp.sqrt(v_ + epsilon), a.dtype)
+        i = 0
+        if has_w:
+            y = y * shape_for(wb[i], nd)
+            i += 1
+        if has_b:
+            y = y + shape_for(wb[i], nd)
+        return y.astype(a.dtype)
+
+    # mean/var used for normalization must participate in autograd when they
+    # came from the batch (paddle semantics): recompute them inside fn instead
+    if use_batch_stats:
+        ins2 = [x] + ins[3:]
+
+        def fn_train(a, *wb):
+            mu = jnp.mean(a, axis=axes, keepdims=True)
+            v2 = jnp.var(a, axis=axes, keepdims=True)
+            y = (a - mu) / jnp.sqrt(v2 + epsilon)
+            i = 0
+            if has_w:
+                y = y * shape_for(wb[i], a.ndim)
+                i += 1
+            if has_b:
+                y = y + shape_for(wb[i], a.ndim)
+            return y.astype(a.dtype)
+
+        return apply_op(fn_train, ins2, name="batch_norm")
+    return apply_op(fn, ins, name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None,
+               epsilon: float = 1e-5, name=None):
+    """reference: functional/norm.py layer_norm (phi layer_norm kernel)."""
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+    if has_b:
+        ins.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        mu = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        y = (a - mu) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            y = y * wb[i]
+            i += 1
+        if has_b:
+            y = y + wb[i]
+        return y.astype(a.dtype)
+
+    return apply_op(fn, ins, name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats: bool = True, momentum: float = 0.9,
+                  eps: float = 1e-5, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    # stats per (N, C) over spatial dims
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sp_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(range(1, x.ndim - 1))
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+    if has_b:
+        ins.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        mu = jnp.mean(a, axis=sp_axes, keepdims=True)
+        var = jnp.var(a, axis=sp_axes, keepdims=True)
+        y = (a - mu) / jnp.sqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        i = 0
+        if has_w:
+            y = y * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            y = y + wb[i].reshape(shape)
+        return y.astype(a.dtype)
+
+    return apply_op(fn, ins, name="instance_norm")
+
+
+def group_norm(x, num_groups: int, epsilon: float = 1e-5, weight=None, bias=None,
+               data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = not data_format.startswith("NC")
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(ensure_tensor(weight))
+    if has_b:
+        ins.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        rest = a_t.shape[2:]
+        g = a_t.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, g.ndim))
+        mu = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        y = ((g - mu) / jnp.sqrt(var + epsilon)).reshape(a_t.shape)
+        shape = [1, c] + [1] * (a_t.ndim - 2)
+        i = 0
+        if has_w:
+            y = y * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            y = y + wb[i].reshape(shape)
+        if channel_last:
+            y = jnp.moveaxis(y, 1, -1)
+        return y.astype(a.dtype)
+
+    return apply_op(fn, ins, name="group_norm")
+
+
+def local_response_norm(x, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+
+    def fn(a):
+        sq = a * a
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            sl = [slice(None)] * a.ndim
+            sl[ch_axis] = slice(i, i + a.shape[ch_axis])
+            acc = acc + padded[tuple(sl)]
+        return a / ((k + alpha * acc) ** beta)
+
+    from ...ops._apply import unary
+
+    return unary(fn, x, name="local_response_norm")

@@ -1,0 +1,352 @@
+"""Pooling functionals.
+
+reference parity: python/paddle/nn/functional/pooling.py (phi pool kernels).
+All windows ride ``lax.reduce_window`` — the XLA-native pooling primitive that
+tiles onto the TPU vector unit; adaptive pools compute static per-output
+windows (shapes are static under jit, so this unrolls into fused slices).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._apply import ensure_tensor, unary
+from ...autograd.engine import apply_op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad pool padding {padding}")
+
+
+def _window(a_ndim, ksize, stride, n, channel_last):
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _full_pad(pad, n, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return [(0, 0)] + list(pad) + [(0, 0)]
+    return [(0, 0), (0, 0)] + list(pad)
+
+
+def _max_pool(x, kernel_size, stride, padding, ceil_mode, n, data_format, return_mask=False):
+    x = ensure_tensor(x)
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    ksize = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    pad = _pool_pad(padding, n)
+
+    def fn(a):
+        dims, strides = _window(a.ndim, ksize, stride, n, channel_last)
+        p = _full_pad(pad, n, channel_last)
+        if isinstance(p, str):
+            pcfg = p
+        else:
+            pcfg = p
+            if ceil_mode:
+                pcfg = [list(q) for q in pcfg]
+                sp_axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+                for i, ax in enumerate(sp_axes):
+                    size = a.shape[ax] + pcfg[ax][0] + pcfg[ax][1]
+                    rem = (size - ksize[i]) % stride[i]
+                    if rem:
+                        pcfg[ax][1] += stride[i] - rem
+                pcfg = [tuple(q) for q in pcfg]
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return lax.reduce_window(a, neg, lax.max, dims, strides, pcfg)
+
+    out = unary(fn, x, name=f"max_pool{n}d")
+    if return_mask:
+        # flat-spatial argmax per window (paddle mask semantics): extract the
+        # k-offset shifted views, stack, argmax over offsets, then map the
+        # winning offset back to a global flat index. Exact — no packing tricks.
+        if channel_last or isinstance(pad, str):
+            raise NotImplementedError(
+                "return_mask needs NC-first layout and explicit padding")
+
+        def idx_fn(a):
+            sp = a.shape[2:]
+            neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            pcfg = [(0, 0), (0, 0)] + list(pad)
+            # ceil_mode extension
+            if ceil_mode:
+                pcfg = [list(q) for q in pcfg]
+                for i in range(n):
+                    size = sp[i] + pcfg[2 + i][0] + pcfg[2 + i][1]
+                    rem = (size - ksize[i]) % stride[i]
+                    if rem:
+                        pcfg[2 + i][1] += stride[i] - rem
+                pcfg = [tuple(q) for q in pcfg]
+            ap = jnp.pad(a, pcfg, constant_values=neg)
+            out_sp = tuple(
+                (ap.shape[2 + i] - ksize[i]) // stride[i] + 1 for i in range(n)
+            )
+            # global (padded) coordinates of each input element
+            coords = jnp.meshgrid(*[jnp.arange(s) for s in ap.shape[2:]],
+                                  indexing="ij")
+            views, view_coords = [], []
+            import itertools as _it
+
+            for offs in _it.product(*[range(k) for k in ksize]):
+                sl = tuple(
+                    slice(offs[i], offs[i] + out_sp[i] * stride[i], stride[i])
+                    for i in range(n)
+                )
+                views.append(ap[(slice(None), slice(None)) + sl])
+                # flat UNPADDED spatial index of this element
+                flat = jnp.zeros(out_sp, jnp.int32)
+                mult = 1
+                for i in reversed(range(n)):
+                    c = coords[i][sl] - pad[i][0]
+                    flat = flat + c.astype(jnp.int32) * mult
+                    mult *= sp[i]
+                view_coords.append(flat)
+            stacked = jnp.stack(views, axis=2)  # [N, C, K, *out_sp]
+            win = jnp.argmax(stacked, axis=2)  # [N, C, *out_sp]
+            idx_stack = jnp.stack(view_coords, axis=0)  # [K, *out_sp]
+            return jnp.take_along_axis(
+                jnp.broadcast_to(idx_stack[None, None], stacked.shape),
+                win[:, :, None], axis=2,
+            ).squeeze(2)
+
+        mask = unary(idx_fn, x, differentiable=False, name="max_pool_mask")
+        return out, mask
+    return out
+
+
+def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, divisor_override,
+              n, data_format):
+    x = ensure_tensor(x)
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    ksize = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    pad = _pool_pad(padding, n)
+
+    def fn(a):
+        dims, strides = _window(a.ndim, ksize, stride, n, channel_last)
+        p = _full_pad(pad, n, channel_last)
+        if ceil_mode and not isinstance(p, str):
+            p = [list(q) for q in p]
+            sp_axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+            for i, ax in enumerate(sp_axes):
+                size = a.shape[ax] + p[ax][0] + p[ax][1]
+                rem = (size - ksize[i]) % stride[i]
+                if rem:
+                    p[ax][1] += stride[i] - rem
+            p = [tuple(q) for q in p]
+        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, p)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and not isinstance(p, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, p)
+            return summed / jnp.maximum(counts, 1.0)
+        return summed / float(np.prod(ksize))
+
+    return unary(fn, x, name=f"avg_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 1, df, return_mask)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 2, data_format, return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 3, data_format, return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, None, 1, df)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, 2, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, 3, data_format)
+
+
+def _adaptive_windows(in_size, out_size):
+    """Per-output [start, end) windows (paddle adaptive pooling semantics)."""
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, op):
+    x = ensure_tensor(x)
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    out_sp = _tuplize(output_size, n)
+    out_sp = tuple(
+        (x.shape[1 + i] if channel_last else x.shape[2 + i]) if o is None else o
+        for i, o in enumerate(out_sp)
+    )
+
+    def fn(a):
+        sp_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for i, ax in enumerate(sp_axes):
+            in_size = out.shape[ax]
+            starts, ends = _adaptive_windows(in_size, out_sp[i])
+            slices = []
+            for s, e in zip(starts, ends):
+                window = lax.slice_in_dim(out, s, e, axis=ax)
+                if op == "avg":
+                    slices.append(jnp.mean(window, axis=ax, keepdims=True))
+                else:
+                    slices.append(jnp.max(window, axis=ax, keepdims=True))
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return unary(fn, x, name=f"adaptive_{op}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def _adaptive_max_mask(x, output_size, n):
+    """Flat-spatial argmax index per adaptive window (paddle mask semantics).
+    Static per-cell windows → unrolled gathers, fused by XLA."""
+    import itertools as _it
+
+    out_sp = _tuplize(output_size, n)
+
+    def fn(a):
+        sp = a.shape[2:]
+        windows = [_adaptive_windows(sp[i], out_sp[i]) for i in range(n)]
+        cells = []
+        for cell in _it.product(*[range(o) for o in out_sp]):
+            sl = tuple(slice(windows[i][0][cell[i]], windows[i][1][cell[i]])
+                       for i in range(n))
+            w = a[(slice(None), slice(None)) + sl]
+            flat = w.reshape(w.shape[0], w.shape[1], -1)
+            loc = jnp.argmax(flat, axis=-1)
+            # local flat → coords → global flat index
+            wsp = w.shape[2:]
+            rem = loc
+            mult_g = 1
+            gidx = jnp.zeros_like(loc)
+            for i in reversed(range(n)):
+                c = rem % wsp[i]
+                rem = rem // wsp[i]
+                gidx = gidx + (c + windows[i][0][cell[i]]) * mult_g
+                mult_g *= sp[i]
+            cells.append(gidx)
+        stacked = jnp.stack(cells, axis=-1)  # [N, C, prod(out_sp)]
+        return stacked.reshape(a.shape[:2] + out_sp).astype(jnp.int32)
+
+    return unary(fn, ensure_tensor(x), differentiable=False, name="adaptive_max_mask")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max")
+    return (out, _adaptive_max_mask(x, output_size, 1)) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return (out, _adaptive_max_mask(x, output_size, 2)) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return (out, _adaptive_max_mask(x, output_size, 3)) if return_mask else out
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n, data_format):
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    ksize = _tuplize(kernel_size, n)
+    stride_ = _tuplize(stride if stride is not None else kernel_size, n)
+    if output_size is None:
+        in_sp = x.shape[2:]
+        out_sp = tuple(
+            (in_sp[i] - 1) * stride_[i] + ksize[i] - 2 * _tuplize(padding, n)[i]
+            for i in range(n)
+        )
+    else:
+        out_sp = tuple(int(s) for s in output_size)[-n:]
+
+    def fn(a, idx):
+        nb, c = a.shape[0], a.shape[1]
+        flat_sp = int(np.prod(out_sp))
+        out = jnp.zeros((nb, c, flat_sp), a.dtype)
+        flat_in = a.reshape(nb, c, -1)
+        flat_idx = idx.reshape(nb, c, -1).astype(jnp.int32)
+        bidx = jnp.arange(nb)[:, None, None]
+        cidx = jnp.arange(c)[None, :, None]
+        out = out.at[bidx, cidx, flat_idx].set(flat_in)
+        return out.reshape((nb, c) + out_sp)
+
+    return apply_op(fn, [x, indices], name=f"max_unpool{n}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3, data_format)

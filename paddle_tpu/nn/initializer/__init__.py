@@ -1,0 +1,215 @@
+"""Parameter initializers.
+
+reference parity: python/paddle/nn/initializer/ (Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform,
+Assign, Orthogonal, Dirac, calculate_gain). Each initializer is a callable
+``(shape, dtype) -> jax.Array`` drawing from the global generator — pure
+threefry on device, no host RNG.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import dtypes
+from ...generator import default_generator
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: paddle.nn.initializer.set_global_initializer."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+def _fan_in_out(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    # paddle convention: fc weights are [in, out]; conv are [out, in/g, k...]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[0] * receptive if len(shape) == 2 else shape[1] * receptive
+    fan_out = shape[1] * receptive if len(shape) == 2 else shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        return self.mean + self.std * jax.random.normal(k, shape, dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Truncated at 2 std (reference: TruncatedNormalInitializer)."""
+
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        return self.mean + self.std * jax.random.truncated_normal(k, -2.0, 2.0, shape, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        return jax.random.uniform(k, shape, dtype, minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = default_generator.next_key()
+        return std * jax.random.normal(k, shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = default_generator.next_key()
+        return jax.random.uniform(k, shape, dtype, minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu", name=None):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        k = default_generator.next_key()
+        return std * jax.random.normal(k, shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu", name=None):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        k = default_generator.next_key()
+        return jax.random.uniform(k, shape, dtype, minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        from ...tensor import Tensor
+
+        if isinstance(value, Tensor):
+            value = np.asarray(value._value)
+        self.value = np.asarray(value)
+
+    def __call__(self, shape, dtype):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {self.value.shape} != parameter shape {shape}"
+            )
+        return jnp.asarray(self.value, dtype=dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >= 2 dims")
+        k = default_generator.next_key()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.orthogonal(k, max(rows, cols), dtype=jnp.float32)
+        return (self.gain * flat[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference: nn/initializer/dirac.py)."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        if len(shape) not in (3, 4, 5):
+            raise ValueError("Dirac initializer needs conv weight of rank 3/4/5")
+        out_c, in_c = shape[0], shape[1]
+        value = np.zeros(shape, dtype=np.float32)
+        min_c = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min_c):
+                idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
+                value[idx] = 1.0
+        return jnp.asarray(value, dtype=dtype)
+
+
+# paddle also exposes these under short aliases in some code paths
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform

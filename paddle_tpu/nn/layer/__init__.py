@@ -1,0 +1,1 @@
+from . import activation, common, container, conv, loss, norm, pooling, rnn, transformer  # noqa: F401

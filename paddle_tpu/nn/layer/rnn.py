@@ -1,0 +1,387 @@
+"""Recurrent layers.
+
+reference parity: python/paddle/nn/layer/rnn.py (RNNCellBase, SimpleRNNCell,
+LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU).
+
+TPU design: the time loop is ``lax.scan`` — one compiled XLA while-loop with a
+static trip count, instead of the reference's per-step kernel launches
+(cudnn RNN / rnn_op). Gate matmuls are batched [T] inside the scan so the MXU
+sees full-size GEMMs.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+from .. import functional as F
+from ..layer_base import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value: float = 0.0, batch_dim_idx: int = 0):
+        batch = batch_ref.shape[batch_dim_idx]
+        st_shape = shape or self.state_shape
+        if isinstance(st_shape, (list, tuple)) and st_shape and isinstance(st_shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value, jnp.float32))
+                for s in st_shape
+            )
+        return Tensor(jnp.full((batch,) + tuple(st_shape), init_value, jnp.float32))
+
+
+def _std_uniform(hidden_size):
+    from .. import initializer as I
+
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int, activation: str = "tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        inputs, states = ensure_tensor(inputs), ensure_tensor(states)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+
+        h = apply_op(fn, [inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh], name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size: int = 0, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        inputs, h, c = ensure_tensor(inputs), ensure_tensor(h), ensure_tensor(c)
+
+        def fn(x, h_, c_, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + h_ @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c_ + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_op(fn, [inputs, h, c, self.weight_ih, self.weight_hh,
+                                     self.bias_ih, self.bias_hh], name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        inputs, states = ensure_tensor(inputs), ensure_tensor(states)
+
+        def fn(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (h - c) * z + c
+
+        h = apply_op(fn, [inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh], name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False, time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        inputs = ensure_tensor(inputs)
+        t_axis = 0 if self.time_major else 1
+        T = inputs.shape[t_axis]
+        states = initial_states
+        time_range = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in time_range:
+            x_t = inputs[:, t] if t_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...ops import stack
+
+        return stack(outs, axis=t_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (None, None) if initial_states is None else initial_states
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw)
+        from ...ops import concat
+
+        return concat([out_fw, out_bw], axis=-1), (fw_states, bw_states)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent network executed as stacked
+    lax.scans — the whole sequence loop is ONE fused XLA computation per
+    layer/direction (the reference dispatches cudnn rnn or per-step ops)."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, activation: str = "tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        num_dir = 2 if self.bidirect else 1
+        gate_mult = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        init = _std_uniform(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                suffix = "_reverse" if d == 1 else ""
+                wih = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                            attr=weight_ih_attr, default_initializer=init)
+                whh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                            attr=weight_hh_attr, default_initializer=init)
+                bih = self.create_parameter([gate_mult * hidden_size], attr=bias_ih_attr,
+                                            is_bias=True, default_initializer=init)
+                bhh = self.create_parameter([gate_mult * hidden_size], attr=bias_hh_attr,
+                                            is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", whh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bhh)
+                self._all_weights.append((wih, whh, bih, bhh))
+
+    def _cell_step(self, mode, activation):
+        if mode in ("RNN_TANH", "RNN_RELU"):
+            act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+            def step(x, state, wih, whh, bih, bhh):
+                h = state[0]
+                h_new = act(x @ wih.T + bih + h @ whh.T + bhh)
+                return h_new, (h_new,)
+
+            return step
+        if mode == "LSTM":
+            def step(x, state, wih, whh, bih, bhh):
+                h, c = state
+                gates = x @ wih.T + bih + h @ whh.T + bhh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return h_new, (h_new, c_new)
+
+            return step
+
+        def step(x, state, wih, whh, bih, bhh):  # GRU
+            h = state[0]
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            h_new = (h - c) * z + c
+            return h_new, (h_new,)
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        num_dir = 2 if self.bidirect else 1
+        n_states = 2 if self.MODE == "LSTM" else 1
+        mode, activation, time_major = self.MODE, self.activation, self.time_major
+        num_layers, hidden = self.num_layers, self.hidden_size
+        step = self._cell_step(mode, activation)
+
+        batch_axis = 1 if time_major else 0
+        batch = inputs.shape[batch_axis]
+        if initial_states is None:
+            zeros = jnp.zeros((num_layers * num_dir, batch, hidden), jnp.float32)
+            if n_states == 2:
+                init_states = (Tensor(zeros), Tensor(zeros))
+            else:
+                init_states = (Tensor(zeros),)
+        else:
+            init_states = initial_states if isinstance(initial_states, (tuple, list)) \
+                else (initial_states,)
+            init_states = tuple(ensure_tensor(s) for s in init_states)
+
+        flat_w = [w for group in self._all_weights for w in group]
+        # inter-layer dropout keys (paddle: dropout on every layer's output
+        # except the last, training only)
+        drop_keys = None
+        if self.dropout > 0.0 and self.training and num_layers > 1:
+            from ...generator import default_generator
+
+            drop_keys = [default_generator.next_key() for _ in range(num_layers - 1)]
+        drop_p = self.dropout
+
+        def fn(x, *args):
+            states = args[:n_states]
+            ws = args[n_states:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            out = x
+            final_h, final_c = [], []
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(num_dir):
+                    li = layer * num_dir + d
+                    wih, whh, bih, bhh = ws[4 * li: 4 * li + 4]
+                    st0 = tuple(s[li] for s in states)
+                    seq = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def scan_fn(carry, x_t, wih=wih, whh=whh, bih=bih, bhh=bhh):
+                        h_new, carry_new = step(x_t, carry, wih, whh, bih, bhh)
+                        return carry_new, h_new
+
+                    carry_T, ys = jax.lax.scan(scan_fn, st0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    final_h.append(carry_T[0])
+                    if n_states == 2:
+                        final_c.append(carry_T[1])
+                out = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 else dir_outs[0]
+                if drop_keys is not None and layer < num_layers - 1:
+                    keep = jax.random.bernoulli(drop_keys[layer], 1.0 - drop_p, out.shape)
+                    out = jnp.where(keep, out / (1.0 - drop_p), 0.0).astype(out.dtype)
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(final_h, axis=0)
+            if n_states == 2:
+                return out, h_stack, jnp.stack(final_c, axis=0)
+            return out, h_stack
+
+        res = apply_op(fn, [inputs, *init_states, *flat_w], name=f"rnn_{mode.lower()}")
+        if n_states == 2:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        if activation == "relu":
+            self.MODE = "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

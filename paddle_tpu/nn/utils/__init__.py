@@ -1,0 +1,166 @@
+"""nn.utils — weight_norm, spectral_norm, vector↔parameters.
+
+reference parity: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py, clip_grad_norm_/value_).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Parameter, Tensor
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters",
+    "clip_grad_norm_", "clip_grad_value_",
+]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w ** 2))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w ** 2, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize weight = g * v / ||v|| via a forward-pre-hook
+    (reference: nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    v0 = w._value
+    g0 = _norm_except(v0, dim)
+    layer.add_parameter(name + "_v", Parameter(v0, trainable=not w.stop_gradient))
+    layer.add_parameter(name + "_g", Parameter(
+        g0.reshape(-1) if dim is not None else g0, trainable=not w.stop_gradient))
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...autograd.engine import apply_op
+
+        v = getattr(lyr, name + "_v")
+        g = getattr(lyr, name + "_g")
+
+        def fn(v_, g_):
+            n = _norm_except(v_, dim)
+            if dim is not None:
+                shape = [1] * v_.ndim
+                shape[dim] = -1
+                g_ = g_.reshape(shape)
+            return g_ * v_ / jnp.maximum(n, 1e-12)
+
+        w_new = apply_op(fn, [v, g], name="weight_norm")
+        object.__setattr__(lyr, "_wn_computed_" + name, w_new)
+        lyr.__dict__[name] = w_new
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__["_weight_norm_handle_" + name] = handle
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    handle = layer.__dict__.pop("_weight_norm_handle_" + name, None)
+    if handle is not None:
+        handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    dim0 = 0
+    w = layer.__dict__.pop(name, None)
+    if w is None:
+        w = Tensor(v._value)
+    layer.add_parameter(name, Parameter(w._value, trainable=not v.stop_gradient))
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """reference: nn/utils/spectral_norm_hook.py."""
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith("Transpose") else 0
+    w = getattr(layer, name)
+    from ...generator import default_generator
+    import jax
+
+    wm = jnp.moveaxis(w._value, dim, 0).reshape(w.shape[dim], -1)
+    k1, k2 = default_generator.next_key(), default_generator.next_key()
+    u = jax.random.normal(k1, (wm.shape[0],))
+    v = jax.random.normal(k2, (wm.shape[1],))
+    layer.register_buffer(name + "_u", Tensor(u / jnp.linalg.norm(u)))
+    layer.register_buffer(name + "_v", Tensor(v / jnp.linalg.norm(v)))
+    orig = Parameter(w._value, trainable=not w.stop_gradient)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...autograd.engine import apply_op
+
+        w_orig = getattr(lyr, name + "_orig")
+        u_t = lyr._buffers[name + "_u"]
+        v_t = lyr._buffers[name + "_v"]
+        u_, v_ = u_t._value, v_t._value
+        wmat = jnp.moveaxis(w_orig._value, dim, 0).reshape(w_orig.shape[dim], -1)
+        for _ in range(n_power_iterations):
+            v_ = wmat.T @ u_
+            v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+            u_ = wmat @ v_
+            u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        u_t._set_value(u_)
+        v_t._set_value(v_)
+        uc, vc = u_, v_
+
+        def fn(w_):
+            wm_ = jnp.moveaxis(w_, dim, 0).reshape(w_.shape[dim], -1)
+            sigma = uc @ wm_ @ vc
+            return w_ / sigma
+
+        lyr.__dict__[name] = apply_op(fn, [w_orig], name="spectral_norm")
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__["_spectral_norm_handle_" + name] = handle
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        p._set_value(v[offset: offset + n].reshape(p._value.shape).astype(p._value.dtype))
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(
+            jnp.stack([jnp.sum(jnp.abs(g._value.astype(jnp.float32)) ** norm_type)
+                       for g in grads])
+        ) ** (1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor((p.grad._value * factor).astype(p.grad._value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value: float):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._value, -clip_value, clip_value))

@@ -1,0 +1,10 @@
+"""Pallas TPU kernel tier.
+
+reference parity: the reference's fused/native kernel layer —
+FlashAttention (paddle/phi/kernels/gpu/flash_attn_kernel.cu:213, external lib
+via cmake/external/flashattn.cmake) and the CUTLASS memory-efficient attention
+(phi/kernels/fusion/cutlass/). Here the fused kernels are Pallas TPU kernels
+(VMEM-tiled, MXU matmuls); non-TPU platforms fall back to pure-XLA reference
+math in the callers.
+"""
+from . import flash_attention  # noqa: F401

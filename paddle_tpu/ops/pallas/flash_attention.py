@@ -1,0 +1,174 @@
+"""Flash attention as a Pallas TPU kernel.
+
+TPU-native replacement for the reference's flash-attn integration
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:213): online-softmax attention
+tiled over VMEM blocks so the [S, S] score matrix never materializes in HBM.
+
+Layout: paddle flash-attn layout [batch, seq, heads, head_dim] at the API
+boundary; internally [batch*heads, seq, head_dim] with a (bh, q_block,
+k_block) grid. The k loop is the innermost grid dim — TPU grids run
+sequentially, so VMEM scratch (acc, running max m, running sum l) carries
+across k steps (the standard TPU flash pattern).
+
+Backward: jax.custom_vjp whose bwd recomputes attention with the pure-XLA
+reference math and differentiates it — numerically identical, keeps the
+Pallas fast path for inference/forward; a fused Pallas bwd can replace it
+without API change.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _i32(x):
+    # index maps must stay int32: under jax_enable_x64 a python-int literal
+    # traces as i64, which Mosaic refuses to legalize
+    return jnp.asarray(x, jnp.int32)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                 causal: bool, scale: float, block_q: int, block_k: int,
+                 seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    neg_inf = jnp.float32(NEG_INF)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+        m_ref[...] = jnp.full_like(m_ref[...], neg_inf)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_k  # padded keys
+    if causal:
+        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+    s = jnp.where(mask, s, neg_inf)
+
+    m_prev = m_ref[...]  # [bq, 128] replicated
+    l_prev = l_ref[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)  # [bq, 128]
+    p = jnp.exp(s - m_new[:, :1])  # [bq, bk]
+    l_new = alpha * l_prev + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+    v = v_ref[0].astype(jnp.float32)  # [bk, d]
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # [bq, d]
+    acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...][:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    """q,k,v: [BH, S, D] → out [BH, Sq, D]."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, max(128, 1 << (sq - 1).bit_length()) if sq < block_q else block_q)
+    bq = min(bq, block_q)
+    bk = min(block_k, max(128, 1 << (sk - 1).bit_length()) if sk < block_k else block_k)
+    pad_q = (-sq) % bq
+    pad_k = (-sk) % bk
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    nq = qp.shape[1] // bq
+    nk = kp.shape[1] // bk
+
+    grid = (bh, nq, nk)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk, seq_q=sq, seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+    )(qp, kp, vp)
+    return out[:, :sq]
+
+
+def _ref_attention_bshd(q, k, v, causal: bool, scale: float):
+    """Pure-XLA reference (same math), used for the backward pass."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        sq_, sk_ = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq_, sk_), bool), sk_ - sq_)
+        logits = jnp.where(cm, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal: bool, scale: float):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    of = _flash_fwd_bhsd(qf, kf, vf, causal, scale)
+    return jnp.swapaxes(of.reshape(b, h, sq, d), 1, 2)
+
+
+def _fwd(q, k, v, causal, scale):
+    return _flash_attention(q, k, v, causal, scale), (q, k, v)
+
+
+def _bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _ref_attention_bshd(q_, k_, v_, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None):
+    """Flash attention, paddle layout [B, S, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if not _HAS_PLTPU:
+        return _ref_attention_bshd(q, k, v, causal, scale)
+    return _flash_attention(q, k, v, causal, scale)
