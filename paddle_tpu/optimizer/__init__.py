@@ -1,1 +1,16 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
+
+reference parity: python/paddle/optimizer/ (Optimizer base optimizer.py:91,
+SGD/Momentum/Adam/AdamW/Adamax/Adagrad/Adadelta/RMSProp/Lamb, lr.py
+schedulers).
+"""
+from . import lr
+from .optimizer import Optimizer
+from .optimizers import (
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+)
+
+__all__ = [
+    "lr", "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+    "Adagrad", "Adadelta", "RMSProp", "Lamb",
+]

@@ -1,0 +1,262 @@
+"""Optimizer base class.
+
+reference parity: python/paddle/optimizer/optimizer.py:91 (``Optimizer`` with
+``step`` :1477, ``minimize`` :1391, ``_apply_optimize`` :1186, accumulator
+machinery ``_add_accumulator``), reshaped TPU-first:
+
+- Optimizer state ("accumulators") is a per-parameter dict of ``jax.Array``s,
+  i.e. a pytree. The whole update is pure jnp code over (param, grad, accs),
+  so a train step wrapped in ``paddle_tpu.jit`` compiles parameter updates
+  into the same XLA program as forward+backward — the TPU counterpart of the
+  reference's fused_adam multi-tensor kernel (phi/kernels/gpu/fused_adam_kernel.cu).
+- In-place semantics (the reference's ``adamw_`` inplace ops) are realized by
+  rebinding the Parameter's payload cell (``Tensor._set_value``), which the
+  jit tracer records for functionalization.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Parameter, Tensor
+from ..autograd import no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class _L2Decay:
+    """L2 regularization added to the gradient (reference:
+    python/paddle/regularizer.py L2Decay)."""
+
+    def __init__(self, coeff: float):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_value, grad_value):
+        return grad_value + self.coeff * param_value
+
+
+class _L1Decay:
+    """reference: python/paddle/regularizer.py L1Decay."""
+
+    def __init__(self, coeff: float):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_value, grad_value):
+        return grad_value + self.coeff * jnp.sign(param_value)
+
+
+def _coerce_regularizer(weight_decay):
+    if weight_decay is None:
+        return None
+    if callable(weight_decay):
+        return weight_decay
+    return _L2Decay(float(weight_decay))
+
+
+class Optimizer:
+    """Base optimizer (reference: python/paddle/optimizer/optimizer.py:91).
+
+    Subclasses implement ``_update(param_value, grad_value, accs, lr)``
+    returning ``(new_param_value, new_accs)`` — pure jnp, jit-traceable —
+    and list their accumulator names/initializers in ``_accumulator_specs``.
+    """
+
+    # name -> init fn(param_value) for per-param state; subclasses override.
+    _accumulator_specs: dict = {}
+
+    def __init__(
+        self,
+        learning_rate: Union[float, LRScheduler] = 0.001,
+        parameters: Optional[Iterable] = None,
+        weight_decay=None,
+        grad_clip=None,
+        name: Optional[str] = None,
+    ):
+        # per-param overrides from the param-group API:
+        # [{'params': [...], 'learning_rate': mult, 'weight_decay': wd}, ...]
+        self._group_lr_mult: dict = {}    # param uid -> lr multiplier
+        self._group_wd: dict = {}         # param uid -> regularizer
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    for p in g["params"]:
+                        flat.append(p)
+                        if "learning_rate" in g:
+                            self._group_lr_mult[p._uid] = float(g["learning_rate"])
+                        if "weight_decay" in g:
+                            self._group_wd[p._uid] = _coerce_regularizer(
+                                g["weight_decay"])
+                parameters = flat
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self.regularization = _coerce_regularizer(weight_decay)
+        self._grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        # param.name -> {acc_name: jax.Array}
+        self._accumulators: dict = {}
+        self._global_step = 0
+
+    # -------------------------------------------------------------- lr plumbing
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "optimizer's learning rate can't be set when it uses an LRScheduler"
+            )
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    def _lr_value(self):
+        """Current lr as a jnp scalar (traceable)."""
+        return jnp.asarray(self.get_lr(), dtype=jnp.float32)
+
+    # ---------------------------------------------------------- accumulators
+    def _get_accumulators(self, p: Parameter) -> dict:
+        accs = self._accumulators.get(p.name)
+        if accs is None:
+            accs = {
+                name: init(p._value) for name, init in self._accumulator_specs.items()
+            }
+            self._accumulators[p.name] = accs
+        return accs
+
+    # ---------------------------------------------------------------- update
+    def _update(self, param_value, grad_value, accs: dict, lr):
+        raise NotImplementedError
+
+    def _param_lr(self, param) -> float:
+        """Per-parameter lr multiplier (ParamAttr learning_rate × param-group
+        learning_rate, reference: optimizer.py _create_param_lr)."""
+        mult = float(getattr(param, "optimize_attr", {}).get("learning_rate", 1.0))
+        return mult * self._group_lr_mult.get(param._uid, 1.0)
+
+    def _param_regularizer(self, param):
+        """Effective regularizer: per-param > per-group > optimizer-wide."""
+        if getattr(param, "regularizer", None) is not None:
+            return param.regularizer
+        if param._uid in self._group_wd:
+            return self._group_wd[param._uid]
+        return self.regularization
+
+    def _collect_params_grads(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError(
+                "optimizer constructed without a parameter list; pass "
+                "parameters=model.parameters()"
+            )
+        out = []
+        for p in params:
+            if p.stop_gradient or p.grad is None:
+                continue
+            if not getattr(p, "trainable", True):
+                continue
+            out.append((p, p.grad))
+        return out
+
+    @no_grad()
+    def step(self):
+        """Apply one optimizer update (reference: optimizer.py:1477)."""
+        params_grads = self._collect_params_grads()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self._lr_value()
+        for p, g in params_grads:
+            gv = g._value
+            if gv.dtype != p._value.dtype:
+                gv = gv.astype(p._value.dtype)
+            reg = self._param_regularizer(p)
+            if reg is not None:
+                gv = reg(p._value, gv)
+            accs = self._get_accumulators(p)
+            plr = self._param_lr(p)
+            new_val, new_accs = self._update(p._value, gv, accs, lr * plr)
+            p._set_value(new_val)
+            self._accumulators[p.name] = new_accs
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """reference: optimizer.py:1391 — backward + step in one call."""
+        loss.backward()
+        self.step()
+        return None, self._collect_params_grads()
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        """reference: optimizer.py clear_grad."""
+        if self._parameter_list is None:
+            return
+        for p in self._parameter_list:
+            if set_to_zero and p.grad is not None:
+                p.grad = Tensor(jnp.zeros_like(p.grad._value))
+            else:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self) -> dict:
+        """Accumulators + LR scheduler state (reference: optimizer.py
+        state_dict — accumulator tensors keyed by name).
+
+        Keys are ``pos:{index}.{acc_name}`` where index is the parameter's
+        position in the optimizer's parameter list — stable across processes,
+        unlike auto-generated tensor names (tensor.py's process-global uid
+        counter shifts between runs).
+        """
+        sd = {}
+        pos_of = {p.name: i for i, p in enumerate(self._parameter_list or [])}
+        for pname, accs in self._accumulators.items():
+            for aname, val in accs.items():
+                if pname in pos_of:
+                    key = f"pos:{pos_of[pname]}.{aname}"
+                else:  # param no longer in the list; keep name-keyed
+                    key = f"{pname}.{aname}"
+                sd[key] = Tensor(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        state_dict = dict(state_dict)
+        lr_state = state_dict.pop("LR_Scheduler", None)
+        if lr_state is not None and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(lr_state)
+        self._global_step = int(state_dict.pop("@global_step", 0))
+        params = self._parameter_list or []
+        for key, val in state_dict.items():
+            pname, _, aname = key.rpartition(".")
+            if not pname:
+                continue
+            if pname.startswith("pos:"):
+                idx = int(pname[4:])
+                if idx >= len(params):
+                    raise KeyError(
+                        f"optimizer state refers to parameter index {idx} but "
+                        f"this optimizer has only {len(params)} parameters"
+                    )
+                pname = params[idx].name
+            arr = val._value if isinstance(val, Tensor) else jnp.asarray(val)
+            self._accumulators.setdefault(pname, {})[aname] = arr
+
+    load_state_dict = set_state_dict
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.get_lr()})"

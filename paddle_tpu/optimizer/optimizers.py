@@ -1,0 +1,259 @@
+"""The optimizer family.
+
+reference parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,adamax,
+adagrad,adadelta,rmsprop,lamb}.py. Each ``_update`` is a pure jnp function
+over (param, grad, accumulators, lr) so the whole family jit-compiles into
+the training step (the TPU equivalent of the reference's fused multi-tensor
+CUDA kernels, e.g. phi/kernels/gpu/fused_adam_kernel.cu).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = [
+    "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta",
+    "RMSProp", "Lamb",
+]
+
+
+def _zeros_like(v):
+    return jnp.zeros_like(v)
+
+
+def _f32_scalar(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+class SGD(Optimizer):
+    """reference: python/paddle/optimizer/sgd.py."""
+
+    def _update(self, p, g, accs, lr):
+        return p - lr.astype(p.dtype) * g, accs
+
+
+class Momentum(Optimizer):
+    """reference: python/paddle/optimizer/momentum.py (supports Nesterov)."""
+
+    _accumulator_specs = {"velocity": _zeros_like}
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update(self, p, g, accs, lr):
+        lr = lr.astype(p.dtype)
+        mu = self._momentum
+        v = mu * accs["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + mu * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: python/paddle/optimizer/adam.py. L2 weight_decay is coupled
+    (added to the gradient by the base class)."""
+
+    _accumulator_specs = {
+        "moment1": _zeros_like,
+        "moment2": _zeros_like,
+        "beta1_pow": lambda v: _f32_scalar(1.0),
+        "beta2_pow": lambda v: _f32_scalar(1.0),
+    }
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update(self, p, g, accs, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        m = b1 * accs["moment1"] + (1 - b1) * g
+        v = b2 * accs["moment2"] + (1 - b2) * g * g
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(p.dtype)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """reference: python/paddle/optimizer/adamw.py — decoupled weight decay
+    applied directly to the parameter, gated by apply_decay_param_fun."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if weight_decay is not None else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param_name = None
+
+    def _param_lr(self, param):
+        self._current_param_name = param.name
+        base = super()._param_lr(param)
+        if self._lr_ratio is not None:
+            base *= float(self._lr_ratio(param))
+        return base
+
+    def _update(self, p, g, accs, lr):
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
+                self._current_param_name):
+            decay = 0.0
+        if decay:
+            p = p * (1 - lr.astype(p.dtype) * decay)
+        return super()._update(p, g, accs, lr)
+
+
+class Adamax(Optimizer):
+    """reference: python/paddle/optimizer/adamax.py (infinity-norm Adam)."""
+
+    _accumulator_specs = {
+        "moment": _zeros_like,
+        "inf_norm": _zeros_like,
+        "beta1_pow": lambda v: _f32_scalar(1.0),
+    }
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, accs, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = accs["beta1_pow"] * b1
+        m = b1 * accs["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * accs["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - b1p)).astype(p.dtype) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    """reference: python/paddle/optimizer/adagrad.py."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        init = float(initial_accumulator_value)
+        self._accumulator_specs = {
+            "moment": lambda v: jnp.full_like(v, init),
+        }
+
+    def _update(self, p, g, accs, lr):
+        moment = accs["moment"] + g * g
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(moment) + self._epsilon)
+        return new_p, {"moment": moment}
+
+
+class Adadelta(Optimizer):
+    """reference: python/paddle/optimizer/adadelta.py."""
+
+    _accumulator_specs = {
+        "avg_squared_grad": _zeros_like,
+        "avg_squared_update": _zeros_like,
+    }
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update(self, p, g, accs, lr):
+        rho, eps = self._rho, self._epsilon
+        sg = rho * accs["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt((accs["avg_squared_update"] + eps) / (sg + eps)) * g
+        su = rho * accs["avg_squared_update"] + (1 - rho) * update * update
+        new_p = p + lr.astype(p.dtype) * update
+        return new_p, {"avg_squared_grad": sg, "avg_squared_update": su}
+
+
+class RMSProp(Optimizer):
+    """reference: python/paddle/optimizer/rmsprop.py (centered option)."""
+
+    _accumulator_specs = {
+        "mean_square": _zeros_like,
+        "mean_grad": _zeros_like,
+        "momentum_acc": _zeros_like,
+    }
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update(self, p, g, accs, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * accs["mean_square"] + (1 - rho) * g * g
+        mg = accs["mean_grad"]
+        if self._centered:
+            mg = rho * mg + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * accs["momentum_acc"] + lr.astype(p.dtype) * g / denom
+        new_p = p - mom
+        return new_p, {"mean_square": ms, "mean_grad": mg, "momentum_acc": mom}
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py — layerwise-adaptive Adam
+    with trust-ratio scaling (used for large-batch BERT)."""
+
+    _accumulator_specs = {
+        "moment1": _zeros_like,
+        "moment2": _zeros_like,
+        "beta1_pow": lambda v: _f32_scalar(1.0),
+        "beta2_pow": lambda v: _f32_scalar(1.0),
+    }
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_weight_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._current_param = None
+
+    def _param_lr(self, param):
+        self._current_param = param
+        return super()._param_lr(param)
+
+    def _update(self, p, g, accs, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        m = b1 * accs["moment1"] + (1 - b1) * g
+        v = b2 * accs["moment2"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        decay = self._lamb_weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(self._current_param):
+            decay = 0.0
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + decay * p
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - (lr * trust).astype(p.dtype) * r
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
