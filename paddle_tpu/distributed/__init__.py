@@ -1,1 +1,9 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.distributed — mesh-parallel training over XLA collectives.
+
+reference parity: python/paddle/distributed/ (see SURVEY.md §2.3). Built up
+in milestones: env/bootstrap first; mesh topology, collectives API, TP/PP/
+sharding/MoE layers, auto_parallel engine, launch CLI follow.
+"""
+from .env import ParallelEnv, get_rank, get_world_size
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]

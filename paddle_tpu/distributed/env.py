@@ -1,0 +1,78 @@
+"""Process-level distributed environment.
+
+reference parity: python/paddle/distributed/parallel.py (ParallelEnv :662,
+get_rank/get_world_size) — env-var contract PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM written by the launch CLI (launch/main.py:18).
+
+On TPU, multi-host process identity comes from the JAX distributed runtime
+(jax.process_index/process_count after jax.distributed.initialize); the env
+vars take precedence so the paddle launch contract keeps working. Reading
+these never initializes the device backend unless JAX multi-process was
+already initialized elsewhere.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_rank", "get_world_size", "ParallelEnv"]
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_count()
+    except Exception:
+        pass
+    return 1
+
+
+class ParallelEnv:
+    """reference: parallel.py:662 ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -10,9 +10,11 @@ want randomness (threefry keys compiled into the program, no host round trip).
 """
 from __future__ import annotations
 
+import itertools
 import os
 
 import jax
+import numpy as np
 
 
 class Generator:
@@ -58,8 +60,21 @@ default_generator = Generator(int(os.environ.get("PADDLE_TPU_SEED", "0")))
 def seed(value: int):
     """paddle.seed equivalent: reseed the global generator (reference:
     python/paddle/framework/random.py)."""
+    global _host_counter
     default_generator.manual_seed(int(value))
+    _host_counter = itertools.count()
     return default_generator
+
+
+_host_counter = itertools.count()
+
+
+def host_rng() -> np.random.Generator:
+    """Host-side numpy RNG derived from the global seed — for DataLoader
+    shuffling and dataset splits, which must never touch the device backend
+    (backend init claims the TPU chip). Each call yields a fresh, seeded
+    stream; reproducible after paddle_tpu.seed()."""
+    return np.random.default_rng((default_generator.seed(), next(_host_counter)))
 
 
 def get_rng_state():

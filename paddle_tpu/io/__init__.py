@@ -1,1 +1,22 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+reference parity: paddle.io (python/paddle/io/, fluid/reader.py:311,
+fluid/dataloader/).
+"""
+from .dataloader import DataLoader, default_collate_fn
+from .dataset import (
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .sampler import (
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)
+
+__all__ = [
+    "DataLoader", "default_collate_fn",
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+]

@@ -1,0 +1,220 @@
+"""DataLoader.
+
+reference parity: python/paddle/io.DataLoader (fluid/reader.py:311) +
+fluid/dataloader/dataloader_iter.py (single-process and multi-worker prefetch
+iterators with shared-memory queues).
+
+TPU-first reshaping: the reference's multiprocess workers + shared-memory
+blobs exist to keep CUDA-stream H2D copies off the Python loop. On TPU the
+equivalent goal is keeping the XLA dispatch pipeline fed: batches are
+assembled as host numpy arrays by a pool of prefetch worker threads (numpy
+slicing/decoding releases the GIL) feeding a bounded queue, and transfer to
+device HBM happens asynchronously on first use inside jit. num_workers>0
+selects threaded prefetch; num_workers=0 is fully synchronous (debuggable),
+matching the reference's semantics.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    fluid/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(group)) for group in transposed]
+    raise TypeError(f"batch data must be tensor/ndarray/number/dict/list, got {type(sample)}")
+
+
+class _SingleProcessIter:
+    def __init__(self, loader: "DataLoader"):
+        self._loader = loader
+        self._index_iter = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self._index_iter)
+        return self._loader._fetch(indices)
+
+
+class _ThreadedPrefetchIter:
+    """Bounded-queue prefetch over worker threads; preserves batch order."""
+
+    def __init__(self, loader: "DataLoader"):
+        self._loader = loader
+        self._indices = list(iter(loader.batch_sampler))
+        capacity = max(2, loader.prefetch_factor * loader.num_workers)
+        self._results: dict = {}
+        self._results_lock = threading.Condition()
+        self._next_out = 0
+        self._next_in = 0
+        self._in_lock = threading.Lock()
+        self._capacity = capacity
+        self._shutdown = False
+        self._workers = [
+            threading.Thread(target=self._work, args=(wid,), daemon=True)
+            for wid in range(loader.num_workers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _work(self, worker_id: int):
+        if self._loader.worker_init_fn is not None:
+            self._loader.worker_init_fn(worker_id)
+        while True:
+            with self._in_lock:
+                i = self._next_in
+                if i >= len(self._indices):
+                    return
+                self._next_in += 1
+            try:
+                batch = self._loader._fetch(self._indices[i])
+                payload = (i, batch, None)
+            except Exception:  # propagate to consumer
+                payload = (i, None, traceback.format_exc())
+            with self._results_lock:
+                while (not self._shutdown and
+                       i - self._next_out >= self._capacity):
+                    self._results_lock.wait(0.1)
+                if self._shutdown:
+                    return
+                self._results[i] = payload
+                self._results_lock.notify_all()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_out >= len(self._indices):
+            self.close()
+            raise StopIteration
+        with self._results_lock:
+            while self._next_out not in self._results:
+                self._results_lock.wait()
+            i, batch, err = self._results.pop(self._next_out)
+            self._next_out += 1
+            self._results_lock.notify_all()
+        if err is not None:
+            self.close()
+            raise RuntimeError(f"DataLoader worker failed:\n{err}")
+        return batch
+
+    def close(self):
+        with self._results_lock:
+            self._shutdown = True
+            self._results_lock.notify_all()
+
+    def __del__(self):
+        self.close()
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader: "DataLoader"):
+        self._loader = loader
+        self._it = iter(loader.dataset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = list(itertools.islice(self._it, self._loader.batch_size))
+        if not batch:
+            raise StopIteration
+        if self._loader.drop_last and len(batch) < self._loader.batch_size:
+            raise StopIteration
+        collate = self._loader.collate_fn or default_collate_fn
+        return collate(batch)
+
+
+class DataLoader:
+    """reference: paddle.io.DataLoader (fluid/reader.py:311)."""
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: Optional[int] = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+    ):
+        del feed_list, places, return_list  # static-graph-only args
+        del use_buffer_reader, use_shared_memory, timeout, persistent_workers
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        self.drop_last = drop_last
+        if self._is_iterable:
+            assert batch_sampler is None, (
+                "batch_sampler is not supported for IterableDataset"
+            )
+            self.batch_size = batch_size or 1
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            assert batch_size is not None and batch_size > 0
+            self.batch_size = batch_size
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        collate = self.collate_fn or default_collate_fn
+        return collate(samples)
+
+    def __iter__(self):
+        if self._is_iterable:
+            return _IterableDatasetIter(self)
+        if self.num_workers > 0:
+            return _ThreadedPrefetchIter(self)
+        return _SingleProcessIter(self)
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()

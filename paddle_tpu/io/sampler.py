@@ -1,0 +1,197 @@
+"""Samplers.
+
+reference parity: python/paddle/fluid/dataloader/sampler.py (Sampler,
+SequenceSampler, RandomSampler, WeightedRandomSampler) and batch_sampler.py
+(BatchSampler, DistributedBatchSampler).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..generator import host_rng
+
+__all__ = [
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "SubsetRandomSampler",
+]
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.generator is not None:
+            # bounded to num_samples (an unbounded generator must not make
+            # the epoch infinite)
+            yield from (int(i) for i in
+                        itertools.islice(self.generator, self.num_samples))
+            return
+        rng = host_rng()
+        if self.replacement:
+            yield from rng.integers(0, n, size=self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[: self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices: Sequence[int]):
+        super().__init__(None)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        rng = host_rng()
+        yield from (self.indices[i] for i in rng.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights: Sequence[float], num_samples: int,
+                 replacement: bool = True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if num_samples <= 0:
+            raise ValueError("num_samples should be a positive integer")
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError(
+                "num_samples should not be larger than weights length when "
+                "replacement is False"
+            )
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = host_rng()
+        idx = rng.choice(len(p), size=self.num_samples, replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """reference: dataloader/batch_sampler.py BatchSampler."""
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
+                 shuffle: bool = False, batch_size: int = 1,
+                 drop_last: bool = False):
+        super().__init__(dataset)
+        if sampler is not None:
+            assert dataset is None, "either dataset or sampler, not both"
+            self.sampler = sampler
+        else:
+            assert dataset is not None, "either dataset or sampler must be given"
+            self.sampler = (
+                RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+            )
+        assert batch_size > 0, "batch_size should be a positive integer"
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+
+    def __iter__(self) -> Iterator[List[int]]:
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards batches across data-parallel ranks (reference:
+    python/paddle/fluid/dataloader/batch_sampler.py DistributedBatchSampler).
+
+    On TPU the same sampler serves jax.process-level sharding: each host
+    loads only its shard and the global batch is assembled by the mesh
+    sharding (distributed/dataloader wires this up)."""
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = False,
+                 drop_last: bool = False):
+        self.dataset = dataset
+        assert batch_size > 0
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        if num_replicas is None or rank is None:
+            from .. import distributed as dist
+
+            num_replicas = num_replicas if num_replicas is not None else dist.get_world_size()
+            rank = rank if rank is not None else dist.get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n).tolist()
+            self.epoch += 1
+        else:
+            indices = list(range(n))
+        # pad to be evenly divisible
+        indices += indices[: (self.total_size - n)]
+        # subsample for this rank
+        indices = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch

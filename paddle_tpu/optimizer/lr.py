@@ -45,16 +45,18 @@ class LRScheduler:
     def get_lr(self) -> float:
         raise NotImplementedError
 
+    # progress state only — hyperparameters belong to the constructor, so a
+    # resume with a new schedule config is not silently overwritten
+    # (reference: lr.py keys = ['last_epoch', 'last_lr'])
+    _state_keys = ("last_epoch", "last_lr")
+
     def state_dict(self) -> dict:
-        return {
-            k: v for k, v in self.__dict__.items()
-            if isinstance(v, (int, float, bool, str, list, tuple)) or v is None
-        }
+        return {k: self.__dict__[k] for k in self._state_keys if k in self.__dict__}
 
     def set_state_dict(self, state: dict):
-        for k, v in state.items():
-            if k in self.__dict__:
-                self.__dict__[k] = v
+        for k in self._state_keys:
+            if k in state:
+                self.__dict__[k] = state[k]
 
     load_state_dict = set_state_dict
 
@@ -204,11 +206,6 @@ class LambdaDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * self.lr_lambda(self.last_epoch)
 
-    def state_dict(self):
-        sd = super().state_dict()
-        sd.pop("lr_lambda", None)
-        return sd
-
 
 class MultiplicativeDecay(LRScheduler):
     def __init__(self, learning_rate, lr_lambda: Callable[[int], float],
@@ -258,6 +255,9 @@ class CosineAnnealingWarmRestarts(LRScheduler):
 
 class ReduceOnPlateau(LRScheduler):
     """reference: lr.py ReduceOnPlateau — metric-driven, step(metric)."""
+
+    _state_keys = ("last_epoch", "last_lr", "cooldown_counter", "best",
+                   "num_bad_epochs")
 
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,

@@ -102,7 +102,8 @@ class Optimizer:
         self.regularization = _coerce_regularizer(weight_decay)
         self._grad_clip = grad_clip
         self._name = name or type(self).__name__
-        # param.name -> {acc_name: jax.Array}
+        # param uid -> {acc_name: jax.Array} (uid, not name: two params may
+        # share a user-chosen name, and uid is already the group-override key)
         self._accumulators: dict = {}
         self._global_step = 0
 
@@ -128,12 +129,12 @@ class Optimizer:
 
     # ---------------------------------------------------------- accumulators
     def _get_accumulators(self, p: Parameter) -> dict:
-        accs = self._accumulators.get(p.name)
+        accs = self._accumulators.get(p._uid)
         if accs is None:
             accs = {
                 name: init(p._value) for name, init in self._accumulator_specs.items()
             }
-            self._accumulators[p.name] = accs
+            self._accumulators[p._uid] = accs
         return accs
 
     # ---------------------------------------------------------------- update
@@ -188,7 +189,7 @@ class Optimizer:
             plr = self._param_lr(p)
             new_val, new_accs = self._update(p._value, gv, accs, lr * plr)
             p._set_value(new_val)
-            self._accumulators[p.name] = new_accs
+            self._accumulators[p._uid] = new_accs
         self._global_step += 1
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
@@ -221,14 +222,12 @@ class Optimizer:
         counter shifts between runs).
         """
         sd = {}
-        pos_of = {p.name: i for i, p in enumerate(self._parameter_list or [])}
-        for pname, accs in self._accumulators.items():
+        pos_of = {p._uid: i for i, p in enumerate(self._parameter_list or [])}
+        for uid, accs in self._accumulators.items():
+            if uid not in pos_of:
+                continue  # param no longer tracked by this optimizer
             for aname, val in accs.items():
-                if pname in pos_of:
-                    key = f"pos:{pos_of[pname]}.{aname}"
-                else:  # param no longer in the list; keep name-keyed
-                    key = f"{pname}.{aname}"
-                sd[key] = Tensor(val)
+                sd[f"pos:{pos_of[uid]}.{aname}"] = Tensor(val)
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["@global_step"] = self._global_step
@@ -242,19 +241,18 @@ class Optimizer:
         self._global_step = int(state_dict.pop("@global_step", 0))
         params = self._parameter_list or []
         for key, val in state_dict.items():
-            pname, _, aname = key.rpartition(".")
-            if not pname:
+            pkey, _, aname = key.rpartition(".")
+            if not pkey or not pkey.startswith("pos:"):
                 continue
-            if pname.startswith("pos:"):
-                idx = int(pname[4:])
-                if idx >= len(params):
-                    raise KeyError(
-                        f"optimizer state refers to parameter index {idx} but "
-                        f"this optimizer has only {len(params)} parameters"
-                    )
-                pname = params[idx].name
+            idx = int(pkey[4:])
+            if idx >= len(params):
+                raise KeyError(
+                    f"optimizer state refers to parameter index {idx} but "
+                    f"this optimizer has only {len(params)} parameters"
+                )
+            uid = params[idx]._uid
             arr = val._value if isinstance(val, Tensor) else jnp.asarray(val)
-            self._accumulators.setdefault(pname, {})[aname] = arr
+            self._accumulators.setdefault(uid, {})[aname] = arr
 
     load_state_dict = set_state_dict
 

@@ -100,19 +100,28 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name)
         self._coeff = float(weight_decay) if weight_decay is not None else 0.0
+        # AdamW decay is DECOUPLED: reinterpret param-group weight_decay
+        # (parsed as coupled-L2 regularizers by the base) as per-param
+        # decoupled coefficients.
+        self._decay_by_uid = {
+            uid: getattr(reg, "coeff", 0.0) for uid, reg in self._group_wd.items()
+        }
+        self._group_wd = {}
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
         self._current_param_name = None
+        self._current_param_uid = None
 
     def _param_lr(self, param):
         self._current_param_name = param.name
+        self._current_param_uid = param._uid
         base = super()._param_lr(param)
         if self._lr_ratio is not None:
             base *= float(self._lr_ratio(param))
         return base
 
     def _update(self, p, g, accs, lr):
-        decay = self._coeff
+        decay = self._decay_by_uid.get(self._current_param_uid, self._coeff)
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(
                 self._current_param_name):
             decay = 0.0

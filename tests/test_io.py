@@ -1,0 +1,222 @@
+"""io tests (reference semantics: paddle.io Dataset/DataLoader/samplers,
+fluid/dataloader/*; save/load framework/io.py:646,888)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import (
+    BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, DataLoader,
+    Dataset, DistributedBatchSampler, IterableDataset, RandomSampler,
+    SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
+    random_split,
+)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i * 2)
+
+    def __len__(self):
+        return self.n
+
+
+class StreamDataset(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.int64)
+    ds = TensorDataset([pt.to_tensor(x), y])
+    assert len(ds) == 6
+    a, b = ds[3]
+    np.testing.assert_allclose(a, x[3])
+    assert b == 3
+
+
+def test_concat_subset_split():
+    d1, d2 = RangeDataset(4), RangeDataset(6)
+    cat = ConcatDataset([d1, d2])
+    assert len(cat) == 10
+    assert cat[5][0] == 1.0  # second dataset index 1
+    sub = Subset(cat, [0, 5, 9])
+    assert len(sub) == 3
+    parts = random_split(RangeDataset(10), [7, 3])
+    assert sorted(len(p) for p in parts) == [3, 7]
+    all_idx = sorted(i for p in parts for i in p.indices)
+    assert all_idx == list(range(10))
+
+
+def test_random_split_fractions():
+    parts = random_split(RangeDataset(10), [0.5, 0.5])
+    assert [len(p) for p in parts] == [5, 5]
+
+
+def test_compose_chain():
+    comp = ComposeDataset([RangeDataset(3), RangeDataset(3)])
+    item = comp[1]
+    assert len(item) == 4
+    ch = ChainDataset([StreamDataset(2), StreamDataset(3)])
+    assert len(list(ch)) == 5
+
+
+def test_sequence_and_random_sampler():
+    ds = RangeDataset(8)
+    assert list(SequenceSampler(ds)) == list(range(8))
+    pt.seed(0)
+    order = list(RandomSampler(ds))
+    assert sorted(order) == list(range(8))
+    pt.seed(0)
+    assert list(RandomSampler(ds)) == order  # reproducible after reseed
+
+
+def test_weighted_sampler():
+    w = [0.0, 0.0, 1.0, 0.0]
+    s = WeightedRandomSampler(w, num_samples=10, replacement=True)
+    assert all(i == 2 for i in s)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([1.0], num_samples=0)
+
+
+def test_batch_sampler():
+    bs = BatchSampler(RangeDataset(10), batch_size=3)
+    batches = list(bs)
+    assert [len(b) for b in batches] == [3, 3, 3, 1]
+    assert len(bs) == 4
+    bs2 = BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3 == len(bs2)
+
+
+def test_distributed_batch_sampler():
+    ds = RangeDataset(10)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+        for b in s:
+            seen.extend(b)
+    assert sorted(set(seen)) == list(range(10))
+    assert len(seen) == 12  # padded to 4*3
+
+
+def test_dataloader_basic():
+    dl = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4] and y.shape == [4]
+    np.testing.assert_allclose(x.numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(y.numpy(), [0, 2, 4, 6])
+
+
+def test_dataloader_shuffle_reproducible():
+    pt.seed(3)
+    a = [b[0].numpy().tolist() for b in DataLoader(RangeDataset(10), batch_size=5, shuffle=True)]
+    pt.seed(3)
+    b = [b[0].numpy().tolist() for b in DataLoader(RangeDataset(10), batch_size=5, shuffle=True)]
+    assert a == b
+    flat = [i for batch in a for i in batch]
+    assert sorted(flat) == list(range(10))
+
+
+def test_dataloader_multiworker_order_and_content():
+    dl = DataLoader(RangeDataset(50), batch_size=4, num_workers=3)
+    got = []
+    for x, y in dl:
+        got.extend(x.numpy().tolist())
+    assert got == [float(i) for i in range(50)]
+
+
+def test_dataloader_worker_error_propagates():
+    class Bad(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("boom")
+            return np.float32(i)
+
+    dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+def test_dataloader_iterable_dataset():
+    dl = DataLoader(StreamDataset(7), batch_size=3)
+    shapes = [b.shape for b in dl]
+    assert shapes == [[3], [3], [1]]
+    dl2 = DataLoader(StreamDataset(7), batch_size=3, drop_last=True)
+    assert [b.shape for b in dl2] == [[3], [3]]
+
+
+def test_dataloader_dict_collate():
+    class DictDs(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return {"a": np.float32(i), "b": np.ones(2, np.float32) * i}
+
+    batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+    assert set(batch.keys()) == {"a", "b"}
+    assert batch["b"].shape == [4, 2]
+
+
+def test_dataloader_custom_collate():
+    dl = DataLoader(RangeDataset(4), batch_size=2,
+                    collate_fn=lambda samples: len(samples))
+    assert list(dl) == [2, 2]
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = pt.nn.Linear(3, 2)
+    path = str(tmp_path / "model.pdparams")
+    pt.save(m.state_dict(), path)
+    loaded = pt.load(path)
+    m2 = pt.nn.Linear(3, 2)
+    m2.set_state_dict(loaded)
+    for p1, p2 in zip(m.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+def test_save_load_optimizer_state(tmp_path):
+    m = pt.nn.Linear(3, 2)
+    opt = pt.optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    x = pt.to_tensor(np.ones((2, 3), np.float32))
+    ((m(x)) ** 2).mean().backward()
+    opt.step()
+    path = str(tmp_path / "opt.pdopt")
+    pt.save(opt.state_dict(), path)
+    sd = pt.load(path)
+    opt2 = pt.optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._global_step == 1
+
+
+def test_parallel_env_defaults():
+    env = pt.distributed.ParallelEnv()
+    assert env.rank == 0
+    assert env.world_size == 1
+
+
+def test_worker_init_fn_called_per_worker():
+    seen = []
+    dl = DataLoader(RangeDataset(8), batch_size=2, num_workers=2,
+                    worker_init_fn=lambda wid: seen.append(wid))
+    list(dl)
+    assert sorted(seen) == [0, 1]
+
+
+def test_random_sampler_bounded_generator():
+    import itertools
+    s = RandomSampler(RangeDataset(4), num_samples=5,
+                      generator=itertools.count())
+    assert list(s) == [0, 1, 2, 3, 4]

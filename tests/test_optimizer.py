@@ -346,6 +346,45 @@ def test_state_dict_position_keyed_across_name_shift():
     opt2 = pt.optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
     opt2.set_state_dict(sd)
     for p1, p2 in zip(m1.parameters(), m2.parameters()):
-        a1, a2 = opt1._accumulators[p1.name], opt2._accumulators[p2.name]
+        a1, a2 = opt1._accumulators[p1._uid], opt2._accumulators[p2._uid]
         np.testing.assert_allclose(np.asarray(a1["moment1"]),
                                    np.asarray(a2["moment1"]))
+
+
+def test_duplicate_param_names_keep_separate_state():
+    p1 = pt.Parameter(np.zeros((2,), np.float32), name="weight")
+    p2 = pt.Parameter(np.zeros((2,), np.float32), name="weight")
+    opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[p1, p2])
+    p1.grad = pt.to_tensor(np.ones((2,), np.float32))
+    p2.grad = pt.to_tensor(np.full((2,), -1.0, np.float32))
+    opt.step()
+    m1 = np.asarray(opt._accumulators[p1._uid]["moment1"])
+    m2 = np.asarray(opt._accumulators[p2._uid]["moment1"])
+    assert m1[0] > 0 and m2[0] < 0  # independent moments
+
+
+def test_adamw_group_weight_decay_is_decoupled():
+    w = np.ones((2,), np.float32)
+    p = pt.Parameter(w.copy())
+    opt = pt.optimizer.AdamW(
+        learning_rate=0.1, weight_decay=0.0,
+        parameters=[{"params": [p], "weight_decay": 0.5}])
+    p.grad = pt.to_tensor(np.zeros((2,), np.float32))
+    opt.step()
+    # zero grad: decoupled decay shrinks the param by lr*coeff exactly and
+    # the Adam moments stay zero (coupled L2 would have polluted them)
+    np.testing.assert_allclose(p.numpy(), w * (1 - 0.1 * 0.5), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(opt._accumulators[p._uid]["moment1"]), 0.0)
+
+
+def test_scheduler_state_dict_excludes_hyperparams():
+    s = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=10)
+    for _ in range(12):
+        s.step()
+    sd = s.state_dict()
+    s2 = pt.optimizer.lr.StepDecay(learning_rate=0.01, step_size=5)
+    s2.set_state_dict(sd)
+    assert s2.last_epoch == 12
+    assert s2.base_lr == pytest.approx(0.01)  # new hyperparams preserved
+    assert s2.step_size == 5
