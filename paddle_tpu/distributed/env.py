@@ -24,7 +24,10 @@ def get_rank() -> int:
     try:
         import jax
 
-        if jax.process_count() > 1:
+        # only consult JAX when multi-process was explicitly initialized —
+        # jax.process_count() itself would initialize the device backend
+        # (claiming the TPU chip from e.g. a data-prep process)
+        if jax.distributed.is_initialized():
             return jax.process_index()
     except Exception:
         pass
@@ -38,7 +41,7 @@ def get_world_size() -> int:
     try:
         import jax
 
-        if jax.process_count() > 1:
+        if jax.distributed.is_initialized():
             return jax.process_count()
     except Exception:
         pass

@@ -85,19 +85,28 @@ class _ThreadedPrefetchIter:
             w.start()
 
     def _work(self, worker_id: int):
-        if self._loader.worker_init_fn is not None:
-            self._loader.worker_init_fn(worker_id)
+        init_err = None
+        try:
+            if self._loader.worker_init_fn is not None:
+                self._loader.worker_init_fn(worker_id)
+        except Exception:
+            # must not die silently: claim batches and deliver the error,
+            # otherwise the consumer waits forever on the missing index
+            init_err = traceback.format_exc()
         while True:
             with self._in_lock:
                 i = self._next_in
                 if i >= len(self._indices):
                     return
                 self._next_in += 1
-            try:
-                batch = self._loader._fetch(self._indices[i])
-                payload = (i, batch, None)
-            except Exception:  # propagate to consumer
-                payload = (i, None, traceback.format_exc())
+            if init_err is not None:
+                payload = (i, None, init_err)
+            else:
+                try:
+                    batch = self._loader._fetch(self._indices[i])
+                    payload = (i, batch, None)
+                except Exception:  # propagate to consumer
+                    payload = (i, None, traceback.format_exc())
             with self._results_lock:
                 while (not self._shutdown and
                        i - self._next_out >= self._capacity):

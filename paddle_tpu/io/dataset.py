@@ -139,6 +139,10 @@ class Subset(Dataset):
 def random_split(dataset: Dataset, lengths: Sequence, generator=None) -> List[Subset]:
     """reference: dataloader/dataset.py random_split (supports fractions)."""
     if all(isinstance(l, float) for l in lengths):
+        if abs(sum(lengths) - 1.0) > 1e-6:
+            raise ValueError(
+                f"Fractional lengths must sum to 1, got {sum(lengths)}"
+            )
         total = len(dataset)
         counts = [int(np.floor(total * f)) for f in lengths]
         rem = total - sum(counts)

@@ -59,7 +59,13 @@ class RandomSampler(Sampler):
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:
-            yield from rng.permutation(n)[: self.num_samples].tolist()
+            # num_samples may exceed n: concatenate fresh permutations so the
+            # yielded count always matches __len__
+            want = self.num_samples
+            while want > 0:
+                chunk = rng.permutation(n)[:want].tolist()
+                yield from chunk
+                want -= len(chunk)
 
     def __len__(self):
         return self.num_samples
@@ -175,8 +181,10 @@ class DistributedBatchSampler(BatchSampler):
             self.epoch += 1
         else:
             indices = list(range(n))
-        # pad to be evenly divisible
-        indices += indices[: (self.total_size - n)]
+        # pad to be evenly divisible; cycle when total_size - n > n
+        # (tiny dataset over many replicas)
+        while len(indices) < self.total_size:
+            indices += indices[: (self.total_size - len(indices))]
         # subsample for this rank
         indices = indices[self.local_rank::self.nranks]
         batch = []

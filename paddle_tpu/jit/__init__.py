@@ -1,1 +1,181 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.jit — trace/compile ("dynamic-to-static") API.
+
+Reference parity: ``paddle.jit`` (``python/paddle/jit/api.py:232`` to_static,
+``jit.save/load`` → ``.pdmodel``/``.pdiparams``, ``TranslatedLayer``
+``jit/translated_layer.py``). TPU-native: no AST transforms or ProgramDesc —
+tracing with JAX tracers over the (traceable) eager engine yields one XLA
+program per input signature (static_function.py), and the deployment artifact
+is serialized StableHLO via ``jax.export`` instead of a ProgramDesc protobuf.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import no_grad
+from ..nn.layer_base import Layer
+from ..tensor import Tensor
+from .static_function import InputSpec, StaticFunction, _flatten_out, _rebuild_out
+
+__all__ = [
+    "to_static", "not_to_static", "save", "load", "TranslatedLayer",
+    "StaticFunction", "InputSpec", "enable_to_static", "ignore_module",
+]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    """reference: paddle.jit.enable_to_static — global kill-switch so the same
+    code can run fully eagerly for debugging."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def ignore_module(modules):  # reference: paddle.jit.ignore_module (no-op here)
+    return None
+
+
+def not_to_static(function: Callable) -> Callable:
+    """reference: paddle.jit.not_to_static. The tracer inlines everything, so
+    this is an annotation only (kept for API compatibility)."""
+    function._paddle_tpu_not_to_static = True
+    return function
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Compile an imperative function/Layer per input signature
+    (reference: paddle.jit.to_static, python/paddle/jit/api.py:232)."""
+
+    def decorate(obj):
+        if not _to_static_enabled:
+            return obj
+        if isinstance(obj, Layer):
+            obj.forward = StaticFunction(obj.forward, input_spec, observe=[obj])
+            return obj
+        return StaticFunction(obj, input_spec)
+
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+# ------------------------------------------------------------------ save/load
+_PROGRAM_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+
+
+def _input_avals(input_spec):
+    avals = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            if any(d is None for d in s.shape):
+                # polymorphic dims via jax.export symbolic shapes
+                names = ",".join(
+                    f"s{i}_{j}" if d is None else str(d)
+                    for j, d in enumerate(s.shape)
+                )
+                shape = jax.export.symbolic_shape(f"({names})")
+                avals.append(jax.ShapeDtypeStruct(shape, s.dtype))
+            else:
+                avals.append(jax.ShapeDtypeStruct(s.shape, s.dtype))
+        elif isinstance(s, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+        else:
+            arr = jnp.asarray(s)
+            avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return avals
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
+    """Serialize a Layer/function for deployment (reference: paddle.jit.save,
+    python/paddle/jit/api.py; artifact roles match .pdmodel/.pdiparams from
+    jit/serializer.cc — program := serialized StableHLO, params := pickled
+    ndarray state_dict)."""
+    fn = layer.forward if isinstance(layer, Layer) else layer
+    if isinstance(fn, StaticFunction):
+        if input_spec is None:
+            input_spec = fn._input_spec
+        fn = fn.dygraph_function
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (list of InputSpec/Tensor)")
+
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    names = list(state.keys())
+    was_training = isinstance(layer, Layer) and layer.training
+    if isinstance(layer, Layer):
+        layer.eval()
+    holder = {}
+
+    def pure(params, *xs):
+        old = [state[n]._value for n in names]
+        for n in names:
+            state[n]._value = params[n]
+        try:
+            with no_grad():
+                out = fn(*[Tensor(x) for x in xs])
+        finally:
+            for n, v in zip(names, old):
+                state[n]._value = v
+        arrays, spec = _flatten_out(out)
+        holder["out_spec"] = spec
+        return arrays
+
+    try:
+        param_avals = {n: jax.ShapeDtypeStruct(tuple(state[n].shape), state[n].dtype)
+                       for n in names}
+        exported = jax.export.export(jax.jit(pure))(param_avals, *_input_avals(input_spec))
+        blob = exported.serialize()
+    finally:
+        if was_training:
+            layer.train()
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + _PROGRAM_SUFFIX, "wb") as f:
+        pickle.dump({"stablehlo": bytes(blob), "out_spec": holder["out_spec"],
+                     "param_names": names}, f)
+    with open(path + _PARAMS_SUFFIX, "wb") as f:
+        pickle.dump({n: np.asarray(state[n]._value) for n in names}, f)
+
+
+class TranslatedLayer(Layer):
+    """A deployed program loaded back as a Layer (reference: TranslatedLayer,
+    python/paddle/jit/translated_layer.py). Executes the deserialized
+    StableHLO program; parameters are real Parameters so ``state_dict`` and
+    device placement work normally."""
+
+    def __init__(self, exported, out_spec, params: dict):
+        super().__init__()
+        from ..tensor import Parameter
+
+        self._exported = exported
+        self._out_spec = out_spec
+        self._param_names = list(params.keys())
+        for flat_name, value in params.items():
+            safe = flat_name.replace(".", "__")
+            self.add_parameter(safe, Parameter(jnp.asarray(value)))
+
+    def forward(self, *inputs):
+        params = {
+            n: self._parameters[n.replace(".", "__")]._value
+            for n in self._param_names
+        }
+        xs = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs]
+        arrays = self._exported.call(params, *xs)
+        return _rebuild_out(self._out_spec, list(arrays))
+
+
+def load(path: str) -> TranslatedLayer:
+    """reference: paddle.jit.load."""
+    with open(path + _PROGRAM_SUFFIX, "rb") as f:
+        prog = pickle.load(f)
+    with open(path + _PARAMS_SUFFIX, "rb") as f:
+        params = pickle.load(f)
+    exported = jax.export.deserialize(prog["stablehlo"])
+    return TranslatedLayer(exported, prog["out_spec"], params)

@@ -1,0 +1,454 @@
+"""StaticFunction — the trace/compile engine behind ``paddle_tpu.jit.to_static``.
+
+TPU-native counterpart of the reference's dy2static stack
+(``python/paddle/jit/api.py:232`` ``to_static`` → ``StaticFunction``
+``dy2static/program_translator.py:304`` → AST transform → Program →
+``PartialProgramLayer``) **and** of the static-graph executor
+(``InterpreterCore``, ``new_executor/interpretercore.h:41``): on TPU both
+collapse into "trace the imperative code with JAX tracers, compile one XLA
+program per input signature, cache it" (cache keyed like ``_ExecutorCache``,
+``fluid/executor.py:722``).
+
+No AST rewriting is needed: the eager engine (autograd/engine.py) is
+traceable by construction, so the *same* imperative train-step code — forward,
+``loss.backward()`` tape walk, ``opt.step()`` — runs under ``jax.jit`` tracers
+and lowers to a single fused XLA program, parameter updates included (the
+reference needed separate eager/static engines + program passes for this).
+
+Mutable state is functionalized through *slots*: every Parameter/buffer cell,
+optimizer accumulator, and RNG key reachable from the function is passed in
+and returned as an explicit pytree, with input buffers donated so XLA updates
+parameters in place (the buffer-donation answer to the reference's inplace
+``adamw_`` ops — SURVEY.md §7 hard part #2).
+"""
+from __future__ import annotations
+
+import gc
+import weakref
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import tensor as tensor_mod
+from ..generator import Generator, default_generator
+from ..nn.layer_base import Layer
+from ..optimizer.optimizer import Optimizer
+from ..tensor import Tensor
+
+__all__ = ["StaticFunction", "InputSpec"]
+
+
+class InputSpec:
+    """reference: paddle.static.InputSpec (python/paddle/static/input.py).
+
+    ``None`` dims mean "polymorphic": each distinct concrete value simply
+    compiles (and caches) one more XLA executable — padding/bucketing is the
+    caller's policy (SURVEY.md §7 hard part #3).
+    """
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        from .. import dtypes
+
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# --------------------------------------------------------------------- slots
+class _TensorSlot:
+    """A mutable Tensor cell captured as compiled-step state."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, t: Tensor):
+        self.t = t
+
+    def get(self):
+        return self.t._value
+
+    def set(self, v):
+        self.t._value = v
+
+    def sanitize(self):
+        """Drop trace-time tape residue so no tracer outlives the trace."""
+        t = self.t
+        t._grad_node = None
+        if t.grad is not None and isinstance(t.grad._value, jax.core.Tracer):
+            t.grad = None
+
+
+class _AccSlot:
+    """One optimizer accumulator array (state lives in Optimizer._accumulators)."""
+
+    __slots__ = ("opt", "uid", "name")
+
+    def __init__(self, opt: Optimizer, uid: int, name: str):
+        self.opt, self.uid, self.name = opt, uid, name
+
+    def get(self):
+        return self.opt._accumulators[self.uid][self.name]
+
+    def set(self, v):
+        self.opt._accumulators[self.uid][self.name] = v
+
+    def sanitize(self):
+        pass
+
+
+class _GenSlot:
+    """The global PRNG key (generator.py) — randomness becomes a pure
+    function of the captured key, threefry compiled into the program."""
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen: Generator):
+        self.gen = gen
+
+    def get(self):
+        return self.gen.get_state()
+
+    def set(self, v):
+        self.gen.set_state(v)
+
+    def sanitize(self):
+        pass
+
+
+class _WriteRecorder:
+    """Hooks tensor_mod._trace_recorders during the warm-up eager call to
+    catch mutable cells the structural scan missed (module-global EMA tensors
+    and the like)."""
+
+    def __init__(self):
+        self.written: dict[int, weakref.ref] = {}
+
+    def record_write(self, t: Tensor):
+        self.written[id(t)] = weakref.ref(t)
+
+    def alive_tensors(self):
+        gc.collect()  # temporaries written in-place then dropped must not become state
+        return [r() for r in self.written.values() if r() is not None]
+
+
+# ----------------------------------------------------------------- discovery
+def _scan_state(objs: Sequence[Any], transient: Sequence[Any] = ()):
+    """Walk closures/args for Layers, Optimizers, Generators, Tensors and any
+    object exposing ``__jit_state__()`` (e.g. amp.GradScaler). Returns
+    (slots, optimizers, layers).
+
+    ``transient`` objects (call arguments) are walked for Layers/Optimizers,
+    but bare Tensors found there are data batches, not persistent state —
+    registering them as slots would pin the warm-up batch in HBM forever and
+    round-trip it through every compiled call."""
+    seen: set[int] = set()
+    tensors: list[Tensor] = []
+    opts: list[Optimizer] = []
+    layers: list[Layer] = []
+    gens: list[Generator] = [default_generator]
+    stack = [(o, False) for o in objs] + [(o, True) for o in transient]
+    while stack:
+        o, is_transient = stack.pop()
+        if o is None or id(o) in seen:
+            continue
+        seen.add(id(o))
+        if isinstance(o, Tensor):
+            if not is_transient:
+                tensors.append(o)
+        elif isinstance(o, Layer):
+            layers.append(o)
+            tensors.extend(o.parameters())
+            tensors.extend(o.buffers())
+        elif isinstance(o, Optimizer):
+            opts.append(o)
+            stack.extend((p, False) for p in (o._parameter_list or []))
+            if getattr(o, "_grad_clip", None) is not None:
+                stack.append((o._grad_clip, False))
+        elif isinstance(o, Generator):
+            gens.append(o)
+        elif isinstance(o, (list, tuple, set, frozenset)):
+            stack.extend((v, is_transient) for v in o)
+        elif isinstance(o, dict):
+            stack.extend((v, is_transient) for v in o.values())
+        if hasattr(o, "__jit_state__"):
+            try:
+                stack.extend((v, False) for v in o.__jit_state__())
+            except Exception:
+                pass
+    slots: list = []
+    slot_ids: set[int] = set()
+    for t in tensors:
+        if id(t) not in slot_ids:
+            slot_ids.add(id(t))
+            slots.append(_TensorSlot(t))
+    for g in dict.fromkeys(gens):
+        slots.append(_GenSlot(g))
+    return slots, opts, layers, slot_ids
+
+
+def _closure_objects(fn: Callable):
+    objs = []
+    f = fn
+    if hasattr(f, "__self__") and f.__self__ is not None:
+        objs.append(f.__self__)
+        f = f.__func__
+    if getattr(f, "__closure__", None):
+        for cell in f.__closure__:
+            try:
+                objs.append(cell.cell_contents)
+            except ValueError:
+                pass
+    if getattr(f, "__defaults__", None):
+        objs.extend(f.__defaults__)
+    return objs
+
+
+# ------------------------------------------------------------ arg flattening
+class _Static:
+    """Marker wrapping a non-tensor leaf; identity participates in cache key."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+
+def _flatten_args(tree):
+    """Split (args, kwargs) into (traced arrays, spec) where spec rebuilds the
+    structure with placeholders for traced leaves. Tensors and bare jax/numpy
+    arrays are traced; python scalars/strings/None are static."""
+    arrays: list = []
+    meta: list = []  # parallel to arrays: (stop_gradient,)
+
+    def go(x):
+        if isinstance(x, Tensor):
+            arrays.append(x._value)
+            meta.append(bool(x.stop_gradient))
+            return ("T", len(arrays) - 1)
+        if isinstance(x, (jax.Array, np.ndarray)):
+            arrays.append(jnp.asarray(x))
+            meta.append(True)
+            return ("A", len(arrays) - 1)
+        if isinstance(x, (list, tuple)):
+            return (type(x).__name__, [go(v) for v in x])
+        if isinstance(x, dict):
+            return ("dict", [(k, go(v)) for k, v in sorted(x.items(), key=lambda kv: str(kv[0]))])
+        return ("S", _Static(x))
+
+    spec = go(tree)
+    return arrays, meta, spec
+
+
+def _rebuild_args(spec, arrays, meta):
+    kind, payload = spec
+    if kind == "T":
+        return Tensor(arrays[payload], stop_gradient=meta[payload])
+    if kind == "A":
+        return arrays[payload]
+    if kind == "S":
+        return payload.v
+    if kind == "list":
+        return [_rebuild_args(s, arrays, meta) for s in payload]
+    if kind == "tuple":
+        return tuple(_rebuild_args(s, arrays, meta) for s in payload)
+    if kind == "dict":
+        return {k: _rebuild_args(s, arrays, meta) for k, s in payload}
+    raise AssertionError(kind)
+
+
+def _spec_key(spec, arrays, meta):
+    kind, payload = spec
+    if kind in ("T", "A"):
+        a = arrays[payload]
+        return (kind, tuple(a.shape), str(a.dtype), meta[payload])
+    if kind == "S":
+        v = payload.v
+        try:
+            hash(v)
+            return ("S", v)
+        except TypeError:
+            return ("S", repr(v))
+    if kind in ("list", "tuple"):
+        return (kind, tuple(_spec_key(s, arrays, meta) for s in payload))
+    if kind == "dict":
+        return ("dict", tuple((k, _spec_key(s, arrays, meta)) for k, s in payload))
+    raise AssertionError(kind)
+
+
+def _flatten_out(out):
+    arrays: list = []
+
+    def go(x):
+        if isinstance(x, Tensor):
+            arrays.append(x._value)
+            return ("T", len(arrays) - 1, bool(x.stop_gradient))
+        if isinstance(x, (jax.Array, jax.core.Tracer)):
+            arrays.append(x)
+            return ("A", len(arrays) - 1, True)
+        if isinstance(x, (list, tuple)):
+            return (type(x).__name__, [go(v) for v in x], None)
+        if isinstance(x, dict):
+            return ("dict", [(k, go(v)) for k, v in x.items()], None)
+        return ("S", x, None)
+
+    spec = go(out)
+    return arrays, spec
+
+
+def _rebuild_out(spec, arrays):
+    kind, payload, extra = spec
+    if kind == "T":
+        return Tensor(arrays[payload], stop_gradient=extra)
+    if kind == "A":
+        return arrays[payload]
+    if kind == "S":
+        return payload
+    if kind == "list":
+        return [_rebuild_out(s, arrays) for s in payload]
+    if kind == "tuple":
+        return tuple(_rebuild_out(s, arrays) for s in payload)
+    if kind == "dict":
+        return {k: _rebuild_out(s, arrays) for k, s in payload}
+    raise AssertionError(kind)
+
+
+def _buffer_ptr(v):
+    try:
+        return v.unsafe_buffer_pointer()
+    except Exception:
+        return id(v)
+
+
+def _unalias(state_vals, protected):
+    """State buffers are donated to the compiled step; XLA rejects a donated
+    buffer that aliases another argument (e.g. two accumulators both produced
+    by one CSE'd zeros_like, or a Parameter also passed as a data input).
+    Copy any such duplicate so every donated buffer is unique."""
+    seen = {_buffer_ptr(v) for v in protected}
+    out = []
+    for v in state_vals:
+        ptr = _buffer_ptr(v)
+        if ptr in seen:
+            v = jnp.array(v, copy=True)
+        else:
+            seen.add(ptr)
+        out.append(v)
+    return out
+
+
+# ------------------------------------------------------------ StaticFunction
+class _Compiled:
+    __slots__ = ("jitted", "out_spec")
+
+    def __init__(self, jitted, out_spec=None):
+        self.jitted = jitted
+        self.out_spec = out_spec
+
+
+class StaticFunction:
+    """Callable wrapper compiling the wrapped imperative fn per input
+    signature (reference: StaticFunction, dy2static/program_translator.py:304).
+    """
+
+    def __init__(self, function: Callable, input_spec=None, build_strategy=None,
+                 property=False, full_graph=True, observe: Sequence[Any] = ()):
+        self._fn = function
+        self._input_spec = input_spec
+        self._observe = list(observe)
+        self._slots: Optional[list] = None
+        self._slot_ids: set[int] = set()
+        self._opts: list[Optimizer] = []
+        self._layers: list[Layer] = []
+        self._cache: dict = {}
+        self._warmed_up = False
+        self.__name__ = getattr(function, "__name__", "static_fn")
+        self.__doc__ = getattr(function, "__doc__", None)
+
+    # -- paddle API surface --------------------------------------------------
+    @property
+    def dygraph_function(self):
+        return self._fn
+
+    def concrete_program_specified_input_spec(self, *a, **k):  # legacy shim
+        return None
+
+    def rollback(self):
+        return self._fn
+
+    # -- warm-up -------------------------------------------------------------
+    def _warmup(self, args, kwargs):
+        """First call runs eagerly: materializes lazy optimizer accumulators,
+        and records every cell written in-place (counterpart of the program
+        build phase of the reference's first Executor.run)."""
+        rec = _WriteRecorder()
+        tensor_mod._trace_recorders.append(rec)
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            tensor_mod._trace_recorders.remove(rec)
+        slots, opts, layers, slot_ids = _scan_state(
+            _closure_objects(self._fn) + self._observe,
+            transient=list(args) + list(kwargs.values()),
+        )
+        for t in rec.alive_tensors():
+            if id(t) not in slot_ids:
+                slot_ids.add(id(t))
+                slots.append(_TensorSlot(t))
+        for opt in opts:
+            for uid, accs in opt._accumulators.items():
+                for name in accs:
+                    slots.append(_AccSlot(opt, uid, name))
+        self._slots, self._opts, self._layers = slots, opts, layers
+        self._slot_ids = slot_ids
+        self._warmed_up = True
+        return out
+
+    # -- compile -------------------------------------------------------------
+    def _build(self, spec, meta):
+        slots, opts, fn = self._slots, self._opts, self._fn
+        holder = _Compiled(None)
+
+        def _functional(state_vals, lr_vals, arg_arrays):
+            for slot, v in zip(slots, state_vals):
+                slot.set(v)
+            for opt, lr in zip(opts, lr_vals):
+                opt._lr_override = lr
+            try:
+                args, kwargs = _rebuild_args(spec, arg_arrays, meta)
+                out = fn(*args, **kwargs)
+            finally:
+                for opt in opts:
+                    opt._lr_override = None
+            out_arrays, out_spec = _flatten_out(out)
+            holder.out_spec = out_spec
+            new_state = [slot.get() for slot in slots]
+            return out_arrays, new_state
+
+        holder.jitted = jax.jit(_functional, donate_argnums=(0,))
+        return holder
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not self._warmed_up:
+            return self._warmup(args, kwargs)
+        arrays, meta, spec = _flatten_args((args, kwargs))
+        key = (
+            _spec_key(spec, arrays, meta),
+            tuple(l.training for l in self._layers),
+        )
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(spec, tuple(meta))
+            self._cache[key] = compiled
+        state_vals = _unalias([s.get() for s in self._slots], arrays)
+        lr_vals = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._opts]
+        out_arrays, new_state = compiled.jitted(state_vals, lr_vals, arrays)
+        for slot, v in zip(self._slots, new_state):
+            slot.set(v)
+            slot.sanitize()
+        return _rebuild_out(compiled.out_spec, out_arrays)

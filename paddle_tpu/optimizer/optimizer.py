@@ -124,7 +124,12 @@ class Optimizer:
         self._learning_rate = scheduler
 
     def _lr_value(self):
-        """Current lr as a jnp scalar (traceable)."""
+        """Current lr as a jnp scalar (traceable). Under paddle_tpu.jit the
+        tracer installs ``_lr_override`` so the lr is a traced input of the
+        compiled step — scheduler.step() between calls then needs no retrace."""
+        override = getattr(self, "_lr_override", None)
+        if override is not None:
+            return override
         return jnp.asarray(self.get_lr(), dtype=jnp.float32)
 
     # ---------------------------------------------------------- accumulators

@@ -12,7 +12,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from .optimizer import Optimizer
+from .optimizer import Optimizer, _L2Decay
 
 __all__ = [
     "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta",
@@ -102,11 +102,16 @@ class AdamW(Adam):
         self._coeff = float(weight_decay) if weight_decay is not None else 0.0
         # AdamW decay is DECOUPLED: reinterpret param-group weight_decay
         # (parsed as coupled-L2 regularizers by the base) as per-param
-        # decoupled coefficients.
-        self._decay_by_uid = {
-            uid: getattr(reg, "coeff", 0.0) for uid, reg in self._group_wd.items()
-        }
-        self._group_wd = {}
+        # decoupled coefficients. A custom callable regularizer has no
+        # decoupled interpretation — it stays a coupled grad-transform.
+        self._decay_by_uid = {}
+        kept = {}
+        for uid, reg in self._group_wd.items():
+            if isinstance(reg, _L2Decay):
+                self._decay_by_uid[uid] = reg.coeff
+            else:
+                kept[uid] = reg
+        self._group_wd = kept
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
         self._current_param_name = None

@@ -1,0 +1,166 @@
+"""paddle_tpu.jit: trace/compile parity with eager execution.
+
+Mirrors the reference's dy2static test strategy (SURVEY.md §4: run the same
+nn code eagerly and compiled, compare outputs — test/dygraph_to_static/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+
+
+def _make_model_and_data(seed=7):
+    paddle.seed(seed)
+    model = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+    )
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((32, 8)).astype("float32")
+    y = rng.integers(0, 4, (32,))
+    return model, x, y
+
+
+class TestToStaticForward:
+    def test_forward_matches_eager(self):
+        model, x, _ = _make_model_and_data()
+        eager_out = model(paddle.to_tensor(x)).numpy()
+
+        fwd = jit.to_static(lambda t: model(t))
+        t = paddle.to_tensor(x)
+        out1 = fwd(t).numpy()          # warm-up (eager)
+        out2 = fwd(paddle.to_tensor(x)).numpy()  # compiled
+        np.testing.assert_allclose(out1, eager_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out2, eager_out, rtol=1e-5, atol=1e-5)
+
+    def test_retrace_on_new_shape(self):
+        model, x, _ = _make_model_and_data()
+        fwd = jit.to_static(lambda t: model(t))
+        fwd(paddle.to_tensor(x))                   # warmup
+        fwd(paddle.to_tensor(x))                   # compile @32
+        out = fwd(paddle.to_tensor(x[:8])).numpy() # compile @8
+        assert out.shape == (8, 4)
+        assert len(fwd._cache) == 2
+
+    def test_layer_decoration(self):
+        model, x, _ = _make_model_and_data()
+        ref = model(paddle.to_tensor(x)).numpy()
+        model = jit.to_static(model)
+        out = model(paddle.to_tensor(x))
+        out = model(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+class TestCompiledTrainStep:
+    def test_train_step_matches_eager(self):
+        """Two models, same init: one trained eagerly, one with a compiled
+        step (forward+backward+adam update in one XLA program)."""
+        model_a, x, y = _make_model_and_data(seed=3)
+        model_b, _, _ = _make_model_and_data(seed=3)
+        for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+            np.testing.assert_array_equal(pa.numpy(), pb.numpy())
+
+        opt_a = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model_a.parameters())
+        opt_b = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model_b.parameters())
+
+        def eager_step(xb, yb):
+            loss = F.cross_entropy(model_a(xb), yb)
+            loss.backward()
+            opt_a.step()
+            opt_a.clear_grad()
+            return loss
+
+        @jit.to_static
+        def compiled_step(xb, yb):
+            loss = F.cross_entropy(model_b(xb), yb)
+            loss.backward()
+            opt_b.step()
+            opt_b.clear_grad()
+            return loss
+
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        losses_a = [float(eager_step(xt, yt).numpy()) for _ in range(5)]
+        losses_b = [float(compiled_step(xt, yt).numpy()) for _ in range(5)]
+        np.testing.assert_allclose(losses_a, losses_b, rtol=1e-4, atol=1e-5)
+        for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+            np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=1e-4, atol=1e-5)
+        assert losses_a[-1] < losses_a[0]
+
+    def test_lr_scheduler_no_retrace(self):
+        model, x, y = _make_model_and_data()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+
+        @jit.to_static
+        def step(xb, yb):
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        step(xt, yt)  # warmup
+        before = [p.numpy().copy() for p in model.parameters()]
+        step(xt, yt)  # compiled, lr=0.1 (after 0 sched steps... first call already stepped? no: sched.step() is manual)
+        sched.step()
+        step(xt, yt)  # compiled, lr=0.05 — must NOT retrace
+        assert len(step._cache) == 1
+        after = [p.numpy() for p in model.parameters()]
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_dropout_rng_advances(self):
+        paddle.seed(11)
+        drop = nn.Dropout(0.5)
+
+        @jit.to_static
+        def f(t):
+            return drop(t)
+
+        x = paddle.to_tensor(np.ones((64, 64), "float32"))
+        f(x)  # warmup
+        a = f(x).numpy()
+        b = f(x).numpy()
+        assert not np.array_equal(a, b), "PRNG key must advance between compiled calls"
+        assert abs(a.mean() - 1.0) < 0.2  # inverted dropout scaling
+
+    def test_batchnorm_stats_update(self):
+        bn = nn.BatchNorm1D(8)
+
+        @jit.to_static
+        def f(t):
+            return bn(t)
+
+        x = np.random.default_rng(0).standard_normal((16, 8)).astype("float32") * 3 + 5
+        f(paddle.to_tensor(x))  # warmup (eager) updates stats once
+        m1 = bn._mean.numpy().copy()
+        f(paddle.to_tensor(x))  # compiled
+        m2 = bn._mean.numpy()
+        assert not np.allclose(m1, m2), "running mean must update inside compiled step"
+        assert m2.mean() > 0.8  # moving toward true mean 5 (≈5·(1−0.9²) after 2 steps)
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        model, x, _ = _make_model_and_data()
+        model.eval()
+        ref = model(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "infer/model")
+        jit.save(model, path, input_spec=[jit.InputSpec([32, 8], "float32")])
+
+        loaded = jit.load(path)
+        out = loaded(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_save_load_dynamic_batch(self, tmp_path):
+        model, x, _ = _make_model_and_data()
+        model.eval()
+        path = str(tmp_path / "model_dyn")
+        jit.save(model, path, input_spec=[jit.InputSpec([None, 8], "float32")])
+        loaded = jit.load(path)
+        for n in (4, 32):
+            out = loaded(paddle.to_tensor(x[:n])).numpy()
+            ref = model(paddle.to_tensor(x[:n])).numpy()
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
