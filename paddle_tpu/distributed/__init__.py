@@ -1,9 +1,37 @@
 """paddle_tpu.distributed — mesh-parallel training over XLA collectives.
 
-reference parity: python/paddle/distributed/ (see SURVEY.md §2.3). Built up
-in milestones: env/bootstrap first; mesh topology, collectives API, TP/PP/
-sharding/MoE layers, auto_parallel engine, launch CLI follow.
+reference parity: python/paddle/distributed/ (see SURVEY.md §2.3). The
+reference's process groups / NCCL rings / program passes become: ONE
+jax.sharding.Mesh with the hybrid axes [dp, pp, sharding, sep, mp]
+(topology.py), GSPMD sharding annotations (sharding_api.py), lax collectives
+inside shard_map for manual comm (collective.py), and fleet/* parallel layers
+annotated for the mesh.
 """
-from .env import ParallelEnv, get_rank, get_world_size
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
+    new_group, reduce, scatter, wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel, init_parallel_env, scale_loss, shard_map_fn,
+)
+from .sharding import group_sharded_parallel  # noqa: F401
+from .sharding_api import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    reshard, shard_layer, shard_tensor,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, create_mesh, get_mesh, set_mesh,
+)
 
-__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "DataParallel", "scale_loss", "shard_map_fn",
+    "ReduceOp", "new_group", "get_group", "all_reduce", "all_gather",
+    "broadcast", "reduce", "scatter", "alltoall", "barrier", "wait",
+    "ProcessMesh", "Shard", "Replicate", "Partial", "Placement",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+    "CommunicateTopology", "HybridCommunicateGroup", "create_mesh",
+    "get_mesh", "set_mesh", "fleet", "group_sharded_parallel",
+]

@@ -1,0 +1,282 @@
+"""Collective communication API.
+
+Reference parity: ``python/paddle/distributed/communication/`` (all_reduce,
+all_gather, broadcast, reduce, scatter, alltoall, send/recv, barrier) over
+``ProcessGroup`` (``paddle/fluid/distributed/collective/process_group.h:53``).
+
+TPU-native semantics: there are no per-process tensors to reduce — a
+"collective" is an XLA op over a mesh axis. Two usage modes:
+
+1. **Inside a shard_map region** (the counterpart of writing a collective op
+   into a static program): these functions lower to ``lax.psum`` /
+   ``lax.all_gather`` / ``lax.ppermute`` / ``lax.all_to_all`` on the group's
+   axis and XLA schedules them onto ICI.
+2. **Eager, on mesh-sharded arrays**: reduction across an axis a tensor is
+   *sharded or partial over* is what GSPMD inserts automatically; calling
+   all_reduce on a replicated eager tensor is therefore the identity (matching
+   the observable per-rank result of the reference's allreduce of identical
+   replicas). Calling it on per-shard-distinct data requires shard_map —
+   a clear error says so.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..ops._apply import ensure_tensor
+from ..autograd.engine import apply_op
+from ..tensor import Tensor
+from . import topology
+from .topology import _AxisGroup
+
+__all__ = [
+    "ReduceOp", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "broadcast", "reduce", "scatter", "alltoall",
+    "barrier", "send", "recv", "wait", "split_axis",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_groups: dict = {}
+_next_gid = [0]
+
+
+def new_group(ranks=None, backend=None, axis: Optional[str] = None,
+              timeout=None) -> _AxisGroup:
+    """reference: paddle.distributed.new_group. A group handle names a mesh
+    axis; default is the whole (flattened) mesh."""
+    mesh = topology.get_mesh()
+    if mesh is None:
+        raise RuntimeError("no device mesh; call fleet.init or init_parallel_env first")
+    axis = axis or mesh.axis_names[0]
+    g = _AxisGroup(mesh, axis)
+    g.id = _next_gid[0]
+    _next_gid[0] += 1
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[_AxisGroup]:
+    return _groups.get(gid)
+
+
+def _axis_of(group) -> Optional[str]:
+    if group is None:
+        mesh = topology.get_mesh()
+        if mesh is None:
+            return None
+        # default group: every mesh axis (full world)
+        return tuple(mesh.axis_names)
+    return group.axis
+
+
+def _axis_bound(axis) -> bool:
+    """True only when ``axis`` is a bound collective axis, i.e. we are inside
+    a shard_map region over it. A plain jit/vjp tracer has no bound axes —
+    those must take the eager/error path, not emit an unbound psum."""
+    if axis is None:
+        return False
+    names = axis if isinstance(axis, tuple) else (axis,)
+    try:
+        for n in names:
+            jax.lax.axis_size(n)
+        return True
+    except Exception:
+        return False
+
+
+def _single_axis(ax, op_name: str) -> str:
+    if isinstance(ax, tuple):
+        if len(ax) == 1:
+            return ax[0]
+        raise ValueError(
+            f"{op_name} over the default (multi-axis) group is ambiguous on a "
+            f"hybrid mesh {ax}; pass group=new_group(axis='<mesh axis>')"
+        )
+    return ax
+
+
+def _reduce_traced(value, axis, op):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(value, axis)
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(value, axis)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(value, axis)
+    if op in (ReduceOp.AVG, "avg"):
+        return jax.lax.pmean(value, axis)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(jax.lax.psum(jnp.log(value), axis))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: communication/all_reduce.py. In-place on the Tensor wrapper
+    (paddle mutates its argument); returns it for chaining."""
+    t = ensure_tensor(tensor)
+    axis = _axis_of(group)
+    if _axis_bound(axis):
+        out = apply_op(lambda v: _reduce_traced(v, axis, op), [t], name="all_reduce")
+        if isinstance(tensor, Tensor):
+            from ..autograd.engine import inplace_rebind
+
+            inplace_rebind(tensor, out)
+            return tensor
+        return out
+    # eager: replicated value — allreduce of identical replicas is identity
+    # (scaled by nranks for SUM, matching observable per-rank results only
+    # when replicas differ would shard_map be needed)
+    raise RuntimeError(
+        "eager all_reduce outside shard_map has no per-rank operands on TPU: "
+        "under GSPMD gradient/activation reductions are inserted by XLA. For "
+        "manual collectives, run inside paddle_tpu.distributed.shard_map_fn."
+    )
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_dim: int = 0):
+    """reference: communication/all_gather.py — gathers shards along a new
+    leading dim, appended to tensor_list (paddle convention) or returned."""
+    t = ensure_tensor(tensor)
+    ax = _axis_of(group)
+    if not _axis_bound(ax):
+        raise RuntimeError("eager all_gather requires a shard_map region on TPU")
+    out = apply_op(
+        lambda v: jax.lax.all_gather(v, ax, axis=axis_dim, tiled=False),
+        [t], name="all_gather",
+    )
+    if tensor_list is not None:
+        from ..ops import manipulation as _manip
+
+        n = out.shape[axis_dim]
+        for i in range(n):
+            tensor_list.append(out[i] if axis_dim == 0
+                               else _manip.squeeze(
+                                   _manip.slice(out, [axis_dim], [i], [i + 1]),
+                                   axis=axis_dim))
+        return None
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    """reference: communication/all_gather.py all_gather_object — host-side
+    python object gather. Single-controller SPMD: every 'rank' holds the same
+    object; multi-host object exchange goes through the coordination service.
+    """
+    if jax.distributed.is_initialized() and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(obj)
+        object_list.extend(list(gathered))
+    else:
+        mesh = topology.get_mesh()
+        if group is not None:
+            n = group.nranks
+        elif mesh is not None:
+            n = int(np.prod(list(mesh.shape.values())))
+        else:
+            n = 1
+        object_list.extend([obj] * n)
+    return None
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    """reference: communication/broadcast.py. Inside shard_map: take src
+    rank's value across the axis."""
+    t = ensure_tensor(tensor)
+    ax = _axis_of(group)
+    if not _axis_bound(ax):
+        return tensor  # replicated SPMD value is already "broadcast"
+    def _bcast(v):
+        return jax.lax.all_gather(v, ax)[src]
+
+    out = apply_op(_bcast, [t], name="broadcast")
+    if isinstance(tensor, Tensor):
+        from ..autograd.engine import inplace_rebind
+
+        inplace_rebind(tensor, out)
+        return tensor
+    return out
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: communication/reduce.py — on SPMD every rank computes the
+    reduction; dst selection is a no-op (all ranks hold the result)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    """reference: communication/scatter.py — inside shard_map, rank i takes
+    slice i of the src-stacked input."""
+    t = ensure_tensor(tensor)
+    ax = _axis_of(group)
+    if not _axis_bound(ax):
+        raise RuntimeError("eager scatter requires a shard_map region on TPU")
+    axis_name = _single_axis(ax, "scatter")
+
+    def _scatter(v):
+        i = jax.lax.axis_index(axis_name)
+        return jax.lax.dynamic_index_in_dim(v, i, axis=0, keepdims=False)
+
+    return apply_op(_scatter, [t], name="scatter")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference: communication/all_to_all.py → lax.all_to_all (the
+    global_scatter/global_gather MoE path, operators/collective/)."""
+    t = ensure_tensor(in_tensor_list)
+    ax = _axis_of(group)
+    if not _axis_bound(ax):
+        raise RuntimeError("eager alltoall requires a shard_map region on TPU")
+    axis_name = _single_axis(ax, "alltoall")
+    return apply_op(
+        lambda v: jax.lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0,
+                                     tiled=True),
+        [t], name="alltoall",
+    )
+
+
+def send(tensor, dst: int, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv maps to lax.ppermute inside shard_map on "
+        "TPU; use paddle_tpu.distributed.p2p helpers or pipeline layers"
+    )
+
+
+recv = send
+
+
+def barrier(group=None):
+    """reference: communication/barrier — single-controller SPMD needs no
+    host barrier; block on device work instead."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor
+
+
+def split_axis(x, axis_name: str, dim: int = 0):
+    """Helper: inside shard_map, slice this rank's shard along dim."""
+    t = ensure_tensor(x)
+
+    def _split(v):
+        i = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        size = v.shape[dim] // n
+        return jax.lax.dynamic_slice_in_dim(v, i * size, size, axis=dim)
+
+    return apply_op(_split, [t], name="split_axis")

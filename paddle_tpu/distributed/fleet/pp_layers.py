@@ -1,0 +1,186 @@
+"""Pipeline-parallel layer container.
+
+Reference parity: ``python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py`` — ``LayerDesc`` (:56), ``SharedLayerDesc``
+(:76, tied embeddings), ``SegmentLayers`` (:92, uniform / parameter-count
+balanced partitioning), ``PipelineLayer`` (:208).
+
+TPU-native execution model: a PipelineLayer DESCRIBES the stage partition;
+the schedule is not an interceptor message loop (fleet_executor) nor NCCL P2P
+(p2p_communication.py) but one XLA program: stages are laid out over the
+mesh's 'pp' axis and microbatches stream through a ``lax.scan`` whose carry
+moves between stages via collective-permute (see pipeline_schedule.py). The
+container here owns segmentation + the user API; it runs stages sequentially
+when pp degree is 1 (exact semantics, zero overhead).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from .. import topology
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "SegmentLayers"]
+
+
+class LayerDesc:
+    """reference: pp_layers.py:56 — lazy layer constructor so each pipeline
+    stage only materializes its own parameters."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) and not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer class or callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py:76 — a layer shared between stages (tied
+    input/output embeddings). On TPU the two stages share THE parameter cell
+    (single-controller), so the reference's shared-weight allreduce sync over
+    the embed group is unnecessary: gradient contributions from both uses
+    accumulate on one tape leaf."""
+
+    def __init__(self, key: str, layer_func: Callable, forward_func=None,
+                 shared_weight_attr: str = "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:92 — split N layer descs into num_parts
+    contiguous segments, uniformly or balanced by parameter count."""
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform", num_virtual_pipeline_stage: int = 1):
+        self.descs = list(layers_desc)
+        self.num_parts = num_parts * num_virtual_pipeline_stage
+        self.method = method
+        if len(self.descs) < self.num_parts:
+            raise ValueError(
+                f"cannot split {len(self.descs)} layers into {self.num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        n, k = len(self.descs), self.num_parts
+        if self.method == "uniform":
+            return self._uniform(n, k)
+        m = re.match(r"layer:(.+)", self.method)
+        if m:
+            # balance by count of a named layer class (reference:
+            # "layer:TransformerBlock" convention)
+            cls_name = m.group(1)
+            weights = [1 if getattr(d.layer_func, "__name__", "") == cls_name
+                       or type(d).__name__ == cls_name else 0 for d in self.descs]
+            return self._balance(weights, k)
+        if self.method == "parameters":
+            weights = []
+            for d in self.descs:
+                if isinstance(d, LayerDesc):
+                    # estimate without building: count ctor size args
+                    weights.append(int(np.prod([v for v in d.inputs
+                                                if isinstance(v, int)]) or 1))
+                else:
+                    weights.append(sum(int(np.prod(p.shape))
+                                       for p in d.parameters()) if isinstance(d, Layer) else 1)
+            return self._balance(weights, k)
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def _uniform(n: int, k: int) -> List[int]:
+        bounds = [0]
+        base, rem = divmod(n, k)
+        for i in range(k):
+            bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+        return bounds
+
+    @staticmethod
+    def _balance(weights: Sequence[int], k: int) -> List[int]:
+        total = sum(weights) or 1
+        target = total / k
+        bounds, acc, taken = [0], 0.0, 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * (taken + 1) and len(bounds) < k:
+                bounds.append(i + 1)
+                taken += 1
+        while len(bounds) < k + 1:
+            bounds.append(len(weights))
+        bounds[-1] = len(weights)
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:208.
+
+    Builds ALL stages (single-controller SPMD: every host runs the same
+    program; stage placement over the 'pp' mesh axis happens at compile time
+    in pipeline_schedule.py, not by building only a rank's slice).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology_=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages: int = 1,
+                 **kwargs):
+        super().__init__()
+        mesh = topology.get_mesh()
+        if num_stages is None:
+            num_stages = mesh.shape["pp"] if (mesh and "pp" in mesh.axis_names) else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self.descs = list(layers)
+        seg = SegmentLayers(self.descs, num_stages, method=seg_method,
+                            num_virtual_pipeline_stage=num_virtual_pipeline_stages)
+        self.segment_parts = seg.do_segment()
+
+        self._shared: dict = {}
+        built: List[Layer] = []
+        self.run_funcs: List = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                layer = self._shared[d.layer_name]
+                fwd = d.forward_func
+                built.append(layer)
+                self.run_funcs.append(
+                    (lambda l, f: (lambda *xs: f(l, *xs) if f else l(*xs)))(layer, fwd))
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                built.append(layer)
+                self.run_funcs.append(layer)
+            elif isinstance(d, Layer):
+                built.append(d)
+                self.run_funcs.append(d)
+            elif callable(d):
+                self.run_funcs.append(d)
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        for i, l in enumerate(built):
+            self.add_sublayer(str(i), l)
+
+    # -- stage introspection (reference API) ---------------------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_layers(self, stage: int) -> List:
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return self.run_funcs[lo:hi]
+
+    def forward(self, *args):
+        x = args if len(args) > 1 else args[0]
+        for f in self.run_funcs:
+            x = f(*x) if isinstance(x, tuple) else f(x)
+        return x

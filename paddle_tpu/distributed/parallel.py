@@ -1,0 +1,159 @@
+"""Process bootstrap + DataParallel + shard_map wrapper.
+
+Reference parity: ``python/paddle/distributed/parallel.py`` —
+``init_parallel_env`` (:934; env parse → TCPStore :1095 → process group :1103
+→ barrier) and the ``DataParallel`` layer wrapper (:203) over C++
+``EagerReducer`` (collective/reducer.h:89).
+
+TPU-native: rendezvous is ``jax.distributed.initialize`` (the JAX
+coordination service replaces TCPStore); after it, every host sees the global
+device set and a single SPMD program spans the slice. DataParallel is a batch
+-dim sharding annotation — the reference's reducer machinery (gradient
+bucketing, fused allreduce overlapping backward, reducer.h:110) is explicitly
+unnecessary: XLA already fuses and overlaps the gradient psum over the dp axis
+with the backward computation (SURVEY.md §7 step 6 notes this).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor
+from . import topology
+from .env import get_rank, get_world_size
+from .sharding_api import shard_tensor
+
+__all__ = ["init_parallel_env", "DataParallel", "shard_map_fn", "scale_loss"]
+
+_initialized = [False]
+
+
+def init_parallel_env(mesh_axes: Optional[dict] = None):
+    """reference: parallel.py:934. Multi-host: initialize the JAX distributed
+    runtime from the paddle launch env contract (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / MASTER_ADDR|PORT); then install a default
+    data-parallel mesh over all (global) devices."""
+    if not _initialized[0]:
+        n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        master = os.environ.get("MASTER_ADDR")
+        if n_proc > 1 and master and not jax.distributed.is_initialized():
+            port = os.environ.get("MASTER_PORT", "8476")
+            jax.distributed.initialize(
+                coordinator_address=f"{master}:{port}",
+                num_processes=n_proc,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+        _initialized[0] = True
+    if mesh_axes == {}:
+        return None  # rendezvous only (fleet.init installs its own mesh)
+    if topology.get_mesh() is None:
+        axes = mesh_axes if mesh_axes is not None else {"dp": len(jax.devices())}
+        topology.set_mesh(topology.create_mesh(axes))
+    return None
+
+
+class DataParallel(Layer):
+    """reference: parallel.py:203 DataParallel.
+
+    Wraps a model for data parallelism: inputs are sharded along the mesh's
+    'dp' axis, parameters replicated across it. Gradient synchronization is
+    NOT done by a reducer — with replicated params and dp-sharded batch, XLA
+    inserts (and overlaps) the gradient psum itself. find_unused_parameters /
+    bucketing knobs are accepted for API compatibility and ignored.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1, find_unused_parameters: bool = False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        mesh = topology.get_mesh()
+        if mesh is None:
+            init_parallel_env()
+            mesh = topology.get_mesh()
+        self._mesh = mesh
+        self._dp_axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        # replicate parameters over the dp axis (leave other-axis shardings,
+        # e.g. TP, untouched if already set by mp layers)
+        for p in layers.parameters():
+            if p.dist_attr is None and not isinstance(p._value, jax.core.Tracer):
+                shard_tensor(p, mesh=mesh, spec=PartitionSpec())
+        for b in layers.buffers():
+            if b.dist_attr is None and not isinstance(b._value, jax.core.Tracer):
+                shard_tensor(b, mesh=mesh, spec=PartitionSpec())
+
+    def _shard_input(self, x):
+        if isinstance(x, Tensor) and x.ndim >= 1:
+            spec = PartitionSpec(self._dp_axis, *([None] * (x.ndim - 1)))
+            return shard_tensor(x, mesh=self._mesh, spec=spec)
+        return x
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    # paddle API: these existed for manual no_sync/rebuild control
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # grads are psum'd exactly once under GSPMD
+
+
+def scale_loss(loss):
+    """reference: parallel.py scale_loss — identity under GSPMD (loss is a
+    global-batch mean already)."""
+    return loss
+
+
+def shard_map_fn(fn, mesh: Optional[Mesh] = None, in_specs=None, out_specs=None,
+                 check_vma: bool = False):
+    """Run ``fn`` with per-shard (per-"rank") semantics over the mesh — the
+    escape hatch for manual collectives (paddle_tpu.distributed.collective
+    functions are usable inside). Tensor-aware wrapper over jax.shard_map."""
+    m = mesh or topology.get_mesh()
+    if m is None:
+        raise RuntimeError("no mesh; fleet.init or init_parallel_env first")
+
+    def to_spec(s):
+        return s if isinstance(s, PartitionSpec) else PartitionSpec(*s)
+
+    if isinstance(in_specs, (list, tuple)) and not isinstance(in_specs, PartitionSpec):
+        ispec = tuple(to_spec(s) for s in in_specs)
+    else:
+        ispec = to_spec(in_specs) if in_specs is not None else None
+    if isinstance(out_specs, (list, tuple)) and not isinstance(out_specs, PartitionSpec):
+        ospec = tuple(to_spec(s) for s in out_specs)
+    else:
+        ospec = to_spec(out_specs) if out_specs is not None else None
+
+    def wrapper(*tensors):
+        arrays = [t._value if isinstance(t, Tensor) else t for t in tensors]
+
+        def inner(*arrs):
+            outs = fn(*[Tensor(a) for a in arrs])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o._value if isinstance(o, Tensor) else o for o in outs)
+            return outs._value if isinstance(outs, Tensor) else outs
+
+        mapped = jax.shard_map(inner, mesh=m, in_specs=ispec, out_specs=ospec,
+                               check_vma=check_vma)
+        out = mapped(*arrays)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    return wrapper

@@ -1,0 +1,128 @@
+"""ZeRO-style sharded data parallelism ("group sharded" / sharding stages).
+
+Reference parity: ``python/paddle/distributed/sharding/group_sharded.py``
+(``group_sharded_parallel``) and the stage classes —
+``DygraphShardingOptimizer`` (stage 1, dygraph_sharding_optimizer.py:29),
+``GroupShardedOptimizerStage2``/``GroupShardedStage2`` (stage 2),
+``GroupShardedStage3`` (stage 3 param slicing w/ prefetch, :59).
+
+TPU-native: a ZeRO stage is a *layout*, not a runtime. Optimizer state
+(stage 1/os), gradients (stage 2/os_g — grad layout is derived by XLA from
+the state layout), and parameters (stage 3/p_g_os) are sharded over the
+'sharding' mesh axis; XLA schedules the all-gathers before use and
+reduce-scatters after backward — the hand-written bucketing/prefetch hooks of
+the reference collapse into GSPMD (SURVEY.md §7 step 6: "sharding stages =
+weight/opt-state sharding annotations").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer_base import Layer
+from ..optimizer.optimizer import Optimizer
+from . import topology
+from .sharding_api import shard_tensor
+
+__all__ = ["group_sharded_parallel", "shard_optimizer_state", "shard_model_params"]
+
+
+def _sharding_axis(mesh) -> Optional[str]:
+    for name in ("sharding", "dp"):
+        if name in mesh.axis_names and mesh.shape[name] > 1:
+            return name
+    return None
+
+
+def _shard_spec_for(shape, axis: str, axis_size: int, ndim: int) -> P:
+    """Shard the largest dim divisible by the axis size; replicate if none.
+    (The reference slices flattened buffers; dim-sharding keeps arrays
+    natural for XLA and is equivalent bandwidth-wise.)"""
+    order = sorted(range(ndim), key=lambda i: -int(shape[i]))
+    for d in order:
+        if shape[d] % axis_size == 0 and shape[d] >= axis_size:
+            entries = [None] * ndim
+            entries[d] = axis
+            return P(*entries)
+    return P()
+
+
+def shard_optimizer_state(optimizer: Optimizer, mesh=None, axis: Optional[str] = None):
+    """Stage-1: place every optimizer accumulator sharded over the sharding
+    axis (reference: DygraphShardingOptimizer param-group partition)."""
+    mesh = mesh or topology.get_mesh()
+    if mesh is None:
+        raise RuntimeError("no mesh; fleet.init first")
+    axis = axis or _sharding_axis(mesh)
+    if axis is None:
+        return optimizer
+    size = mesh.shape[axis]
+    for uid, accs in optimizer._accumulators.items():
+        for name, val in accs.items():
+            if val.ndim == 0:
+                continue
+            spec = _shard_spec_for(val.shape, axis, size, val.ndim)
+            accs[name] = jax.device_put(val, NamedSharding(mesh, spec))
+    # future accumulators (lazily created on first step) inherit via hook
+    optimizer._sharded_state_cfg = (mesh, axis, size)
+    orig_get = optimizer._get_accumulators
+
+    def wrapped(p):
+        accs = orig_get(p)
+        cfg = optimizer._sharded_state_cfg
+        if cfg is not None:
+            m, ax, sz = cfg
+            for name, val in accs.items():
+                if val.ndim and not isinstance(val, jax.core.Tracer):
+                    spec = _shard_spec_for(val.shape, ax, sz, val.ndim)
+                    if val.sharding != NamedSharding(m, spec):
+                        accs[name] = jax.device_put(val, NamedSharding(m, spec))
+        return accs
+
+    optimizer._get_accumulators = wrapped
+    return optimizer
+
+
+def shard_model_params(model: Layer, mesh=None, axis: Optional[str] = None):
+    """Stage-3: parameters themselves sharded over the sharding axis
+    (reference: GroupShardedStage3 param slicing, group_sharded_stage3.py:59).
+    XLA all-gathers a layer's weights just before its compute and frees them
+    after — the reference's forward prefetch hooks, compiled."""
+    mesh = mesh or topology.get_mesh()
+    if mesh is None:
+        raise RuntimeError("no mesh; fleet.init first")
+    axis = axis or _sharding_axis(mesh)
+    if axis is None:
+        return model
+    size = mesh.shape[axis]
+    for p in model.parameters():
+        if p.ndim == 0 or p.dist_attr is not None:
+            continue
+        spec = _shard_spec_for(p.shape, axis, size, p.ndim)
+        shard_tensor(p, mesh=mesh, spec=spec)
+    return model
+
+
+def group_sharded_parallel(model: Layer, optimizer: Optimizer, level: str = "os_g",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False):
+    """reference: paddle.distributed.sharding.group_sharded_parallel
+    (sharding/group_sharded.py) — level in {'os', 'os_g', 'p_g_os'}.
+
+    os    → optimizer-state sharding (ZeRO-1)
+    os_g  → + gradient sharding (ZeRO-2; gradient layout follows state layout
+            inside the compiled step — reduce-scatter emitted by XLA)
+    p_g_os→ + parameter sharding (ZeRO-3)
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level}")
+    if level == "p_g_os":
+        shard_model_params(model)
+    shard_optimizer_state(optimizer)
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer

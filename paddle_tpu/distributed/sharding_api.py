@@ -1,0 +1,236 @@
+"""Tensor sharding API: shard_tensor / reshard / placements.
+
+Reference parity: the auto_parallel annotation surface —
+``shard_tensor``/``shard_op`` (``python/paddle/distributed/auto_parallel/
+interface.py``), ``ProcessMesh`` (``process_mesh.py``), and the C++
+``TensorDistAttr{process_mesh, dims_mapping}`` (``paddle/fluid/distributed/
+auto_parallel/dist_attr.h``). TPU-native: a dist_attr IS a
+``jax.sharding.NamedSharding``; the Completer/Partitioner/Resharder pipeline
+(completion.py:107, partitioner.py:38, reshard.py:1008) collapses into XLA's
+GSPMD propagation — annotate inputs/params, the compiler completes the rest
+and inserts the collectives the Resharder would have.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..tensor import Parameter, Tensor
+from . import topology
+
+__all__ = [
+    "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+    "named_sharding", "constraint",
+]
+
+
+class ProcessMesh:
+    """reference: auto_parallel/process_mesh.py — an N-D logical view over the
+    device set. Thin veneer over jax.sharding.Mesh."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray, Mesh, None] = None,
+                 dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None, shape=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+        else:
+            devices = np.asarray(jax.devices())
+            if mesh is not None:
+                ids = np.asarray(mesh)
+                shape = ids.shape
+            elif shape is not None:
+                shape = tuple(shape)
+            else:
+                shape = (len(devices),)
+            if dim_names is None:
+                dim_names = [f"d{i}" for i in range(len(shape))]
+            if mesh is not None:
+                dev_arr = devices[np.asarray(mesh).reshape(-1)].reshape(shape)
+            else:
+                dev_arr = devices[: int(np.prod(shape))].reshape(shape)
+            self._jax_mesh = Mesh(dev_arr, axis_names=tuple(dim_names))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def shape(self):
+        return list(self._jax_mesh.devices.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._jax_mesh.axis_names)
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._jax_mesh.devices.reshape(-1)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+# -------------------------------------------------------------- placements
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard the tensor dim ``dim`` over the corresponding mesh dim
+    (reference: paddle.distributed.Shard)."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materializes partial values only
+    inside the compiler; at the API boundary we treat it as Replicate after
+    an immediate reduction (reference: paddle.distributed.Partial)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def _to_mesh(mesh) -> Mesh:
+    if mesh is None:
+        m = topology.get_mesh()
+        if m is None:
+            raise ValueError("no mesh: pass one or fleet.init/set_mesh first")
+        return m
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    raise TypeError(f"expected Mesh/ProcessMesh, got {type(mesh)}")
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: Mesh, ndim: int
+                        ) -> PartitionSpec:
+    """placements[i] describes mesh dim i (paddle semantics) → PartitionSpec
+    maps tensor dims to mesh axis names."""
+    entries: list = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Replicate) or p is None:
+            continue
+        if isinstance(p, Partial):
+            continue  # resolved by reduction at annotation site
+        if isinstance(p, Shard):
+            axis_name = mesh.axis_names[mesh_dim]
+            if p.dim >= ndim:
+                raise ValueError(f"Shard(dim={p.dim}) out of range for ndim={ndim}")
+            cur = entries[p.dim]
+            if cur is None:
+                entries[p.dim] = axis_name
+            elif isinstance(cur, tuple):
+                entries[p.dim] = cur + (axis_name,)
+            else:
+                entries[p.dim] = (cur, axis_name)
+    return PartitionSpec(*entries)
+
+
+def named_sharding(mesh=None, spec: Union[PartitionSpec, Sequence, None] = None,
+                   placements: Optional[Sequence[Placement]] = None,
+                   ndim: Optional[int] = None) -> NamedSharding:
+    """Build a NamedSharding from either a PartitionSpec-like or paddle
+    placements."""
+    m = _to_mesh(mesh)
+    if placements is not None:
+        if ndim is None:
+            raise ValueError("placements require ndim")
+        return NamedSharding(m, _placements_to_spec(placements, m, ndim))
+    if spec is None:
+        return NamedSharding(m, PartitionSpec())
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return NamedSharding(m, spec)
+
+
+def shard_tensor(x, mesh=None, placements: Optional[Sequence[Placement]] = None,
+                 spec=None, stop_gradient: Optional[bool] = None) -> Tensor:
+    """Place a Tensor onto the mesh with the given layout (reference:
+    paddle.distributed.shard_tensor, auto_parallel/interface.py).
+
+    Eager: an actual device_put — the array is physically distributed across
+    chips. Under jit trace: a sharding constraint on the traced value.
+    """
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    ns = named_sharding(mesh, spec=spec, placements=placements,
+                        ndim=t.ndim if placements is not None else None)
+    if isinstance(t._value, jax.core.Tracer):
+        new_val = jax.lax.with_sharding_constraint(t._value, ns)
+    else:
+        new_val = jax.device_put(t._value, ns)
+    if isinstance(t, Parameter) or not t.stop_gradient:
+        # keep the same cell so optimizers/jit slots track it
+        t._set_value(new_val)
+        out = t
+    else:
+        out = Tensor(new_val, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient, name=t.name)
+    out.dist_attr = ns
+    return out
+
+
+def reshard(x: Tensor, mesh=None, placements=None, spec=None) -> Tensor:
+    """Change an existing distributed tensor's layout (reference: Resharder,
+    auto_parallel/reshard.py:1008 — here a single device_put / sharding
+    constraint; XLA emits the all-to-all/allgather/slice traffic)."""
+    return shard_tensor(x, mesh=mesh, placements=placements, spec=spec)
+
+
+def constraint(value, *spec_entries, mesh=None):
+    """with_sharding_constraint on a raw jax value (for layer forwards)."""
+    m = _to_mesh(mesh)
+    ns = NamedSharding(m, PartitionSpec(*spec_entries))
+    return jax.lax.with_sharding_constraint(value, ns)
+
+
+def shard_layer(layer, mesh=None, shard_fn=None, input_fn=None, output_fn=None):
+    """reference: paddle.distributed.shard_layer — apply shard_fn(name, layer,
+    mesh) to every sublayer to place its parameters."""
+    m = _to_mesh(mesh)
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):  # default: replicate params
+            for p in sublayer.parameters(include_sublayers=False):
+                shard_tensor(p, mesh=m, spec=PartitionSpec())
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, m)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, m))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, m))
+    return layer
+
+
+def dtensor_from_fn(fn, mesh=None, placements=None, *args, **kwargs) -> Tensor:
+    """reference: paddle.distributed.dtensor_from_fn — build then shard."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh=mesh, placements=placements)

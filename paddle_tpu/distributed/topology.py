@@ -1,0 +1,211 @@
+"""Hybrid-parallel topology → jax device mesh.
+
+Reference parity: ``CommunicateTopology`` + ``HybridCommunicateGroup``
+(``python/paddle/distributed/fleet/base/topology.py:54,140``): axis order
+[dp, pp, sharding, mp(, sep)], one communicator group per axis. TPU-native:
+the "groups" ARE the axes of one ``jax.sharding.Mesh`` — XLA lowers every
+collective onto ICI rings along the axis, so there is nothing to allocate
+per-group; a *_group handle is just (mesh, axis-name).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "get_mesh", "set_mesh", "create_mesh", "axis_size",
+]
+
+# Paddle's canonical axis order (topology.py:54). "sep" (sequence/context
+# parallel) exceeds the reference snapshot — SURVEY.md §2.3 checklist.
+_HYBRID_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+_current_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or _current_mesh
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.shape[name]
+
+
+def create_mesh(axes: dict, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: degree}. Degree -1 absorbs the remaining
+    devices. Axis order follows the hybrid canonical order so the innermost
+    (fastest-varying, ICI-nearest) axis is mp — matching the reference's
+    topology where mp ranks are adjacent (NVLink there, ICI here)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    names, degrees = [], []
+    for name in _HYBRID_ORDER:
+        if name in axes:
+            names.append(name)
+            degrees.append(int(axes[name]))
+    for name in axes:  # user-custom axis names keep their given order
+        if name not in names:
+            names.append(name)
+            degrees.append(int(axes[name]))
+    if any(d == -1 for d in degrees):
+        known = int(np.prod([d for d in degrees if d != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed degrees {axes}")
+        degrees = [n // known if d == -1 else d for d in degrees]
+    if int(np.prod(degrees)) != n:
+        raise ValueError(
+            f"mesh degrees {dict(zip(names, degrees))} need {int(np.prod(degrees))} "
+            f"devices, have {n}"
+        )
+    arr = np.asarray(devices).reshape(degrees)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+class CommunicateTopology:
+    """reference: topology.py:54 — named-axis coordinate arithmetic."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self._world_size = int(np.prod(self._dims))
+        all_coords = [self.coordinate(*c) for c in np.ndindex(*self._dims)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(
+            rank for coord, rank in self._coord2rank.items() if coord[axis] == index
+        )
+
+    def get_dim_size(self, axis_name):
+        return self.get_dim(axis_name)
+
+    def get_comm_list(self, axis_name):
+        """All groups along axis_name: list of rank-lists varying only in that
+        coordinate."""
+        axis = self._parallel_names.index(axis_name)
+        groups = collections.defaultdict(list)
+        for coord, rank in sorted(self._coord2rank.items(), key=lambda kv: kv[1]):
+            key = tuple(v for i, v in enumerate(coord) if i != axis)
+            groups[key].append(rank)
+        return list(groups.values())
+
+
+class _AxisGroup:
+    """A communicator handle = (mesh, axis). Stands in for the reference's
+    ProcessGroup objects returned by HybridCommunicateGroup getters."""
+
+    def __init__(self, mesh: Mesh, axis: str, rank_in_axis: int = 0):
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = mesh.shape[axis] if axis in mesh.axis_names else 1
+        self.rank = rank_in_axis
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"AxisGroup(axis={self.axis}, nranks={self.nranks})"
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:140. Builds THE device mesh for 4D(+sep) hybrid
+    parallelism; accessors return axis handles instead of NCCL groups."""
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+                 sharding_degree: int = 1, sep_degree: int = 1,
+                 devices: Optional[Sequence] = None):
+        if topology is not None:
+            name_map = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                        "model": "mp", "sep": "sep"}
+            axes = {name_map.get(n, n): topology.get_dim(n)
+                    for n in topology.get_hybrid_group_names()}
+        else:
+            axes = {"dp": dp_degree, "pp": pp_degree, "sharding": sharding_degree,
+                    "sep": sep_degree, "mp": mp_degree}
+        self._axes = axes
+        self.mesh = create_mesh(axes, devices=devices)
+        set_mesh(self.mesh)
+        self.global_rank = 0  # single-controller SPMD: no per-process rank
+        self.nranks = int(np.prod(list(self.mesh.shape.values())))
+
+    # degree accessors (reference API)
+    def get_data_parallel_world_size(self):
+        return axis_size("dp", self.mesh)
+
+    def get_model_parallel_world_size(self):
+        return axis_size("mp", self.mesh)
+
+    def get_pipe_parallel_world_size(self):
+        return axis_size("pp", self.mesh)
+
+    def get_sharding_parallel_world_size(self):
+        return axis_size("sharding", self.mesh)
+
+    def get_sep_parallel_world_size(self):
+        return axis_size("sep", self.mesh)
+
+    # group accessors
+    def get_data_parallel_group(self):
+        return _AxisGroup(self.mesh, "dp")
+
+    def get_model_parallel_group(self):
+        return _AxisGroup(self.mesh, "mp")
+
+    def get_pipe_parallel_group(self):
+        return _AxisGroup(self.mesh, "pp")
+
+    def get_sharding_parallel_group(self):
+        return _AxisGroup(self.mesh, "sharding")
+
+    def get_sep_parallel_group(self):
+        return _AxisGroup(self.mesh, "sep")
+
+    def get_check_parallel_group(self):
+        return _AxisGroup(self.mesh, "mp")
+
+    # ranks: single-controller SPMD has no python-side rank; kept for API
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def topology(self):
+        return self._axes

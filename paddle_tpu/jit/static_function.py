@@ -192,6 +192,10 @@ def _scan_state(objs: Sequence[Any], transient: Sequence[Any] = ()):
 
 
 def _closure_objects(fn: Callable):
+    """Objects the function can reach: bound self, closure cells, defaults,
+    and the module globals it actually references (``co_names`` — a
+    module-level train step holds its model/optimizer as globals, not
+    closure cells)."""
     objs = []
     f = fn
     if hasattr(f, "__self__") and f.__self__ is not None:
@@ -205,6 +209,20 @@ def _closure_objects(fn: Callable):
                 pass
     if getattr(f, "__defaults__", None):
         objs.extend(f.__defaults__)
+    code = getattr(f, "__code__", None)
+    glob = getattr(f, "__globals__", None)
+    if code is not None and glob is not None:
+        import dis
+
+        # only names actually loaded as globals — co_names also lists
+        # attribute names, which could collide with unrelated module globals
+        loaded = {
+            ins.argval for ins in dis.get_instructions(code)
+            if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME")
+        }
+        for name in loaded:
+            if name in glob:
+                objs.append(glob[name])
     return objs
 
 
