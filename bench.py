@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Flagship benchmark: GPT causal-LM pretraining throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (+extras).
+The reference publishes no numbers (BASELINE.md) — the metric is
+tokens/sec/chip on a GPT-medium-scale config with bf16 AMP and a fully
+compiled train step (forward+backward+AdamW in one XLA program), plus the MFU
+against the chip's advertised bf16 peak.
+
+Env knobs: BENCH_SMALL=1 (tiny config for CPU smoke), BENCH_STEPS, BENCH_BATCH,
+BENCH_SEQ.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    small = os.environ.get("BENCH_SMALL") == "1" or not on_tpu
+
+    if small:
+        cfg = GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
+                        num_heads=8, max_position_embeddings=512,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        B = int(os.environ.get("BENCH_BATCH", 4))
+        S = int(os.environ.get("BENCH_SEQ", 256))
+        steps = int(os.environ.get("BENCH_STEPS", 5))
+    else:
+        # GPT-medium-scale: ~355M params — saturates one v5e chip in bf16
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position_embeddings=1024,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        B = int(os.environ.get("BENCH_BATCH", 8))
+        S = int(os.environ.get("BENCH_SEQ", 1024))
+        steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(ids, labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            _, loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = jit.StaticFunction(train_fn, observe=[model, opt], warmup=False)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)))
+    labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
+
+    t0 = time.time()
+    loss = step(ids, labels)
+    loss.value.block_until_ready()
+    compile_s = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    loss.value.block_until_ready()
+    dt = time.time() - t0
+
+    tokens_per_s = B * S * steps / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params  # fwd+bwd dense-transformer convention
+    achieved_tflops = flops_per_token * tokens_per_s / 1e12
+    peak = 197.0 if on_tpu else float("nan")  # v5e bf16 peak TFLOP/s
+    mfu = achieved_tflops / peak if on_tpu else None
+
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md): this run IS the baseline
+        "config": f"gpt-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}-bf16",
+        "params_m": round(n_params / 1e6, 1),
+        "loss": float(np.asarray(loss.numpy(), dtype="float32")),
+        "step_ms": round(1000 * dt / steps, 1),
+        "compile_s": round(compile_s, 1),
+        "achieved_tflops_per_s": round(achieved_tflops, 2),
+        "mfu_vs_v5e_peak": round(mfu, 4) if mfu is not None else None,
+        "device": str(dev.platform),
+    }))
+
+
+if __name__ == "__main__":
+    main()

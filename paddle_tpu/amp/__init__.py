@@ -1,1 +1,258 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.amp — automatic mixed precision, bf16-first.
+
+Reference parity: ``python/paddle/amp/`` — ``auto_cast``
+(``amp/auto_cast.py:636``), ``decorate`` (:698), per-op allow/block lists
+(``amp/amp_lists.py``; C++ intercept ``eager/eager_amp_auto_cast.h``), and
+``GradScaler`` (``amp/grad_scaler.py:562``) dynamic loss scaling.
+
+TPU-native: bf16 shares float32's exponent range, so the default recipe is
+O1/O2 bf16 WITHOUT loss scaling (scaler enabled=False is a no-op passthrough
+exactly like the reference when use_dynamic_loss_scaling=False). GradScaler
+remains fully functional (and jit-traceable: its scale state registers via
+``__jit_state__`` and the skip-step is a jnp.where) for float16 workflows.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..autograd.engine import amp_state
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+from .. import dtypes
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "white_list",
+           "black_list"]
+
+# reference: amp/amp_lists.py WHITE_LIST — MXU-bound ops where bf16 wins
+WHITE_LIST = frozenset({
+    "linear", "matmul", "mm", "bmm", "einsum", "dot",
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "scaled_dot_product_attention", "flash_attention",
+    "addmm", "matmul_v2",
+    "vocab_parallel_embedding", "column_parallel_linear", "row_parallel_linear",
+})
+
+# reference: amp/amp_lists.py BLACK_LIST — numerically sensitive reductions
+BLACK_LIST = frozenset({
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "logsumexp", "cross_entropy", "nll_loss",
+    "softmax_with_cross_entropy", "parallel_cross_entropy",
+    "mean", "sum", "prod", "cumsum", "norm", "p_norm",
+    "batch_norm", "layer_norm", "instance_norm", "group_norm", "rms_norm",
+    "sigmoid_cross_entropy_with_logits", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "smooth_l1_loss",
+    "mse_loss", "l1_loss",
+})
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Optional[Sequence] = None,
+              custom_black_list: Optional[Sequence] = None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """reference: paddle.amp.auto_cast (amp/auto_cast.py:636).
+
+    O1: ops on the white list compute in ``dtype``; black list pinned fp32;
+    everything else runs in its input dtype. O2: everything except the black
+    list computes in ``dtype``.
+    """
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp level must be O0/O1/O2, got {level}")
+    target = dtypes.convert_dtype(dtype)
+    if target not in (jnp.bfloat16, jnp.float16):
+        raise ValueError(f"amp dtype must be bfloat16/float16, got {dtype}")
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    black = (set(BLACK_LIST) - set(custom_white_list or ())) | set(
+        custom_black_list or ())
+    white -= black
+    prev = dict(amp_state)
+    amp_state.update(
+        enabled=bool(enable) and level != "O0", dtype=target, level=level,
+        white=frozenset(white), black=frozenset(black),
+    )
+    try:
+        yield
+    finally:
+        amp_state.update(prev)
+
+
+amp_guard = auto_cast  # legacy alias (fluid.dygraph.amp_guard)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype: Optional[str] = None):
+    """reference: paddle.amp.decorate (amp/auto_cast.py:698). O2 casts model
+    floating params to ``dtype`` and turns on optimizer master weights
+    (fp32 true-state accumulators) unless master_weight=False."""
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = ([optimizers] if single_opt else list(optimizers or []))
+    if level == "O2":
+        target = dtypes.convert_dtype(dtype)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                # norms keep fp32 params (reference keeps BN fp32 in O2)
+                if type(layer).__name__.startswith(
+                        ("BatchNorm", "LayerNorm", "SyncBatchNorm",
+                         "InstanceNorm", "GroupNorm", "RMSNorm",
+                         "LocalResponseNorm", "SpectralNorm")):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p._value.dtype, jnp.floating):
+                        p._set_value(p._value.astype(target))
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+    if optimizers is None:
+        return models if single_model else model_list
+    return (
+        model_list[0] if single_model else model_list,
+        opt_list[0] if single_opt else opt_list,
+    )
+
+
+class GradScaler:
+    """reference: paddle.amp.GradScaler (amp/grad_scaler.py:562) — dynamic
+    loss scaling. Fully traceable: scale/counter live in Tensor cells exposed
+    to the jit tracer via ``__jit_state__``; the skip-on-inf is a jnp.where
+    inside Optimizer.step (no host branch)."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = bool(enable)
+        self._use_dynamic = bool(use_dynamic_loss_scaling) and self._enable
+        self._scale = Tensor(jnp.float32(init_loss_scaling))
+        self._good_steps = Tensor(jnp.int32(0))
+        self._bad_steps = Tensor(jnp.int32(0))
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every = int(incr_every_n_steps)
+        self._decr_every = int(decr_every_n_nan_or_inf)
+        self._unscaled: set = set()  # optimizer ids already unscaled this step
+
+    def __jit_state__(self):
+        return [self._scale, self._good_steps, self._bad_steps]
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale._value)
+
+    def set_init_loss_scaling(self, v):
+        self._scale._set_value(jnp.float32(v))
+
+    def scale(self, loss):
+        """reference: grad_scaler.py scale — multiply the loss."""
+        if not self._enable:
+            return ensure_tensor(loss)
+        from ..ops import math as _math
+
+        return _math.multiply(ensure_tensor(loss), Tensor(self._scale._value))
+
+    @no_grad()
+    def _unscale_and_check(self, optimizer):
+        inv = 1.0 / self._scale._value
+        found = jnp.bool_(False)
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad._value * inv.astype(p.grad._value.dtype)
+            found = found | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+            p.grad = Tensor(g)
+        return found
+
+    def step(self, optimizer):
+        """reference: grad_scaler.py step — unscale (at most once per step,
+        so the unscale_-then-clip workflow doesn't divide twice), skip on
+        inf/nan."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if id(optimizer) in self._unscaled:
+            found = optimizer._found_inf._value
+        else:
+            found = self._unscale_and_check(optimizer)
+            optimizer._found_inf = Tensor(found)
+        try:
+            optimizer.step()
+        finally:
+            optimizer._found_inf = None
+            self._unscaled.discard(id(optimizer))
+        self.update(found)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def unscale_(self, optimizer):
+        if id(optimizer) in self._unscaled:
+            return optimizer._found_inf._value
+        found = self._unscale_and_check(optimizer)
+        optimizer._found_inf = Tensor(found)
+        self._unscaled.add(id(optimizer))
+        return found
+
+    @no_grad()
+    def update(self, found_inf=None):
+        """reference: update_loss_scaling op semantics, traceable."""
+        if not self._use_dynamic:
+            return
+        found = found_inf._value if isinstance(found_inf, Tensor) else found_inf
+        if found is None:
+            return
+        scale, good, bad = (self._scale._value, self._good_steps._value,
+                            self._bad_steps._value)
+        new_bad = jnp.where(found, bad + 1, jnp.int32(0))
+        new_good = jnp.where(found, jnp.int32(0), good + 1)
+        shrink = new_bad >= self._decr_every
+        grow = new_good >= self._incr_every
+        new_scale = jnp.where(
+            shrink, jnp.maximum(scale * self._decr_ratio, jnp.float32(1e-6)),
+            jnp.where(grow, scale * self._incr_ratio, scale))
+        new_bad = jnp.where(shrink, jnp.int32(0), new_bad)
+        new_good = jnp.where(grow, jnp.int32(0), new_good)
+        self._scale._set_value(new_scale)
+        self._good_steps._set_value(new_good)
+        self._bad_steps._set_value(new_bad)
+
+    def state_dict(self):
+        return {
+            "scale": Tensor(self._scale._value),
+            "incr_ratio": self._incr_ratio, "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "incr_count": Tensor(self._good_steps._value),
+            "decr_count": Tensor(self._bad_steps._value),
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale._set_value(
+            state["scale"]._value if isinstance(state["scale"], Tensor)
+            else jnp.float32(state["scale"]))
+        if "incr_count" in state:
+            v = state["incr_count"]
+            self._good_steps._set_value(v._value if isinstance(v, Tensor) else jnp.int32(v))
+        if "decr_count" in state:
+            v = state["decr_count"]
+            self._bad_steps._set_value(v._value if isinstance(v, Tensor) else jnp.int32(v))

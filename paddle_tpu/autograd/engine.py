@@ -102,6 +102,31 @@ def make_node_for_outputs(vjp_fn, inputs, out_tensors, name="", out_tuple=False)
     return node
 
 
+# AMP dispatch state, mutated by paddle_tpu.amp.auto_cast (the eager AMP
+# interception point — reference: eager_amp_auto_cast.h + AmpOperators,
+# fluid/imperative/amp_auto_cast.h:39). Kept here so the hot path reads one
+# module-global dict instead of importing the amp package per op.
+amp_state = {
+    "enabled": False, "dtype": None, "level": "O1",
+    "white": frozenset(), "black": frozenset(),
+}
+
+
+def _amp_cast(arrays, name):
+    st = amp_state
+    if name in st["black"]:
+        target = jnp.float32
+    elif st["level"] == "O2" or name in st["white"]:
+        target = st["dtype"]
+    else:
+        return arrays
+    return [
+        a.astype(target)
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != target else a
+        for a in arrays
+    ]
+
+
 def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
              differentiable: bool = True, name: str = "") -> "Tensor | tuple":
     """Run one op through the tape.
@@ -113,6 +138,8 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
     """
     attrs = attrs or {}
     arrays = [t._value for t in tensors]
+    if amp_state["enabled"]:
+        arrays = _amp_cast(arrays, name)
     needs_grad = (
         differentiable
         and is_grad_enabled()

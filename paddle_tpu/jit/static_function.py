@@ -374,10 +374,12 @@ class StaticFunction:
     """
 
     def __init__(self, function: Callable, input_spec=None, build_strategy=None,
-                 property=False, full_graph=True, observe: Sequence[Any] = ()):
+                 property=False, full_graph=True, observe: Sequence[Any] = (),
+                 warmup: bool = True):
         self._fn = function
         self._input_spec = input_spec
         self._observe = list(observe)
+        self._do_warmup = warmup
         self._slots: Optional[list] = None
         self._slot_ids: set[int] = set()
         self._opts: list[Optimizer] = []
@@ -451,9 +453,29 @@ class StaticFunction:
         return holder
 
     # -- call ----------------------------------------------------------------
+    def _setup_no_warmup(self):
+        """Discover state without an eager warm-up call (to_static(...,
+        warmup=False)): structural scan only — optimizer accumulators are
+        materialized explicitly, and cells invisible to the scan (module
+        globals are covered; arbitrary object attributes are not) must be
+        reachable via ``observe`` or ``__jit_state__``."""
+        slots, opts, layers, slot_ids = _scan_state(
+            _closure_objects(self._fn) + self._observe, transient=())
+        for opt in opts:
+            opt._materialize_accumulators()
+            for uid, accs in opt._accumulators.items():
+                for name in accs:
+                    slots.append(_AccSlot(opt, uid, name))
+        self._slots, self._opts, self._layers = slots, opts, layers
+        self._slot_ids = slot_ids
+        self._warmed_up = True
+
     def __call__(self, *args, **kwargs):
         if not self._warmed_up:
-            return self._warmup(args, kwargs)
+            if not self._do_warmup:
+                self._setup_no_warmup()
+            else:
+                return self._warmup(args, kwargs)
         arrays, meta, spec = _flatten_args((args, kwargs))
         key = (
             _spec_key(spec, arrays, meta),

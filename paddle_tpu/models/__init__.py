@@ -1,0 +1,9 @@
+"""paddle_tpu.models — the transformer model zoo (flagship benchmark models).
+
+The reference ships its LLM zoo out-of-tree (PaddleNLP); the BASELINE.json
+north-star configs (GPT-3 1.3B DP+TP, Llama-2 7B 4D hybrid, BERT-base) make
+these first-class here. Vision models live in paddle_tpu.vision.models.
+"""
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny, gpt3_1_3b  # noqa: F401
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1_3b"]

@@ -1,0 +1,291 @@
+"""GPT model family — the flagship causal-LM benchmark model.
+
+Reference parity: the GPT pattern models used by the reference's hybrid
+-parallel tests (``test/legacy_test/auto_parallel_gpt_model.py``) and the
+fused-transformer surface (``incubate/nn/layer/fused_transformer.py:192``).
+
+TPU-native design:
+- pre-LN decoder blocks whose matmuls are MXU-shaped (hidden sizes multiples
+  of 128); attention via the Pallas flash kernel (ops/pallas/flash_attention)
+  with an XLA sdpa fallback;
+- tensor parallelism by construction: when the active mesh has mp>1 the QKV /
+  MLP projections are Column/RowParallelLinear and the vocab embedding is
+  VocabParallelEmbedding — same module code, sharding annotations compiled in;
+- sequence parallelism: activations optionally sharded over the 'sep' axis on
+  the sequence dim (GSPMD inserts the boundary collectives);
+- weight tying between embedding and LM head (SharedLayerDesc semantics —
+  single parameter cell, gradients accumulate on one tape leaf).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..distributed import topology
+from ..distributed.sharding_api import shard_tensor
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1_3b"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to a multiple of 128 (MXU)
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_heads:
+            raise ValueError("num_heads must divide hidden_size")
+
+
+def gpt_tiny(**kw) -> "GPTConfig":
+    cfg = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+               max_position_embeddings=128, hidden_dropout_prob=0.0,
+               attention_dropout_prob=0.0)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+def gpt3_1_3b(**kw) -> "GPTConfig":
+    """BASELINE.json north-star config: GPT-3 XL 1.3B."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+               max_position_embeddings=2048)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+def _mesh_mp() -> int:
+    mesh = topology.get_mesh()
+    if mesh is None or "mp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["mp"]
+
+
+def _normal_init(std):
+    from ..nn import initializer as I
+
+    return I.Normal(mean=0.0, std=std)
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention. QKV column-parallel (heads sharded over mp),
+    output row-parallel — the Megatron layout the reference's
+    ColumnParallelLinear/RowParallelLinear exist for (mp_layers.py:173,343).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.cfg = config
+        h, nh = config.hidden_size, config.num_heads
+        self.head_dim = h // nh
+        mp = _mesh_mp()
+        if nh % mp:
+            raise ValueError(f"num_heads {nh} not divisible by mp {mp}")
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        if mp > 1:
+            from ..distributed.fleet import ColumnParallelLinear, RowParallelLinear
+
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, gather_output=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+            self.out_proj = RowParallelLinear(
+                h, h, input_is_parallel=True,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
+        else:
+            self.qkv_proj = nn.Linear(
+                h, 3 * h, weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+            self.out_proj = nn.Linear(
+                h, h, weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
+        self.attn_drop_p = config.attention_dropout_prob
+
+    def forward(self, x):
+        B, S, H = x.shape
+        nh, hd = self.cfg.num_heads, self.head_dim
+        qkv = self.qkv_proj(x)  # [B, S, 3H] (H possibly mp-sharded)
+
+        def split_heads(v):
+            # [B, S, 3H] -> 3 x [B, S, nh, hd]; head dim is the sharded one,
+            # so reshape keeps shards intact ([..., nh/mp, hd] per shard)
+            q, k, v_ = jnp.split(v, 3, axis=-1)
+            return tuple(t.reshape(B, S, nh, hd) for t in (q, k, v_))
+
+        q, k, v = apply_op(split_heads, [ensure_tensor(qkv)], name="split_heads")
+        if self.cfg.use_flash_attention:
+            ctx = F.flash_attention(q, k, v, causal=True,
+                                    dropout=self.attn_drop_p if self.training else 0.0)
+        else:
+            ctx = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.attn_drop_p if self.training else 0.0)
+        if isinstance(ctx, tuple):
+            ctx = ctx[0]
+        merged = apply_op(lambda t: t.reshape(B, S, nh * hd),
+                          [ensure_tensor(ctx)], name="merge_heads")
+        return self.out_proj(merged)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        mp = _mesh_mp()
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        if mp > 1:
+            from ..distributed.fleet import ColumnParallelLinear, RowParallelLinear
+
+            self.fc1 = ColumnParallelLinear(
+                h, ff, gather_output=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+            self.fc2 = RowParallelLinear(
+                ff, h, input_is_parallel=True,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
+        else:
+            self.fc1 = nn.Linear(h, ff, weight_attr=nn.ParamAttr(
+                initializer=_normal_init(std)))
+            self.fc2 = nn.Linear(ff, h, weight_attr=nn.ParamAttr(
+                initializer=_normal_init(proj_std)))
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.ln1 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(config)
+        self.drop_p = config.hidden_dropout_prob
+
+    def forward(self, x):
+        h = self.attn(self.ln1(x))
+        if self.drop_p and self.training:
+            h = F.dropout(h, self.drop_p)
+        x = x + h
+        h = self.mlp(self.ln2(x))
+        if self.drop_p and self.training:
+            h = F.dropout(h, self.drop_p)
+        return x + h
+
+
+class GPTModel(nn.Layer):
+    """Transformer trunk: embeddings → N decoder blocks → final LN."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        mp = _mesh_mp()
+        std = config.initializer_range
+        if mp > 1:
+            from ..distributed.fleet import VocabParallelEmbedding
+
+            self.embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+        else:
+            self.embeddings = nn.Embedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+        self.layers = nn.LayerList([GPTDecoderLayer(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.drop_p = config.hidden_dropout_prob
+
+    def _seq_parallel(self, x):
+        mesh = topology.get_mesh()
+        if (not self.config.sequence_parallel or mesh is None
+                or "sep" not in mesh.axis_names or mesh.shape["sep"] == 1):
+            return x
+        # activations sharded on the sequence dim over 'sep'
+        def fn(v):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(None, "sep", None)))
+
+        return apply_op(fn, [ensure_tensor(x)], name="seq_parallel_constraint")
+
+    def forward(self, input_ids, position_ids=None):
+        ids = ensure_tensor(input_ids)
+        B, S = ids.shape
+        if position_ids is None:
+            pos_val = jnp.arange(S, dtype=jnp.int64)[None, :].repeat(B, axis=0)
+            position_ids = Tensor(pos_val, stop_gradient=True)
+        x = self.embeddings(ids) + self.position_embeddings(position_ids)
+        if self.drop_p and self.training:
+            x = F.dropout(x, self.drop_p)
+        x = self._seq_parallel(x)
+        for layer in self.layers:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head on the trunk; weight-tied to the input embedding by default
+    (one parameter cell — SharedLayerDesc semantics without the allreduce).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+        else:
+            self.lm_head = None
+
+    def logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        w = self.gpt.embeddings.weight  # [V, H] (possibly mp-sharded on V)
+        return apply_op(lambda h, wv: h @ wv.T,
+                        [ensure_tensor(hidden), w], name="matmul")
+
+    def forward(self, input_ids, position_ids=None, labels=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        mp = _mesh_mp()
+        V = self.config.vocab_size
+        flat_logits = logits.reshape([-1, V])
+        flat_labels = ensure_tensor(labels).reshape([-1])
+        if mp > 1:
+            from ..distributed.fleet import ParallelCrossEntropy
+
+            loss = ParallelCrossEntropy()(flat_logits, flat_labels)
+            from ..ops import math as _math
+
+            return logits, _math.mean(loss)
+        loss = F.cross_entropy(flat_logits, flat_labels)
+        return logits, loss

@@ -133,6 +133,18 @@ class Optimizer:
         return jnp.asarray(self.get_lr(), dtype=jnp.float32)
 
     # ---------------------------------------------------------- accumulators
+    def _materialize_accumulators(self):
+        """Eagerly create all per-param state (normally lazy on first step) —
+        lets paddle_tpu.jit compile a train step without an eager warm-up
+        call (to_static(..., warmup=False))."""
+        multi_precision = getattr(self, "_multi_precision", False)
+        for p in self._parameter_list or []:
+            if getattr(p, "trainable", True) and not p.stop_gradient:
+                accs = self._get_accumulators(p)
+                if multi_precision and p._value.dtype in (
+                        jnp.bfloat16, jnp.float16) and "@master" not in accs:
+                    accs["@master"] = p._value.astype(jnp.float32)
+
     def _get_accumulators(self, p: Parameter) -> dict:
         accs = self._accumulators.get(p._uid)
         if accs is None:
@@ -178,23 +190,60 @@ class Optimizer:
 
     @no_grad()
     def step(self):
-        """Apply one optimizer update (reference: optimizer.py:1477)."""
+        """Apply one optimizer update (reference: optimizer.py:1477).
+
+        Two AMP hooks (paddle_tpu.amp):
+        - master weights (``multi_precision``, reference: optimizer.py
+          _create_master_weight): low-precision params keep an fp32 "master"
+          accumulator that carries the true state; the param cell holds its
+          down-cast.
+        - ``_found_inf`` (set by GradScaler before step, reference:
+          check_finite_and_unscale + update_loss_scaling ops): when the traced
+          flag is true the whole update is a jnp.where no-op — the traceable
+          equivalent of the reference's skip-step.
+        """
         params_grads = self._collect_params_grads()
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self._lr_value()
+        found_inf = getattr(self, "_found_inf", None)
+        if found_inf is not None and isinstance(found_inf, Tensor):
+            found_inf = found_inf._value
+        multi_precision = getattr(self, "_multi_precision", False)
         for p, g in params_grads:
             gv = g._value
-            if gv.dtype != p._value.dtype:
-                gv = gv.astype(p._value.dtype)
+            use_master = multi_precision and p._value.dtype in (
+                jnp.bfloat16, jnp.float16)
+            accs = self._get_accumulators(p)
+            if use_master:
+                if "@master" not in accs:
+                    accs["@master"] = p._value.astype(jnp.float32)
+                pv = accs["@master"]
+                gv = gv.astype(jnp.float32)
+            else:
+                pv = p._value
+                if gv.dtype != pv.dtype:
+                    gv = gv.astype(pv.dtype)
             reg = self._param_regularizer(p)
             if reg is not None:
-                gv = reg(p._value, gv)
-            accs = self._get_accumulators(p)
+                gv = reg(pv, gv)
             plr = self._param_lr(p)
-            new_val, new_accs = self._update(p._value, gv, accs, lr * plr)
-            p._set_value(new_val)
+            new_val, new_accs = self._update(pv, gv, accs, lr * plr)
+            if found_inf is not None:
+                new_val = jnp.where(found_inf, pv, new_val)
+                new_accs = {
+                    k: jnp.where(found_inf, accs[k], v) if k in accs
+                    and getattr(v, "shape", None) == getattr(accs[k], "shape", None)
+                    else v
+                    for k, v in new_accs.items()
+                }
+            if use_master:
+                new_accs["@master"] = new_val
+                p._set_value(new_val.astype(p._value.dtype))
+            else:
+                p._set_value(new_val)
             self._accumulators[p._uid] = new_accs
+        self._found_inf = None  # consume-once: a stale flag must not freeze future steps
         self._global_step += 1
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):

@@ -1,0 +1,146 @@
+"""AMP: auto_cast op interception, O2 decorate + master weights, GradScaler."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import amp, jit
+
+
+class TestAutoCast:
+    def test_o1_white_ops_bf16(self):
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        with amp.auto_cast(level="O1"):
+            y = lin(x)
+            assert str(y.dtype) == "bfloat16"
+            # black-listed op stays fp32
+            s = F.softmax(y)
+            assert str(s.dtype) == "float32"
+        # outside the context, back to fp32 compute
+        y2 = lin(x)
+        assert str(y2.dtype) == "float32"
+
+    def test_o2_casts_everything_but_black(self):
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        with amp.auto_cast(level="O2"):
+            y = paddle.add(x, x)
+            assert str(y.dtype) == "bfloat16"
+
+    def test_backward_through_autocast(self):
+        lin = nn.Linear(8, 4)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal((16, 8)).astype("float32"))
+        with amp.auto_cast(level="O1"):
+            loss = F.cross_entropy(lin(x), paddle.to_tensor(np.zeros(16, "int64")))
+        loss.backward()
+        assert lin.weight.grad is not None
+        # cross_entropy was fp32 (black), gradient flows bf16->param
+        assert np.isfinite(lin.weight.grad.numpy().astype("float32")).all()
+
+
+class TestDecorate:
+    def test_o2_decorate_master_weights(self):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2")
+        assert all(str(p.dtype) == "bfloat16" for p in model.parameters())
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, (8,)))
+        with amp.auto_cast(level="O2"):
+            loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        accs = next(iter(opt._accumulators.values()))
+        assert "@master" in accs and str(accs["@master"].dtype) == "float32"
+
+    def test_o2_training_converges(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2")
+        rng = np.random.default_rng(2)
+        W = rng.standard_normal((16, 4)).astype("float32")
+        losses = []
+        for _ in range(20):
+            xb = rng.standard_normal((64, 16)).astype("float32")
+            yb = (xb @ W).argmax(-1)
+            with amp.auto_cast(level="O2"):
+                loss = F.cross_entropy(model(paddle.to_tensor(xb)), paddle.to_tensor(yb))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8
+
+
+class TestGradScaler:
+    def _setup(self):
+        paddle.seed(3)
+        model = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        return model, opt
+
+    def test_scale_unscale_roundtrip(self):
+        model, opt = self._setup()
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        loss = model(x).sum()
+        scaler.scale(loss).backward()
+        before = model.weight.numpy().copy()
+        scaler.step(opt)
+        opt.clear_grad()
+        # compare against unscaled reference
+        model2, opt2 = self._setup()
+        loss2 = model2(x).sum()
+        loss2.backward()
+        opt2.step()
+        np.testing.assert_allclose(model.weight.numpy(), model2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert not np.allclose(before, model.weight.numpy())
+
+    def test_inf_skips_update_and_shrinks_scale(self):
+        model, opt = self._setup()
+        scaler = amp.GradScaler(init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1)
+        before = model.weight.numpy().copy()
+        x = paddle.to_tensor(np.full((4, 8), np.inf, "float32"))
+        loss = model(x).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        np.testing.assert_array_equal(before, model.weight.numpy())
+        assert float(scaler.get_loss_scaling().numpy()) == 512.0
+
+    def test_scale_grows_after_n_good_steps(self):
+        model, opt = self._setup()
+        scaler = amp.GradScaler(init_loss_scaling=256.0, incr_every_n_steps=3)
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        for _ in range(3):
+            loss = model(x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            opt.clear_grad()
+        assert float(scaler.get_loss_scaling().numpy()) == 512.0
+
+    def test_compiled_scaler_step(self):
+        model, opt = self._setup()
+        scaler = amp.GradScaler(init_loss_scaling=64.0, incr_every_n_steps=2)
+        rng = np.random.default_rng(4)
+
+        @jit.to_static
+        def step(xb, yb):
+            loss = F.mse_loss(model(xb), yb)
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            opt.clear_grad()
+            return loss
+
+        x = rng.standard_normal((8, 8)).astype("float32")
+        y = rng.standard_normal((8, 4)).astype("float32")
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+        assert len(step._cache) == 1
+        # dynamic scale state advanced inside the compiled step
+        assert float(scaler.get_loss_scaling().numpy()) > 64.0
