@@ -22,12 +22,15 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pipeline_schedule import StackedPipelineBlocks, pipeline_apply  # noqa: F401
 
 __all__ = [
     "init", "fleet", "Fleet", "DistributedStrategy", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+    "PipelineParallel", "StackedPipelineBlocks", "pipeline_apply",
     "worker_index", "worker_num",
 ]
 
@@ -139,7 +142,7 @@ class Fleet:
         if not self._is_initialized:
             raise RuntimeError("call fleet.init() first")
         if isinstance(model, PipelineLayer):
-            return model
+            return PipelineParallel(model, hcg=self._hcg, strategy=self._strategy)
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):

@@ -44,10 +44,18 @@ def _mp_size(mesh) -> int:
 
 
 def _constrain(value, *entries, mesh):
-    ns = NamedSharding(mesh, P(*entries))
     if isinstance(value, jax.core.Tracer):
-        return jax.lax.with_sharding_constraint(value, ns)
-    return jax.device_put(value, ns)
+        # inside a partial-manual shard_map region the context mesh differs
+        # (manual axis types) — a bare PartitionSpec binds to whatever mesh
+        # is current, NamedSharding(mesh=...) would mismatch
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty:
+            manual = {n for n, t in zip(ctx.axis_names, ctx.axis_types)
+                      if t == jax.sharding.AxisType.Manual}
+            cleaned = [None if (e in manual) else e for e in entries]
+            return jax.lax.with_sharding_constraint(value, P(*cleaned))
+        return jax.lax.with_sharding_constraint(value, NamedSharding(mesh, P(*entries)))
+    return jax.device_put(value, NamedSharding(mesh, P(*entries)))
 
 
 class VocabParallelEmbedding(Layer):

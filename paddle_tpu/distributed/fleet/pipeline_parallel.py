@@ -1,0 +1,84 @@
+"""PipelineParallel model wrapper — the user-facing pp training API.
+
+Reference parity: ``PipelineParallel`` (``fleet/meta_parallel/
+pipeline_parallel.py:32``) with ``train_batch`` (:127) /
+``forward_backward_pipeline`` (1F1B :153) and ``eval_batch``.
+
+TPU-native: when the wrapped model's compute is a ``StackedPipelineBlocks``
+run, the 1F1B schedule is already compiled into the forward (scan+ppermute,
+pipeline_schedule.py) and backward falls out of AD — train_batch is then just
+loss+backward+step. For heterogeneous ``PipelineLayer`` models the stages run
+in one program with microbatch gradient accumulation (XLA's latency-hiding
+scheduler overlaps independent microbatch chains; the explicit interceptor
+loop of fleet_executor has no TPU counterpart)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer_base import Layer
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    """reference: pipeline_parallel.py:32."""
+
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        micro = 1
+        if strategy is not None:
+            hc = getattr(strategy, "hybrid_configs", {})
+            micro = int(hc.get("accumulate_steps", 1))
+        self.accumulate_steps = max(micro, 1)
+        self._loss_fn = getattr(layers, "_loss_fn", None)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, data, n):
+        xs, ys = data
+        xs, ys = ensure_tensor(xs), ensure_tensor(ys)
+        B = xs.shape[0]
+        if B % n:
+            raise ValueError(f"batch {B} not divisible by accumulate_steps {n}")
+        m = B // n
+        return [(xs[i * m:(i + 1) * m], ys[i * m:(i + 1) * m]) for i in range(n)]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference: pipeline_parallel.py train_batch :127 — returns the
+        mean micro-batch loss after one optimizer step."""
+        if self._loss_fn is None:
+            raise RuntimeError(
+                "train_batch needs the PipelineLayer to be built with loss_fn")
+        n = self.accumulate_steps
+        total = None
+        for xb, yb in self._split_micro(data, n):
+            out = self._layers(xb)
+            loss = self._loss_fn(out, yb)
+            if n > 1:
+                loss = loss / float(n)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        xs, ys = data
+        out = self._layers(ensure_tensor(xs))
+        if compute_loss and self._loss_fn is not None:
+            return self._loss_fn(out, ensure_tensor(ys))
+        return out

@@ -1,0 +1,218 @@
+"""Pipeline-parallel execution: GPipe/1F1B over the 'pp' mesh axis.
+
+Reference parity: ``PipelineParallel.forward_backward_pipeline`` (1F1B,
+``fleet/meta_parallel/pipeline_parallel.py:153``) and the P2P layer
+(``pp_utils/p2p_communication.py``) + static-graph ``fleet_executor``
+interceptor DAG (SURVEY.md §2.3).
+
+TPU-native: there is no NCCL P2P and no interceptor message loop. The whole
+schedule is ONE compiled XLA program (SURVEY.md §7 hard part #1):
+
+- stage weights are stacked — each block parameter becomes [num_layers, ...]
+  sharded over 'pp' on dim 0, so stage i's slice lives on the pp=i devices;
+- a ``lax.scan`` over M + P - 1 ticks runs, per tick, every stage's block
+  chunk in parallel on its own microbatch (the steady-state of 1F1B), and
+  moves activations between stages with ``lax.ppermute`` over ICI;
+- backward is jax.vjp *through* the scan+ppermute (ppermute transposes to the
+  reverse rotation) — the cooldown schedule the reference hand-codes falls
+  out of AD, with ``jax.checkpoint`` on the block for the standard
+  recompute-per-microbatch memory profile;
+- dp/mp/sep axes stay GSPMD-managed: the shard_map is *partial-manual* over
+  {'pp'} only, so tensor-parallel layers and batch sharding compose unchanged.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...autograd import no_grad
+from ...nn.layer_base import Layer
+from ...ops._apply import apply_op, ensure_tensor
+from ...tensor import Parameter, Tensor
+from .. import topology
+
+__all__ = ["StackedPipelineBlocks", "pipeline_apply"]
+
+
+class StackedPipelineBlocks(Layer):
+    """N homogeneous blocks with stage-stacked parameters.
+
+    ``factory()`` must build one block Layer; all N are built (for faithful
+    per-layer init) and their parameters stacked into [N, ...] Parameters
+    sharded over 'pp' dim 0 when a pp>1 mesh is active. One template block is
+    kept for functional application.
+    """
+
+    def __init__(self, factory: Callable[[], Layer], num_layers: int,
+                 remat: bool = True):
+        super().__init__()
+        self.num_layers = num_layers
+        self.remat = remat
+        mesh = topology.get_mesh()
+        self._mesh_ref = mesh
+        self._pp = topology.axis_size("pp", mesh) if mesh is not None else 1
+        if num_layers % max(self._pp, 1):
+            raise ValueError(
+                f"num_layers {num_layers} not divisible by pp {self._pp}")
+        blocks = [factory() for _ in range(num_layers)]
+        # scratch block for functional application: must NOT register as a
+        # sublayer, or its (never-trained) cells would duplicate into
+        # parameters()/state_dict/optimizer state alongside the stacked ones
+        object.__setattr__(self, "template", blocks[0])
+        self._param_names = [n for n, _ in self.template.named_parameters()]
+        self._cells = [p for _, p in self.template.named_parameters()]
+        stacked_vals = []
+        tmpl_params = dict(self.template.named_parameters())
+        for name in self._param_names:
+            per_layer = []
+            for b in blocks:
+                d = dict(b.named_parameters())
+                per_layer.append(d[name]._value)
+            stacked_vals.append(jnp.stack(per_layer, axis=0))
+        self.stacked = []
+        for name, v in zip(self._param_names, stacked_vals):
+            if self._pp > 1:
+                # merge 'pp' on the stack dim with the block param's own
+                # sharding (e.g. mp-sharded TP weights) shifted right by one
+                inner = [None] * (v.ndim - 1)
+                da = tmpl_params[name].dist_attr
+                if da is not None and hasattr(da, "spec"):
+                    for i, e in enumerate(tuple(da.spec)):
+                        if i < len(inner):
+                            inner[i] = e
+                spec = P(*(["pp"] + inner))
+                v = jax.device_put(v, NamedSharding(mesh, spec))
+            p = Parameter(v, name=f"stacked_{name.replace('.', '_')}")
+            if self._pp > 1:
+                p.dist_attr = NamedSharding(mesh, spec)
+            self.add_parameter(f"s_{name.replace('.', '__')}", p)
+            self.stacked.append(p)
+
+    # -- functional single-block application --------------------------------
+    def _run_block(self, vals: Sequence, x):
+        """Pure-jax application of the template block with parameter values
+        ``vals`` (binding the cells; inner tape disabled — the OUTER trace
+        differentiates the pure computation)."""
+        old = [c._value for c in self._cells]
+        for c, v in zip(self._cells, vals):
+            c._value = v
+        try:
+            with no_grad():
+                out = self.template(Tensor(x, stop_gradient=True))
+        finally:
+            for c, o in zip(self._cells, old):
+                c._value = o
+        return out._value if isinstance(out, Tensor) else out
+
+    def train(self):
+        super().train()
+        self.template.train()
+        return self
+
+    def eval(self):
+        super().eval()
+        self.template.eval()
+        return self
+
+    def _chunk_fn(self):
+        """(local_stacked_vals, x) -> y : applies this stage's layer chunk
+        via lax.scan over the local leading dim."""
+        run = self._run_block
+        use_remat = self.remat
+
+        def apply_chunk(local_vals: List, x):
+            def body(h, layer_vals):
+                f = (jax.checkpoint(lambda hh, lv: run(lv, hh))
+                     if use_remat else (lambda hh, lv: run(lv, hh)))
+                return f(h, list(layer_vals)), None
+
+            y, _ = jax.lax.scan(body, x, tuple(local_vals))
+            return y
+
+        return apply_chunk
+
+    def forward(self, x, num_microbatches: Optional[int] = None):
+        """Run all layers. pp==1: plain scan over layers (one fused program,
+        weight-stationary). pp>1: the pipelined schedule over microbatches —
+        x [B, ...] is split into ``num_microbatches`` along dim 0."""
+        xt = ensure_tensor(x)
+        if self._pp == 1:
+            chunk = self._chunk_fn()
+
+            def fn(xv, *stacked):
+                return chunk(list(stacked), xv)
+
+            return apply_op(fn, [xt] + list(self.stacked), name="stacked_blocks")
+        M = num_microbatches or self._pp
+        return pipeline_apply(self, xt, M)
+
+
+def pipeline_apply(stack: StackedPipelineBlocks, x: Tensor, num_microbatches: int):
+    """The compiled GPipe loop (see module docstring). x: [B, ...] with B
+    divisible by num_microbatches."""
+    mesh = stack._mesh_ref
+    Pp = stack._pp
+    M = int(num_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    chunk = stack._chunk_fn()
+    n_params = len(stack.stacked)
+
+    def fn(xv, *stacked):
+        mb = xv.reshape((M, B // M) + xv.shape[1:])
+
+        def inner(mb_in, *stacked_local):
+            # manual over 'pp': stacked_local leading dim = layers/stage
+            r = jax.lax.axis_index("pp")
+            T = M + Pp - 1
+            # carry is per-stage state: mark it varying over the manual axis.
+            # fresh jnp.zeros (NOT zeros_like of the outer traced value, whose
+            # committed all-Auto sharding would clash with the Manual context)
+            state = jax.lax.pcast(
+                jnp.zeros(mb_in.shape[1:], mb_in.dtype), ("pp",), to="varying")
+            outputs = jax.lax.pcast(
+                jnp.zeros(mb_in.shape, mb_in.dtype), ("pp",), to="varying")
+            perm = [(i, (i + 1) % Pp) for i in range(Pp)]
+
+            def tick(carry, t):
+                state, outputs = carry
+                feed_idx = jnp.clip(t, 0, M - 1)
+                first_in = jnp.where(
+                    (t < M), mb_in[feed_idx], jnp.zeros_like(mb_in[0]))
+                x_in = jnp.where(r == 0, first_in, state)
+                y = chunk(list(stacked_local), x_in)
+                out_t = t - (Pp - 1)
+                valid = (r == Pp - 1) & (out_t >= 0)
+                store_idx = jnp.clip(out_t, 0, M - 1)
+                outputs = jnp.where(
+                    valid,
+                    jax.lax.dynamic_update_index_in_dim(
+                        outputs, y, store_idx, axis=0),
+                    outputs)
+                state = jax.lax.ppermute(y, "pp", perm)
+                return (state, outputs), None
+
+            (state, outputs), _ = jax.lax.scan(
+                tick, (state, outputs), jnp.arange(T))
+            # outputs live on the last stage only; replicate over pp
+            outputs = jax.lax.psum(
+                jnp.where(r == Pp - 1, outputs, jnp.zeros_like(outputs)), "pp")
+            return outputs
+
+        stacked_specs = tuple(
+            P(*(["pp"] + [None] * (s.ndim - 1))) for s in stacked)
+        # default check_vma: the final masked psum makes outputs provably
+        # invariant over 'pp', so out_specs=P() passes the replication check
+        mapped = jax.shard_map(
+            inner, mesh=mesh, axis_names={"pp"},
+            in_specs=(P(),) + stacked_specs,
+            out_specs=P())
+        out_mb = mapped(mb, *stacked)
+        return out_mb.reshape((B,) + out_mb.shape[2:])
+
+    return apply_op(fn, [x] + list(stack.stacked), name="pipeline_apply")

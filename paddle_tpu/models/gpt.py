@@ -51,6 +51,7 @@ class GPTConfig:
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     tie_word_embeddings: bool = True
+    pp_num_microbatches: Optional[int] = None  # default: pp degree
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -76,10 +77,11 @@ def gpt3_1_3b(**kw) -> "GPTConfig":
 
 
 def _mesh_mp() -> int:
-    mesh = topology.get_mesh()
-    if mesh is None or "mp" not in mesh.axis_names:
-        return 1
-    return mesh.shape["mp"]
+    return topology.axis_size("mp")
+
+
+def _mesh_pp() -> int:
+    return topology.axis_size("pp")
 
 
 def _normal_init(std):
@@ -216,8 +218,24 @@ class GPTModel(nn.Layer):
         self.position_embeddings = nn.Embedding(
             config.max_position_embeddings, config.hidden_size,
             weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
-        self.layers = nn.LayerList([GPTDecoderLayer(config)
-                                    for _ in range(config.num_layers)])
+        pp = _mesh_pp()
+        self._pp = pp
+        if pp > 1:
+            # stage-stacked blocks: the 1F1B scan+ppermute schedule compiles
+            # into the forward (distributed/fleet/pipeline_schedule.py)
+            if config.hidden_dropout_prob or config.attention_dropout_prob:
+                raise ValueError(
+                    "pp>1 uses lax.scan-stacked blocks whose dropout would "
+                    "reuse one PRNG key per scan; set dropout probs to 0")
+            from ..distributed.fleet.pipeline_schedule import (
+                StackedPipelineBlocks,
+            )
+
+            self.layers = StackedPipelineBlocks(
+                lambda: GPTDecoderLayer(config), config.num_layers)
+        else:
+            self.layers = nn.LayerList([GPTDecoderLayer(config)
+                                        for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
         self.drop_p = config.hidden_dropout_prob
@@ -238,14 +256,18 @@ class GPTModel(nn.Layer):
         ids = ensure_tensor(input_ids)
         B, S = ids.shape
         if position_ids is None:
-            pos_val = jnp.arange(S, dtype=jnp.int64)[None, :].repeat(B, axis=0)
+            pos_val = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
             position_ids = Tensor(pos_val, stop_gradient=True)
         x = self.embeddings(ids) + self.position_embeddings(position_ids)
         if self.drop_p and self.training:
             x = F.dropout(x, self.drop_p)
         x = self._seq_parallel(x)
-        for layer in self.layers:
-            x = layer(x)
+        if self._pp > 1:
+            x = self.layers(
+                x, num_microbatches=self.config.pp_num_microbatches or self._pp)
+        else:
+            for layer in self.layers:
+                x = layer(x)
         return self.ln_f(x)
 
 

@@ -1,0 +1,150 @@
+"""Pipeline parallelism: stacked blocks + scan/ppermute schedule parity."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.pipeline_schedule import StackedPipelineBlocks
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    dist.set_mesh(None)
+
+
+class Block(nn.Layer):
+    def __init__(self, h=16):
+        super().__init__()
+        self.lin = nn.Linear(h, h)
+        self.ln = nn.LayerNorm(h)
+
+    def forward(self, x):
+        return x + F.gelu(self.lin(self.ln(x)))
+
+
+def _init_pp(pp=4, dp=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "pp_degree": pp}
+    fleet.fleet._is_initialized = False
+    fleet.init(strategy=strategy)
+
+
+def _sequential_reference(stack, x):
+    """Apply the stacked weights layer-by-layer with plain numpy-free jax."""
+    h = x
+    for i in range(stack.num_layers):
+        vals = [np.asarray(p.value)[i] for p in stack.stacked]
+        h = stack._run_block([paddle.to_tensor(v).value for v in vals],
+                             paddle.to_tensor(h).value)
+        h = np.asarray(h)
+    return h
+
+
+class TestStackedBlocks:
+    def test_pp1_scan_matches_sequential(self):
+        dist.set_mesh(None)
+        paddle.seed(0)
+        stack = StackedPipelineBlocks(lambda: Block(16), 4, remat=False)
+        x = np.random.default_rng(0).standard_normal((8, 16)).astype("float32")
+        out = stack(paddle.to_tensor(x)).numpy()
+        ref = _sequential_reference(stack, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_pipeline_matches_sequential(self):
+        _init_pp(pp=4)
+        paddle.seed(1)
+        stack = StackedPipelineBlocks(lambda: Block(16), 8)
+        x = np.random.default_rng(1).standard_normal((8, 16)).astype("float32")
+        out = stack(paddle.to_tensor(x), num_microbatches=4).numpy()
+        ref = _sequential_reference(stack, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        # stage weights really live sharded over pp
+        assert not stack.stacked[0].value.sharding.is_fully_replicated
+
+    def test_pipeline_gradients_match_pp1(self):
+        x = np.random.default_rng(2).standard_normal((8, 16)).astype("float32")
+
+        def grads(pp):
+            if pp == 1:
+                dist.set_mesh(None)
+            else:
+                _init_pp(pp=pp)
+            paddle.seed(3)
+            stack = StackedPipelineBlocks(lambda: Block(16), 4, remat=False)
+            out = stack(paddle.to_tensor(x),
+                        num_microbatches=2 if pp > 1 else None)
+            loss = (out * out).mean()
+            loss.backward()
+            return [np.asarray(p.grad.value) for p in stack.stacked]
+
+        g1 = grads(1)
+        g4 = grads(2)
+        for a, b in zip(g1, g4):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_pipelined_training_compiled(self):
+        _init_pp(pp=4, dp=2)
+        paddle.seed(4)
+        h = 16
+        head = nn.Linear(h, 4)
+        stack = StackedPipelineBlocks(lambda: Block(h), 4)
+        params = stack.parameters() + head.parameters()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+
+        @jit.to_static
+        def step(xb, yb):
+            hidden = stack(xb, num_microbatches=4)
+            loss = F.cross_entropy(head(hidden), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((16, h)).astype("float32")
+        y = rng.integers(0, 4, (16,))
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+        assert len(step._cache) == 1
+
+
+class TestGPT4D:
+    def test_gpt_dp_mp_pp_train(self):
+        """2x2x2 hybrid: dp x pp x mp on 8 virtual devices."""
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+        fleet.fleet._is_initialized = False
+        fleet.init(strategy=strategy)
+        paddle.seed(9)
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        cfg = gpt_tiny(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=model.parameters())
+
+        @jit.to_static
+        def step(ids, labels):
+            _, loss = model(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.default_rng(10)
+        ids = rng.integers(0, 256, (8, 16))
+        labels = np.roll(ids, -1, 1)
+        losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)).numpy())
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+        # stacked block weights sharded over pp (+mp inner for TP weights)
+        stacked = model.gpt.layers.stacked
+        assert any(not p.value.sharding.is_fully_replicated for p in stacked)
