@@ -84,7 +84,11 @@ def _max_pool(x, kernel_size, stride, padding, ceil_mode, n, data_format, return
                     if rem:
                         pcfg[ax][1] += stride[i] - rem
                 pcfg = [tuple(q) for q in pcfg]
-        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        # floats MUST use -inf: jax only recognizes the differentiable
+        # reduce_window_max monoid for (-inf, lax.max); finfo.min falls back
+        # to the generic reduce_window which has no autodiff rule
+        neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+               else jnp.iinfo(a.dtype).min)
         return lax.reduce_window(a, neg, lax.max, dims, strides, pcfg)
 
     out = unary(fn, x, name=f"max_pool{n}d")

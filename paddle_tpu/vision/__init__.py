@@ -1,1 +1,6 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.vision (reference: python/paddle/vision/ — models, transforms,
+datasets, ops; SURVEY.md §2.4)."""
+from . import datasets, models, ops, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+__all__ = ["models", "transforms", "datasets", "ops"]

@@ -1,0 +1,149 @@
+"""paddle_tpu.vision.datasets (reference: python/paddle/vision/datasets/ —
+MNIST/FashionMNIST/Cifar10/Cifar100/Flowers/VOC2012 with download helpers).
+
+Zero-egress build: no downloads. Each dataset reads the standard on-disk
+format from a user-supplied path (``data_file``/``data_dir``); ``FakeData``
+generates deterministic synthetic samples for pipelines and tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class MNIST(Dataset):
+    """reference: datasets/mnist.py — idx-format images/labels."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        if download and (image_path is None or label_path is None):
+            raise RuntimeError(
+                f"{type(self).__name__}: downloads are disabled in this build; "
+                "pass image_path/label_path to the local idx files")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _parse_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx image magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _parse_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx label magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference: datasets/cifar.py — the python-pickle tar format."""
+
+    _num_classes = 10
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2"):
+        if data_file is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: downloads are disabled in this build; "
+                "pass data_file to the local cifar tar.gz")
+        self.mode = mode
+        self.transform = transform
+        self.data, self.labels = self._load(data_file)
+
+    def _member_filter(self, name: str) -> bool:
+        if self._num_classes == 10:
+            return ("data_batch" in name) if self.mode == "train" else (
+                "test_batch" in name)
+        return name.endswith("train") if self.mode == "train" else name.endswith("test")
+
+    def _load(self, data_file):
+        datas, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                if not member.isfile() or not self._member_filter(member.name):
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                datas.append(batch[b"data"])
+                labels.extend(batch.get(b"labels", batch.get(b"fine_labels", [])))
+        data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        return data, np.asarray(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC for transforms
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], dtype="int64")
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _num_classes = 100
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset (pipelines/tests/benchmarks without
+    real data — stands in for the reference's downloadable sets)."""
+
+    def __init__(self, size: int = 1000, image_shape=(3, 224, 224),
+                 num_classes: int = 1000, transform: Optional[Callable] = None,
+                 dtype: str = "float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        if idx < 0 or idx >= self.size:
+            raise IndexError(idx)
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], dtype="int64")
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,424 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, box_coder, yolo_box, yolo_loss, distribute_fpn_proposals...).
+
+TPU notes: detection post-processing is dynamic-shape by nature; the kernels
+here keep static shapes (fixed-size outputs with validity masks / -1 padding)
+so they compile once — the paddle API shape contract is preserved where
+possible and documented where padded.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F  # noqa: F401 (parity surface)
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "box_area",
+           "box_iou", "deform_conv2d", "DeformConv2D", "RoIAlign", "RoIPool"]
+
+
+def box_area(boxes):
+    """reference: vision/ops.py box_area ([N,4] xyxy)."""
+    b = ensure_tensor(boxes)
+    return apply_op(
+        lambda v: (v[:, 2] - v[:, 0]) * (v[:, 3] - v[:, 1]), [b], name="box_area")
+
+
+def _pairwise_iou(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    a, b = ensure_tensor(boxes1), ensure_tensor(boxes2)
+    return apply_op(_pairwise_iou, [a, b], name="box_iou")
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None):
+    """reference: vision/ops.py nms — greedy suppression, returns kept indices
+    sorted by score. Static-shape kernel: O(N^2) IoU matrix + iterative mask
+    via lax.fori_loop (compiles once per N)."""
+    b = ensure_tensor(boxes)
+    n = b.shape[0]
+    if scores is None:
+        scores_t = Tensor(jnp.arange(n, 0, -1, dtype=jnp.float32))
+    else:
+        scores_t = ensure_tensor(scores)
+
+    def fn(bv, sv, *cat):
+        order = jnp.argsort(-sv)
+        bb = bv[order]
+        iou = _pairwise_iou(bb, bb)
+        if cat:  # category-aware: only same-category boxes suppress
+            cv = cat[0][order]
+            iou = jnp.where(cv[:, None] == cv[None, :], iou, 0.0)
+
+        def body(i, keep):
+            # suppress i iff a kept higher-scored j overlaps it
+            suppressed = jnp.any(
+                jnp.where(jnp.arange(n) < i,
+                          (iou[:, i] > iou_threshold) & keep.astype(bool),
+                          False))
+            return keep.at[i].set(jnp.where(suppressed, False, True))
+
+        keep = jnp.ones((n,), dtype=bool)
+        keep = jax.lax.fori_loop(1, n, body, keep)
+        kept_sorted = jnp.where(keep, order, -1)
+        # compact: stable partition of valid entries first
+        idx = jnp.argsort(~keep)  # True(keep) first, stable
+        return kept_sorted[idx]
+
+    ins = [b, scores_t]
+    if category_idxs is not None:
+        ins.append(ensure_tensor(category_idxs))
+    out = apply_op(fn, ins, differentiable=False, name="nms")
+    # host-side compaction to paddle's dynamic shape (eager only)
+    if not isinstance(out._value, jax.core.Tracer):
+        vals = np.asarray(out._value)
+        vals = vals[vals >= 0]
+        if top_k is not None:
+            vals = vals[:top_k]
+        return Tensor(jnp.asarray(vals, dtype=jnp.int64))
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """reference: vision/ops.py roi_align (phi roi_align kernel) — bilinear
+    sampling of box regions to [num_rois, C, out_h, out_w]."""
+    xt, bt = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = ensure_tensor(boxes_num)
+
+    def fn(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        # map each roi to its batch image by boxes_num
+        counts = rois_num.astype(jnp.int32)
+        batch_of = jnp.repeat(jnp.arange(N), counts, total_repeat_length=rois.shape[0])
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid [R, oh*sr, ow*sr]
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] * rh[:, None]
+              / (oh * sr))
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] * rw[:, None]
+              / (ow * sr))
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            # explicit gather: [C, ny, nx]
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1_]
+            v10 = img[:, y1_][:, :, x0]
+            v11 = img[:, y1_][:, :, x1_]
+            wy_ = wy[None, :, None]
+            wx_ = wx[None, None, :]
+            return (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                    + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+
+        def per_roi(i):
+            img = feat[batch_of[i]]
+            samp = bilinear(img, ys[i], xs[i])  # [C, oh*sr, ow*sr]
+            return samp.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+
+    return apply_op(fn, [xt, bt, Tensor(bn._value, stop_gradient=True)],
+                    name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """reference: vision/ops.py roi_pool — max-pool variant via dense sampling."""
+    xt, bt = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = ensure_tensor(boxes_num)
+
+    def fn(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        counts = rois_num.astype(jnp.int32)
+        batch_of = jnp.repeat(jnp.arange(N), counts,
+                              total_repeat_length=rois.shape[0])
+        sr = 4
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        ys = y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] * rh[:, None] / (oh * sr)
+        xs = x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] * rw[:, None] / (ow * sr)
+
+        def per_roi(i):
+            img = feat[batch_of[i]]
+            yi = jnp.clip(jnp.round(ys[i]).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.round(xs[i]).astype(jnp.int32), 0, W - 1)
+            samp = img[:, yi][:, :, xi]
+            return samp.reshape(C, oh, sr, ow, sr).max(axis=(2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+
+    return apply_op(fn, [xt, bt, Tensor(bn._value, stop_gradient=True)],
+                    name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None):
+    """reference: vision/ops.py box_coder (phi box_coder kernel)."""
+    pb, tb = ensure_tensor(prior_box), ensure_tensor(target_box)
+    pbv = ensure_tensor(prior_box_var) if prior_box_var is not None else None
+
+    def fn(p, t, *v):
+        norm = 0.0 if box_normalized else 1.0
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        var = v[0] if v else jnp.ones((1, 4), p.dtype)
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :]),
+            ], axis=-1)
+            return out / var.reshape(1, -1, 4)
+        # decode_center_size
+        d = t * var.reshape(1, -1, 4) if v else t
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :], pcx[None, :],
+                                    pcy[None, :])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None], pcx[:, None],
+                                    pcy[:, None])
+        ocx = d[..., 0] * pw_ + pcx_
+        ocy = d[..., 1] * ph_ + pcy_
+        ow_ = jnp.exp(d[..., 2]) * pw_
+        oh_ = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([ocx - ow_ / 2, ocy - oh_ / 2,
+                          ocx + ow_ / 2 - norm, ocy + oh_ / 2 - norm], axis=-1)
+
+    ins = [pb, tb] + ([pbv] if pbv is not None else [])
+    return apply_op(fn, ins, name="box_coder")
+
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float = 0.01,
+             downsample_ratio: int = 32, clip_bbox: bool = True, name=None,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5):
+    """reference: vision/ops.py yolo_box (phi yolo_box kernel) — decode YOLO
+    head predictions to boxes+scores. Returns (boxes [N, anchors*H*W, 4],
+    scores [N, anchors*H*W, class_num]); sub-threshold boxes zeroed."""
+    xt, st = ensure_tensor(x), ensure_tensor(img_size)
+    na = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, dtype="float32").reshape(na, 2))
+
+    def fn(v, imgs):
+        N, C, H, W = v.shape
+        v = v.reshape(N, na, -1, H, W)
+        box_attr = v.shape[2]
+        gx = (jnp.arange(W) + 0.5)[None, None, None, :]
+        gy = (jnp.arange(H) + 0.5)[None, None, :, None]
+        sx = jax.nn.sigmoid(v[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        cx = (jnp.floor(gx) + sx) / W
+        cy = (jnp.floor(gy) + sy) / H
+        input_h = downsample_ratio * H
+        input_w = downsample_ratio * W
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        cls = jax.nn.sigmoid(v[:, :, 5:5 + class_num]) * conf[:, :, None]
+        imh = imgs[:, 0].astype(v.dtype)[:, None, None, None]
+        imw = imgs[:, 1].astype(v.dtype)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imw - 1)
+            y2 = jnp.minimum(y2, imh - 1)
+        mask = (conf > conf_thresh).astype(v.dtype)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * mask[..., None]
+        boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(N, -1, 4)
+        scores = (cls * mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(
+            N, -1, class_num)
+        return boxes, scores
+
+    return apply_op(fn, [xt, Tensor(st._value, stop_gradient=True)],
+                    name="yolo_box")
+
+
+def deform_conv2d(x, offset, weight, mask=None, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, name=None):
+    """reference: vision/ops.py deform_conv2d — v1/v2 deformable convolution
+    via explicit bilinear sampling + matmul (MXU-friendly im2col form)."""
+    xt = ensure_tensor(x)
+    ot = ensure_tensor(offset)
+    wt = ensure_tensor(weight)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def fn(xv, off, w, *rest):
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = w.shape
+        ph, pw = padding
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        oh = (Hp - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1
+        ow = (Wp - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1
+        # offsets [N, dg, 2(y,x), k, oh, ow]; optional modulation mask after
+        off = off.reshape(N, deformable_groups, 2, kh * kw, oh, ow)
+        mask_v = None
+        if mask is not None:
+            mask_v = rest[0].reshape(N, deformable_groups, kh * kw, oh, ow)
+        # sampling coords per (n, dg, k, i, j)
+        kyx = jnp.stack(jnp.meshgrid(jnp.arange(kh) * dilation[0],
+                                     jnp.arange(kw) * dilation[1],
+                                     indexing="ij"), 0).reshape(2, -1)
+        gy = jnp.arange(oh) * stride[0]
+        gx = jnp.arange(ow) * stride[1]
+        sy = (gy[None, None, None, :, None] + kyx[0][None, None, :, None, None]
+              + off[:, :, 0])
+        sx = (gx[None, None, None, None, :] + kyx[1][None, None, :, None, None]
+              + off[:, :, 1])
+
+        def bilin(img2d, yy2, xx2):
+            y0 = jnp.floor(yy2)
+            x0 = jnp.floor(xx2)
+            wy = yy2 - y0
+            wx = xx2 - x0
+            y0i = jnp.clip(y0.astype(jnp.int32), 0, Hp - 1)
+            x0i = jnp.clip(x0.astype(jnp.int32), 0, Wp - 1)
+            y1i = jnp.clip(y0i + 1, 0, Hp - 1)
+            x1i = jnp.clip(x0i + 1, 0, Wp - 1)
+            ok = (yy2 > -1) & (yy2 < Hp) & (xx2 > -1) & (xx2 < Wp)
+            v = (img2d[y0i, x0i] * (1 - wy) * (1 - wx)
+                 + img2d[y0i, x1i] * (1 - wy) * wx
+                 + img2d[y1i, x0i] * wy * (1 - wx)
+                 + img2d[y1i, x1i] * wy * wx)
+            return jnp.where(ok, v, 0.0)
+
+        cpg = C // deformable_groups  # channels per deformable group
+
+        def per_n(n):
+            def per_c(c):
+                dg = c // cpg
+                s = bilin(xp[n, c], sy[n, dg], sx[n, dg])  # [k, oh, ow]
+                if mask_v is not None:
+                    s = s * mask_v[n, dg]
+                return s
+
+            return jax.vmap(per_c)(jnp.arange(C))  # [C, k, oh, ow]
+
+        cols = jax.vmap(per_n)(jnp.arange(N))  # [N, C, k, oh, ow]
+        cols = cols.reshape(N, C * kh * kw, oh * ow)
+        wmat = w.reshape(Co, Cg * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("ok,nkp->nop", wmat, cols)
+        else:
+            cols_g = cols.reshape(N, groups, (C // groups) * kh * kw, oh * ow)
+            wg = wmat.reshape(groups, Co // groups, Cg * kh * kw)
+            out = jnp.einsum("gok,ngkp->ngop", wg, cols_g).reshape(
+                N, Co, oh * ow)
+        out = out.reshape(N, Co, oh, ow)
+        if bias is not None:
+            out = out + rest[-1].reshape(1, -1, 1, 1)
+        return out
+
+    ins = [xt, ot, wt]
+    if mask is not None:
+        ins.append(ensure_tensor(mask))
+    if bias is not None:
+        ins.append(ensure_tensor(bias))
+    return apply_op(fn, ins, name="deform_conv2d")
+
+
+class DeformConv2D:
+    """reference: vision/ops.py DeformConv2D layer."""
+
+    def __new__(cls, *a, **k):
+        from .. import nn
+
+        class _DeformConv2D(nn.Layer):
+            def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                         padding=0, dilation=1, deformable_groups=1, groups=1,
+                         weight_attr=None, bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+                    else tuple(kernel_size)
+                self._attrs = dict(stride=stride, padding=padding,
+                                   dilation=dilation,
+                                   deformable_groups=deformable_groups,
+                                   groups=groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, *ks], attr=weight_attr)
+                self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                                  is_bias=True)
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, mask=mask,
+                                     bias=self.bias, **self._attrs)
+
+        return _DeformConv2D(*a, **k)
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from .. import nn
+
+        class _RoIAlign(nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_align(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIAlign()
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from .. import nn
+
+        class _RoIPool(nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIPool()

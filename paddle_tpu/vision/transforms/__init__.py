@@ -1,0 +1,25 @@
+"""paddle_tpu.vision.transforms (reference: python/paddle/vision/transforms/
+— class transforms over numpy HWC images + functional API). Host-side numpy
+only: transforms run in DataLoader workers and must never touch the device
+backend (generator.host_rng pattern)."""
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
+    normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip,
+)
+from .transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
+    RandomErasing, RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
+    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+)
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "RandomRotation", "ColorJitter",
+    "Grayscale", "BrightnessTransform", "ContrastTransform", "HueTransform",
+    "SaturationTransform", "RandomErasing",
+    "to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
+    "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
+    "adjust_contrast", "adjust_hue",
+]

@@ -1,0 +1,184 @@
+"""Functional image transforms over numpy arrays (reference:
+python/paddle/vision/transforms/functional.py + functional_cv2.py; the
+PIL/cv2 backends collapse to one numpy implementation — HWC uint8/float)."""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
+    "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
+    "adjust_contrast", "adjust_hue",
+]
+
+
+def _as_hwc(img) -> np.ndarray:
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def to_tensor(pic, data_format: str = "CHW"):
+    """HWC uint8 [0,255] -> float32 CHW [0,1] (reference: functional.to_tensor).
+    Returns numpy (DataLoader collates to device arrays at the batch level)."""
+    arr = _as_hwc(pic).astype("float32")
+    if arr.max() > 1.0:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def _interp_resize(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize in pure numpy (no cv2/PIL in the image)."""
+    H, W = img.shape[:2]
+    if (H, W) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = img.astype("float32")
+    out = (im[y0][:, x0] * (1 - wy) * (1 - wx) + im[y0][:, x1] * (1 - wy) * wx
+           + im[y1][:, x0] * wy * (1 - wx) + im[y1][:, x1] * wy * wx)
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    """reference: functional.resize — size int (short side) or (h, w)."""
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    if isinstance(size, int):
+        if H < W:
+            h, w = size, int(size * W / H)
+        else:
+            h, w = int(size * H / W), size
+    else:
+        h, w = size
+    return _interp_resize(arr, int(h), int(w))
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    th, tw = output_size
+    return crop(arr, (H - th) // 2, (W - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb: bool = False):
+    arr = np.asarray(img, dtype="float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    arr = _as_hwc(img)
+    if isinstance(padding, int):
+        pl = pt = pr = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+
+
+def rotate(img, angle: float, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    """Nearest-neighbor rotation (reference: functional.rotate)."""
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    a = -np.deg2rad(angle)
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None else center
+    yy, xx = np.mgrid[0:H, 0:W]
+    ys = cy + (yy - cy) * np.cos(a) - (xx - cx) * np.sin(a)
+    xs = cx + (yy - cy) * np.sin(a) + (xx - cx) * np.cos(a)
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+    out = np.full_like(arr, fill)
+    out[ok] = arr[yi[ok], xi[ok]]
+    return out
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    arr = _as_hwc(img).astype("float32")
+    gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray.astype(np.asarray(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    arr = _as_hwc(img).astype("float32") * brightness_factor
+    return _clip_like(arr, img)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    arr = _as_hwc(img).astype("float32")
+    mean = to_grayscale(arr).mean()
+    out = (arr - mean) * contrast_factor + mean
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor: float):
+    """reference: functional.adjust_hue — rotate hue in HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_hwc(img).astype("float32")
+    scale = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    x = arr / scale
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6.0
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    rgb = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], axis=-1)
+    return _clip_like(rgb * scale, img)
+
+
+def _clip_like(arr, ref):
+    dt = np.asarray(ref).dtype
+    if dt == np.uint8:
+        return np.clip(arr, 0, 255).astype(np.uint8)
+    return arr.astype("float32")

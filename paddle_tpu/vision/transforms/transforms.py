@@ -1,0 +1,315 @@
+"""Class-style transforms (reference: python/paddle/vision/transforms/
+transforms.py — BaseTransform with _apply_image dispatch, Compose)."""
+from __future__ import annotations
+
+import numbers
+import random as _random
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...generator import host_rng
+from . import functional as F
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "RandomRotation", "ColorJitter",
+    "Grayscale", "BrightnessTransform", "ContrastTransform", "HueTransform",
+    "SaturationTransform", "RandomErasing",
+]
+
+
+class BaseTransform:
+    """reference: transforms.py BaseTransform — keys-based multi-input apply."""
+
+    def __init__(self, keys: Optional[Sequence[str]] = None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            out = []
+            for key, data in zip(self.keys, inputs):
+                if key == "image":
+                    out.append(self._apply_image(data))
+                else:
+                    out.append(data)
+            return tuple(out) + tuple(inputs[len(self.keys):])
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return F.resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return F.center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = F.pad(img, self.padding, self.fill, self.padding_mode)
+        arr = np.asarray(img)
+        th, tw = self.size
+        H, W = arr.shape[:2]
+        if self.pad_if_needed and (H < th or W < tw):
+            img = F.pad(arr, (0, 0, max(tw - W, 0), max(th - H, 0)), self.fill,
+                        self.padding_mode)
+            arr = np.asarray(img)
+            H, W = arr.shape[:2]
+        rng = host_rng()
+        top = int(rng.integers(0, H - th + 1))
+        left = int(rng.integers(0, W - tw + 1))
+        return F.crop(arr, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        H, W = arr.shape[:2]
+        area = H * W
+        rng = host_rng()
+        for _ in range(10):
+            target = area * rng.uniform(*self.scale)
+            logr = np.log(self.ratio)
+            ar = np.exp(rng.uniform(*logr))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                top = int(rng.integers(0, H - h + 1))
+                left = int(rng.integers(0, W - w + 1))
+                patch = F.crop(arr, top, left, h, w)
+                return F.resize(patch, self.size, self.interpolation)
+        return F.resize(F.center_crop(arr, min(H, W)), self.size,
+                        self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if host_rng().random() < self.prob:
+            return F.hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if host_rng().random() < self.prob:
+            return F.vflip(img)
+        return np.asarray(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = host_rng().uniform(*self.degrees)
+        return F.rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = host_rng().uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = host_rng().uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = host_rng().uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = F.to_grayscale(img, 3).astype("float32")
+        arr = np.asarray(img).astype("float32")
+        return F._clip_like(arr * factor + gray * (1 - factor), img)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return F.adjust_hue(img, host_rng().uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.py ColorJitter — random order of the four."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [
+            BrightnessTransform(brightness), ContrastTransform(contrast),
+            SaturationTransform(saturation), HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = host_rng().permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing (CHW float input)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        arr = np.array(img, copy=True)
+        rng = host_rng()
+        if rng.random() >= self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        H, W = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = area * rng.uniform(*self.scale)
+            ar = np.exp(rng.uniform(*np.log(self.ratio)))
+            h = int(round(np.sqrt(target / ar)))
+            w = int(round(np.sqrt(target * ar)))
+            if h < H and w < W:
+                top = int(rng.integers(0, H - h + 1))
+                left = int(rng.integers(0, W - w + 1))
+                if chw:
+                    arr[:, top:top + h, left:left + w] = self.value
+                else:
+                    arr[top:top + h, left:left + w] = self.value
+                return arr
+        return arr

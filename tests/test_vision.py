@@ -1,0 +1,237 @@
+"""Vision subsystem: model zoo forwards, transforms, datasets, detection ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import datasets, models, ops, transforms as T
+
+
+def _img(n=1, c=3, h=64, w=64, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).standard_normal((n, c, h, w)).astype("float32"))
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("ctor,kw", [
+        (models.resnet18, {}),
+        (models.resnet50, {}),
+        (models.resnext50_32x4d, {}),
+        (models.wide_resnet50_2, {}),
+        (models.mobilenet_v1, {}),
+        (models.mobilenet_v2, {}),
+        (models.mobilenet_v3_small, {}),
+        (models.vgg11, {}),
+        (models.squeezenet1_1, {}),
+        (models.shufflenet_v2_x0_25, {}),
+        (models.densenet121, {}),
+    ])
+    def test_forward_shape(self, ctor, kw):
+        paddle.seed(0)
+        model = ctor(num_classes=10, **kw)
+        model.eval()
+        out = model(_img(2, 3, 64, 64))
+        assert out.shape == [2, 10]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_lenet(self):
+        model = models.LeNet()
+        out = model(paddle.to_tensor(np.zeros((2, 1, 28, 28), "float32")))
+        assert out.shape == [2, 10]
+
+    def test_alexnet(self):
+        model = models.alexnet(num_classes=7)
+        model.eval()
+        out = model(_img(1, 3, 224, 224))
+        assert out.shape == [1, 7]
+
+    def test_googlenet_train_aux(self):
+        model = models.googlenet(num_classes=6)
+        model.train()
+        out, aux1, aux2 = model(_img(1, 3, 96, 96))
+        assert out.shape == [1, 6] and aux1.shape == [1, 6] and aux2.shape == [1, 6]
+        model.eval()
+        out = model(_img(1, 3, 96, 96))
+        assert out.shape == [1, 6]
+
+    def test_inception_v3(self):
+        model = models.inception_v3(num_classes=5)
+        model.eval()
+        out = model(_img(1, 3, 299, 299))
+        assert out.shape == [1, 5]
+
+    def test_lenet_trains(self):
+        paddle.seed(1)
+        model = models.LeNet()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((16, 1, 28, 28)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 10, (16,)))
+
+        @paddle.jit.to_static
+        def step(xb, yb):
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(x, y).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        img = (np.random.default_rng(0).integers(0, 256, (40, 60, 3))
+               .astype("uint8"))
+        pipeline = T.Compose([
+            T.Resize(32), T.CenterCrop(32),
+            T.RandomHorizontalFlip(0.5),
+            T.ToTensor(),
+            T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+        ])
+        out = pipeline(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+        assert -2.0 <= out.min() and out.max() <= 2.0
+
+    def test_resize_semantics(self):
+        img = np.zeros((40, 80, 3), "uint8")
+        assert T.resize(img, 20).shape[:2] == (20, 40)  # short side
+        assert T.resize(img, (10, 12)).shape[:2] == (10, 12)
+
+    def test_normalize_values(self):
+        img = np.ones((3, 4, 4), "float32")
+        out = T.normalize(img, [1.0, 1.0, 1.0], [2.0, 2.0, 2.0])
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_flips_and_crop(self):
+        img = np.arange(16).reshape(4, 4, 1)
+        np.testing.assert_array_equal(T.hflip(img)[:, :, 0], img[:, ::-1, 0])
+        np.testing.assert_array_equal(T.vflip(img)[:, :, 0], img[::-1, :, 0])
+        np.testing.assert_array_equal(T.crop(img, 1, 1, 2, 2)[:, :, 0],
+                                      img[1:3, 1:3, 0])
+
+    def test_color_jitter_runs(self):
+        img = (np.random.default_rng(1).integers(0, 256, (16, 16, 3))
+               .astype("uint8"))
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert out.shape == img.shape and out.dtype == np.uint8
+
+    def test_random_erasing(self):
+        img = np.ones((3, 32, 32), "float32")
+        out = T.RandomErasing(prob=1.0, value=0.0)(img)
+        assert (out == 0).any() and out.shape == img.shape
+
+
+class TestDatasets:
+    def test_fake_data_loader(self):
+        ds = datasets.FakeData(size=32, image_shape=(3, 8, 8), num_classes=4)
+        loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True)
+        batches = list(loader)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert xb.shape == [8, 3, 8, 8] and yb.shape == [8, 1]
+
+    def test_mnist_idx_parsing(self, tmp_path):
+        import struct
+
+        rng = np.random.default_rng(3)
+        imgs = rng.integers(0, 256, (10, 28, 28)).astype("uint8")
+        labels = rng.integers(0, 10, (10,)).astype("uint8")
+        ip = tmp_path / "images.idx"
+        lp = tmp_path / "labels.idx"
+        with open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+            f.write(imgs.tobytes())
+        with open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 10))
+            f.write(labels.tobytes())
+        ds = datasets.MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 10
+        img, label = ds[3]
+        np.testing.assert_array_equal(img[:, :, 0], imgs[3])
+        assert label[0] == labels[3]
+
+
+class TestVisionOps:
+    def test_nms_matches_numpy(self):
+        rng = np.random.default_rng(5)
+        xy = rng.uniform(0, 80, (30, 2))
+        wh = rng.uniform(5, 30, (30, 2))
+        boxes = np.concatenate([xy, xy + wh], -1).astype("float32")
+        scores = rng.random(30).astype("float32")
+
+        def ref_nms(boxes, scores, thr):
+            order = np.argsort(-scores)
+            keep = []
+            while order.size:
+                i = order[0]
+                keep.append(i)
+                if order.size == 1:
+                    break
+                rest = order[1:]
+                a, b = boxes[i], boxes[rest]
+                lt = np.maximum(a[:2], b[:, :2])
+                rb = np.minimum(a[2:], b[:, 2:])
+                whs = np.clip(rb - lt, 0, None)
+                inter = whs[:, 0] * whs[:, 1]
+                area_a = (a[2] - a[0]) * (a[3] - a[1])
+                area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+                iou = inter / (area_a + area_b - inter + 1e-10)
+                order = rest[iou <= thr]
+            return keep
+
+        got = ops.nms(paddle.to_tensor(boxes), 0.4,
+                      scores=paddle.to_tensor(scores)).numpy()
+        expect = ref_nms(boxes, scores, 0.4)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_box_iou_identity(self):
+        boxes = paddle.to_tensor(
+            np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32"))
+        iou = ops.box_iou(boxes, boxes).numpy()
+        np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-5)
+        assert 0.1 < iou[0, 1] < 0.2  # 25/175
+
+    def test_roi_align_constant_field(self):
+        # constant feature map -> every pooled value equals the constant
+        feat = paddle.to_tensor(np.full((1, 2, 16, 16), 3.25, "float32"))
+        rois = paddle.to_tensor(np.array([[2, 2, 10, 10]], "float32"))
+        out = ops.roi_align(feat, rois, paddle.to_tensor(np.array([1])), 4)
+        assert out.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 3.25, rtol=1e-5)
+
+    def test_roi_pool_shape(self):
+        feat = _img(2, 3, 16, 16, seed=7)
+        rois = paddle.to_tensor(
+            np.array([[0, 0, 8, 8], [4, 4, 12, 12], [1, 1, 9, 9]], "float32"))
+        nums = paddle.to_tensor(np.array([2, 1]))
+        out = ops.roi_pool(feat, rois, nums, (2, 2))
+        assert out.shape == [3, 3, 2, 2]
+
+    def test_yolo_box_shapes(self):
+        n_anchors, classes, H = 3, 5, 4
+        x = _img(2, n_anchors * (5 + classes), H, H, seed=8)
+        img_size = paddle.to_tensor(np.array([[128, 128], [96, 64]], "int32"))
+        boxes, scores = ops.yolo_box(x, img_size, [10, 13, 16, 30, 33, 23],
+                                     classes, conf_thresh=0.0)
+        assert boxes.shape == [2, n_anchors * H * H, 4]
+        assert scores.shape == [2, n_anchors * H * H, classes]
+
+    def test_deform_conv_reduces_to_conv_with_zero_offset(self):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((1, 2, 8, 8)).astype("float32")
+        w = rng.standard_normal((4, 2, 3, 3)).astype("float32")
+        offset = np.zeros((1, 2 * 9, 6, 6), "float32")
+        out = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                                paddle.to_tensor(w))
+        import jax.numpy as jnp
+        from jax import lax
+
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                                   atol=2e-4)
