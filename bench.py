@@ -1,34 +1,112 @@
 #!/usr/bin/env python
 """Flagship benchmark: GPT causal-LM pretraining throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (+extras).
-The reference publishes no numbers (BASELINE.md) — the metric is
-tokens/sec/chip on a GPT-medium-scale config with bf16 AMP and a fully
-compiled train step (forward+backward+AdamW in one XLA program), plus the MFU
-against the chip's advertised bf16 peak.
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}
+(+extras). All diagnostics go to stderr. The reference publishes no numbers
+(BASELINE.md) — the metric is tokens/sec/chip on a GPT-medium-scale config
+with bf16 AMP and a fully compiled train step (forward+backward+AdamW in one
+XLA program), plus the MFU against the chip's advertised bf16 peak.
+
+Backend acquisition is retried with backoff (round 1 recorded a transient
+"Unable to initialize backend 'axon': UNAVAILABLE" with zero resilience —
+VERDICT.md weak #1). If the TPU backend stays down past the budget, the
+benchmark re-execs itself into a scrubbed CPU-only environment so a JSON
+line is ALWAYS produced (device field says which path ran).
 
 Env knobs: BENCH_SMALL=1 (tiny config for CPU smoke), BENCH_STEPS, BENCH_BATCH,
-BENCH_SEQ.
+BENCH_SEQ, BENCH_BACKEND_WAIT (seconds, default 600).
 """
 import json
 import os
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
 
-def main():
-    import jax
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+
+def _probe_backend_subprocess(timeout_s: float) -> bool:
+    """Probe backend init in a KILLABLE subprocess — the axon plugin can
+    hang (not error) inside client init, which no in-process retry loop
+    survives. Returns True when `jax.devices()` + a tiny computation work."""
+    import subprocess
+    code = ("import jax, jax.numpy as jnp;"
+            "d=jax.devices();"
+            "jnp.zeros((8,8)).block_until_ready();"
+            "print('PROBE_OK', d[0].platform, len(d))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+        ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+        tail = (r.stdout + r.stderr).strip().splitlines()[-3:]
+        _log(f"probe rc={r.returncode} ok={ok}: {' | '.join(tail)}")
+        return ok
+    except subprocess.TimeoutExpired:
+        _log(f"probe HUNG past {timeout_s:.0f}s (killed)")
+        return False
+
+
+def _acquire_device(max_wait: float):
+    """Bounded-retry backend acquisition. Probes in a subprocess first (so
+    hangs are killable), then initializes in-process. Returns a jax.Device
+    or None."""
+    deadline = time.time() + max_wait
+    attempt = 0
+    while True:
+        attempt += 1
+        probe_budget = max(30.0, min(180.0, deadline - time.time()))
+        if _probe_backend_subprocess(probe_budget):
+            break
+        if time.time() >= deadline:
+            _log("backend acquisition budget exhausted")
+            return None
+        sleep_s = min(30.0, 5.0 * attempt)
+        _log(f"retrying probe in {sleep_s:.0f}s "
+             f"({deadline - time.time():.0f}s left in budget)")
+        time.sleep(sleep_s)
+
+    import jax
+    try:
+        devs = jax.devices()
+        import jax.numpy as jnp
+        jnp.zeros((8, 8)).block_until_ready()
+        _log(f"backend up: {devs[0].platform} x{len(devs)} "
+             f"(attempt {attempt})")
+        return devs[0]
+    except Exception as e:
+        _log(f"in-process init failed after successful probe: "
+             f"{type(e).__name__}: {str(e)[:300]}")
+        _log(traceback.format_exc(limit=5))
+        return None
+
+
+def _reexec_cpu_fallback():
+    """Re-exec into a scrubbed env where the axon TPU plugin never registers
+    (sitecustomize gates on PALLAS_AXON_POOL_IPS) so plain CPU jax runs."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PJRT_LIBRARY_PATH", None)  # a lingering plugin path can still hang init
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMALL"] = "1"
+    env["BENCH_CPU_FALLBACK"] = "1"
+    _log("re-exec into CPU-only fallback (scrubbed env)")
+    rc = subprocess.call([sys.executable, os.path.abspath(__file__)], env=env)
+    sys.exit(rc)
+
+
+def run_bench(dev):
     import paddle_tpu as paddle
     from paddle_tpu import amp, jit
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+    on_tpu = dev.platform in ("tpu", "axon")
     small = os.environ.get("BENCH_SMALL") == "1" or not on_tpu
 
     if small:
@@ -47,6 +125,8 @@ def main():
         S = int(os.environ.get("BENCH_SEQ", 1024))
         steps = int(os.environ.get("BENCH_STEPS", 10))
 
+    _log(f"config: h{cfg.hidden_size} l{cfg.num_layers} B{B} S{S} "
+         f"steps={steps} device={dev.platform}")
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -67,10 +147,12 @@ def main():
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)))
     labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
 
+    _log("compiling train step...")
     t0 = time.time()
     loss = step(ids, labels)
     loss.value.block_until_ready()
     compile_s = time.time() - t0
+    _log(f"compiled in {compile_s:.1f}s; timing {steps} steps...")
 
     t0 = time.time()
     for _ in range(steps):
@@ -98,7 +180,22 @@ def main():
         "achieved_tflops_per_s": round(achieved_tflops, 2),
         "mfu_vs_v5e_peak": round(mfu, 4) if mfu is not None else None,
         "device": str(dev.platform),
-    }))
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    }), flush=True)
+
+
+def main():
+    max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", 600))
+    if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+        max_wait = 60.0
+    dev = _acquire_device(max_wait)
+    if dev is None:
+        if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+            _log("FATAL: CPU fallback backend also failed")
+            sys.exit(1)
+        _reexec_cpu_fallback()
+        return
+    run_bench(dev)
 
 
 if __name__ == "__main__":

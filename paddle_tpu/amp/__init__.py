@@ -201,7 +201,15 @@ class GradScaler:
         self.update(found)
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        """reference: grad_scaler.py minimize — collects grads already
+        produced by ``scaled_loss.backward()``; does NOT run backward itself
+        (running it here would double-accumulate for users following the
+        reference's documented scaled.backward() → scaler.minimize pattern)."""
+        params = optimizer._parameter_list
+        if params is not None and not any(p.grad is not None for p in params):
+            raise RuntimeError(
+                "GradScaler.minimize found no gradients: call "
+                "scaled_loss.backward() before minimize()")
         self.step(optimizer)
 
     def unscale_(self, optimizer):

@@ -257,9 +257,20 @@ recv = send
 
 
 def barrier(group=None):
-    """reference: communication/barrier — single-controller SPMD needs no
-    host barrier; block on device work instead."""
-    (jnp.zeros(()) + 0).block_until_ready()
+    """reference: communication/barrier. Single-process: block on device
+    work. Multi-host (jax.distributed initialized): a real cross-process
+    rendezvous via sync_global_devices — a local block_until_ready alone
+    would let rank-0-writes/others-read patterns race."""
+    try:
+        multiproc = jax.process_count() > 1
+    except Exception:
+        multiproc = False
+    if multiproc:
+        from jax.experimental import multihost_utils
+        barrier._seq = getattr(barrier, "_seq", 0) + 1
+        multihost_utils.sync_global_devices(f"paddle_tpu_barrier_{barrier._seq}")
+    else:
+        (jnp.zeros(()) + 0).block_until_ready()
 
 
 def wait(tensor, group=None, use_calc_stream=True):

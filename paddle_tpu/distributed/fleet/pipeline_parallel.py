@@ -36,6 +36,28 @@ class PipelineParallel(Layer):
             micro = int(hc.get("accumulate_steps", 1))
         self.accumulate_steps = max(micro, 1)
         self._loss_fn = getattr(layers, "_loss_fn", None)
+        # Heterogeneous PipelineLayer models run all stages in one program —
+        # correct numerics, but parameters are NOT partitioned over the 'pp'
+        # mesh axis (only homogeneous StackedPipelineBlocks get the compiled
+        # scan+ppermute schedule). Be loud about it so models sized for pp
+        # sharding don't silently OOM.
+        pp_degree = 1
+        if hcg is not None:
+            try:
+                pp_degree = int(hcg.get_pipe_parallel_world_size())
+            except Exception:
+                pp_degree = 1
+        from .pipeline_schedule import StackedPipelineBlocks
+        if (pp_degree > 1 and isinstance(layers, PipelineLayer)
+                and not isinstance(layers, StackedPipelineBlocks)):
+            import warnings
+            warnings.warn(
+                "PipelineParallel over a pp>1 mesh with a heterogeneous "
+                "PipelineLayer: stages execute in one program and parameters "
+                "are replicated across the pp axis (no per-stage memory "
+                "saving). Use StackedPipelineBlocks for the compiled "
+                "scan+ppermute pipeline schedule with pp-sharded parameters.",
+                stacklevel=2)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

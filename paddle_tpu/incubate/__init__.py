@@ -1,1 +1,9 @@
-"""Placeholder — populated in a subsequent milestone."""
+"""paddle_tpu.incubate — experimental APIs (reference: python/paddle/incubate/).
+
+Populated: ``distributed.models.moe`` (MoELayer + gates + expert-parallel
+all-to-all). Fused-layer and autograd subpackages land with their
+subsystems.
+"""
+from . import distributed  # noqa: F401
+
+__all__ = ["distributed"]

@@ -1,0 +1,12 @@
+"""MoE / expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+from .moe_layer import ExpertLayer, MoELayer  # noqa: F401
+from .utils import _random_routing, count_by_gate, limit_by_capacity  # noqa: F401
+
+__all__ = [
+    "MoELayer", "ExpertLayer", "BaseGate", "NaiveGate", "GShardGate",
+    "SwitchGate", "ClipGradForMOEByGlobalNorm", "limit_by_capacity",
+    "count_by_gate",
+]

@@ -1,0 +1,47 @@
+"""reference: incubate/distributed/models/moe/gate/gshard_gate.py — top-2
+gate with GShard load-balance aux loss, capacity limiting and random
+proportional routing of the 2nd expert."""
+from __future__ import annotations
+
+import math
+
+from ...... import ops as _ops
+from ......nn import functional as F
+from ......ops import math as _math
+from ..utils import _random_routing, limit_by_capacity
+from .naive_gate import NaiveGate
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int,
+                 topk: int = 2, capacity=(1.2, 2.4),
+                 random_routing: bool = True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_expert, world_size)
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.group = group
+
+    def forward(self, x):
+        topk_val, topk_idx, gate_score = super().forward(
+            x, return_all_scores=True)
+        s = gate_score.shape[0]
+        # load-balance aux loss: fraction of tokens whose top-1 is expert e
+        # (c_e) × mean gate prob of e (m_e); mean over experts × E²
+        top1 = topk_idx[:, 0]
+        c_e = _math.mean(
+            F.one_hot(top1, self.tot_expert).astype("float32"), axis=0)
+        m_e = _math.mean(F.softmax(gate_score, axis=1), axis=0)
+        loss = _math.mean(_math.multiply(c_e, m_e)) * (self.num_expert ** 2)
+        self.set_loss(loss)
+
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * s)
+        _, _, topk_idx = limit_by_capacity(
+            topk_idx, self.num_expert, self.world_size, capacity,
+            group=self.group)
+
+        if self.random_routing and self.training:
+            rand_prob = _ops.random.rand([s], dtype="float32")
+            topk_idx = _random_routing(topk_idx, topk_val, rand_prob)
+        return topk_val, topk_idx

@@ -1,0 +1,23 @@
+"""reference: incubate/distributed/models/moe/gate/naive_gate.py — linear
+gate + top-k selection (no capacity limit, no aux loss)."""
+from __future__ import annotations
+
+from ......nn.layer.common import Linear
+from ......ops import manipulation as _manip
+from .base_gate import BaseGate
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores: bool = False):
+        gate = self.gate(inp)
+        gate_top_k_val, gate_top_k_idx = _manip.topk(
+            gate, k=self.top_k, axis=-1, largest=True, sorted=True)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx

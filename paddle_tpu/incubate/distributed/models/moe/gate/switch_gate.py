@@ -1,0 +1,51 @@
+"""reference: incubate/distributed/models/moe/gate/switch_gate.py — top-1
+switch-transformer gate: additive jitter noise (training), softmax scores,
+capacity limiting, and the switch load-balance loss
+Σ_e fraction_e · prob_e · E."""
+from __future__ import annotations
+
+import math
+
+from ...... import ops as _ops
+from ......nn import functional as F
+from ......ops import math as _math
+from ..utils import limit_by_capacity
+from .naive_gate import NaiveGate
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model: int, num_expert: int, world_size: int,
+                 topk: int = 1, switch_eps: float = 0.1,
+                 capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.group = group
+
+    def forward(self, inp):
+        score = self.gate(inp)
+        if self.training:
+            noise = _ops.random.rand(score.shape, dtype="float32")
+            noise = noise * (2 * self.switch_eps) + (1.0 - self.switch_eps)
+            score = score + noise
+        score = F.softmax(score, axis=-1)
+        top1_score, top1_idx = _ops.manipulation.topk(score, k=1, axis=-1)
+
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * inp.shape[0])
+        _, _, top1_idx = limit_by_capacity(
+            top1_idx, self.num_expert, self.world_size, capacity,
+            group=self.group)
+
+        # switch load-balance loss over kept assignments
+        kept = (top1_idx[:, 0] >= 0).astype("float32")
+        n_kept = _math.clip(_math.sum(kept), min=1.0)
+        frac = _math.sum(
+            F.one_hot(_math.clip(top1_idx[:, 0], min=0), self.tot_expert)
+            * kept[:, None], axis=0) / n_kept
+        prob = _math.sum(score, axis=0) / n_kept
+        loss = _math.sum(_math.multiply(frac, prob)) * self.tot_expert
+        self.set_loss(loss)
+
+        return top1_score, top1_idx

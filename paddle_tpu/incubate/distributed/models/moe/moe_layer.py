@@ -1,0 +1,279 @@
+"""MoE layer with expert parallelism, TPU-native.
+
+Reference parity: ``MoELayer``
+(python/paddle/incubate/distributed/models/moe/moe_layer.py:261) whose
+dispatch/combine are CUDA global_scatter/global_gather collectives
+(paddle/fluid/operators/collective/global_scatter_op.cu.cc) moving variable
+-length token buffers between ranks.
+
+TPU redesign: static-shape capacity dispatch. Each token's (expert, slot)
+position is computed by a one-hot cumsum; tokens gather into a dense
+[E, C, d] buffer (XLA gather — differentiable, sortless, SPMD-friendly) and
+expert outputs gather back per (token, k). Expert parallelism runs the whole
+dispatch inside ``shard_map`` over the moe mesh axis with
+``jax.lax.all_to_all`` standing in for global_scatter/global_gather — the
+collective rides ICI exactly like the reference's NCCL AllToAll rides
+NVLink/IB. Dropped tokens (over capacity, or gshard random routing) simply
+combine to a zero contribution, matching the reference's semantics.
+"""
+from __future__ import annotations
+
+import math as _pymath
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....nn import functional as F
+from .....nn.layer.common import Linear
+from .....nn.layer.container import LayerList
+from .....nn.layer_base import Layer
+from .....ops import manipulation as _manip
+from .....ops._apply import apply_op, ensure_tensor
+from .....tensor import Tensor
+from .....distributed.topology import get_mesh
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertLayer"]
+
+
+class ExpertLayer(Layer):
+    """Stackable two-Linear FFN expert (the reference docs' ExpertLayer
+    shape). Homogeneous ExpertLayer banks take the fused expert-parallel
+    path in MoELayer."""
+
+    def __init__(self, d_model: int, d_hidden: int, activation: str = "gelu",
+                 name=None, rank: int = 0, windex: int = 0,
+                 num_expert: int = 1):
+        super().__init__()
+        self.htoh4 = Linear(d_model, d_hidden)
+        self.h4toh = Linear(d_hidden, d_model)
+        self._activation = activation
+
+    def _act(self, x):
+        if self._activation is None or self._activation == "identity":
+            return x
+        return getattr(F, self._activation)(x)
+
+    def forward(self, x):
+        return self.h4toh(self._act(self.htoh4(x)))
+
+
+def _routing_plan(idx, tot_expert: int, capacity: int):
+    """idx [T, k] int (−1 dropped) → static-shape routing arrays:
+    gather_idx [E*C] (source token per slot), slot_valid [E*C],
+    tok_slot [T*k] (each assignment's slot), tok_valid [T*k]."""
+    T, k = idx.shape
+    flat = idx.reshape(-1).astype(jnp.int32)
+    valid = flat >= 0
+    safe = jnp.clip(flat, 0, tot_expert - 1)
+    oh = jnp.where(
+        valid[:, None],
+        (safe[:, None] == jnp.arange(tot_expert)[None, :]).astype(jnp.int32),
+        0)
+    pos = jnp.take_along_axis(jnp.cumsum(oh, axis=0), safe[:, None], 1)[:, 0] - 1
+    valid = valid & (pos < capacity)
+    n_slots = tot_expert * capacity
+    slot = jnp.where(valid, safe * capacity + pos, n_slots)  # overflow bin
+    token = jnp.arange(T * k, dtype=jnp.int32) // k
+    tfs = jnp.zeros(n_slots + 1, jnp.int32).at[slot].add(token + 1)
+    tfs = tfs[:n_slots]  # positions are unique per expert → no collisions
+    slot_valid = tfs > 0
+    gather_idx = jnp.maximum(tfs - 1, 0)
+    tok_slot = jnp.minimum(slot, n_slots - 1)
+    return gather_idx, slot_valid, tok_slot, valid
+
+
+class MoELayer(Layer):
+    """reference: moe_layer.py:261 — same constructor contract.
+
+    ``moe_group`` selects the expert-parallel mesh axis: an axis-group handle
+    (fleet ``get_data_parallel_group()``), an axis name string, or None
+    (single-program local experts). ``capacity_factor`` is the TPU-native
+    extra: per-expert capacity C = ceil(factor · T · k / E); None means
+    C = T (exact, nothing ever drops in the layer itself — gates may still
+    drop)."""
+
+    def __init__(self, d_model: int, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval: int = 0,
+                 recompute_ctx=None, capacity_factor: Optional[float] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.recompute_interval = recompute_interval
+        self.recompute_ctx = recompute_ctx
+        self.capacity_factor = capacity_factor
+
+        self._ep_axis = None
+        self.world_size = 1
+        mesh = get_mesh()
+        axis = None
+        if isinstance(moe_group, str):
+            axis = moe_group
+        elif moe_group is not None and hasattr(moe_group, "axis"):
+            axis = moe_group.axis
+        if axis and mesh is not None and axis in mesh.axis_names \
+                and mesh.shape[axis] > 1:
+            self._ep_axis = axis
+            self.world_size = mesh.shape[axis]
+        self._mesh_ref = mesh
+
+        if not isinstance(experts, LayerList):
+            experts = LayerList(experts)
+        self.experts = experts
+        self.num_expert = len(experts)
+        self.tot_expert = self.num_expert  # single program sees all experts
+
+        if gate is None:
+            gate = {}
+        if isinstance(gate, dict):
+            self.top_k = gate.get("top_k", 2)
+            kind = gate.get("type", "gshard")
+            if kind == "naive" or kind is None:
+                gate = NaiveGate(d_model, num_expert=self.num_expert,
+                                 world_size=1, topk=self.top_k)
+            elif kind == "gshard":
+                gate = GShardGate(d_model, num_expert=self.num_expert,
+                                  world_size=1, topk=self.top_k)
+            elif kind == "switch":
+                gate = SwitchGate(d_model, num_expert=self.num_expert,
+                                  world_size=1, topk=self.top_k)
+            else:
+                raise AssertionError(
+                    f"only naive/gshard/switch gates supported, got {kind}")
+        elif isinstance(gate, BaseGate):
+            self.top_k = gate.top_k
+        else:
+            raise TypeError("gate must be a dict or a moe.BaseGate instance")
+        self.gate = gate
+
+        self._stackable = all(isinstance(e, ExpertLayer) for e in experts) \
+            and len({e._activation for e in experts
+                     if isinstance(e, ExpertLayer)}) <= 1
+        if self._ep_axis and not self._stackable:
+            raise ValueError(
+                "expert-parallel MoELayer needs a homogeneous ExpertLayer "
+                "bank (stacked weights ride the mesh's expert axis); "
+                "heterogeneous experts run with moe_group=None")
+
+    # -------------------------------------------------------- local path
+    def _capacity(self, T: int) -> int:
+        if self.capacity_factor is None:
+            return T
+        return min(T, _pymath.ceil(
+            self.capacity_factor * T * self.top_k / self.tot_expert))
+
+    def _forward_local(self, x2d, value, idx, T):
+        E, C = self.tot_expert, self._capacity(T)
+        k = self.top_k
+
+        def plan(iv):
+            return _routing_plan(iv, E, C)
+
+        gi, sv, ts, tv = apply_op(plan, [Tensor(idx._value, stop_gradient=True)],
+                                  name="moe_routing_plan")
+        gi_t = Tensor(gi._value, stop_gradient=True)
+        ts_t = Tensor(ts._value, stop_gradient=True)
+
+        def dispatch(xv, g, valid):
+            return xv[g] * valid[:, None].astype(xv.dtype)
+
+        expert_in = apply_op(dispatch, [x2d, gi_t, sv], name="moe_dispatch")
+        expert_in = _manip.reshape(expert_in, [E, C, -1])
+
+        outs = [self.experts[e](expert_in[e]) for e in range(E)]
+        expert_out = _manip.stack(outs, axis=0)  # [E, C, d]
+
+        def combine(eo, slots, valid, val):
+            flat = eo.reshape(E * C, -1)
+            y = flat[slots] * valid[:, None].astype(flat.dtype)  # [T*k, d]
+            y = y.reshape(T, k, -1)
+            return jnp.sum(y * val[..., None].astype(y.dtype), axis=1)
+
+        return apply_op(combine, [expert_out, ts_t, tv, value],
+                        name="moe_combine")
+
+    # ------------------------------------------------- expert-parallel path
+    def _forward_ep(self, x2d, value, idx, T):
+        """Dispatch + all_to_all + stacked-expert FFN + all_to_all back,
+        inside shard_map over the moe axis (tokens and experts both sharded
+        on it). TPU-native global_scatter/global_gather."""
+        mesh, axis = self._mesh_ref, self._ep_axis
+        ep = self.world_size
+        E, k = self.tot_expert, self.top_k
+        if E % ep:
+            raise ValueError(f"num_expert {E} not divisible by ep degree {ep}")
+        if T % ep:
+            raise ValueError(f"token count {T} not divisible by ep degree {ep}")
+        T_l = T // ep
+        C = self._capacity(T_l)
+        E_l = E // ep
+        act = _ACTS[self.experts[0]._activation or "identity"]
+
+        params = []
+        for e in self.experts:
+            params += [e.htoh4.weight, e.htoh4.bias,
+                       e.h4toh.weight, e.h4toh.bias]
+
+        def fn(xv, val, iv, *flat_w):
+            w1 = jnp.stack(flat_w[0::4])   # [E, d, h]
+            b1 = jnp.stack(flat_w[1::4])   # [E, h]
+            w2 = jnp.stack(flat_w[2::4])   # [E, h, d]
+            b2 = jnp.stack(flat_w[3::4])   # [E, d]
+
+            def kernel(x_l, val_l, idx_l, w1_l, b1_l, w2_l, b2_l):
+                gi, sv, ts, tv = _routing_plan(idx_l, E, C)
+                ein = x_l[gi] * sv[:, None].astype(x_l.dtype)  # [E*C, d]
+                d = ein.shape[-1]
+                # global_scatter: route each expert's buffer to its owner
+                ein = ein.reshape(ep, E_l, C, d)
+                ein = jax.lax.all_to_all(ein, axis, split_axis=0,
+                                         concat_axis=0, tiled=False)
+                # [ep_src, E_l, C, d] → experts see tokens from every rank
+                ein = jnp.moveaxis(ein, 0, 1).reshape(E_l, ep * C, d)
+                h = jnp.einsum("etd,edh->eth", ein, w1_l) + b1_l[:, None]
+                h = act(h)
+                eo = jnp.einsum("eth,ehd->etd", h, w2_l) + b2_l[:, None]
+                # global_gather: route results back to token owners
+                eo = jnp.moveaxis(eo.reshape(E_l, ep, C, d), 1, 0)
+                eo = jax.lax.all_to_all(eo, axis, split_axis=0,
+                                        concat_axis=0, tiled=False)
+                flat = eo.reshape(E * C, d)
+                y = flat[ts] * tv[:, None].astype(flat.dtype)
+                y = y.reshape(T_l, k, d)
+                return jnp.sum(y * val_l[..., None].astype(y.dtype), axis=1)
+
+            return jax.shard_map(
+                kernel, mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis),
+                          P(axis), P(axis), P(axis), P(axis)),
+                out_specs=P(axis), check_vma=False,
+            )(xv, val, iv, w1, b1, w2, b2)
+
+        idx_in = Tensor(idx._value, stop_gradient=True)
+        return apply_op(fn, [x2d, value, idx_in] + params, name="moe_ep")
+
+    def forward(self, inp):
+        inp = ensure_tensor(inp)
+        if len(inp.shape) != 3:
+            raise ValueError("MoELayer input must be [batch, seq, d_model]")
+        B, S, d = inp.shape
+        x2d = _manip.reshape(inp, [-1, d])
+        T = B * S
+        value, idx = self.gate(x2d)
+        if self._ep_axis:
+            out = self._forward_ep(x2d, value, idx, T)
+        else:
+            out = self._forward_local(x2d, value, idx, T)
+        return _manip.reshape(out, [B, S, d])
+
+
+_ACTS = {
+    # matches nn.functional variants (gelu default approximate=False)
+    "identity": lambda x: x,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}

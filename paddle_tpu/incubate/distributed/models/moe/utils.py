@@ -1,0 +1,92 @@
+"""MoE routing utilities, static-shape TPU redesign.
+
+Reference parity: ``python/paddle/incubate/distributed/models/moe/utils.py``
+(``limit_by_capacity`` :74) and
+``python/paddle/distributed/models/moe/utils.py`` (``_random_routing`` :109).
+The reference backs these with CUDA ops (number_count, limit_by_capacity,
+random_routing); here they are static-shape XLA programs: capacity limiting
+is a one-hot cumsum (position-in-expert) + mask, which jits and shards
+cleanly (no dynamic shapes).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....ops._apply import apply_op, ensure_tensor
+from .....tensor import Tensor
+
+__all__ = ["limit_by_capacity", "count_by_gate", "_random_routing"]
+
+
+def _positions_in_expert(flat_idx, tot_expert):
+    """flat_idx [N] int (−1 = dropped) → (pos [N], one_hot [N, E] int32).
+    pos is each entry's 0-based arrival order within its expert."""
+    valid = (flat_idx >= 0)
+    safe = jnp.clip(flat_idx, 0, tot_expert - 1)
+    oh = jnp.where(valid[:, None],
+                   jnp.equal(safe[:, None],
+                             jnp.arange(tot_expert)[None, :]).astype(jnp.int32),
+                   0)
+    cum = jnp.cumsum(oh, axis=0)
+    pos = jnp.take_along_axis(cum, safe[:, None], axis=1)[:, 0] - 1
+    pos = jnp.where(valid, pos, -1)
+    return pos, oh
+
+
+def limit_by_capacity(topk_idx, num_expert, world_size, capacity, group=None):
+    """reference: moe/utils.py:74 — mark tokens routed beyond each expert's
+    capacity with −1. Returns (local_expert_count, global_expert_count,
+    new_topk_idx). Under single-controller SPMD the local/global counts
+    coincide (the program sees global state; per-rank counts are a
+    multi-process artifact of the NCCL design)."""
+    t = ensure_tensor(topk_idx)
+    tot = num_expert * world_size
+
+    def fn(idx):
+        shape = idx.shape
+        flat = idx.reshape(-1).astype(jnp.int32)
+        pos, oh = _positions_in_expert(flat, tot)
+        keep = (flat >= 0) & (pos < capacity)
+        new = jnp.where(keep, flat, -1)
+        counts = jnp.sum(
+            jnp.where(keep[:, None], oh, 0), axis=0).astype(jnp.int64)
+        return counts, counts, new.reshape(shape)
+
+    lec, gec, new_idx = apply_op(fn, [Tensor(t._value, stop_gradient=True)],
+                                 name="limit_by_capacity")
+    return lec, gec, new_idx
+
+
+def count_by_gate(gate_idx, num_expert, world_size, require_pos=True, group=None):
+    """reference: moe/utils.py count_by_gate — per-expert counts and each
+    token's position within its expert."""
+    t = ensure_tensor(gate_idx)
+    tot = num_expert * world_size
+
+    def fn(idx):
+        flat = idx.reshape(-1).astype(jnp.int32)
+        pos, oh = _positions_in_expert(flat, tot)
+        counts = jnp.sum(oh, axis=0).astype(jnp.int64)
+        return pos, counts, counts
+
+    pos, lec, gec = apply_op(fn, [Tensor(t._value, stop_gradient=True)],
+                             name="count_by_gate")
+    return pos, lec, gec
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """reference: distributed/models/moe/utils.py:109 — drop the 2nd expert
+    where 2·value₂ < prob (random proportional routing)."""
+    if topk != 2:
+        raise RuntimeError("only topk=2 is supported now")
+    it, vt, pt = ensure_tensor(topk_idx), ensure_tensor(topk_value), ensure_tensor(prob)
+
+    def fn(idx, val, p):
+        drop = (2.0 * val[:, 1]) < p
+        second = jnp.where(drop, -1, idx[:, 1])
+        return jnp.stack([idx[:, 0], second], axis=1)
+
+    return apply_op(fn, [Tensor(it._value, stop_gradient=True),
+                         Tensor(vt._value, stop_gradient=True),
+                         Tensor(pt._value, stop_gradient=True)],
+                    name="random_routing")

@@ -39,6 +39,14 @@ class RandomSampler(Sampler):
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
+        # reference: sampler.py rejects oversampling without replacement at
+        # construction — failing here keeps __len__ honest for DataLoader
+        # sizing instead of blowing up mid-epoch
+        if (not replacement and num_samples is not None
+                and generator is None and num_samples > len(data_source)):
+            raise ValueError(
+                "RandomSampler: num_samples should not exceed dataset "
+                "length when replacement=False")
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
@@ -59,13 +67,7 @@ class RandomSampler(Sampler):
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:
-            # num_samples may exceed n: concatenate fresh permutations so the
-            # yielded count always matches __len__
-            want = self.num_samples
-            while want > 0:
-                chunk = rng.permutation(n)[:want].tolist()
-                yield from chunk
-                want -= len(chunk)
+            yield from rng.permutation(n)[:self.num_samples].tolist()
 
     def __len__(self):
         return self.num_samples

@@ -1,24 +1,36 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention (forward AND backward) as Pallas TPU kernels.
 
 TPU-native replacement for the reference's flash-attn integration
-(paddle/phi/kernels/gpu/flash_attn_kernel.cu:213): online-softmax attention
-tiled over VMEM blocks so the [S, S] score matrix never materializes in HBM.
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:213 — fwd+bwd both registered):
+online-softmax attention tiled over VMEM blocks so the [S, S] score matrix
+never materializes in HBM, in either direction.
 
 Layout: paddle flash-attn layout [batch, seq, heads, head_dim] at the API
-boundary; internally [batch*heads, seq, head_dim] with a (bh, q_block,
-k_block) grid. The k loop is the innermost grid dim — TPU grids run
-sequentially, so VMEM scratch (acc, running max m, running sum l) carries
-across k steps (the standard TPU flash pattern).
+boundary; internally [batch*heads, seq, head_dim]. TPU grids run
+sequentially over the innermost dim, so VMEM scratch accumulators carry
+across that dim (the standard TPU flash pattern):
 
-Backward: jax.custom_vjp whose bwd recomputes attention with the pure-XLA
-reference math and differentiates it — numerically identical, keeps the
-Pallas fast path for inference/forward; a fused Pallas bwd can replace it
-without API change.
+- forward: grid (bh, nq, nk) — k innermost; carries (acc, running max m,
+  running sum l); emits O and the logsumexp LSE = m + log l (the residual
+  that makes a flash backward possible).
+- dq kernel: grid (bh, nq, nk) — k innermost; recomputes p from (q, k, LSE)
+  per block and accumulates dq = scale * Σ_j ds·k.
+- dkv kernel: grid (bh, nk, nq) — q innermost; accumulates
+  dv = Σ_i pᵀ·do and dk = scale * Σ_i dsᵀ·q.
+
+where ds = p ∘ (do·vᵀ − Δ) and Δ = rowsum(do ∘ o) is precomputed in XLA
+(elementwise — no [S,S]). LSE/Δ ride in [*, bq, 128]-lane-replicated blocks,
+the layout jax's own TPU kernels use for row statistics.
+
+Set PADDLE_TPU_PALLAS_INTERPRET=1 to run the kernels in pallas interpret
+mode (CPU) — used by the test suite to exercise the real kernel code paths
+without a TPU.
 """
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +46,11 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+LANES = 128
+
+
+def _interpret() -> bool:
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
 
 
 def _i32(x):
@@ -42,7 +59,16 @@ def _i32(x):
     return jnp.asarray(x, jnp.int32)
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _pick_block(seq: int, block: int) -> int:
+    if seq < block:
+        return min(block, max(128, 1 << (seq - 1).bit_length()))
+    return block
+
+
+# ───────────────────────────── forward ─────────────────────────────
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                  causal: bool, scale: float, block_q: int, block_k: int,
                  seq_q: int, seq_k: int):
     qi = pl.program_id(1)
@@ -84,18 +110,20 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        denom = jnp.maximum(l_ref[...][:, :1], jnp.float32(1e-30))
-        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        l_fin = jnp.maximum(l_ref[...], jnp.float32(1e-30))
+        o_ref[0] = (acc_ref[...] / l_fin[:, :1]).astype(o_ref.dtype)
+        # logsumexp residual for the flash backward
+        lse_ref[0] = m_ref[...] + jnp.log(l_fin)
 
 
 def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
-                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
-    """q,k,v: [BH, S, D] → out [BH, Sq, D]."""
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """q,k,v: [BH, S, D] → (out [BH, Sq, D], lse [BH, Sq] f32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, max(128, 1 << (sq - 1).bit_length()) if sq < block_q else block_q)
-    bq = min(bq, block_q)
-    bk = min(block_k, max(128, 1 << (sk - 1).bit_length()) if sk < block_k else block_k)
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
     pad_q = (-sq) % bq
     pad_k = (-sk) % bk
     qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
@@ -105,7 +133,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
     nk = kp.shape[1] // bk
 
     grid = (bh, nq, nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_attn_kernel, causal=causal, scale=scale,
                           block_q=bq, block_k=bk, seq_q=sq, seq_k=sk),
         grid=grid,
@@ -114,19 +142,190 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
-        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
         ],
+        interpret=_interpret(),
     )(qp, kp, vp)
-    return out[:, :sq]
+    return out[:, :sq], lse[:, :sq, 0]
+
+
+# ───────────────────────────── backward ─────────────────────────────
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+               dq_acc, *, causal: bool, scale: float, block_q: int,
+               block_k: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc[...])
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]   # [bq, 1]
+    dlt = dlt_ref[0][:, :1]   # [bq, 1]
+
+    s = jax.lax.dot_general(q * jnp.float32(scale), k,
+                            (((1,), (1,)), ((), ())))  # [bq, bk]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_k
+    if causal:
+        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+    ds = p * (dp - dlt)
+    dq_acc[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc[...] * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, causal: bool, scale: float,
+                block_q: int, block_k: int, seq_q: int, seq_k: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc[...])
+        dv_acc[...] = jnp.zeros_like(dv_acc[...])
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    dlt = dlt_ref[0][:, :1]
+
+    s = jax.lax.dot_general(q * jnp.float32(scale), k,
+                            (((1,), (1,)), ((), ())))  # [bq, bk]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    # padded q rows must not contribute to dk/dv sums
+    mask = (k_pos < seq_k) & (q_pos < seq_q)
+    if causal:
+        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+
+    # dv += pᵀ · do : contract the bq dim
+    dv_acc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+    ds = p * (dp - dlt)
+    dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[...] * jnp.float32(scale)).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """All [BH, S, D] (lse [BH, Sq]) → (dq, dk, dv)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    pad_q = (-sq) % bq
+    pad_k = (-sk) % bk
+
+    # Δ = rowsum(do ∘ o): pure elementwise+reduce, XLA fuses it — no [S,S]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, pad_q), (0, 0))) if pad_q else x
+
+    def padk(x):
+        return jnp.pad(x, ((0, 0), (0, pad_k), (0, 0))) if pad_k else x
+
+    qp, dop = padq(q), padq(do)
+    kp, vp = padk(k), padk(v)
+    # row statistics ride lane-replicated [BH, Sqp, 128] blocks
+    lse_b = jnp.broadcast_to(
+        (jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse)[..., None],
+        (bh, sq + pad_q, LANES))
+    dlt_b = jnp.broadcast_to(
+        (jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta)[..., None],
+        (bh, sq + pad_q, LANES))
+
+    nq = qp.shape[1] // bq
+    nk = kp.shape[1] // bk
+    kw = dict(causal=causal, scale=scale, block_q=bq, block_k=bk,
+              seq_q=sq, seq_k=sk)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
+        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lse_b, dlt_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kp.shape[1], d), k.dtype),
+            jax.ShapeDtypeStruct((bh, kp.shape[1], d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(kp, vp, qp, dop, lse_b, dlt_b)
+
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+# ───────────────────────────── public op ─────────────────────────────
 
 
 def _ref_attention_bshd(q, k, v, causal: bool, scale: float):
-    """Pure-XLA reference (same math), used for the backward pass."""
+    """Pure-XLA reference (same math), used off-TPU."""
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -140,33 +339,44 @@ def _ref_attention_bshd(q, k, v, causal: bool, scale: float):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _to_bh(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal: bool, scale: float):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-    of = _flash_fwd_bhsd(qf, kf, vf, causal, scale)
-    return jnp.swapaxes(of.reshape(b, h, sq, d), 1, 2)
+    o, _ = _fwd(q, k, v, causal, scale)
+    return o
 
 
 def _fwd(q, k, v, causal, scale):
-    return _flash_attention(q, k, v, causal, scale), (q, k, v)
+    b, sq, h, d = q.shape
+    of, lse = _flash_fwd_bhsd(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale)
+    o = _from_bh(of, b, h)
+    return o, (q, k, v, o, lse)
 
 
 def _bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _ref_attention_bshd(q_, k_, v_, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    b, sq, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bhsd(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
+        causal, scale)
+    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
 
 
 _flash_attention.defvjp(_fwd, _bwd)
 
 
 def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None):
-    """Flash attention, paddle layout [B, S, H, D]."""
+    """Flash attention, paddle layout [B, S, H, D]. Fwd and bwd are both
+    Pallas flash kernels (no [S,S] materialization in either direction)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PLTPU:

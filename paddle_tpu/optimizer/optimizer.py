@@ -247,8 +247,18 @@ class Optimizer:
         self._global_step += 1
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        """reference: optimizer.py:1391 — backward + step in one call."""
-        loss.backward()
+        """reference: optimizer.py:1391 — in dygraph the reference's
+        ``backward`` only *collects* grads already produced by a prior
+        ``loss.backward()`` call; it never re-runs autodiff. Matching that
+        contract here: callers must run ``loss.backward()`` first (the
+        documented pattern), otherwise we raise instead of silently
+        double-accumulating."""
+        if (self._parameter_list is not None
+                and not any(p.grad is not None for p in self._parameter_list)):
+            raise RuntimeError(
+                "Optimizer.minimize found no gradients: call loss.backward() "
+                "before minimize() (minimize only applies already-computed "
+                "grads, matching the reference dygraph contract)")
         self.step()
         return None, self._collect_params_grads()
 

@@ -245,6 +245,12 @@ def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float = 0.01,
 
     def fn(v, imgs):
         N, C, H, W = v.shape
+        # iou-aware head layout (phi yolo_box_util.h GetEntryIndex/GetIoUIndex):
+        # first na channels are per-anchor IoU logits, then the usual
+        # na×(5+class_num) blocks; conf = obj^(1-f) * iou^f
+        if iou_aware:
+            iou = jax.nn.sigmoid(v[:, :na].reshape(N, na, H, W))
+            v = v[:, na:]
         v = v.reshape(N, na, -1, H, W)
         box_attr = v.shape[2]
         gx = (jnp.arange(W) + 0.5)[None, None, None, :]
@@ -258,6 +264,9 @@ def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float = 0.01,
         bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] / input_w
         bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] / input_h
         conf = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            f = jnp.asarray(iou_aware_factor, v.dtype)
+            conf = conf ** (1.0 - f) * iou ** f
         cls = jax.nn.sigmoid(v[:, :, 5:5 + class_num]) * conf[:, :, None]
         imh = imgs[:, 0].astype(v.dtype)[:, None, None, None]
         imw = imgs[:, 1].astype(v.dtype)[:, None, None, None]

@@ -1,0 +1,104 @@
+"""Pallas flash-attention fwd+bwd vs the XLA reference, in interpret mode.
+
+Reference parity: phi flash_attn fwd+bwd kernels
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:213,302 and
+flash_attn_grad_kernel). The Pallas kernels run in interpret mode on CPU so
+the real kernel code paths (block indexing, masks, lse math) are tested
+without a TPU; VERDICT.md weak #3 required the bwd to stop materializing
+[S,S] — asserted here on the compiled jaxpr.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 256), (100, 100)])
+def test_forward_matches_reference(causal, sq, sk):
+    b, h, d = 2, 2, 64
+    q = _rand((b, sq, h, d), 0)
+    k = _rand((b, sk, h, d), 1)
+    v = _rand((b, sk, h, d), 2)
+    scale = 1.0 / np.sqrt(d)
+    out = fa._flash_attention(q, k, v, causal, scale)
+    ref = fa._ref_attention_bshd(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq", [128, 256, 100])
+def test_backward_matches_reference(causal, sq):
+    b, h, d = 2, 2, 64
+    q = _rand((b, sq, h, d), 3)
+    k = _rand((b, sq, h, d), 4)
+    v = _rand((b, sq, h, d), 5)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa._flash_attention(q, k, v, causal, scale) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(fa._ref_attention_bshd(q, k, v, causal, scale) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch (causal={causal}, sq={sq})")
+
+
+def test_cross_attention_backward():
+    b, h, d, sq, sk = 1, 2, 64, 128, 256
+    q = _rand((b, sq, h, d), 6)
+    k = _rand((b, sk, h, d), 7)
+    v = _rand((b, sk, h, d), 8)
+    scale = 1.0 / np.sqrt(d)
+    g_flash = jax.grad(
+        lambda q, k, v: jnp.sum(fa._flash_attention(q, k, v, True, scale)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(fa._ref_attention_bshd(q, k, v, True, scale)),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_backward_jaxpr_has_no_SxS_intermediate():
+    """The grad jaxpr must contain no [S,S]-shaped dense intermediates
+    outside the pallas kernels (VERDICT weak #3: bwd used to re-run
+    full-softmax XLA math materializing [S,S] per head)."""
+    b, h, d, s = 1, 1, 64, 512
+    q = _rand((b, s, h, d), 9)
+    k = _rand((b, s, h, d), 10)
+    v = _rand((b, s, h, d), 11)
+
+    jaxpr = jax.make_jaxpr(
+        jax.grad(lambda q, k, v: jnp.sum(
+            fa._flash_attention(q, k, v, True, 0.125))),
+    )(q, k, v)
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            continue  # kernel-internal blocks are VMEM-tiled by construction
+        for var in eqn.outvars:
+            shape = getattr(var.aval, "shape", ())
+            assert not (len(shape) >= 2 and shape[-1] == s
+                        and shape[-2] == s), (
+                f"[S,S] intermediate {shape} from {eqn.primitive.name}")

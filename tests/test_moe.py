@@ -1,0 +1,179 @@
+"""MoE layer + gates + expert parallelism.
+
+Reference parity: MoELayer
+(python/paddle/incubate/distributed/models/moe/moe_layer.py:261), gates
+(moe/gate/*.py), limit_by_capacity (moe/utils.py:74), grad clip
+(moe/grad_clip.py:23). VERDICT.md missing #2: 8-CPU-device test matching a
+dense/ungated reference on tiny configs, all three gates.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.incubate.distributed.models.moe import (
+    ClipGradForMOEByGlobalNorm, ExpertLayer, GShardGate, MoELayer, NaiveGate,
+    SwitchGate, limit_by_capacity)
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.tensor import Tensor
+
+D_MODEL, D_HIDDEN, E = 8, 16, 4
+
+
+def _experts(n=E, activation="gelu", seed=0):
+    paddle.seed(seed)
+    return LayerList([ExpertLayer(D_MODEL, D_HIDDEN, activation=activation)
+                      for _ in range(n)])
+
+
+def _input(B=2, S=8, seed=1):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(
+        rng.standard_normal((B, S, D_MODEL)).astype("float32"))
+
+
+def test_limit_by_capacity_marks_overflow():
+    idx = paddle.to_tensor(np.array([[0], [0], [0], [1], [0], [1]]))
+    lec, gec, new = limit_by_capacity(idx, num_expert=2, world_size=1,
+                                      capacity=2)
+    new_np = np.asarray(new.numpy())
+    # expert 0 arrives at rows 0,1,2,4 → rows 2 and 4 overflow capacity 2
+    assert new_np.tolist() == [[0], [0], [-1], [1], [-1], [1]]
+    assert np.asarray(lec.numpy()).tolist() == [2, 2]
+
+
+def test_identical_experts_match_dense_reference():
+    """With every expert holding the SAME weights, MoE(x) must equal
+    (Σ_k val_k) · expert(x) — the dense/ungated twin."""
+    experts = _experts(seed=3)
+    sd = experts[0].state_dict()
+    for e in experts:
+        e.set_state_dict(sd)
+    moe = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 2})
+    x = _input()
+    out = moe(x)
+
+    x2d = x.reshape([-1, D_MODEL])
+    val, _ = moe.gate(x2d)
+    dense = experts[0](x2d)
+    expected = (np.asarray(val.numpy()).sum(-1, keepdims=True)
+                * np.asarray(dense.numpy()))
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1, D_MODEL),
+                               expected, atol=1e-5, rtol=1e-5)
+
+
+def test_forced_routing_selects_right_expert():
+    """Bias the gate so every token picks expert 2: output must equal
+    val·experts[2](x)."""
+    experts = _experts(seed=4)
+    moe = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 1})
+    with paddle.no_grad():
+        w = np.zeros((D_MODEL, E), dtype="float32")
+        b = np.zeros((E,), dtype="float32")
+        b[2] = 10.0
+        moe.gate.gate.weight._set_value(paddle.to_tensor(w)._value)
+        moe.gate.gate.bias._set_value(paddle.to_tensor(b)._value)
+    x = _input(seed=5)
+    out = moe(x)
+    x2d = x.reshape([-1, D_MODEL])
+    expected = 10.0 * np.asarray(experts[2](x2d).numpy())
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1, D_MODEL),
+                               expected, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("gate_type", ["naive", "gshard", "switch"])
+def test_all_gates_train(gate_type):
+    """Forward+backward through each gate type; grads reach gate and
+    experts; aux loss (gshard/switch) joins the graph."""
+    experts = _experts(seed=6)
+    top_k = 1 if gate_type == "switch" else 2
+    moe = MoELayer(D_MODEL, experts, gate={"type": gate_type, "top_k": top_k})
+    x = _input(seed=7)
+    out = moe(x)
+    loss = out.pow(2).mean()
+    if moe.gate.has_loss:
+        loss = loss + 0.01 * moe.gate.get_loss()
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+    assert moe.gate.gate.weight.grad is not None
+    got_expert_grad = any(
+        e.htoh4.weight.grad is not None
+        and np.abs(np.asarray(e.htoh4.weight.grad.numpy())).sum() > 0
+        for e in experts)
+    assert got_expert_grad
+
+
+def test_gshard_eval_deterministic():
+    experts = _experts(seed=8)
+    moe = MoELayer(D_MODEL, experts, gate={"type": "gshard", "top_k": 2})
+    moe.eval()
+    x = _input(seed=9)
+    a = np.asarray(moe(x).numpy())
+    b = np.asarray(moe(x).numpy())
+    np.testing.assert_array_equal(a, b)
+
+
+def test_capacity_drops_scale_output():
+    """capacity_factor small enough to drop tokens → dropped tokens combine
+    to zero contribution (reference global_scatter semantics)."""
+    experts = _experts(seed=10)
+    moe = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 1},
+                   capacity_factor=0.25)
+    with paddle.no_grad():
+        w = np.zeros((D_MODEL, E), dtype="float32")
+        b = np.zeros((E,), dtype="float32")
+        b[0] = 10.0  # everyone wants expert 0 → capacity overflow
+        moe.gate.gate.weight._set_value(paddle.to_tensor(w)._value)
+        moe.gate.gate.bias._set_value(paddle.to_tensor(b)._value)
+    x = _input(B=1, S=16, seed=11)
+    out = np.asarray(moe(x).numpy()).reshape(-1, D_MODEL)
+    T = 16
+    cap = max(1, int(np.ceil(0.25 * T * 1 / E)))
+    zero_rows = np.sum(np.all(np.abs(out) < 1e-12, axis=1))
+    assert zero_rows == T - cap, f"{zero_rows} zero rows, want {T - cap}"
+
+
+def test_expert_parallel_matches_local():
+    """8-CPU-device expert-parallel path (shard_map + all_to_all over 'dp')
+    must reproduce the single-program local path bit-for-bit-ish."""
+    fleet.fleet._is_initialized = False
+    dist.set_mesh(None)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    moe_group = hcg.get_data_parallel_group()
+
+    experts = _experts(n=8, seed=12)
+    x = _input(B=4, S=16, seed=13)
+
+    moe_local = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 2})
+    ref = np.asarray(moe_local(x).numpy())
+
+    moe_ep = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 2},
+                      moe_group=moe_group)
+    moe_ep.gate = moe_local.gate  # same gate weights
+    assert moe_ep._ep_axis == "dp"
+    out = np.asarray(moe_ep(x).numpy())
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    # backward through the ep path
+    loss = moe_ep(x).pow(2).mean()
+    loss.backward()
+    assert experts[0].htoh4.weight.grad is not None
+    dist.set_mesh(None)
+    fleet.fleet._is_initialized = False
+
+
+def test_moe_grad_clip():
+    experts = _experts(seed=14)
+    moe = MoELayer(D_MODEL, experts, gate={"type": "naive", "top_k": 2})
+    x = _input(seed=15)
+    (moe(x).pow(2).sum() * 100).backward()
+    pg = [(p, p.grad) for p in moe.parameters()]
+    clip = ClipGradForMOEByGlobalNorm(clip_norm=1.0)
+    clipped = clip(pg)
+    total = sum(np.sum(np.asarray(g.numpy()).astype("float64") ** 2)
+                for _, g in clipped if g is not None)
+    assert np.sqrt(total) <= 1.0 + 1e-4

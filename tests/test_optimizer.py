@@ -175,6 +175,11 @@ def test_minimize():
     _, x, y = _linear_problem()
     loss = ((model(pt.to_tensor(x)) - pt.to_tensor(y)) ** 2).mean()
     before = float(loss.numpy())
+    # reference dygraph contract: minimize collects grads from a prior
+    # loss.backward(); calling it without one raises (ADVICE.md round 1)
+    with pytest.raises(RuntimeError):
+        opt.minimize(loss)
+    loss.backward()
     opt.minimize(loss)
     loss2 = ((model(pt.to_tensor(x)) - pt.to_tensor(y)) ** 2).mean()
     assert float(loss2.numpy()) < before
