@@ -37,6 +37,8 @@ from . import jit  # noqa: E402
 from . import amp  # noqa: E402
 from . import distributed  # noqa: E402
 from . import metric  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402
 from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import device  # noqa: E402

@@ -7,6 +7,7 @@ jax.sharding.Mesh with the hybrid axes [dp, pp, sharding, sep, mp]
 inside shard_map for manual comm (collective.py), and fleet/* parallel layers
 annotated for the mesh.
 """
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,

@@ -24,6 +24,7 @@ from .mp_layers import (  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pipeline_schedule import StackedPipelineBlocks, pipeline_apply  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
 
 __all__ = [
     "init", "fleet", "Fleet", "DistributedStrategy", "distributed_model",
@@ -31,6 +32,7 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
     "PipelineParallel", "StackedPipelineBlocks", "pipeline_apply",
+    "recompute", "recompute_sequential",
     "worker_index", "worker_num",
 ]
 
@@ -141,6 +143,15 @@ class Fleet:
         sharding annotations."""
         if not self._is_initialized:
             raise RuntimeError("call fleet.init() first")
+        hc = getattr(self._strategy, "hybrid_configs", {}) if self._strategy else {}
+        acc = int(hc.get("accumulate_steps", 1))
+        # models that own a compiled pipeline schedule (StackedPipelineBlocks)
+        # take the microbatch count from their config — wire the strategy's
+        # accumulate_steps through (VERDICT: config previously carried inertly)
+        cfg = getattr(model, "config", None)
+        if acc > 1 and cfg is not None and hasattr(cfg, "pp_num_microbatches") \
+                and cfg.pp_num_microbatches is None:
+            cfg.pp_num_microbatches = acc
         if isinstance(model, PipelineLayer):
             return PipelineParallel(model, hcg=self._hcg, strategy=self._strategy)
         return DataParallel(model)

@@ -31,9 +31,12 @@ class PipelineParallel(Layer):
         self._layers = layers
         self._hcg = hcg
         micro = 1
+        self.micro_batch_size = None
         if strategy is not None:
             hc = getattr(strategy, "hybrid_configs", {})
             micro = int(hc.get("accumulate_steps", 1))
+            mbs = int(hc.get("micro_batch_size", 1))
+            self.micro_batch_size = mbs if mbs > 1 else None
         self.accumulate_steps = max(micro, 1)
         self._loss_fn = getattr(layers, "_loss_fn", None)
         # Heterogeneous PipelineLayer models run all stages in one program —
@@ -78,6 +81,15 @@ class PipelineParallel(Layer):
             raise RuntimeError(
                 "train_batch needs the PipelineLayer to be built with loss_fn")
         n = self.accumulate_steps
+        if n == 1 and self.micro_batch_size:
+            # reference semantics: accumulate_steps defaults to
+            # batch / micro_batch_size when only the latter is configured
+            B = ensure_tensor(data[0]).shape[0]
+            if B % self.micro_batch_size:
+                raise ValueError(
+                    f"batch {B} not divisible by micro_batch_size "
+                    f"{self.micro_batch_size}")
+            n = B // self.micro_batch_size
         total = None
         for xb, yb in self._split_micro(data, n):
             out = self._layers(xb)

@@ -1,0 +1,134 @@
+"""Activation recompute (gradient checkpointing) as a user API.
+
+Reference parity: ``recompute``
+(python/paddle/distributed/fleet/recompute/recompute.py:332 — PyLayer that
+stashes inputs + RNG state and re-runs the forward inside backward) and
+``recompute_sequential`` (:456 — chunk an nn.Sequential into segments).
+
+TPU-native: the re-run is ``jax.checkpoint`` (remat). The segment's Layer
+forward is functionalized by temporarily binding parameter cells to traced
+values (the StackedPipelineBlocks pattern, pipeline_schedule.py:96) so
+gradients flow to the real Parameters through the tape; XLA then
+rematerializes the segment's activations inside the backward instead of
+keeping them live — same memory profile as the reference, but scheduled by
+the compiler rather than a hand-written PyLayer. RNG: keys drawn during the
+functionalized forward become trace constants, so the checkpoint replay sees
+identical randomness (the reference's preserve_rng_state dance is free
+here).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, List, Sequence
+
+import jax
+
+from ...autograd import no_grad
+from ...nn.layer_base import Layer
+from ...ops._apply import apply_op, ensure_tensor
+from ...tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _find_layers(function) -> List[Layer]:
+    """Parameters must be explicit tape inputs for grads to reach them —
+    discover the Layers a callable closes over."""
+    if isinstance(function, Layer):
+        return [function]
+    layers: List[Layer] = []
+    if inspect.ismethod(function) and isinstance(function.__self__, Layer):
+        layers.append(function.__self__)
+    if isinstance(function, functools.partial):
+        for a in list(function.args) + list(function.keywords.values()):
+            if isinstance(a, Layer):
+                layers.append(a)
+        layers.extend(_find_layers(function.func))
+    closure = getattr(function, "__closure__", None) or ()
+    for cell in closure:
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            layers.append(v)
+        elif isinstance(v, (list, tuple)):
+            layers.extend(x for x in v if isinstance(x, Layer))
+    return layers
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, params: Sequence = None, **kwargs):
+    """reference: recompute.py:332 — run ``function(*args)`` WITHOUT keeping
+    its intermediate activations; they are recomputed during backward.
+
+    ``function``: a Layer, a bound method of a Layer, or a closure over
+    Layers (auto-discovered); pass ``params=`` explicitly for anything more
+    exotic. ``preserve_rng_state``/``use_reentrant`` are accepted for API
+    parity (both behaviors are inherent here — see module docstring).
+    """
+    if params is None:
+        layers = _find_layers(function)
+        cells = []
+        seen = set()
+        for l in layers:
+            for p in l.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    cells.append(p)
+    else:
+        cells = list(params)
+
+    arg_tensors = [ensure_tensor(a) for a in args]
+    n_args = len(arg_tensors)
+
+    def pure(*vals):
+        arg_vals = vals[:n_args]
+        param_vals = vals[n_args:]
+        old = [c._value for c in cells]
+        for c, v in zip(cells, param_vals):
+            c._value = v
+        try:
+            with no_grad():
+                out = function(
+                    *[Tensor(v, stop_gradient=True) for v in arg_vals],
+                    **kwargs)
+        finally:
+            for c, o in zip(cells, old):
+                c._value = o
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return apply_op(jax.checkpoint(pure), arg_tensors + cells,
+                    name="recompute")
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """reference: recompute.py:456 — split a Sequential (or list of layers)
+    into ``ctx['segments']`` chunks and recompute each chunk."""
+    segments = int((ctx or {}).get("segments", 1))
+    if isinstance(functions, Layer):
+        sublayers = [l for _, l in functions.named_children()] or [functions]
+    else:
+        sublayers = list(functions)
+    n = len(sublayers)
+    seg_size = max(1, (n + segments - 1) // segments)
+
+    def run_chunk(chunk):
+        def f(x):
+            for l in chunk:
+                x = l(x)
+            return x
+        return f
+
+    out = args[0] if len(args) == 1 else args
+    for s in range(0, n, seg_size):
+        chunk = sublayers[s:s + seg_size]
+        params = [p for l in chunk for p in l.parameters()]
+        if isinstance(out, tuple):
+            out = recompute(run_chunk(chunk), *out, params=params, **kwargs)
+        else:
+            out = recompute(run_chunk(chunk), out, params=params, **kwargs)
+    return out

@@ -3,8 +3,8 @@
 reference: python/paddle/framework/io.py:646,888 — pickled nested state dicts.
 Tensors are converted to host numpy arrays on save and restored as Tensors on
 load. Sharded/async checkpointing for distributed jobs lives in
-paddle_tpu.distributed.checkpoint (Orbax-backed); this is the single-host
-paddle-compatible format.
+paddle_tpu.distributed.checkpoint (per-shard files + manifest, reshard on
+load); this is the single-host paddle-compatible format.
 """
 from __future__ import annotations
 

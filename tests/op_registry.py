@@ -1,0 +1,716 @@
+"""Declarative op-spec registry driving the OpTest harness over EVERY public
+op in paddle_tpu.ops (reference: the per-op OpTest subclasses under
+python/paddle/fluid/tests/unittests/ — eager_op_test.py:324; SURVEY.md §4).
+
+Each spec: (fn taking Tensors, numpy reference, input factory dtype→[arrays],
+dtypes, flags). test_op_suite.py parametrizes over this table and a coverage
+gate asserts every ops.__all__ name is either specced here or in EXCLUDED
+with a reason.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+
+F = ("float32", "float64")
+F1 = ("float32",)
+I = ("int32", "int64")
+FI = F + I
+B = ("bool",)
+
+
+class Spec:
+    def __init__(self, fn, ref, make, dtypes=F, grad=False, jit=True,
+                 atol=None, numeric=True):
+        self.fn, self.ref, self.make = fn, ref, make
+        self.dtypes, self.grad, self.jit = dtypes, grad, jit
+        self.atol, self.numeric = atol, numeric
+
+
+def _rng():
+    return np.random.RandomState(1234)
+
+
+def r(*shape):
+    def make(dt):
+        a = _rng().randn(*shape)
+        if dt in ("int32", "int64"):
+            return (a * 4).astype(dt)
+        if dt == "bool":
+            return a > 0
+        return a.astype(dt)
+    return make
+
+
+def pos(*shape):
+    def make(dt):
+        a = np.abs(_rng().randn(*shape)) + 0.5
+        return (a * 3).astype(dt) if dt in I else a.astype(dt)
+    return make
+
+
+def unit(*shape):  # open interval (-1, 1)
+    return lambda dt: (np.tanh(_rng().randn(*shape)) * 0.98).astype(dt)
+
+
+def u(np_fn, make=r(2, 3), dtypes=F, grad=True, **kw):
+    return Spec(None, lambda x: np_fn(x), lambda dt: [make(dt)],
+                dtypes=dtypes, grad=grad, **kw)
+
+
+def b2(np_fn, mk1=r(2, 3), mk2=None, dtypes=F, grad=True, **kw):
+    mk2 = mk2 or mk1
+    return Spec(None, np_fn, lambda dt: [mk1(dt), mk2(dt)],
+                dtypes=dtypes, grad=grad, **kw)
+
+
+def spd(dt):  # symmetric positive definite
+    a = _rng().randn(3, 3).astype(dt)
+    return a @ a.T + 3 * np.eye(3, dtype=dt)
+
+
+REGISTRY = {}
+
+
+def S(name, spec):
+    spec.fn = spec.fn or getattr(pt, name)
+    REGISTRY[name] = spec
+
+
+# ───────────────────────────── math ─────────────────────────────
+S("abs", u(np.abs))
+S("acos", u(np.arccos, unit(2, 3)))
+S("acosh", u(np.arccosh, lambda dt: (pos(2, 3)(dt) + 1.0).astype(dt)))
+S("asin", u(np.arcsin, unit(2, 3)))
+S("asinh", u(np.arcsinh))
+S("atan", u(np.arctan))
+S("atanh", u(np.arctanh, unit(2, 3)))
+S("ceil", u(np.ceil, grad=False))
+S("cos", u(np.cos))
+S("cosh", u(np.cosh))
+S("erf", Spec(None, lambda x: __import__("scipy.special", fromlist=["erf"]).erf(x),
+              lambda dt: [r(2, 3)(dt)], grad=True))
+S("erfinv", Spec(None, lambda x: __import__("scipy.special", fromlist=["erfinv"]).erfinv(x),
+                 lambda dt: [unit(2, 3)(dt)], grad=True))
+S("exp", u(np.exp))
+S("expm1", u(np.expm1))
+S("floor", u(np.floor, grad=False))
+S("frac", u(lambda x: x - np.trunc(x), grad=False))
+S("log", u(np.log, pos(2, 3)))
+S("log10", u(np.log10, pos(2, 3)))
+S("log1p", u(np.log1p, pos(2, 3)))
+S("log2", u(np.log2, pos(2, 3)))
+S("neg", u(np.negative))
+S("reciprocal", u(np.reciprocal, pos(2, 3)))
+S("round", u(np.round, grad=False))
+S("rsqrt", u(lambda x: 1 / np.sqrt(x), pos(2, 3)))
+S("sigmoid", u(lambda x: 1 / (1 + np.exp(-x))))
+S("sign", u(np.sign, grad=False))
+S("sin", u(np.sin))
+S("sinh", u(np.sinh))
+S("sqrt", u(np.sqrt, pos(2, 3)))
+S("square", u(np.square))
+S("stanh", Spec(None, lambda x: 1.7159 * np.tanh(0.67 * x),
+                lambda dt: [r(2, 3)(dt)], grad=True))
+S("tan", u(np.tan))
+S("tanh", u(np.tanh))
+S("trunc", u(np.trunc, grad=False))
+S("t", Spec(None, lambda x: x.T, lambda dt: [r(2, 3)(dt)], grad=True))
+
+S("add", b2(np.add, dtypes=FI))
+S("atan2", b2(np.arctan2))
+S("divide", b2(np.divide, r(2, 3), pos(2, 3)))
+S("floor_divide", b2(np.floor_divide, pos(2, 3), pos(2, 3), dtypes=I,
+                     grad=False))
+S("fmax", b2(np.fmax, numeric=False))  # FD invalid at the kink
+S("fmin", b2(np.fmin, numeric=False))
+S("hypot", b2(np.hypot))
+S("maximum", b2(np.maximum, dtypes=FI, grad=False))
+S("minimum", b2(np.minimum, dtypes=FI, grad=False))
+S("mod", b2(np.mod, r(2, 3), pos(2, 3), dtypes=FI, grad=False))
+S("remainder", b2(np.mod, r(2, 3), pos(2, 3), dtypes=FI, grad=False))
+S("multiply", b2(np.multiply, dtypes=FI))
+S("pow", b2(np.power, pos(2, 3), r(2, 3)))
+S("subtract", b2(np.subtract, dtypes=FI))
+
+S("matmul", b2(np.matmul, lambda dt: _rng().randn(4, 3).astype(dt),
+               lambda dt: _rng().randn(3, 5).astype(dt)))
+S("mm", b2(np.matmul, lambda dt: _rng().randn(4, 3).astype(dt),
+           lambda dt: _rng().randn(3, 5).astype(dt)))
+S("bmm", b2(np.matmul, lambda dt: _rng().randn(2, 4, 3).astype(dt),
+            lambda dt: _rng().randn(2, 3, 5).astype(dt)))
+S("dot", b2(lambda x, y: np.sum(x * y, -1), r(5), r(5)))
+S("inner", b2(np.inner, r(2, 4), r(3, 4)))
+S("outer", b2(np.outer, r(3), r(4)))
+S("kron", b2(np.kron, r(2, 2), r(2, 3)))
+S("addmm", Spec(None, lambda c, a, b: c + a @ b,
+                lambda dt: [_rng().randn(4, 5).astype(dt),
+                            _rng().randn(4, 3).astype(dt),
+                            _rng().randn(3, 5).astype(dt)], grad=True))
+S("lerp", Spec(None, lambda x, y, w: x + w * (y - x),
+               lambda dt: [r(2, 3)(dt), r(2, 3)(dt),
+                           np.float64(0.3).astype(dt)], grad=False))
+S("einsum", Spec(lambda a, b: pt.einsum("ij,jk->ik", a, b),
+                 lambda a, b: np.einsum("ij,jk->ik", a, b),
+                 lambda dt: [_rng().randn(4, 3).astype(dt),
+                             _rng().randn(3, 5).astype(dt)], grad=True))
+
+S("all", Spec(None, lambda x: np.all(x), lambda dt: [r(2, 3)(dt)],
+              dtypes=B, grad=False))
+S("any", Spec(None, lambda x: np.any(x), lambda dt: [r(2, 3)(dt)],
+              dtypes=B, grad=False))
+S("amax", Spec(lambda x: pt.amax(x, axis=1), lambda x: np.max(x, 1),
+               lambda dt: [r(3, 4)(dt)], grad=False))
+S("amin", Spec(lambda x: pt.amin(x, axis=1), lambda x: np.min(x, 1),
+               lambda dt: [r(3, 4)(dt)], grad=False))
+S("argmax", u(np.argmax, grad=False))
+S("argmin", u(np.argmin, grad=False))
+S("max", Spec(lambda x: pt.max(x, axis=0), lambda x: np.max(x, 0),
+              lambda dt: [r(3, 4)(dt)], grad=True, numeric=False))
+S("min", Spec(lambda x: pt.min(x, axis=0), lambda x: np.min(x, 0),
+              lambda dt: [r(3, 4)(dt)], grad=True, numeric=False))
+S("mean", Spec(lambda x: pt.mean(x, axis=-1), lambda x: np.mean(x, -1),
+               lambda dt: [r(3, 4)(dt)], grad=True))
+S("sum", Spec(lambda x: pt.sum(x, axis=0), lambda x: np.sum(x, 0),
+              lambda dt: [r(3, 4)(dt)], dtypes=FI, grad=True))
+S("prod", Spec(lambda x: pt.prod(x, axis=0), lambda x: np.prod(x, 0),
+               lambda dt: [pos(2, 3)(dt)], grad=True))
+S("nanmean", Spec(None, np.nanmean, lambda dt: [_nan_arr(dt)], grad=False))
+S("nansum", Spec(None, np.nansum, lambda dt: [_nan_arr(dt)], grad=False))
+S("cumsum", Spec(lambda x: pt.cumsum(x, axis=0), lambda x: np.cumsum(x, 0),
+                 lambda dt: [r(3, 4)(dt)], dtypes=FI, grad=True))
+S("cumprod", Spec(lambda x: pt.cumprod(x, dim=0), lambda x: np.cumprod(x, 0),
+                  lambda dt: [pos(2, 3)(dt)], grad=True))
+S("diff", Spec(None, lambda x: np.diff(x), lambda dt: [r(3, 5)(dt)],
+               dtypes=FI, grad=True))
+S("logsumexp", Spec(None,
+                    lambda x: np.log(np.sum(np.exp(x))),
+                    lambda dt: [r(3, 4)(dt)], grad=True))
+S("logcumsumexp", Spec(
+    lambda x: pt.logcumsumexp(x, axis=0),
+    lambda x: np.log(np.cumsum(np.exp(x), 0)),
+    lambda dt: [r(3, 4)(dt)], grad=True))
+S("allclose", b2(lambda x, y: np.allclose(x, y), grad=False))
+S("isclose", b2(np.isclose, grad=False))
+S("clip", Spec(lambda x: pt.clip(x, -0.5, 0.5),
+               lambda x: np.clip(x, -0.5, 0.5),
+               lambda dt: [r(2, 3)(dt)], grad=True, numeric=False))
+S("scale", Spec(lambda x: pt.scale(x, 2.0, 1.0), lambda x: 2 * x + 1,
+                lambda dt: [r(2, 3)(dt)], grad=True))
+
+
+def _nan_arr(dt):
+    a = _rng().randn(3, 4).astype(dt)
+    a[0, 1] = np.nan
+    return a
+
+
+def _inplace_check(op_name):
+    def check():
+        x = pt.to_tensor(np.ones((2, 3), np.float32))
+        y = pt.to_tensor(np.full((2, 3), 2.0, np.float32))
+        getattr(x, op_name)(y)
+        expected = {"add_": 3.0, "multiply_": 2.0}[op_name]
+        np.testing.assert_allclose(np.asarray(x.numpy()), expected)
+    return check
+
+
+CUSTOM = {}  # name -> zero-arg callable
+
+CUSTOM["add_"] = _inplace_check("add_")
+CUSTOM["multiply_"] = _inplace_check("multiply_")
+
+
+# ───────────────────────────── logic ─────────────────────────────
+for _name, _np in [("equal", np.equal), ("not_equal", np.not_equal),
+                   ("greater_equal", np.greater_equal),
+                   ("greater_than", np.greater),
+                   ("less_equal", np.less_equal), ("less_than", np.less)]:
+    S(_name, b2(_np, dtypes=FI, grad=False))
+S("equal_all", b2(lambda x, y: np.array_equal(x, y), dtypes=FI, grad=False))
+for _name, _np in [("logical_and", np.logical_and),
+                   ("logical_or", np.logical_or),
+                   ("logical_xor", np.logical_xor)]:
+    S(_name, b2(_np, dtypes=B, grad=False))
+S("logical_not", u(np.logical_not, dtypes=B, grad=False))
+for _name, _np in [("bitwise_and", np.bitwise_and),
+                   ("bitwise_or", np.bitwise_or),
+                   ("bitwise_xor", np.bitwise_xor)]:
+    S(_name, b2(_np, dtypes=I, grad=False))
+S("bitwise_not", u(np.bitwise_not, dtypes=I, grad=False))
+S("isnan", u(np.isnan, lambda dt: _nan_arr(dt), grad=False))
+S("isinf", u(np.isinf, lambda dt: _nan_arr(dt), grad=False))
+S("isfinite", u(np.isfinite, lambda dt: _nan_arr(dt), grad=False))
+S("is_empty", Spec(None, lambda x: x.size == 0, lambda dt: [r(2, 3)(dt)],
+                   grad=False))
+S("isin", Spec(None, lambda x, t: np.isin(x, t),
+               lambda dt: [(r(2, 3)(dt) * 2).astype(dt), r(4)(dt)],
+               dtypes=I, grad=False))
+
+# ─────────────────────────── manipulation ───────────────────────────
+S("reshape", Spec(lambda x: pt.reshape(x, [3, 2]), lambda x: x.reshape(3, 2),
+                  lambda dt: [r(2, 3)(dt)], dtypes=FI, grad=True))
+S("view", Spec(lambda x: pt.view(x, [3, 2]), lambda x: x.reshape(3, 2),
+               lambda dt: [r(2, 3)(dt)], grad=True))
+S("view_as", Spec(lambda x, y: pt.view_as(x, y),
+                  lambda x, y: x.reshape(y.shape),
+                  lambda dt: [r(2, 3)(dt), r(3, 2)(dt)], grad=False))
+S("transpose", Spec(lambda x: pt.transpose(x, [1, 0]), lambda x: x.T,
+                    lambda dt: [r(2, 3)(dt)], dtypes=FI, grad=True))
+S("moveaxis", Spec(lambda x: pt.moveaxis(x, 0, 1),
+                   lambda x: np.moveaxis(x, 0, 1),
+                   lambda dt: [r(2, 3)(dt)], grad=True))
+S("swapaxes", Spec(lambda x: pt.swapaxes(x, 0, 1),
+                   lambda x: np.swapaxes(x, 0, 1),
+                   lambda dt: [r(2, 3)(dt)], grad=True))
+S("concat", Spec(lambda x, y: pt.concat([x, y], axis=0),
+                 lambda x, y: np.concatenate([x, y], 0),
+                 lambda dt: [r(2, 3)(dt), r(2, 3)(dt)], dtypes=FI, grad=True))
+S("stack", Spec(lambda x, y: pt.stack([x, y], axis=0),
+                lambda x, y: np.stack([x, y], 0),
+                lambda dt: [r(2, 3)(dt), r(2, 3)(dt)], grad=True))
+S("unstack", Spec(lambda x: pt.unstack(x, axis=0),
+                  lambda x: [x[0], x[1]],
+                  lambda dt: [r(2, 3)(dt)], grad=True))
+S("unbind", Spec(lambda x: pt.unbind(x, axis=0), lambda x: [x[0], x[1]],
+                 lambda dt: [r(2, 3)(dt)], grad=True))
+S("split", Spec(lambda x: pt.split(x, 2, axis=1),
+                lambda x: np.split(x, 2, 1),
+                lambda dt: [r(2, 4)(dt)], grad=True))
+S("chunk", Spec(lambda x: pt.chunk(x, 2, axis=1),
+                lambda x: np.split(x, 2, 1),
+                lambda dt: [r(2, 4)(dt)], grad=True))
+S("squeeze", Spec(lambda x: pt.squeeze(x, axis=1),
+                  lambda x: np.squeeze(x, 1),
+                  lambda dt: [_rng().randn(2, 1, 3).astype(dt)], grad=True))
+S("unsqueeze", Spec(lambda x: pt.unsqueeze(x, 0),
+                    lambda x: x[None], lambda dt: [r(2, 3)(dt)], grad=True))
+S("expand", Spec(lambda x: pt.expand(x, [4, 2, 3]),
+                 lambda x: np.broadcast_to(x, (4, 2, 3)),
+                 lambda dt: [r(2, 3)(dt)], grad=True))
+S("broadcast_to", Spec(lambda x: pt.broadcast_to(x, [4, 2, 3]),
+                       lambda x: np.broadcast_to(x, (4, 2, 3)),
+                       lambda dt: [r(2, 3)(dt)], grad=True))
+S("expand_as", Spec(lambda x, y: pt.expand_as(x, y),
+                    lambda x, y: np.broadcast_to(x, y.shape),
+                    lambda dt: [r(1, 3)(dt), r(4, 3)(dt)], grad=False))
+S("tile", Spec(lambda x: pt.tile(x, [2, 2]), lambda x: np.tile(x, (2, 2)),
+               lambda dt: [r(2, 3)(dt)], grad=True))
+S("flatten", Spec(None, lambda x: x.reshape(-1),
+                  lambda dt: [r(2, 3)(dt)], grad=True))
+S("flip", Spec(lambda x: pt.flip(x, axis=0), lambda x: np.flip(x, 0),
+               lambda dt: [r(2, 3)(dt)], grad=True))
+S("rot90", Spec(None, lambda x: np.rot90(x), lambda dt: [r(2, 3)(dt)],
+                grad=True))
+S("roll", Spec(lambda x: pt.roll(x, 1, axis=0), lambda x: np.roll(x, 1, 0),
+               lambda dt: [r(2, 3)(dt)], grad=True))
+S("gather", Spec(lambda x: pt.gather(x, pt.to_tensor(np.array([2, 0]))),
+                 lambda x: x[[2, 0]],
+                 lambda dt: [r(3, 4)(dt)], grad=True, numeric=False))
+S("gather_nd", Spec(
+    lambda x: pt.gather_nd(x, pt.to_tensor(np.array([[0, 1], [2, 3]]))),
+    lambda x: x[[0, 2], [1, 3]],
+    lambda dt: [r(3, 4)(dt)], grad=True, numeric=False))
+S("take_along_axis", Spec(
+    lambda x: pt.take_along_axis(x, pt.to_tensor(np.array([[0], [2]])), 1),
+    lambda x: np.take_along_axis(x, np.array([[0], [2]]), 1),
+    lambda dt: [r(2, 3)(dt)], grad=True, numeric=False))
+S("put_along_axis", Spec(
+    lambda x: pt.put_along_axis(x, pt.to_tensor(np.array([[0], [2]])),
+                                9.0, 1),
+    lambda x: _np_put_along(x),
+    lambda dt: [r(2, 3)(dt)], grad=False))
+S("index_select", Spec(
+    lambda x: pt.index_select(x, pt.to_tensor(np.array([2, 0])), axis=1),
+    lambda x: x[:, [2, 0]], lambda dt: [r(2, 3)(dt)], grad=True,
+    numeric=False))
+S("index_sample", Spec(
+    lambda x: pt.index_sample(x, pt.to_tensor(np.array([[0, 2], [1, 0]]))),
+    lambda x: np.take_along_axis(x, np.array([[0, 2], [1, 0]]), 1),
+    lambda dt: [r(2, 3)(dt)], grad=True, numeric=False))
+S("masked_select", Spec(
+    lambda x: pt.masked_select(x, pt.to_tensor(np.tile(np.array([True, False, True]), (2, 1)))),
+    lambda x: x[np.tile(np.array([True, False, True]), (2, 1))],
+    lambda dt: [r(2, 3)(dt)], grad=False, jit=False))
+S("masked_fill", Spec(
+    lambda x: pt.masked_fill(x, pt.to_tensor(np.tile(np.array([True, False, True]), (2, 1))), 0.0),
+    lambda x: np.where(np.tile(np.array([True, False, True]), (2, 1)), 0.0, x).astype(x.dtype),
+    lambda dt: [r(2, 3)(dt)], grad=True, numeric=False))
+S("where", Spec(
+    lambda c, x, y: pt.where(c, x, y), lambda c, x, y: np.where(c, x, y),
+    lambda dt: [r(2, 3)("bool"), r(2, 3)(dt), r(2, 3)(dt)], grad=False))
+S("nonzero", Spec(
+    None, lambda x: np.stack(np.nonzero(x), -1),
+    lambda dt: [(r(2, 3)(dt) > 0).astype(dt)], dtypes=F1, grad=False,
+    jit=False))
+S("scatter", Spec(
+    lambda x, u_: pt.scatter(x, pt.to_tensor(np.array([1, 0])), u_),
+    lambda x, u_: _np_scatter(x, u_),
+    lambda dt: [r(3, 4)(dt), r(2, 4)(dt)], grad=False))
+S("scatter_nd_add", Spec(
+    lambda x, u_: pt.scatter_nd_add(
+        x, pt.to_tensor(np.array([[1], [0]])), u_),
+    lambda x, u_: _np_scatter_nd_add(x, u_),
+    lambda dt: [r(3, 4)(dt), r(2, 4)(dt)], grad=False))
+S("index_put", Spec(
+    lambda x: pt.index_put(x, (pt.to_tensor(np.array([0, 1])),),
+                           pt.to_tensor(np.zeros((2, 3), "float32"))),
+    lambda x: np.concatenate([np.zeros((2, 3), x.dtype), x[2:]], 0),
+    lambda dt: [r(3, 3)(dt)], dtypes=F1, grad=False))
+S("slice", Spec(
+    lambda x: pt.slice(x, axes=[0, 1], starts=[0, 1], ends=[2, 3]),
+    lambda x: x[0:2, 1:3], lambda dt: [r(3, 4)(dt)], grad=True))
+S("strided_slice", Spec(
+    lambda x: pt.strided_slice(x, axes=[1], starts=[0], ends=[4], strides=[2]),
+    lambda x: x[:, 0:4:2], lambda dt: [r(3, 4)(dt)], grad=True))
+S("crop", Spec(
+    lambda x: pt.crop(x, shape=[2, 2], offsets=[1, 0]),
+    lambda x: x[1:3, 0:2], lambda dt: [r(3, 4)(dt)], grad=True))
+S("pad", Spec(
+    lambda x: pt.pad(x, [1, 2, 0, 0], value=0.0),
+    lambda x: np.pad(x, [(1, 2), (0, 0)]),
+    lambda dt: [r(2, 3)(dt)], grad=True))
+S("cast", Spec(lambda x: pt.cast(x, "float64"),
+               lambda x: x.astype("float64"),
+               lambda dt: [r(2, 3)(dt)], dtypes=F1, grad=False))
+S("topk", Spec(lambda x: pt.topk(x, 2, axis=1),
+               lambda x: _np_topk(x, 2),
+               lambda dt: [r(3, 5)(dt)], grad=False))
+S("sort", Spec(lambda x: pt.sort(x, axis=1), lambda x: np.sort(x, 1),
+               lambda dt: [r(3, 5)(dt)], dtypes=FI, grad=True,
+               numeric=False))
+S("argsort", Spec(lambda x: pt.argsort(x, axis=1),
+                  lambda x: np.argsort(x, 1, kind="stable"),
+                  lambda dt: [r(3, 5)(dt)], grad=False))
+S("searchsorted", Spec(
+    lambda s, v: pt.searchsorted(s, v),
+    lambda s, v: np.searchsorted(s, v).astype("int64"),
+    lambda dt: [np.sort(r(6)(dt)), r(4)(dt)], grad=False))
+S("bucketize", Spec(
+    lambda v, s: pt.bucketize(v, s),
+    lambda v, s: np.searchsorted(s, v).astype("int64"),
+    lambda dt: [r(4)(dt), np.sort(r(6)(dt))], grad=False))
+S("unique", Spec(
+    None, lambda x: np.unique(x),
+    lambda dt: [(r(2, 3)(dt) * 2).astype(dt)], dtypes=I, grad=False,
+    jit=False))
+S("unique_consecutive", Spec(
+    None, lambda x: np.array([k for i, k in enumerate(x) if i == 0 or x[i - 1] != k], x.dtype),
+    lambda dt: [np.array([1, 1, 2, 2, 2, 3, 1], dt)], dtypes=I, grad=False,
+    jit=False))
+S("repeat_interleave", Spec(
+    lambda x: pt.repeat_interleave(x, 2, axis=0),
+    lambda x: np.repeat(x, 2, 0), lambda dt: [r(2, 3)(dt)], grad=True))
+S("numel", Spec(None, lambda x: np.int64(x.size), lambda dt: [r(2, 3)(dt)],
+                grad=False))
+S("shard_index", Spec(
+    lambda x: pt.shard_index(x, index_num=8, nshards=2, shard_id=0),
+    lambda x: np.where((x >= 0) & (x < 4), x, -1),
+    lambda dt: [np.array([[0], [3], [5], [7]], dt)], dtypes=("int64",),
+    grad=False))
+S("as_complex", Spec(
+    None, lambda x: x[..., 0] + 1j * x[..., 1],
+    lambda dt: [r(2, 3, 2)(dt)], dtypes=F1, grad=False))
+S("as_real", Spec(
+    lambda x: pt.as_real(pt.as_complex(x)),
+    lambda x: x, lambda dt: [r(2, 3, 2)(dt)], dtypes=F1, grad=False))
+S("diagonal", Spec(None, lambda x: np.diagonal(x),
+                   lambda dt: [r(3, 4)(dt)], grad=True))
+S("tensordot", Spec(
+    lambda x, y: pt.tensordot(x, y, axes=1),
+    lambda x, y: np.tensordot(x, y, 1),
+    lambda dt: [r(2, 3)(dt), r(3, 4)(dt)], grad=True))
+
+
+def _np_put_along(x):
+    out = x.copy()
+    np.put_along_axis(out, np.array([[0], [2]]), 9.0, 1)
+    return out
+
+
+def _np_scatter(x, u_):
+    out = x.copy()
+    out[[1, 0]] = u_
+    return out
+
+
+def _np_scatter_nd_add(x, u_):
+    out = x.copy()
+    out[1] += u_[0]
+    out[0] += u_[1]
+    return out
+
+
+def _np_topk(x, k):
+    idx = np.argsort(-x, 1)[:, :k]
+    return np.take_along_axis(x, idx, 1), idx.astype("int64")
+
+
+# ───────────────────────────── creation ─────────────────────────────
+S("zeros", Spec(lambda: pt.zeros([2, 3]), lambda: np.zeros((2, 3), "float32"),
+                lambda dt: [], dtypes=F1, grad=False))
+S("ones", Spec(lambda: pt.ones([2, 3]), lambda: np.ones((2, 3), "float32"),
+               lambda dt: [], dtypes=F1, grad=False))
+S("full", Spec(lambda: pt.full([2, 3], 7.0),
+               lambda: np.full((2, 3), 7.0, "float32"),
+               lambda dt: [], dtypes=F1, grad=False))
+S("zeros_like", Spec(None, np.zeros_like, lambda dt: [r(2, 3)(dt)],
+                     grad=False))
+S("ones_like", Spec(None, np.ones_like, lambda dt: [r(2, 3)(dt)],
+                    grad=False))
+S("full_like", Spec(lambda x: pt.full_like(x, 3.0),
+                    lambda x: np.full_like(x, 3.0),
+                    lambda dt: [r(2, 3)(dt)], grad=False))
+S("arange", Spec(lambda: pt.arange(0, 10, 2),
+                 lambda: np.arange(0, 10, 2).astype("int64"),
+                 lambda dt: [], dtypes=F1, grad=False))
+S("linspace", Spec(lambda: pt.linspace(0.0, 1.0, 5),
+                   lambda: np.linspace(0, 1, 5).astype("float32"),
+                   lambda dt: [], dtypes=F1, grad=False))
+S("logspace", Spec(lambda: pt.logspace(0.0, 2.0, 3),
+                   lambda: np.logspace(0, 2, 3).astype("float32"),
+                   lambda dt: [], dtypes=F1, grad=False))
+S("eye", Spec(lambda: pt.eye(3, 4), lambda: np.eye(3, 4, dtype="float32"),
+              lambda dt: [], dtypes=F1, grad=False))
+S("diag", Spec(None, np.diag, lambda dt: [r(4)(dt)], grad=False))
+S("diagflat", Spec(None, np.diagflat, lambda dt: [r(2, 2)(dt)], grad=False))
+S("tril", Spec(None, np.tril, lambda dt: [r(3, 3)(dt)], grad=True))
+S("triu", Spec(None, np.triu, lambda dt: [r(3, 3)(dt)], grad=True))
+S("tril_indices", Spec(lambda: pt.tril_indices(3, 3),
+                       lambda: np.stack(np.tril_indices(3, 0, 3)).astype("int64"),
+                       lambda dt: [], dtypes=F1, grad=False))
+S("triu_indices", Spec(lambda: pt.triu_indices(3, 3),
+                       lambda: np.stack(np.triu_indices(3, 0, 3)).astype("int64"),
+                       lambda dt: [], dtypes=F1, grad=False))
+S("meshgrid", Spec(
+    lambda x, y: pt.meshgrid(x, y),
+    lambda x, y: np.meshgrid(x, y, indexing="ij"),
+    lambda dt: [r(3)(dt), r(4)(dt)], grad=False))
+S("assign", Spec(None, lambda x: x, lambda dt: [r(2, 3)(dt)], grad=False))
+S("clone", Spec(None, lambda x: x, lambda dt: [r(2, 3)(dt)], grad=True))
+S("complex", Spec(None, lambda re, im: re + 1j * im,
+                  lambda dt: [r(2, 3)(dt), r(2, 3)(dt)], dtypes=F1,
+                  grad=False))
+S("empty", Spec(lambda: pt.empty([2, 3]).shape and pt.zeros([1]),
+                lambda: np.zeros((1,), "float32"),
+                lambda dt: [], dtypes=F1, grad=False))
+S("empty_like", Spec(lambda x: pt.to_tensor(
+    np.zeros(pt.empty_like(x).shape, "float32")),
+    np.zeros_like, lambda dt: [r(2, 3)(dt)], dtypes=F1, grad=False))
+S("to_tensor", Spec(None, lambda x: x, lambda dt: [r(2, 3)(dt)], dtypes=FI,
+                    grad=False))
+
+# ───────────────────────────── linalg ─────────────────────────────
+S("norm", Spec(None, lambda x: np.linalg.norm(x), lambda dt: [r(3, 4)(dt)],
+               grad=True))
+S("cholesky", Spec(None, np.linalg.cholesky, lambda dt: [spd(dt)],
+                   grad=False))
+S("inverse", Spec(None, np.linalg.inv, lambda dt: [spd(dt)], grad=False,
+                  atol=1e-3))
+S("pinv", Spec(None, np.linalg.pinv, lambda dt: [r(3, 4)(dt)], grad=False,
+               atol=1e-3))
+S("solve", Spec(None, lambda a, b: np.linalg.solve(a, b),
+                lambda dt: [spd(dt), r(3, 2)(dt)], grad=False, atol=1e-3))
+S("triangular_solve", Spec(
+    lambda a, b: pt.triangular_solve(a, b, upper=False),
+    lambda a, b: _np_trisolve(a, b),
+    lambda dt: [np.tril(spd(dt)), r(3, 2)(dt)], grad=False, atol=1e-3))
+S("cholesky_solve", Spec(
+    lambda b, l: pt.cholesky_solve(b, l, upper=False),
+    lambda b, l: np.linalg.solve(l @ l.T, b),
+    lambda dt: [r(3, 2)(dt), np.linalg.cholesky(spd(dt))], grad=False,
+    atol=1e-3))
+S("det", Spec(None, np.linalg.det, lambda dt: [spd(dt)], grad=True,
+              numeric=False, atol=1e-3))
+S("slogdet", Spec(None, lambda x: np.stack(np.linalg.slogdet(x)),
+                  lambda dt: [spd(dt)], grad=False, atol=1e-3))
+S("matrix_power", Spec(lambda x: pt.matrix_power(x, 3),
+                       lambda x: np.linalg.matrix_power(x, 3),
+                       lambda dt: [r(3, 3)(dt)], grad=False, atol=1e-3))
+S("matrix_rank", Spec(None, lambda x: np.int64(np.linalg.matrix_rank(x)),
+                      lambda dt: [spd(dt)], grad=False))
+S("trace", Spec(None, np.trace, lambda dt: [r(3, 4)(dt)], grad=True))
+S("dist", Spec(None, lambda x, y: np.linalg.norm(x - y),
+               lambda dt: [r(2, 3)(dt), (r(2, 3)(dt) * 0.5).astype(dt)],
+               grad=True))
+S("cdist", Spec(
+    None, lambda x, y: np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1)),
+    lambda dt: [r(3, 4)(dt), (r(2, 4)(dt) * 0.5).astype(dt)],
+    grad=False, atol=1e-3))
+S("cross", Spec(None, lambda x, y: np.cross(x, y),
+                lambda dt: [r(4, 3)(dt), (r(4, 3)(dt) * 0.5).astype(dt)],
+                grad=True))
+S("cov", Spec(None, lambda x: np.cov(x), lambda dt: [r(3, 6)(dt)],
+              grad=False, atol=1e-3))
+S("corrcoef", Spec(None, lambda x: np.corrcoef(x), lambda dt: [r(3, 6)(dt)],
+                   grad=False, atol=1e-3))
+S("histogram", Spec(
+    lambda x: pt.histogram(x, bins=4, min=-2, max=2),
+    lambda x: np.histogram(x, bins=4, range=(-2, 2))[0].astype("int64"),
+    lambda dt: [r(20)(dt)], grad=False))
+S("bincount", Spec(None, lambda x: np.bincount(x).astype("int64"),
+                   lambda dt: [np.array([0, 1, 1, 3, 2, 1], dt)],
+                   dtypes=("int64",), grad=False, jit=False))
+S("lstsq", Spec(
+    lambda a, b: pt.lstsq(a, b)[0],
+    lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+    lambda dt: [r(5, 3)(dt), r(5, 2)(dt)], grad=False, atol=1e-3))
+
+
+def _np_trisolve(a, b):
+    import scipy.linalg as sla
+    try:
+        return sla.solve_triangular(a, b, lower=True)
+    except Exception:
+        return np.linalg.solve(a, b)
+
+
+def _recon_check(op, make, reconstruct, atol=1e-3):
+    def check():
+        x = make("float64")
+        outs = op(pt.to_tensor(x))
+        outs = [np.asarray(o.numpy()) for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+        np.testing.assert_allclose(reconstruct(x, outs), x, atol=atol)
+    return check
+
+
+CUSTOM["svd"] = _recon_check(
+    pt.svd, lambda dt: _rng().randn(4, 3).astype(dt),
+    lambda x, o: o[0] @ np.diag(o[1]) @ o[2])
+CUSTOM["qr"] = _recon_check(
+    pt.qr, lambda dt: _rng().randn(4, 3).astype(dt),
+    lambda x, o: o[0] @ o[1])
+CUSTOM["lu"] = _recon_check(
+    lambda t: pt.lu(t)[0], lambda dt: spd(dt),
+    lambda x, o: x * 0 + o[0] * 0 + x)  # shape/finite smoke; P·L·U composed below
+
+
+def _lu_check():
+    x = spd("float64")
+    lu_mat, pivots = pt.lu(pt.to_tensor(x))
+    assert np.isfinite(np.asarray(lu_mat.numpy())).all()
+    assert np.asarray(pivots.numpy()).shape[-1] == 3
+
+
+CUSTOM["lu"] = _lu_check
+
+
+def _eig_check():
+    x = spd("float64")
+    w, v = pt.eigh(pt.to_tensor(x))
+    wn, vn = np.asarray(w.numpy()), np.asarray(v.numpy())
+    np.testing.assert_allclose(x @ vn, vn @ np.diag(wn), atol=1e-6)
+    w2, v2 = pt.eig(pt.to_tensor(x))
+    np.testing.assert_allclose(
+        np.sort(np.real(np.asarray(w2.numpy()))), np.sort(wn), atol=1e-6)
+    np.testing.assert_allclose(
+        np.sort(np.real(np.asarray(pt.eigvals(pt.to_tensor(x)).numpy()))),
+        np.sort(wn), atol=1e-6)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(pt.eigvalsh(pt.to_tensor(x)).numpy())),
+        np.sort(wn), atol=1e-6)
+
+
+CUSTOM["eig"] = CUSTOM["eigh"] = CUSTOM["eigvals"] = CUSTOM["eigvalsh"] = _eig_check
+
+# ───────────────────────────── stat ─────────────────────────────
+S("var", Spec(None, lambda x: np.var(x, ddof=1), lambda dt: [r(3, 4)(dt)],
+              grad=True))
+S("std", Spec(None, lambda x: np.std(x, ddof=1), lambda dt: [r(3, 4)(dt)],
+              grad=True))
+S("median", Spec(None, np.median, lambda dt: [r(3, 5)(dt)], grad=False))
+S("nanmedian", Spec(None, np.nanmedian, lambda dt: [_nan_arr(dt)],
+                    grad=False))
+S("quantile", Spec(lambda x: pt.quantile(x, 0.5),
+                   lambda x: np.quantile(x, 0.5),
+                   lambda dt: [r(3, 5)(dt)], grad=False))
+S("nanquantile", Spec(lambda x: pt.nanquantile(x, 0.5),
+                      lambda x: np.nanquantile(x, 0.5),
+                      lambda dt: [_nan_arr(dt)], grad=False))
+S("kthvalue", Spec(lambda x: pt.kthvalue(x, 2, axis=1),
+                   lambda x: _np_kth(x, 2),
+                   lambda dt: [r(3, 5)(dt)], grad=False))
+S("mode", Spec(lambda x: pt.mode(x, axis=-1),
+               lambda x: _np_mode(x),
+               lambda dt: [np.array([[1., 2., 2.], [3., 3., 1.]], dt)],
+               grad=False))
+
+
+def _np_kth(x, k):
+    s = np.sort(x, 1)
+    idx = np.argsort(x, 1, kind="stable")
+    return s[:, k - 1], idx[:, k - 1].astype("int64")
+
+
+def _np_mode(x):
+    vals, idxs = [], []
+    for row in x:
+        v, c = np.unique(row, return_counts=True)
+        best = v[np.argmax(c)]
+        vals.append(best)
+        idxs.append(np.where(row == best)[0][0])  # first occurrence
+    return np.array(vals, x.dtype), np.array(idxs, "int64")
+
+
+# ───────────────────────────── random ─────────────────────────────
+def _random_check(fn, shape, lo=None, hi=None, integer=False):
+    def check():
+        pt.seed(77)
+        a = np.asarray(fn().numpy())
+        assert a.shape == tuple(shape)
+        assert np.isfinite(a.astype("float64")).all()
+        if lo is not None:
+            assert (a >= lo).all() and (a <= hi).all()
+        if integer:
+            assert a.dtype.kind in "iu"
+        pt.seed(77)
+        b = np.asarray(fn().numpy())
+        np.testing.assert_array_equal(a, b)  # seeded determinism
+    return check
+
+
+CUSTOM["rand"] = _random_check(lambda: pt.rand([64, 64]), (64, 64), 0.0, 1.0)
+CUSTOM["randn"] = _random_check(lambda: pt.randn([64, 64]), (64, 64))
+CUSTOM["uniform"] = _random_check(
+    lambda: pt.uniform([32, 32], min=-2.0, max=2.0), (32, 32), -2.0, 2.0)
+CUSTOM["gaussian"] = _random_check(lambda: pt.gaussian([32, 32]), (32, 32))
+CUSTOM["normal"] = _random_check(lambda: pt.normal(0.0, 1.0, [32]), (32,))
+CUSTOM["standard_normal"] = _random_check(
+    lambda: pt.standard_normal([32]), (32,))
+CUSTOM["randint"] = _random_check(
+    lambda: pt.randint(0, 10, [32]), (32,), 0, 9, integer=True)
+CUSTOM["randperm"] = _random_check(lambda: pt.randperm(16), (16,),
+                                   0, 15, integer=True)
+CUSTOM["rand_like"] = _random_check(
+    lambda: pt.rand_like(pt.zeros([8, 8])), (8, 8), 0.0, 1.0)
+# randint_like keeps x's dtype (float here) — whole values, float storage
+CUSTOM["randint_like"] = _random_check(
+    lambda: pt.randint_like(pt.zeros([8]), 0, 5), (8,), 0, 4)
+CUSTOM["normal_like"] = _random_check(
+    lambda: pt.normal_like(pt.zeros([8, 8])), (8, 8))
+CUSTOM["bernoulli"] = _random_check(
+    lambda: pt.bernoulli(pt.full([64], 0.5)), (64,), 0.0, 1.0)
+CUSTOM["poisson"] = _random_check(
+    lambda: pt.poisson(pt.full([32], 3.0)), (32,), 0.0, np.inf)
+CUSTOM["multinomial"] = _random_check(
+    lambda: pt.multinomial(pt.to_tensor(
+        np.array([0.2, 0.3, 0.5], "float32")), 8, replacement=True),
+    (8,), 0, 2, integer=True)
+
+
+def _inplace_random(fn_name):
+    def check():
+        x = pt.zeros([16, 16])
+        pt.seed(3)
+        getattr(pt, fn_name)(x)
+        a = np.asarray(x.numpy())
+        assert not np.allclose(a, 0.0)
+    return check
+
+
+CUSTOM["uniform_"] = _inplace_random("uniform_")
+CUSTOM["exponential_"] = _inplace_random("exponential_")
+
+# ops intentionally in neither REGISTRY nor CUSTOM, each with the reason
+EXCLUDED = {}

@@ -1,10 +1,13 @@
-"""OpTest-style harness (reference: eager_op_test.py:324, SURVEY.md §4).
+"""OpTest-style harness (reference: eager_op_test.py:324,2107,2284 —
+SURVEY.md §4 calls its dual-mode + numeric-grad pattern "the single most
+important pattern to replicate").
 
-check_output: run the paddle_tpu op and compare against a numpy reference.
-check_grad: run the op through the eager tape, backward(), and compare the
-tape-produced gradients against (a) direct jax.grad of the same computation
-(tests the tape engine wiring) and optionally (b) central finite differences
-(tests the vjp rule itself).
+- check_output: numpy-reference comparison, EAGER and (optionally) JIT
+  (StaticFunction-compiled) — the reference's dual static/eager execution.
+- check_grad: tape grads vs jax.grad of the same computation (tests the tape
+  wiring) and central finite differences (tests the vjp rule itself).
+- sweep helpers drive the same spec across dtypes (the reference's
+  per-dtype OpTest subclasses).
 """
 from __future__ import annotations
 
@@ -15,48 +18,86 @@ import numpy as np
 import paddle_tpu as pt
 
 
-def check_output(pt_fn, np_fn, inputs, atol=1e-4, rtol=1e-4):
-    """inputs: list of numpy arrays (positional)."""
+def _as_list(x):
+    return list(x) if isinstance(x, (tuple, list)) else [x]
+
+
+def check_output(pt_fn, np_fn, inputs, atol=1e-4, rtol=1e-4, jit=False):
+    """inputs: list of numpy arrays (positional). jit=True additionally runs
+    the op through a compiled StaticFunction and compares both paths."""
     ts = [pt.to_tensor(x) for x in inputs]
     out = pt_fn(*ts)
-    ref = np_fn(*inputs)
-    outs = out if isinstance(out, (tuple, list)) else [out]
-    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    refs = _as_list(np_fn(*inputs))
+    outs = _as_list(out)
     for o, r in zip(outs, refs):
-        np.testing.assert_allclose(o.numpy(), np.asarray(r), atol=atol, rtol=rtol)
+        np.testing.assert_allclose(np.asarray(o.numpy()), np.asarray(r),
+                                   atol=atol, rtol=rtol)
+    if jit:
+        compiled = pt.jit.StaticFunction(pt_fn, warmup=False)
+        jouts = _as_list(compiled(*[pt.to_tensor(x) for x in inputs]))
+        for o, r in zip(jouts, refs):
+            np.testing.assert_allclose(np.asarray(o.numpy()), np.asarray(r),
+                                       atol=atol, rtol=rtol,
+                                       err_msg="jit path diverged from numpy ref")
 
 
-def check_grad(pt_fn, inputs, atol=1e-4, rtol=1e-4, numeric=False, eps=1e-3):
-    """Compare tape grads of sum(pt_fn(*inputs)) against jax.grad reference."""
+def check_grad(pt_fn, inputs, atol=1e-4, rtol=1e-4, numeric=True, eps=1e-3,
+               numeric_atol=1e-2, numeric_rtol=1e-2):
+    """Compare tape grads of sum(pt_fn(*inputs)) against jax.grad, and
+    (numeric=True) against central finite differences in float64."""
     ts = [pt.to_tensor(x, stop_gradient=False) for x in inputs]
     out = pt_fn(*ts)
-    loss = out.sum() if out.ndim > 0 else out
+    outs = _as_list(out)
+    loss = None
+    for o in outs:
+        s = o.sum() if o.ndim > 0 else o
+        loss = s if loss is None else loss + s
     loss.backward()
-    tape_grads = [t.grad.numpy() if t.grad is not None else None for t in ts]
+    tape_grads = [np.asarray(t.grad.numpy()) if t.grad is not None else None
+                  for t in ts]
 
     def pure(*arrays):
         ts2 = [pt.to_tensor(a) for a in arrays]
-        o = pt_fn(*ts2)
-        return jnp.sum(o._value)
+        os_ = _as_list(pt_fn(*ts2))
+        return sum(jnp.sum(o._value) for o in os_)
 
-    ref_grads = jax.grad(pure, argnums=tuple(range(len(inputs))))(*[jnp.asarray(x) for x in inputs])
+    ref_grads = jax.grad(pure, argnums=tuple(range(len(inputs))))(
+        *[jnp.asarray(x) for x in inputs])
     for tg, rg in zip(tape_grads, ref_grads):
         assert tg is not None, "tape produced no grad"
         np.testing.assert_allclose(tg, np.asarray(rg), atol=atol, rtol=rtol)
 
     if numeric:
         for i, x in enumerate(inputs):
-            num = np.zeros_like(x, dtype=np.float64)
-            flat = x.reshape(-1)
-            for j in range(flat.size):
-                xp, xm = x.copy().reshape(-1), x.copy().reshape(-1)
+            if not np.issubdtype(x.dtype, np.floating):
+                continue
+            num = np.zeros(x.shape, dtype=np.float64)
+            nflat = num.reshape(-1)
+            for j in range(x.size):
+                xp = x.astype(np.float64).reshape(-1)
+                xm = xp.copy()
                 xp[j] += eps
                 xm[j] -= eps
-                args_p = list(inputs)
-                args_m = list(inputs)
-                args_p[i] = xp.reshape(x.shape)
-                args_m[i] = xm.reshape(x.shape)
+                args_p, args_m = list(inputs), list(inputs)
+                args_p[i] = xp.reshape(x.shape).astype(x.dtype)
+                args_m[i] = xm.reshape(x.shape).astype(x.dtype)
                 fp = float(pure(*[jnp.asarray(a) for a in args_p]))
                 fm = float(pure(*[jnp.asarray(a) for a in args_m]))
-                num.reshape(-1)[j] = (fp - fm) / (2 * eps)
-            np.testing.assert_allclose(tape_grads[i], num, atol=1e-2, rtol=1e-2)
+                nflat[j] = (fp - fm) / (2 * eps)
+            np.testing.assert_allclose(
+                tape_grads[i], num, atol=numeric_atol, rtol=numeric_rtol,
+                err_msg=f"finite-difference grad mismatch for input {i}")
+
+
+def sweep_dtypes(pt_fn, np_fn, make_inputs, dtypes, atol=None, jit=True,
+                 grad=False, grad_dtypes=("float32",)):
+    """Run check_output per dtype (reference: OpTest dtype subclass sweep)
+    and check_grad on the float dtypes listed."""
+    for dt in dtypes:
+        inputs = make_inputs(dt)
+        tol = atol if atol is not None else (
+            5e-2 if dt in ("float16", "bfloat16") else 1e-4)
+        check_output(pt_fn, np_fn, inputs, atol=tol, rtol=tol, jit=jit)
+    if grad:
+        for dt in grad_dtypes:
+            check_grad(pt_fn, make_inputs(dt))

@@ -1,0 +1,141 @@
+"""Sharded/async distributed checkpoint + cross-topology reshard.
+
+Reference parity: auto_parallel dist_saver.py (per-rank shard save) and
+converter.py (re-shard checkpoints across parallel layouts). VERDICT.md
+missing #3: save under dp2×mp2×pp2 → load under mp4 → bitwise-equal params.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.topology import create_mesh
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def _sharded_state(mesh):
+    """A state dict sharded over the given mesh (params + nested opt state)."""
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((16, 32)).astype("float32")
+    w2 = rng.standard_normal((32, 8)).astype("float32")
+    emb = rng.standard_normal((64, 16)).astype("float32")
+    step = np.asarray(7, dtype="int64")
+    axes1 = P(None, "mp") if "mp" in mesh.axis_names else P()
+    axes2 = P("mp", None) if "mp" in mesh.axis_names else P()
+    state = {
+        "linear1": {"weight": Tensor(
+            jax.device_put(w1, NamedSharding(mesh, axes1)))},
+        "linear2": {"weight": Tensor(
+            jax.device_put(w2, NamedSharding(mesh, axes2)))},
+        "embedding.weight": Tensor(
+            jax.device_put(emb, NamedSharding(mesh, P("dp", None)))
+            if "dp" in mesh.axis_names else emb),
+        "opt": {"step": Tensor(step)},
+    }
+    return state, {"linear1//weight": w1, "linear2//weight": w2,
+                   "embedding.weight": emb, "opt//step": step}
+
+
+def test_save_sharded_load_other_topology(tmp_path):
+    # save under dp2 × mp2 × pp2
+    mesh_a = create_mesh({"dp": 2, "mp": 2, "pp": 2})
+    state, raw = _sharded_state(mesh_a)
+    h = ckpt.save_state_dict(state, str(tmp_path / "ck"))
+    h.wait()
+
+    # load under dp2 × mp4 — different layout entirely
+    mesh_b = create_mesh({"dp": 2, "mp": 4})
+    shardings = {
+        "linear1": {"weight": NamedSharding(mesh_b, P(None, "mp"))},
+        "linear2": {"weight": NamedSharding(mesh_b, P("mp", None))},
+        "embedding.weight": NamedSharding(mesh_b, P("dp", None)),
+    }
+    loaded = ckpt.load_state_dict(str(tmp_path / "ck"), shardings=shardings)
+
+    np.testing.assert_array_equal(
+        np.asarray(loaded["linear1"]["weight"].numpy()), raw["linear1//weight"])
+    np.testing.assert_array_equal(
+        np.asarray(loaded["linear2"]["weight"].numpy()), raw["linear2//weight"])
+    np.testing.assert_array_equal(
+        np.asarray(loaded["embedding.weight"].numpy()), raw["embedding.weight"])
+    assert int(loaded["opt"]["step"].numpy()) == 7
+    # placement actually followed the NEW mesh
+    got = loaded["linear1"]["weight"]._value.sharding
+    assert got.spec == P(None, "mp")
+    assert got.mesh.shape["mp"] == 4
+
+
+def test_per_shard_files_written(tmp_path):
+    """Sharded leaves persist as multiple per-shard files (dist_saver
+    semantics), replicated axes deduped to replica-0."""
+    mesh = create_mesh({"dp": 2, "mp": 2, "pp": 2})
+    state, _ = _sharded_state(mesh)
+    ckpt.save_state_dict(state, str(tmp_path / "ck")).wait()
+    files = os.listdir(tmp_path / "ck")
+    l1 = [f for f in files if f.startswith("linear1__weight")]
+    # [16, 32] over P(None, 'mp'): mp=2 shards, dp/pp replicas deduped
+    assert len(l1) == 2, files
+    emb = [f for f in files if f.startswith("embedding.weight")]
+    assert len(emb) == 2, files
+
+
+def test_async_save(tmp_path):
+    mesh = create_mesh({"dp": 8})
+    state, raw = _sharded_state(mesh)
+    h = ckpt.save_state_dict(state, str(tmp_path / "ck"), async_save=True)
+    ckpt.wait()
+    assert h.done()
+    loaded = ckpt.load_state_dict(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(
+        np.asarray(loaded["linear1"]["weight"].numpy()), raw["linear1//weight"])
+
+
+def test_load_with_target_template(tmp_path):
+    """Pass target= (fresh model state under the new mesh) instead of
+    explicit shardings — the converter path a resuming job uses."""
+    mesh_a = create_mesh({"dp": 4, "mp": 2})
+    state, raw = _sharded_state(mesh_a)
+    ckpt.save_state_dict(state, str(tmp_path / "ck")).wait()
+
+    mesh_b = create_mesh({"mp": 8})
+    tmpl, _ = _sharded_state(mesh_b)
+    loaded = ckpt.load_state_dict(str(tmp_path / "ck"), target=tmpl)
+    got = loaded["linear1"]["weight"]
+    np.testing.assert_array_equal(np.asarray(got.numpy()), raw["linear1//weight"])
+    assert got._value.sharding.mesh.shape["mp"] == 8
+
+
+def test_bf16_roundtrip(tmp_path):
+    mesh = create_mesh({"dp": 8})
+    v = Tensor(jax.device_put(
+        np.arange(64, dtype="float32").reshape(8, 8),
+        NamedSharding(mesh, P("dp", None))).astype("bfloat16"))
+    ckpt.save_state_dict({"w": v}, str(tmp_path / "ck")).wait()
+    loaded = ckpt.load_state_dict(str(tmp_path / "ck"))
+    assert str(loaded["w"].dtype) in ("paddle.bfloat16", "bfloat16") or \
+        "bfloat16" in str(loaded["w"]._value.dtype)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["w"]._value.astype("float32")),
+        np.arange(64, dtype="float32").reshape(8, 8))
+
+
+def test_converter_class(tmp_path):
+    mesh = create_mesh({"dp": 2, "mp": 4})
+    state, raw = _sharded_state(mesh)
+    ckpt.save_state_dict(state, str(tmp_path / "ck")).wait()
+    conv = ckpt.Converter()
+    out = conv.convert(path=str(tmp_path / "ck"))
+    np.testing.assert_array_equal(
+        np.asarray(out["linear2"]["weight"].numpy()), raw["linear2//weight"])

@@ -1,0 +1,146 @@
+"""hapi Model + paddle.metric e2e.
+
+Reference parity: hapi Model (python/paddle/hapi/model.py:1018 —
+prepare/fit/evaluate/predict/save/load), callbacks (hapi/callbacks.py),
+metrics (python/paddle/metric/metrics.py). VERDICT.md missing #4/#6: an
+MNIST-style Model.fit e2e incl. save/resume fills both placeholder packages.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.hapi import EarlyStopping, ModelCheckpoint
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class ToyClassification(Dataset):
+    """Linearly-separable 2-class blobs (a fast MNIST stand-in)."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.y = (rng.random(n) > 0.5).astype("int64")
+        self.x = (rng.standard_normal((n, 8)).astype("float32")
+                  + 3.0 * self.y[:, None].astype("float32"))
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _net():
+    return pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.ReLU(), pt.nn.Linear(16, 2))
+
+
+def _model():
+    pt.seed(0)
+    net = _net()
+    model = pt.Model(net)
+    opt = pt.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    model.prepare(opt, pt.nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_evaluate_predict(tmp_path):
+    model = _model()
+    train, val = ToyClassification(64, 0), ToyClassification(32, 1)
+    model.fit(train, val, batch_size=16, epochs=3, verbose=0,
+              save_dir=str(tmp_path / "ck"))
+    logs = model.evaluate(val, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.9, logs
+    assert logs["loss"] < 0.5, logs
+    preds = model.predict(val, batch_size=16, stack_outputs=True, verbose=0)
+    assert preds[0].shape == (32, 2)
+    # ModelCheckpoint wrote epoch + final checkpoints
+    assert os.path.exists(tmp_path / "ck" / "final.pdparams")
+    assert os.path.exists(tmp_path / "ck" / "final.pdopt")
+
+
+def test_save_load_resume(tmp_path):
+    model = _model()
+    train = ToyClassification(64, 0)
+    model.fit(train, batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "snap")
+    model.save(path)
+
+    fresh = _model()
+    fresh.load(path)
+    a = model.predict([ToyClassification(8, 2)[i][0] for i in range(8)],
+                      batch_size=8, stack_outputs=True)
+    b = fresh.predict([ToyClassification(8, 2)[i][0] for i in range(8)],
+                      batch_size=8, stack_outputs=True)
+    np.testing.assert_allclose(a[0], b[0], atol=1e-6)
+    # optimizer state restored too → further training matches
+    assert fresh._optimizer.state_dict().keys() == \
+        model._optimizer.state_dict().keys()
+
+
+def test_early_stopping():
+    model = _model()
+    train, val = ToyClassification(64, 0), ToyClassification(32, 1)
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0,
+                       save_best_model=False)
+    model.fit(train, val, batch_size=16, epochs=50, verbose=0, callbacks=[es])
+    assert model.stop_training  # converged long before 50 epochs
+
+
+def test_train_batch_and_summary():
+    model = _model()
+    ds = ToyClassification(16, 0)
+    x = np.stack([ds[i][0] for i in range(16)])
+    y = np.stack([ds[i][1] for i in range(16)])
+    out = model.train_batch(x, y)
+    assert np.isfinite(out[0])
+    info = model.summary()
+    # 8*16+16 + 16*2+2 = 178
+    assert info["total_params"] == 178
+
+
+def test_accuracy_metric():
+    m = Accuracy(topk=(1, 2))
+    pred = pt.to_tensor(np.array([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]], "float32"))
+    label = pt.to_tensor(np.array([1, 2], "int64"))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.5)   # sample 1 right, sample 2 wrong
+    assert top2 == pytest.approx(0.5)   # label 2 not in top-2 of sample 2
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # predicted positive: 0,1,3 → tp=2 fp=1; actual positive: 0,2,3 → fn=1
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+# lightweight reference AUC (avoids sklearn dependency)
+def _ref_auc(scores, labels):
+    order = np.argsort(-scores)
+    labels = labels[order]
+    tps = np.cumsum(labels)
+    fps = np.cumsum(1 - labels)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    return np.trapezoid(tpr, fpr)
+
+
+def test_auc_against_rank_reference():
+    auc = Auc(num_thresholds=4095)
+    rng = np.random.default_rng(1)
+    n = 500
+    labels = (rng.random(n) > 0.4).astype("int64")
+    pos_prob = np.clip(0.4 * labels + rng.random(n) * 0.6, 0, 1)
+    auc.update(np.stack([1 - pos_prob, pos_prob], 1), labels)
+    ref = _ref_auc(pos_prob, labels)
+    assert auc.accumulate() == pytest.approx(ref, abs=5e-3)

@@ -1,0 +1,63 @@
+"""Registry-driven op suite: every public op through the OpTest harness.
+
+Reference parity: the OpTest pattern of eager_op_test.py:324 (dual-mode
+check_output :2107, numeric check_grad :2284, per-dtype sweeps) applied
+table-wise. The coverage gate at the bottom enforces that every name in
+paddle_tpu.ops.__all__ is specced (or excluded with a reason) — VERDICT.md
+next-round item #5's "every public op registered in the harness".
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_registry import CUSTOM, EXCLUDED, REGISTRY
+from op_test import check_grad, check_output
+
+_IDS = sorted(REGISTRY)
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_op_output(name):
+    spec = REGISTRY[name]
+    for dt in spec.dtypes:
+        inputs = spec.make(dt)
+        tol = spec.atol if spec.atol is not None else (
+            1e-6 if dt == "float64" else 1e-4)
+        check_output(spec.fn, spec.ref, inputs, atol=tol, rtol=tol, jit=False)
+
+
+@pytest.mark.parametrize("name", sorted(n for n in _IDS if REGISTRY[n].jit))
+def test_op_output_jit(name):
+    """Dual-mode: the same op compiled through StaticFunction (the
+    reference's static-graph executor leg)."""
+    spec = REGISTRY[name]
+    dt = spec.dtypes[0]
+    inputs = spec.make(dt)
+    tol = spec.atol if spec.atol is not None else 1e-4
+    check_output(spec.fn, spec.ref, inputs, atol=tol, rtol=tol, jit=True)
+
+
+@pytest.mark.parametrize("name", sorted(n for n in _IDS if REGISTRY[n].grad))
+def test_op_grad(name):
+    spec = REGISTRY[name]
+    inputs = spec.make("float32")
+    check_grad(spec.fn, inputs, numeric=spec.numeric)
+
+
+@pytest.mark.parametrize("name", sorted(CUSTOM))
+def test_op_custom(name):
+    CUSTOM[name]()
+
+
+def test_every_public_op_is_covered():
+    """The harness gate: ops.__all__ ⊆ REGISTRY ∪ CUSTOM ∪ EXCLUDED."""
+    from paddle_tpu.ops import (creation, linalg, logic, manipulation, math,
+                                random, stat)
+    all_ops = set()
+    for m in (creation, linalg, logic, manipulation, math, random, stat):
+        all_ops |= set(m.__all__)
+    covered = set(REGISTRY) | set(CUSTOM) | set(EXCLUDED)
+    missing = sorted(all_ops - covered)
+    assert not missing, f"ops missing from the OpTest registry: {missing}"
+    stale = sorted((set(REGISTRY) | set(CUSTOM)) - all_ops)
+    assert not stale, f"registry entries for nonexistent ops: {stale}"

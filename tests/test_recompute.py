@@ -1,0 +1,146 @@
+"""Recompute user API + gradient accumulation wiring.
+
+Reference parity: recompute
+(python/paddle/distributed/fleet/recompute/recompute.py:332),
+recompute_sequential (:456), accumulate_steps/micro_batch_size in
+DistributedStrategy (framework/distributed_strategy.proto). VERDICT.md
+missing #5: remat visible in jaxpr; accumulated-step numerics equal
+large-batch step.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential
+from paddle_tpu.tensor import Tensor
+
+
+def _mlp(seed=0):
+    pt.seed(seed)
+    return pt.nn.Sequential(
+        pt.nn.Linear(8, 32), pt.nn.GELU(), pt.nn.Linear(32, 8))
+
+
+def _x(seed=1, n=4):
+    return pt.to_tensor(np.random.default_rng(seed)
+                        .standard_normal((n, 8)).astype("float32"))
+
+
+def test_recompute_matches_plain_forward_backward():
+    net = _mlp()
+    x = _x()
+    ref = net(x)
+    ref_loss = ref.pow(2).sum()
+    ref_loss.backward()
+    ref_grads = [np.asarray(p.grad.numpy()) for p in net.parameters()]
+    for p in net.parameters():
+        p.grad = None
+
+    out = recompute(net, x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), atol=1e-6)
+    out.pow(2).sum().backward()
+    for p, rg in zip(net.parameters(), ref_grads):
+        assert p.grad is not None
+        np.testing.assert_allclose(np.asarray(p.grad.numpy()), rg, atol=1e-5)
+
+
+def test_recompute_closure_function():
+    net = _mlp(seed=2)
+    x = _x(seed=3)
+
+    def block(h):
+        return net(h) + h
+
+    out = recompute(block, x)
+    out.sum().backward()
+    assert all(p.grad is not None for p in net.parameters())
+
+
+def test_recompute_sequential_segments():
+    net = _mlp(seed=4)
+    x = _x(seed=5)
+    ref = net(x)
+    out = recompute_sequential({"segments": 2}, net, x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), atol=1e-6)
+    out.sum().backward()
+    assert all(p.grad is not None for p in net.parameters())
+
+
+def test_remat_visible_in_jaxpr():
+    """The checkpoint must appear as a remat region in the traced program
+    (VERDICT 'Done = remat visible in jaxpr')."""
+    net = _mlp(seed=6)
+
+    def fwd(xv):
+        return recompute(net, Tensor(xv, stop_gradient=True))._value
+
+    jaxpr = jax.make_jaxpr(fwd)(np.zeros((4, 8), "float32"))
+    assert "remat" in str(jaxpr), str(jaxpr)[:2000]
+
+
+def test_gradient_accumulation_equals_large_batch():
+    """PipelineParallel.train_batch with accumulate_steps=n produces the
+    same update as one full-batch step (SGD — linear in grads)."""
+    from paddle_tpu.distributed.fleet.pp_layers import LayerDesc, PipelineLayer
+
+    def build(accumulate_steps, micro_batch_size=1):
+        fleet.fleet._is_initialized = False
+        dist.set_mesh(None)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                            "accumulate_steps": accumulate_steps,
+                            "micro_batch_size": micro_batch_size}
+        fleet.init(is_collective=True, strategy=s)
+        pt.seed(7)
+        model = PipelineLayer(
+            layers=[LayerDesc(pt.nn.Linear, 8, 8), LayerDesc(pt.nn.GELU),
+                    LayerDesc(pt.nn.Linear, 8, 1)],
+            loss_fn=lambda out, y: (out - y).pow(2).mean())
+        wrapped = fleet.distributed_model(model)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+        return model, wrapped, opt
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((8, 8)).astype("float32")
+    y = rng.standard_normal((8, 1)).astype("float32")
+
+    m1, w1, o1 = build(accumulate_steps=1)
+    w1.train_batch((pt.to_tensor(x), pt.to_tensor(y)), o1)
+    ref_params = [np.asarray(p.numpy()) for p in m1.parameters()]
+
+    m2, w2, o2 = build(accumulate_steps=4)
+    assert w2.accumulate_steps == 4
+    w2.train_batch((pt.to_tensor(x), pt.to_tensor(y)), o2)
+    for p, rp in zip(m2.parameters(), ref_params):
+        np.testing.assert_allclose(np.asarray(p.numpy()), rp, atol=1e-6)
+
+    # micro_batch_size alone implies accumulate_steps = B / mbs
+    m3, w3, o3 = build(accumulate_steps=1, micro_batch_size=2)
+    w3.train_batch((pt.to_tensor(x), pt.to_tensor(y)), o3)
+    for p, rp in zip(m3.parameters(), ref_params):
+        np.testing.assert_allclose(np.asarray(p.numpy()), rp, atol=1e-6)
+
+    dist.set_mesh(None)
+    fleet.fleet._is_initialized = False
+
+
+def test_strategy_accumulate_steps_reaches_gpt_config():
+    fleet.fleet._is_initialized = False
+    dist.set_mesh(None)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                        "accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=s)
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    pt.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    fleet.distributed_model(model)
+    assert model.config.pp_num_microbatches == 4
+    dist.set_mesh(None)
+    fleet.fleet._is_initialized = False
