@@ -73,13 +73,29 @@ def test_recompute_sequential_segments():
 
 def test_remat_visible_in_jaxpr():
     """The checkpoint must appear as a remat region in the traced program
-    (VERDICT 'Done = remat visible in jaxpr')."""
+    (VERDICT 'Done = remat visible in jaxpr'). jax partial-evals the remat
+    out of a forward-only trace — the primitive lives in the backward, so
+    trace the full grad step (which is where recompute pays off anyway)."""
     net = _mlp(seed=6)
+    cells = list(net.parameters())
 
-    def fwd(xv):
-        return recompute(net, Tensor(xv, stop_gradient=True))._value
+    def loss_and_grads(xv, *param_vals):
+        old = [c._value for c in cells]
+        for c, v in zip(cells, param_vals):
+            c._value = v
+        try:
+            x = Tensor(xv, stop_gradient=True)
+            out = recompute(net, x)
+            loss = out.pow(2).sum()
+            import paddle_tpu.autograd as ag
+            grads = ag.grad([loss], cells)
+            return loss._value, tuple(g._value for g in grads)
+        finally:
+            for c, o in zip(cells, old):
+                c._value = o
 
-    jaxpr = jax.make_jaxpr(fwd)(np.zeros((4, 8), "float32"))
+    jaxpr = jax.make_jaxpr(loss_and_grads)(
+        np.zeros((4, 8), "float32"), *[c._value for c in cells])
     assert "remat" in str(jaxpr), str(jaxpr)[:2000]
 
 
