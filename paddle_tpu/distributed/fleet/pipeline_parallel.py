@@ -39,6 +39,10 @@ class PipelineParallel(Layer):
             self.micro_batch_size = mbs if mbs > 1 else None
         self.accumulate_steps = max(micro, 1)
         self._loss_fn = getattr(layers, "_loss_fn", None)
+        self._schedule_mode = "F-then-B"
+        if strategy is not None:
+            pc = getattr(strategy, "pipeline_configs", {}) or {}
+            self._schedule_mode = pc.get("schedule_mode", "F-then-B")
         # Heterogeneous PipelineLayer models run all stages in one program —
         # correct numerics, but parameters are NOT partitioned over the 'pp'
         # mesh axis (only homogeneous StackedPipelineBlocks get the compiled
@@ -74,12 +78,96 @@ class PipelineParallel(Layer):
         m = B // n
         return [(xs[i * m:(i + 1) * m], ys[i * m:(i + 1) * m]) for i in range(n)]
 
+    def _decompose_for_1f1b(self):
+        """Split the wrapped model into (prefix, stack, suffix) around its
+        StackedPipelineBlocks trunk so the hand-rolled 1F1B schedule can fuse
+        prefix into stage 0 and suffix+loss into the last stage."""
+        from .pipeline_schedule import StackedPipelineBlocks
+
+        m = self._layers
+        if isinstance(m, StackedPipelineBlocks):
+            return None, m, None
+        funcs = list(getattr(m, "run_funcs", []))
+        idx = [i for i, f in enumerate(funcs)
+               if isinstance(f, StackedPipelineBlocks)]
+        if len(idx) != 1:
+            return None, None, None
+        i = idx[0]
+        pre, post = funcs[:i], funcs[i + 1:]
+
+        def seq(fs):
+            if not fs:
+                return None
+
+            def run(x):
+                # same tuple-splat convention as PipelineLayer.forward so
+                # flipping schedule_mode never changes entry semantics
+                for f in fs:
+                    x = f(*x) if isinstance(x, tuple) else f(x)
+                return x
+            # expose Layers for parameter discovery (_find_layers walks the
+            # closure cells of `run`, which close over `fs`)
+            return run
+        return seq(pre), funcs[i], seq(post)
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """reference: pipeline_parallel.py train_batch :127 — returns the
-        mean micro-batch loss after one optimizer step."""
+        mean micro-batch loss after one optimizer step.
+
+        ``strategy.pipeline_configs['schedule_mode'] = '1F1B'`` selects the
+        hand-rolled interleaved schedule (pipeline_schedule.pipeline_1f1b_train)
+        when the model has a StackedPipelineBlocks trunk; the default
+        'F-then-B' runs forward for all microbatches with AD backward."""
         if self._loss_fn is None:
             raise RuntimeError(
                 "train_batch needs the PipelineLayer to be built with loss_fn")
+        if self._schedule_mode == "1F1B":
+            # decompose + compose ONCE: pipeline_1f1b_train's compile cache is
+            # keyed on the loss_fn/prefix identities, so rebuilding closures
+            # per call would force a full XLA recompile every step
+            if not hasattr(self, "_1f1b_parts"):
+                prefix, stack, suffix = self._decompose_for_1f1b()
+                loss_fn = self._loss_fn
+                if suffix is not None and stack is not None:
+                    user_loss = loss_fn
+                    loss_fn = lambda out, lab: user_loss(suffix(out), lab)
+                self._1f1b_parts = (prefix, stack, loss_fn)
+            prefix, stack, loss_fn = self._1f1b_parts
+            if stack is not None and stack._pp > 1:
+                from .pipeline_schedule import pipeline_1f1b_train
+
+                xb, yb = data
+                B = ensure_tensor(xb).shape[0]
+                M = self.accumulate_steps
+                if M == 1 and self.micro_batch_size:
+                    if B % self.micro_batch_size:
+                        raise ValueError(
+                            f"batch {B} not divisible by micro_batch_size "
+                            f"{self.micro_batch_size}")
+                    M = B // self.micro_batch_size
+                if M == 1:
+                    M = stack._pp
+                # with a scaler, fresh grad contributions carry the loss
+                # scale (runtime arg, not baked into the compiled schedule)
+                # so scaler.step's unscale sees reference-shaped grads
+                loss = pipeline_1f1b_train(
+                    stack, ensure_tensor(xb), ensure_tensor(yb), loss_fn,
+                    num_microbatches=M, prefix=prefix,
+                    grad_scale=None if scaler is None
+                    else scaler._scale._value)
+                if scaler is not None:
+                    scaler.step(optimizer)
+                else:
+                    optimizer.step()
+                optimizer.clear_grad()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
+            import warnings
+            warnings.warn(
+                "schedule_mode='1F1B' needs a single StackedPipelineBlocks "
+                "trunk and pp>1; falling back to F-then-B accumulation",
+                stacklevel=2)
         n = self.accumulate_steps
         if n == 1 and self.micro_batch_size:
             # reference semantics: accumulate_steps defaults to

@@ -35,7 +35,7 @@ from ...ops._apply import apply_op, ensure_tensor
 from ...tensor import Parameter, Tensor
 from .. import topology
 
-__all__ = ["StackedPipelineBlocks", "pipeline_apply"]
+__all__ = ["StackedPipelineBlocks", "pipeline_apply", "pipeline_1f1b_train"]
 
 
 class StackedPipelineBlocks(Layer):
@@ -216,3 +216,242 @@ def pipeline_apply(stack: StackedPipelineBlocks, x: Tensor, num_microbatches: in
         return out_mb.reshape((B,) + out_mb.shape[2:])
 
     return apply_op(fn, [x] + list(stack.stacked), name="pipeline_apply")
+
+
+# --------------------------------------------------------------------- 1F1B
+def _functionalize(function, params=None):
+    """(pure_fn(param_vals, *arg_vals) -> jax value(s), cells): bind the
+    callable's Parameter cells to traced values so the hand-rolled schedule
+    can differentiate through it (the StackedPipelineBlocks pattern)."""
+    from .recompute import _discover_cells
+
+    if function is None:
+        return None, []
+    cells = _discover_cells(function, params)
+
+    def pure(param_vals, *arg_vals):
+        old = [c._value for c in cells]
+        for c, v in zip(cells, param_vals):
+            c._value = v
+        try:
+            with no_grad():
+                out = function(
+                    *[Tensor(v, stop_gradient=True) for v in arg_vals])
+        finally:
+            for c, o in zip(cells, old):
+                c._value = o
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return pure, cells
+
+
+def _accum_grad(param: Parameter, gval):
+    g = Tensor(gval, stop_gradient=True)
+    param.grad = g if param.grad is None else Tensor(
+        param.grad._value + gval, stop_gradient=True)
+
+
+def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
+                        num_microbatches: int, prefix=None,
+                        loss_params=None, prefix_params=None,
+                        grad_scale=None):
+    """Hand-rolled interleaved 1F1B train step compiled into ONE XLA program.
+
+    Reference parity: ``PipelineParallel.forward_backward_pipeline``
+    (fleet/meta_parallel/pipeline_parallel.py:153) — the 1F1B schedule whose
+    point is that per-stage activation liveness is bounded by the number of
+    *in-flight* microbatches, not the total M (GPipe's profile, which is what
+    AD through ``pipeline_apply``'s scan gives).
+
+    TPU-native formulation: a lockstep ``lax.scan`` over T = M + 2(P-1)
+    ticks. Each tick, every stage executes ONE forward microstep (microbatch
+    ``t - r``) and ONE backward microstep (microbatch ``t - 2(P-1) + r``) —
+    the steady-state interleave — with activations saved in a circular
+    buffer of 2P-1 slots (the in-flight bound; independent of M) and
+    re-differentiated per-microbatch with ``jax.vjp`` (recompute-style, no
+    [T]-long residual chain). Forward activations move to the next stage via
+    ppermute(+1); gradients move back via ppermute(-1).
+
+    ``prefix`` (e.g. embedding) runs fused into stage 0's microstep;
+    ``loss_fn(out, label)`` (e.g. final-norm + lm-head + CE) fused into the
+    last stage's — so the loss gradient enters the backward ppermute chain in
+    the same tick its forward completes, exactly the reference's
+    "last stage starts backward immediately" behavior.
+
+    Returns the mean microbatch loss (replicated) and ACCUMULATES ``.grad``
+    on ``stack.stacked`` + prefix/loss-fn parameters — the caller owns
+    ``optimizer.step()`` (reference train_batch contract).
+    """
+    mesh = stack._mesh_ref
+    Pp = stack._pp
+    if mesh is None or Pp <= 1:
+        raise ValueError("pipeline_1f1b_train requires an active pp>1 mesh")
+    M = int(num_microbatches)
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    B = xt.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    chunk = stack._chunk_fn()
+    cache = getattr(stack, "_1f1b_cache", None)
+    key = (M, xt.shape, str(xt._value.dtype), yt.shape, str(yt._value.dtype),
+           id(loss_fn), id(prefix))
+    if cache is not None and cache[0] == key:
+        # cache hit: the compiled program already bakes the pure closures —
+        # only the cell lists (traced-input order) are needed per call
+        _, jitted, prefix_cells, loss_cells = cache
+        return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
+                         grad_scale)
+    prefix_pure, prefix_cells = _functionalize(prefix, prefix_params)
+    loss_pure, loss_cells = _functionalize(loss_fn, loss_params)
+    if loss_pure is None:
+        raise ValueError("1F1B needs a loss_fn (the schedule computes the "
+                         "loss gradient on the last stage)")
+
+    D = 2 * Pp - 1  # circular activation-buffer depth = max in-flight
+    T = M + 2 * (Pp - 1)
+    w = 1.0 / M  # mean-over-microbatches weight, folded into dy at source
+
+    def fn(xv, yv, stacked_vals, pvals, lvals):
+        mb_x = xv.reshape((M, B // M) + xv.shape[1:])
+        mb_y = yv.reshape((M, B // M) + yv.shape[1:])
+
+        def inner(mb_x, mb_y, pvals, lvals, *stacked_local):
+            r = jax.lax.axis_index("pp")
+            sl = list(stacked_local)
+
+            def stage0_in(pv, x_raw):
+                return (prefix_pure(pv, x_raw) if prefix_pure is not None
+                        else x_raw)
+
+            # activation template for carries (shape of a chunk in/out)
+            act0 = jax.eval_shape(stage0_in, pvals, mb_x[0])
+            zero_act = lambda: jax.lax.pcast(
+                jnp.zeros(act0.shape, act0.dtype), ("pp",), to="varying")
+            state_f = zero_act()
+            state_b = zero_act()
+            act_buf = jax.lax.pcast(
+                jnp.zeros((D,) + act0.shape, act0.dtype), ("pp",), to="varying")
+            pgrads = [jax.lax.pcast(jnp.zeros(s.shape, s.dtype), ("pp",),
+                                    to="varying") for s in sl]
+            prefix_g = [jax.lax.pcast(jnp.zeros(v.shape, v.dtype), ("pp",),
+                                      to="varying") for v in pvals]
+            loss_g = [jax.lax.pcast(jnp.zeros(v.shape, v.dtype), ("pp",),
+                                    to="varying") for v in lvals]
+            loss_acc = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",),
+                                     to="varying")
+            fwd_perm = [(i, (i + 1) % Pp) for i in range(Pp)]
+            bwd_perm = [(i, (i - 1) % Pp) for i in range(Pp)]
+
+            def tick(carry, t):
+                (state_f, state_b, act_buf, pgrads, prefix_g, loss_g,
+                 loss_acc) = carry
+                # ---- forward microstep: microbatch t - r ------------------
+                mf = t - r
+                f_valid = (mf >= 0) & (mf < M)
+                mfc = jnp.clip(mf, 0, M - 1)
+                x0 = stage0_in(pvals, mb_x[mfc])
+                x_in = jnp.where(r == 0, x0, state_f)
+                y_out = chunk(sl, x_in)
+                act_buf2 = jax.lax.dynamic_update_index_in_dim(
+                    act_buf, x_in, mfc % D, axis=0)
+                act_buf = jnp.where(f_valid, act_buf2, act_buf)
+
+                # last stage: loss value + dL/dy + loss-param grads, same tick.
+                # The mask must sit INSIDE the differentiated function: lvals
+                # is invariant over the manual 'pp' axis, so jax pvary-promotes
+                # it — and pvary's transpose is a hidden psum over 'pp'. Each
+                # tick's dloss_lv is therefore the SUM of every stage's
+                # contribution; masking the loss pre-grad makes the garbage
+                # stages contribute exact zeros to that psum.
+                last_fwd = (r == Pp - 1) & f_valid
+
+                def loss_of(lv, yy):
+                    return jnp.where(
+                        last_fwd, loss_pure(lv, yy, mb_y[mfc]) * w, 0.0)
+                (ls, (dloss_lv, dy_last)) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1))(lvals, y_out)
+                loss_acc = loss_acc + ls.astype(jnp.float32)
+                loss_g = [g + d for g, d in zip(loss_g, dloss_lv)]
+
+                # ---- backward microstep: microbatch t - 2(P-1) + r --------
+                mb_i = t - 2 * (Pp - 1) + r
+                b_valid = (mb_i >= 0) & (mb_i < M)
+                mbc = jnp.clip(mb_i, 0, M - 1)
+                g_in = jnp.where(r == Pp - 1, dy_last, state_b)
+                x_saved = act_buf[mbc % D]
+                _, chunk_vjp = jax.vjp(lambda vals, xx: chunk(vals, xx),
+                                       sl, x_saved)
+                dvals, dx = chunk_vjp(g_in)
+                pgrads = [g + jnp.where(b_valid, d, jnp.zeros_like(d))
+                          for g, d in zip(pgrads, dvals)]
+                # stage 0: route dx into the prefix's params. Same hidden-psum
+                # rule as the loss grads: pvals is invariant over 'pp', so the
+                # vjp psums every stage's cotangent — mask dx first so only
+                # stage 0's survives.
+                if prefix_pure is not None:
+                    pmask = (r == 0) & b_valid
+                    _, pref_vjp = jax.vjp(
+                        lambda pv: stage0_in(pv, mb_x[mbc]), pvals)
+                    (dpref,) = pref_vjp(jnp.where(pmask, dx,
+                                                  jnp.zeros_like(dx)))
+                    prefix_g = [g + d for g, d in zip(prefix_g, dpref)]
+
+                state_f = jax.lax.ppermute(y_out, "pp", fwd_perm)
+                state_b = jax.lax.ppermute(dx, "pp", bwd_perm)
+                return (state_f, state_b, act_buf, pgrads, prefix_g, loss_g,
+                        loss_acc), None
+
+            carry = (state_f, state_b, act_buf, pgrads, prefix_g, loss_g,
+                     loss_acc)
+            carry, _ = jax.lax.scan(tick, carry, jnp.arange(T))
+            (_, _, _, pgrads, prefix_g, loss_g, loss_acc) = carry
+            # replicate: loss + head grads live on the last stage, prefix
+            # grads on stage 0 — masked psum over pp
+            last = r == Pp - 1
+            loss_out = jax.lax.psum(jnp.where(last, loss_acc, 0.0), "pp")
+            loss_g = [jax.lax.psum(jnp.where(last, g, jnp.zeros_like(g)), "pp")
+                      for g in loss_g]
+            prefix_g = [jax.lax.psum(
+                jnp.where(r == 0, g, jnp.zeros_like(g)), "pp")
+                for g in prefix_g]
+            return loss_out, tuple(pgrads), tuple(prefix_g), tuple(loss_g)
+
+        stacked_specs = tuple(
+            P(*(["pp"] + [None] * (s.ndim - 1))) for s in stacked_vals)
+        mapped = jax.shard_map(
+            inner, mesh=mesh, axis_names={"pp"},
+            in_specs=(P(), P(), P(), P()) + stacked_specs,
+            out_specs=(P(), stacked_specs, P(), P()))
+        return mapped(mb_x, mb_y, pvals, lvals, *stacked_vals)
+
+    jitted = jax.jit(fn)
+    stack._1f1b_cache = (key, jitted, prefix_cells, loss_cells)
+    return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
+                     grad_scale)
+
+
+def _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells, grad_scale):
+    with no_grad():
+        loss_v, pg, prefg, lossg = jitted(
+            xt._value, yt._value,
+            tuple(p._value for p in stack.stacked),
+            tuple(c._value for c in prefix_cells),
+            tuple(c._value for c in loss_cells))
+    # grad_scale (e.g. GradScaler's loss scale) applies to the FRESH
+    # contribution only — scaling after accumulation would re-scale grads
+    # already sitting on the params
+    s = None if grad_scale is None else jnp.asarray(grad_scale)
+    for p, g in zip(stack.stacked, pg):
+        _accum_grad(p, g if s is None else g * s)
+    for c, g in zip(prefix_cells, prefg):
+        _accum_grad(c, g if s is None else g * s)
+    for c, g in zip(loss_cells, lossg):
+        _accum_grad(c, g if s is None else g * s)
+    # every param this schedule wrote a grad to (loss-fn/prefix cells may not
+    # be sublayers of the pipeline model — callers post-processing grads
+    # need the full set)
+    stack._1f1b_touched = list(stack.stacked) + prefix_cells + loss_cells
+    return Tensor(loss_v, stop_gradient=True)

@@ -141,9 +141,18 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self.descs = list(layers)
-        seg = SegmentLayers(self.descs, num_stages, method=seg_method,
-                            num_virtual_pipeline_stage=num_virtual_pipeline_stages)
-        self.segment_parts = seg.do_segment()
+        from .pipeline_schedule import StackedPipelineBlocks
+
+        if any(isinstance(d, StackedPipelineBlocks) for d in self.descs):
+            # the stack IS the pipelined trunk: its layers are already
+            # stage-partitioned over the 'pp' mesh axis internally, so entry-
+            # level segmentation does not apply
+            self.segment_parts = [0, len(self.descs)]
+        else:
+            seg = SegmentLayers(
+                self.descs, num_stages, method=seg_method,
+                num_virtual_pipeline_stage=num_virtual_pipeline_stages)
+            self.segment_parts = seg.do_segment()
 
         self._shared: dict = {}
         built: List[Layer] = []
@@ -176,6 +185,13 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def stage_layers(self, stage: int) -> List:
+        if stage >= self._num_stages or stage < 0:
+            raise IndexError(f"stage {stage} out of range "
+                             f"({self._num_stages} stages)")
+        if len(self.segment_parts) == 2 and self._num_stages > 1:
+            # stack-trunk model: every stage executes the same entry list
+            # (the stack partitions its layers over 'pp' internally)
+            return list(self.run_funcs)
         lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
         return self.run_funcs[lo:hi]
 

@@ -32,9 +32,16 @@ from ...tensor import Tensor
 __all__ = ["recompute", "recompute_sequential"]
 
 
-def _find_layers(function) -> List[Layer]:
+def _find_layers(function, _seen=None, _depth=3) -> List[Layer]:
     """Parameters must be explicit tape inputs for grads to reach them —
-    discover the Layers a callable closes over."""
+    discover the Layers a callable closes over (recursing through nested
+    closures/partials — depth-bounded so library functions reachable from
+    the closure don't drag in unrelated module state)."""
+    if _seen is None:
+        _seen = set()
+    if id(function) in _seen or _depth < 0:
+        return []
+    _seen.add(id(function))
     if isinstance(function, Layer):
         return [function]
     layers: List[Layer] = []
@@ -44,18 +51,55 @@ def _find_layers(function) -> List[Layer]:
         for a in list(function.args) + list(function.keywords.values()):
             if isinstance(a, Layer):
                 layers.append(a)
-        layers.extend(_find_layers(function.func))
+            elif callable(a):
+                layers.extend(_find_layers(a, _seen, _depth - 1))
+        layers.extend(_find_layers(function.func, _seen, _depth - 1))
+    # (value, depth for recursing into callables found there)
+    reachable = []
     closure = getattr(function, "__closure__", None) or ()
     for cell in closure:
         try:
-            v = cell.cell_contents
+            reachable.append((cell.cell_contents, _depth - 1))
         except ValueError:
             continue
+    # module-level callables hold their Layers as globals, not closure cells.
+    # Recursion through global callables is capped at one hop: deeper walks
+    # would capture Layer instances merely living in some library module's
+    # namespace as tape inputs.
+    code = getattr(function, "__code__", None)
+    glob = getattr(function, "__globals__", None)
+    if code is not None and glob is not None:
+        import dis
+
+        for ins in dis.get_instructions(code):
+            if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME") and ins.argval in glob:
+                reachable.append((glob[ins.argval], 0))
+    for v, d in reachable:
         if isinstance(v, Layer):
             layers.append(v)
         elif isinstance(v, (list, tuple)):
-            layers.extend(x for x in v if isinstance(x, Layer))
+            for x in v:
+                if isinstance(x, Layer):
+                    layers.append(x)
+                elif callable(x) and not isinstance(x, type):
+                    layers.extend(_find_layers(x, _seen, d))
+        elif callable(v) and not isinstance(v, type):
+            layers.extend(_find_layers(v, _seen, d))
     return layers
+
+
+def _discover_cells(function, params: Sequence = None) -> List:
+    """Unique Parameter cells a callable needs as explicit tape inputs —
+    from ``params`` when given, else discovered via ``_find_layers``."""
+    if params is not None:
+        return list(params)
+    cells, seen = [], set()
+    for l in _find_layers(function):
+        for p in l.parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                cells.append(p)
+    return cells
 
 
 def recompute(function: Callable, *args, preserve_rng_state: bool = True,
@@ -68,17 +112,7 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
     exotic. ``preserve_rng_state``/``use_reentrant`` are accepted for API
     parity (both behaviors are inherent here — see module docstring).
     """
-    if params is None:
-        layers = _find_layers(function)
-        cells = []
-        seen = set()
-        for l in layers:
-            for p in l.parameters():
-                if id(p) not in seen:
-                    seen.add(id(p))
-                    cells.append(p)
-    else:
-        cells = list(params)
+    cells = _discover_cells(function, params)
 
     arg_tensors = [ensure_tensor(a) for a in args]
     n_args = len(arg_tensors)
