@@ -296,12 +296,15 @@ def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
 
     chunk = stack._chunk_fn()
     cache = getattr(stack, "_1f1b_cache", None)
+    if cache is None:
+        cache = stack._1f1b_cache = {}
     key = (M, xt.shape, str(xt._value.dtype), yt.shape, str(yt._value.dtype),
            id(loss_fn), id(prefix))
-    if cache is not None and cache[0] == key:
+    hit = cache.get(key)
+    if hit is not None:
         # cache hit: the compiled program already bakes the pure closures —
         # only the cell lists (traced-input order) are needed per call
-        _, jitted, prefix_cells, loss_cells = cache
+        jitted, prefix_cells, loss_cells = hit
         return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
                          grad_scale)
     prefix_pure, prefix_cells = _functionalize(prefix, prefix_params)
@@ -428,7 +431,7 @@ def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
         return mapped(mb_x, mb_y, pvals, lvals, *stacked_vals)
 
     jitted = jax.jit(fn)
-    stack._1f1b_cache = (key, jitted, prefix_cells, loss_cells)
+    cache[key] = (jitted, prefix_cells, loss_cells)
     return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
                      grad_scale)
 

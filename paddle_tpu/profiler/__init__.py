@@ -1,0 +1,448 @@
+"""paddle_tpu.profiler — scoped-annotation profiling with chrome-trace export.
+
+Reference parity: ``paddle.profiler.Profiler``
+(python/paddle/profiler/profiler.py:340 — scheduler states CLOSED/READY/
+RECORD/RECORD_AND_RETURN, ``make_scheduler`` :114, ``export_chrome_tracing``
+:212, ``summary`` :832), ``RecordEvent`` scoped annotations
+(python/paddle/profiler/utils.py:37, C++ shape at
+paddle/fluid/platform/profiler/event_tracing.h:36) and the chrome-tracing
+serializer (paddle/fluid/platform/profiler/chrometracing_logger.cc).
+
+TPU-native split of responsibilities:
+
+- **Device timeline** belongs to XLA: during RECORD windows the profiler
+  drives ``jax.profiler.start_trace/stop_trace``, producing an xplane
+  protobuf + perfetto trace under ``<dir>/plugins/profile/...`` — the
+  counterpart of the reference's CUPTI tracer (cuda_tracer.cc). Per-op host
+  interception would only measure dispatch, not the fused XLA program.
+- **Host annotations** are this module: ``RecordEvent`` records wall-time
+  spans into the active profiler AND enters a ``jax.profiler.TraceAnnotation``
+  so the span shows up inside the device trace, mirroring the reference's
+  host_tracer + RecordEvent bridge.
+- ``summary()`` prints the host-event and step-time tables the reference
+  builds in profiler_statistic.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "Profiler", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "export_protobuf",
+    "load_profiler_result", "SortedKeys",
+]
+
+
+class ProfilerState(Enum):
+    """reference: profiler.py:79."""
+
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    """reference: profiler.py:99 (CPU/GPU/CUSTOM_DEVICE) + TPU first-class."""
+
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SortedKeys(Enum):
+    """reference: profiler_statistic.py SortedKeys — summary sort orders."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference: profiler.py:114 — step-indexed state machine:
+    skip_first -> (closed -> ready -> record[last=RETURN]) x repeat."""
+    if closed < 0 or ready < 0 or record <= 0 or repeat < 0 or skip_first < 0:
+        raise ValueError("make_scheduler: closed/ready >= 0, record >= 1")
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    """Always-on (reference default_prof_scheduler)."""
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """reference: profiler.py:212 — returns an on_trace_ready handler that
+    writes ``<worker>_time.paddle_trace.json`` chrome://tracing files."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    seq = [0]
+
+    def handle(prof: "Profiler"):
+        w = worker_name or f"host_{socket.gethostname()}_{os.getpid()}"
+        seq[0] += 1
+        path = os.path.join(
+            dir_name,
+            f"{w}_time_{time.strftime('%Y_%m_%d_%H_%M_%S')}_w{seq[0]}"
+            ".paddle_trace.json")
+        prof._write_chrome_trace(path)
+        prof._last_export_path = path
+
+    return handle
+
+
+def export_protobuf(dir_name: str,
+                    worker_name: Optional[str] = None) -> Callable:
+    """reference: profiler.py:267. The device-side protobuf is the xplane
+    dump jax.profiler already wrote under the trace dir; host events are
+    exported as chrome JSON next to it (one artifact dir)."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    """reference: profiler.py load_profiler_result — reload an exported
+    chrome trace for inspection."""
+    with open(filename) as f:
+        return json.load(f)
+
+
+# --------------------------------------------------------------- record event
+_active_profiler: Optional["Profiler"] = None
+
+
+class RecordEvent:
+    """reference: utils.py:37 / event_tracing.h:36 — user-scoped span.
+
+    Records host wall-time into the active Profiler (when RECORDing) and
+    enters a jax TraceAnnotation so the span also appears on the device
+    timeline inside xplane traces.
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def __call__(self, func):
+        import functools
+
+        @functools.wraps(func)
+        def wrapped(*args, **kwargs):
+            with RecordEvent(self.name):
+                return func(*args, **kwargs)
+
+        return wrapped
+
+    def begin(self):
+        prof = _active_profiler
+        if prof is None or not prof._recording:
+            return
+        try:
+            import jax.profiler as jprof
+
+            self._ann = jprof.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._ann = None
+        prof = _active_profiler
+        if prof is not None and prof._recording:
+            prof._add_event(self.name, self._t0, dt)
+        self._t0 = None
+
+
+# ------------------------------------------------------------------- profiler
+class Profiler:
+    """reference: profiler.py:340.
+
+    ``targets`` defaults to {CPU, TPU}; the TPU target drives
+    ``jax.profiler`` tracing (xplane + perfetto artifacts) during RECORD
+    windows, written under ``trace_dir``.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None,
+                 trace_dir: str = "./profiler_log"):
+        self.targets = set(targets) if targets is not None else {
+            ProfilerTarget.CPU, ProfilerTarget.TPU}
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=min(start, 1),
+                record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready or export_chrome_tracing(
+            trace_dir)
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self.current_state = ProfilerState.CLOSED
+        self.step_num = 0
+        self._events: list = []  # (name, t0, dur_s) — current window
+        self._step_times: list = []  # (t_start, dur_s) — current window
+        self._window_step0 = 0
+        # run-cumulative copies for summary(); windows clear the live buffers
+        self._hist_events: list = []
+        self._hist_step_times: list = []
+        self._step_t0 = None
+        self._recording = False
+        self._jax_trace_on = False
+        self._last_export_path = None
+        self._benchmark = _Benchmark()
+
+    # -- lifecycle ----------------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        """reference: profiler.py Profiler.start."""
+        global _active_profiler
+        _active_profiler = self
+        self.current_state = self._scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._step_t0 = time.perf_counter()
+        self._benchmark.begin()
+
+    def stop(self):
+        """reference: profiler.py Profiler.stop."""
+        global _active_profiler
+        self._stop_jax_trace()
+        if self._recording:
+            self._recording = False
+            self._flush_window()
+        if _active_profiler is self:
+            _active_profiler = None
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        """Advance the scheduler by one iteration boundary
+        (reference: profiler.py Profiler.step)."""
+        now = time.perf_counter()
+        if self._step_t0 is not None and self._recording:
+            self._step_times.append((self._step_t0, now - self._step_t0))
+        self._step_t0 = now
+        self._benchmark.step(num_samples)
+        old = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        self._transition(old, self.current_state)
+
+    def step_info(self, unit: str = "samples") -> str:
+        """reference: timer.py Benchmark.step_info — 'reader_cost avg ips'."""
+        return self._benchmark.step_info(unit)
+
+    # -- state machine ------------------------------------------------------
+    def _transition(self, old: ProfilerState, new: ProfilerState):
+        rec_states = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        was = old in rec_states
+        now = new in rec_states
+        if not was and now:
+            self._recording = True
+            self._start_jax_trace()
+        if was and old == ProfilerState.RECORD_AND_RETURN:
+            # window closed at the step boundary: flush
+            self._stop_jax_trace()
+            self._recording = False
+            self._flush_window()
+            self._recording = now
+            if now:
+                self._start_jax_trace()
+        elif was and not now:
+            self._stop_jax_trace()
+            self._recording = False
+            self._flush_window()
+
+    def _start_jax_trace(self):
+        if self.timer_only or ProfilerTarget.TPU not in self.targets:
+            return
+        try:
+            import jax.profiler as jprof
+
+            jprof.start_trace(self.trace_dir)
+            self._jax_trace_on = True
+        except Exception:
+            self._jax_trace_on = False
+
+    def _stop_jax_trace(self):
+        if not self._jax_trace_on:
+            return
+        try:
+            import jax.profiler as jprof
+
+            jprof.stop_trace()
+        except Exception:
+            pass
+        self._jax_trace_on = False
+
+    # -- event sink ---------------------------------------------------------
+    def _add_event(self, name: str, t0: float, dur: float):
+        self._events.append((name, t0, dur))
+
+    def _write_chrome_trace(self, path: str):
+        pid = os.getpid()
+        events = [{
+            "name": name, "ph": "X", "cat": "host",
+            "ts": t0 * 1e6, "dur": dur * 1e6, "pid": pid, "tid": 0,
+        } for name, t0, dur in self._events]
+        for i, (t0, dt) in enumerate(self._step_times):
+            events.append({"name": f"ProfileStep#{self._window_step0 + i}",
+                           "ph": "X", "cat": "step", "ts": t0 * 1e6,
+                           "dur": dt * 1e6, "pid": pid, "tid": 1})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def _flush_window(self):
+        """Export + reset per-window buffers so repeat windows don't
+        re-serialize earlier windows' events (reference per-window
+        semantics)."""
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        self._hist_events.extend(self._events)
+        self._hist_step_times.extend(self._step_times)
+        self._events = []
+        self._step_times = []
+        self._window_step0 = self.step_num
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        """reference: profiler.py:832 — print host-event + step-time tables."""
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+        lines = []
+        all_steps = self._hist_step_times + self._step_times
+        if all_steps:
+            ts = [dur for _, dur in all_steps]
+            lines.append("-" * 72)
+            lines.append(f"{'Step summary':<30}{'calls':>8}{'avg':>10}"
+                         f"{'min':>10}{'max':>10}  [{time_unit}]")
+            lines.append("-" * 72)
+            lines.append(
+                f"{'ProfileStep':<30}{len(ts):>8}"
+                f"{sum(ts) / len(ts) * unit:>10.3f}"
+                f"{min(ts) * unit:>10.3f}{max(ts) * unit:>10.3f}")
+        agg = {}
+        for name, _, dur in self._hist_events + self._events:
+            tot, cnt, mn, mx = agg.get(name, (0.0, 0, float("inf"), 0.0))
+            agg[name] = (tot + dur, cnt + 1, min(mn, dur), max(mx, dur))
+        if agg:
+            key = {
+                SortedKeys.CPUTotal: lambda kv: -kv[1][0],
+                SortedKeys.CPUAvg: lambda kv: -(kv[1][0] / kv[1][1]),
+                SortedKeys.CPUMax: lambda kv: -kv[1][3],
+                SortedKeys.CPUMin: lambda kv: kv[1][2],
+            }.get(sorted_by, lambda kv: -kv[1][0])
+            lines.append("-" * 72)
+            lines.append(f"{'Event (host)':<30}{'calls':>8}{'total':>10}"
+                         f"{'avg':>10}{'max':>10}  [{time_unit}]")
+            lines.append("-" * 72)
+            for name, (tot, cnt, mn, mx) in sorted(agg.items(), key=key):
+                lines.append(f"{name[:29]:<30}{cnt:>8}{tot * unit:>10.3f}"
+                             f"{tot / cnt * unit:>10.3f}{mx * unit:>10.3f}")
+        if self._last_export_path:
+            lines.append(f"chrome trace: {self._last_export_path}")
+        if self._jax_trace_on or (
+                ProfilerTarget.TPU in self.targets and not self.timer_only):
+            lines.append(f"device trace (xplane/perfetto): {self.trace_dir}"
+                         "/plugins/profile/")
+        out = "\n".join(lines) if lines else "(no profiling data recorded)"
+        print(out)
+        return out
+
+
+# ------------------------------------------------------------------ benchmark
+class _Benchmark:
+    """reference: timer.py:349 Benchmark — reader cost + ips tracking."""
+
+    def __init__(self):
+        self._t0 = None
+        self._steps = 0
+        self._samples = 0
+        self._elapsed = 0.0
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._elapsed += now - self._t0
+            self._steps += 1
+            if num_samples:
+                self._samples += num_samples
+        self._t0 = now
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._steps or self._elapsed <= 0:
+            return "avg_cost: -, ips: -"
+        avg = self._elapsed / self._steps
+        ips = (self._samples or self._steps) / self._elapsed
+        return f"avg_cost: {avg:.5f} sec, ips: {ips:.5f} {unit}/sec"
+
+
+def benchmark() -> _Benchmark:
+    """reference: timer.py:447 — global benchmark timer facade."""
+    global _global_benchmark
+    try:
+        return _global_benchmark
+    except NameError:
+        _global_benchmark = _Benchmark()
+        return _global_benchmark

@@ -1,0 +1,165 @@
+"""Profiler: scheduler states, RecordEvent spans, chrome-trace export,
+summary tables (reference: python/paddle/profiler/profiler.py:340,
+utils.py:37)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, export_chrome_tracing,
+                                 load_profiler_result, make_scheduler)
+
+
+class TestScheduler:
+    def test_make_scheduler_states(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states == [
+            ProfilerState.CLOSED,      # skip_first
+            ProfilerState.CLOSED,      # closed
+            ProfilerState.READY,       # ready
+            ProfilerState.RECORD,      # record
+            ProfilerState.RECORD_AND_RETURN,  # last record step
+            ProfilerState.CLOSED,      # repeat exhausted
+        ]
+
+    def test_default_scheduler_always_records(self):
+        p = Profiler(targets=[ProfilerTarget.CPU], trace_dir="/tmp/_pt_prof0")
+        assert p._scheduler(0) == ProfilerState.RECORD
+
+    def test_bad_scheduler_args(self):
+        with pytest.raises(ValueError):
+            make_scheduler(closed=-1, ready=0, record=1)
+        with pytest.raises(ValueError):
+            make_scheduler(closed=0, ready=0, record=0)
+
+
+class TestProfiler:
+    def test_record_events_and_export(self, tmp_path):
+        traced = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                              repeat=1),
+                     on_trace_ready=export_chrome_tracing(str(tmp_path)),
+                     trace_dir=str(tmp_path))
+        p.start()
+        for _ in range(2):
+            with RecordEvent("forward"):
+                x = paddle.to_tensor(np.ones((4, 4), "float32"))
+                (x @ x).numpy()
+            with RecordEvent("backward"):
+                pass
+            p.step()
+        p.stop()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.endswith(".paddle_trace.json")]
+        assert files, "no chrome trace exported"
+        trace = load_profiler_result(os.path.join(tmp_path, files[0]))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "forward" in names and "backward" in names
+        for e in trace["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0
+
+    def test_record_event_noop_when_closed(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=lambda step: ProfilerState.CLOSED,
+                     trace_dir=str(tmp_path))
+        p.start()
+        with RecordEvent("invisible"):
+            pass
+        p.stop()
+        assert p._events == []
+
+    def test_record_event_decorator_and_begin_end(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU], trace_dir=str(tmp_path),
+                     on_trace_ready=lambda prof: None)
+        p.start()
+
+        @RecordEvent("decorated")
+        def f():
+            return 1
+
+        f()
+        ev = RecordEvent("manual")
+        ev.begin()
+        ev.end()
+        p.stop()
+        names = [n for n, _, _ in p._hist_events + p._events]
+        assert "decorated" in names and "manual" in names
+
+    def test_summary_table(self, tmp_path, capsys):
+        p = Profiler(targets=[ProfilerTarget.CPU], trace_dir=str(tmp_path),
+                     on_trace_ready=lambda prof: None)
+        p.start()
+        for _ in range(3):
+            with RecordEvent("matmul"):
+                pass
+            p.step()
+        p.stop()
+        out = p.summary()
+        assert "matmul" in out and "ProfileStep" in out
+
+    def test_step_info_ips(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU], trace_dir=str(tmp_path),
+                     on_trace_ready=lambda prof: None, timer_only=True)
+        p.start()
+        p.step(num_samples=32)
+        p.step(num_samples=32)
+        info = p.step_info()
+        assert "ips" in info and "avg_cost" in info
+        p.stop()
+
+    def test_context_manager_with_repeat_windows(self, tmp_path):
+        exports = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                              repeat=2),
+                     on_trace_ready=lambda prof: exports.append(
+                         len(prof._events)),
+                     trace_dir=str(tmp_path))
+        with p:
+            for _ in range(4):
+                with RecordEvent("work"):
+                    pass
+                p.step()
+        assert len(exports) == 2  # one flush per completed record window
+
+    def test_windows_do_not_duplicate_events(self, tmp_path):
+        """Each record window flushes only its own events (per-window
+        reference semantics), and exports get unique filenames."""
+        exports = []
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                              repeat=2),
+                     on_trace_ready=lambda prof: exports.append(
+                         [n for n, _, _ in prof._events]),
+                     trace_dir=str(tmp_path))
+        with p:
+            for i in range(4):
+                if p.current_state.name.startswith("RECORD"):
+                    with RecordEvent(f"work{i}"):
+                        pass
+                p.step()
+        assert exports == [["work1"], ["work3"]]
+
+    def test_step_events_exported_with_timestamps(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=export_chrome_tracing(str(tmp_path)),
+                     trace_dir=str(tmp_path))
+        p.start()
+        for _ in range(3):
+            with RecordEvent("op"):
+                pass
+            p.step()
+        p.stop()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.endswith(".paddle_trace.json")]
+        trace = load_profiler_result(os.path.join(tmp_path, files[0]))
+        steps = [e for e in trace["traceEvents"] if e["cat"] == "step"]
+        assert len(steps) == 3
+        assert all(e["ts"] > 0 and e["dur"] >= 0 for e in steps)
