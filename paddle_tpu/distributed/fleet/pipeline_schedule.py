@@ -298,8 +298,8 @@ def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
     cache = getattr(stack, "_1f1b_cache", None)
     if cache is None:
         cache = stack._1f1b_cache = {}
-    key = (M, xt.shape, str(xt._value.dtype), yt.shape, str(yt._value.dtype),
-           id(loss_fn), id(prefix))
+    key = (M, tuple(xt.shape), str(xt._value.dtype), tuple(yt.shape),
+           str(yt._value.dtype), id(loss_fn), id(prefix))
     hit = cache.get(key)
     if hit is not None:
         # cache hit: the compiled program already bakes the pure closures —
