@@ -304,7 +304,7 @@ def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
     if hit is not None:
         # cache hit: the compiled program already bakes the pure closures —
         # only the cell lists (traced-input order) are needed per call
-        jitted, prefix_cells, loss_cells = hit
+        jitted, prefix_cells, loss_cells = hit[:3]
         return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
                          grad_scale)
     prefix_pure, prefix_cells = _functionalize(prefix, prefix_params)
@@ -431,7 +431,9 @@ def pipeline_1f1b_train(stack: StackedPipelineBlocks, x, y, loss_fn,
         return mapped(mb_x, mb_y, pvals, lvals, *stacked_vals)
 
     jitted = jax.jit(fn)
-    cache[key] = (jitted, prefix_cells, loss_cells)
+    # the trailing refs pin loss_fn/prefix alive so the id()s in `key`
+    # cannot be recycled onto new closures while this entry exists
+    cache[key] = (jitted, prefix_cells, loss_cells, loss_fn, prefix)
     return _run_1f1b(stack, jitted, xt, yt, prefix_cells, loss_cells,
                      grad_scale)
 

@@ -1,0 +1,19 @@
+"""paddle_tpu.distributed.launch — multi-process/multi-host job launcher.
+
+Reference parity: ``python -m paddle.distributed.launch``
+(python/paddle/distributed/launch/main.py:18) with the collective
+controller (launch/controllers/collective.py): it materializes the
+PADDLE_TRAINER_* env contract consumed by ``init_parallel_env``
+(distributed/parallel.py) and supervises worker processes.
+
+TPU-native: rendezvous is the JAX distributed runtime's coordination
+service (MASTER_ADDR/MASTER_PORT → ``jax.distributed.initialize``), not a
+hand-rolled TCPStore; on TPU pods the typical layout is one process per
+host (``--nproc_per_node 1``) with the device mesh spanning hosts via ICI,
+so the launcher's job is env wiring + supervision, not NCCL ring setup.
+The parameter-server and IPU controllers of the reference are
+GPU/CPU-recsys specific and intentionally out of scope (SURVEY.md §7).
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,193 @@
+"""Launcher implementation (reference: launch/main.py:18 + controllers/).
+
+``python -m paddle_tpu.distributed.launch [--nnodes N] [--nproc_per_node P]
+[--master HOST:PORT] [--rank R] [--log_dir DIR] [--max_restarts K]
+script.py [script args...]``
+
+Env contract written for every worker (consumed by
+``paddle_tpu.distributed.env`` / ``init_parallel_env``):
+
+- ``PADDLE_TRAINER_ID``        global rank
+- ``PADDLE_TRAINERS_NUM``      world size
+- ``PADDLE_LOCAL_RANK``        rank within this node
+- ``PADDLE_TRAINER_ENDPOINTS`` comma list of worker endpoints
+- ``PADDLE_CURRENT_ENDPOINT``  this worker's endpoint
+- ``MASTER_ADDR`` / ``MASTER_PORT`` coordination-service address
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher (collective jobs)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes (int, or 'N:M' elastic range — the "
+                        "lower bound is used; full elasticity via "
+                        "paddle_tpu.distributed.elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="workers on this node (default: 1 — one process per "
+                        "TPU host)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordination address host:port (default: "
+                        "127.0.0.1:<free port> single-node)")
+    p.add_argument("--rank", type=int, default=0,
+                   help="this node's rank (multi-node)")
+    p.add_argument("--log_dir", type=str, default="log",
+                   help="per-worker log directory")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the job up to K times if a worker fails")
+    p.add_argument("--run_mode", type=str, default="collective",
+                   help="only 'collective' is supported (ps/rpc are "
+                        "out of scope on TPU)")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="accepted for reference-CLI compat; TPU visibility "
+                        "is managed by the runtime")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class _Worker:
+    def __init__(self, proc: subprocess.Popen, rank: int, log_path: str):
+        self.proc = proc
+        self.rank = rank
+        self.log_path = log_path
+
+
+def _spawn_workers(args, master: str, node_rank: int, nnodes: int,
+                   nproc: int) -> List[_Worker]:
+    world = nnodes * nproc
+    host = master.split(":")[0] if nnodes == 1 else socket.gethostname()
+    # endpoint list covers THIS NODE's workers only: peer addresses on other
+    # nodes are not knowable without a gather, and inventing them would hand
+    # consumers bogus addresses. Cross-host identity comes from MASTER_ADDR +
+    # rank/world (the JAX coordination service); single-node jobs still see
+    # the full world list (reference behavior).
+    local_endpoints = [f"{host}:{_free_port()}" for _ in range(nproc)]
+    os.makedirs(args.log_dir, exist_ok=True)
+    workers = []
+    for local in range(nproc):
+        rank = node_rank * nproc + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(local_endpoints),
+            "PADDLE_CURRENT_ENDPOINT": local_endpoints[local],
+            "MASTER_ADDR": master.split(":")[0],
+            "MASTER_PORT": master.split(":")[1],
+        })
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        if rank == 0:
+            # rank 0 streams to the console (reference behavior)
+            proc = subprocess.Popen(
+                [sys.executable, "-u", args.training_script]
+                + args.training_script_args, env=env)
+        else:
+            with open(log_path, "w") as out:
+                proc = subprocess.Popen(
+                    [sys.executable, "-u", args.training_script]
+                    + args.training_script_args,
+                    env=env, stdout=out, stderr=subprocess.STDOUT)
+        workers.append(_Worker(proc, rank, log_path))
+    return workers
+
+
+def _supervise(workers: List[_Worker]) -> int:
+    """Wait for all workers; on any failure kill the rest (reference
+    controller.watch). Returns the job's exit code."""
+    try:
+        while True:
+            alive = 0
+            for w in workers:
+                rc = w.proc.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    print(f"[launch] worker {w.rank} failed rc={rc} "
+                          f"(log: {w.log_path}); terminating job",
+                          file=sys.stderr, flush=True)
+                    for o in workers:
+                        if o.proc.poll() is None:
+                            o.proc.terminate()
+                    for o in workers:
+                        try:
+                            o.proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            o.proc.kill()
+                    return rc
+            if alive == 0:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for w in workers:
+            if w.proc.poll() is None:
+                w.proc.send_signal(signal.SIGINT)
+        for w in workers:
+            w.proc.wait()
+        return 130
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.run_mode != "collective":
+        raise SystemExit(
+            f"run_mode={args.run_mode!r} is not supported: the brpc "
+            "parameter-server stack is GPU/CPU-recsys specific "
+            "(SURVEY.md §7); only collective jobs run on TPU")
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node if args.nproc_per_node is not None else 1
+    if nnodes > 1 and not args.master:
+        raise SystemExit(
+            "--master host:port is required for multi-node jobs: a per-node "
+            "default coordinator address can never rendezvous")
+    master = args.master or f"127.0.0.1:{_free_port()}"
+
+    from ..fleet.elastic import ELASTIC_EXIT_CODE
+
+    attempt = 0
+    while True:
+        t0 = time.time()
+        print(f"[launch] nnodes={nnodes} nproc_per_node={nproc} "
+              f"master={master} node_rank={args.rank} "
+              f"(attempt {attempt + 1})", file=sys.stderr, flush=True)
+        workers = _spawn_workers(args, master, args.rank, nnodes, nproc)
+        rc = _supervise(workers)
+        if rc == 0:
+            print(f"[launch] job finished in {time.time() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            return 0
+        if rc == ELASTIC_EXIT_CODE:
+            # elastic scale event: always re-form at the new world size
+            # (manager.py:30 contract) — not counted against max_restarts
+            print("[launch] elastic scale event (rc=101): relaunching",
+                  file=sys.stderr, flush=True)
+            continue
+        if attempt >= args.max_restarts:
+            return rc
+        attempt += 1
+        print(f"[launch] restarting ({attempt}/{args.max_restarts})",
+              file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    sys.exit(launch())

@@ -1,0 +1,99 @@
+"""Elastic manager: registry/heartbeat/scale-watch/relaunch contract
+(reference: fleet/elastic/manager.py:124)."""
+import os
+import time
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, FileStore)
+
+
+def test_exit_code_contract():
+    assert ELASTIC_EXIT_CODE == 101
+
+
+def test_register_and_liveness(tmp_path):
+    store = FileStore(str(tmp_path), ttl=5.0)
+    a = ElasticManager(np="2", host="hostA", store=store,
+                       heartbeat_interval=0.1)
+    b = ElasticManager(np="2", host="hostB", store=store,
+                       heartbeat_interval=0.1)
+    a.register()
+    b.register()
+    time.sleep(0.3)
+    assert set(store.hosts()) == {"hostA", "hostB"}
+    a.exit(completed=True)
+    b.exit(completed=True)
+    assert store.hosts() == []
+
+
+def test_scale_in_detected_and_env_rewritten(tmp_path):
+    store = FileStore(str(tmp_path), ttl=0.5)
+    a = ElasticManager(np="1:3", host="hostA", store=store,
+                       heartbeat_interval=0.1)
+    b = ElasticManager(np="1:3", host="hostB", store=store,
+                       heartbeat_interval=0.1)
+    a.register()
+    b.register()
+    time.sleep(0.3)
+    assert len(a.hosts()) == 2
+    # absorb the scale-out event from hostB joining after a's baseline
+    assert a.watch(interval=0.1, timeout=10) == ElasticStatus.RESTART
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    # hostB dies (heartbeat stops, ttl expires)
+    b._stop.set()
+    b._hb_thread.join()
+    status = a.watch(interval=0.1, timeout=10)
+    assert status == ElasticStatus.RESTART
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+    assert os.environ["PADDLE_TRAINER_ENDPOINTS"] == "hostA"
+    assert os.environ["PADDLE_TRAINER_ID"] == "0"
+    a.exit(completed=True)
+    for k in ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+              "PADDLE_TRAINER_ID"):
+        os.environ.pop(k, None)
+
+
+def test_scale_out_detected(tmp_path):
+    store = FileStore(str(tmp_path), ttl=5.0)
+    a = ElasticManager(np="1:3", host="hostA", store=store,
+                       heartbeat_interval=0.1)
+    a.register()
+    time.sleep(0.2)
+    assert a.watch(interval=0.05, timeout=0.3) == ElasticStatus.HOLD
+    c = ElasticManager(np="1:3", host="hostC", store=store,
+                       heartbeat_interval=0.1)
+    c.register()
+    status = a.watch(interval=0.05, timeout=10)
+    assert status == ElasticStatus.RESTART
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    a.exit()
+    c.exit()
+    for k in ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+              "PADDLE_TRAINER_ID"):
+        os.environ.pop(k, None)
+
+
+def test_disabled_when_np_zero(tmp_path):
+    m = ElasticManager(np="0", store=FileStore(str(tmp_path)))
+    assert not m.enable
+    m.register()  # no-op
+    assert m.watch() == ElasticStatus.COMPLETED
+
+
+def test_below_quorum_exits_after_deadline(tmp_path):
+    """Losing quorum holds for rejoin until the deadline, then EXITs
+    (the teardown path — regression: EXIT used to be unreachable)."""
+    store = FileStore(str(tmp_path), ttl=0.4)
+    a = ElasticManager(np="2:3", host="hostA", store=store,
+                       heartbeat_interval=0.1)
+    b = ElasticManager(np="2:3", host="hostB", store=store,
+                       heartbeat_interval=0.1)
+    a.register()
+    b.register()
+    time.sleep(0.3)
+    a.watch(interval=0.05, timeout=5)  # absorb hostB's join
+    b._stop.set()
+    b._hb_thread.join()
+    status = a.watch(interval=0.1, timeout=2.0)
+    assert status == ElasticStatus.EXIT
+    a.exit()
