@@ -9,6 +9,7 @@ annotated for the mesh.
 """
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
     new_group, reduce, scatter, wait,

@@ -154,16 +154,24 @@ class ElasticManager:
                 return ElasticStatus.COMPLETED
             hosts = self.hosts()
             n = len(hosts)
-            if set(hosts) != set(self._last_hosts):
+            # the effective set is capped at np_max (the declared range's
+            # upper bound): extra joiners beyond it don't re-form the job
+            eff = sorted(hosts)[: self.np_max] if self.np_max else hosts
+            base = sorted(self._last_hosts)[: self.np_max] \
+                if self.np_max else self._last_hosts
+            if set(eff) != set(base):
                 if n < self.np_min:
                     # below quorum: keep the baseline (so the deficit stays
                     # observable) and poll for rejoin until the deadline —
                     # then EXIT, the reference's teardown path
                     below_quorum = True
+                elif self.host not in eff:
+                    # scaled past np_max and this node lost the slot race
+                    return ElasticStatus.EXIT
                 else:
                     self._last_hosts = hosts
                     # quorum intact at a NEW world size: rewrite env, restart
-                    self._rewrite_env(hosts)
+                    self._rewrite_env(eff)
                     return ElasticStatus.RESTART
             else:
                 below_quorum = False

@@ -143,7 +143,11 @@ def _supervise(workers: List[_Worker]) -> int:
             if w.proc.poll() is None:
                 w.proc.send_signal(signal.SIGINT)
         for w in workers:
-            w.proc.wait()
+            try:
+                w.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()  # escalate past SIGINT-masking trainers
+                w.proc.wait()
         return 130
 
 
@@ -162,7 +166,18 @@ def launch(argv: Optional[List[str]] = None) -> int:
             "default coordinator address can never rendezvous")
     master = args.master or f"127.0.0.1:{_free_port()}"
 
-    from ..fleet.elastic import ELASTIC_EXIT_CODE
+    from ..fleet.elastic import ELASTIC_EXIT_CODE, ElasticManager
+
+    # elastic jobs: the LAUNCHER owns node registration (stable hostname
+    # identity, lives across trainer relaunches) so rc=101 can re-derive the
+    # node set — env rewrites inside a dying trainer are lost with it
+    elastic = None
+    if os.environ.get("PADDLE_ELASTIC_NP"):
+        elastic = ElasticManager(host=socket.gethostname())
+        if elastic.enable:
+            elastic.register()
+        else:
+            elastic = None
 
     attempt = 0
     while True:
@@ -175,14 +190,30 @@ def launch(argv: Optional[List[str]] = None) -> int:
         if rc == 0:
             print(f"[launch] job finished in {time.time() - t0:.1f}s",
                   file=sys.stderr, flush=True)
+            if elastic is not None:
+                elastic.exit(completed=True)
             return 0
-        if rc == ELASTIC_EXIT_CODE:
-            # elastic scale event: always re-form at the new world size
+        if rc == ELASTIC_EXIT_CODE and elastic is not None:
+            # scale event: re-form at the CURRENT registry membership
             # (manager.py:30 contract) — not counted against max_restarts
-            print("[launch] elastic scale event (rc=101): relaunching",
+            time.sleep(2.0)  # let departures expire / arrivals register
+            hosts = sorted(elastic.hosts())
+            if hosts:
+                nnodes = len(hosts)
+                try:
+                    args.rank = hosts.index(elastic.host)
+                except ValueError:
+                    print("[launch] this node left the elastic set; exiting",
+                          file=sys.stderr, flush=True)
+                    elastic.exit()
+                    return 0
+            print(f"[launch] elastic scale event: re-forming with "
+                  f"nnodes={nnodes} rank={args.rank}",
                   file=sys.stderr, flush=True)
             continue
         if attempt >= args.max_restarts:
+            if elastic is not None:
+                elastic.exit()
             return rc
         attempt += 1
         print(f"[launch] restarting ({attempt}/{args.max_restarts})",

@@ -385,9 +385,32 @@ class StaticFunction:
         self._opts: list[Optimizer] = []
         self._layers: list[Layer] = []
         self._cache: dict = {}
+        self._abstract_args: dict = {}  # cache key -> ShapeDtypeStruct tree
         self._warmed_up = False
         self.__name__ = getattr(function, "__name__", "static_fn")
         self.__doc__ = getattr(function, "__doc__", None)
+
+    # -- introspection -------------------------------------------------------
+    def cost_analysis(self, key=None) -> Optional[dict]:
+        """XLA cost analysis (flops / bytes accessed / ...) of a compiled
+        signature — the TPU answer to the reference auto_parallel cost model
+        (engine.py:1751, auto_parallel/cost/). ``key=None`` picks the most
+        recent signature. Returns None before any call compiled."""
+        if not self._cache:
+            return None
+        if key is None:
+            key = next(reversed(self._abstract_args)) \
+                if self._abstract_args else None
+        compiled = self._cache.get(key)
+        abstract = self._abstract_args.get(key)
+        if compiled is None or abstract is None:
+            return None
+        state_s, lr_s, arr_s = abstract
+        lowered = compiled.jitted.lower(state_s, lr_s, arr_s)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost) if cost else {}
 
     # -- paddle API surface --------------------------------------------------
     @property
@@ -487,6 +510,10 @@ class StaticFunction:
             self._cache[key] = compiled
         state_vals = _unalias([s.get() for s in self._slots], arrays)
         lr_vals = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._opts]
+        self._abstract_args.pop(key, None)  # move-to-end: dict order = recency
+        self._abstract_args[key] = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (state_vals, lr_vals, list(arrays)))
         out_arrays, new_state = compiled.jitted(state_vals, lr_vals, arrays)
         for slot, v in zip(self._slots, new_state):
             slot.set(v)
