@@ -42,6 +42,7 @@ from .hapi import Model  # noqa: E402
 from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import device  # noqa: E402
+from . import distribution  # noqa: E402
 from . import profiler  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402

@@ -1,0 +1,25 @@
+"""paddle.distribution parity package (reference:
+python/paddle/distribution/__init__.py)."""
+from .distribution import Distribution, kl_divergence, register_kl  # noqa: F401
+from .distributions import (  # noqa: F401
+    Bernoulli, Beta, Categorical, Dirichlet, ExponentialFamily, Geometric,
+    Gumbel, Independent, Laplace, LogNormal, Multinomial, Normal,
+    TransformedDistribution, Uniform,
+)
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+
+__all__ = [
+    "Distribution", "kl_divergence", "register_kl",
+    "Bernoulli", "Beta", "Categorical", "Dirichlet", "ExponentialFamily",
+    "Geometric", "Gumbel", "Independent", "Laplace", "LogNormal",
+    "Multinomial", "Normal", "TransformedDistribution", "Uniform",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

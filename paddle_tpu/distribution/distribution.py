@@ -1,0 +1,125 @@
+"""Distribution base + KL registry.
+
+Reference parity: ``Distribution``
+(python/paddle/distribution/distribution.py), ``kl_divergence`` /
+``register_kl`` (python/paddle/distribution/kl.py:35,67).
+
+TPU-native: every density/statistic is pure Tensor math on the eager tape —
+``log_prob`` is differentiable and jit-traceable by construction; sampling
+draws keys from the global threefry Generator (generator.py) so sample
+streams are reproducible and capturable as compiled-step state.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple, Type
+
+import numpy as np
+
+from .. import ops
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["Distribution", "kl_divergence", "register_kl"]
+
+
+class Distribution:
+    """reference: distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        """Draw without gradient (stop_gradient=True)."""
+        with _no_grad():
+            s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return ops.exp(self.log_prob(value))
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        return kl_divergence(self, other)
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _validate(value) -> Tensor:
+        return ensure_tensor(value)
+
+    def _extend_shape(self, sample_shape) -> Tuple[int, ...]:
+        if isinstance(sample_shape, int):
+            sample_shape = (sample_shape,)
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")
+
+
+def _no_grad():
+    from ..autograd import no_grad
+
+    return no_grad()
+
+
+# ----------------------------------------------------------------- KL registry
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(cls_p: Type, cls_q: Type):
+    """reference: kl.py:67 — decorator registering a pairwise KL rule."""
+    if not (issubclass(cls_p, Distribution) and
+            issubclass(cls_q, Distribution)):
+        raise TypeError("cls_p and cls_q must be subclass of Distribution")
+
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """reference: kl.py:35 — dispatch on the most-derived registered pair."""
+    matches = [
+        (cp, cq) for (cp, cq) in _KL_REGISTRY
+        if isinstance(p, cp) and isinstance(q, cq)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p || q) rule registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+
+    def specificity(pair):
+        cp, cq = pair
+        return (len(type(p).__mro__) - type(p).__mro__.index(cp),
+                len(type(q).__mro__) - type(q).__mro__.index(cq))
+
+    best = max(matches, key=specificity)
+    return _KL_REGISTRY[best](p, q)

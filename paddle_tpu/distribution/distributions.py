@@ -1,0 +1,710 @@
+"""Concrete distributions.
+
+Reference parity: python/paddle/distribution/{normal,uniform,bernoulli,
+beta,categorical,dirichlet,exponential_family,geometric,gumbel,laplace,
+lognormal,multinomial,independent,transformed_distribution}.py — same
+constructor/property/method surfaces, densities re-derived as pure Tensor
+math (differentiable, jit-traceable); sampling via jax.random with keys
+from the global Generator.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..nn import functional as F
+from ..generator import default_generator
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+from .distribution import (Distribution, _no_grad, kl_divergence,
+                           register_kl)
+
+__all__ = [
+    "Normal", "Uniform", "Bernoulli", "Beta", "Categorical", "Dirichlet",
+    "ExponentialFamily", "Geometric", "Gumbel", "Laplace", "LogNormal",
+    "Multinomial", "Independent", "TransformedDistribution",
+]
+
+
+def _t(x) -> Tensor:
+    t = ensure_tensor(x)
+    if not np.issubdtype(np.dtype(str(t._value.dtype)), np.floating):
+        t = t.astype("float32")
+    return t
+
+
+def _broadcast_shapes(*tensors) -> tuple:
+    return tuple(np.broadcast_shapes(*(tuple(t.shape) for t in tensors)))
+
+
+def _sample_op(fn, shape, *param_tensors, name: str):
+    """Run a jax.random draw through the tape so rsample is differentiable
+    w.r.t. the distribution parameters (reparameterization)."""
+    key = default_generator.next_key()
+    return apply_op(lambda *vals: fn(key, shape, *vals),
+                    [ensure_tensor(p) for p in param_tensors], name=name)
+
+
+class ExponentialFamily(Distribution):
+    """reference: exponential_family.py — entropy via the Bregman identity
+    is specialized per subclass here; the class exists for isinstance
+    parity and shared structure."""
+
+
+# --------------------------------------------------------------------- Normal
+class Normal(ExponentialFamily):
+    """reference: normal.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return ops.broadcast_to(self.loc, list(self.batch_shape)) \
+            if tuple(self.loc.shape) != self.batch_shape else self.loc
+
+    @property
+    def variance(self):
+        v = self.scale * self.scale
+        return ops.broadcast_to(v, list(self.batch_shape)) \
+            if tuple(v.shape) != self.batch_shape else v
+
+    @property
+    def stddev(self):
+        return ops.broadcast_to(self.scale, list(self.batch_shape)) \
+            if tuple(self.scale.shape) != self.batch_shape else self.scale
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        return _sample_op(
+            lambda key, s, loc, scale:
+                loc + scale * jax.random.normal(key, s, loc.dtype),
+            out_shape, self.loc, self.scale, name="normal_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) * (value - self.loc)) / (2.0 * var)
+                - ops.log(self.scale) - 0.5 * math.log(2.0 * math.pi))
+
+    def entropy(self):
+        return (0.5 + 0.5 * math.log(2.0 * math.pi)
+                + ops.log(self.scale)) * ops.ones_like(self.loc)
+
+    def cdf(self, value):
+        value = _t(value)
+        return 0.5 * (1.0 + ops.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2.0))))
+
+    def icdf(self, value):
+        value = _t(value)
+        return self.loc + self.scale * math.sqrt(2.0) * ops.erfinv(
+            2.0 * value - 1.0)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    var_ratio = (p.scale / q.scale)
+    var_ratio = var_ratio * var_ratio
+    t1 = (p.loc - q.loc) / q.scale
+    t1 = t1 * t1
+    return 0.5 * (var_ratio + t1 - 1.0 - ops.log(var_ratio))
+
+
+# -------------------------------------------------------------------- Uniform
+class Uniform(Distribution):
+    """reference: uniform.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(batch_shape=_broadcast_shapes(self.low, self.high))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        return _sample_op(
+            lambda key, s, low, high:
+                low + (high - low) * jax.random.uniform(key, s, low.dtype),
+            out_shape, self.low, self.high, name="uniform_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = ops.logical_and(value >= self.low, value < self.high)
+        dens = -ops.log(self.high - self.low)
+        neg_inf = ops.full_like(dens, -np.inf)
+        return ops.where(inside, dens * ops.ones_like(value),
+                         neg_inf * ops.ones_like(value))
+
+    def entropy(self):
+        return ops.log(self.high - self.low)
+
+    def cdf(self, value):
+        value = _t(value)
+        return ops.clip((value - self.low) / (self.high - self.low), 0.0, 1.0)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform):
+    return ops.log((q.high - q.low) / (p.high - p.low))
+
+
+# ------------------------------------------------------------------ Bernoulli
+class Bernoulli(ExponentialFamily):
+    """reference: bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = default_generator.next_key()
+        p = self.probs._value
+        return Tensor(jax.random.bernoulli(
+            key, p, out_shape).astype(p.dtype), stop_gradient=True)
+
+    rsample = sample  # discrete: no reparameterization (reference parity)
+
+    def log_prob(self, value):
+        value = _t(value)
+        eps = 1e-7
+        p = ops.clip(self.probs, eps, 1.0 - eps)
+        return value * ops.log(p) + (1.0 - value) * ops.log(1.0 - p)
+
+    def entropy(self):
+        eps = 1e-7
+        p = ops.clip(self.probs, eps, 1.0 - eps)
+        return -(p * ops.log(p) + (1.0 - p) * ops.log(1.0 - p))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p: Bernoulli, q: Bernoulli):
+    eps = 1e-7
+    pp = ops.clip(p.probs, eps, 1 - eps)
+    qp = ops.clip(q.probs, eps, 1 - eps)
+    return (pp * (ops.log(pp) - ops.log(qp))
+            + (1 - pp) * (ops.log(1 - pp) - ops.log(1 - qp)))
+
+
+# ----------------------------------------------------------------------- Beta
+class Beta(ExponentialFamily):
+    """reference: beta.py Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(
+            batch_shape=_broadcast_shapes(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        return _sample_op(
+            lambda key, s, a, b: jax.random.beta(key, a, b, s, a.dtype),
+            out_shape, self.alpha, self.beta, name="beta_sample")
+
+    def _log_norm(self):
+        return (ops.lgamma(self.alpha) + ops.lgamma(self.beta)
+                - ops.lgamma(self.alpha + self.beta))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return ((self.alpha - 1.0) * ops.log(value)
+                + (self.beta - 1.0) * ops.log1p(-value) - self._log_norm())
+
+    def entropy(self):
+        s = self.alpha + self.beta
+        return (self._log_norm()
+                - (self.alpha - 1.0) * ops.digamma(self.alpha)
+                - (self.beta - 1.0) * ops.digamma(self.beta)
+                + (s - 2.0) * ops.digamma(s))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p: Beta, q: Beta):
+    ps = p.alpha + p.beta
+    return ((ops.lgamma(q.alpha) + ops.lgamma(q.beta)
+             - ops.lgamma(q.alpha + q.beta))
+            - (ops.lgamma(p.alpha) + ops.lgamma(p.beta) - ops.lgamma(ps))
+            + (p.alpha - q.alpha) * ops.digamma(p.alpha)
+            + (p.beta - q.beta) * ops.digamma(p.beta)
+            + (q.alpha + q.beta - ps) * ops.digamma(ps))
+
+
+# ---------------------------------------------------------------- Categorical
+class Categorical(Distribution):
+    """reference: categorical.py Categorical(logits) — NOTE the reference
+    treats the input as unnormalized LOG-probabilities only through
+    softmax of logits; probs accessor provided."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(batch_shape=tuple(self.logits.shape[:-1]))
+        self._n = int(self.logits.shape[-1])
+
+    def probs(self, value):
+        """reference: categorical.py Categorical.probs(value) — the
+        probabilities of the given category indices (a METHOD in the
+        reference API, not a property)."""
+        return ops.exp(self.log_prob(value))
+
+    @property
+    def probs_tensor(self):
+        """Full probability vector softmax(logits)."""
+        return F.softmax(self.logits, axis=-1)
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no mean")
+
+    def sample(self, shape=()):
+        if isinstance(shape, int):
+            shape = (shape,)
+        key = default_generator.next_key()
+        out_shape = tuple(shape) + self.batch_shape
+        draw = jax.random.categorical(
+            key, self.logits._value, axis=-1, shape=out_shape)
+        return Tensor(draw, stop_gradient=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        logp = F.log_softmax(self.logits, axis=-1)
+        idx = value.astype("int64")
+        return ops.squeeze(
+            ops.take_along_axis(logp, ops.unsqueeze(idx, -1), axis=-1), -1)
+
+    def entropy(self):
+        logp = F.log_softmax(self.logits, axis=-1)
+        return -ops.sum(ops.exp(logp) * logp, axis=-1)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p: Categorical, q: Categorical):
+    logp = F.log_softmax(p.logits, axis=-1)
+    logq = F.log_softmax(q.logits, axis=-1)
+    return ops.sum(ops.exp(logp) * (logp - logq), axis=-1)
+
+
+# ------------------------------------------------------------------ Dirichlet
+class Dirichlet(ExponentialFamily):
+    """reference: dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(
+            batch_shape=tuple(self.concentration.shape[:-1]),
+            event_shape=tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.concentration / ops.sum(
+            self.concentration, axis=-1, keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = ops.sum(self.concentration, axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def rsample(self, shape=()):
+        if isinstance(shape, int):
+            shape = (shape,)
+        out_shape = tuple(shape) + self.batch_shape + self.event_shape
+        return _sample_op(
+            lambda key, s, c: jax.random.dirichlet(
+                key, jnp.broadcast_to(c, s), dtype=c.dtype),
+            out_shape, self.concentration, name="dirichlet_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        c = self.concentration
+        return (ops.sum((c - 1.0) * ops.log(value), axis=-1)
+                + ops.lgamma(ops.sum(c, axis=-1))
+                - ops.sum(ops.lgamma(c), axis=-1))
+
+    def entropy(self):
+        c = self.concentration
+        a0 = ops.sum(c, axis=-1)
+        k = float(self.event_shape[-1])
+        return (ops.sum(ops.lgamma(c), axis=-1) - ops.lgamma(a0)
+                + (a0 - k) * ops.digamma(a0)
+                - ops.sum((c - 1.0) * ops.digamma(c), axis=-1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p: Dirichlet, q: Dirichlet):
+    pc, qc = p.concentration, q.concentration
+    p0 = ops.sum(pc, axis=-1)
+    return (ops.lgamma(p0) - ops.sum(ops.lgamma(pc), axis=-1)
+            - ops.lgamma(ops.sum(qc, axis=-1))
+            + ops.sum(ops.lgamma(qc), axis=-1)
+            + ops.sum((pc - qc) * (ops.digamma(pc)
+                                   - ops.unsqueeze(ops.digamma(p0), -1)),
+                      axis=-1))
+
+
+# ------------------------------------------------------------------ Geometric
+class Geometric(Distribution):
+    """reference: geometric.py Geometric(probs) — #failures before the
+    first success, support {0, 1, 2, ...}."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs * self.probs)
+
+    @property
+    def stddev(self):
+        return ops.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = default_generator.next_key()
+        p = self.probs._value
+        u = jax.random.uniform(
+            key, out_shape, p.dtype, minval=jnp.finfo(p.dtype).tiny)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        eps = 1e-7
+        p = ops.clip(self.probs, eps, 1.0 - eps)
+        return value * ops.log1p(-p) + ops.log(p)
+
+    def entropy(self):
+        eps = 1e-7
+        p = ops.clip(self.probs, eps, 1.0 - eps)
+        q = 1.0 - p
+        return -(q * ops.log(q) + p * ops.log(p)) / p
+
+    def cdf(self, value):
+        value = _t(value)
+        return 1.0 - ops.pow(1.0 - self.probs, value + 1.0)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p: Geometric, q: Geometric):
+    return (-p.entropy()
+            - ops.log1p(-q.probs) * ((1.0 - p.probs) / p.probs)
+            - ops.log(q.probs))
+
+
+# -------------------------------------------------------------------- Laplace
+class Laplace(Distribution):
+    """reference: laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def draw(key, s, loc, scale):
+            finfo = jnp.finfo(loc.dtype)
+            u = jax.random.uniform(key, s, loc.dtype,
+                                   minval=-1.0 + finfo.eps, maxval=1.0)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-jnp.abs(u))
+
+        return _sample_op(draw, out_shape, self.loc, self.scale,
+                          name="laplace_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (-ops.log(2.0 * self.scale)
+                - ops.abs(value - self.loc) / self.scale)
+
+    def entropy(self):
+        return 1.0 + ops.log(2.0 * self.scale)
+
+    def cdf(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * ops.sign(z) * ops.expm1(-ops.abs(z))
+
+    def icdf(self, value):
+        value = _t(value)
+        term = value - 0.5
+        return self.loc - self.scale * ops.sign(term) * ops.log1p(
+            -2.0 * ops.abs(term))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p: Laplace, q: Laplace):
+    # KL = log(s_q/s_p) + |mu_p-mu_q|/s_q + s_p/s_q·exp(-|mu_p-mu_q|/s_p) - 1
+    adiff = ops.abs(p.loc - q.loc)
+    return (ops.log(q.scale / p.scale) + adiff / q.scale
+            + (p.scale / q.scale) * ops.exp(-adiff / p.scale) - 1.0)
+
+
+# ---------------------------------------------------------------- Multinomial
+class Multinomial(Distribution):
+    """reference: multinomial.py Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        p = _t(probs)
+        self.probs = p / ops.sum(p, axis=-1, keepdim=True)
+        super().__init__(batch_shape=tuple(p.shape[:-1]),
+                         event_shape=tuple(p.shape[-1:]))
+
+    @property
+    def mean(self):
+        return float(self.total_count) * self.probs
+
+    @property
+    def variance(self):
+        return float(self.total_count) * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        if isinstance(shape, int):
+            shape = (shape,)
+        key = default_generator.next_key()
+        logits = ops.log(self.probs)._value
+        out_shape = tuple(shape) + self.batch_shape
+        draws = jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(self.total_count,) + out_shape)  # [N, ...]
+        k = int(self.event_shape[-1])
+        counts = jax.nn.one_hot(draws, k, dtype=self.probs._value.dtype).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        logits = ops.log(self.probs)
+        return (ops.lgamma(ops.full([], float(self.total_count) + 1.0))
+                - ops.sum(ops.lgamma(value + 1.0), axis=-1)
+                + ops.sum(value * logits, axis=-1))
+
+    def entropy(self):
+        # exact: H = -log n! + sum_i E[log x_i!] - n * sum_i p_i log p_i,
+        # with x_i ~ Binomial(n, p_i) and E[log x_i!] summed over the
+        # binomial pmf (O(n·K) — n is a static python int)
+        n = self.total_count
+        p = self.probs
+        ks = ops.arange(0, n + 1, dtype="float32")       # [n+1]
+        log_binom = (ops.lgamma(ops.full([], float(n) + 1.0))
+                     - ops.lgamma(ks + 1.0) - ops.lgamma(float(n) - ks + 1.0))
+        pk = ops.unsqueeze(p, -1)                        # [..., K, 1]
+        eps = 1e-30
+        log_pmf = (log_binom + ks * ops.log(pk + eps)
+                   + (float(n) - ks) * ops.log(1.0 - pk + eps))
+        e_log_fact = ops.sum(ops.exp(log_pmf) * ops.lgamma(ks + 1.0), axis=-1)
+        return (-ops.lgamma(ops.full([], float(n) + 1.0))
+                + ops.sum(e_log_fact, axis=-1)
+                - float(n) * ops.sum(p * ops.log(p + eps), axis=-1))
+
+
+# ---------------------------------------------------------------- Independent
+class Independent(Distribution):
+    """reference: independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_rank: int, name=None):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank too large")
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - self._rank
+        super().__init__(batch_shape=shape[:split],
+                         event_shape=shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self._rank):
+            lp = ops.sum(lp, axis=-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self._rank):
+            e = ops.sum(e, axis=-1)
+        return e
+
+
+# ----------------------------------------------------- TransformedDistribution
+class TransformedDistribution(Distribution):
+    """reference: transformed_distribution.py — push a base distribution
+    through a chain of bijective Transforms (transform.py)."""
+
+    def __init__(self, base: Distribution, transforms, name=None):
+        from .transform import ChainTransform, Transform
+
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms) \
+            if len(self.transforms) != 1 else self.transforms[0]
+        # shape-changing transforms (Reshape, StickBreaking) alter the event
+        full = base.batch_shape + base.event_shape
+        out_full = tuple(self._chain.forward_shape(full))
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=out_full[:nb],
+                         event_shape=out_full[nb:])
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        with _no_grad():
+            y = self._chain.forward(x)
+        y.stop_gradient = True
+        return y
+
+    def log_prob(self, value):
+        value = _t(value)
+        x = self._chain.inverse(value)
+        return (self.base.log_prob(x)
+                - self._chain.forward_log_det_jacobian(x))
+
+
+# ------------------------------------------------- LogNormal / Gumbel (real)
+class LogNormal(TransformedDistribution):
+    """reference: lognormal.py — exp-transformed Normal."""
+
+    def __init__(self, loc, scale, name=None):
+        from .transform import ExpTransform
+
+        base = Normal(loc, scale)
+        self.loc = base.loc
+        self.scale = base.scale
+        super().__init__(base, [ExpTransform()])
+
+    @property
+    def mean(self):
+        return ops.exp(self.loc + self.scale * self.scale / 2.0)
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return ops.expm1(s2) * ops.exp(2.0 * self.loc + s2)
+
+    def entropy(self):
+        return self.base.entropy() + self.loc
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p: LogNormal, q: LogNormal):
+    return kl_divergence(p.base, q.base)
+
+
+class Gumbel(TransformedDistribution):
+    """reference: gumbel.py Gumbel(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        from .transform import AffineTransform
+
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        base = _StandardGumbel(_broadcast_shapes(self.loc, self.scale))
+        super().__init__(base, [AffineTransform(self.loc, self.scale)])
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * float(np.euler_gamma)
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return ops.sqrt(self.variance)
+
+    def log_prob(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return -(z + ops.exp(-z)) - ops.log(self.scale)
+
+    def entropy(self):
+        return ops.log(self.scale) + (1.0 + float(np.euler_gamma)) \
+            * ops.ones_like(self.scale)
+
+
+class _StandardGumbel(Distribution):
+    def __init__(self, shape):
+        super().__init__(batch_shape=tuple(shape))
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = default_generator.next_key()
+        return Tensor(jax.random.gumbel(key, out_shape), stop_gradient=False)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return -(value + ops.exp(-value))

@@ -1,0 +1,315 @@
+"""Bijective transforms for TransformedDistribution.
+
+Reference parity: python/paddle/distribution/transform.py — ``Transform``
+base with forward/inverse/forward_log_det_jacobian, and the concrete
+Affine/Exp/Sigmoid/Tanh/Power/Abs/Softmax/StickBreaking/Reshape/Chain/
+Independent transforms. Pure Tensor math on the tape (differentiable
+bijectors for free).
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from .. import ops
+from ..nn import functional as F
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    """reference: transform.py Transform."""
+
+    _type = "bijection"
+
+    def forward(self, x) -> Tensor:
+        return self._forward(ensure_tensor(x))
+
+    def inverse(self, y) -> Tensor:
+        return self._inverse(ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x) -> Tensor:
+        return self._forward_log_det_jacobian(ensure_tensor(x))
+
+    def inverse_log_det_jacobian(self, y) -> Tensor:
+        y = ensure_tensor(y)
+        return -self._forward_log_det_jacobian(self._inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference: transform.py AffineTransform)."""
+
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return ops.log(ops.abs(self.scale)) * ops.ones_like(x)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return ops.exp(x)
+
+    def _inverse(self, y):
+        return ops.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power."""
+
+    def __init__(self, power):
+        self.power = ensure_tensor(power)
+
+    def _forward(self, x):
+        return ops.pow(x, self.power)
+
+    def _inverse(self, y):
+        return ops.pow(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return ops.log(ops.abs(self.power * ops.pow(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def _forward(self, x):
+        return ops.sigmoid(x)
+
+    def _inverse(self, y):
+        return ops.log(y) - ops.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def _forward(self, x):
+        return ops.tanh(x)
+
+    def _inverse(self, y):
+        return ops.atanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log|dy/dx| = log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """y = |x| (not injective: inverse returns the positive branch)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return ops.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return ops.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (reference: not bijective on R^n —
+    inverse is log up to an additive constant)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return F.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return ops.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform has no well-defined log-det (rank deficient)")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K via stick breaking (reference parity)."""
+
+    def _forward(self, x):
+        offset = ops.cumsum(ops.ones_like(x), axis=-1)
+        k = float(x.shape[-1])
+        z = ops.sigmoid(x - ops.log(k - offset + 1.0))
+        zpad = ops.concat([z, ops.zeros_like(z[..., :1])], axis=-1)
+        one = ops.ones_like(zpad[..., :1])
+        cum = ops.cumprod(1.0 - zpad + 1e-30, dim=-1)
+        lead = ops.concat([one, cum[..., :-1]], axis=-1)
+        zfull = ops.concat([z, ops.ones_like(z[..., :1])], axis=-1)
+        return lead * zfull
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        one = ops.ones_like(y_crop[..., :1])
+        cum = 1.0 - ops.cumsum(y_crop, axis=-1)
+        lead = ops.concat([one, cum[..., :-1]], axis=-1)
+        frac = y_crop / lead
+        k = float(y.shape[-1] - 1)
+        offset = ops.cumsum(ops.ones_like(y_crop), axis=-1)
+        return (ops.log(frac) - ops.log1p(-frac)
+                + ops.log(k - offset + 1.0))
+
+    def _forward_log_det_jacobian(self, x):
+        # lower-triangular Jacobian: y_i = lead_i * z_i with lead_i = y_i/z_i,
+        # dy_i/dx_i = lead_i * z_i(1-z_i)
+        # => log|det J| = sum_i [log lead_i + log z_i + log(1-z_i)]
+        y = self._forward(x)
+        offset = ops.cumsum(ops.ones_like(x), axis=-1)
+        k = float(x.shape[-1])
+        z = ops.sigmoid(x - ops.log(k - offset + 1.0))
+        return ops.sum(ops.log(z) + ops.log1p(-z)
+                       + ops.log(y[..., :-1] / z), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    """reference: transform.py ReshapeTransform(in_event_shape,
+    out_event_shape)."""
+
+    def __init__(self, in_event_shape: Sequence[int],
+                 out_event_shape: Sequence[int]):
+        if int(np.prod(in_event_shape)) != int(np.prod(out_event_shape)):
+            raise ValueError("event sizes must match")
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = tuple(x.shape)[: len(tuple(x.shape))
+                                - len(self.in_event_shape)]
+        return ops.reshape(x, list(batch + self.out_event_shape))
+
+    def _inverse(self, y):
+        batch = tuple(y.shape)[: len(tuple(y.shape))
+                                - len(self.out_event_shape)]
+        return ops.reshape(y, list(batch + self.in_event_shape))
+
+    def _forward_log_det_jacobian(self, x):
+        return ops.zeros_like(ops.sum(x))
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        batch = tuple(shape[:-n]) if n else tuple(shape)
+        return batch + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        batch = tuple(shape[:-n]) if n else tuple(shape)
+        return batch + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Sum the log-det over trailing event dims (reference parity)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base.forward(x)
+
+    def _inverse(self, y):
+        return self.base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        for _ in range(self._rank):
+            ld = ops.sum(ld, axis=-1)
+        return ld
+
+
+class StackTransform(Transform):
+    """Apply one transform per slice along ``axis`` (reference parity)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        parts = ops.unstack(x, axis=self.axis)
+        outs = [getattr(t, method)(p)
+                for t, p in zip(self.transforms, parts)]
+        return ops.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("forward", x)
+
+    def _inverse(self, y):
+        return self._map("inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class ChainTransform(Transform):
+    """Compose transforms left-to-right (reference: ChainTransform)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape

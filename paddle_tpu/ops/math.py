@@ -28,7 +28,7 @@ __all__ = [
     "logcumsumexp", "einsum", "dot", "mm", "bmm", "t", "multiply_", "add_",
     "addmm", "inner", "outer", "kron", "diff", "nanmean", "nansum", "amax",
     "amin", "lerp", "erf", "erfinv", "stanh", "atan2", "hypot", "frac",
-    "isclose", "allclose",
+    "isclose", "allclose", "lgamma", "digamma", "i0", "i0e", "i1", "i1e",
 ]
 
 
@@ -118,6 +118,12 @@ sigmoid = _unary_factory(jax.nn.sigmoid, "sigmoid")
 reciprocal = _unary_factory(jnp.reciprocal, "reciprocal")
 erf = _unary_factory(jax.lax.erf, "erf")
 erfinv = _unary_factory(jax.lax.erf_inv, "erfinv")
+lgamma = _unary_factory(jax.lax.lgamma, "lgamma")
+digamma = _unary_factory(jax.lax.digamma, "digamma")
+i0 = _unary_factory(jax.scipy.special.i0, "i0")
+i0e = _unary_factory(jax.scipy.special.i0e, "i0e")
+i1 = _unary_factory(jax.scipy.special.i1, "i1")
+i1e = _unary_factory(jax.scipy.special.i1e, "i1e")
 
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):

@@ -1,0 +1,304 @@
+"""paddle.distribution parity: densities vs scipy, sampling moments, KL
+registry, transforms (reference: python/paddle/distribution/)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype="float64")
+
+
+class TestDensitiesVsScipy:
+    def test_normal(self):
+        d = D.Normal(0.5, 2.0)
+        for v in (-1.0, 0.0, 1.3):
+            np.testing.assert_allclose(
+                _np(d.log_prob(v)), st.norm(0.5, 2).logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.norm(0.5, 2).entropy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.cdf(0.7)), st.norm(0.5, 2).cdf(0.7), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.icdf(0.8)), st.norm(0.5, 2).ppf(0.8), rtol=1e-4)
+
+    def test_uniform(self):
+        d = D.Uniform(1.0, 3.0)
+        np.testing.assert_allclose(
+            _np(d.log_prob(2.0)), st.uniform(1, 2).logpdf(2.0), rtol=1e-6)
+        assert _np(d.log_prob(5.0)) == -np.inf
+        np.testing.assert_allclose(_np(d.entropy()), np.log(2.0), rtol=1e-6)
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            _np(d.log_prob(1.0)), np.log(0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.bernoulli(0.3).entropy(), rtol=1e-5)
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(
+            _np(d.log_prob(0.4)), st.beta(2, 3).logpdf(0.4), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.mean), st.beta(2, 3).mean(), rtol=1e-6)
+        np.testing.assert_allclose(
+            _np(d.variance), st.beta(2, 3).var(), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.beta(2, 3).entropy(), rtol=1e-4)
+
+    def test_laplace(self):
+        d = D.Laplace(0.0, 1.5)
+        np.testing.assert_allclose(
+            _np(d.log_prob(0.7)), st.laplace(0, 1.5).logpdf(0.7), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.cdf(-0.5)), st.laplace(0, 1.5).cdf(-0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.icdf(0.3)), st.laplace(0, 1.5).ppf(0.3), rtol=1e-4)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.2, 0.7)
+        ref = st.lognorm(s=0.7, scale=np.exp(0.2))
+        np.testing.assert_allclose(
+            _np(d.log_prob(1.5)), ref.logpdf(1.5), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.variance), ref.var(), rtol=1e-4)
+
+    def test_gumbel(self):
+        d = D.Gumbel(1.0, 2.0)
+        ref = st.gumbel_r(1.0, 2.0)
+        np.testing.assert_allclose(
+            _np(d.log_prob(2.5)), ref.logpdf(2.5), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.variance), ref.var(), rtol=1e-5)
+
+    def test_geometric(self):
+        d = D.Geometric(0.25)
+        # scipy geom counts trials (support 1..); ours counts failures (0..)
+        np.testing.assert_allclose(
+            _np(d.log_prob(3.0)), st.geom(0.25, loc=-1).logpmf(3), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.mean), 3.0, rtol=1e-6)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.5, 0.3], "float32"))
+        d = D.Categorical(logits)
+        np.testing.assert_allclose(_np(d.log_prob(1)), np.log(0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(d.entropy()),
+            -(0.2 * np.log(0.2) + 0.5 * np.log(0.5) + 0.3 * np.log(0.3)),
+            rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.array([2.0, 3.0, 4.0], "float32")
+        d = D.Dirichlet(c)
+        x = np.array([0.2, 0.3, 0.5], "float64")
+        np.testing.assert_allclose(
+            _np(d.log_prob(x.astype("float32"))),
+            st.dirichlet(c.astype("float64")).logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(
+            _np(d.mean), c / c.sum(), rtol=1e-6)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], "float32")
+        d = D.Multinomial(10, p)
+        x = np.array([2.0, 3.0, 5.0], "float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)),
+            st.multinomial(10, p.astype("float64")).logpmf([2, 3, 5]),
+            rtol=1e-4)
+
+
+class TestSampling:
+    def test_moments(self):
+        paddle.seed(7)
+        cases = [
+            (D.Normal(1.0, 2.0), 1.0, 4.0),
+            (D.Uniform(0.0, 4.0), 2.0, 16 / 12),
+            (D.Laplace(0.5, 1.0), 0.5, 2.0),
+            (D.Gumbel(0.0, 1.0), np.euler_gamma, np.pi ** 2 / 6),
+        ]
+        for d, mean, var in cases:
+            s = _np(d.sample((20000,)))
+            np.testing.assert_allclose(s.mean(), mean, atol=0.08)
+            np.testing.assert_allclose(s.var(), var, rtol=0.1)
+
+    def test_bernoulli_categorical_support(self):
+        paddle.seed(8)
+        b = _np(D.Bernoulli(0.7).sample((5000,)))
+        assert set(np.unique(b)) <= {0.0, 1.0}
+        np.testing.assert_allclose(b.mean(), 0.7, atol=0.03)
+        c = np.asarray(D.Categorical(
+            np.log(np.array([0.1, 0.9], "float32"))).sample((5000,)).numpy())
+        np.testing.assert_allclose((c == 1).mean(), 0.9, atol=0.03)
+
+    def test_dirichlet_simplex(self):
+        paddle.seed(9)
+        s = _np(D.Dirichlet(np.array([1.0, 2.0, 3.0], "float32"))
+                .sample((100,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        assert (s >= 0).all()
+
+    def test_multinomial_counts(self):
+        paddle.seed(10)
+        s = _np(D.Multinomial(7, np.array([0.5, 0.5], "float32"))
+                .sample((50,)))
+        np.testing.assert_allclose(s.sum(-1), 7.0)
+
+    def test_rsample_differentiable(self):
+        """Reparameterized sampling: grads flow to loc/scale."""
+        loc = paddle.to_tensor(np.float32(0.0))
+        loc.stop_gradient = False
+        scale = paddle.to_tensor(np.float32(1.0))
+        scale.stop_gradient = False
+        paddle.seed(11)
+        s = D.Normal(loc, scale).rsample((256,))
+        s.sum().backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(_np(loc.grad), 256.0, rtol=1e-5)
+
+
+class TestKL:
+    def test_normal_normal_closed_form(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        expect = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(_np(D.kl_divergence(p, q)), expect,
+                                   rtol=1e-5)
+
+    def test_kl_nonnegative_various(self):
+        pairs = [
+            (D.Uniform(0.0, 1.0), D.Uniform(-1.0, 2.0)),
+            (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Categorical(np.log(np.array([0.3, 0.7], "float32"))),
+             D.Categorical(np.log(np.array([0.6, 0.4], "float32")))),
+            (D.Dirichlet(np.array([1.0, 2.0], "float32")),
+             D.Dirichlet(np.array([2.0, 1.0], "float32"))),
+            (D.Geometric(0.4), D.Geometric(0.6)),
+        ]
+        for p, q in pairs:
+            assert float(_np(D.kl_divergence(p, q))) >= -1e-6
+
+    def test_kl_monte_carlo_agreement(self):
+        """Closed-form KL(beta||beta) matches a Monte-Carlo estimate."""
+        paddle.seed(12)
+        p, q = D.Beta(2.0, 4.0), D.Beta(4.0, 2.0)
+        x = p.sample((40000,))
+        mc = _np((p.log_prob(x) - q.log_prob(x))).mean()
+        np.testing.assert_allclose(_np(D.kl_divergence(p, q)), mc, rtol=0.05)
+
+    def test_unregistered_pair_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Uniform(0.0, 1.0))
+
+    def test_register_kl_dispatch(self):
+        class MyNormal(D.Normal):
+            pass
+
+        @D.register_kl(MyNormal, D.Normal)
+        def _kl(p, q):  # noqa
+            return paddle.to_tensor(np.float32(42.0))
+
+        assert _np(D.kl_divergence(MyNormal(0.0, 1.0),
+                                   D.Normal(0.0, 1.0))) == 42.0
+        # plain Normal still uses the closed form
+        np.testing.assert_allclose(
+            _np(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))),
+            0.0, atol=1e-6)
+
+
+class TestTransforms:
+    def test_affine_roundtrip_and_jacobian(self):
+        t = D.AffineTransform(1.0, 3.0)
+        x = np.array([0.5, -2.0], "float32")
+        y = _np(t.forward(x))
+        np.testing.assert_allclose(y, 1.0 + 3.0 * x, rtol=1e-6)
+        np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-6)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)),
+                                   np.log(3.0), rtol=1e-6)
+
+    def test_exp_sigmoid_tanh_roundtrip(self):
+        for t in (D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform()):
+            x = np.array([0.3, -0.4], "float32")
+            np.testing.assert_allclose(_np(t.inverse(t.forward(x))), x,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_chain(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = np.array([0.1, 0.7], "float32")
+        np.testing.assert_allclose(_np(t.forward(x)), np.exp(2 * x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(t.forward_log_det_jacobian(x)), np.log(2.0) + 2 * x,
+            rtol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0], "float32")
+        y = _np(t.forward(x))
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(y.astype("float32"))), x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_transformed_distribution_lognormal_equivalence(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+        ref = st.lognorm(s=1.0)
+        np.testing.assert_allclose(_np(td.log_prob(2.0)), ref.logpdf(2.0),
+                                   rtol=1e-5)
+
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), "float32"),
+                        np.ones((3, 4), "float32"))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        x = np.zeros((3, 4), "float32")
+        np.testing.assert_allclose(
+            _np(ind.log_prob(x)), _np(base.log_prob(x)).sum(-1), rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_multinomial_entropy_exact(self):
+        """n=2, p=[.5,.5]: H over {(2,0):.25,(1,1):.5,(0,2):.25} = 1.0397."""
+        d = D.Multinomial(2, np.array([0.5, 0.5], "float32"))
+        probs = {(2, 0): 0.25, (1, 1): 0.5, (0, 2): 0.25}
+        expect = -sum(p * np.log(p) for p in probs.values())
+        np.testing.assert_allclose(_np(d.entropy()), expect, rtol=1e-4)
+
+    def test_stickbreaking_log_det_finite_difference(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0], "float64")
+        eps = 1e-3  # forward computes in f32: smaller eps is below precision
+        J = np.zeros((3, 3))
+        for j in range(3):
+            xp, xm = x.copy(), x.copy()
+            xp[j] += eps
+            xm[j] -= eps
+            fp = _np(t.forward(xp.astype("float32")))[:3]
+            fm = _np(t.forward(xm.astype("float32")))[:3]
+            J[:, j] = (fp - fm) / (2 * eps)
+        expect = np.log(np.abs(np.linalg.det(J)))
+        np.testing.assert_allclose(
+            _np(t.forward_log_det_jacobian(x.astype("float32"))), expect,
+            rtol=1e-3)
+
+    def test_categorical_probs_is_a_method(self):
+        d = D.Categorical(np.log(np.array([0.2, 0.8], "float32")))
+        np.testing.assert_allclose(_np(d.probs(1)), 0.8, rtol=1e-5)
+        np.testing.assert_allclose(_np(d.probs_tensor), [0.2, 0.8],
+                                   rtol=1e-5)
+
+    def test_transformed_event_shape_pushed_through(self):
+        base = D.Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+        td = D.TransformedDistribution(
+            D.Independent(base, 1), [D.ReshapeTransform((3,), (3, 1))])
+        assert td.event_shape == (3, 1)
+        assert tuple(td.rsample().shape) == (3, 1)
+
+    def test_normal_stat_shapes_agree(self):
+        d = D.Normal(np.zeros(3, "float32"), 2.0)
+        assert tuple(d.mean.shape) == tuple(d.variance.shape) \
+            == tuple(d.stddev.shape) == (3,)
