@@ -43,6 +43,9 @@ from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import device  # noqa: E402
 from . import distribution  # noqa: E402
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import sparse  # noqa: E402
 from . import profiler  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402

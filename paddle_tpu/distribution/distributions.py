@@ -528,10 +528,13 @@ class Multinomial(Distribution):
 
     def log_prob(self, value):
         value = _t(value)
-        logits = ops.log(self.probs)
+        # mask the (count==0, prob==0) cells: 0 * log(0) must contribute 0,
+        # not NaN (torch/paddle xlogy semantics)
+        term = ops.where(value == 0.0, ops.zeros_like(value),
+                         value * ops.log(self.probs))
         return (ops.lgamma(ops.full([], float(self.total_count) + 1.0))
                 - ops.sum(ops.lgamma(value + 1.0), axis=-1)
-                + ops.sum(value * logits, axis=-1))
+                + ops.sum(term, axis=-1))
 
     def entropy(self):
         # exact: H = -log n! + sum_i E[log x_i!] - n * sum_i p_i log p_i,
@@ -608,12 +611,22 @@ class TransformedDistribution(Distribution):
         self.transforms = list(transforms)
         self._chain = ChainTransform(self.transforms) \
             if len(self.transforms) != 1 else self.transforms[0]
-        # shape-changing transforms (Reshape, StickBreaking) alter the event
-        full = base.batch_shape + base.event_shape
-        out_full = tuple(self._chain.forward_shape(full))
+        # shape-changing transforms (Reshape, StickBreaking) act on the
+        # EVENT part: any dim they alter (and everything after it) is event
+        in_full = base.batch_shape + base.event_shape
+        out_full = tuple(self._chain.forward_shape(in_full))
+        prefix = 0
         nb = len(base.batch_shape)
-        super().__init__(batch_shape=out_full[:nb],
-                         event_shape=out_full[nb:])
+        while (prefix < nb and prefix < len(out_full)
+               and out_full[prefix] == in_full[prefix]
+               and len(out_full) == len(in_full)):
+            prefix += 1
+        if out_full == in_full:
+            prefix = nb
+        # dims of the base's full shape consumed as event by the transform
+        self._consumed = len(in_full) - prefix
+        super().__init__(batch_shape=out_full[:prefix],
+                         event_shape=out_full[prefix:])
 
     def rsample(self, shape=()):
         x = self.base.rsample(shape)
@@ -629,8 +642,12 @@ class TransformedDistribution(Distribution):
     def log_prob(self, value):
         value = _t(value)
         x = self._chain.inverse(value)
-        return (self.base.log_prob(x)
-                - self._chain.forward_log_det_jacobian(x))
+        lp = self.base.log_prob(x)
+        # rank-changing transforms: base density factorizes elementwise over
+        # the consumed dims — sum them (the reference's _sum_rightmost)
+        for _ in range(self._consumed - len(self.base.event_shape)):
+            lp = ops.sum(lp, axis=-1)
+        return lp - self._chain.forward_log_det_jacobian(x)
 
 
 # ------------------------------------------------- LogNormal / Gumbel (real)

@@ -162,7 +162,8 @@ class SoftmaxTransform(Transform):
 class StickBreakingTransform(Transform):
     """R^{K-1} -> simplex^K via stick breaking (reference parity)."""
 
-    def _forward(self, x):
+    def _parts(self, x):
+        """(z, y): stick fractions + simplex point, computed once."""
         offset = ops.cumsum(ops.ones_like(x), axis=-1)
         k = float(x.shape[-1])
         z = ops.sigmoid(x - ops.log(k - offset + 1.0))
@@ -171,7 +172,10 @@ class StickBreakingTransform(Transform):
         cum = ops.cumprod(1.0 - zpad + 1e-30, dim=-1)
         lead = ops.concat([one, cum[..., :-1]], axis=-1)
         zfull = ops.concat([z, ops.ones_like(z[..., :1])], axis=-1)
-        return lead * zfull
+        return z, lead * zfull
+
+    def _forward(self, x):
+        return self._parts(x)[1]
 
     def _inverse(self, y):
         y_crop = y[..., :-1]
@@ -188,10 +192,7 @@ class StickBreakingTransform(Transform):
         # lower-triangular Jacobian: y_i = lead_i * z_i with lead_i = y_i/z_i,
         # dy_i/dx_i = lead_i * z_i(1-z_i)
         # => log|det J| = sum_i [log lead_i + log z_i + log(1-z_i)]
-        y = self._forward(x)
-        offset = ops.cumsum(ops.ones_like(x), axis=-1)
-        k = float(x.shape[-1])
-        z = ops.sigmoid(x - ops.log(k - offset + 1.0))
+        z, y = self._parts(x)
         return ops.sum(ops.log(z) + ops.log1p(-z)
                        + ops.log(y[..., :-1] / z), axis=-1)
 
@@ -302,6 +303,8 @@ class ChainTransform(Transform):
             ld = t.forward_log_det_jacobian(x)
             total = ld if total is None else total + ld
             x = t.forward(x)
+        if total is None:  # empty chain: identity, log-det 0
+            return ops.zeros_like(x)
         return total
 
     def forward_shape(self, shape):

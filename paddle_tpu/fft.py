@@ -1,0 +1,140 @@
+"""paddle.fft parity over jnp.fft.
+
+Reference parity: python/paddle/fft.py (fft/ifft/rfft/irfft/hfft/ihfft +
+2D/N-D variants :167-1236, fftfreq/rfftfreq/fftshift/ifftshift :1236-1424)
+backed there by cuFFT/onemkl phi kernels — here each is one jnp.fft call
+lowered by XLA to its native FFT; gradients come from jax's fft JVP rules
+through the eager tape (differentiable where the reference's are).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._apply import ensure_tensor, unary
+from .tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm}. Norm should be forward, backward "
+            f"or ortho")
+
+
+def _fft_factory(jnp_fn, name, is_nd=False, default_axes=None):
+    if is_nd:
+        def op(x, s=None, axes=default_axes, norm="backward", name_=None):
+            _check_norm(norm)
+            return unary(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x,
+                         name=name)
+    else:
+        def op(x, n=None, axis=-1, norm="backward", name_=None):
+            _check_norm(norm)
+            return unary(lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), x,
+                         name=name)
+    op.__name__ = name
+    op.__doc__ = f"reference: python/paddle/fft.py {name} — jnp.fft.{name}."
+    return op
+
+
+fft = _fft_factory(jnp.fft.fft, "fft")
+ifft = _fft_factory(jnp.fft.ifft, "ifft")
+rfft = _fft_factory(jnp.fft.rfft, "rfft")
+irfft = _fft_factory(jnp.fft.irfft, "irfft")
+hfft = _fft_factory(jnp.fft.hfft, "hfft")
+ihfft = _fft_factory(jnp.fft.ihfft, "ihfft")
+
+fft2 = _fft_factory(jnp.fft.fft2, "fft2", is_nd=True, default_axes=(-2, -1))
+ifft2 = _fft_factory(jnp.fft.ifft2, "ifft2", is_nd=True,
+                     default_axes=(-2, -1))
+rfft2 = _fft_factory(jnp.fft.rfft2, "rfft2", is_nd=True,
+                     default_axes=(-2, -1))
+irfft2 = _fft_factory(jnp.fft.irfft2, "irfft2", is_nd=True,
+                      default_axes=(-2, -1))
+fftn = _fft_factory(jnp.fft.fftn, "fftn", is_nd=True)
+ifftn = _fft_factory(jnp.fft.ifftn, "ifftn", is_nd=True)
+rfftn = _fft_factory(jnp.fft.rfftn, "rfftn", is_nd=True)
+irfftn = _fft_factory(jnp.fft.irfftn, "irfftn", is_nd=True)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: fft.py:1123 — hermitian 2D fft via hfftn."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: fft.py:1172."""
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """reference: fft.py:774 — C2R hermitian ND: ifftn over the leading
+    axes then hfft on the last (jnp has no hfftn)."""
+    _check_norm(norm)
+
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        *lead, last = ax
+        n_last = None if s is None else s[-1]
+        if lead:
+            s_lead = None if s is None else list(s[:-1])
+            a = jnp.fft.ifftn(a, s=s_lead, axes=tuple(lead),
+                              norm={"backward": "forward",
+                                    "forward": "backward",
+                                    "ortho": "ortho"}[norm])
+        return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
+
+    return unary(f, x, name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """reference: fft.py:823 — R2C hermitian ND."""
+    _check_norm(norm)
+
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        *lead, last = ax
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+        if lead:
+            s_lead = None if s is None else list(s[:-1])
+            out = jnp.fft.fftn(out, s=s_lead, axes=tuple(lead),
+                               norm={"backward": "forward",
+                                     "forward": "backward",
+                                     "ortho": "ortho"}[norm])
+        return out
+
+    return unary(f, x, name="ihfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """reference: fft.py:1236."""
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(
+        dtype or "float32"), stop_gradient=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    """reference: fft.py:1282."""
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(
+        dtype or "float32"), stop_gradient=True)
+
+
+def fftshift(x, axes=None, name=None):
+    """reference: fft.py:1331."""
+    return unary(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    """reference: fft.py:1378."""
+    return unary(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 name="ifftshift")

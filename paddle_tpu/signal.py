@@ -1,0 +1,159 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft.
+
+Reference parity: python/paddle/signal.py (frame :31, overlap_add :151,
+stft :239, istft :406) — there backed by phi frame/overlap_add kernels +
+fft; here pure jnp (gather-based framing, scatter-add overlap) under the
+eager tape, so all four are differentiable and jit-traceable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import fft as _fft
+from .ops._apply import ensure_tensor, unary
+from .tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """reference: signal.py:31 — slide a window of ``frame_length`` every
+    ``hop_length`` samples. axis=-1: [..., frame_length, num_frames];
+    axis=0: [num_frames, frame_length, ...]."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, got {hop_length}")
+    xt = ensure_tensor(x)
+    n = int(xt.shape[axis])
+    if not 0 < frame_length <= n:
+        raise ValueError(
+            f"frame_length should be in (0, {n}], got {frame_length}")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = np.arange(num_frames) * hop_length
+    idx = starts[:, None] + np.arange(frame_length)[None, :]  # [F, L]
+
+    def f(a):
+        g = jnp.take(a, jnp.asarray(idx), axis=axis)  # axis -> [F, L]
+        if axis == 0:
+            return g  # frames-first layout [F, L, ...] (reference axis=0)
+        # [..., F, L] -> [..., L, F]
+        return jnp.swapaxes(g, -1, -2)
+
+    return unary(f, xt, name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """reference: signal.py:151 — inverse of frame: scatter-add frames at
+    ``hop_length`` strides. axis=-1 input [..., frame_length, num_frames]."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, got {hop_length}")
+    xt = ensure_tensor(x)
+
+    def f(a):
+        if axis in (-1, a.ndim - 1):
+            frames = jnp.swapaxes(a, -1, -2)  # [..., F, L]
+        else:
+            frames = a  # [F, L, ...] -> move to [..., F, L]
+            if a.ndim > 2:
+                frames = jnp.moveaxis(a, (0, 1), (-2, -1))
+                frames = jnp.swapaxes(frames, -1, -2)
+        F, L = frames.shape[-2], frames.shape[-1]
+        n_out = (F - 1) * hop_length + L
+        idx = (np.arange(F) * hop_length)[:, None] + np.arange(L)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (n_out,), frames.dtype)
+        out = out.at[..., jnp.asarray(idx)].add(frames)
+        if axis == 0 and a.ndim > 2:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return unary(f, xt, name="overlap_add")
+
+
+def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """reference: signal.py:239 — short-time Fourier transform.
+    x: [B, T] or [T] real (or complex with onesided=False); returns
+    [B, n_fft//2+1 or n_fft, num_frames] complex."""
+    xt = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = ensure_tensor(window)._value
+    else:
+        w = jnp.ones((win_length,), "float32")
+    # center-pad window to n_fft (reference behavior)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def f(a):
+        was_1d = a.ndim == 1
+        if was_1d:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, ((0, 0), (pad, pad)), mode=pad_mode)
+        n = a.shape[-1]
+        num_frames = 1 + (n - n_fft) // hop_length
+        idx = (np.arange(num_frames) * hop_length)[:, None] \
+            + np.arange(n_fft)[None, :]
+        frames = a[..., jnp.asarray(idx)] * w  # [B, F, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                and not jnp.iscomplexobj(a) else
+                jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(float(n_fft), spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)  # [B, bins, F]
+        return out[0] if was_1d else out
+
+    return unary(f, xt, name="stft")
+
+
+def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length=None, return_complex: bool = False,
+          name=None):
+    """reference: signal.py:406 — inverse STFT with window-envelope
+    normalization (NOLA)."""
+    xt = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = ensure_tensor(window)._value
+    else:
+        w = jnp.ones((win_length,), "float32")
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def f(a):
+        was_2d = a.ndim == 2
+        if was_2d:
+            a = a[None]
+        spec = jnp.swapaxes(a, -1, -2)  # [B, F, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(float(n_fft),
+                                               spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1))
+        if not return_complex:
+            frames = frames.real if jnp.iscomplexobj(frames) else frames
+        frames = frames * w  # [B, F, n_fft]
+        F = frames.shape[-2]
+        n_out = (F - 1) * hop_length + n_fft
+        idx = (np.arange(F) * hop_length)[:, None] + np.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (n_out,), frames.dtype)
+        out = out.at[..., jnp.asarray(idx)].add(frames)
+        env = jnp.zeros((n_out,), w.dtype)
+        env = env.at[jnp.asarray(idx)].add(
+            jnp.broadcast_to(w * w, (F, n_fft)))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:n_out - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if was_2d else out
+
+    return unary(f, xt, name="istft")

@@ -302,3 +302,30 @@ class TestReviewRegressions:
         d = D.Normal(np.zeros(3, "float32"), 2.0)
         assert tuple(d.mean.shape) == tuple(d.variance.shape) \
             == tuple(d.stddev.shape) == (3,)
+
+    def test_stickbreaking_transformed_density_scalar(self):
+        """Rank-changing transform: base log_prob sums over consumed dims
+        (the reference's _sum_rightmost) -> scalar density on the simplex."""
+        base = D.Normal(np.zeros(2, "float32"), np.ones(2, "float32"))
+        td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+        assert td.event_shape == (3,)
+        y = _np(td.rsample())
+        lp = td.log_prob(y.astype("float32"))
+        assert tuple(lp.shape) == ()
+        # value = sum(base.log_prob(x)) - ldj at x = inverse(y)
+        t = D.StickBreakingTransform()
+        x = t.inverse(y.astype("float32"))
+        expect = _np(base.log_prob(x)).sum() \
+            - _np(t.forward_log_det_jacobian(x))
+        np.testing.assert_allclose(_np(lp), expect, rtol=1e-5)
+
+    def test_multinomial_zero_prob_zero_count_not_nan(self):
+        d = D.Multinomial(2, np.array([0.5, 0.5, 0.0], "float32"))
+        lp = _np(d.log_prob(np.array([1.0, 1.0, 0.0], "float32")))
+        np.testing.assert_allclose(lp, np.log(0.5), rtol=1e-5)
+
+    def test_empty_transform_chain_identity(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [])
+        np.testing.assert_allclose(
+            _np(td.log_prob(0.5)), _np(D.Normal(0.0, 1.0).log_prob(0.5)),
+            rtol=1e-6)

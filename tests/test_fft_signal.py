@@ -1,0 +1,142 @@
+"""paddle.fft + paddle.signal parity vs numpy/scipy references
+(reference: python/paddle/fft.py, signal.py)."""
+import numpy as np
+import pytest
+import scipy.signal as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFFT:
+    x = np.random.default_rng(0).standard_normal(16).astype("float32")
+    x2 = np.random.default_rng(1).standard_normal((4, 8)).astype("float32")
+
+    def test_fft_ifft_roundtrip(self):
+        y = fft.fft(self.x)
+        np.testing.assert_allclose(_np(y), np.fft.fft(self.x), rtol=1e-4)
+        back = fft.ifft(y)
+        np.testing.assert_allclose(_np(back).real, self.x, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        y = fft.rfft(self.x)
+        np.testing.assert_allclose(_np(y), np.fft.rfft(self.x), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np(fft.irfft(y)), self.x, atol=1e-5)
+
+    def test_hfft_ihfft(self):
+        y = fft.ihfft(self.x)
+        np.testing.assert_allclose(_np(y), np.fft.ihfft(self.x), rtol=1e-4,
+                                   atol=1e-6)
+        h = fft.hfft(y)
+        np.testing.assert_allclose(_np(h), self.x, atol=1e-4)
+
+    def test_norm_modes(self):
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(
+                _np(fft.fft(self.x, norm=norm)),
+                np.fft.fft(self.x, norm=norm), rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            fft.fft(self.x, norm="bogus")
+
+    def test_2d_and_nd(self):
+        np.testing.assert_allclose(_np(fft.fft2(self.x2)),
+                                   np.fft.fft2(self.x2), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(_np(fft.rfft2(self.x2)),
+                                   np.fft.rfft2(self.x2), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(_np(fft.fftn(self.x2)),
+                                   np.fft.fftn(self.x2), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(
+            _np(fft.irfftn(fft.rfftn(self.x2))), self.x2, atol=1e-5)
+
+    def test_freq_and_shift(self):
+        np.testing.assert_allclose(_np(fft.fftfreq(8, d=0.5)),
+                                   np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        np.testing.assert_allclose(_np(fft.rfftfreq(8)),
+                                   np.fft.rfftfreq(8), rtol=1e-6)
+        np.testing.assert_allclose(_np(fft.fftshift(self.x)),
+                                   np.fft.fftshift(self.x))
+        np.testing.assert_allclose(
+            _np(fft.ifftshift(fft.fftshift(self.x))), self.x)
+
+    def test_fft_gradients(self):
+        x = paddle.to_tensor(self.x)
+        x.stop_gradient = False
+        y = fft.rfft(x)
+        loss = (y.real() ** 2 + y.imag() ** 2).sum() \
+            if hasattr(y, "real") and callable(getattr(y, "real", None)) \
+            else paddle.ops.sum(paddle.ops.abs(y) ** 2)
+        loss.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum|rfft(x)|^2 relates to 2*N*x (up to onesided
+        # double-count); just check finiteness and nonzero
+        g = _np(x.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = np.arange(8, dtype="float32")
+        f = _np(signal.frame(x, frame_length=4, hop_length=2))
+        assert f.shape == (4, 3)
+        np.testing.assert_allclose(f[:, 0], x[0:4])
+        np.testing.assert_allclose(f[:, 1], x[2:6])
+        np.testing.assert_allclose(f[:, 2], x[4:8])
+
+    def test_frame_axis0_and_batch(self):
+        x = np.arange(8, dtype="float32")
+        f0 = _np(signal.frame(x, 4, 2, axis=0))
+        assert f0.shape == (3, 4)
+        xb = np.stack([x, x + 1])
+        fb = _np(signal.frame(xb, 4, 2))
+        assert fb.shape == (2, 4, 3)
+
+    def test_overlap_add_inverts_frame_ones_window(self):
+        x = np.random.default_rng(2).standard_normal(16).astype("float32")
+        f = signal.frame(x, frame_length=4, hop_length=4)  # no overlap
+        y = _np(signal.overlap_add(f, hop_length=4))
+        np.testing.assert_allclose(y, x, rtol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        ones = np.ones((4, 3), "float32")  # 3 frames of length 4
+        y = _np(signal.overlap_add(ones, hop_length=2))
+        np.testing.assert_allclose(y, [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_matches_scipy(self):
+        x = np.random.default_rng(3).standard_normal(256).astype("float32")
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype("float32")
+        got = _np(signal.stft(x, n_fft=n_fft, hop_length=hop,
+                              window=win, center=True))
+        _, _, ref = sps.stft(x, nperseg=n_fft, noverlap=n_fft - hop,
+                             window=win, boundary="even", padded=False,
+                             return_onesided=True)
+        # scipy scales by 1/win.sum(); align scales
+        ref = ref * win.sum()
+        n = min(got.shape[-1], ref.shape[-1])
+        np.testing.assert_allclose(got[:, :n], ref[:, :n], atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        x = np.random.default_rng(4).standard_normal(400).astype("float32")
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype("float32")
+        spec = signal.stft(x, n_fft=n_fft, hop_length=hop, window=win)
+        back = _np(signal.istft(spec, n_fft=n_fft, hop_length=hop,
+                                window=win, length=len(x)))
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_errors(self):
+        x = np.arange(8, dtype="float32")
+        with pytest.raises(ValueError):
+            signal.frame(x, frame_length=9, hop_length=1)
+        with pytest.raises(ValueError):
+            signal.frame(x, frame_length=4, hop_length=0)
+        with pytest.raises(ValueError):
+            signal.overlap_add(np.ones((4, 3), "float32"), hop_length=-1)

@@ -1,0 +1,226 @@
+"""paddle.sparse parity: creation, unary/binary ops, nn layers
+(reference: python/paddle/sparse/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo_from_dense(d):
+    idx = np.argwhere(d != 0).T
+    vals = d[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, shape=d.shape)
+
+
+def _dense():
+    rng = np.random.default_rng(0)
+    d = rng.standard_normal((4, 6)).astype("float32")
+    d[rng.random((4, 6)) < 0.5] = 0.0
+    return d
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        np.testing.assert_allclose(s.numpy(), d)
+        assert s.nnz == (d != 0).sum()
+        assert s.indices().shape[0] == 2
+
+    def test_csr_roundtrip(self):
+        import scipy.sparse as sp
+
+        d = _dense()
+        ref = sp.csr_matrix(d)
+        s = sparse.sparse_csr_tensor(ref.indptr, ref.indices, ref.data,
+                                     shape=d.shape)
+        np.testing.assert_allclose(s.numpy(), d)
+        np.testing.assert_array_equal(np.asarray(s.crows().numpy()),
+                                      ref.indptr)
+
+    def test_coo_csr_conversion(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        csr = s.to_sparse_csr()
+        np.testing.assert_allclose(csr.numpy(), d)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.numpy(), d)
+
+    def test_infer_shape(self):
+        s = sparse.sparse_coo_tensor([[0, 2], [1, 3]], [1.0, 2.0])
+        assert s.shape == [3, 4]
+
+
+class TestUnary:
+    def test_value_ops_preserve_structure(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        for name in ("sin", "tanh", "square", "abs", "neg", "expm1"):
+            got = getattr(sparse, name)(s)
+            ref = getattr(np, {"neg": "negative", "abs": "abs"}.get(
+                name, name))(d)
+            mask = d != 0
+            np.testing.assert_allclose(got.numpy()[mask], ref[mask],
+                                       rtol=1e-5)
+            # zeros stay zeros (structure preserved, not densified)
+            np.testing.assert_allclose(got.numpy()[~mask], 0.0)
+
+    def test_pow_cast(self):
+        d = np.abs(_dense())
+        s = _coo_from_dense(d)
+        np.testing.assert_allclose(sparse.pow(s, 2.0).numpy(), d ** 2,
+                                   rtol=1e-5)
+        assert sparse.cast(s, value_dtype="float64").dtype == "float64"
+
+    def test_coalesce_merges_duplicates(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0],
+                                     shape=(2, 2))
+        c = s.coalesce()
+        assert c.numpy()[0, 1] == 3.0
+
+    def test_transpose_reshape(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        np.testing.assert_allclose(
+            sparse.transpose(s, [1, 0]).numpy(), d.T)
+        np.testing.assert_allclose(
+            sparse.reshape(s, [6, 4]).numpy(), d.reshape(6, 4))
+
+
+class TestBinary:
+    def test_spmm_vs_dense(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        y = np.random.default_rng(1).standard_normal((6, 3)).astype("float32")
+        got = sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(got.numpy()), d @ y,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mv(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        v = np.random.default_rng(2).standard_normal(6).astype("float32")
+        got = sparse.mv(s, paddle.to_tensor(v))
+        np.testing.assert_allclose(np.asarray(got.numpy()), d @ v,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((4, 5)).astype("float32")
+        y = rng.standard_normal((5, 4)).astype("float32")
+        mask_d = (rng.random((4, 4)) < 0.4).astype("float32")
+        mask = _coo_from_dense(mask_d)
+        got = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        np.testing.assert_allclose(got.numpy(), (x @ y) * (mask_d != 0),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_add_subtract_union(self):
+        a, b = _dense(), np.roll(_dense(), 1, axis=0)
+        sa, sb = _coo_from_dense(a), _coo_from_dense(b)
+        np.testing.assert_allclose(sparse.add(sa, sb).numpy(), a + b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(sparse.subtract(sa, sb).numpy(), a - b,
+                                   rtol=1e-5)
+
+    def test_multiply_divide(self):
+        a = _dense()
+        b = a * 2.0 + (a == 0)  # nonzero where a is
+        sa = _coo_from_dense(a)
+        sb = _coo_from_dense(b)
+        np.testing.assert_allclose(sparse.multiply(sa, sb).numpy(), a * b,
+                                   rtol=1e-5)
+        got = sparse.divide(sa, sb).numpy()
+        mask = a != 0
+        np.testing.assert_allclose(got[mask], (a / b)[mask], rtol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.default_rng(4)
+        inp = rng.standard_normal((4, 3)).astype("float32")
+        d = _dense()
+        y = rng.standard_normal((6, 3)).astype("float32")
+        got = sparse.addmm(paddle.to_tensor(inp), _coo_from_dense(d),
+                           paddle.to_tensor(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   0.5 * inp + 2.0 * (d @ y), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_is_same_shape(self):
+        d = _dense()
+        assert sparse.is_same_shape(_coo_from_dense(d), _coo_from_dense(d))
+
+
+class TestNN:
+    def test_relu_softmax(self):
+        d = _dense()
+        s = _coo_from_dense(d)
+        r = sparse.nn.functional.relu(s)
+        np.testing.assert_allclose(r.numpy(), np.maximum(d, 0) * (d != 0))
+        sm = sparse.nn.functional.softmax(s)
+        out = sm.numpy()
+        for i in range(d.shape[0]):
+            row = d[i][d[i] != 0]
+            if len(row):
+                e = np.exp(row - row.max())
+                np.testing.assert_allclose(out[i][d[i] != 0], e / e.sum(),
+                                           rtol=1e-5)
+
+    def test_batchnorm_values(self):
+        rng = np.random.default_rng(5)
+        # [N, D, H, W, C] point cloud with C=3 channels
+        idx = rng.integers(0, 4, (4, 20))
+        vals = rng.standard_normal((20, 3)).astype("float32")
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(4, 4, 4, 4, 3))
+        bn = sparse.nn.BatchNorm(3)
+        bn.train()
+        out = bn(s)
+        ov = np.asarray(out.values().numpy())
+        np.testing.assert_allclose(ov.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(ov.std(0), 1.0, atol=1e-2)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        paddle.seed(0)
+        rng = np.random.default_rng(6)
+        idx = np.unique(rng.integers(0, 4, (30, 4)), axis=0).T  # [4, nnz]
+        vals = rng.standard_normal((idx.shape[1], 2)).astype("float32")
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(2, 4, 4, 4, 2))
+        conv = sparse.nn.SubmConv3D(2, 5, kernel_size=3, padding=1)
+        out = conv(s)
+        assert out.shape == [2, 4, 4, 4, 5]
+        assert out.nnz == s.nnz  # submanifold: same support
+        # numerics match a dense conv sampled at the active sites
+        import jax
+
+        dense_in = np.asarray(s.to_dense().numpy())
+        ref = jax.lax.conv_general_dilated(
+            dense_in, np.asarray(conv.weight.numpy()), (1, 1, 1),
+            [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        ref = np.asarray(ref) + np.asarray(conv.bias.numpy())
+        got_dense = np.asarray(out.to_dense().numpy())
+        mask = (dense_in != 0).any(-1)
+        np.testing.assert_allclose(got_dense[mask], ref[mask], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv3d_output(self):
+        paddle.seed(1)
+        rng = np.random.default_rng(7)
+        idx = np.unique(rng.integers(0, 4, (10, 4)), axis=0).T
+        vals = rng.standard_normal((idx.shape[1], 2)).astype("float32")
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(1, 4, 4, 4, 2))
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=2)
+        out = conv(s)
+        assert out.shape == [1, 3, 3, 3, 3]
+
+    def test_maxpool3d(self):
+        rng = np.random.default_rng(8)
+        idx = np.unique(rng.integers(0, 4, (20, 4)), axis=0).T
+        vals = np.abs(rng.standard_normal(
+            (idx.shape[1], 2))).astype("float32")
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(1, 4, 4, 4, 2))
+        out = sparse.nn.functional.max_pool3d(s, kernel_size=2, stride=2)
+        assert out.shape == [1, 2, 2, 2, 2]
+        dense = np.asarray(s.to_dense().numpy())
+        ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((2, 4, 6))
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), ref,
+                                   rtol=1e-5)
