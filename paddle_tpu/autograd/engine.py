@@ -67,12 +67,18 @@ class enable_grad(contextlib.ContextDecorator):
 
 
 class GradNode:
-    """One tape entry (reference: GradNodeBase, grad_node_info.h:168)."""
+    """One tape entry (reference: GradNodeBase, grad_node_info.h:168).
 
-    __slots__ = ("vjp_fn", "edges", "out_uids", "out_avals", "out_tuple", "name", "post_hooks")
+    ``fn``/``in_vals`` keep the op's pure function + recorded input values so
+    ``grad(create_graph=True)`` can re-derive the VJP *as a tape op* (the
+    reference's double-grad story: codegen'd higher-order GradNodes; here
+    jax.vjp composes, so one generic re-derivation covers every op)."""
+
+    __slots__ = ("vjp_fn", "edges", "out_uids", "out_avals", "out_tuple",
+                 "name", "post_hooks", "fn", "in_vals")
 
     def __init__(self, vjp_fn, inputs: Sequence[Tensor], out_uids, out_avals, name="",
-                 out_tuple=False):
+                 out_tuple=False, fn=None, in_vals=None):
         self.vjp_fn = vjp_fn
         # (tensor, uid-at-record, producer-node-at-record) per differentiable input
         self.edges = [(t, t._uid, t._grad_node) for t in inputs]
@@ -81,12 +87,15 @@ class GradNode:
         self.out_tuple = out_tuple  # forward returned a tuple (even 1-element)
         self.name = name
         self.post_hooks = None
+        self.fn = fn
+        self.in_vals = in_vals  # values the vjp was taken at (post-amp-cast)
 
     def __repr__(self):
         return f"GradNode({self.name})"
 
 
-def make_node_for_outputs(vjp_fn, inputs, out_tensors, name="", out_tuple=False):
+def make_node_for_outputs(vjp_fn, inputs, out_tensors, name="", out_tuple=False,
+                          fn=None, in_vals=None):
     """Record a GradNode and attach it to out_tensors (all Tensors)."""
     node = GradNode(
         vjp_fn,
@@ -95,6 +104,8 @@ def make_node_for_outputs(vjp_fn, inputs, out_tensors, name="", out_tuple=False)
         [(tuple(t._value.shape), t._value.dtype) for t in out_tensors],
         name=name,
         out_tuple=out_tuple,
+        fn=fn,
+        in_vals=in_vals,
     )
     for i, t in enumerate(out_tensors):
         t._grad_node = node
@@ -157,7 +168,8 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
     outs_seq = outs if is_tuple else (outs,)
     out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs_seq)
     make_node_for_outputs(vjp_fn, tensors, out_tensors,
-                          name=name or getattr(fn, "__name__", "op"), out_tuple=is_tuple)
+                          name=name or getattr(fn, "__name__", "op"),
+                          out_tuple=is_tuple, fn=f, in_vals=tuple(arrays))
     return out_tensors if is_tuple else out_tensors[0]
 
 
@@ -322,6 +334,74 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = 
     _run_backward(tensors, grad_tensors, retain_graph, accumulate_into_leaves=True)
 
 
+def _run_backward_create_graph(out_tensors, out_grads, wanted_uids: set):
+    """The double-grad walk (reference: higher-order GradNodes emitted by
+    eager_gen + prim composite rules). Cotangents are TENSORS and every VJP
+    application is re-derived through ``apply_op`` from the node's recorded
+    (fn, input values) — so the returned grads carry their own tape and can
+    be differentiated again (any order: jax.vjp composes)."""
+    grads_by_uid: dict[int, Tensor] = {}
+    roots = []
+    for i, t in enumerate(out_tensors):
+        g = None if out_grads is None else out_grads[i]
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for tensors with a "
+                    f"single element; got shape {t.shape}.")
+            gt = Tensor(jnp.ones(t._value.shape, t._value.dtype),
+                        stop_gradient=True)
+        else:
+            gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        uid = t._uid
+        grads_by_uid[uid] = (grads_by_uid[uid] + gt) if uid in grads_by_uid \
+            else gt
+        if t._grad_node is not None:
+            roots.append(t._grad_node)
+
+    for node in _toposort(roots):
+        if node.fn is None or node.in_vals is None:
+            raise RuntimeError(
+                f"node {node.name} was not recorded with its forward fn; "
+                "create_graph=True cannot differentiate through it")
+        cts = []
+        for uid, (shape, dtype) in zip(node.out_uids, node.out_avals):
+            g = grads_by_uid.get(uid)
+            cts.append(Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+                       if g is None else g.astype(str(dtype)))
+        # differentiation inputs: the edge tensors when not rebound (their
+        # lineage carries second-order grads further back), else constants
+        # at the recorded values
+        in_tensors = []
+        for (t, uid, _), v in zip(node.edges, node.in_vals):
+            if t._uid == uid and tuple(t._value.shape) == tuple(v.shape):
+                in_tensors.append(t)
+            else:
+                in_tensors.append(Tensor(v, stop_gradient=True))
+        n_in = len(in_tensors)
+        out_tuple = node.out_tuple
+        node_fn = node.fn
+
+        def grad_op(*vals, _fn=node_fn, _n=n_in, _tuple=out_tuple):
+            ins, gs = vals[:_n], vals[_n:]
+            _, vjp = jax.vjp(_fn, *ins)
+            res = vjp(tuple(gs) if _tuple else gs[0])
+            return tuple(res)
+
+        in_grads = apply_op(grad_op, in_tensors + cts,
+                            name=f"{node.name}_grad")
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for (t, uid, producer), g in zip(node.edges, in_grads):
+            if g is None or g._value.dtype == jax.dtypes.float0:
+                continue
+            if producer is None and t.stop_gradient and uid not in wanted_uids:
+                continue
+            grads_by_uid[uid] = (grads_by_uid[uid] + g) \
+                if uid in grads_by_uid else g
+    return grads_by_uid
+
+
 def grad(
     outputs,
     inputs,
@@ -333,26 +413,27 @@ def grad(
 ):
     """reference: paddle.grad (eager GeneralGrad, eager/general_grad.h).
 
-    Note: create_graph (grad-of-grad through the tape) is not supported in the
-    tape engine; use paddle_tpu.incubate.autograd (direct jax.grad composition)
-    for higher-order derivatives.
+    ``create_graph=True`` returns grads that are themselves on the tape
+    (differentiable — the double-grad path), re-deriving each op's VJP from
+    its recorded forward; see ``_run_backward_create_graph``. Forward-mode /
+    program-level higher-order AD also lives in paddle_tpu.incubate.autograd.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the tape engine; use "
-            "paddle_tpu.incubate.autograd for higher-order AD."
-        )
     del only_inputs
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if grad_outputs is not None and isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph
     wanted = {t._uid for t in inputs}
-    grads_by_uid = _run_backward(
-        outputs, grad_outputs, retain_graph, accumulate_into_leaves=False, wanted_uids=wanted
-    )
+    if create_graph:
+        grads_by_uid = _run_backward_create_graph(outputs, grad_outputs,
+                                                  wanted_uids=wanted)
+    else:
+        grads_by_uid = _run_backward(
+            outputs, grad_outputs, retain_graph, accumulate_into_leaves=False,
+            wanted_uids=wanted
+        )
     results = []
     for t in inputs:
         g = grads_by_uid.get(t._uid)
@@ -363,6 +444,8 @@ def grad(
                     "pass allow_unused=True to get None for it."
                 )
             results.append(None)
+        elif create_graph:
+            results.append(g)  # already a tape Tensor with lineage
         else:
             results.append(Tensor(g))
     return results

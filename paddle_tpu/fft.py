@@ -32,15 +32,15 @@ def _check_norm(norm):
 
 def _fft_factory(jnp_fn, name, is_nd=False, default_axes=None):
     if is_nd:
-        def op(x, s=None, axes=default_axes, norm="backward", name_=None):
+        def op(x, s=None, axes=default_axes, norm="backward", name=None):
             _check_norm(norm)
             return unary(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x,
-                         name=name)
+                         name=op.__name__)
     else:
-        def op(x, n=None, axis=-1, norm="backward", name_=None):
+        def op(x, n=None, axis=-1, norm="backward", name=None):
             _check_norm(norm)
             return unary(lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), x,
-                         name=name)
+                         name=op.__name__)
     op.__name__ = name
     op.__doc__ = f"reference: python/paddle/fft.py {name} — jnp.fft.{name}."
     return op
@@ -86,11 +86,10 @@ def hfftn(x, s=None, axes=None, norm="backward", name=None):
         *lead, last = ax
         n_last = None if s is None else s[-1]
         if lead:
+            # forward transform on the leading axes (matches scipy.fft.hfftn:
+            # hfft is itself forward-style, all axes share the norm)
             s_lead = None if s is None else list(s[:-1])
-            a = jnp.fft.ifftn(a, s=s_lead, axes=tuple(lead),
-                              norm={"backward": "forward",
-                                    "forward": "backward",
-                                    "ortho": "ortho"}[norm])
+            a = jnp.fft.fftn(a, s=s_lead, axes=tuple(lead), norm=norm)
         return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
 
     return unary(f, x, name="hfftn")
@@ -106,11 +105,9 @@ def ihfftn(x, s=None, axes=None, norm="backward", name=None):
         n_last = None if s is None else s[-1]
         out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
         if lead:
+            # inverse transform on the leading axes (ihfft is inverse-style)
             s_lead = None if s is None else list(s[:-1])
-            out = jnp.fft.fftn(out, s=s_lead, axes=tuple(lead),
-                               norm={"backward": "forward",
-                                     "forward": "backward",
-                                     "ortho": "ortho"}[norm])
+            out = jnp.fft.ifftn(out, s=s_lead, axes=tuple(lead), norm=norm)
         return out
 
     return unary(f, x, name="ihfftn")

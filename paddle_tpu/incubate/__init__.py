@@ -1,9 +1,10 @@
 """paddle_tpu.incubate — experimental APIs (reference: python/paddle/incubate/).
 
 Populated: ``distributed.models.moe`` (MoELayer + gates + expert-parallel
-all-to-all). Fused-layer and autograd subpackages land with their
-subsystems.
+all-to-all), ``autograd`` (functional vjp/jvp/Jacobian/Hessian + primapi
+forward_grad/grad).
 """
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 
-__all__ = ["distributed"]
+__all__ = ["autograd", "distributed"]

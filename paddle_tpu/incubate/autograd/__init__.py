@@ -1,0 +1,266 @@
+"""paddle.incubate.autograd parity: functional + primitive AD.
+
+Reference parity: python/paddle/incubate/autograd/ —
+``functional.py`` (vjp :22, jvp :80, Jacobian :171, Hessian :260) and
+``primapi.py`` (forward_grad :25, grad :108), plus the prim-state toggles
+(enable_prim/disable_prim, primx orig2prim/prim2orig program rewrites).
+
+TPU-native collapse: the reference's prim system exists to decompose big
+grad ops into primitive ops so (a) higher-order AD works and (b) a compiler
+(CINN) sees a small op set. On TPU both jobs belong to jax/XLA — jaxpr IS
+the primitive decomposition and jax's vjp/jvp compose to any order — so
+each API here is a thin functionalization of the user callable over the
+eager tape into a pure jax function, then the corresponding jax transform.
+``enable_prim`` is therefore a no-op switch kept for API compatibility.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "forward_grad", "grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+_prim_state = {"enabled": False}
+
+
+def enable_prim():
+    """reference: primapi — on TPU the primitive decomposition is jaxpr;
+    the switch is kept for compatibility."""
+    _prim_state["enabled"] = True
+
+
+def disable_prim():
+    _prim_state["enabled"] = False
+
+
+def prim_enabled() -> bool:
+    return _prim_state["enabled"]
+
+
+def _tensorize(xs):
+    if isinstance(xs, (list, tuple)):
+        return [ensure_tensor(x) for x in xs]
+    return [ensure_tensor(xs)]
+
+
+def _functionalize(func: Callable, n: int):
+    """Wrap a Tensor->Tensor callable as a pure jax function of n arrays."""
+
+    def pure(*vals):
+        from ...autograd import no_grad
+
+        with no_grad():
+            out = func(*[Tensor(v, stop_gradient=True) for v in vals])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return pure
+
+
+def vjp(func: Callable, xs, v=None):
+    """reference: functional.py:22 — (outputs, vjp_result) of func at xs
+    with cotangent v (defaults to ones)."""
+    xs_list = _tensorize(xs)
+    pure = _functionalize(func, len(xs_list))
+    vals = [t._value for t in xs_list]
+    outs, vjp_fn = jax.vjp(pure, *vals)
+    if v is None:
+        ct = jax.tree_util.tree_map(jnp.ones_like, outs)
+    elif isinstance(v, (list, tuple)):
+        ct = tuple(ensure_tensor(x)._value for x in v)
+        if not isinstance(outs, tuple):
+            ct = ct[0]
+    else:
+        ct = ensure_tensor(v)._value
+    grads = vjp_fn(ct)
+    outs_t = (tuple(Tensor(o) for o in outs) if isinstance(outs, tuple)
+              else Tensor(outs))
+    grads_t = [Tensor(g) for g in grads]
+    return outs_t, (grads_t if len(grads_t) > 1 else grads_t[0])
+
+
+def jvp(func: Callable, xs, v=None):
+    """reference: functional.py:80 — forward-mode: (outputs, Jv)."""
+    xs_list = _tensorize(xs)
+    pure = _functionalize(func, len(xs_list))
+    vals = [t._value for t in xs_list]
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    elif isinstance(v, (list, tuple)):
+        tangents = tuple(ensure_tensor(x)._value for x in v)
+    else:
+        tangents = (ensure_tensor(v)._value,)
+    outs, jv = jax.jvp(pure, tuple(vals), tangents)
+    outs_t = (tuple(Tensor(o) for o in outs) if isinstance(outs, tuple)
+              else Tensor(outs))
+    jv_t = (tuple(Tensor(o) for o in jv) if isinstance(jv, tuple)
+            else Tensor(jv))
+    return outs_t, jv_t
+
+
+class Jacobian:
+    """reference: functional.py:171 — lazy Jacobian with [] slicing.
+
+    For func mapping [*, N] -> [*, M] (or flat vectors), ``J[:]``
+    materializes the full matrix via jax.jacfwd/jacrev (picking the cheaper
+    direction by shape).
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._xs = _tensorize(xs)
+        self._pure = _functionalize(func, len(self._xs))
+        self._vals = [t._value for t in self._xs]
+        self._is_batched = is_batched
+        self._mat = None
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def _materialize(self):
+        if self._mat is None:
+            if len(self._vals) != 1:
+                raise ValueError("Jacobian expects a single xs tensor; "
+                                 "concatenate inputs first (reference "
+                                 "behavior)")
+            x = self._vals[0]
+            out_shape = jax.eval_shape(self._pure, x).shape
+            in_sz = int(np.prod(x.shape[1:] if self._is_batched else x.shape))
+            out_sz = int(np.prod(out_shape[1:] if self._is_batched
+                                 else out_shape))
+            jac_fn = jax.jacfwd if in_sz <= out_sz else jax.jacrev
+            if self._is_batched:
+                f = jax.vmap(jac_fn(self._pure))
+                j = f(x)  # [B, out..., in...]
+                B = x.shape[0]
+                self._mat = jnp.reshape(j, (B, out_sz, in_sz))
+            else:
+                j = jac_fn(self._pure)(x)
+                self._mat = jnp.reshape(j, (out_sz, in_sz))
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def numpy(self):
+        return np.asarray(self._materialize())
+
+
+class Hessian:
+    """reference: functional.py:260 — Hessian of a scalar-output func."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._xs = _tensorize(xs)
+        pure = _functionalize(func, len(self._xs))
+
+        def scalar(x):
+            out = pure(x)
+            return jnp.reshape(out, ()) if not is_batched \
+                else jnp.reshape(out, out.shape[:1])
+
+        self._pure = scalar
+        self._vals = [t._value for t in self._xs]
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            x = self._vals[0]
+            if self._is_batched:
+                h = jax.vmap(jax.hessian(
+                    lambda xx: jnp.reshape(self._pure(xx[None]), ())))(x)
+                B = x.shape[0]
+                n = int(np.prod(x.shape[1:]))
+                self._mat = jnp.reshape(h, (B, n, n))
+            else:
+                h = jax.hessian(self._pure)(x)
+                n = int(np.prod(x.shape))
+                self._mat = jnp.reshape(h, (n, n))
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def numpy(self):
+        return np.asarray(self._materialize())
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """reference: primapi.py:25 — forward-mode grads of tape outputs w.r.t.
+    tape inputs. The tape records (fn, in_vals) per node, so the computation
+    from ``inputs`` to ``outputs`` is replayed as a pure function and pushed
+    through jax.jvp."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    fn, in_vals = _replay_function(outputs, inputs)
+    if grad_inputs is None:
+        tangents = tuple(jnp.ones_like(v) for v in in_vals)
+    else:
+        gi = grad_inputs if isinstance(grad_inputs, (list, tuple)) \
+            else [grad_inputs]
+        tangents = tuple(ensure_tensor(g)._value for g in gi)
+    _, jv = jax.jvp(fn, tuple(in_vals), tangents)
+    if not isinstance(jv, tuple):
+        return Tensor(jv)
+    res = [Tensor(g) for g in jv]
+    return res if len(res) > 1 else res[0]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference: primapi.py:108 — reverse-mode via the same replay."""
+    from ...autograd import engine
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    res = engine.grad(outs, ins, grad_outputs=grad_outputs,
+                      retain_graph=True, allow_unused=True)
+    return res if len(res) > 1 else res[0]
+
+
+def _replay_function(outputs: Sequence[Tensor], inputs: Sequence[Tensor]):
+    """Rebuild the pure function inputs->outputs from the tape (each GradNode
+    recorded its forward fn + input values)."""
+    def replay(*in_vals):
+        env = {}  # uid -> value
+        for t, v in zip(inputs, in_vals):
+            env[t._uid] = v
+        computed = set()
+
+        def compute(node):
+            if id(node) in computed:
+                return
+            computed.add(id(node))
+            vals = []
+            for t, uid, producer in node.edges:
+                if uid not in env and producer is not None:
+                    compute(producer)
+                vals.append(env.get(uid, t._value))
+            if node.fn is None:
+                raise RuntimeError(
+                    f"node {node.name} lacks a recorded forward fn")
+            out = node.fn(*vals)
+            outs = out if isinstance(out, tuple) else (out,)
+            for uid, o in zip(node.out_uids, outs):
+                env[uid] = o
+
+        results = []
+        for t in outputs:
+            if t._grad_node is not None:
+                compute(t._grad_node)
+            results.append(env.get(t._uid, t._value))
+        return tuple(results) if len(results) > 1 else results[0]
+
+    return replay, [t._value for t in inputs]

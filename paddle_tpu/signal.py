@@ -50,13 +50,13 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     xt = ensure_tensor(x)
 
     def f(a):
-        if axis in (-1, a.ndim - 1):
+        if axis in (-1, a.ndim - 1) and axis != 0:
             frames = jnp.swapaxes(a, -1, -2)  # [..., F, L]
+        elif a.ndim > 2:
+            # axis==0 layout [F, L, ...]: moveaxis alone yields [..., F, L]
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))
         else:
-            frames = a  # [F, L, ...] -> move to [..., F, L]
-            if a.ndim > 2:
-                frames = jnp.moveaxis(a, (0, 1), (-2, -1))
-                frames = jnp.swapaxes(frames, -1, -2)
+            frames = a  # 2-D [F, L]
         F, L = frames.shape[-2], frames.shape[-1]
         n_out = (F - 1) * hop_length + L
         idx = (np.arange(F) * hop_length)[:, None] + np.arange(L)[None, :]
@@ -88,6 +88,10 @@ def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
         w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
 
     def f(a):
+        if jnp.iscomplexobj(a) and onesided:
+            raise ValueError(
+                "stft with a complex input requires onesided=False "
+                "(reference contract: onesided spectra are for real input)")
         was_1d = a.ndim == 1
         if was_1d:
             a = a[None]

@@ -274,9 +274,13 @@ def matmul(x, y, name=None):
                 xs, yd,
                 dimension_numbers=(((xs.ndim - 1,), (0,)), ((), ()))),
             [yv], name="sparse_matmul")
-    # dense @ sparse
+    # dense @ sparse (2-D only: compute (y^T @ x^T)^T via spmm)
     ys = _coo(y)._bcoo
     xv = ensure_tensor(x)
+    if ys.ndim != 2 or len(xv.shape) != 2:
+        raise NotImplementedError(
+            "dense @ sparse matmul supports 2-D operands; batched layouts "
+            "need sparse @ dense (bcoo_dot_general) instead")
     return apply_op(
         lambda xd: jsparse.bcoo_dot_general(
             ys.transpose((1, 0)), xd.T,
@@ -299,8 +303,6 @@ def masked_matmul(x, y, mask, name=None):
     mask's sparsity (bcoo_dot_general_sampled; cuSPARSE SDDMM counterpart)."""
     m = _coo(mask)._bcoo
     xv, yv = ensure_tensor(x), ensure_tensor(y)
-
-    key = {"out": None}
 
     def fn(xd, yd):
         out = jsparse.bcoo_dot_general_sampled(

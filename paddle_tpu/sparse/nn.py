@@ -103,18 +103,29 @@ class functional:
         v = ensure_tensor(value)
         d = float(q.shape[-1])
         B, H = q.shape[0], q.shape[1]
+        kp = None if key_padding_mask is None \
+            else ensure_tensor(key_padding_mask)._value
+        am = None if attn_mask is None else ensure_tensor(attn_mask)._value
         outs = []
         for b in range(B):
             for h in range(H):
                 scores = masked_matmul(
                     q[b, h] / (d ** 0.5),
                     k[b, h].transpose([1, 0]), sparse_mask)
+                sb = scores._bcoo
+                data, idx = sb.data, sb.indices  # idx [nnz, 2] = (i, j)
+                if am is not None:
+                    data = data + am[idx[:, 0], idx[:, 1]]
+                if kp is not None:
+                    # True/nonzero = padded key -> excluded from softmax
+                    data = jnp.where(kp[b][idx[:, 1]].astype(bool),
+                                     jnp.asarray(-1e9, data.dtype), data)
+                scores = _wrap(jsparse.BCOO((data, idx), shape=sb.shape))
                 p = functional.softmax(scores)
                 outs.append(smatmul(p, v[b, h]))
-        out0 = outs[0]
-        stacked = jnp.stack([o._value if isinstance(o, Tensor) else o._bcoo.todense()
-                             for o in outs]).reshape(
-            (B, H) + tuple(outs[0].shape))
+        stacked = jnp.stack(
+            [o._value if isinstance(o, Tensor) else o._bcoo.todense()
+             for o in outs]).reshape((B, H) + tuple(outs[0].shape))
         return Tensor(stacked)
 
     @staticmethod
@@ -129,7 +140,7 @@ class functional:
         out = F.max_pool3d(Tensor(dn), kernel_size, stride=stride,
                            padding=padding)
         od = jnp.moveaxis(out._value, 1, -1)
-        return _wrap(jsparse.BCOO.fromdense(od))
+        return _wrap(jsparse.BCOO.fromdense(od, n_dense=1))
 
 
 class ReLU(Layer):

@@ -140,3 +140,43 @@ class TestSignal:
             signal.frame(x, frame_length=4, hop_length=0)
         with pytest.raises(ValueError):
             signal.overlap_add(np.ones((4, 3), "float32"), hop_length=-1)
+
+
+class TestReviewRegressions:
+    def test_hfftn_vs_scipy(self):
+        import scipy.fft as sft
+
+        rng = np.random.default_rng(9)
+        x = (rng.standard_normal((4, 5))
+             + 1j * rng.standard_normal((4, 5))).astype("complex64")
+        np.testing.assert_allclose(_np(fft.hfftn(x)), sft.hfftn(x),
+                                   rtol=1e-3, atol=1e-4)
+        r = rng.standard_normal((4, 5)).astype("float32")
+        np.testing.assert_allclose(_np(fft.ihfftn(r)), sft.ihfftn(r),
+                                   rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(_np(fft.hfft2(x)), sft.hfft2(x),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_overlap_add_axis0_batched(self):
+        x = np.random.default_rng(10).standard_normal(
+            (3, 4, 2)).astype("float32")  # [F, L, B]
+        y = _np(signal.overlap_add(x, hop_length=2, axis=0))
+        assert y.shape == (8, 2)
+        expect = np.zeros((8, 2), "float32")
+        for f in range(3):
+            expect[f * 2:f * 2 + 4] += x[f]
+        np.testing.assert_allclose(y, expect, rtol=1e-5)
+
+    def test_fft_accepts_name_kwarg(self):
+        x = np.ones(8, "float32")
+        fft.fft(x, name="n")
+        fft.fftn(x, name="n")
+
+    def test_stft_complex_onesided_raises(self):
+        x = np.ones(64, "complex64")
+        with pytest.raises(ValueError):
+            signal.stft(x, n_fft=16)
+
+
+def _np(t):
+    return np.asarray(t.numpy())

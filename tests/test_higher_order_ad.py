@@ -1,0 +1,149 @@
+"""Higher-order AD: grad(create_graph=True) double/triple grads +
+incubate.autograd functional/primapi (reference: eager GeneralGrad,
+incubate/autograd/functional.py:22,80,171,260, primapi.py:25,108)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.autograd as ag
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import autograd as iag
+
+
+def _x(v=2.0):
+    x = paddle.to_tensor(np.float32(v))
+    x.stop_gradient = False
+    return x
+
+
+class TestCreateGraph:
+    def test_double_and_triple_grad_polynomial(self):
+        x = _x(2.0)
+        y = x * x * x
+        (g1,) = ag.grad([y], [x], create_graph=True)
+        np.testing.assert_allclose(float(g1.numpy()), 12.0, rtol=1e-6)
+        (g2,) = ag.grad([g1], [x], create_graph=True)
+        np.testing.assert_allclose(float(g2.numpy()), 12.0, rtol=1e-6)
+        (g3,) = ag.grad([g2], [x])
+        np.testing.assert_allclose(float(g3.numpy()), 6.0, rtol=1e-6)
+
+    def test_double_grad_through_nonlinearity(self):
+        x = _x(0.3)
+        y = paddle.ops.tanh(x)
+        (g1,) = ag.grad([y], [x], create_graph=True)
+        (g2,) = ag.grad([g1], [x])
+        t = np.tanh(0.3)
+        np.testing.assert_allclose(float(g1.numpy()), 1 - t ** 2, rtol=1e-5)
+        np.testing.assert_allclose(float(g2.numpy()),
+                                   -2 * t * (1 - t ** 2), rtol=1e-5)
+
+    def test_double_grad_vector_sum(self):
+        xv = np.array([1.0, 2.0, 3.0], "float32")
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        y = (x * x * x).sum()
+        (g1,) = ag.grad([y], [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(g1.numpy()), 3 * xv ** 2,
+                                   rtol=1e-5)
+        (g2,) = ag.grad([g1.sum()], [x])
+        np.testing.assert_allclose(np.asarray(g2.numpy()), 6 * xv, rtol=1e-5)
+
+    def test_double_grad_through_layer(self):
+        """Gradient-penalty pattern: ||d loss/d x||^2 differentiated w.r.t.
+        layer weights."""
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        xv = np.random.default_rng(0).standard_normal((3, 4)).astype(
+            "float32")
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        out = lin(x).sum()
+        (gx,) = ag.grad([out], [x], create_graph=True)
+        penalty = (gx * gx).sum()
+        penalty.backward()
+        # d penalty / d W = 2 * B * W (gx = W broadcast over batch rows)
+        expect = 2 * 3 * np.asarray(lin.weight.numpy())
+        np.testing.assert_allclose(np.asarray(lin.weight.grad.numpy()),
+                                   expect, rtol=1e-4)
+
+    def test_create_graph_result_requires_grad(self):
+        x = _x()
+        (g,) = ag.grad([x * x], [x], create_graph=True)
+        assert not g.stop_gradient
+
+    def test_plain_grad_unchanged(self):
+        x = _x(3.0)
+        (g,) = ag.grad([x * x], [x])
+        np.testing.assert_allclose(float(g.numpy()), 6.0, rtol=1e-6)
+        assert g.stop_gradient
+
+
+class TestFunctionalAD:
+    def test_vjp(self):
+        out, g = iag.vjp(lambda x: (x * x).sum(),
+                         paddle.to_tensor(np.array([1.0, 2.0], "float32")))
+        np.testing.assert_allclose(float(out.numpy()), 5.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g.numpy()), [2.0, 4.0],
+                                   rtol=1e-6)
+
+    def test_jvp(self):
+        out, jv = iag.jvp(
+            lambda x: x * x,
+            paddle.to_tensor(np.array([1.0, 2.0], "float32")),
+            v=paddle.to_tensor(np.array([1.0, 0.0], "float32")))
+        np.testing.assert_allclose(np.asarray(jv.numpy()), [2.0, 0.0],
+                                   rtol=1e-6)
+
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        J = iag.Jacobian(lambda x: paddle.ops.stack(
+            [x[0] * x[1], x[0] + x[1]]), x)
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   [[2.0, 1.0], [1.0, 1.0]], rtol=1e-5)
+        assert J.shape == (2, 2)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        H = iag.Hessian(lambda x: (x * x).sum() + x[0] * x[1], x)
+        np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                                   [[2.0, 1.0], [1.0, 2.0]], rtol=1e-5)
+
+    def test_batched_jacobian(self):
+        xv = np.random.default_rng(1).standard_normal((4, 3)).astype(
+            "float32")
+        J = iag.Jacobian(lambda x: x * x, paddle.to_tensor(xv),
+                         is_batched=True)
+        got = np.asarray(J[:].numpy())
+        assert got.shape == (4, 3, 3)
+        for b in range(4):
+            np.testing.assert_allclose(got[b], np.diag(2 * xv[b]), rtol=1e-5)
+
+
+class TestPrimAPI:
+    def test_forward_grad_replays_tape(self):
+        x = _x(2.0)
+        y = x * x * x
+        fg = iag.forward_grad(y, x)
+        np.testing.assert_allclose(float(fg.numpy()), 12.0, rtol=1e-5)
+
+    def test_forward_grad_with_tangent(self):
+        xv = np.array([1.0, 2.0], "float32")
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        y = (x * x).sum()
+        fg = iag.forward_grad(y, x, grad_inputs=paddle.to_tensor(
+            np.array([1.0, 0.0], "float32")))
+        np.testing.assert_allclose(float(fg.numpy()), 2.0, rtol=1e-5)
+
+    def test_primapi_grad(self):
+        x = _x(3.0)
+        y = x * x
+        g = iag.grad(y, x)
+        np.testing.assert_allclose(float(g.numpy()), 6.0, rtol=1e-6)
+
+    def test_prim_toggles(self):
+        iag.enable_prim()
+        assert iag.prim_enabled()
+        iag.disable_prim()
+        assert not iag.prim_enabled()

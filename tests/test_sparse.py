@@ -224,3 +224,45 @@ class TestNN:
         ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((2, 4, 6))
         np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), ref,
                                    rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_maxpool_keeps_dense_channel_layout(self):
+        rng = np.random.default_rng(9)
+        idx = np.unique(rng.integers(0, 4, (20, 4)), axis=0).T
+        vals = np.abs(rng.standard_normal((idx.shape[1], 2))).astype(
+            "float32")
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(1, 4, 4, 4, 2))
+        out = sparse.nn.functional.max_pool3d(s, kernel_size=2, stride=2)
+        assert np.asarray(out.values().numpy()).ndim == 2  # [nnz, C]
+        assert out.indices().shape[0] == 4  # spatial dims only
+
+    def test_dense_sparse_matmul_batched_raises(self):
+        d = np.zeros((2, 3, 4), "float32")
+        s = sparse.sparse_coo_tensor([[0], [0]], [1.0], shape=(4, 4))
+        import paddle_tpu as pt
+        with pytest.raises(NotImplementedError):
+            sparse.matmul(pt.to_tensor(d), s)
+
+    def test_attention_masks_applied(self):
+        import paddle_tpu as pt
+
+        rng = np.random.default_rng(11)
+        B, H, S, D = 1, 1, 4, 8
+        q = rng.standard_normal((B, H, S, D)).astype("float32")
+        k = rng.standard_normal((B, H, S, D)).astype("float32")
+        v = rng.standard_normal((B, H, S, D)).astype("float32")
+        mask = sparse.sparse_coo_tensor(
+            np.argwhere(np.ones((S, S))).T, np.ones(S * S, "float32"),
+            shape=(S, S))
+        kp = np.array([[0, 0, 0, 1]], "float32")  # last key padded
+        out = sparse.nn.functional.attention(
+            pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v), mask,
+            key_padding_mask=pt.to_tensor(kp))
+        # reference: dense attention with the padded key excluded
+        scores = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        scores[:, 3] = -1e9
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   p @ v[0, 0], rtol=1e-3, atol=1e-4)
