@@ -46,6 +46,8 @@ from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
+from . import static  # noqa: E402
 from . import profiler  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402

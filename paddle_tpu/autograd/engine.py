@@ -359,6 +359,33 @@ def _run_backward_create_graph(out_tensors, out_grads, wanted_uids: set):
         if t._grad_node is not None:
             roots.append(t._grad_node)
 
+    # tensor hooks fire on the finalized grad exactly like the first-order
+    # walk — a hook (e.g. grad clipping) silently skipped under create_graph
+    # would make double-grad results diverge from backward()/grad()
+    hooked: dict[int, Tensor] = {}
+    hooks_applied: set[int] = set()
+
+    def _register(t: Tensor, uid: int):
+        if t._uid == uid and t._hooks:
+            hooked[uid] = t
+
+    for t in out_tensors:
+        _register(t, t._uid)
+
+    def _apply_hooks(uid: int):
+        t = hooked.get(uid)
+        if t is None or uid in hooks_applied or uid not in grads_by_uid:
+            return
+        hooks_applied.add(uid)
+        g = grads_by_uid[uid]
+        for hook in t._hooks:
+            if hook is None:
+                continue
+            res = hook(g)
+            if res is not None:
+                g = res if isinstance(res, Tensor) else Tensor(jnp.asarray(res))
+        grads_by_uid[uid] = g
+
     for node in _toposort(roots):
         if node.fn is None or node.in_vals is None:
             raise RuntimeError(
@@ -366,6 +393,7 @@ def _run_backward_create_graph(out_tensors, out_grads, wanted_uids: set):
                 "create_graph=True cannot differentiate through it")
         cts = []
         for uid, (shape, dtype) in zip(node.out_uids, node.out_avals):
+            _apply_hooks(uid)  # grad final: all consumers ran
             g = grads_by_uid.get(uid)
             cts.append(Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
                        if g is None else g.astype(str(dtype)))
@@ -399,6 +427,9 @@ def _run_backward_create_graph(out_tensors, out_grads, wanted_uids: set):
                 continue
             grads_by_uid[uid] = (grads_by_uid[uid] + g) \
                 if uid in grads_by_uid else g
+            _register(t, uid)
+    for uid in list(hooked):
+        _apply_hooks(uid)  # leaves: finalized at end of walk
     return grads_by_uid
 
 

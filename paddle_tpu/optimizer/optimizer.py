@@ -252,7 +252,24 @@ class Optimizer:
         ``loss.backward()`` call; it never re-runs autodiff. Matching that
         contract here: callers must run ``loss.backward()`` first (the
         documented pattern), otherwise we raise instead of silently
-        double-accumulating."""
+        double-accumulating.
+
+        Inside a ``static.program_guard`` this is DECLARATIVE (reference:
+        static-graph minimize appends backward+opt ops to the Program): the
+        loss/optimizer register with the program; the actual grads + step
+        happen in Executor.run."""
+        from ..static import _collect_parameters, _guard_stack
+
+        if _guard_stack:
+            prog = _guard_stack[-1][0]
+            prog.loss = loss
+            prog.optimizer = self
+            if self._parameter_list is None:
+                # static contract: minimize() without parameters= trains
+                # every trainable var reachable from the loss
+                self._parameter_list = _collect_parameters(loss)
+                self._materialize_accumulators()
+            return None, []
         if (self._parameter_list is not None
                 and not any(p.grad is not None for p in self._parameter_list)):
             raise RuntimeError(

@@ -1,0 +1,328 @@
+"""paddle.quantization parity: QuantConfig + QAT/PTQ over fake-quant ops.
+
+Reference parity: python/paddle/quantization/ — ``QuantConfig``
+(config.py:60, add_layer_config/add_name_config/add_type_config),
+``QAT.quantize`` (qat.py:41 — insert fake quanters), ``PTQ.quantize``
+(ptq.py:41 — insert observers), ``AbsmaxObserver`` (observers/abs_max.py),
+``FakeQuanterWithAbsMaxObserver`` (quanters/abs_max.py), and ``convert``
+producing the deploy-form model.
+
+TPU-native: fake-quantization is a straight-through-estimator op
+(jax.custom_vjp — identity gradient), so QAT trains through the rounding
+exactly like the reference's fake_quantize_dequantize kernels; observers
+are plain Layers tracking absmax state. int8 simulation keeps values in
+float (scale * round(x/scale)) — on TPU the deploy win comes from XLA
+int8 matmul lowering, which consumes the same scales.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "BaseObserver", "BaseQuanter",
+    "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
+    "QuantedConv2D", "quanters", "observers",
+]
+
+
+# ----------------------------------------------------------- fake-quant (STE)
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale, qmax)
+
+
+def _fq_bwd(res, g):
+    x, scale, qmax = res
+    s = jnp.maximum(scale, 1e-9)
+    # straight-through inside the clip range, zero outside
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale), None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+# ------------------------------------------------------------------- base API
+class BaseObserver(Layer):
+    """reference: base_observer.py — collects statistics, yields scales."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def scales(self) -> Tensor:
+        raise NotImplementedError
+
+    def quantize(self, x):
+        """Fake-quantize with the observed scale (post-calibration)."""
+        xt = ensure_tensor(x)
+        s = self.scales()._value
+        return apply_op(lambda v: _fake_quant(v, s, self.qmax), [xt],
+                        name="fake_quant")
+
+
+class BaseQuanter(BaseObserver):
+    """reference: base_quanter.py — an observer that also fake-quants in
+    the forward (QAT)."""
+
+
+class _Factory:
+    """reference: factory.py QuanterFactory — configs hold a factory so each
+    layer gets its OWN observer instance."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls, self.args, self.kwargs = cls, args, kwargs
+
+    def _instance(self):
+        return self.cls(*self.args, **self.kwargs)
+
+
+def _instantiate(spec):
+    if spec is None:
+        return None
+    if isinstance(spec, _Factory):
+        return spec._instance()
+    if isinstance(spec, type):
+        return spec()
+    # a template instance: clone per layer
+    return copy.deepcopy(spec)
+
+
+# ------------------------------------------------------------------ observers
+class AbsmaxObserver(BaseObserver):
+    """reference: observers/abs_max.py — running max(|x|) calibration."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._max = 1e-9
+
+    def forward(self, x):
+        xt = ensure_tensor(x)
+        self._max = max(self._max,
+                        float(jnp.max(jnp.abs(xt._value))))
+        return xt
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.float32(self._max), stop_gradient=True)
+
+
+class observers:
+    AbsmaxObserver = AbsmaxObserver
+
+
+# ------------------------------------------------------------------- quanters
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """reference: quanters/abs_max.py — moving-average absmax + fake-quant
+    forward with STE gradient."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._state = 1.0
+        self._accum = 1.0
+        self._scale = 1e-9
+
+    def forward(self, x):
+        xt = ensure_tensor(x)
+        if self.training:
+            cur = float(jnp.max(jnp.abs(xt._value)))
+            r = self.moving_rate
+            self._accum = r * self._accum + cur
+            self._state = r * self._state + 1.0
+            self._scale = self._accum / self._state
+        s = jnp.float32(max(self._scale, 1e-9))
+        return apply_op(lambda v: _fake_quant(v, s, self.qmax), [xt],
+                        name="fake_quant")
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.float32(max(self._scale, 1e-9)),
+                      stop_gradient=True)
+
+
+class quanters:
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+
+
+# -------------------------------------------------------------------- config
+class QuantConfig:
+    """reference: config.py:60."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._layer_cfg: Dict[int, dict] = {}
+        self._name_cfg: Dict[str, dict] = {}
+        self._type_cfg: Dict[Type, dict] = {}
+        # seeded with the defaults so add_qat_layer_mapping EXTENDS them
+        # (an empty start would silently drop Linear/Conv2D quantization
+        # the moment a user adds one custom mapping)
+        self._qat_layer_mapping = _default_mapping()
+
+    def add_layer_config(self, layers, activation=None, weight=None):
+        """reference: config.py:96 — per-instance override."""
+        layers = layers if isinstance(layers, (list, tuple)) else [layers]
+        for l in layers:
+            self._layer_cfg[id(l)] = {"activation": activation,
+                                      "weight": weight}
+
+    def add_name_config(self, names, activation=None, weight=None):
+        """reference: config.py:140 — by full_name prefix."""
+        names = names if isinstance(names, (list, tuple)) else [names]
+        for n in names:
+            self._name_cfg[n] = {"activation": activation, "weight": weight}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        """reference: config.py:183 — by layer class."""
+        layer_types = layer_types if isinstance(layer_types, (list, tuple)) \
+            else [layer_types]
+        for t in layer_types:
+            self._type_cfg[t] = {"activation": activation, "weight": weight}
+
+    def add_qat_layer_mapping(self, source: Type, target: Type):
+        """reference: config.py add_qat_layer_mapping."""
+        self._qat_layer_mapping[source] = target
+
+    def _config_for(self, layer: Layer, name: str) -> Optional[dict]:
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for prefix, cfg in self._name_cfg.items():
+            if name == prefix or name.startswith(prefix + "."):
+                return cfg
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global_activation is not None \
+                or self._global_weight is not None:
+            return {"activation": self._global_activation,
+                    "weight": self._global_weight}
+        return None
+
+
+# ------------------------------------------------------------- quanted layers
+class QuantedLinear(Layer):
+    """QAT/PTQ wrapper for nn.Linear (reference: nn/quant_layers Linear)."""
+
+    def __init__(self, source: Layer, weight_quanter, act_quanter):
+        super().__init__()
+        self.source = source
+        self.weight_quanter = weight_quanter
+        self.activation_quanter = act_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = self.source.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.linear(x, w, self.source.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT/PTQ wrapper for nn.Conv2D."""
+
+    def __init__(self, source: Layer, weight_quanter, act_quanter):
+        super().__init__()
+        self.source = source
+        self.weight_quanter = weight_quanter
+        self.activation_quanter = act_quanter
+
+    def forward(self, x):
+        src = self.source
+        w = src.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        from ..nn import functional as F
+
+        return F.conv2d(x, w, src.bias, stride=src._stride,
+                        padding=src._padding, dilation=src._dilation,
+                        groups=src._groups)
+
+
+def _default_mapping():
+    from .. import nn
+
+    return {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+# ------------------------------------------------------------------ QAT / PTQ
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _convert_layers(self, model: Layer, prefix: str = ""):
+        cfg = self._config
+        mapping = cfg._qat_layer_mapping
+        for name, child in list(model.named_children()):
+            path = f"{prefix}.{name}" if prefix else name
+            self._convert_layers(child, prefix=path)
+            lcfg = cfg._config_for(child, path)
+            target = None
+            for src_t, tgt in mapping.items():
+                if type(child) is src_t:
+                    target = tgt
+                    break
+            if lcfg is None or target is None:
+                continue
+            wq = _instantiate(lcfg.get("weight"))
+            aq = _instantiate(lcfg.get("activation"))
+            if wq is None and aq is None:
+                continue
+            model.add_sublayer(name, target(child, wq, aq))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False):
+        """reference: quantize.py convert — freeze to the deploy form:
+        weights replaced by their fake-quantized values, observers dropped."""
+        _model = model if inplace else copy.deepcopy(model)
+        for name, child in list(_model.named_children()):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                src = child.source
+                if child.weight_quanter is not None:
+                    src.weight._value = child.weight_quanter.quantize(
+                        src.weight)._value
+                _model.add_sublayer(name, src)
+            else:
+                self.convert(child, inplace=True)
+        return _model
+
+
+class QAT(Quantization):
+    """reference: qat.py:23."""
+
+    def quantize(self, model: Layer, inplace: bool = False):
+        assert model.training, (
+            "Quantization-Aware Training should work on training models. "
+            "Please set training mode by model.train().")
+        _model = model if inplace else copy.deepcopy(model)
+        return self._convert_layers(_model)
+
+
+class PTQ(Quantization):
+    """reference: ptq.py:24."""
+
+    def quantize(self, model: Layer, inplace: bool = False):
+        assert not model.training, (
+            "Post-Training Quantization should not work on training models. "
+            "Please set evaluation mode by model.eval().")
+        _model = model if inplace else copy.deepcopy(model)
+        return self._convert_layers(_model)

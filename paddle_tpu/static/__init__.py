@@ -1,0 +1,323 @@
+"""paddle.static facade: Program / Executor / data / program_guard.
+
+Reference parity: python/paddle/static/ — ``Program`` (fluid/framework.py
+:5222), ``Executor`` (fluid/executor.py:893 → C++ StandaloneExecutor/
+InterpreterCore), ``data`` (static/input.py), ``program_guard``,
+``save/load_inference_model`` (static/io.py), plus ``InputSpec`` and the
+``nn`` sublayer helpers.
+
+TPU-native collapse (SURVEY.md §7 step 5): the reference's Program is an
+op-desc graph executed instruction-by-instruction by InterpreterCore. Here
+the eager tape IS the graph — ``static.data`` creates placeholder leaves,
+the user's layer calls record tape nodes as usual, and ``Executor.run``
+replays the recorded subgraph placeholders→fetches as ONE pure jax
+function compiled per feed signature (the whole InterpreterCore scheduling
+problem collapses into XLA's static schedule). ``Optimizer.minimize``
+inside a program records the loss + optimizer so ``run`` performs the
+fused train step (grads via jax, update via the optimizer machinery).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.static_function import InputSpec  # noqa: F401 (re-export)
+from ..ops._apply import ensure_tensor
+from ..tensor import Parameter, Tensor
+from .. import dtypes as _dtypes
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "InputSpec",
+    "save_inference_model", "load_inference_model", "cpu_places",
+    "cuda_places", "xpu_places", "global_scope",
+]
+
+
+class Program:
+    """reference: fluid/framework.py:5222 — here: a registry of placeholder
+    inputs + (after minimize) the training objective."""
+
+    def __init__(self):
+        self.placeholders: Dict[str, Tensor] = {}
+        self.loss: Optional[Tensor] = None
+        self.optimizer = None
+        self.random_seed = 0
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.placeholders = dict(self.placeholders)
+        if not for_test:
+            p.loss, p.optimizer = self.loss, self.optimizer
+        return p
+
+    def global_block(self):
+        return self
+
+    @property
+    def var_names(self):
+        return list(self.placeholders)
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[tuple] = []
+
+
+def default_main_program() -> Program:
+    """reference: fluid/framework.py default_main_program."""
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _guard_stack[-1][1] if _guard_stack else _default_startup
+
+
+class program_guard:
+    """reference: static/program_guard — scope main/startup programs."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _guard_stack.append((self.main, self.startup))
+        return self
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        return False
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Tensor:
+    """reference: static/input.py data — a placeholder leaf registered with
+    the current program. ``None``/-1 dims become 1 at build; Executor.run
+    recompiles per concrete feed shape (polymorphic like the reference)."""
+    concrete = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
+    dt = _dtypes.convert_dtype(dtype)
+    # stop_gradient=False: every downstream op must record a tape node even
+    # when no Parameter participates, or Executor.run's replay would hand
+    # back stale build-time values for parameter-free fetches
+    t = Tensor(jnp.zeros(concrete, dt), stop_gradient=False)
+    t.name = name
+    default_main_program().placeholders[name] = t
+    return t
+
+
+def _collect_parameters(loss: Tensor) -> List[Parameter]:
+    """All trainable Parameter leaves reachable from ``loss``'s tape — the
+    static-graph minimize() contract (reference: minimize collects every
+    trainable var in the program when no parameter list is given)."""
+    seen_nodes, seen_ids, out = set(), set(), []
+    stack = [loss._grad_node] if loss._grad_node is not None else []
+    while stack:
+        node = stack.pop()
+        if id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        for t, uid, producer in node.edges:
+            if producer is not None:
+                stack.append(producer)
+            elif (isinstance(t, Parameter) and not t.stop_gradient
+                  and t._uid == uid and id(t) not in seen_ids):
+                seen_ids.add(id(t))
+                out.append(t)
+    return out
+
+
+class Executor:
+    """reference: fluid/executor.py:893. ``run`` compiles the recorded
+    subgraph per (program, feed shapes) and executes it as one XLA call."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    @staticmethod
+    def _reachable_uids(fetches) -> set:
+        """uids of every tensor the fetch subgraph reads."""
+        seen_nodes, uids = set(), set()
+        stack = [t._grad_node for t in fetches if t._grad_node is not None]
+        uids.update(t._uid for t in fetches)
+        while stack:
+            node = stack.pop()
+            if id(node) in seen_nodes:
+                continue
+            seen_nodes.add(id(node))
+            for t, uid, producer in node.edges:
+                uids.add(uid)
+                if producer is not None:
+                    stack.append(producer)
+        return uids
+
+    def _resolve_fetch(self, program: Program, f):
+        if isinstance(f, Tensor):
+            return f
+        if isinstance(f, str):
+            if f in program.placeholders:
+                return program.placeholders[f]
+            raise ValueError(
+                f"fetch_list name {f!r} is not a program placeholder; pass "
+                "the Tensor object for intermediate variables (the tape has "
+                "no global name registry)")
+        raise TypeError(f"bad fetch_list entry: {f!r}")
+
+    def run(self, program: Optional[Program] = None, feed: dict = None,
+            fetch_list: Sequence = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = [self._resolve_fetch(program, f)
+                      for f in (fetch_list or [])]
+        if not fetch_list and program.loss is None:
+            return []  # startup programs: parameters already initialized
+
+        from ..incubate.autograd import _replay_function
+
+        train = program.loss is not None and program.optimizer is not None
+        fetches = list(fetch_list)
+        loss_idx = None
+        if train:
+            for i, f in enumerate(fetches):
+                if f is program.loss:
+                    loss_idx = i
+                    break
+            if loss_idx is None:
+                fetches.append(program.loss)
+                loss_idx = len(fetches) - 1
+
+        # every placeholder the fetch subgraph reads MUST be fed — a missing
+        # feed silently evaluating to build-time zeros is how wrong numbers
+        # (and wrong gradients) escape unnoticed
+        needed = self._reachable_uids(fetches)
+        missing = [n for n, t in program.placeholders.items()
+                   if t._uid in needed and n not in feed]
+        if missing:
+            raise KeyError(
+                f"feed is missing required placeholder(s): {missing}")
+
+        ph_names = [n for n in feed if n in program.placeholders]
+        placeholders = [program.placeholders[n] for n in ph_names]
+        params = list(program.optimizer._parameter_list or []) if train \
+            else []
+
+        # bind feeds (shape-polymorphic: replace placeholder values)
+        for n, t in zip(ph_names, placeholders):
+            t._value = ensure_tensor(np.asarray(feed[n]))._value
+
+        key = (id(program), tuple(t._uid for t in fetches), train,
+               tuple((tuple(t._value.shape), str(t._value.dtype))
+                     for t in placeholders))
+        cached = self._cache.get(key)
+        if cached is None:
+            fn, _ = _replay_function(fetches, placeholders + params)
+            n_ph = len(placeholders)
+
+            if train and params:
+                def loss_of(*vals):
+                    outs = fn(*vals)
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    return jnp.reshape(outs[loss_idx], ())
+
+                def step_fn(*vals):
+                    outs = fn(*vals)
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    grads = jax.grad(
+                        lambda *pv: loss_of(*(list(vals[:n_ph]) + list(pv)))
+                    )(*vals[n_ph:])
+                    if not isinstance(grads, (tuple, list)):
+                        grads = (grads,)
+                    return outs, tuple(grads)
+
+                cached = jax.jit(step_fn)
+            else:
+                def fwd_fn(*vals):
+                    outs = fn(*vals)
+                    return outs if isinstance(outs, tuple) else (outs,)
+
+                cached = jax.jit(fwd_fn)
+            self._cache[key] = cached
+
+        in_vals = [t._value for t in placeholders] \
+            + [p._value for p in params]
+        if train and params:
+            outs, grads = cached(*in_vals)
+            for p, g in zip(params, grads):
+                p.grad = Tensor(g) if p.grad is None \
+                    else Tensor(p.grad._value + g)
+            program.optimizer.step()
+            program.optimizer.clear_grad()
+        else:
+            outs = cached(*in_vals)
+            if train:
+                program.optimizer.step()
+                program.optimizer.clear_grad()
+        outs = outs[: len(fetch_list)] if fetch_list else outs
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+# ------------------------------------------------------------ inference io
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None, **kwargs):
+    """reference: static/io.py save_inference_model — exports the
+    placeholders→fetches subgraph via the jit StableHLO path."""
+    from .. import jit
+    from ..nn.layer_base import Layer
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    from ..incubate.autograd import _replay_function
+
+    fn, in_vals = _replay_function(list(fetch_vars), list(feed_vars))
+
+    class _Prog(Layer):
+        def forward(self, *xs):
+            out = fn(*[x._value if isinstance(x, Tensor) else x for x in xs])
+            if isinstance(out, tuple):
+                return tuple(Tensor(o) for o in out)
+            return Tensor(out)
+
+    specs = [InputSpec(tuple(v.shape), str(v._value.dtype))
+             for v in feed_vars]
+    jit.save(_Prog(), path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix: str, executor, **kwargs):
+    """reference: static/io.py load_inference_model — returns
+    (program-like callable, feed_names, fetch_names)."""
+    from .. import jit
+
+    layer = jit.load(path_prefix)
+    return layer, getattr(layer, "_feed_names", None), \
+        getattr(layer, "_fetch_names", None)
+
+
+# ---------------------------------------------------------------- place API
+def cpu_places(device_count: Optional[int] = None):
+    return ["cpu"] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+_scope = {}
+
+
+def global_scope():
+    return _scope

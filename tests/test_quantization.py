@@ -1,0 +1,188 @@
+"""paddle.quantization parity: QuantConfig routing, QAT fake-quant + STE
+gradients, PTQ calibration, convert (reference: python/paddle/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    QuantedLinear,
+)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _x(n=4):
+    return paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((n, 8)).astype("float32"))
+
+
+class TestFakeQuant:
+    def test_values_quantized_to_grid(self):
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.0)
+        q.train()
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype("float32"))
+        out = q(x)
+        scale = float(q.scales().numpy())
+        grid = np.asarray(out.numpy()) / (scale / 127.0)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+    def test_ste_gradient_identity_inside_range(self):
+        q = FakeQuanterWithAbsMaxObserver()
+        q.train()
+        x = paddle.to_tensor(np.array([0.1, -0.5, 0.9], "float32"))
+        x.stop_gradient = False
+        q(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.ones(3), rtol=1e-6)
+
+    def test_quant_error_bounded(self):
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.0)
+        q.train()
+        xv = np.random.default_rng(2).uniform(-2, 2, 64).astype("float32")
+        out = np.asarray(q(paddle.to_tensor(xv)).numpy())
+        scale = float(q.scales().numpy())
+        assert np.abs(out - xv).max() <= scale / 127.0 + 1e-6
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        m = _model()
+        m.train()
+        qat = QAT(QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(),
+            weight=FakeQuanterWithAbsMaxObserver()))
+        qm = qat.quantize(m)
+        kinds = [type(l).__name__ for _, l in qm.named_children()]
+        assert kinds.count("QuantedLinear") == 2
+        # original model untouched (inplace=False)
+        assert all(type(l).__name__ != "QuantedLinear"
+                   for _, l in m.named_children())
+
+    def test_qat_model_trains(self):
+        m = _model()
+        m.train()
+        qat = QAT(QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(),
+            weight=FakeQuanterWithAbsMaxObserver()))
+        qm = qat.quantize(m)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=qm.parameters())
+        x = _x(8)
+        y = paddle.to_tensor(np.random.default_rng(3).integers(0, 4, (8,)))
+        losses = []
+        for _ in range(12):
+            loss = nn.functional.cross_entropy(qm(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_requires_training_mode(self):
+        m = _model()
+        m.eval()
+        with pytest.raises(AssertionError):
+            QAT(QuantConfig(weight=FakeQuanterWithAbsMaxObserver())) \
+                .quantize(m)
+
+    def test_type_and_layer_config_routing(self):
+        m = _model()
+        m.train()
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear,
+                            weight=FakeQuanterWithAbsMaxObserver())
+        qm = QAT(cfg).quantize(m)
+        quanted = [l for _, l in qm.named_children()
+                   if isinstance(l, QuantedLinear)]
+        assert len(quanted) == 2
+        assert all(l.activation_quanter is None for l in quanted)
+        assert all(l.weight_quanter is not None for l in quanted)
+        # per-layer instances are distinct (not shared state)
+        assert quanted[0].weight_quanter is not quanted[1].weight_quanter
+
+
+class TestPTQ:
+    def test_observer_calibration_and_convert(self):
+        m = _model()
+        m.eval()
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=AbsmaxObserver()))
+        qm = ptq.quantize(m)
+        x = _x(16)
+        ref_out = np.asarray(m(x).numpy())
+        for _ in range(3):
+            qm(x)  # calibrate
+        deploy = ptq.convert(qm)
+        out = np.asarray(deploy(x).numpy())
+        # int8 simulation stays close to fp32 on a small net
+        assert np.abs(out - ref_out).max() < 0.2
+        kinds = [type(l).__name__ for _, l in deploy.named_children()]
+        assert "QuantedLinear" not in kinds  # frozen back to plain layers
+
+    def test_rejects_training_model(self):
+        m = _model()
+        m.train()
+        with pytest.raises(AssertionError):
+            PTQ(QuantConfig(weight=AbsmaxObserver())).quantize(m)
+
+
+class TestConv:
+    def test_quanted_conv2d(self):
+        paddle.seed(4)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+        m.train()
+        qat = QAT(QuantConfig(weight=FakeQuanterWithAbsMaxObserver()))
+        qm = qat.quantize(m)
+        x = paddle.to_tensor(np.random.default_rng(5)
+                             .standard_normal((2, 3, 8, 8)).astype("float32"))
+        out = qm(x)
+        assert list(out.shape) == [2, 8, 8, 8]
+        ref = np.asarray(m(x).numpy())
+        assert np.abs(np.asarray(out.numpy()) - ref).max() < 0.1
+
+
+class TestReviewRegressions:
+    def test_custom_mapping_extends_defaults(self):
+        m = _model()
+        m.train()
+        cfg = QuantConfig(weight=FakeQuanterWithAbsMaxObserver())
+
+        class MyLayer(nn.Layer):
+            pass
+
+        class MyQuanted(nn.Layer):
+            def __init__(self, src, wq, aq):
+                super().__init__()
+
+        cfg.add_qat_layer_mapping(MyLayer, MyQuanted)
+        qm = QAT(cfg).quantize(m)
+        kinds = [type(l).__name__ for _, l in qm.named_children()]
+        assert kinds.count("QuantedLinear") == 2  # defaults still active
+
+    def test_name_config_matches_dotted_path(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.block = nn.Sequential(nn.Linear(4, 4))
+                self.head = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.head(self.block(x))
+
+        paddle.seed(5)
+        net = Net()
+        net.train()
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_name_config(["block.0"],
+                            weight=FakeQuanterWithAbsMaxObserver())
+        qm = QAT(cfg).quantize(net)
+        inner = dict(qm.named_children())["block"]
+        assert any(isinstance(l, QuantedLinear)
+                   for _, l in inner.named_children())
+        assert not isinstance(dict(qm.named_children())["head"],
+                              QuantedLinear)

@@ -1,0 +1,189 @@
+"""paddle.static facade: Program/Executor/data/program_guard + train loop
+(reference: fluid/framework.py:5222, fluid/executor.py:893)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+def test_program_guard_scoping():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        assert static.default_main_program() is main
+        assert static.default_startup_program() is startup
+        static.data("x", [None, 4])
+    assert "x" in main.placeholders
+    assert static.default_main_program() is not main
+
+
+def test_executor_forward_feed_fetch():
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        lin = nn.Linear(4, 2)
+        y = lin(x)
+    exe = static.Executor()
+    xv = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    ref = xv @ np.asarray(lin.weight.numpy()) + np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # polymorphic feed shape: recompiles for a new batch size
+    xv8 = np.random.default_rng(1).standard_normal((8, 4)).astype("float32")
+    (out8,) = exe.run(main, feed={"x": xv8}, fetch_list=[y])
+    assert out8.shape == (8, 2)
+
+
+def test_executor_training_via_minimize():
+    paddle.seed(1)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        lab = static.data("y", [None], "int64")
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        logits = net(x)
+        loss = nn.functional.cross_entropy(logits, lab)
+        opt = paddle.optimizer.SGD(learning_rate=0.2,
+                                   parameters=net.parameters())
+        opt.minimize(loss)
+    assert main.loss is loss and main.optimizer is opt
+
+    exe = static.Executor()
+    exe.run(startup)  # no-op parity call
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((8, 4)).astype("float32")
+    xv = rng.standard_normal((64, 8)).astype("float32")
+    yv = (xv @ w).argmax(-1)
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_program_clone_for_test_drops_optimizer():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2])
+        lin = nn.Linear(2, 2)
+        loss = lin(x).sum()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.optimizer is None and test_prog.loss is None
+    assert "x" in test_prog.placeholders
+
+
+def test_eager_minimize_still_works():
+    paddle.seed(3)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = lin(x).sum()
+    loss.backward()
+    opt.minimize(loss)  # applies already-computed grads (dygraph contract)
+    opt.clear_grad()
+    with pytest.raises(RuntimeError):
+        opt.minimize(lin(x).sum())  # no backward first -> loud error
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(4)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 6], "float32")
+        lin = nn.Linear(6, 3)
+        y = lin(x)
+    exe = static.Executor()
+    path = str(tmp_path / "inf")
+    static.save_inference_model(path, [x], [y], exe)
+    layer, _, _ = static.load_inference_model(path, exe)
+    xv = np.random.default_rng(5).standard_normal((4, 6)).astype("float32")
+    got = layer(paddle.to_tensor(xv))
+    if isinstance(got, (list, tuple)):
+        got = got[0]
+    ref = xv @ np.asarray(lin.weight.numpy()) + np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_parameter_free_fetch_uses_feed():
+    """A fetch with no Parameters must still recompute from the feed."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    xv = np.full((2, 2), 3.0, "float32")
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_loss_position_in_fetch_list(self):
+        paddle.seed(6)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 2)
+            logits = lin(x)
+            loss = logits.sum()
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        xv = np.ones((2, 4), "float32")
+        lv, lg = exe.run(main, feed={"x": xv}, fetch_list=[loss, logits])
+        assert lv.shape == () and lg.shape == (2, 2)
+
+    def test_minimize_without_parameters_collects_them(self):
+        paddle.seed(7)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 2)
+            loss = (lin(x) ** 2).sum()
+            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+        assert len(opt._parameter_list) == 2  # weight + bias discovered
+        exe = static.Executor()
+        xv = np.random.default_rng(8).standard_normal(
+            (8, 4)).astype("float32")
+        l0 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        for _ in range(5):
+            l1 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        assert l1 < l0
+
+    def test_missing_feed_raises(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data("a", [2], "float32")
+            b = static.data("b", [2], "float32")
+            c = a + b
+        with pytest.raises(KeyError):
+            static.Executor().run(main, feed={"a": np.ones(2, "float32")},
+                                  fetch_list=[c])
+
+    def test_fetch_by_placeholder_name(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+        (out,) = static.Executor().run(
+            main, feed={"x": np.array([1.0, 2.0], "float32")},
+            fetch_list=["x"])
+        np.testing.assert_allclose(out, [1.0, 2.0])
+
+    def test_executor_caches_compiled_steps(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            y = x * 3.0
+        exe = static.Executor()
+        feed = {"x": np.ones(2, "float32")}
+        exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(main, feed=feed, fetch_list=[y])
+        assert len(exe._cache) == 1
