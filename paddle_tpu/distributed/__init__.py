@@ -18,6 +18,7 @@ from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel, init_parallel_env, scale_loss, shard_map_fn,
 )
+from .ring_attention import ring_attention  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
 from .sharding_api import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,

@@ -1,0 +1,106 @@
+"""Ring attention: exact attention over sequence-sharded q/k/v.
+
+Beyond-reference capability (SURVEY.md §2.3: the reference snapshot has NO
+sequence/context parallelism — long-sequence support stops at fused/flash
+attention kernels; SURVEY §7 step 6 requires it for the TPU build's
+long-context north star).
+
+Design (Ring Attention, Liu et al. 2023, re-derived for ICI): q/k/v
+[B, S, H, D] with S sharded over the mesh's ``sep`` axis. Each device
+keeps its q block resident and streams every k/v block through the ring
+with ``ppermute`` (one neighbor hop per step — bandwidth-optimal on a
+torus), folding each block into a running flash-style log-sum-exp
+softmax. Peak memory per device is O(S/P) and the P-step loop overlaps
+each block's compute with the next block's transfer under XLA's async
+collective-permute. Backward differentiates through the scan+ppermute
+(ppermute transposes to the reverse rotation), so grads are exact.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+from . import topology
+
+__all__ = ["ring_attention"]
+
+
+def _ring_attn_local(q, k, v, axis: str, causal: bool, scale: float):
+    """Per-device body (inside shard_map, manual over ``axis``):
+    q/k/v [B, C, H, D] local chunks of the S dim."""
+    r = jax.lax.axis_index(axis)
+    Pn = jax.lax.axis_size(axis)
+    B, C, H, D = q.shape
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, C, D]
+    perm = [(j, (j + 1) % Pn) for j in range(Pn)]
+
+    q_pos = r * C + jnp.arange(C)  # global positions of local queries
+
+    def step(carry, i):
+        k_blk, v_blk, acc, m, l = carry
+        src = (r - i) % Pn  # ring: after i hops we hold rank (r-i)'s block
+        kh = jnp.swapaxes(k_blk, 1, 2)  # [B, H, C, D]
+        vh = jnp.swapaxes(v_blk, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            k_pos = src * C + jnp.arange(C)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [C, C]
+            scores = jnp.where(mask[None, None], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        blk_max = jnp.max(scores, axis=-1)  # [B, H, C]
+        m_new = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vh)
+        l = l * correction + jnp.sum(p, axis=-1)
+        m = m_new
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, acc, m, l), None
+
+    vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+    acc0 = vary(jnp.zeros((B, H, C, D), jnp.float32))
+    m0 = vary(jnp.full((B, H, C), -jnp.inf, jnp.float32))
+    l0 = vary(jnp.zeros((B, H, C), jnp.float32))
+    (k_f, v_f, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(Pn))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, C, H, D]
+
+
+def ring_attention(query, key, value, causal: bool = False,
+                   scale: Optional[float] = None, axis: str = "sep",
+                   mesh=None):
+    """Exact attention with q/k/v [B, S, H, D] sequence-sharded over the
+    mesh's ``axis``; returns the output with the same sharding. Falls back
+    to one-device flash/dense attention when the axis is absent or size 1."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mesh = mesh or topology.get_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        from ..nn import functional as F
+
+        return F.scaled_dot_product_attention(q, k, v, is_causal=causal)
+    if q.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by "
+            f"{axis} degree {mesh.shape[axis]}")
+
+    def fn(qv, kv, vv):
+        spec = P(None, axis, None, None)
+        mapped = jax.shard_map(
+            lambda a, b, c: _ring_attn_local(a, b, c, axis, causal, scale),
+            mesh=mesh, axis_names={axis},
+            in_specs=(spec, spec, spec), out_specs=spec)
+        return mapped(qv, kv, vv)
+
+    return apply_op(fn, [q, k, v], name="ring_attention")

@@ -1,0 +1,26 @@
+"""paddle.incubate.nn — fused layers.
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :192, FusedFeedForward :497,
+FusedTransformerEncoderLayer :725, FusedMultiTransformer :1021,
+FusedBiasDropoutResidualLayerNorm :82), fused_linear.py, fused_dropout_add.py.
+
+TPU-native: the reference's CUDA megakernels (fused_attention_op.cu,
+fused_feedforward_op.cc) exist to dodge kernel-launch overhead and HBM
+round-trips; under XLA one traced forward IS one fused program, so these
+layers express the same math (single packed qkv weight, pre/post-LN,
+residual+dropout epilogues) through the flash-attention Pallas kernel +
+plain ops and let the compiler fuse — same parameter surface, state_dict
+keys, and numerics as the reference modules.
+"""
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer", "FusedLinear",
+    "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+]

@@ -1,0 +1,282 @@
+"""Fused layer implementations (see package docstring for the design)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...ops._apply import ensure_tensor
+from ...tensor import Parameter, Tensor
+
+__all__ = [
+    "FusedLinear", "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+]
+
+
+def _uniform_param(shape, fan_in):
+    from ... import ops as O
+
+    bound = 1.0 / math.sqrt(max(fan_in, 1))
+    return Parameter(O.uniform(list(shape), min=-bound, max=bound)._value)
+
+
+class FusedLinear(Layer):
+    """reference: incubate/nn/layer/fused_linear.py:19 — gemm+bias epilogue;
+    one dot under XLA."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = _uniform_param(shape, in_features)
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((out_features,), "float32"))
+
+    def forward(self, x):
+        w = self.weight
+        if self.transpose_weight:
+            w = ops.t(w)
+        return F.linear(x, w, self.bias)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: fused_dropout_add.py:19 — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + ensure_tensor(y)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: fused_transformer.py:82 — ln(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), "float32"))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), "float32"))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), "float32"))
+
+    def forward(self, x, residual):
+        h = ensure_tensor(x) + self.linear_bias
+        h = F.dropout(h, p=self.dropout_rate, training=self.training)
+        h = ensure_tensor(residual) + h
+        return F.layer_norm(h, [self.embed_dim], weight=self.ln_scale,
+                            bias=self.ln_bias, epsilon=self._epsilon)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference: fused_transformer.py:192 — packed-qkv attention block with
+    pre/post LN, residual, and dropout epilogues (fused_attention_op.cu);
+    here the core runs the Pallas flash kernel via
+    F.scaled_dot_product_attention and XLA fuses the epilogues."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.need_weights = need_weights
+        self._epsilon = epsilon
+        self.transpose_qkv_wb = transpose_qkv_wb
+        if transpose_qkv_wb:
+            qkv_w_shape = [embed_dim, 3 * embed_dim]
+            qkv_b_shape = [3 * embed_dim]
+        else:
+            # reference layout: [3, num_heads, head_dim, embed_dim]
+            qkv_w_shape = [3, num_heads, self.head_dim, embed_dim]
+            qkv_b_shape = [3, num_heads, self.head_dim]
+        self.qkv_weight = _uniform_param(qkv_w_shape, embed_dim)
+        self.qkv_bias = Parameter(jnp.zeros(qkv_b_shape, "float32"))
+        self.linear_weight = _uniform_param([embed_dim, embed_dim], embed_dim)
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), "float32"))
+        self.pre_ln_scale = Parameter(jnp.ones((embed_dim,), "float32"))
+        self.pre_ln_bias = Parameter(jnp.zeros((embed_dim,), "float32"))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), "float32"))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), "float32"))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        x = ensure_tensor(query)
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], weight=self.pre_ln_scale,
+                             bias=self.pre_ln_bias, epsilon=self._epsilon)
+        B, S = x.shape[0], x.shape[1]
+        H, D = self.num_heads, self.head_dim
+        if self.transpose_qkv_wb:
+            qkv = ops.matmul(x, self.qkv_weight) + self.qkv_bias
+            qkv = ops.reshape(qkv, [B, S, 3, H, D])
+        else:
+            # x [B,S,E] @ w [3,H,D,E] -> [B,S,3,H,D]
+            w = ops.reshape(self.qkv_weight, [3 * H * D, self.embed_dim])
+            qkv = ops.matmul(x, ops.t(w))
+            qkv = ops.reshape(qkv, [B, S, 3, H, D]) \
+                + ops.reshape(self.qkv_bias, [1, 1, 3, H, D])
+        q = qkv[:, :, 0]  # [B, S, H, D]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        ctx = ops.reshape(ctx, [B, S, self.embed_dim])
+        out = ops.matmul(ctx, self.linear_weight) + self.linear_bias
+        out = F.dropout(out, p=self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], weight=self.ln_scale,
+                               bias=self.ln_bias, epsilon=self._epsilon)
+        return out
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"dropout_rate={self.dropout_rate}, "
+                f"normalize_before={self.normalize_before}")
+
+
+class FusedFeedForward(Layer):
+    """reference: fused_transformer.py:497 — ln/linear/act/dropout/linear/
+    dropout/residual in one region (fused_feedforward_op.cc)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._d_model = d_model
+        self._dim_feedforward = dim_feedforward
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._act_method = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = _uniform_param([d_model, dim_feedforward],
+                                             d_model)
+        self.linear1_bias = Parameter(jnp.zeros((dim_feedforward,),
+                                                "float32"))
+        self.linear2_weight = _uniform_param([dim_feedforward, d_model],
+                                             dim_feedforward)
+        self.linear2_bias = Parameter(jnp.zeros((d_model,), "float32"))
+        self.ln1_scale = Parameter(jnp.ones((d_model,), "float32"))
+        self.ln1_bias = Parameter(jnp.zeros((d_model,), "float32"))
+        self.ln2_scale = Parameter(jnp.ones((d_model,), "float32"))
+        self.ln2_bias = Parameter(jnp.zeros((d_model,), "float32"))
+
+    def forward(self, src, cache=None):
+        x = ensure_tensor(src)
+        residual = x
+        if self._normalize_before:
+            x = F.layer_norm(x, [self._d_model], weight=self.ln1_scale,
+                             bias=self.ln1_bias, epsilon=self._epsilon)
+        h = F.linear(x, self.linear1_weight, self.linear1_bias)
+        h = getattr(F, self._act_method)(h)
+        h = F.dropout(h, p=self._act_dropout_rate, training=self.training)
+        h = F.linear(h, self.linear2_weight, self.linear2_bias)
+        h = F.dropout(h, p=self._dropout_rate, training=self.training)
+        out = residual + h
+        if not self._normalize_before:
+            out = F.layer_norm(out, [self._d_model], weight=self.ln2_scale,
+                               bias=self.ln2_bias, epsilon=self._epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: fused_transformer.py:725 — FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """reference: fused_transformer.py:1021 — N pre-LN decoder blocks with
+    packed per-layer weights and KV caches, the inference fast path
+    (fused_multi_transformer_op.cu). Here each block is flash attention +
+    fused epilogues; ``caches`` carry [B, H, S, D] K/V for incremental
+    decoding."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None, **kwargs):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer only supports normalize_before=True "
+                "(reference contract)")
+        if num_layers < 0:
+            num_layers = 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        blocks = []
+        for i in range(num_layers):
+            blocks.append(FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True))
+        self.blocks = blocks
+        for i, b in enumerate(blocks):
+            self.add_sublayer(str(i), b)
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        x = ensure_tensor(src)
+        for b in self.blocks:
+            x = b(x, src_mask=attn_mask)
+        if caches is not None:
+            return x, caches
+        return x

@@ -264,11 +264,19 @@ class Optimizer:
             prog = _guard_stack[-1][0]
             prog.loss = loss
             prog.optimizer = self
-            if self._parameter_list is None:
+            if parameters is not None:
+                plist = list(parameters)
+            elif self._parameter_list is not None:
+                plist = list(self._parameter_list)
+            else:
                 # static contract: minimize() without parameters= trains
                 # every trainable var reachable from the loss
-                self._parameter_list = _collect_parameters(loss)
-                self._materialize_accumulators()
+                plist = _collect_parameters(loss)
+            if no_grad_set:
+                frozen = {id(p) for p in no_grad_set}
+                plist = [p for p in plist if id(p) not in frozen]
+            self._parameter_list = plist
+            self._materialize_accumulators()
             return None, []
         if (self._parameter_list is not None
                 and not any(p.grad is not None for p in self._parameter_list)):

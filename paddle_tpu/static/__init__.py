@@ -43,6 +43,7 @@ class Program:
 
     def __init__(self):
         self.placeholders: Dict[str, Tensor] = {}
+        self.declared_shapes: Dict[str, tuple] = {}  # None dims preserved
         self.loss: Optional[Tensor] = None
         self.optimizer = None
         self.random_seed = 0
@@ -50,6 +51,7 @@ class Program:
     def clone(self, for_test: bool = False) -> "Program":
         p = Program()
         p.placeholders = dict(self.placeholders)
+        p.declared_shapes = dict(self.declared_shapes)
         if not for_test:
             p.loss, p.optimizer = self.loss, self.optimizer
         return p
@@ -65,6 +67,7 @@ class Program:
 _default_main = Program()
 _default_startup = Program()
 _guard_stack: List[tuple] = []
+_declared_by_uid: Dict[int, tuple] = {}  # placeholder uid -> declared shape
 
 
 def default_main_program() -> Program:
@@ -105,8 +108,27 @@ def data(name: str, shape: Sequence[int], dtype="float32",
     # back stale build-time values for parameter-free fetches
     t = Tensor(jnp.zeros(concrete, dt), stop_gradient=False)
     t.name = name
-    default_main_program().placeholders[name] = t
+    prog = default_main_program()
+    prog.placeholders[name] = t
+    # declared shape (None dims preserved) — save_inference_model exports
+    # polymorphic dims from this, not the concretized build shape. Keyed by
+    # uid in a module registry too: at save time the declaring program may
+    # no longer be the guarded default.
+    declared = tuple(
+        None if (d is None or int(d) < 0) else int(d) for d in shape)
+    prog.declared_shapes[name] = declared
+    _declared_by_uid[t._uid] = declared
     return t
+
+
+def _collect_parameters_multi(fetches) -> List[Parameter]:
+    seen, out = set(), []
+    for f in fetches:
+        for p in _collect_parameters(f):
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
+    return out
 
 
 def _collect_parameters(loss: Tensor) -> List[Parameter]:
@@ -200,16 +222,21 @@ class Executor:
             raise KeyError(
                 f"feed is missing required placeholder(s): {missing}")
 
-        ph_names = [n for n in feed if n in program.placeholders]
+        # sort names so feed-dict insertion order cannot desync the cached
+        # function's positional binding
+        ph_names = sorted(n for n in feed if n in program.placeholders)
         placeholders = [program.placeholders[n] for n in ph_names]
+        # parameters are jit ARGUMENTS in eval mode too: baking them in as
+        # constants would freeze eval results at first-run weights
         params = list(program.optimizer._parameter_list or []) if train \
-            else []
+            else _collect_parameters_multi(fetches)
 
         # bind feeds (shape-polymorphic: replace placeholder values)
         for n, t in zip(ph_names, placeholders):
             t._value = ensure_tensor(np.asarray(feed[n]))._value
 
         key = (id(program), tuple(t._uid for t in fetches), train,
+               tuple(ph_names),
                tuple((tuple(t._value.shape), str(t._value.dtype))
                      for t in placeholders))
         cached = self._cache.get(key)
@@ -218,17 +245,18 @@ class Executor:
             n_ph = len(placeholders)
 
             if train and params:
-                def loss_of(*vals):
+                def loss_and_outs(*vals):
                     outs = fn(*vals)
                     outs = outs if isinstance(outs, tuple) else (outs,)
-                    return jnp.reshape(outs[loss_idx], ())
+                    return jnp.reshape(outs[loss_idx], ()), outs
 
                 def step_fn(*vals):
-                    outs = fn(*vals)
-                    outs = outs if isinstance(outs, tuple) else (outs,)
-                    grads = jax.grad(
-                        lambda *pv: loss_of(*(list(vals[:n_ph]) + list(pv)))
-                    )(*vals[n_ph:])
+                    # one forward trace: grads + every fetch via has_aux
+                    grads, outs = jax.grad(
+                        lambda *pv: loss_and_outs(
+                            *(list(vals[:n_ph]) + list(pv))),
+                        argnums=tuple(range(len(vals) - n_ph)),
+                        has_aux=True)(*vals[n_ph:])
                     if not isinstance(grads, (tuple, list)):
                         grads = (grads,)
                     return outs, tuple(grads)
@@ -288,8 +316,8 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
                 return tuple(Tensor(o) for o in out)
             return Tensor(out)
 
-    specs = [InputSpec(tuple(v.shape), str(v._value.dtype))
-             for v in feed_vars]
+    specs = [InputSpec(_declared_by_uid.get(v._uid, tuple(v.shape)),
+                       str(v._value.dtype)) for v in feed_vars]
     jit.save(_Prog(), path_prefix, input_spec=specs)
 
 

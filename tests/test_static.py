@@ -147,7 +147,7 @@ class TestReviewRegressions:
             x = static.data("x", [None, 4], "float32")
             lin = nn.Linear(4, 2)
             loss = (lin(x) ** 2).sum()
-            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt = paddle.optimizer.SGD(learning_rate=0.01)
             opt.minimize(loss)
         assert len(opt._parameter_list) == 2  # weight + bias discovered
         exe = static.Executor()
@@ -187,3 +187,63 @@ class TestReviewRegressions:
         exe.run(main, feed=feed, fetch_list=[y])
         exe.run(main, feed=feed, fetch_list=[y])
         assert len(exe._cache) == 1
+
+    def test_feed_dict_order_irrelevant(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data("a", [2], "float32")
+            b = static.data("b", [2], "float32")
+            c = a - b
+        exe = static.Executor()
+        av, bv = np.full(2, 5.0, "float32"), np.full(2, 1.0, "float32")
+        (r1,) = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[c])
+        (r2,) = exe.run(main, feed={"b": bv, "a": av}, fetch_list=[c])
+        np.testing.assert_allclose(r1, [4.0, 4.0])
+        np.testing.assert_allclose(r2, [4.0, 4.0])
+
+    def test_eval_sees_updated_weights(self):
+        paddle.seed(9)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            lin = nn.Linear(4, 2)
+            y = lin(x)
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), "float32")}
+        (r1,) = exe.run(main, feed=feed, fetch_list=[y])
+        with paddle.no_grad():
+            lin.weight._set_value(lin.weight.value + 1.0)
+        (r2,) = exe.run(main, feed=feed, fetch_list=[y])
+        np.testing.assert_allclose(r2 - r1, 4.0, rtol=1e-5)
+
+    def test_save_inference_model_polymorphic_batch(self, tmp_path):
+        paddle.seed(10)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 6], "float32")
+            lin = nn.Linear(6, 3)
+            y = lin(x)
+        path = str(tmp_path / "poly")
+        static.save_inference_model(path, [x], [y], static.Executor())
+        layer, _, _ = static.load_inference_model(path, static.Executor())
+        xv = np.random.default_rng(11).standard_normal(
+            (4, 6)).astype("float32")
+        got = layer(paddle.to_tensor(xv))
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        assert tuple(got.shape) == (4, 3)
+
+    def test_minimize_parameters_subset_honored(self):
+        paddle.seed(12)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 2)
+            loss = (lin(x) ** 2).sum()
+            opt = paddle.optimizer.SGD(learning_rate=0.5)
+            opt.minimize(loss, parameters=[lin.weight])
+        assert opt._parameter_list == [lin.weight]
+        exe = static.Executor()
+        b0 = np.asarray(lin.bias.numpy()).copy()
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(lin.bias.numpy()), b0)
