@@ -11,7 +11,7 @@ import jax as _jax
 
 # Paddle float32 semantics: real fp32 matmuls (the TPU perf path is bf16 via
 # paddle_tpu.amp, whose operands are bf16 and unaffected by this setting).
-# Overridable via paddle_tpu.set_flags({'matmul_precision': ...}).
+# Overridable via paddle_tpu.set_flags({'FLAGS_matmul_precision': ...}).
 _jax.config.update("jax_default_matmul_precision", "highest")
 
 # Paddle dtype parity: int64 is the default index dtype and float64 exists.
@@ -48,14 +48,26 @@ from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
 from . import static  # noqa: E402
+from . import audio  # noqa: E402
+from . import geometric  # noqa: E402
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
+from . import text  # noqa: E402
 from . import profiler  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402
+from .framework.flags import get_flags, set_flags  # noqa: E402
 
 __version__ = "0.1.0"
 
-disable_static = lambda: None  # eager is the default and only imperative mode
-enable_static = None  # static graph API is served by paddle_tpu.jit
+def disable_static():
+    """Eager is the default imperative mode; kept for script parity."""
+
+
+def enable_static():
+    """reference: paddle.enable_static. No global mode switch is needed:
+    paddle_tpu.static.Program/Executor build over the eager tape directly
+    (the ops record the same graph either way) — call them as-is."""
 
 
 def is_compiled_with_cuda() -> bool:

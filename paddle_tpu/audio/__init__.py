@@ -1,0 +1,11 @@
+"""paddle.audio parity: feature extractors + functional frequency tools.
+
+Reference parity: python/paddle/audio/ — ``functional`` (hz_to_mel,
+mel_to_hz, mel_frequencies, fft_frequencies, compute_fbank_matrix,
+power_to_db, create_dct) and ``features`` (Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC layers) built on the stft from paddle.signal.
+The dataset/backend IO tier is out of scope in a zero-egress image.
+"""
+from . import features, functional  # noqa: F401
+
+__all__ = ["features", "functional"]

@@ -122,6 +122,24 @@ amp_state = {
     "white": frozenset(), "black": frozenset(),
 }
 
+# FLAGS_check_nan_inf / FLAGS_benchmark (framework/flags.py) — module-level
+# bools so the hot path pays one dict-free read (reference: the per-op sweep
+# in eager/nan_inf_utils.cc gated by the same flag)
+check_nan_inf_enabled = False
+benchmark_sync_enabled = False
+
+
+def _nan_inf_sweep(outs, name: str):
+    seq = outs if isinstance(outs, tuple) else (outs,)
+    for i, o in enumerate(seq):
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
+            if isinstance(o, jax.core.Tracer):
+                continue  # traced values are checked when materialized
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output {i} of op {name!r} "
+                    f"(FLAGS_check_nan_inf sweep)")
+
 
 def _amp_cast(arrays, name):
     st = amp_state
@@ -158,12 +176,20 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
     )
     if not needs_grad:
         outs = fn(*arrays, **attrs)
+        if check_nan_inf_enabled:
+            _nan_inf_sweep(outs, name)
+        if benchmark_sync_enabled:
+            jax.block_until_ready(outs)
         if isinstance(outs, tuple):
             return tuple(Tensor(o, stop_gradient=True) for o in outs)
         return Tensor(outs, stop_gradient=True)
 
     f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
     outs, vjp_fn = jax.vjp(f, *arrays)
+    if check_nan_inf_enabled:
+        _nan_inf_sweep(outs, name)
+    if benchmark_sync_enabled:
+        jax.block_until_ready(outs)
     is_tuple = isinstance(outs, tuple)
     outs_seq = outs if is_tuple else (outs,)
     out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs_seq)

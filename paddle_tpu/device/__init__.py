@@ -24,17 +24,25 @@ def device_count(device_type=None) -> int:
         return 0
 
 
+def _parse_device(device: str):
+    """'tpu', 'tpu:0', 'gpu:1' (gpu aliases to the accelerator), 'cpu' →
+    the jax.Device. Single resolver shared by set_device and the memory
+    telemetry APIs."""
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platform = {"gpu": None, "tpu": None, "cpu": "cpu"}.get(name, name)
+    devs = jax.devices() if platform is None else jax.devices(platform)
+    return devs[idx]
+
+
 def set_device(device: str):
     """reference: paddle.set_device. Accepts 'tpu', 'cpu', 'tpu:0', ...
     Sets jax's default device for subsequent array creation."""
     global _current_device
-    name = device.split(":")[0]
-    idx = int(device.split(":")[1]) if ":" in device else 0
-    platform = {"gpu": "tpu", "tpu": None, "cpu": "cpu"}.get(name, name)
-    devs = jax.devices() if platform is None else jax.devices(platform)
-    jax.config.update("jax_default_device", devs[idx])
+    dev = _parse_device(device)
+    jax.config.update("jax_default_device", dev)
     _current_device = device
-    return devs[idx]
+    return dev
 
 
 def get_device() -> str:
@@ -52,3 +60,48 @@ def synchronize(device=None):
 
 def is_compiled_with_cuda() -> bool:
     return False
+
+
+# ---------------------------------------------------------- memory telemetry
+def memory_stats(device=None) -> dict:
+    """Device memory telemetry (reference: paddle/fluid/memory/stats.cc +
+    device.cuda.memory_* APIs) — PJRT's per-device stats dict; keys include
+    bytes_in_use, peak_bytes_in_use, bytes_limit where the backend reports
+    them. CPU backends may report nothing ({})."""
+    dev = _resolve(device)
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def _resolve(device):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        return _parse_device(device)
+    return device
+
+
+def memory_allocated(device=None) -> int:
+    """reference: device.cuda.memory_allocated — current live bytes."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """reference: device.cuda.max_memory_allocated — peak live bytes."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """reference: device.cuda.memory_reserved — backend pool bytes."""
+    s = memory_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_reserved",
+                                         s.get("bytes_in_use", 0))))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))

@@ -89,6 +89,11 @@ def ring_attention(query, key, value, causal: bool = False,
             or mesh.shape[axis] <= 1:
         from ..nn import functional as F
 
+        # sdpa scales by 1/sqrt(D) internally; fold a custom scale into q so
+        # the fallback matches the ring path exactly
+        default = 1.0 / math.sqrt(q.shape[-1])
+        if abs(scale - default) > 1e-12:
+            q = q * (scale / default)
         return F.scaled_dot_product_attention(q, k, v, is_causal=causal)
     if q.shape[1] % mesh.shape[axis]:
         raise ValueError(

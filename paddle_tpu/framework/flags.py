@@ -1,0 +1,108 @@
+"""Global flags registry: paddle.set_flags / paddle.get_flags.
+
+Reference parity: the gflags-backed registry — 89 ``PHI_DEFINE_EXPORTED_*``
+definitions in paddle/phi/core/flags.cc surfaced through
+``paddle.set_flags/get_flags`` (fluid/framework.py:7486,7511), plus env-var
+pass-through at init (parallel.py:996).
+
+TPU-native: most reference flags steer CUDA allocators/cudnn autotune and
+are inert here (accepted and stored so configs port over); the flags that
+change behavior on this stack are wired where they act:
+
+- ``FLAGS_check_nan_inf`` — per-op NaN/Inf sweep at tape dispatch
+  (reference: eager/nan_inf_utils.cc enabled by the same flag).
+- ``FLAGS_benchmark`` — per-op host sync for timing honesty.
+- ``FLAGS_cudnn_deterministic`` accepted for API compat (XLA is
+  deterministic by default).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Union
+
+__all__ = ["set_flags", "get_flags"]
+
+# flag -> (default, doc). Inert reference flags are accepted via the
+# catch-all below; these are the ones with wired behavior or common use.
+_DEFS = {
+    "FLAGS_check_nan_inf": (False, "per-op NaN/Inf sweep at dispatch"),
+    "FLAGS_benchmark": (False, "block per op for honest timing"),
+    "FLAGS_cudnn_deterministic": (True, "inert: XLA is deterministic"),
+    "FLAGS_eager_delete_tensor_gb": (0.0, "inert: jax GC owns buffers"),
+    "FLAGS_allocator_strategy": ("auto_growth", "inert: PJRT allocates"),
+    "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "inert on TPU"),
+    "FLAGS_use_pallas_flash_attention": (True,
+                                         "route attention to the Pallas "
+                                         "flash kernel when shapes allow"),
+    "FLAGS_matmul_precision": ("highest", "jax default matmul precision"),
+}
+
+_values: Dict[str, object] = {}
+
+
+def _env_default(name: str, default):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, int):
+        return int(raw)
+    return raw
+
+
+def _init():
+    for name, (default, _) in _DEFS.items():
+        _values[name] = _env_default(name, default)
+        if _values[name] != default:
+            # env-var pass-through must WIRE the flag, not just store it
+            _apply_side_effects(name, _values[name])
+
+
+def _apply_side_effects(name: str, value):
+    if name == "FLAGS_check_nan_inf":
+        from ..autograd import engine
+
+        engine.check_nan_inf_enabled = bool(value)
+    elif name == "FLAGS_benchmark":
+        from ..autograd import engine
+
+        engine.benchmark_sync_enabled = bool(value)
+    elif name == "FLAGS_matmul_precision":
+        import jax
+
+        jax.config.update("jax_default_matmul_precision", str(value))
+    elif name == "FLAGS_use_pallas_flash_attention":
+        from ..nn.functional import attention
+
+        attention.pallas_flash_enabled = bool(value)
+
+
+_init()
+
+
+def set_flags(flags: Dict[str, object]):
+    """reference: fluid/framework.py:7486 set_flags."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict of FLAGS_* entries")
+    for name, value in flags.items():
+        if not name.startswith("FLAGS_"):
+            raise ValueError(f"flag name must start with FLAGS_: {name!r}")
+        _values[name] = value
+        _apply_side_effects(name, value)
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, object]:
+    """reference: fluid/framework.py:7511 get_flags."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for name in names:
+        if name in _values:
+            out[name] = _values[name]
+        elif name in _DEFS:
+            out[name] = _DEFS[name][0]
+        else:
+            raise ValueError(f"unknown flag {name!r}")
+    return out

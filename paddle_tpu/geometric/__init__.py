@@ -1,0 +1,183 @@
+"""paddle.geometric parity: graph message passing + segment math.
+
+Reference parity: python/paddle/geometric/ — ``send_u_recv``/``send_ue_recv``
+/``send_uv`` (message_passing/send_recv.py:35,178), ``segment_sum/mean/
+min/max`` (math.py:23), ``reindex_graph`` (reindex.py), ``sample_neighbors``
+(sampling/neighbors.py).
+
+TPU-native: gathers + ``jax.ops.segment_*`` — XLA scatter-reduce lowering,
+differentiable through the tape. ``sample_neighbors`` draws from the global
+threefry Generator. ``out_size`` semantics (pad/truncate the destination
+dim) match the reference kernels (phi/kernels/gpu/graph_send_recv_*).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generator import default_generator
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "sample_neighbors",
+]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum / count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _segment(fn_name, data, segment_ids, num_segments):
+    def fn(d, seg):
+        n = num_segments
+        if fn_name == "mean":
+            s = jax.ops.segment_sum(d, seg, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(seg, d.dtype), seg,
+                                      num_segments=n)
+            shaped = cnt.reshape((n,) + (1,) * (d.ndim - 1))
+            return s / jnp.maximum(shaped, 1)
+        out = _REDUCERS[fn_name](d, seg, num_segments=n)
+        if fn_name in ("min", "max"):
+            # empty segments: the reference yields 0, jax yields +/-inf
+            cnt = jax.ops.segment_sum(jnp.ones_like(seg, jnp.int32), seg,
+                                      num_segments=n)
+            mask = (cnt > 0).reshape((n,) + (1,) * (d.ndim - 1))
+            out = jnp.where(mask, out, jnp.zeros_like(out))
+        return out
+
+    return fn
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference: geometric/math.py:23."""
+    return _segment_entry("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_entry("mean", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_entry("min", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_entry("max", data, segment_ids)
+
+
+def _segment_entry(kind, data, segment_ids):
+    d = ensure_tensor(data)
+    seg = ensure_tensor(segment_ids)
+    n = int(np.asarray(seg.numpy()).max()) + 1 if seg.size else 0
+    return apply_op(lambda dv: _segment(kind, None, None, n)(
+        dv, seg._value.astype("int32")), [d], name=f"segment_{kind}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum",
+                out_size: Optional[int] = None, name=None):
+    """reference: send_recv.py:35 — gather x[src], reduce into dst slots."""
+    xt = ensure_tensor(x)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+    n = out_size if out_size is not None else int(xt.shape[0])
+
+    def fn(xv):
+        msgs = jnp.take(xv, src._value.astype("int32"), axis=0)
+        return _segment(reduce_op, None, None, n)(
+            msgs, dst._value.astype("int32"))
+
+    return apply_op(fn, [xt], name=f"send_u_recv_{reduce_op}")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size: Optional[int] = None, name=None):
+    """reference: send_recv.py:178 — combine node features x[src] with edge
+    features y (add/sub/mul/div), reduce into dst."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+    n = out_size if out_size is not None else int(xt.shape[0])
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+
+    def fn(xv, yv):
+        msgs = combine(jnp.take(xv, src._value.astype("int32"), axis=0), yv)
+        return _segment(reduce_op, None, None, n)(
+            msgs, dst._value.astype("int32"))
+
+    return apply_op(fn, [xt, yt], name=f"send_ue_recv_{message_op}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """reference: send_recv.py send_uv — per-edge message
+    combine(x[src], y[dst]) with NO reduction."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+
+    def fn(xv, yv):
+        return combine(jnp.take(xv, src._value.astype("int32"), axis=0),
+                       jnp.take(yv, dst._value.astype("int32"), axis=0))
+
+    return apply_op(fn, [xt, yt], name=f"send_uv_{message_op}")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """reference: reindex.py reindex_graph — compact global node ids to
+    local ids: x (unique center nodes) then first-seen neighbor order."""
+    xv = np.asarray(ensure_tensor(x).numpy()).astype("int64")
+    nb = np.asarray(ensure_tensor(neighbors).numpy()).astype("int64")
+    cnt = np.asarray(ensure_tensor(count).numpy()).astype("int32")
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    out_nodes = list(xv)
+    reindexed = np.empty_like(nb)
+    for i, v in enumerate(nb):
+        key = int(v)
+        if key not in mapping:
+            mapping[key] = len(out_nodes)
+            out_nodes.append(key)
+        reindexed[i] = mapping[key]
+    # reindexed dst: centers repeated per their neighbor count
+    dst = np.repeat(np.arange(len(xv), dtype="int64"), cnt)
+    return (Tensor(jnp.asarray(reindexed), stop_gradient=True),
+            Tensor(jnp.asarray(dst), stop_gradient=True),
+            Tensor(jnp.asarray(np.asarray(out_nodes, "int64")),
+                   stop_gradient=True))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """reference: sampling/neighbors.py sample_neighbors — CSC graph
+    (row, colptr), sample up to ``sample_size`` neighbors per input node."""
+    rowv = np.asarray(ensure_tensor(row).numpy()).astype("int64")
+    ptr = np.asarray(ensure_tensor(colptr).numpy()).astype("int64")
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).astype("int64")
+    key = default_generator.next_key()
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    out_neighbors, out_count = [], []
+    for nd in nodes:
+        beg, end = int(ptr[nd]), int(ptr[nd + 1])
+        neigh = rowv[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neighbors.append(neigh)
+        out_count.append(len(neigh))
+    flat = (np.concatenate(out_neighbors) if out_neighbors
+            else np.empty((0,), "int64"))
+    return (Tensor(jnp.asarray(flat.astype("int64")), stop_gradient=True),
+            Tensor(jnp.asarray(np.asarray(out_count, "int32")),
+                   stop_gradient=True))

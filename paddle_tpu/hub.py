@@ -1,0 +1,67 @@
+"""paddle.hub parity: list/help/load over hubconf.py repos.
+
+Reference parity: python/paddle/hub.py — entrypoint discovery via a repo's
+``hubconf.py``. The ``local`` source is fully supported; ``github``/
+``gitee`` sources require network access and raise in this zero-egress
+image (the reference would download+cache the repo archive).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source {source!r} needs network access (the reference "
+            "downloads the repo archive); this image is zero-egress — use "
+            "source='local' with a checked-out repo directory")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """reference: hub.list — entrypoint names exposed by hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [name for name in dir(mod)
+            if callable(getattr(mod, name)) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> Optional[str]:
+    """reference: hub.help — the entrypoint's docstring."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """reference: hub.load — call the entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")
+    return fn(**kwargs)

@@ -148,6 +148,14 @@ class FusedMultiHeadAttention(Layer):
         q = qkv[:, :, 0]  # [B, S, H, D]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        new_cache = None
+        if cache is not None:
+            # incremental decoding: cache = (k_past, v_past) [B, S_past, H, D]
+            k_past, v_past = cache
+            if k_past is not None and k_past.shape[1] > 0:
+                k = ops.concat([ensure_tensor(k_past), k], axis=1)
+                v = ops.concat([ensure_tensor(v_past), v], axis=1)
+            new_cache = (k, v)
         ctx = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.attn_dropout_rate if self.training else 0.0)
@@ -158,6 +166,8 @@ class FusedMultiHeadAttention(Layer):
         if not self.normalize_before:
             out = F.layer_norm(out, [self.embed_dim], weight=self.ln_scale,
                                bias=self.ln_bias, epsilon=self._epsilon)
+        if cache is not None:
+            return out, new_cache
         return out
 
     def extra_repr(self):
@@ -239,6 +249,10 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            attn_out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                                  cache=cache)
+            return self.ffn(attn_out), new_cache
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
 
 
@@ -275,8 +289,16 @@ class FusedMultiTransformer(Layer):
 
     def forward(self, src, attn_mask=None, caches=None, **kwargs):
         x = ensure_tensor(src)
-        for b in self.blocks:
-            x = b(x, src_mask=attn_mask)
-        if caches is not None:
-            return x, caches
-        return x
+        if caches is None:
+            for b in self.blocks:
+                x = b(x, src_mask=attn_mask)
+            return x
+        if len(caches) != len(self.blocks):
+            raise ValueError(
+                f"caches must have one (k, v) pair per layer: got "
+                f"{len(caches)} for {len(self.blocks)} layers")
+        new_caches = []
+        for b, c in zip(self.blocks, caches):
+            x, nc = b(x, src_mask=attn_mask, cache=c)
+            new_caches.append(nc)
+        return x, new_caches

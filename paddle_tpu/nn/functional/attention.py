@@ -45,7 +45,14 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, key=None):
     return jnp.swapaxes(out, 1, 2)  # B S H D
 
 
+# FLAGS_use_pallas_flash_attention (framework/flags.py) — lets users route
+# attention off the Pallas kernel for debugging/numerics comparison
+pallas_flash_enabled = True
+
+
 def _use_pallas(q_value) -> bool:
+    if not pallas_flash_enabled:
+        return False
     try:
         dev = list(q_value.devices())[0]
         return dev.platform == "tpu"

@@ -273,8 +273,12 @@ class Optimizer:
                 # every trainable var reachable from the loss
                 plist = _collect_parameters(loss)
             if no_grad_set:
-                frozen = {id(p) for p in no_grad_set}
-                plist = [p for p in plist if id(p) not in frozen]
+                frozen_ids = {id(p) for p in no_grad_set
+                              if not isinstance(p, str)}
+                frozen_names = {p for p in no_grad_set if isinstance(p, str)}
+                plist = [p for p in plist
+                         if id(p) not in frozen_ids
+                         and getattr(p, "name", None) not in frozen_names]
             self._parameter_list = plist
             self._materialize_accumulators()
             return None, []

@@ -121,17 +121,19 @@ def data(name: str, shape: Sequence[int], dtype="float32",
     return t
 
 
-def _collect_parameters_multi(fetches) -> List[Parameter]:
+def _collect_parameters_multi(fetches,
+                              trainable_only: bool = True) -> List[Parameter]:
     seen, out = set(), []
     for f in fetches:
-        for p in _collect_parameters(f):
+        for p in _collect_parameters(f, trainable_only=trainable_only):
             if id(p) not in seen:
                 seen.add(id(p))
                 out.append(p)
     return out
 
 
-def _collect_parameters(loss: Tensor) -> List[Parameter]:
+def _collect_parameters(loss: Tensor,
+                        trainable_only: bool = True) -> List[Parameter]:
     """All trainable Parameter leaves reachable from ``loss``'s tape — the
     static-graph minimize() contract (reference: minimize collects every
     trainable var in the program when no parameter list is given)."""
@@ -145,7 +147,8 @@ def _collect_parameters(loss: Tensor) -> List[Parameter]:
         for t, uid, producer in node.edges:
             if producer is not None:
                 stack.append(producer)
-            elif (isinstance(t, Parameter) and not t.stop_gradient
+            elif (isinstance(t, Parameter)
+                  and (not t.stop_gradient or not trainable_only)
                   and t._uid == uid and id(t) not in seen_ids):
                 seen_ids.add(id(t))
                 out.append(t)
@@ -228,8 +231,11 @@ class Executor:
         placeholders = [program.placeholders[n] for n in ph_names]
         # parameters are jit ARGUMENTS in eval mode too: baking them in as
         # constants would freeze eval results at first-run weights
+        # eval path lifts EVERY reachable Parameter (frozen ones included)
+        # to jit arguments — constants baked into the cache would freeze
+        # later weight updates out of eval results
         params = list(program.optimizer._parameter_list or []) if train \
-            else _collect_parameters_multi(fetches)
+            else _collect_parameters_multi(fetches, trainable_only=False)
 
         # bind feeds (shape-polymorphic: replace placeholder values)
         for n, t in zip(ph_names, placeholders):

@@ -1,0 +1,129 @@
+"""paddle.text parity: viterbi decoding (+ dataset surface note).
+
+Reference parity: python/paddle/text/ — ``viterbi_decode``/``ViterbiDecoder``
+(viterbi_decode.py) implemented as a lax.scan DP (jit-able, batched);
+the ``datasets`` subpackage (Imdb/Imikolov/Movielens/...) is download-based
+and cannot operate in a zero-egress image — constructors raise with that
+explanation rather than pretending.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """reference: text/viterbi_decode.py viterbi_decode.
+
+    potentials [B, T, N] emissions, transition_params [N, N] (with optional
+    BOS=N-2/EOS=N-1 rows when include_bos_eos_tag), lengths [B].
+    Returns (scores [B], paths [B, T]).
+    """
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)
+    B, T, N = pot.shape
+
+    def fn(p, tr, ln):
+        bos, eos = N - 2, N - 1
+
+        init = p[:, 0, :]
+        if include_bos_eos_tag:
+            init = init + tr[bos][None, :]
+
+        def step(carry, t):
+            alpha, hist_dummy = carry
+            # scores[b, prev, cur] = alpha[b, prev] + tr[prev, cur] + emit
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            best_score = jnp.max(scores, axis=1) + p[:, t, :]
+            # frozen past sequence end
+            active = (t < ln)[:, None]
+            alpha_new = jnp.where(active, best_score, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.arange(N)[None, :].astype(best_prev.dtype))
+            return (alpha_new, hist_dummy), bp
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (init, jnp.zeros((), jnp.int32)), jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + tr[:, eos][None, :]
+        last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+        scores = jnp.max(alpha, axis=-1)
+
+        # walk back through [T-1, B, N] pointers
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan: final carry = tag at t=0; ys[i] = tag at t=i+1
+        first_tag, tags_rest = jax.lax.scan(back, last_tag, backptrs,
+                                            reverse=True)
+        paths = jnp.concatenate(
+            [first_tag[:, None], jnp.swapaxes(tags_rest, 0, 1)],
+            axis=1)  # [B, T]
+        # positions past each length take the last valid tag (ref pads)
+        idx = jnp.arange(T)[None, :]
+        paths = jnp.where(idx < ln[:, None], paths,
+                          jnp.take_along_axis(
+                              paths, jnp.maximum(ln - 1, 0)[:, None],
+                              axis=1))
+        return scores, paths
+
+    out = apply_op(lambda pv, tv: fn(pv, tv, lens._value.astype("int32")),
+                   [pot, trans], name="viterbi_decode")
+    return out
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _ZeroEgressDataset:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"{type(self).__name__} downloads its corpus from the network; "
+            "this environment is zero-egress. Provide the files locally and "
+            "use paddle_tpu.io.Dataset to wrap them.")
+
+
+class datasets:
+    class Imdb(_ZeroEgressDataset):
+        pass
+
+    class Imikolov(_ZeroEgressDataset):
+        pass
+
+    class Movielens(_ZeroEgressDataset):
+        pass
+
+    class UCIHousing(_ZeroEgressDataset):
+        pass
+
+    class WMT14(_ZeroEgressDataset):
+        pass
+
+    class WMT16(_ZeroEgressDataset):
+        pass
+
+    class Conll05st(_ZeroEgressDataset):
+        pass

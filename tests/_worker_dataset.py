@@ -1,0 +1,18 @@
+"""Picklable dataset for process-worker DataLoader tests (spawn children
+import this by module path)."""
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i * i), np.int64(os.getpid())
+
+    def __len__(self):
+        return self.n

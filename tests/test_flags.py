@@ -1,0 +1,65 @@
+"""Flags registry + nan/inf runtime guard + memory stats
+(reference: phi/core/flags.cc, fluid/framework.py:7486,
+eager/nan_inf_utils.cc, memory/stats.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False,
+                      "FLAGS_benchmark": False})
+
+
+def test_set_get_flags_roundtrip():
+    paddle.set_flags({"FLAGS_benchmark": True})
+    assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    got = paddle.get_flags(["FLAGS_benchmark", "FLAGS_allocator_strategy"])
+    assert got["FLAGS_allocator_strategy"] == "auto_growth"
+
+
+def test_unknown_flag_and_bad_name():
+    with pytest.raises(ValueError):
+        paddle.get_flags("FLAGS_not_a_real_flag")
+    with pytest.raises(ValueError):
+        paddle.set_flags({"not_flags_prefixed": 1})
+    # unknown-but-prefixed flags are carried inertly (configs port over)
+    paddle.set_flags({"FLAGS_some_reference_only_flag": 3})
+    assert paddle.get_flags(
+        "FLAGS_some_reference_only_flag")["FLAGS_some_reference_only_flag"] == 3
+
+
+def test_check_nan_inf_sweep_raises():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+    with pytest.raises(FloatingPointError) as e:
+        _ = x / 0.0
+    assert "divide" in str(e.value) or "op" in str(e.value)
+    # finite ops pass untouched
+    y = x + 1.0
+    np.testing.assert_allclose(np.asarray(y.numpy()), [2.0, 1.0])
+
+
+def test_check_nan_inf_log_of_negative():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    with pytest.raises(FloatingPointError):
+        paddle.ops.log(paddle.to_tensor(np.array([-1.0], "float32")))
+
+
+def test_sweep_disabled_by_default():
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    bad = paddle.to_tensor(np.array([1.0], "float32")) / 0.0
+    assert np.isinf(np.asarray(bad.numpy())).all()  # no raise
+
+
+def test_memory_stats_shape():
+    stats = device.memory_stats()
+    assert isinstance(stats, dict)
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= device.memory_allocated() \
+        or device.max_memory_allocated() == 0
+    assert device.memory_reserved() >= 0

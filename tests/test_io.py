@@ -220,3 +220,37 @@ def test_random_sampler_bounded_generator():
     s = RandomSampler(RangeDataset(4), num_samples=5,
                       generator=itertools.count())
     assert list(s) == [0, 1, 2, 3, 4]
+
+
+class TestProcessWorkers:
+    def test_process_workers_parallel_and_ordered(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _worker_dataset import SquaresDataset
+
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader(SquaresDataset(32), batch_size=4,
+                            num_workers=2, worker_mode="process")
+        vals, pids = [], set()
+        for xb, pb in loader:
+            vals.extend(np.asarray(xb.numpy()).tolist())
+            pids.update(np.asarray(pb.numpy()).ravel().tolist())
+        assert vals == [float(i * i) for i in range(32)]  # order preserved
+        assert os.getpid() not in pids  # fetched in child processes
+        assert len(pids) >= 1
+
+    def test_bad_worker_mode_rejected(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class D(Dataset):
+            def __getitem__(self, i):
+                return i
+
+            def __len__(self):
+                return 4
+
+        with pytest.raises(ValueError):
+            DataLoader(D(), batch_size=2, worker_mode="fork")

@@ -1,0 +1,194 @@
+"""Misc API families: geometric, audio, text (viterbi), hub, onnx
+(reference: python/paddle/{geometric,audio,text,hub,onnx}/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, hub, text
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestGeometric:
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    src = np.array([0, 1, 2, 0], "int64")
+    dst = np.array([1, 2, 1, 0], "int64")
+
+    def test_segment_ops(self):
+        data = np.array([[1.0, 2], [3, 4], [5, 6]], "float32")
+        seg = np.array([0, 0, 1], "int64")
+        np.testing.assert_allclose(
+            _np(geometric.segment_sum(data, seg)), [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            _np(geometric.segment_mean(data, seg)), [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            _np(geometric.segment_min(data, seg)), [[1, 2], [5, 6]])
+        np.testing.assert_allclose(
+            _np(geometric.segment_max(data, seg)), [[3, 4], [5, 6]])
+
+    def test_send_u_recv_reduces(self):
+        out = geometric.send_u_recv(self.x, self.src, self.dst,
+                                    reduce_op="sum")
+        expect = np.zeros((4, 3), "float32")
+        for s, d in zip(self.src, self.dst):
+            expect[d] += self.x[s]
+        np.testing.assert_allclose(_np(out), expect)
+
+    def test_send_u_recv_empty_segment_zero(self):
+        out = geometric.send_u_recv(self.x, self.src, self.dst,
+                                    reduce_op="max")
+        assert _np(out)[3].sum() == 0.0  # node 3 receives nothing
+
+    def test_send_ue_recv(self):
+        y = np.ones((4, 3), "float32")
+        out = geometric.send_ue_recv(self.x, y, self.src, self.dst,
+                                     message_op="add", reduce_op="sum")
+        expect = np.zeros((4, 3), "float32")
+        for i, (s, d) in enumerate(zip(self.src, self.dst)):
+            expect[d] += self.x[s] + y[i]
+        np.testing.assert_allclose(_np(out), expect)
+
+    def test_send_uv(self):
+        out = geometric.send_uv(self.x, self.x, self.src, self.dst,
+                                message_op="mul")
+        expect = self.x[self.src] * self.x[self.dst]
+        np.testing.assert_allclose(_np(out), expect)
+
+    def test_send_u_recv_differentiable(self):
+        xt = paddle.to_tensor(self.x)
+        xt.stop_gradient = False
+        out = geometric.send_u_recv(xt, self.src, self.dst, reduce_op="sum")
+        out.sum().backward()
+        g = _np(xt.grad)
+        expect = np.zeros((4, 3), "float32")
+        for s in self.src:
+            expect[s] += 1.0
+        np.testing.assert_allclose(g, expect)
+
+    def test_reindex_graph(self):
+        x = np.array([10, 20], "int64")
+        neighbors = np.array([30, 10, 40, 30], "int64")
+        count = np.array([2, 2], "int32")
+        re_nb, re_dst, nodes = geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(_np(nodes), [10, 20, 30, 40])
+        np.testing.assert_array_equal(_np(re_nb), [2, 0, 3, 2])
+        np.testing.assert_array_equal(_np(re_dst), [0, 0, 1, 1])
+
+    def test_sample_neighbors(self):
+        paddle.seed(0)
+        # CSC: node0 <- [1,2,3], node1 <- [0]
+        row = np.array([1, 2, 3, 0], "int64")
+        colptr = np.array([0, 3, 4], "int64")
+        nb, cnt = geometric.sample_neighbors(row, colptr,
+                                             np.array([0, 1], "int64"),
+                                             sample_size=2)
+        np.testing.assert_array_equal(_np(cnt), [2, 1])
+        assert set(_np(nb)[:2]) <= {1, 2, 3}
+        assert _np(nb)[2] == 0
+
+
+class TestAudio:
+    def test_mel_hz_roundtrip(self):
+        for htk in (False, True):
+            f = audio.functional.mel_to_hz(
+                audio.functional.hz_to_mel(440.0, htk), htk)
+            np.testing.assert_allclose(f, 440.0, rtol=1e-4)
+
+    def test_fbank_matrix_shape_and_rows(self):
+        fb = _np(audio.functional.compute_fbank_matrix(16000, 512,
+                                                       n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(-1) > 0).all()  # every filter covers some bins
+
+    def test_power_to_db(self):
+        s = np.array([1.0, 10.0, 100.0], "float32")
+        db = _np(audio.functional.power_to_db(s, top_db=None))
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_spectrogram_and_melspectrogram(self):
+        paddle.seed(1)
+        x = np.random.default_rng(1).standard_normal(
+            (2, 2048)).astype("float32")
+        spec = audio.features.Spectrogram(n_fft=256)(paddle.to_tensor(x))
+        assert _np(spec).shape[0:2] == (2, 129)
+        mel = audio.features.MelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(paddle.to_tensor(x))
+        assert _np(mel).shape[0:2] == (2, 32)
+        assert (_np(mel) >= 0).all()
+
+    def test_mfcc_shape(self):
+        x = np.random.default_rng(2).standard_normal(
+            (1, 2048)).astype("float32")
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                   n_mels=32)(paddle.to_tensor(x))
+        assert _np(mfcc).shape[0:2] == (1, 13)
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        rng = np.random.default_rng(3)
+        B, T, N = 2, 4, 5  # tags incl BOS=3, EOS=4
+        pot = rng.standard_normal((B, T, N)).astype("float32")
+        trans = rng.standard_normal((N, N)).astype("float32")
+        lens = np.array([4, 3], "int64")
+        scores, paths = text.viterbi_decode(pot, trans, lens,
+                                            include_bos_eos_tag=True)
+        import itertools
+
+        for b in range(B):
+            L = int(lens[b])
+            best, best_path = -np.inf, None
+            for cand in itertools.product(range(N), repeat=L):
+                s = trans[N - 2, cand[0]] + pot[b, 0, cand[0]]
+                for t in range(1, L):
+                    s += trans[cand[t - 1], cand[t]] + pot[b, t, cand[t]]
+                s += trans[cand[-1], N - 1]
+                if s > best:
+                    best, best_path = s, cand
+            np.testing.assert_allclose(_np(scores)[b], best, rtol=1e-4)
+            np.testing.assert_array_equal(_np(paths)[b][:L], best_path)
+
+    def test_viterbi_decoder_layer(self):
+        rng = np.random.default_rng(4)
+        pot = rng.standard_normal((1, 3, 4)).astype("float32")
+        trans = rng.standard_normal((4, 4)).astype("float32")
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        scores, paths = dec(paddle.to_tensor(pot),
+                            paddle.to_tensor(np.array([3], "int64")))
+        assert _np(paths).shape == (1, 3)
+
+    def test_zero_egress_datasets_raise(self):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            text.datasets.Imdb(mode="train")
+
+
+class TestHubOnnx:
+    def test_hub_local_repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy_model(scale=2):\n"
+            "    'build a toy'\n"
+            "    return ('model', scale)\n")
+        assert "toy_model" in hub.list(str(tmp_path))
+        assert "toy" in hub.help(str(tmp_path), "toy_model")
+        assert hub.load(str(tmp_path), "toy_model", scale=3) == ("model", 3)
+
+    def test_hub_remote_sources_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            hub.list("PaddlePaddle/PaddleClas", source="github")
+
+    def test_onnx_export_produces_stablehlo_artifact(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.static_function import InputSpec
+
+        paddle.seed(5)
+        lin = nn.Linear(4, 2)
+        path = str(tmp_path / "model")
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            paddle.onnx.export(lin, path,
+                               input_spec=[InputSpec((2, 4), "float32")])
+        import os
+
+        assert any(f.startswith("model") for f in os.listdir(tmp_path))
