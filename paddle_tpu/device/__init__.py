@@ -30,9 +30,16 @@ def _parse_device(device: str):
     telemetry APIs."""
     name = device.split(":")[0]
     idx = int(device.split(":")[1]) if ":" in device else 0
-    platform = {"gpu": None, "tpu": None, "cpu": "cpu"}.get(name, name)
-    devs = jax.devices() if platform is None else jax.devices(platform)
-    return devs[idx]
+    if name in ("gpu", "tpu"):
+        # accelerator request must not silently land on CPU
+        for platform in ("tpu", "gpu"):
+            try:
+                return jax.devices(platform)[idx]
+            except RuntimeError:
+                continue
+        raise RuntimeError(
+            f"set_device({device!r}): no accelerator backend available")
+    return jax.devices(name)[idx]
 
 
 def set_device(device: str):

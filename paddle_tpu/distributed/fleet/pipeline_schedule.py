@@ -48,17 +48,36 @@ class StackedPipelineBlocks(Layer):
     """
 
     def __init__(self, factory: Callable[[], Layer], num_layers: int,
-                 remat: bool = True):
+                 remat: bool = True, vpp: int = 1):
         super().__init__()
         self.num_layers = num_layers
         self.remat = remat
+        self.vpp = max(int(vpp), 1)
         mesh = topology.get_mesh()
         self._mesh_ref = mesh
         self._pp = topology.axis_size("pp", mesh) if mesh is not None else 1
-        if num_layers % max(self._pp, 1):
+        if num_layers % max(self._pp * self.vpp, 1):
             raise ValueError(
-                f"num_layers {num_layers} not divisible by pp {self._pp}")
+                f"num_layers {num_layers} not divisible by "
+                f"pp*vpp {self._pp * self.vpp}")
         blocks = [factory() for _ in range(num_layers)]
+        # interleaved VPP (circular pipeline): device r hosts chunks
+        # {r, r+P, ..., r+(V-1)P}. The stack dim is GSPMD-sharded
+        # contiguously over 'pp', so reorder the layer stacking device-major
+        # (reference: PipelineLayerChunk round-robin assignment,
+        # pp_layers.py:182). self.layer_order maps stacked row -> original
+        # layer index (checkpoint converters need it).
+        self.layer_order = list(range(num_layers))
+        if self.vpp > 1 and self._pp > 1:
+            Pn, V = self._pp, self.vpp
+            Lc = num_layers // (Pn * V)
+            order = []
+            for r in range(Pn):
+                for v in range(V):
+                    c = v * Pn + r
+                    order.extend(range(c * Lc, (c + 1) * Lc))
+            self.layer_order = order
+            blocks = [blocks[i] for i in order]
         # scratch block for functional application: must NOT register as a
         # sublayer, or its (never-trained) cells would duplicate into
         # parameters()/state_dict/optimizer state alongside the stacked ones
@@ -147,7 +166,9 @@ class StackedPipelineBlocks(Layer):
                 return chunk(list(stacked), xv)
 
             return apply_op(fn, [xt] + list(self.stacked), name="stacked_blocks")
-        M = num_microbatches or self._pp
+        M = num_microbatches or max(self._pp, self.vpp)
+        if self.vpp > 1:
+            return pipeline_apply_vpp(self, xt, M)
         return pipeline_apply(self, xt, M)
 
 
@@ -216,6 +237,102 @@ def pipeline_apply(stack: StackedPipelineBlocks, x: Tensor, num_microbatches: in
         return out_mb.reshape((B,) + out_mb.shape[2:])
 
     return apply_op(fn, [x] + list(stack.stacked), name="pipeline_apply")
+
+
+def pipeline_apply_vpp(stack: StackedPipelineBlocks, x: Tensor,
+                       num_microbatches: int):
+    """Interleaved-VPP (circular) pipeline forward.
+
+    Reference parity: ``PipelineParallelWithInterleave``
+    (fleet/meta_parallel/pipeline_parallel.py:514) + ``PipelineLayerChunk``
+    (pp_layers.py:182): each device hosts V non-contiguous layer chunks, so
+    a microbatch circles the ring V times; the warm-up ramp is paid once,
+    shrinking the bubble from (P-1)/(M+P-1) to (P-1)/(V·M+P-1).
+
+    TPU-native formulation: one ``lax.scan`` over T = V·M + P - 1 ticks. At
+    tick t device r runs chunk slot v = (t-r)//M on microbatch m = (t-r)%M
+    (device-major stacking puts global chunk v·P+r in local slot v, so
+    chunks execute in global order). Activations hop to the next device via
+    ppermute; the P-1 → 0 wrap parks in an [M, ...] buffer until stage 0 is
+    free (requires M ≥ P). Backward is AD through the scan with per-chunk
+    remat — 1F1B memory bounds come from ``pipeline_1f1b_train`` instead.
+    """
+    mesh = stack._mesh_ref
+    Pp, V = stack._pp, stack.vpp
+    M = int(num_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if M < Pp:
+        raise ValueError(
+            f"interleaved VPP needs num_microbatches >= pp ({Pp}); got {M} "
+            "(the circular wrap re-enters stage 0 M ticks later)")
+    chunk = stack._chunk_fn()
+    Lc = stack.num_layers // (Pp * V)
+    T = V * M + Pp - 1
+
+    def fn(xv, *stacked):
+        mb = xv.reshape((M, B // M) + xv.shape[1:])
+
+        def inner(mb_in, *stacked_local):
+            r = jax.lax.axis_index("pp")
+            vary = lambda z: jax.lax.pcast(z, ("pp",), to="varying")
+            state = vary(jnp.zeros(mb_in.shape[1:], mb_in.dtype))
+            wrap = vary(jnp.zeros(mb_in.shape, mb_in.dtype))
+            outputs = vary(jnp.zeros(mb_in.shape, mb_in.dtype))
+            perm = [(i, (i + 1) % Pp) for i in range(Pp)]
+
+            def tick(carry, t):
+                state, wrap, outputs = carry
+                # stage 0: the circular ppermute delivers stage P-1's output
+                # of tick t-1 in `state` — if it is a wrap (chunk column not
+                # final), PARK it in the wrap buffer until this microbatch's
+                # next round begins (store precedes the read below so M == P
+                # hands off within the same tick)
+                u_arr = t - Pp  # (t-1) - (P-1): the arriving value's index
+                ua = jnp.clip(u_arr, 0, V * M - 1)
+                arr_wrap = ((u_arr >= 0) & (u_arr < V * M)
+                            & (ua // M < V - 1))
+                wrap = jnp.where(
+                    (r == 0) & arr_wrap,
+                    jax.lax.dynamic_update_index_in_dim(
+                        wrap, state, ua % M, axis=0),
+                    wrap)
+
+                u = t - r
+                valid = (u >= 0) & (u < V * M)
+                uc = jnp.clip(u, 0, V * M - 1)
+                v = uc // M          # chunk slot this tick
+                m = uc % M           # microbatch index
+                first = jnp.where(v == 0, mb_in[m], wrap[m])
+                x_in = jnp.where(r == 0, first, state)
+                vals_v = [jax.lax.dynamic_slice_in_dim(s, v * Lc, Lc, axis=0)
+                          for s in stacked_local]
+                y = chunk(vals_v, x_in)
+                outputs = jnp.where(
+                    valid & (r == Pp - 1) & (v == V - 1),
+                    jax.lax.dynamic_update_index_in_dim(outputs, y, m,
+                                                        axis=0),
+                    outputs)
+                state = jax.lax.ppermute(y, "pp", perm)
+                return (state, wrap, outputs), None
+
+            (state, wrap, outputs), _ = jax.lax.scan(
+                tick, (state, wrap, outputs), jnp.arange(T))
+            outputs = jax.lax.psum(
+                jnp.where(r == Pp - 1, outputs, jnp.zeros_like(outputs)),
+                "pp")
+            return outputs
+
+        stacked_specs = tuple(
+            P(*(["pp"] + [None] * (s.ndim - 1))) for s in stacked)
+        mapped = jax.shard_map(
+            inner, mesh=mesh, axis_names={"pp"},
+            in_specs=(P(),) + stacked_specs, out_specs=P())
+        out_mb = mapped(mb, *stacked)
+        return out_mb.reshape((B,) + out_mb.shape[2:])
+
+    return apply_op(fn, [x] + list(stack.stacked), name="pipeline_apply_vpp")
 
 
 # --------------------------------------------------------------------- 1F1B

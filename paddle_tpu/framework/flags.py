@@ -101,8 +101,6 @@ def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, object]:
     for name in names:
         if name in _values:
             out[name] = _values[name]
-        elif name in _DEFS:
-            out[name] = _DEFS[name][0]
         else:
             raise ValueError(f"unknown flag {name!r}")
     return out

@@ -36,7 +36,7 @@ _REDUCERS = {
 }
 
 
-def _segment(fn_name, data, segment_ids, num_segments):
+def _segment(fn_name, num_segments):
     def fn(d, seg):
         n = num_segments
         if fn_name == "mean":
@@ -78,7 +78,7 @@ def _segment_entry(kind, data, segment_ids):
     d = ensure_tensor(data)
     seg = ensure_tensor(segment_ids)
     n = int(np.asarray(seg.numpy()).max()) + 1 if seg.size else 0
-    return apply_op(lambda dv: _segment(kind, None, None, n)(
+    return apply_op(lambda dv: _segment(kind, n)(
         dv, seg._value.astype("int32")), [d], name=f"segment_{kind}")
 
 
@@ -92,7 +92,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum",
 
     def fn(xv):
         msgs = jnp.take(xv, src._value.astype("int32"), axis=0)
-        return _segment(reduce_op, None, None, n)(
+        return _segment(reduce_op, n)(
             msgs, dst._value.astype("int32"))
 
     return apply_op(fn, [xt], name=f"send_u_recv_{reduce_op}")
@@ -111,7 +111,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
 
     def fn(xv, yv):
         msgs = combine(jnp.take(xv, src._value.astype("int32"), axis=0), yv)
-        return _segment(reduce_op, None, None, n)(
+        return _segment(reduce_op, n)(
             msgs, dst._value.astype("int32"))
 
     return apply_op(fn, [xt, yt], name=f"send_ue_recv_{message_op}")
@@ -161,23 +161,35 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
                      eids=None, return_eids: bool = False, perm_buffer=None,
                      name=None):
     """reference: sampling/neighbors.py sample_neighbors — CSC graph
-    (row, colptr), sample up to ``sample_size`` neighbors per input node."""
+    (row, colptr), sample up to ``sample_size`` neighbors per input node;
+    with return_eids=True also returns the sampled edges' ids."""
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
     rowv = np.asarray(ensure_tensor(row).numpy()).astype("int64")
     ptr = np.asarray(ensure_tensor(colptr).numpy()).astype("int64")
     nodes = np.asarray(ensure_tensor(input_nodes).numpy()).astype("int64")
+    eidv = None if eids is None else np.asarray(
+        ensure_tensor(eids).numpy()).astype("int64")
     key = default_generator.next_key()
     seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
     rng = np.random.default_rng(seed)
-    out_neighbors, out_count = [], []
+    out_neighbors, out_count, out_eids = [], [], []
     for nd in nodes:
         beg, end = int(ptr[nd]), int(ptr[nd + 1])
-        neigh = rowv[beg:end]
-        if sample_size > 0 and len(neigh) > sample_size:
-            neigh = rng.choice(neigh, size=sample_size, replace=False)
-        out_neighbors.append(neigh)
-        out_count.append(len(neigh))
+        pos = np.arange(beg, end)
+        if sample_size > 0 and len(pos) > sample_size:
+            pos = rng.choice(pos, size=sample_size, replace=False)
+        out_neighbors.append(rowv[pos])
+        out_count.append(len(pos))
+        if return_eids:
+            out_eids.append(eidv[pos])
     flat = (np.concatenate(out_neighbors) if out_neighbors
             else np.empty((0,), "int64"))
-    return (Tensor(jnp.asarray(flat.astype("int64")), stop_gradient=True),
-            Tensor(jnp.asarray(np.asarray(out_count, "int32")),
-                   stop_gradient=True))
+    result = (Tensor(jnp.asarray(flat.astype("int64")), stop_gradient=True),
+              Tensor(jnp.asarray(np.asarray(out_count, "int32")),
+                     stop_gradient=True))
+    if return_eids:
+        fe = (np.concatenate(out_eids) if out_eids
+              else np.empty((0,), "int64"))
+        return result + (Tensor(jnp.asarray(fe), stop_gradient=True),)
+    return result

@@ -10,21 +10,21 @@ from __future__ import annotations
 import importlib.util
 import os
 import sys
-from typing import List, Optional
+from typing import Optional
 
 __all__ = ["list", "help", "load"]
 
-_builtin_list = list
 
-
-def _load_hubconf(repo_dir: str):
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
     path = os.path.join(repo_dir, "hubconf.py")
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
-    spec = importlib.util.spec_from_file_location(
-        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod_name = f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(repo_dir)))}"
+    if not force_reload and mod_name in sys.modules:
+        return sys.modules[mod_name]  # hubconf module-level code runs once
+    spec = importlib.util.spec_from_file_location(mod_name, path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
+    sys.modules[mod_name] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -40,7 +40,7 @@ def _check_source(source: str):
 def list(repo_dir: str, source: str = "local", force_reload: bool = False):
     """reference: hub.list — entrypoint names exposed by hubconf.py."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [name for name in dir(mod)
             if callable(getattr(mod, name)) and not name.startswith("_")]
 
@@ -49,7 +49,7 @@ def help(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False) -> Optional[str]:
     """reference: hub.help — the entrypoint's docstring."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")
@@ -60,7 +60,7 @@ def load(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False, **kwargs):
     """reference: hub.load — call the entrypoint."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")

@@ -4,14 +4,12 @@ Reference parity: python/paddle/onnx/export.py — a thin wrapper over the
 external ``paddle2onnx`` converter. That converter consumes the reference's
 Program protobuf; this framework's deploy IR is StableHLO (jit.save /
 jax.export), for which the ecosystem path is StableHLO→ONNX via onnx-mlir
-or IREE tooling. ``export`` therefore (a) always produces the StableHLO
-artifact next to the requested path, and (b) emits real ONNX only when the
-optional ``onnx`` python package is importable — otherwise raises with the
-exact gap, never a silent wrong-format file.
+or IREE tooling. ``export`` therefore always produces the StableHLO artifact at the
+requested path and then raises NotImplementedError naming it — direct
+ONNX graph emission is not implemented, and a silent wrong-format success
+would be worse than the loud gap.
 """
 from __future__ import annotations
-
-import warnings
 
 __all__ = ["export"]
 
@@ -24,15 +22,8 @@ def export(layer, path: str, input_spec=None, opset_version: int = 9,
     if input_spec is None:
         raise ValueError("paddle_tpu.onnx.export requires input_spec")
     jit.save(layer, path, input_spec=input_spec)
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise NotImplementedError(
-            "ONNX serialization needs the 'onnx' package (not in this "
-            f"image). The portable StableHLO program + params were written "
-            f"to {path}.* (jit.save format; convertible with "
-            "stablehlo->onnx tooling such as onnx-mlir).")
-    warnings.warn(
-        "paddle_tpu.onnx.export wrote the StableHLO deploy artifact; "
-        "direct ONNX graph emission is not implemented", stacklevel=2)
-    return path
+    raise NotImplementedError(
+        "direct ONNX graph emission is not implemented; the portable "
+        f"StableHLO program + params were written to {path}.* (jit.save "
+        "format — convertible with stablehlo->onnx tooling such as "
+        "onnx-mlir/IREE).")
