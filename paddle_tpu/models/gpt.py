@@ -134,7 +134,17 @@ class GPTAttention(nn.Layer):
             return tuple(t.reshape(B, S, nh, hd) for t in (q, k, v_))
 
         q, k, v = apply_op(split_heads, [ensure_tensor(qkv)], name="split_heads")
-        if self.cfg.use_flash_attention:
+        mesh = topology.get_mesh()
+        if (self.cfg.sequence_parallel and mesh is not None
+                and "sep" in mesh.axis_names and mesh.shape["sep"] > 1
+                and not (self.attn_drop_p and self.training)):
+            # long-context path: exact ring attention over the 'sep' axis —
+            # q stays resident, k/v stream around the ring (ppermute), so
+            # no device ever holds the full sequence (SURVEY §7 step 6)
+            from ..distributed.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, causal=True, mesh=mesh)
+        elif self.cfg.use_flash_attention:
             ctx = F.flash_attention(q, k, v, causal=True,
                                     dropout=self.attn_drop_p if self.training else 0.0)
         else:

@@ -93,6 +93,18 @@ S("erf", Spec(None, lambda x: __import__("scipy.special", fromlist=["erf"]).erf(
               lambda dt: [r(2, 3)(dt)], grad=True))
 S("erfinv", Spec(None, lambda x: __import__("scipy.special", fromlist=["erfinv"]).erfinv(x),
                  lambda dt: [unit(2, 3)(dt)], grad=True))
+S("lgamma", Spec(None, lambda x: __import__("scipy.special", fromlist=["gammaln"]).gammaln(x),
+                 lambda dt: [pos(2, 3)(dt)], grad=True))
+S("digamma", Spec(None, lambda x: __import__("scipy.special", fromlist=["psi"]).psi(x),
+                  lambda dt: [pos(2, 3)(dt)], grad=True))
+S("i0", Spec(None, lambda x: __import__("scipy.special", fromlist=["i0"]).i0(x),
+             lambda dt: [r(2, 3)(dt)], grad=True))
+S("i0e", Spec(None, lambda x: __import__("scipy.special", fromlist=["i0e"]).i0e(x),
+              lambda dt: [r(2, 3)(dt)], grad=True))
+S("i1", Spec(None, lambda x: __import__("scipy.special", fromlist=["i1"]).i1(x),
+             lambda dt: [r(2, 3)(dt)], grad=True))
+S("i1e", Spec(None, lambda x: __import__("scipy.special", fromlist=["i1e"]).i1e(x),
+              lambda dt: [r(2, 3)(dt)], grad=True))
 S("exp", u(np.exp))
 S("expm1", u(np.expm1))
 S("floor", u(np.floor, grad=False))

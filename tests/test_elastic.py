@@ -3,6 +3,8 @@
 import os
 import time
 
+import pytest
+
 from paddle_tpu.distributed.fleet.elastic import (
     ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, FileStore)
 
@@ -97,3 +99,13 @@ def test_below_quorum_exits_after_deadline(tmp_path):
     status = a.watch(interval=0.1, timeout=2.0)
     assert status == ElasticStatus.EXIT
     a.exit()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    """_rewrite_env mutates PADDLE_* globals; never leak them to other
+    test modules (test_io asserts the defaults)."""
+    yield
+    for k in ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+              "PADDLE_TRAINER_ID"):
+        os.environ.pop(k, None)
