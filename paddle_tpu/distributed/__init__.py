@@ -10,6 +10,10 @@ annotated for the mesh.
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
     new_group, reduce, scatter, wait,
@@ -37,4 +41,5 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
     "CommunicateTopology", "HybridCommunicateGroup", "create_mesh",
     "get_mesh", "set_mesh", "fleet", "group_sharded_parallel",
+    "rpc", "TCPStore", "ps", "spawn",
 ]

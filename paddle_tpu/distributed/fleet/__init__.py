@@ -25,8 +25,10 @@ from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pipeline_schedule import StackedPipelineBlocks, pipeline_apply  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import utils  # noqa: F401
 
 __all__ = [
+    "utils",
     "init", "fleet", "Fleet", "DistributedStrategy", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",

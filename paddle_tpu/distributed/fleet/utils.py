@@ -1,0 +1,337 @@
+"""fleet.utils — filesystem abstraction, logging, hybrid-parallel helpers.
+
+Reference parity: ``python/paddle/distributed/fleet/utils/`` — ``fs.py``
+(FS/LocalFS/HDFSClient), ``log_util.py`` (rank-prefixed logger), and
+``hybrid_parallel_util.py`` (broadcast_mp_parameters :198,
+broadcast_dp_parameters :206, fused_allreduce_gradients :226). The
+broadcast/allreduce helpers are GSPMD-redesigned: under one device mesh
+a broadcast is materialized by re-binding every rank's value to the
+axis-0 rank's (here: executing a psum-of-masked under shard_map or, in
+the common single-process-per-mesh case, a no-op because parameters are
+a single sharded jax.Array — the helper still exists so fleet-style
+training scripts port unchanged).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+import sys
+from typing import List, Optional
+
+__all__ = [
+    "ExecuteError", "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
+    "FS", "LocalFS", "HDFSClient", "get_logger", "logger",
+    "broadcast_mp_parameters", "broadcast_dp_parameters",
+    "fused_allreduce_gradients", "recompute",
+]
+
+
+# ------------------------------------------------------------------ fs
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FS:
+    """Abstract filesystem (reference: fs.py:51)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (reference: fs.py:113)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def upload(self, local_path, fs_path):
+        # local "upload" is a copy (reference behavior)
+        if self.is_dir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def cat(self, fs_path):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """HDFS via the ``hadoop fs`` CLI (reference: fs.py HDFSClient — same
+    shell-out contract; raises ExecuteError when the binary is absent)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+        self._hadoop = os.path.join(hadoop_home, "bin/hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = []
+        for k, v in (configs or {}).items():
+            self._configs += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args) -> str:
+        cmd = [self._hadoop, "fs", *self._configs, *args]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._timeout)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not found ({self._hadoop}); set "
+                "hadoop_home") from e
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(" ".join(cmd)) from e
+        if proc.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)}: {proc.stderr.strip()}")
+        return proc.stdout
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-skipTrash", fs_path)
+
+    def rename(self, src, dst):
+        self._run("-mv", src, dst)
+
+    mv = rename
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path):
+        return self._run("-cat", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+
+# ------------------------------------------------------------------ logging
+
+
+def get_logger(log_level=logging.INFO, name: str = "FleetLog") -> logging.Logger:
+    """Rank-prefixed logger (reference: log_util.py)."""
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        handler.setFormatter(logging.Formatter(
+            f"[rank {rank}] %(asctime)s %(levelname)s %(message)s"))
+        lg.addHandler(handler)
+        lg.propagate = False
+    lg.setLevel(log_level)
+    return lg
+
+
+logger = get_logger()
+
+
+# ------------------------------------------- hybrid-parallel param helpers
+
+
+def _sync_params_over_axis(model, axis: str) -> None:
+    """Make every process hold process-0's parameter values.
+
+    Under GSPMD, ranks of a mesh axis share ONE logical jax.Array, so
+    single-process meshes need nothing. In multi-process
+    (jax.distributed) runs each process may have computed its own init —
+    there we broadcast process-0's values to everyone
+    (multihost_utils.broadcast_one_to_all), which is the GSPMD
+    counterpart of the reference's per-axis NCCL broadcast."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return  # one process == one init: nothing can diverge
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    for _, p in model.named_parameters():
+        host = np.asarray(jax.device_get(p._value))
+        synced = multihost_utils.broadcast_one_to_all(host)
+        p._set_value(jax.numpy.asarray(synced, p._value.dtype))
+
+
+def broadcast_mp_parameters(model, hcg=None) -> None:
+    """reference: hybrid_parallel_util.py:198."""
+    _sync_params_over_axis(model, "mp")
+
+
+def broadcast_dp_parameters(model, hcg=None) -> None:
+    """reference: hybrid_parallel_util.py:206."""
+    _sync_params_over_axis(model, "dp")
+
+
+def fused_allreduce_gradients(parameter_list: List, hcg=None) -> None:
+    """Mean-reduce grads across the dp axis (reference:
+    hybrid_parallel_util.py:226 — fused NCCL allreduce of all grads).
+
+    Under GSPMD, grads computed inside a shard_map/pjit program already
+    carry their collective; this helper covers the manual-eager path where
+    each dp rank computed grads on its own microbatch slice: it reduces
+    via the collective API when a process group is live, else no-op."""
+    from .. import collective
+
+    grads = [getattr(p, "grad", None) for p in parameter_list]
+    grads = [g for g in grads if g is not None]
+    for i, g in enumerate(grads):
+        try:
+            collective.all_reduce(g, op=collective.ReduceOp.AVG)
+        except RuntimeError as e:
+            if i == 0 and "shard_map" in str(e):
+                # outside any collective region (single-process eager):
+                # grads are already mesh-global — nothing to reduce
+                return
+            # mid-list failure would leave a reduced/unreduced mix —
+            # that must surface, not be swallowed
+            raise
+
+
+# recompute is re-exported here because fleet.utils.recompute is the
+# reference's import path for it
+from .recompute import recompute  # noqa: E402,F401

@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed.ps — parameter-server training.
+
+Reference parity: the brpc parameter server
+(``paddle/fluid/distributed/ps/``: ``BrpcPsServer/Client``, table layer,
+``Communicator``; Python runtime ``python/paddle/distributed/ps/``).
+Redesigned for this framework: the table engine (hash-map sparse rows +
+fused SGD/AdaGrad/Adam update) is native C++
+(``paddle_tpu/native/src/ps_table.cc``), servers host table shards over
+TCP, and the client API keeps the reference's verbs —
+``pull_sparse`` / ``push_sparse`` / ``pull_dense`` / ``push_dense`` —
+with key-space sharding across servers. ``SparseEmbedding`` plugs the
+client into the eager autograd tape so a dense TPU model can train
+against a host-resident embedding table that never enters HBM.
+"""
+from .table import DenseTable, SparseTable, TableConfig  # noqa: F401
+from .service import PSClient, PSServer  # noqa: F401
+from .layers import SparseEmbedding  # noqa: F401
+
+__all__ = [
+    "TableConfig", "SparseTable", "DenseTable",
+    "PSServer", "PSClient", "SparseEmbedding",
+]

@@ -1,0 +1,68 @@
+"""PS-backed layers for eager training.
+
+Reference parity: ``paddle.static.nn.sparse_embedding`` (the PS-routed
+embedding lookup the reference lowers to ``pull_sparse`` /
+``push_sparse`` ops, ``python/paddle/static/nn/common.py``) — redesigned
+for this framework's eager tape: the lookup pulls rows from the server
+into a leaf Tensor on the forward pass and a gradient hook pushes the
+rows' grads back (server applies the fused optimizer), so the embedding
+never consumes TPU HBM and the dense trunk trains normally on-device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from ...tensor import Tensor
+from .service import PSClient
+from .table import TableConfig
+
+__all__ = ["SparseEmbedding"]
+
+
+class SparseEmbedding(Layer):
+    """Host-resident embedding table behind a :class:`PSClient`.
+
+    Rows are created on first touch (no vocab-size cap, like the
+    reference's grow-on-demand sparse tables — ids are uint64 hashes).
+    The layer holds no device parameters: the "parameter" lives on the
+    servers, updated by the server-side optimizer on every ``backward``.
+    """
+
+    def __init__(self, client: PSClient, table_id: int,
+                 embedding_dim: int,
+                 config: Optional[TableConfig] = None,
+                 name: Optional[str] = None):
+        super().__init__()
+        cfg = config or TableConfig(dim=embedding_dim)
+        if cfg.dim != embedding_dim:
+            raise ValueError(f"TableConfig.dim={cfg.dim} != "
+                             f"embedding_dim={embedding_dim}")
+        self._client = client
+        self._table_id = table_id
+        self._dim = embedding_dim
+        client.create_sparse_table(table_id, cfg)
+
+    def forward(self, ids) -> Tensor:
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+        flat = ids_np.astype(np.uint64).ravel()
+        rows_np = self._client.pull_sparse(self._table_id, flat)
+        rows = Tensor(rows_np, stop_gradient=False)
+
+        if self.training:
+            client, tid = self._client, self._table_id
+
+            def _push(grad):
+                client.push_sparse(tid, flat,
+                                   np.asarray(grad.numpy(), np.float32))
+                return grad
+
+            rows.register_hook(_push)
+        out_shape = tuple(ids_np.shape) + (self._dim,)
+        return rows.reshape(out_shape)
+
+    def extra_repr(self) -> str:
+        return (f"table_id={self._table_id}, dim={self._dim}, "
+                f"servers={self._client.num_servers}")

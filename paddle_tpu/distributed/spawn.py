@@ -1,0 +1,111 @@
+"""paddle.distributed.spawn — start a multi-process training function.
+
+Reference parity: ``python/paddle/distributed/spawn.py`` (``spawn(func,
+args, nprocs, ...)`` → per-process PADDLE_TRAINER_* env +
+``MultiprocessContext`` joining with error propagation). TPU redesign:
+each spawned process gets the same env contract the launch CLI sets
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER_ENDPOINT), so
+``init_parallel_env`` / rpc / TCPStore bootstrap work identically under
+spawn and launch. Processes default to the CPU platform unless the
+caller opts into the TPU (one chip cannot be shared by N processes).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Tuple
+
+from ._wire import free_port as _free_port
+
+__all__ = ["spawn", "MultiprocessContext"]
+
+
+def _worker(func, args, rank: int, nprocs: int, env: dict, error_queue,
+            return_queue) -> None:
+    os.environ.update(env)
+    try:
+        ret = func(*args)
+        return_queue.put((rank, ret))
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        error_queue.put((rank, traceback.format_exc()))
+        raise SystemExit(1)
+
+
+class MultiprocessContext:
+    """Join handle for spawned workers (reference: spawn.py:360)."""
+
+    def __init__(self, processes, error_queues, return_queues):
+        self.processes = processes
+        self.error_queues = error_queues
+        self.return_queues = return_queues
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        failed = [(i, p.exitcode) for i, p in enumerate(self.processes)
+                  if p.exitcode not in (0, None)]
+        if failed:
+            msgs = []
+            while not self.error_queues.empty():
+                rank, tb = self.error_queues.get()
+                msgs.append(f"---- rank {rank} ----\n{tb}")
+            for p in self.processes:  # reap any stragglers
+                if p.is_alive():
+                    p.terminate()
+            raise RuntimeError(
+                "spawned process(es) failed "
+                f"{[f'rank {i} exit {c}' for i, c in failed]}\n"
+                + "\n".join(msgs))
+        return all(p.exitcode == 0 for p in self.processes)
+
+    def results(self) -> dict:
+        out = {}
+        while not self.return_queues.empty():
+            rank, ret = self.return_queues.get()
+            out[rank] = ret
+        return out
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch ``func`` in ``nprocs`` processes with the trainer env set.
+
+    Options: ``master`` ("ip:port", default localhost + free port),
+    ``backend`` (default "cpu": spawned procs must not fight over the
+    single TPU chip; pass "tpu" explicitly for one-proc-per-host jobs).
+    """
+    if nprocs < 1:
+        raise ValueError("nprocs must be >= 1")
+    master = options.get("master") or f"127.0.0.1:{_free_port()}"
+    backend = options.get("backend", "cpu")
+
+    ctx = mp.get_context("spawn")
+    error_queue = ctx.SimpleQueue()
+    return_queue = ctx.SimpleQueue()
+    processes = []
+    endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nprocs)]
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_MASTER_ENDPOINT": master,
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_WORKER_ENDPOINT": endpoints[rank],
+        }
+        if backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        p = ctx.Process(target=_worker,
+                        args=(func, args, rank, nprocs, env, error_queue,
+                              return_queue),
+                        daemon=daemon)
+        p.start()
+        processes.append(p)
+
+    context = MultiprocessContext(processes, error_queue, return_queue)
+    if join:
+        context.join()
+    return context

@@ -1,0 +1,153 @@
+"""TCPStore — key-value rendezvous over TCP, backed by the native core.
+
+Reference parity: ``paddle/phi/core/distributed/store/tcp_store.h:120``
+(C++ TCPStore exposed to Python as ``core.TCPStore``, used by
+``init_parallel_env``, rpc bootstrap and barriers). Same contract here:
+rank 0 hosts the server in-process (a native C++ thread, no GIL
+involvement), every rank connects a client; ``get`` blocks until the key
+appears.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, List, Optional
+
+from ..native import load_library
+
+__all__ = ["TCPStore"]
+
+_lib = None
+
+
+def _native():
+    global _lib
+    if _lib is None:
+        lib = load_library("tcp_store")
+        lib.pd_store_server_start.restype = ctypes.c_void_p
+        lib.pd_store_server_start.argtypes = [ctypes.c_int]
+        lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pd_store_client_connect.restype = ctypes.c_void_p
+        lib.pd_store_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_double]
+        lib.pd_store_client_free.argtypes = [ctypes.c_void_p]
+        lib.pd_store_set.restype = ctypes.c_int
+        lib.pd_store_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.pd_store_get.restype = ctypes.c_int64
+        lib.pd_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.pd_store_free_buf.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.pd_store_add.restype = ctypes.c_int64
+        lib.pd_store_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.pd_store_wait.restype = ctypes.c_int
+        lib.pd_store_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+        lib.pd_store_check.restype = ctypes.c_int
+        lib.pd_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+class TCPStore:
+    """KV store for process-group bootstrap.
+
+    Args:
+        host: server address (rank-0's host).
+        port: server port.
+        is_master: when True, host the server in this process.
+        world_size: recorded for introspection; not enforced by the store.
+        timeout: default client/blocking-get timeout in seconds.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        lib = _native()
+        self.host, self.port = host, int(port)
+        self.world_size = world_size
+        self.timeout = float(timeout)
+        self._server = None
+        if is_master:
+            self._server = lib.pd_store_server_start(self.port)
+            if not self._server:
+                raise RuntimeError(
+                    f"TCPStore: could not bind server on port {self.port}")
+        connect_host = "127.0.0.1" if is_master else host
+        self._client = lib.pd_store_client_connect(
+            connect_host.encode(), self.port, self.timeout)
+        if not self._client:
+            if self._server:
+                lib.pd_store_server_stop(self._server)
+                self._server = None
+            raise RuntimeError(
+                f"TCPStore: could not connect to {host}:{self.port} "
+                f"within {self.timeout:.0f}s")
+
+    # -- reference TCPStore methods ----------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        elif isinstance(value, int):
+            value = str(value).encode()
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value)
+        rc = _native().pd_store_set(self._client, key.encode(), buf,
+                                    len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed (rc={rc})")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        t = self.timeout if timeout is None else float(timeout)
+        n = _native().pd_store_get(self._client, key.encode(), t,
+                                   ctypes.byref(out))
+        if n == -2:
+            raise TimeoutError(f"TCPStore.get({key!r}): no value within "
+                               f"{t:.0f}s")
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) transport error")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            _native().pd_store_free_buf(out)
+
+    def add(self, key: str, amount: int) -> int:
+        v = _native().pd_store_add(self._client, key.encode(), int(amount))
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, keys: Iterable[str] | str,
+             timeout: Optional[float] = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        t = self.timeout if timeout is None else float(timeout)
+        for key in keys:
+            rc = _native().pd_store_wait(self._client, key.encode(), t)
+            if rc == 1:
+                raise TimeoutError(f"TCPStore.wait: key {key!r} absent "
+                                   f"after {t:.0f}s")
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.wait({key!r}) transport error")
+
+    def check(self, keys: Iterable[str] | str) -> bool:
+        if isinstance(keys, str):
+            keys = [keys]
+        return all(_native().pd_store_check(self._client, k.encode()) == 0
+                   for k in keys)
+
+    def stop(self) -> None:
+        lib = _native()
+        if self._client:
+            lib.pd_store_client_free(self._client)
+            self._client = None
+        if self._server:
+            lib.pd_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -2,9 +2,18 @@
 
 Populated: ``distributed.models.moe`` (MoELayer + gates + expert-parallel
 all-to-all), ``autograd`` (functional vjp/jvp/Jacobian/Hessian + primapi
-forward_grad/grad).
+forward_grad/grad), ``nn`` (fused transformer layers), ``asp`` (n:m
+structured sparsity), ``optimizer`` (LookAhead / ModelAverage / LBFGS),
+``autotune`` (kernel/layout/dataloader tuning config).
 """
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .autotune import autotune_status, set_config  # noqa: F401
+from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["autograd", "distributed"]
+__all__ = ["autograd", "distributed", "asp", "nn", "optimizer",
+           "LookAhead", "ModelAverage", "LBFGS", "set_config",
+           "autotune_status"]

@@ -1,0 +1,34 @@
+"""paddle.incubate.asp — automatic structured (n:m) sparsity.
+
+Reference parity: ``python/paddle/incubate/asp/`` (asp.py:216 ``decorate``,
+:302 ``prune_model``; utils.py mask generators ``get_mask_1d`` /
+``get_mask_2d_greedy`` / ``get_mask_2d_best`` and checkers). The TPU
+redesign keeps the same workflow — prune supported weights to an n:m
+pattern, then train with an optimizer wrapper that re-applies the masks
+after every ``step`` so pruned entries stay zero — with masks held as
+device arrays so the re-mask fuses into the compiled train step.
+"""
+from .asp import (  # noqa: F401
+    ASPHelper,
+    decorate,
+    prune_model,
+    reset_excluded_layers,
+    set_excluded_layers,
+)
+from .utils import (  # noqa: F401
+    calculate_density,
+    check_mask_1d,
+    check_mask_2d,
+    check_sparsity,
+    create_mask,
+    get_mask_1d,
+    get_mask_2d_best,
+    get_mask_2d_greedy,
+)
+
+__all__ = [
+    "calculate_density", "check_mask_1d", "get_mask_1d", "check_mask_2d",
+    "get_mask_2d_greedy", "get_mask_2d_best", "create_mask",
+    "check_sparsity", "decorate", "prune_model", "set_excluded_layers",
+    "reset_excluded_layers", "ASPHelper",
+]

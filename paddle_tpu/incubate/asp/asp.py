@@ -1,0 +1,135 @@
+"""ASP workflow: prune supported layers, keep sparsity through training.
+
+Reference parity: ``python/paddle/incubate/asp/asp.py`` (``decorate``
+:216 wraps the optimizer so masks re-apply after each step — the
+reference appends masking ops to the optimizer program; here the mask
+multiply happens right after ``step()``, in jnp so it compiles into the
+train step under jit; ``prune_model`` :302 computes masks with the
+chosen algorithm; excluded-layer registry :40/:127).
+
+Supported layers: Linear (2-D weights, pruned along the input dim) and
+Conv2D (4-D OIHW weights flattened per output channel), matching the
+reference's supported_layer_list.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import check_sparsity, create_mask
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "ASPHelper"]
+
+
+class ASPHelper:
+    """Process-wide registry of masks and exclusions (reference keeps the
+    same singletons keyed by program; eager mode needs just one)."""
+
+    _excluded_param_names: set = set()
+    # param uid -> (param, mask jnp array)
+    _masks: Dict[int, tuple] = {}
+
+    MASK_ALGO_MAP = {
+        "mask_1d": "mask_1d",
+        "mask_2d_greedy": "mask_2d_greedy",
+        "mask_2d_best": "mask_2d_best",
+    }
+
+    @classmethod
+    def _is_supported_param(cls, name: str, value) -> bool:
+        if name in cls._excluded_param_names:
+            return False
+        # weights only (>=2-D); biases/norms stay dense
+        return value.ndim in (2, 4)
+
+    @classmethod
+    def prune_model(cls, model, n: int = 2, m: int = 4,
+                    mask_algo: str = "mask_1d",
+                    with_mask: bool = True) -> Dict[str, np.ndarray]:
+        if mask_algo not in cls.MASK_ALGO_MAP:
+            raise ValueError(f"mask_algo must be one of "
+                             f"{sorted(cls.MASK_ALGO_MAP)}, got {mask_algo!r}")
+        masks: Dict[str, np.ndarray] = {}
+        for name, p in model.named_parameters():
+            v = np.asarray(p._value)
+            if not cls._is_supported_param(name, v):
+                continue
+            # Prune along the reduction dim: Linear weights here are
+            # [in, out] (y = x @ W), so mask groups run down the input
+            # axis — transpose, mask rows, transpose back.
+            if v.ndim == 2:
+                mask = create_mask(v.T, cls.MASK_ALGO_MAP[mask_algo],
+                                   n, m).T
+            else:
+                mask = create_mask(v, cls.MASK_ALGO_MAP[mask_algo], n, m)
+            p._set_value(jnp.asarray(v * mask, p._value.dtype))
+            masks[name] = mask
+            if with_mask:
+                cls._masks[p._uid] = (p, jnp.asarray(mask, p._value.dtype))
+        return masks
+
+    @classmethod
+    def reapply_masks(cls) -> None:
+        for p, mask in cls._masks.values():
+            p._set_value(p._value * mask)
+
+    @classmethod
+    def check_model_sparsity(cls, model, n: int = 2, m: int = 4,
+                             func_name: str = "mask_1d") -> bool:
+        ok = True
+        for name, p in model.named_parameters():
+            if p._uid in cls._masks:
+                v = np.asarray(p._value)
+                ok &= check_sparsity(v.T if v.ndim == 2 else v,
+                                     func_name, n, m)
+        return bool(ok)
+
+
+def set_excluded_layers(param_names: List[str], main_program=None) -> None:
+    """Exclude parameters (by name) from pruning (reference: asp.py:40)."""
+    ASPHelper._excluded_param_names.update(param_names)
+
+
+def reset_excluded_layers(main_program=None) -> None:
+    """Clear the exclusion list (reference: asp.py:127)."""
+    ASPHelper._excluded_param_names.clear()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune ``model``'s supported weights to the n:m pattern.
+
+    When ``with_mask`` is True the masks are retained so a decorated
+    optimizer keeps the pattern through training (reference: asp.py:302).
+    """
+    return ASPHelper.prune_model(model, n, m, mask_algo, with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies ASP masks after every ``step`` (reference: asp.py:548 —
+    the decorated optimizer's masking ops)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self, *args, **kwargs):
+        out = self._optimizer.step(*args, **kwargs)
+        ASPHelper.reapply_masks()
+        return out
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._optimizer.minimize(loss, *args, **kwargs)
+        ASPHelper.reapply_masks()
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    """Wrap ``optimizer`` so pruned weights stay pruned (reference:
+    asp.py:216)."""
+    return OptimizerWithSparsityGuarantee(optimizer)

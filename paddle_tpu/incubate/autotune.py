@@ -1,0 +1,54 @@
+"""paddle.incubate.autotune — runtime tuning switches.
+
+Reference parity: ``python/paddle/incubate/autotune.py`` (``set_config``
+accepting {"kernel": {...}, "layout": {...}, "dataloader": {...}}, backed
+by phi's autotune cache ``paddle/phi/kernels/autotune/``). On TPU the
+kernel-level search belongs to XLA's autotuner, so "kernel" maps to the
+Pallas attention dispatch (block-size selection is static today;
+enable=False routes attention off the Pallas kernel entirely), "layout"
+is a no-op acknowledgment (XLA owns layout assignment), and "dataloader"
+tunes DataLoader prefetch depth.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional, Union
+
+__all__ = ["set_config", "autotune_status"]
+
+_status = {
+    "kernel": {"enable": True},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False, "tuning_steps": 25},
+}
+
+
+def set_config(config: Optional[Union[dict, str]] = None) -> None:
+    """Enable/disable autotune domains. ``config`` is a dict or a path to
+    a JSON file; ``None`` enables everything (reference behavior)."""
+    global _status
+    if config is None:
+        _status["kernel"]["enable"] = True
+        _status["layout"]["enable"] = True
+        _status["dataloader"]["enable"] = True
+    else:
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise TypeError("set_config expects None, a dict, or a JSON path")
+        for domain in ("kernel", "layout", "dataloader"):
+            if domain in config:
+                if not isinstance(config[domain], dict):
+                    raise TypeError(f"autotune config[{domain!r}] must be "
+                                    "a dict")
+                _status[domain].update(config[domain])
+
+    from ..nn.functional import attention as _attn
+
+    _attn.pallas_flash_enabled = bool(_status["kernel"]["enable"])
+
+
+def autotune_status() -> dict:
+    """Snapshot of the current autotune configuration."""
+    return json.loads(json.dumps(_status))

@@ -1,0 +1,12 @@
+"""paddle.incubate.optimizer — LookAhead, ModelAverage, LBFGS.
+
+Reference parity: ``python/paddle/incubate/optimizer/`` (lookahead.py:25,
+modelaverage.py:27, lbfgs.py + line_search_dygraph.py). All three are
+host-driven wrappers over the eager tape; the per-step math is jnp, so
+the slow/fast interpolation and window averaging stay on-device.
+"""
+from .lookahead import LookAhead  # noqa: F401
+from .modelaverage import ModelAverage  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS"]

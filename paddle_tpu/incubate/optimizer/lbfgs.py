@@ -1,0 +1,227 @@
+"""L-BFGS full-batch optimizer with optional strong-Wolfe line search.
+
+Reference parity: ``python/paddle/incubate/optimizer/lbfgs.py`` +
+``line_search_dygraph.py`` (``step(closure)`` quasi-Newton loop with
+``history_size`` curvature pairs and two-loop recursion). Host-driven:
+the closure re-evaluates loss+grads eagerly; direction/line-search math
+runs on flattened fp32 vectors.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd import no_grad
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 max_eval: Optional[int] = None, tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9, history_size: int = 100,
+                 line_search_fn: Optional[str] = None, parameters=None,
+                 weight_decay=None, grad_clip=None, name: str = None):
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List[np.ndarray] = []  # param deltas
+        self._y: List[np.ndarray] = []  # grad deltas
+        self._prev_flat_grad: Optional[np.ndarray] = None
+
+    # -- flat view over the parameter list ----------------------------------
+    def _params(self):
+        return [p for p in (self._parameter_list or [])
+                if not p.stop_gradient]
+
+    def _gather_flat_grad(self) -> np.ndarray:
+        out = []
+        for p in self._params():
+            g = p.grad
+            gv = (np.zeros(p._value.size, np.float64) if g is None
+                  else np.asarray(g._value, np.float64).ravel())
+            out.append(gv)
+        return np.concatenate(out)
+
+    def _gather_flat_params(self) -> np.ndarray:
+        return np.concatenate([np.asarray(p._value, np.float64).ravel()
+                               for p in self._params()])
+
+    def _set_flat_params(self, flat: np.ndarray) -> None:
+        i = 0
+        for p in self._params():
+            n = int(np.prod(p._value.shape)) if p._value.shape else 1
+            chunk = flat[i:i + n].reshape(p._value.shape)
+            p._set_value(jnp.asarray(chunk, p._value.dtype))
+            i += n
+
+    def _directional_evaluate(self, closure, x: np.ndarray, t: float,
+                              d: np.ndarray):
+        self._set_flat_params(x + t * d)
+        loss = float(closure().numpy())
+        g = self._gather_flat_grad()
+        return loss, g
+
+    # -- two-loop recursion --------------------------------------------------
+    def _direction(self, g: np.ndarray) -> np.ndarray:
+        if not self._s:
+            return -g
+        q = g.copy()
+        alphas = []
+        rhos = [1.0 / max(float(y @ s), 1e-10)
+                for s, y in zip(self._s, self._y)]
+        for s, y, rho in zip(reversed(self._s), reversed(self._y),
+                             reversed(rhos)):
+            a = rho * (s @ q)
+            alphas.append(a)
+            q -= a * y
+        y_last, s_last = self._y[-1], self._s[-1]
+        gamma = float(s_last @ y_last) / max(float(y_last @ y_last), 1e-10)
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(self._s, self._y, rhos),
+                                  reversed(alphas)):
+            b = rho * (y @ r)
+            r += (a - b) * s
+        return -r
+
+    # -- strong-Wolfe line search (cubic interpolation, torch-style) --------
+    def _strong_wolfe(self, closure, x, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        d_norm = np.abs(d).max()
+        g = g.copy()
+        f_prev, g_prev, t_prev = f, g, 0.0
+        done = False
+        ls_iter = 0
+        f_new, g_new = self._directional_evaluate(closure, x, t, d)
+        gtd_new = float(g_new @ d)
+        # bracket phase
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                bracket = [t, t]
+                bracket_f = [f_new, f_new]
+                bracket_g = [g_new, g_new]
+                done = True
+                break
+            if gtd_new >= 0:
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                break
+            min_step = t + 0.01 * (t - t_prev)
+            max_step = t * 10
+            tmp = t
+            t = min(max(2 * t, min_step), max_step)
+            t_prev = tmp
+            f_prev, g_prev = f_new, g_new.copy()
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+        else:
+            bracket = [0.0, t]
+            bracket_f = [f, f_new]
+            bracket_g = [g, g_new]
+
+        # zoom phase: bisection (robust; cubic adds little on our scales)
+        while not done and ls_iter < max_ls:
+            lo, hi = (0, 1) if bracket_f[0] <= bracket_f[1] else (1, 0)
+            if abs(bracket[1] - bracket[0]) * d_norm < self.tolerance_change:
+                break
+            t = 0.5 * (bracket[0] + bracket[1])
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+            if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[lo]:
+                bracket[hi] = t
+                bracket_f[hi] = f_new
+                bracket_g[hi] = g_new.copy()
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    done = True
+                elif gtd_new * (bracket[hi] - bracket[lo]) >= 0:
+                    bracket[hi] = bracket[lo]
+                    bracket_f[hi] = bracket_f[lo]
+                    bracket_g[hi] = bracket_g[lo]
+                bracket[lo] = t
+                bracket_f[lo] = f_new
+                bracket_g[lo] = g_new.copy()
+        lo = 0 if bracket_f[0] <= bracket_f[1] else 1
+        return bracket_f[lo], bracket_g[lo], bracket[lo]
+
+    @no_grad()
+    def step(self, closure: Callable):
+        """One L-BFGS outer step. ``closure`` must zero grads, compute the
+        loss, call backward, and return the loss Tensor."""
+        with np.errstate(all="ignore"):
+            return self._step_impl(closure)
+
+    def _step_impl(self, closure):
+        import paddle_tpu as _paddle  # lazy: avoid import cycle
+
+        def eval_closure():
+            self.clear_grad()
+            with _paddle.autograd.enable_grad():
+                loss = closure()
+            return loss
+
+        loss = eval_closure()
+        orig_loss = loss
+        f = float(loss.numpy())
+        g = self._gather_flat_grad()
+        if np.abs(g).max() <= self.tolerance_grad:
+            return orig_loss
+        n_eval = 1
+
+        for _ in range(self.max_iter):
+            d = self._direction(g)
+            gtd = float(g @ d)
+            if gtd > -self.tolerance_change:
+                break
+            t = (min(1.0, 1.0 / max(np.abs(g).sum(), 1e-10)) * self.get_lr()
+                 if not self._s else self.get_lr())
+            x = self._gather_flat_params()
+            if self.line_search_fn == "strong_wolfe":
+                f_new, g_new, t = self._strong_wolfe(
+                    eval_closure, x, t, d, f, g, gtd)
+                self._set_flat_params(x + t * d)
+                n_eval += 1
+            else:
+                self._set_flat_params(x + t * d)
+                loss_new = eval_closure()
+                f_new = float(loss_new.numpy())
+                g_new = self._gather_flat_grad()
+                n_eval += 1
+            s = (self._gather_flat_params() - x)
+            y = g_new - g
+            if float(y @ s) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            delta_f = abs(f_new - f)
+            f, g = f_new, g_new
+            if np.abs(g).max() <= self.tolerance_grad:
+                break
+            if delta_f < self.tolerance_change:
+                break
+            if n_eval >= self.max_eval:
+                break
+        return orig_loss

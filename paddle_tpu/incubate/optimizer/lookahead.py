@@ -1,0 +1,78 @@
+"""LookAhead optimizer ("k steps forward, 1 step back", Zhang et al. 2019).
+
+Reference parity: ``python/paddle/incubate/optimizer/lookahead.py:25`` —
+wraps an inner optimizer; every ``k`` inner steps the slow weights move
+``alpha`` of the way toward the fast weights and the fast weights reset
+to the slow ones.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name: str = None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be within [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        super().__init__(
+            learning_rate=self.alpha,
+            parameters=inner_optimizer._parameter_list,
+            name=name)
+        self._slow: dict = {}  # param uid -> slow weights (jax array)
+        self._k_step = 0
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_step += 1
+        if self._k_step % self.k != 0:
+            return
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(p._uid)
+            if slow is None:
+                # first sync point: slow weights start at the fast weights
+                # as they were *before* this round began is unobservable
+                # here, so reference-style: initialize from current value
+                slow = p._value
+            new_slow = slow + self.alpha * (p._value - slow)
+            self._slow[p._uid] = new_slow
+            p._set_value(jnp.asarray(new_slow, p._value.dtype))
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def state_dict(self) -> dict:
+        sd = {"inner": self.inner_optimizer.state_dict(),
+              "k_step": self._k_step,
+              "slow": {uid: v for uid, v in self._slow.items()}}
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        self.inner_optimizer.set_state_dict(state_dict["inner"])
+        self._k_step = int(state_dict.get("k_step", 0))
+        self._slow = {uid: jnp.asarray(v)
+                      for uid, v in state_dict.get("slow", {}).items()}

@@ -1,0 +1,103 @@
+"""ModelAverage — evaluate with a sliding-window average of parameters.
+
+Reference parity: ``python/paddle/incubate/optimizer/modelaverage.py:27``
+(the ``average_accumulates`` op's window bookkeeping: cumulative sums
+num_accumulates / old_num_accumulates and sum_1 / sum_2 / sum_3, window
+restart when ``max_average_window`` is exceeded). ``step()`` accumulates
+the current parameter values; ``apply()`` swaps in the window average
+(a context manager that restores on exit unless ``need_restore=False``);
+``restore()`` puts the trained weights back.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["ModelAverage"]
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name: str = None):
+        super().__init__(learning_rate=0.0, parameters=parameters, name=name)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        # per-param window state, mirroring average_accumulates:
+        #  sum_1: sum inside the live window
+        #  sum_2: sum of the previous (restarted) window
+        #  sum_3: sum of windows before that
+        self._state: dict = {}
+        self._backup: dict = {}
+
+    def _param_state(self, p):
+        st = self._state.get(p._uid)
+        if st is None:
+            z = jnp.zeros_like(p._value)
+            st = {"sum_1": z, "sum_2": z, "sum_3": z,
+                  "num_accumulates": 0, "old_num_accumulates": 0,
+                  "num_updates": 0}
+            self._state[p._uid] = st
+        return st
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current parameter values into the window."""
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            st = self._param_state(p)
+            st["sum_1"] = st["sum_1"] + p._value
+            st["num_accumulates"] += 1
+            st["num_updates"] += 1
+            window = max(
+                self.min_average_window,
+                min(self.max_average_window,
+                    int(self.average_window * st["num_updates"])))
+            if st["num_accumulates"] >= window:
+                # restart the live window: demote sums one level
+                st["sum_3"] = st["sum_2"]
+                st["sum_2"] = st["sum_1"]
+                st["sum_1"] = jnp.zeros_like(p._value)
+                st["old_num_accumulates"] = (st["num_accumulates"]
+                                             + st["old_num_accumulates"])
+                st["num_accumulates"] = 0
+
+    def _average_value(self, p):
+        st = self._param_state(p)
+        total = st["num_accumulates"] + st["old_num_accumulates"]
+        if total == 0:
+            return p._value
+        s = st["sum_1"] + st["sum_2"] + st["sum_3"]
+        return (s / total).astype(p._value.dtype)
+
+    @contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap the window-averaged weights in (and back out on exit)."""
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            self._backup[p._uid] = p._value
+            p._set_value(self._average_value(p))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    @no_grad()
+    def restore(self, executor=None):
+        """Restore the pre-``apply`` weights."""
+        for p in self._parameter_list or []:
+            if p._uid in self._backup:
+                p._set_value(self._backup.pop(p._uid))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return [], []

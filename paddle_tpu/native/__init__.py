@@ -1,0 +1,66 @@
+"""Native (C++) runtime components and their build/load machinery.
+
+The reference keeps its runtime core in C++ (store/rpc/PS tables under
+``paddle/fluid/distributed``, ``paddle/phi/core/distributed/store``);
+here the native pieces are compiled on first use with the in-image
+toolchain (g++) into ``paddle_tpu/native/lib`` and bound via ctypes —
+this image has no pybind11, and ctypes keeps the ABI surface explicit.
+
+``load_library("tcp_store")`` compiles ``src/tcp_store.cc`` (if the .so
+is missing or older than the source) and returns a ``ctypes.CDLL``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_LIB = os.path.join(_HERE, "lib")
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build(name: str, src_path: str, out_path: str) -> None:
+    os.makedirs(_LIB, exist_ok=True)
+    # Build into a temp file then atomically rename, so concurrent
+    # processes never dlopen a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src_path, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build of {name} failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen the native component ``name``."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src_path = os.path.join(_SRC, f"{name}.cc")
+        if not os.path.exists(src_path):
+            raise FileNotFoundError(f"no native source for '{name}' "
+                                    f"({src_path})")
+        out_path = os.path.join(_LIB, f"lib{name}.so")
+        if (not os.path.exists(out_path)
+                or os.path.getmtime(out_path) < os.path.getmtime(src_path)):
+            _build(name, src_path, out_path)
+        lib = ctypes.CDLL(out_path)
+        _cache[name] = lib
+        return lib

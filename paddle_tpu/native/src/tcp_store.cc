@@ -1,0 +1,380 @@
+// TCP key-value rendezvous store — native core.
+//
+// Reference parity: paddle/phi/core/distributed/store/tcp_store.h:120 and
+// tcp_utils.cc (the C++ TCPStore used for comm bootstrap, rpc rendezvous
+// and barriers).  This is an original TPU-framework implementation: a
+// thread-per-connection blocking server over a mutex+condvar KV map, with
+// WAIT parking on the condvar instead of the reference's callback queue.
+//
+// Exposed as a plain C ABI (no pybind11 in this image) — Python binds via
+// ctypes (paddle_tpu/native/tcp_store.py).
+//
+// Wire protocol (all integers little-endian):
+//   request:  u8 op | u32 key_len | key bytes | payload
+//     op=1 SET   payload: u64 val_len | val bytes
+//     op=2 GET   payload: f64 timeout_s          (blocks until key exists)
+//     op=3 ADD   payload: i64 delta              (atomic add on decimal value)
+//     op=4 WAIT  payload: f64 timeout_s          (blocks until key exists)
+//     op=5 CHECK payload: none                   (non-blocking existence)
+//   response: u8 status (0 ok, 1 timeout/missing) | u64 len | bytes
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct KVState {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> map;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, uint8_t status, const std::string& body) {
+  uint64_t len = body.size();
+  std::string out;
+  out.reserve(1 + 8 + body.size());
+  out.push_back(static_cast<char>(status));
+  out.append(reinterpret_cast<const char*>(&len), 8);
+  out.append(body);
+  return write_full(fd, out.data(), out.size());
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port), stop_(false) {}
+
+  // Returns 0 on success, -1 when the listen socket could not be bound.
+  int Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd_);
+      return -1;
+    }
+    if (::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      return -1;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return 0;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    // Unblock accept() by connecting to ourselves, then close.
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0) {
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      addr.sin_port = htons(static_cast<uint16_t>(port_));
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+      ::close(fd);
+    }
+    kv_.cv.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    ::close(listen_fd_);
+    std::lock_guard<std::mutex> g(workers_mu_);
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0 || stop_.load()) {
+        if (fd >= 0) ::close(fd);
+        if (stop_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(workers_mu_);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_.load()) {
+      uint8_t op;
+      uint32_t key_len;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &key_len, 4)) break;
+      if (key_len > (1u << 20)) break;  // malformed
+      std::string key(key_len, '\0');
+      if (!read_full(fd, key.data(), key_len)) break;
+      bool ok = true;
+      switch (op) {
+        case 1: {  // SET
+          uint64_t vlen;
+          if (!read_full(fd, &vlen, 8) || vlen > (1ull << 32)) {
+            ok = false;
+            break;
+          }
+          std::string val(vlen, '\0');
+          if (!read_full(fd, val.data(), vlen)) {
+            ok = false;
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> g(kv_.mu);
+            kv_.map[key] = std::move(val);
+          }
+          kv_.cv.notify_all();
+          ok = send_reply(fd, 0, "");
+          break;
+        }
+        case 2:    // GET (blocking)
+        case 4: {  // WAIT
+          double timeout_s;
+          if (!read_full(fd, &timeout_s, 8)) {
+            ok = false;
+            break;
+          }
+          std::unique_lock<std::mutex> lk(kv_.mu);
+          auto pred = [&] {
+            return stop_.load() || kv_.map.count(key) > 0;
+          };
+          bool found;
+          if (timeout_s <= 0) {
+            kv_.cv.wait(lk, pred);
+            found = kv_.map.count(key) > 0;
+          } else {
+            found = kv_.cv.wait_for(
+                lk, std::chrono::duration<double>(timeout_s), pred);
+            found = found && kv_.map.count(key) > 0;
+          }
+          if (!found) {
+            lk.unlock();
+            ok = send_reply(fd, 1, "");
+          } else {
+            std::string val = (op == 2) ? kv_.map[key] : "";
+            lk.unlock();
+            ok = send_reply(fd, 0, val);
+          }
+          break;
+        }
+        case 3: {  // ADD
+          int64_t delta;
+          if (!read_full(fd, &delta, 8)) {
+            ok = false;
+            break;
+          }
+          int64_t next;
+          {
+            std::lock_guard<std::mutex> g(kv_.mu);
+            auto it = kv_.map.find(key);
+            int64_t cur =
+                (it == kv_.map.end()) ? 0 : std::strtoll(it->second.c_str(),
+                                                         nullptr, 10);
+            next = cur + delta;
+            kv_.map[key] = std::to_string(next);
+          }
+          kv_.cv.notify_all();
+          ok = send_reply(fd, 0, std::to_string(next));
+          break;
+        }
+        case 5: {  // CHECK
+          bool present;
+          {
+            std::lock_guard<std::mutex> g(kv_.mu);
+            present = kv_.map.count(key) > 0;
+          }
+          ok = send_reply(fd, present ? 0 : 1, "");
+          break;
+        }
+        default:
+          ok = false;
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stop_;
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  KVState kv_;
+};
+
+class StoreClient {
+ public:
+  // Returns nullptr-equivalent failure via Connect() == false.
+  bool Connect(const char* ip, int port, double timeout_s) {
+    auto deadline =
+        std::chrono::steady_clock::now() +
+        std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            std::chrono::duration<double>(timeout_s));
+    while (true) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd_ < 0) return false;
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+        ::close(fd_);
+        return false;
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    }
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  // status: 0 ok, 1 timeout/missing, -1 transport error.
+  int Request(uint8_t op, const std::string& key, const std::string& payload,
+              std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string req;
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    req.push_back(static_cast<char>(op));
+    req.append(reinterpret_cast<const char*>(&klen), 4);
+    req.append(key);
+    req.append(payload);
+    if (!write_full(fd_, req.data(), req.size())) return -1;
+    uint8_t status;
+    uint64_t len;
+    if (!read_full(fd_, &status, 1) || !read_full(fd_, &len, 8)) return -1;
+    out->resize(len);
+    if (len > 0 && !read_full(fd_, out->data(), len)) return -1;
+    return status;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;  // serialize request/response pairs across threads
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pd_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (s->Start() != 0) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void pd_store_server_stop(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  s->Stop();
+  delete s;
+}
+
+void* pd_store_client_connect(const char* ip, int port, double timeout_s) {
+  auto* c = new StoreClient();
+  if (!c->Connect(ip, port, timeout_s)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pd_store_client_free(void* h) { delete static_cast<StoreClient*>(h); }
+
+int pd_store_set(void* h, const char* key, const uint8_t* data, int64_t len) {
+  std::string payload;
+  uint64_t l = static_cast<uint64_t>(len);
+  payload.append(reinterpret_cast<const char*>(&l), 8);
+  payload.append(reinterpret_cast<const char*>(data), len);
+  std::string out;
+  return static_cast<StoreClient*>(h)->Request(1, key, payload, &out);
+}
+
+// Returns value length (>=0) and malloc'd buffer in *out on success;
+// -1 transport error; -2 timeout.
+int64_t pd_store_get(void* h, const char* key, double timeout_s,
+                     uint8_t** out) {
+  std::string payload(reinterpret_cast<const char*>(&timeout_s), 8);
+  std::string val;
+  int st = static_cast<StoreClient*>(h)->Request(2, key, payload, &val);
+  if (st == -1) return -1;
+  if (st == 1) return -2;
+  *out = static_cast<uint8_t*>(::malloc(val.size() ? val.size() : 1));
+  std::memcpy(*out, val.data(), val.size());
+  return static_cast<int64_t>(val.size());
+}
+
+void pd_store_free_buf(uint8_t* p) { ::free(p); }
+
+// Returns new value after add, INT64_MIN on error.
+int64_t pd_store_add(void* h, const char* key, int64_t delta) {
+  std::string payload(reinterpret_cast<const char*>(&delta), 8);
+  std::string out;
+  int st = static_cast<StoreClient*>(h)->Request(3, key, payload, &out);
+  if (st != 0) return INT64_MIN;
+  return std::strtoll(out.c_str(), nullptr, 10);
+}
+
+// 0 = key present before deadline, 1 = timeout, -1 = transport error.
+int pd_store_wait(void* h, const char* key, double timeout_s) {
+  std::string payload(reinterpret_cast<const char*>(&timeout_s), 8);
+  std::string out;
+  return static_cast<StoreClient*>(h)->Request(4, key, payload, &out);
+}
+
+int pd_store_check(void* h, const char* key) {
+  std::string out;
+  return static_cast<StoreClient*>(h)->Request(5, key, "", &out);
+}
+
+}  // extern "C"

@@ -54,6 +54,11 @@ def _use_pallas(q_value) -> bool:
     if not pallas_flash_enabled:
         return False
     try:
+        if isinstance(q_value, jax.core.Tracer):
+            # inside a jit trace there is no concrete device; the trace
+            # compiles for the default backend (this is the hot path —
+            # every StaticFunction train step traces through here)
+            return jax.default_backend() == "tpu"
         dev = list(q_value.devices())[0]
         return dev.platform == "tpu"
     except Exception:
@@ -72,12 +77,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
         rng_key = default_generator.next_key()
 
-    if (
-        attn_mask is None
-        and drop == 0.0
-        and not isinstance(query._value, jax.core.Tracer)
-        and _use_pallas(query._value)
-    ):
+    if attn_mask is None and drop == 0.0 and _use_pallas(query._value):
         from ...ops.pallas import flash_attention as fa
 
         def fn(q, k, v):

@@ -83,30 +83,45 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.full_like(m_ref[...], neg_inf)
         l_ref[...] = jnp.zeros_like(l_ref[...])
 
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+    # causal: skip k-blocks entirely above the diagonal (the grid is
+    # rectangular, so roughly half the blocks are dead weight otherwise)
+    needed = (qi * block_q + (block_q - 1) + (seq_k - seq_q)
+              >= ki * block_k) if causal else (ki >= 0)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = k_pos < seq_k  # padded keys
-    if causal:
-        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
-    s = jnp.where(mask, s, neg_inf)
+    @pl.when(needed)
+    def _body():
+        # bf16 inputs + fp32 accumulation: the MXU's native mode. Casting
+        # inputs up to f32 first would fall off the fast path entirely.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
 
-    m_prev = m_ref[...]  # [bq, 128] replicated
-    l_prev = l_ref[...]
-    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
-    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-    alpha = jnp.exp(m_prev - m_new)  # [bq, 128]
-    p = jnp.exp(s - m_new[:, :1])  # [bq, bk]
-    l_new = alpha * l_prev + jnp.broadcast_to(
-        jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
-    v = v_ref[0].astype(jnp.float32)  # [bk, d]
-    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # [bq, d]
-    acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
-    m_ref[...] = m_new
-    l_ref[...] = l_new
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_k  # padded keys
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+        s = jnp.where(mask, s, neg_inf)
+
+        m_prev = m_ref[...]  # [bq, 128] replicated
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 128]
+        p = jnp.exp(s - m_new[:, :1])  # [bq, bk]
+        l_new = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        v = v_ref[0]  # [bk, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, d]
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+        m_ref[...] = m_new
+        l_ref[...] = l_new
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -174,25 +189,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc[...])
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]   # [bq, 1]
-    dlt = dlt_ref[0][:, :1]   # [bq, 1]
+    needed = (qi * block_q + (block_q - 1) + (seq_k - seq_q)
+              >= ki * block_k) if causal else (ki >= 0)
 
-    s = jax.lax.dot_general(q * jnp.float32(scale), k,
-                            (((1,), (1,)), ((), ())))  # [bq, bk]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = k_pos < seq_k
-    if causal:
-        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+    @pl.when(needed)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]   # [bq, 1]
+        dlt = dlt_ref[0][:, :1]   # [bq, 1]
 
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
-    ds = p * (dp - dlt)
-    dq_acc[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_k
+        if causal:
+            mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = (p * (dp - dlt)).astype(k.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -211,28 +238,43 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc[...])
         dv_acc[...] = jnp.zeros_like(dv_acc[...])
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    dlt = dlt_ref[0][:, :1]
+    needed = (qi * block_q + (block_q - 1) + (seq_k - seq_q)
+              >= kj * block_k) if causal else (qi >= 0)
 
-    s = jax.lax.dot_general(q * jnp.float32(scale), k,
-                            (((1,), (1,)), ((), ())))  # [bq, bk]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    # padded q rows must not contribute to dk/dv sums
-    mask = (k_pos < seq_k) & (q_pos < seq_q)
-    if causal:
-        mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+    @pl.when(needed)
+    def _body():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        dlt = dlt_ref[0][:, :1]
 
-    # dv += pᵀ · do : contract the bq dim
-    dv_acc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
-    ds = p * (dp - dlt)
-    dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # padded q rows must not contribute to dk/dv sums
+        mask = (k_pos < seq_k) & (q_pos < seq_q)
+        if causal:
+            mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
+        pl_ = p.astype(do.dtype)
+
+        # dv += pᵀ · do : contract the bq dim
+        dv_acc[...] += jax.lax.dot_general(
+            pl_, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = (p * (dp - dlt)).astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finish():

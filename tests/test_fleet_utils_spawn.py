@@ -1,0 +1,108 @@
+"""fleet.utils (LocalFS/HDFSClient/logger) + distributed.spawn."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import utils as fleet_utils
+from paddle_tpu.distributed.fleet.utils import (
+    ExecuteError, FSFileExistsError, FSFileNotExistsError, HDFSClient,
+    LocalFS,
+)
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    root = str(tmp_path)
+    d = os.path.join(root, "sub", "dir")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "a.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(f, exist_ok=False)
+    with open(f, "w") as fh:
+        fh.write("payload")
+    assert fs.cat(f) == "payload"
+
+    dirs, files = fs.ls_dir(d)
+    assert files == ["a.txt"] and dirs == []
+    assert fs.list_dirs(os.path.join(root, "sub")) == ["dir"]
+
+    dst = os.path.join(d, "b.txt")
+    fs.mv(f, dst)
+    assert fs.is_file(dst) and not fs.is_exist(f)
+    with pytest.raises(FSFileNotExistsError):
+        fs.mv(os.path.join(d, "nope"), os.path.join(d, "x"))
+
+    fs.upload(dst, os.path.join(root, "copy.txt"))
+    assert fs.cat(os.path.join(root, "copy.txt")) == "payload"
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert fs.need_upload_download() is False
+
+
+def test_hdfs_client_without_hadoop_binary():
+    client = HDFSClient(hadoop_home="/nonexistent/hadoop")
+    with pytest.raises(ExecuteError, match="not found"):
+        client.mkdirs("/tmp/x")
+    assert client.need_upload_download() is True
+    # existence probes swallow ExecuteError into False (reference contract)
+    assert client.is_exist("/tmp/x") is False
+
+
+def test_get_logger_rank_prefixed(capsys):
+    lg = fleet_utils.get_logger(name="FleetLogTest")
+    lg.info("hello fleet")
+    err = capsys.readouterr().err
+    assert "hello fleet" in err and "[rank 0]" in err
+
+
+def test_broadcast_helpers_no_mesh_noop():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    lin = nn.Linear(2, 2)
+    before = np.asarray(lin.weight._value).copy()
+    fleet_utils.broadcast_mp_parameters(lin)
+    fleet_utils.broadcast_dp_parameters(lin)
+    fleet_utils.fused_allreduce_gradients(list(lin.parameters()))
+    np.testing.assert_array_equal(np.asarray(lin.weight._value), before)
+
+
+def _spawn_target(scale):
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert os.environ["PADDLE_MASTER_ENDPOINT"]
+    return (rank + 1) * scale + n
+
+
+def _spawn_failer():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 1:
+        raise RuntimeError("rank1 exploded")
+    return rank
+
+
+def test_spawn_runs_and_collects_results():
+    from paddle_tpu.distributed import spawn
+
+    ctx = spawn(_spawn_target, args=(10,), nprocs=2)
+    results = ctx.results()
+    assert results == {0: 12, 1: 22}
+
+
+def test_spawn_propagates_worker_error():
+    from paddle_tpu.distributed import spawn
+
+    with pytest.raises(RuntimeError, match="rank1 exploded"):
+        spawn(_spawn_failer, nprocs=2)
+
+
+def test_spawn_validates_nprocs():
+    from paddle_tpu.distributed import spawn
+
+    with pytest.raises(ValueError):
+        spawn(_spawn_target, args=(1,), nprocs=0)

@@ -1,0 +1,208 @@
+"""incubate.asp (n:m sparsity) + incubate.optimizer (LookAhead/ModelAverage/
+LBFGS) + incubate.autotune.
+
+Reference test models: test_asp_pruning_*.py, test_lookahead.py,
+test_modelaverage.py, test_lbfgs.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+# ---------------------------------------------------------------- asp utils
+
+
+def test_mask_1d_pattern_and_check():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    mask = asp.get_mask_1d(w, 2, 4)
+    assert mask.shape == w.shape
+    groups = mask.reshape(-1, 4)
+    np.testing.assert_array_equal(groups.sum(axis=1), 2)
+    # kept entries are the two largest |w| of each group
+    wg = np.abs(w.reshape(-1, 4))
+    for g in range(wg.shape[0]):
+        kept = np.sort(np.nonzero(groups[g])[0])
+        top2 = np.sort(np.argsort(-wg[g], kind="stable")[:2])
+        np.testing.assert_array_equal(kept, top2)
+    assert asp.check_mask_1d(w * mask, 2, 4)
+    assert not asp.check_mask_1d(np.ones((4, 8)), 2, 4)
+    assert asp.calculate_density(mask) == pytest.approx(0.5)
+
+
+def test_mask_1d_non_multiple_width():
+    w = np.arange(1, 15, dtype=np.float32).reshape(2, 7)
+    mask = asp.get_mask_1d(w, 2, 4)
+    assert mask.shape == (2, 7)
+    assert asp.check_mask_1d(w * mask, 2, 4)
+
+
+@pytest.mark.parametrize("algo", [asp.get_mask_2d_greedy, asp.get_mask_2d_best])
+def test_mask_2d_row_and_col_budget(algo):
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((8, 8)).astype(np.float32)
+    mask = algo(w, 2, 4)
+    assert asp.check_mask_2d(mask, 2, 4)
+    # every 4x4 tile: exactly-n rows/cols for best, <=n for greedy
+    tiles = mask.reshape(2, 4, 2, 4).transpose(0, 2, 1, 3).reshape(-1, 4, 4)
+    assert np.all(tiles.sum(axis=2) <= 2) and np.all(tiles.sum(axis=1) <= 2)
+
+
+def test_mask_2d_best_beats_or_ties_greedy():
+    rng = np.random.default_rng(2)
+    for _ in range(5):
+        w = rng.standard_normal((4, 4)).astype(np.float32)
+        kept_greedy = np.abs(w * asp.get_mask_2d_greedy(w, 2, 4)).sum()
+        kept_best = np.abs(w * asp.get_mask_2d_best(w, 2, 4)).sum()
+        assert kept_best >= kept_greedy - 1e-6
+
+
+# ---------------------------------------------------------------- asp flow
+
+
+class _TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def test_prune_model_and_decorated_optimizer_keep_sparsity():
+    paddle.seed(0)
+    asp.reset_excluded_layers()
+    model = _TinyNet()
+    masks = asp.prune_model(model, n=2, m=4, mask_algo="mask_1d")
+    assert set(masks) == {"fc1.weight", "fc2.weight"}
+    # pruned along the input dim: columns of W ([in, out]) in m-groups
+    w1 = np.asarray(model.fc1.weight._value)
+    assert asp.check_mask_1d(w1.T, 2, 4)
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+        (4, 16)).astype(np.float32))
+    for _ in range(3):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.ASPHelper.check_model_sparsity(model)
+    w1 = np.asarray(model.fc1.weight._value)
+    assert asp.check_mask_1d(w1.T, 2, 4)
+    asp.ASPHelper._masks.clear()
+
+
+def test_set_excluded_layers():
+    paddle.seed(0)
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers(["fc2.weight"])
+    model = _TinyNet()
+    masks = asp.prune_model(model, n=2, m=4)
+    assert "fc2.weight" not in masks and "fc1.weight" in masks
+    asp.reset_excluded_layers()
+    asp.ASPHelper._masks.clear()
+
+
+# ------------------------------------------------------------ incubate.opt
+
+
+def test_lookahead_slow_fast_interpolation():
+    paddle.seed(1)
+    lin = nn.Linear(4, 4, bias_attr=False)
+    w0 = np.asarray(lin.weight._value).copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.eye(4, dtype=np.float32))
+
+    manual_fast = w0.copy()
+    manual_slow = None
+    for step in range(1, 5):
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        g = np.asarray(lin.weight.grad._value)
+        opt.step()
+        opt.clear_grad()
+        manual_fast = manual_fast - 0.1 * g
+        if step % 2 == 0:
+            if manual_slow is None:
+                manual_slow = manual_fast.copy()  # first sync inits at fast
+            else:
+                manual_slow = manual_slow + 0.5 * (manual_fast - manual_slow)
+            manual_fast = manual_slow.copy()
+        np.testing.assert_allclose(np.asarray(lin.weight._value),
+                                   manual_fast, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, alpha=1.5)
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, k=0)
+
+
+def test_modelaverage_window_average_and_restore():
+    paddle.seed(2)
+    lin = nn.Linear(2, 2, bias_attr=False)
+    ma = paddle.incubate.ModelAverage(
+        1.0, parameters=lin.parameters(),
+        min_average_window=1000, max_average_window=1000)
+    vals = []
+    for i in range(4):
+        lin.weight._set_value(
+            paddle.to_tensor(np.full((2, 2), float(i), np.float32))._value)
+        ma.step()
+        vals.append(float(i))
+    trained = np.asarray(lin.weight._value).copy()
+    with ma.apply():
+        np.testing.assert_allclose(np.asarray(lin.weight._value),
+                                   np.mean(vals), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(lin.weight._value), trained)
+    # need_restore=False keeps the average until restore()
+    with ma.apply(need_restore=False):
+        pass
+    np.testing.assert_allclose(np.asarray(lin.weight._value), np.mean(vals),
+                               rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(lin.weight._value), trained)
+
+
+@pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+def test_lbfgs_converges_on_quadratic(line_search):
+    paddle.seed(3)
+    # min over W of ||W - A||^2 — strictly convex, LBFGS should nail it
+    target = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+    lin = nn.Linear(2, 2, bias_attr=False)
+    opt = paddle.incubate.LBFGS(learning_rate=1.0, max_iter=30,
+                                line_search_fn=line_search,
+                                parameters=lin.parameters())
+    tgt = paddle.to_tensor(target)
+
+    def closure():
+        loss = ((lin.weight - tgt) ** 2).sum()
+        loss.backward()  # the closure computes grads (reference contract)
+        return loss
+
+    for _ in range(3):
+        opt.step(closure)
+    np.testing.assert_allclose(np.asarray(lin.weight._value), target,
+                               rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ autotune
+
+
+def test_autotune_set_config_routes_kernel_switch():
+    from paddle_tpu.nn.functional import attention
+
+    paddle.incubate.set_config({"kernel": {"enable": False}})
+    assert attention.pallas_flash_enabled is False
+    assert paddle.incubate.autotune_status()["kernel"]["enable"] is False
+    paddle.incubate.set_config(None)  # enable everything
+    assert attention.pallas_flash_enabled is True
+    with pytest.raises(TypeError):
+        paddle.incubate.set_config(42)

@@ -1,0 +1,206 @@
+"""Parameter server: native table engine, sharded service, SparseEmbedding.
+
+Mirrors the reference PS test strategy (test_dist_fleet_ps*.py): numeric
+checks of the fused server-side optimizers against numpy references, then
+an end-to-end embedding train loop through the eager tape.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (
+    DenseTable, PSClient, PSServer, SparseEmbedding, SparseTable, TableConfig,
+)
+
+
+# ---------------------------------------------------------------- tables
+
+
+def test_sparse_table_deterministic_init_and_sgd():
+    cfg = TableConfig(dim=4, optimizer="sgd", learning_rate=0.5,
+                      init_range=0.1, seed=7)
+    t = SparseTable(cfg)
+    keys = np.array([3, 99, 3], dtype=np.uint64)
+    rows = t.pull(keys)
+    assert rows.shape == (3, 4)
+    assert np.all(np.abs(rows) <= 0.1)
+    np.testing.assert_array_equal(rows[0], rows[2])  # same key, same row
+    assert not np.allclose(rows[0], rows[1])
+    # second pull returns identical rows (persisted, not re-drawn)
+    np.testing.assert_array_equal(t.pull(keys), rows)
+    assert len(t) == 2
+
+    g = np.ones((2, 4), np.float32)
+    before = t.pull(np.array([3, 99], np.uint64))
+    t.push(np.array([3, 99], np.uint64), g)
+    after = t.pull(np.array([3, 99], np.uint64))
+    np.testing.assert_allclose(after, before - 0.5 * g, rtol=1e-6)
+
+
+def test_sparse_table_duplicate_keys_apply_sequentially():
+    t = SparseTable(TableConfig(dim=2, optimizer="sgd", learning_rate=1.0,
+                                init_range=0.0))
+    k = np.array([5, 5], np.uint64)
+    t.push(k, np.array([[1.0, 0.0], [0.0, 2.0]], np.float32))
+    row = t.pull(np.array([5], np.uint64))[0]
+    np.testing.assert_allclose(row, [-1.0, -2.0], rtol=1e-6)
+
+
+def test_sparse_table_adam_matches_numpy():
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+    t = SparseTable(TableConfig(dim=3, optimizer="adam", learning_rate=lr,
+                                beta1=b1, beta2=b2, epsilon=eps,
+                                init_range=0.0))
+    key = np.array([42], np.uint64)
+    w = t.pull(key)[0].astype(np.float64)
+    m = np.zeros(3)
+    v = np.zeros(3)
+    rng = np.random.default_rng(0)
+    for step in range(1, 6):
+        g = rng.standard_normal(3).astype(np.float32)
+        t.push(key, g[None])
+        gf = g.astype(np.float64)
+        m = b1 * m + (1 - b1) * gf
+        v = b2 * v + (1 - b2) * gf * gf
+        mh = m / (1 - b1 ** step)
+        vh = v / (1 - b2 ** step)
+        w = w - lr * mh / (np.sqrt(vh) + eps)
+    np.testing.assert_allclose(t.pull(key)[0], w, rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_table_adagrad_and_save_load(tmp_path):
+    cfg = TableConfig(dim=2, optimizer="adagrad", learning_rate=0.1,
+                      init_range=0.0)
+    t = SparseTable(cfg)
+    k = np.array([1, 2, 3], np.uint64)
+    t.push(k, np.ones((3, 2), np.float32))
+    expect = -0.1 * 1.0 / (np.sqrt(1.0) + cfg.epsilon)
+    np.testing.assert_allclose(t.pull(k), expect, rtol=1e-5)
+
+    path = str(tmp_path / "table.bin")
+    t.save(path)
+    t2 = SparseTable(cfg)
+    t2.load(path)
+    assert len(t2) == 3
+    np.testing.assert_array_equal(t2.pull(k), t.pull(k))
+    # optimizer slots survive: next identical push matches on both tables
+    t.push(k, np.ones((3, 2), np.float32))
+    t2.push(k, np.ones((3, 2), np.float32))
+    np.testing.assert_array_equal(t2.pull(k), t.pull(k))
+
+    bad = SparseTable(TableConfig(dim=3, optimizer="adagrad"))
+    with pytest.raises(IOError):
+        bad.load(path)  # dim mismatch
+
+
+def test_dense_table_set_pull_push():
+    t = DenseTable(6, TableConfig(optimizer="sgd", learning_rate=0.25))
+    init = np.arange(6, dtype=np.float32)
+    t.set(init)
+    np.testing.assert_array_equal(t.pull(), init)
+    t.push(np.ones(6, np.float32))
+    np.testing.assert_allclose(t.pull(), init - 0.25)
+
+
+# ---------------------------------------------------------------- service
+
+
+@pytest.fixture
+def two_servers():
+    servers = [PSServer(port=0), PSServer(port=0)]
+    client = PSClient([f"127.0.0.1:{s.port}" for s in servers])
+    yield client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def test_ps_service_sparse_sharded(two_servers):
+    client = two_servers
+    assert client.ping()
+    cfg = TableConfig(dim=4, optimizer="sgd", learning_rate=1.0,
+                      init_range=0.0, seed=1)
+    client.create_sparse_table(0, cfg)
+    keys = np.arange(100, dtype=np.uint64)
+    rows = client.pull_sparse(0, keys)
+    assert rows.shape == (100, 4)
+    np.testing.assert_array_equal(rows, 0.0)
+
+    grads = np.tile(np.arange(100, dtype=np.float32)[:, None], (1, 4))
+    client.push_sparse(0, keys, grads)
+    np.testing.assert_allclose(client.pull_sparse(0, keys), -grads)
+    assert client.sparse_size(0) == 100
+    # both shards actually hold keys (hash split)
+    sizes = client._call_all("sparse_size", 0)
+    assert all(s > 0 for s in sizes) and sum(sizes) == 100
+
+
+def test_ps_service_sparse_save_load(two_servers, tmp_path):
+    client = two_servers
+    cfg = TableConfig(dim=2, optimizer="sgd", learning_rate=1.0,
+                      init_range=0.05, seed=3)
+    client.create_sparse_table(7, cfg)
+    keys = np.arange(50, dtype=np.uint64)
+    client.push_sparse(7, keys, np.ones((50, 2), np.float32))
+    want = client.pull_sparse(7, keys)
+    prefix = str(tmp_path / "t7")
+    client.save_sparse(7, prefix)
+
+    servers2 = [PSServer(port=0), PSServer(port=0)]
+    client2 = PSClient([f"127.0.0.1:{s.port}" for s in servers2])
+    try:
+        client2.create_sparse_table(7, cfg)
+        client2.load_sparse(7, prefix)
+        np.testing.assert_array_equal(client2.pull_sparse(7, keys), want)
+    finally:
+        client2.close()
+        for s in servers2:
+            s.stop()
+
+
+def test_ps_service_dense(two_servers):
+    client = two_servers
+    init = np.linspace(0, 1, 8).astype(np.float32)
+    client.create_dense_table(1, 8, TableConfig(optimizer="sgd",
+                                                learning_rate=0.5),
+                              init=init)
+    np.testing.assert_array_equal(client.pull_dense(1), init)
+    client.push_dense(1, np.ones(8, np.float32))
+    np.testing.assert_allclose(client.pull_dense(1), init - 0.5)
+    client.set_dense(1, np.zeros(8, np.float32))
+    np.testing.assert_array_equal(client.pull_dense(1), 0.0)
+
+
+def test_ps_service_remote_error_travels(two_servers):
+    with pytest.raises(KeyError):
+        two_servers.pull_dense(12345)  # table never created
+
+
+# ---------------------------------------------------------------- layer
+
+
+def test_sparse_embedding_trains(two_servers):
+    import paddle_tpu as paddle
+
+    client = two_servers
+    emb = SparseEmbedding(client, table_id=3, embedding_dim=4,
+                          config=TableConfig(dim=4, optimizer="sgd",
+                                             learning_rate=0.5,
+                                             init_range=0.0, seed=2))
+    ids = np.array([[1, 2], [2, 9]], np.int64)
+    target = paddle.to_tensor(np.ones((2, 2, 4), np.float32))
+
+    losses = []
+    for _ in range(25):
+        out = emb(ids)
+        assert tuple(out.shape) == (2, 2, 4)
+        loss = ((out - target) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2, losses
+    # eval mode: no pushes, rows stay fixed
+    emb.eval()
+    before = client.pull_sparse(3, np.array([1, 2, 9], np.uint64))
+    out = emb(ids)
+    ((out - target) ** 2).mean().backward()
+    np.testing.assert_array_equal(
+        client.pull_sparse(3, np.array([1, 2, 9], np.uint64)), before)
