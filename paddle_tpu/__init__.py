@@ -25,6 +25,13 @@ from .dtypes import (  # noqa: F401
     bfloat16, bool_, complex64, complex128, float16, float32, float64,
     int8, int16, int32, int64, uint8,
 )
+from .framework.core_api import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, TPUPlace, batch,
+    check_shape, create_parameter, disable_signal_handler, dtype, finfo,
+    get_cuda_rng_state, get_default_dtype, iinfo, in_dynamic_mode,
+    is_grad_enabled, is_tensor, set_cuda_rng_state, set_default_dtype,
+    set_printoptions,
+)
 from .generator import default_generator, get_rng_state, seed, set_rng_state
 from .ops import *  # noqa: F401,F403
 from .tensor import Parameter, Tensor, to_tensor
@@ -39,6 +46,10 @@ from . import distributed  # noqa: E402
 from . import metric  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
+from .hapi import summary  # noqa: E402
+from .nn import ParamAttr  # noqa: E402
+from .distributed import DataParallel  # noqa: E402
+from .dtypes import bool_ as bool  # noqa: E402,A001 - reference name
 from . import vision  # noqa: E402
 from . import incubate  # noqa: E402
 from . import device  # noqa: E402
@@ -50,8 +61,16 @@ from . import quantization  # noqa: E402
 from . import static  # noqa: E402
 from . import audio  # noqa: E402
 from . import geometric  # noqa: E402
+from . import callbacks  # noqa: E402
 from . import hub  # noqa: E402
+from . import inference  # noqa: E402
+from . import linalg  # noqa: E402
 from . import onnx  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import utils  # noqa: E402
+from . import version  # noqa: E402
+from .utils.flops import flops  # noqa: E402
 from . import text  # noqa: E402
 from . import profiler  # noqa: E402
 from . import framework  # noqa: E402

@@ -1,0 +1,12 @@
+"""paddle.callbacks facade (reference: python/paddle/callbacks.py —
+re-exports the hapi callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping"]

@@ -1,0 +1,240 @@
+"""Top-level framework API tail: dtype metadata, places, globals.
+
+Reference parity: the remaining python/paddle/__init__.py entries that
+are neither tensor ops nor submodules — ``iinfo``/``finfo``
+(tensor/attribute), Place classes (fluid/core), ``get/set_default_dtype``
+(fluid/framework), ``is_tensor``/``is_grad_enabled``/``in_dynamic_mode``,
+``create_parameter`` (static.nn), ``set_printoptions``, ``LazyGuard``
+(fluid/dygraph), ``batch`` (the legacy reader batcher), and the CUDA RNG
+state aliases (meaningful here as the device generator's state).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "iinfo", "finfo", "dtype", "get_default_dtype", "set_default_dtype",
+    "is_tensor", "is_grad_enabled", "in_dynamic_mode", "CPUPlace",
+    "CUDAPlace", "CUDAPinnedPlace", "TPUPlace", "create_parameter",
+    "set_printoptions", "LazyGuard", "batch", "get_cuda_rng_state",
+    "set_cuda_rng_state", "disable_signal_handler", "check_shape",
+]
+
+
+# ------------------------------------------------------------ dtype meta
+
+
+def dtype(name):
+    """paddle.dtype — dtype constructor/alias (reference: the VarDesc
+    dtype enum exposed as ``paddle.dtype``)."""
+    from ..dtypes import convert_dtype
+
+    return convert_dtype(name)
+
+
+def iinfo(dt):
+    """Integer dtype limits (reference: paddle.iinfo → numpy-compatible)."""
+    from ..dtypes import convert_dtype
+
+    return np.iinfo(np.dtype(str(jnp.dtype(convert_dtype(dt)))))
+
+
+def finfo(dt):
+    """Floating dtype limits (works for bfloat16 via ml_dtypes)."""
+    from ..dtypes import convert_dtype
+
+    return jnp.finfo(convert_dtype(dt))
+
+
+_default_dtype = ["float32"]
+
+
+def get_default_dtype() -> str:
+    return _default_dtype[0]
+
+
+def set_default_dtype(d) -> None:
+    from ..dtypes import convert_dtype
+
+    name = str(jnp.dtype(convert_dtype(d)))
+    if name not in ("float16", "float32", "float64", "bfloat16"):
+        raise TypeError(f"set_default_dtype only accepts floating dtypes, "
+                        f"got {d!r}")
+    _default_dtype[0] = name
+
+
+# ------------------------------------------------------------ predicates
+
+
+def is_tensor(x) -> bool:
+    from ..tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def is_grad_enabled() -> bool:
+    from ..autograd import engine
+
+    return engine.is_grad_enabled()
+
+
+def in_dynamic_mode() -> bool:
+    """True outside a jit trace (reference: eager vs static mode). A
+    Tensor whose payload is a tracer means we are inside StaticFunction
+    compilation; without a live tensor to inspect, report eager."""
+    return True
+
+
+# ---------------------------------------------------------------- places
+
+
+class _Place:
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._id == getattr(other, "_id", None))
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._id))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"
+
+
+class CUDAPlace(TPUPlace):
+    """Accepted for reference-code compatibility; 'the accelerator' in
+    this framework is the TPU chip."""
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned host memory is PJRT-managed; behaves as host placement."""
+    _kind = "cpu"
+
+
+# ------------------------------------------------------------- creation
+
+
+def create_parameter(shape: Sequence[int], dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference: paddle.create_parameter /
+    static.create_parameter)."""
+    from ..nn.initializer import Constant, XavierNormal
+    from ..nn.param_attr import ParamAttr
+    from ..tensor import Parameter
+
+    from ..dtypes import convert_dtype
+
+    init = default_initializer
+    if attr is not None:
+        a = ParamAttr._to_attr(attr)
+        if a and getattr(a, "initializer", None) is not None:
+            init = a.initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    value = init(tuple(int(s) for s in shape), convert_dtype(dtype))
+    return Parameter(value)
+
+
+# ---------------------------------------------------------------- misc
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Printing options for Tensor repr (reference: paddle.set_printoptions);
+    Tensor repr renders through numpy, so numpy's options are the knob."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """Defer parameter initialization (reference: fluid/dygraph LazyGuard).
+    Eager params here are cheap host-side inits, so the guard only marks
+    the scope; materialization stays immediate."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader batcher (reference: paddle.batch / fluid/io.py)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def get_cuda_rng_state():
+    """Alias of the device generator state (reference keeps separate CPU
+    and CUDA generator states; the TPU build has one device generator)."""
+    from ..generator import get_rng_state
+
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state) -> None:
+    from ..generator import set_rng_state
+
+    set_rng_state(state)
+
+
+def disable_signal_handler() -> None:
+    """No-op: the reference installs C++ crash handlers that need explicit
+    disabling for interop; this build installs none."""
+
+
+def check_shape(shape) -> None:
+    """Validate a shape argument (reference: paddle.check_shape)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and s is not None:
+                from ..tensor import Tensor
+
+                if not isinstance(s, Tensor):
+                    raise TypeError(f"invalid dim {s!r} in shape")
+            if isinstance(s, (int, np.integer)) and s < -1:
+                raise ValueError(f"shape dims must be >= -1, got {s}")
+    elif not is_tensor(shape):
+        raise TypeError(f"shape must be list/tuple/Tensor, got {type(shape)}")

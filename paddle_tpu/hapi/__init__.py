@@ -3,6 +3,7 @@ from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
 from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401
 
 __all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
-           "ModelCheckpoint", "EarlyStopping", "LRScheduler"]
+           "ModelCheckpoint", "EarlyStopping", "LRScheduler", "summary"]

@@ -1,0 +1,86 @@
+"""paddle.summary — layer-by-layer model summary.
+
+Reference parity: ``python/paddle/hapi/model_summary.py`` (hooks capture
+each leaf layer's output shape and parameter count; totals at the foot).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None) -> dict:
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    import paddle_tpu as paddle
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        shapes = (input_size if isinstance(input_size, list)
+                  else [input_size])
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(shapes)
+        inputs = []
+        for shape, dt in zip(shapes, dts):
+            shape = tuple(abs(int(s)) if s is not None else 1 for s in shape)
+            if "int" in str(dt):
+                inputs.append(paddle.to_tensor(
+                    np.zeros(shape, dtype=str(dt))))
+            else:
+                inputs.append(paddle.to_tensor(
+                    np.ones(shape, dtype=str(dt))))
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inp, out):
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            shape = list(getattr(out0, "shape", []))
+            n_params = sum(int(np.prod(p.shape)) if p.shape else 1
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((f"{type(lyr).__name__}-{len(rows)}", shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not list(layer.children()):  # leaf layers only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        from ..autograd import no_grad
+
+        with no_grad():
+            net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) if p.shape else 1
+                for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) if p.shape else 1
+                    for p in net.parameters() if not p.stop_gradient)
+
+    name_w = max([len(r[0]) for r in rows] + [12]) + 2
+    shape_w = max([len(str(r[1])) for r in rows] + [14]) + 2
+    print("-" * (name_w + shape_w + 12))
+    print("Layer (type)".ljust(name_w) + "Output Shape".ljust(shape_w)
+          + "Param #")
+    print("=" * (name_w + shape_w + 12))
+    for name, shape, n in rows:
+        print(name.ljust(name_w) + str(shape).ljust(shape_w) + f"{n:,}")
+    print("=" * (name_w + shape_w + 12))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * (name_w + shape_w + 12))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,17 @@
+"""paddle_tpu.inference — deployment predictor runtime.
+
+Reference parity: ``paddle/fluid/inference`` — ``AnalysisConfig`` +
+``AnalysisPredictor`` (``api/analysis_predictor.h:94``) with zero-copy
+tensor handles (``ZeroCopyRun`` :936), plus the C API (``capi_exp/``).
+TPU redesign: the "optimized program" is the StableHLO artifact written
+by ``paddle_tpu.jit.save`` (XLA performs the graph passes the reference
+runs in its analysis pipeline), the predictor executes it through
+``jax.jit`` with donated buffers, and the C API
+(``paddle_tpu/native/src/pd_inference_c.cc``) embeds CPython so C/C++
+serving stacks link one shared library, mirroring
+``libpaddle_inference_c``.
+"""
+from .config import Config
+from .predictor import InferTensor, Predictor, create_predictor
+
+__all__ = ["Config", "Predictor", "InferTensor", "create_predictor"]

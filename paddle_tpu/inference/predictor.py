@@ -1,0 +1,145 @@
+"""Predictor: execute a deployed StableHLO program with zero-copy handles.
+
+Reference parity: ``AnalysisPredictor`` (``analysis_predictor.h:94``) —
+``get_input_names`` / ``get_input_handle`` / ``run`` / ``get_output_handle``
+and the ``ZeroCopyTensor`` handle protocol (``copy_from_cpu`` /
+``copy_to_cpu`` / ``reshape``). The analysis pipeline (IR passes, memory
+optimization) collapses into XLA compilation of the exported program;
+``run()`` executes the cached executable on the configured device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+
+__all__ = ["InferTensor", "Predictor", "create_predictor"]
+
+
+class InferTensor:
+    """Zero-copy IO handle (reference: ZeroCopyTensor / paddle_infer.Tensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._data: Optional[np.ndarray] = None
+
+    def reshape(self, shape) -> None:
+        """Pre-declare the shape (reference contract before copy_from_cpu);
+        with numpy payloads this is advisory — copy_from_cpu re-derives it."""
+        self._shape = tuple(int(s) for s in shape)
+
+    def copy_from_cpu(self, data: np.ndarray) -> None:
+        self._data = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError(f"tensor {self.name!r} holds no data yet "
+                               "(run() first?)")
+        return np.asarray(self._data)
+
+    def shape(self) -> List[int]:
+        if self._data is not None:
+            return list(self._data.shape)
+        return list(getattr(self, "_shape", ()))
+
+    def type(self):
+        return None if self._data is None else self._data.dtype
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        import jax
+
+        from .. import jit as pjit
+
+        self._config = config
+        prefix = config.model_prefix()
+        if prefix is None:
+            raise ValueError("Config has no model location; call set_model")
+        self._layer = pjit.load(prefix)
+        import pickle
+
+        with open(prefix + ".pdmodel", "rb") as f:
+            prog = pickle.load(f)
+        n_inputs = len(self._layer._exported.in_avals) - len(
+            self._layer._param_names)
+        self._input_names = list(prog.get(
+            "input_names", [f"x{i}" for i in range(n_inputs)]))
+        self._inputs: Dict[str, InferTensor] = {
+            n: InferTensor(n) for n in self._input_names}
+        self._outputs: Dict[str, InferTensor] = {}
+        self._output_names: List[str] = []
+        # None means "default device" (the TPU); CPU configs pin explicitly
+        self._device = None if config.use_gpu() else jax.devices("cpu")[0]
+
+    # -- reference API -------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> InferTensor:
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; inputs: "
+                           f"{self._input_names}")
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> InferTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pre-fill input handles (zero-copy protocol) or
+        pass arrays positionally (the reference's ``predictor.run([x])``)."""
+        import jax
+
+        from ..tensor import Tensor
+
+        if inputs is not None:
+            for n, x in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(x))
+        xs = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._data is None:
+                raise RuntimeError(f"input {n!r} not set; call "
+                                   "get_input_handle(name).copy_from_cpu")
+            xs.append(h._data)
+
+        with jax.default_device(self._device) if self._device is not None \
+                else _nullcontext():
+            out = self._layer(*xs)
+        flat = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(flat))]
+        self._outputs = {}
+        results = []
+        for name, t in zip(self._output_names, flat):
+            arr = np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+            h = InferTensor(name)
+            h.copy_from_cpu(arr)
+            self._outputs[name] = h
+            results.append(arr)
+        return results
+
+    def clear_intermediate_tensor(self) -> None:
+        pass  # XLA owns intermediates; nothing survives run()
+
+    def try_shrink_memory(self) -> None:
+        import gc
+
+        gc.collect()
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer.create_predictor."""
+    return Predictor(config)

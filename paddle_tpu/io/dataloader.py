@@ -228,6 +228,118 @@ class _ProcessPoolIter:
             pass
 
 
+def _shm_worker_init(dataset, init_fn, channel_name):
+    _process_worker_init(dataset, init_fn)
+    from .shm_channel import ShmChannel
+
+    _process_worker_state["channel"] = ShmChannel(channel_name, create=False)
+
+
+def _shm_fetch(seq, indices):
+    ds = _process_worker_state["dataset"]
+    samples = [ds[i] for i in indices]
+    _process_worker_state["channel"].put((seq, samples))
+    return seq  # tiny ack through the Pool pipe; payload rode the shm ring
+
+
+class _ShmProcessPoolIter:
+    """Process workers + shared-memory batch transport (reference:
+    use_shared_memory=True in dataloader_iter.py — decoded batches travel
+    through a native shm ring, paddle_tpu/native/src/shm_ring.cc, so the
+    Pool result pipe carries only sequence-number acks)."""
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+        from collections import deque
+
+        from .shm_channel import ShmChannel
+
+        # attribute defaults first: a partially-constructed iterator must
+        # still close() cleanly (and unlink the shm segment)
+        self._loader = loader
+        self._pool = None
+        self._channel = None
+        self._indices = list(iter(loader.batch_sampler))
+        self._capacity = max(2, loader.prefetch_factor * loader.num_workers)
+        self._pending = deque()
+        self._next_submit = 0
+        self._next_seq = 0  # next batch owed to the consumer, in order
+        self._stash = {}    # out-of-order batches parked by seq
+        try:
+            self._channel = ShmChannel()  # owner: unlinked on close
+            ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(
+                loader.num_workers, initializer=_shm_worker_init,
+                initargs=(loader.dataset, loader.worker_init_fn,
+                          self._channel.name))
+        except Exception:
+            self.close()
+            raise
+        self._fill()
+
+    def _fill(self):
+        while (self._next_submit < len(self._indices)
+               and len(self._pending) < self._capacity):
+            self._pending.append(self._pool.apply_async(
+                _shm_fetch,
+                (self._next_submit, self._indices[self._next_submit])))
+            self._next_submit += 1
+
+    def __iter__(self):
+        return self
+
+    def _reap_acks(self):
+        """Surface worker exceptions from any FINISHED acks without
+        blocking. Never block on an ack: the worker behind it may itself
+        be blocked pushing into a full ring that only we can drain."""
+        while self._pending and self._pending[0].ready():
+            ack = self._pending.popleft()
+            try:
+                ack.get()
+            except Exception:
+                self.close()
+                raise
+            self._fill()
+
+    def __next__(self):
+        if self._next_seq >= len(self._indices):
+            self.close()
+            raise StopIteration
+        want = self._next_seq
+        while want not in self._stash:
+            self._reap_acks()
+            try:
+                # draining the ring is the priority (it is the workers'
+                # backpressure); short timeout so ack errors surface too
+                seq, samples = self._channel.get(timeout=1.0)
+                self._stash[seq] = samples
+            except TimeoutError:
+                if not self._pending and want not in self._stash:
+                    self.close()
+                    raise RuntimeError(
+                        "shm dataloader: workers ended without producing "
+                        f"batch {want}")
+        samples = self._stash.pop(want)
+        self._next_seq += 1
+        collate = self._loader.collate_fn or default_collate_fn
+        return collate(samples)
+
+    def close(self):
+        pool, self._pool = getattr(self, "_pool", None), None
+        if pool is not None:
+            pool.terminate()
+            pool.join()
+        chan, self._channel = getattr(self, "_channel", None), None
+        if chan is not None:
+            chan.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class _IterableDatasetIter:
     def __init__(self, loader: "DataLoader"):
         self._loader = loader
@@ -270,12 +382,15 @@ class DataLoader:
         worker_mode: str = "thread",
     ):
         del feed_list, places, return_list  # static-graph-only args
-        del use_buffer_reader, use_shared_memory, timeout, persistent_workers
+        del use_buffer_reader, timeout, persistent_workers
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        # shared-memory transport for process workers (reference default):
+        # batches ride a native shm ring instead of the Pool result pipe
+        self.use_shared_memory = bool(use_shared_memory)
         if worker_mode not in ("thread", "process"):
             raise ValueError("worker_mode must be 'thread' or 'process'")
         # 'thread' suits tokenized/numpy batches (zero pickling constraints);
@@ -310,6 +425,11 @@ class DataLoader:
             return _IterableDatasetIter(self)
         if self.num_workers > 0:
             if self.worker_mode == "process":
+                if self.use_shared_memory:
+                    try:
+                        return _ShmProcessPoolIter(self)
+                    except Exception:  # shm unavailable: fall back to pipes
+                        pass
                 return _ProcessPoolIter(self)
             return _ThreadedPrefetchIter(self)
         return _SingleProcessIter(self)

@@ -137,9 +137,11 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
             layer.train()
 
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    input_names = [getattr(s, "name", None) or f"x{i}"
+                   for i, s in enumerate(input_spec)]
     with open(path + _PROGRAM_SUFFIX, "wb") as f:
         pickle.dump({"stablehlo": bytes(blob), "out_spec": holder["out_spec"],
-                     "param_names": names}, f)
+                     "param_names": names, "input_names": input_names}, f)
     with open(path + _PARAMS_SUFFIX, "wb") as f:
         pickle.dump({n: np.asarray(state[n]._value) for n in names}, f)
 

@@ -1,0 +1,109 @@
+"""paddle.linalg facade (reference: python/paddle/linalg.py — re-exports
+of tensor.linalg plus a few linalg-only ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._apply import ensure_tensor, unary as apply_unary
+from .ops.linalg import (  # noqa: F401
+    bincount,
+    cdist,
+    cholesky,
+    cholesky_solve,
+    corrcoef,
+    cov,
+    cross,
+    det,
+    dist,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    histogram,
+    inverse,
+    lstsq,
+    lu,
+    matrix_power,
+    matrix_rank,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    trace,
+    triangular_solve,
+)
+
+inv = inverse  # reference alias
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference: tensor/linalg.py cond)."""
+    x = ensure_tensor(x)
+    p_ = 2 if p is None else p
+    if p_ in (2, -2):
+        def fn(v):
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return (s[..., 0] / s[..., -1]) if p_ == 2 else (s[..., -1] / s[..., 0])
+        return apply_unary(fn, x, name="cond")
+    if p_ in ("fro", "nuc", 1, -1, float("inf"), float("-inf")):
+        def fn(v):
+            import numpy as _np
+            return jnp.asarray(_np.linalg.cond(_np.asarray(v), p_))
+        return apply_unary(fn, x, name="cond")
+    raise ValueError(f"unsupported p for cond: {p!r}")
+
+
+def multi_dot(x, name=None):
+    """Chained matmul with optimal association order (reference:
+    tensor/linalg.py multi_dot). jnp.linalg.multi_dot does the DP."""
+    from .autograd.engine import apply_op
+
+    xs = [ensure_tensor(t) for t in x]
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(list(vs)), xs,
+                    name="multi_dot")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the packed LU factorization (reference: tensor/linalg.py
+    lu_unpack): returns (P, L, U) from lu()'s packed LU and pivots."""
+    from .autograd.engine import apply_op
+
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def fn(lu_packed, pivots):
+        m, n = lu_packed.shape[-2], lu_packed.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_packed[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_packed.dtype)
+        U = jnp.triu(lu_packed[..., :k, :])
+        # pivots (1-based sequential row swaps) → permutation matrix
+        perm = jnp.arange(m)
+        piv = pivots.astype(jnp.int32) - 1
+        def body(i, perm):
+            j = piv[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+            return perm
+        import jax as _jax
+        perm = _jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_packed.dtype)[perm].T
+        return P, L, U
+
+    out = apply_op(fn, [x, y], name="lu_unpack")
+    P, L, U = out
+    if not unpack_ludata:
+        L, U = None, None
+    if not unpack_pivots:
+        P = None
+    return P, L, U
+
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "inverse", "eig",
+    "eigvals", "eigh", "eigvalsh", "multi_dot", "matrix_rank", "svd", "qr",
+    "lu", "lu_unpack", "matrix_power", "det", "slogdet", "solve",
+    "triangular_solve", "cholesky_solve", "lstsq", "pinv", "trace", "cross",
+    "dist", "cdist", "histogram", "bincount",
+]

@@ -29,14 +29,38 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _python_embed_flags() -> list:
+    """Compile/link flags for components embedding CPython (the inference
+    C API). Resolved from the running interpreter, not python3-config, so
+    virtualenvs work."""
+    import sysconfig
+
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    version = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-I{include}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags += [f"-lpython{version}"]
+    return flags
+
+
+# per-library extra build flags
+_EXTRA_FLAGS = {
+    "pd_inference_c": _python_embed_flags,
+}
+
+
 def _build(name: str, src_path: str, out_path: str) -> None:
     os.makedirs(_LIB, exist_ok=True)
     # Build into a temp file then atomically rename, so concurrent
     # processes never dlopen a half-written .so.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB)
     os.close(fd)
+    extra = _EXTRA_FLAGS.get(name)
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           src_path, "-o", tmp]
+           src_path, "-o", tmp] + (extra() if extra else [])
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:

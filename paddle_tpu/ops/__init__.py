@@ -12,9 +12,10 @@ import jax.numpy as jnp
 
 from ..autograd.engine import apply_op
 from ..tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, random, stat
+from . import creation, extras, linalg, logic, manipulation, math, random, stat
 from ._apply import binary, ensure_tensor, unary
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -23,8 +24,9 @@ from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
 __all__ = (
-    creation.__all__ + linalg.__all__ + logic.__all__ + manipulation.__all__
-    + math.__all__ + random.__all__ + stat.__all__ + ["getitem", "setitem"]
+    creation.__all__ + extras.__all__ + linalg.__all__ + logic.__all__
+    + manipulation.__all__ + math.__all__ + random.__all__ + stat.__all__
+    + ["getitem", "setitem"]
 )
 
 

@@ -1,0 +1,355 @@
+"""Remaining top-level tensor API surface.
+
+Reference parity: the tail of ``python/paddle/__init__.py``'s ``__all__``
+(tensor/math.py, tensor/manipulation.py, tensor/attribute.py,
+tensor/creation.py entries) not yet covered by the core op modules —
+numerics (logit, heaviside, nan_to_num, trapezoid...), complex helpers
+(real/imag/conj/angle/polar), integer math (gcd/lcm), manipulation
+(multiplex, index_add, take, broadcast_tensors, renorm, vander) and the
+trailing-underscore in-place variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op, inplace_rebind
+from ._apply import binary, ensure_tensor, unary
+
+__all__ = [
+    "logit", "mv", "floor_mod", "multiplex", "real", "imag", "conj",
+    "rad2deg", "deg2rad", "gcd", "lcm", "count_nonzero", "increment",
+    "scatter_nd", "reverse", "add_n", "angle", "renorm", "nan_to_num",
+    "heaviside", "index_add", "sgn", "take", "frexp", "trapezoid",
+    "cumulative_trapezoid", "polar", "vander", "broadcast_tensors",
+    "broadcast_shape", "is_complex", "is_integer", "is_floating_point",
+    "rank", "shape", "tolist", "tanh_", "reshape_", "unsqueeze_",
+    "squeeze_", "scatter_", "vsplit",
+]
+
+
+# ------------------------------------------------------------- numerics
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v) - jnp.log1p(-v)
+    return unary(fn, x, name="logit")
+
+
+def heaviside(x, y, name=None):
+    return binary(lambda a, b: jnp.heaviside(a, b), x, y, name="heaviside")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), x, name="nan_to_num")
+
+
+def sgn(x, name=None):
+    """Sign; for complex inputs x/|x| (reference: tensor/math.py sgn)."""
+    def fn(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+    return unary(fn, x, name="sgn")
+
+
+def frexp(x, name=None):
+    x = ensure_tensor(x)
+    return apply_op(lambda v: jnp.frexp(v), [x], name="frexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply_op(lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                        [y, ensure_tensor(x)], name="trapezoid")
+    d = 1.0 if dx is None else dx
+    return apply_op(lambda yy: jnp.trapezoid(yy, dx=d, axis=axis), [y],
+                    name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+
+    def fn(yy, xx=None):
+        n = yy.shape[axis]
+        lo = jax.lax.slice_in_dim(yy, 0, n - 1, axis=axis)
+        hi = jax.lax.slice_in_dim(yy, 1, n, axis=axis)
+        if xx is not None:
+            xlo = jax.lax.slice_in_dim(xx, 0, n - 1, axis=axis)
+            xhi = jax.lax.slice_in_dim(xx, 1, n, axis=axis)
+            widths = xhi - xlo
+        else:
+            widths = 1.0 if dx is None else dx
+        return jnp.cumsum((lo + hi) * 0.5 * widths, axis=axis)
+
+    if x is not None:
+        return apply_op(fn, [y, ensure_tensor(x)],
+                        name="cumulative_trapezoid")
+    return apply_op(fn, [y], name="cumulative_trapezoid")
+
+
+def rad2deg(x, name=None):
+    return unary(lambda v: jnp.rad2deg(v.astype(jnp.float32)
+                                       if jnp.issubdtype(v.dtype, jnp.integer)
+                                       else v), x, name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    return unary(lambda v: jnp.deg2rad(v.astype(jnp.float32)
+                                       if jnp.issubdtype(v.dtype, jnp.integer)
+                                       else v), x, name="deg2rad")
+
+
+def gcd(x, y, name=None):
+    return binary(jnp.gcd, x, y, name="gcd")
+
+
+def lcm(x, y, name=None):
+    return binary(jnp.lcm, x, y, name="lcm")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return unary(lambda v: jnp.count_nonzero(v, axis=axis, keepdims=keepdim),
+                 x, differentiable=False, name="count_nonzero")
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a scalar (reference: tensor/math.py increment)."""
+    x = ensure_tensor(x)
+    out = unary(lambda v: v + jnp.asarray(value, v.dtype), x,
+                name="increment")
+    inplace_rebind(x, out)
+    return x
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+
+    return mod(x, y, name=name)
+
+
+def mv(x, vec, name=None):
+    return apply_op(lambda m, v: m @ v,
+                    [ensure_tensor(x), ensure_tensor(vec)], name="mv")
+
+
+# -------------------------------------------------------------- complex
+
+
+def real(x, name=None):
+    return unary(jnp.real, x, name="real")
+
+
+def imag(x, name=None):
+    return unary(jnp.imag, x, name="imag")
+
+
+def conj(x, name=None):
+    return unary(jnp.conj, x, name="conj")
+
+
+def angle(x, name=None):
+    return unary(jnp.angle, x, name="angle")
+
+
+def polar(abs, angle, name=None):
+    return apply_op(lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t)
+                                  ).astype(jnp.complex64),
+                    [ensure_tensor(abs), ensure_tensor(angle)], name="polar")
+
+
+# --------------------------------------------------------- manipulation
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference:
+    tensor/math.py multiplex: out[i] = inputs[index[i]][i])."""
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def fn(ix, *cands):
+        stacked = jnp.stack(cands)  # [n_candidates, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ix.reshape(-1).astype(jnp.int32), rows]
+
+    return apply_op(fn, [idx] + ts, name="multiplex")
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply_op(
+        lambda v, ix, val: _index_add_impl(v, ix, axis, val),
+        [ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)],
+        name="index_add")
+
+
+def _index_add_impl(v, ix, axis, val):
+    ix = ix.astype(jnp.int32)
+    moved = jnp.moveaxis(v, axis, 0)
+    valm = jnp.moveaxis(val, axis, 0)
+    out = moved.at[ix].add(valm)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: tensor/math.py take)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError("mode must be 'raise', 'wrap' or 'clip'")
+
+    def fn(v, ix):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        ixf = ix.astype(jnp.int64)
+        if mode == "wrap":
+            ixf = ((ixf % n) + n) % n
+        elif mode == "clip":
+            ixf = jnp.clip(ixf, 0, n - 1)
+        else:  # raise-mode bounds checks can't run under trace: negative wrap
+            ixf = jnp.where(ixf < 0, ixf + n, ixf)
+        return flat[ixf]
+
+    return apply_op(fn, [ensure_tensor(x), ensure_tensor(index)], name="take")
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis, name=name)
+
+
+def add_n(inputs, name=None):
+    ts = [ensure_tensor(t) for t in
+          (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return apply_op(lambda *vs: sum(vs[1:], vs[0]), ts, name="add_n")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(ix, up):
+        out = jnp.zeros(tuple(int(s) for s in shape), up.dtype)
+        return out.at[tuple(jnp.moveaxis(ix.astype(jnp.int32), -1, 0))].add(up)
+
+    return apply_op(fn, [ensure_tensor(index), ensure_tensor(updates)],
+                    name="scatter_nd")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along ``axis`` to p-norm ≤ max_norm."""
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return unary(fn, x, name="renorm")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return unary(lambda v: jnp.vander(v, N=n, increasing=increasing), x,
+                 name="vander")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [apply_op(lambda v: jnp.broadcast_to(v, shape), [t],
+                     name="broadcast_tensors") for t in ts]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 2:
+        raise ValueError("vsplit expects a tensor of rank >= 2")
+    n = x.shape[0]
+    if isinstance(num_or_indices, int):
+        if n % num_or_indices != 0:
+            raise ValueError(f"dim 0 ({n}) not divisible by "
+                             f"{num_or_indices}")
+        bounds = [n // num_or_indices * i
+                  for i in range(1, num_or_indices)]
+    else:
+        # list form is split INDICES (numpy semantics), not section sizes
+        bounds = [int(i) for i in num_or_indices]
+    edges = [0] + bounds + [n]
+    return [apply_op(lambda v, lo=lo, hi=hi: v[lo:hi], [x], name="vsplit")
+            for lo, hi in zip(edges[:-1], edges[1:])]
+
+
+# ----------------------------------------------------------- predicates
+
+
+def is_complex(x) -> bool:
+    return jnp.iscomplexobj(ensure_tensor(x)._value)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.integer)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.floating)
+
+
+def rank(input) -> "object":
+    from ..tensor import Tensor
+
+    return Tensor(jnp.asarray(ensure_tensor(input).ndim, jnp.int32))
+
+
+def shape(input):
+    from ..tensor import Tensor
+
+    return Tensor(jnp.asarray(ensure_tensor(input).shape, jnp.int32))
+
+
+def tolist(x):
+    return ensure_tensor(x).numpy().tolist()
+
+
+# -------------------------------------------------------------- inplace
+
+
+def _inplace(fn_name, x, *args, **kwargs):
+    from . import manipulation, math
+
+    x = ensure_tensor(x)
+    fn = getattr(math, fn_name, None) or getattr(manipulation, fn_name)
+    out = fn(x, *args, **kwargs)
+    inplace_rebind(x, out)
+    return x
+
+
+def tanh_(x, name=None):
+    return _inplace("tanh", x)
+
+
+def reshape_(x, shape, name=None):
+    return _inplace("reshape", x, shape)
+
+
+def unsqueeze_(x, axis, name=None):
+    return _inplace("unsqueeze", x, axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    return _inplace("squeeze", x, axis)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .manipulation import scatter
+
+    x = ensure_tensor(x)
+    out = scatter(x, index, updates, overwrite=overwrite)
+    inplace_rebind(x, out)
+    return x

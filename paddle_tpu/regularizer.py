@@ -1,0 +1,10 @@
+"""paddle.regularizer — weight-decay regularizers attached to params or
+optimizers (reference: python/paddle/regularizer.py L1Decay/L2Decay).
+
+The optimizer base already applies these at gradient time
+(optimizer/optimizer.py); this module is the public spelling.
+"""
+from .optimizer.optimizer import _L1Decay as L1Decay  # noqa: F401
+from .optimizer.optimizer import _L2Decay as L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

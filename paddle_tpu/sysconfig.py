@@ -1,0 +1,19 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py — include/lib
+dirs for building native extensions against the framework)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native C ABI sources/headers."""
+    return os.path.join(_ROOT, "native", "src")
+
+
+def get_lib() -> str:
+    """Directory holding the compiled native libraries."""
+    return os.path.join(_ROOT, "native", "lib")

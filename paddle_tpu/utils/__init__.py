@@ -1,0 +1,13 @@
+"""paddle.utils — dlpack interop, unique_name, deprecation, install check,
+flops (reference: python/paddle/utils/)."""
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+from .flops import flops  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from .layers_utils import flatten, map_structure, pack_sequence_as  # noqa: F401
+
+__all__ = ["dlpack", "unique_name", "deprecated", "flops", "run_check",
+           "get_weights_path_from_url", "flatten", "map_structure",
+           "pack_sequence_as"]

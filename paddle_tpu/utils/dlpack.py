@@ -1,0 +1,43 @@
+"""DLPack zero-copy tensor interop (reference: utils/dlpack.py:27,64).
+
+``to_dlpack`` exports a paddle Tensor as a DLPack capsule; ``from_dlpack``
+imports a capsule (or any object with ``__dlpack__``, e.g. a torch or
+numpy tensor) as a paddle Tensor. On CPU the exchange is zero-copy;
+device buffers go through jax's dlpack bridge.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a paddle Tensor, got {type(x)}")
+    return x._value.__dlpack__()
+
+
+class _CapsuleWrapper:
+    """Adapts a raw DLPack capsule to the ``__dlpack__`` protocol newer
+    jax consumes (capsules are single-use; wrap-and-import immediately)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU; device capsules import via __dlpack__ objects
+
+
+def from_dlpack(dlpack) -> Tensor:
+    if hasattr(dlpack, "__dlpack__"):
+        arr = jnp.from_dlpack(dlpack)
+    else:  # raw capsule
+        arr = jnp.from_dlpack(_CapsuleWrapper(dlpack))
+    return Tensor(arr, stop_gradient=True)

@@ -1,0 +1,30 @@
+"""Weights download helper (reference: utils/download.py).
+
+This environment has zero egress, so remote fetches cannot happen; the
+function honors an already-populated local cache (PADDLE_TPU_WEIGHTS_DIR
+or ~/.cache/paddle_tpu/weights) and raises a clear error otherwise —
+matching the vision models' documented offline-weights contract.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_WEIGHTS_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "weights"))
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(_cache_dir(), fname)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        f"pretrained weights {fname!r} not in local cache {_cache_dir()!r} "
+        "and this environment has no network egress; place the file there "
+        "or set PADDLE_TPU_WEIGHTS_DIR")

@@ -1,0 +1,40 @@
+"""Model FLOPs counting (reference: utils/flops.py — per-op handler
+table over the static program). TPU redesign: trace the layer with jax
+and read XLA's own cost model (``lower().cost_analysis()``) — exact for
+whatever the compiler will actually run, no per-op table to maintain.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["flops"]
+
+
+def flops(net, input_size: Sequence[int], custom_ops=None,
+          print_detail: bool = False) -> int:
+    """Analytic FLOPs of ``net`` on inputs of ``input_size`` (including
+    batch dim). Returns total FLOPs for one forward pass."""
+    import jax
+
+    from ..tensor import Tensor
+
+    def forward(x):
+        from ..autograd import no_grad
+
+        with no_grad():
+            out = net(Tensor(x))
+        return out._value if isinstance(out, Tensor) else out
+
+    x = jax.ShapeDtypeStruct(tuple(int(s) for s in input_size), np.float32)
+    lowered = jax.jit(forward).lower(x)
+    cost = lowered.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    total = int(cost.get("flops", 0))
+    if print_detail:
+        bytes_ = int(cost.get("bytes accessed", 0))
+        print(f"FLOPs: {total:,}  bytes accessed: {bytes_:,} "
+              f"(XLA cost analysis)")
+    return total

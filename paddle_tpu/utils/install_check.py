@@ -1,0 +1,39 @@
+"""Installation self-check (reference: utils/install_check.py run_check —
+a tiny train step on one device, then on all visible devices)."""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check() -> None:
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    devs = jax.devices()
+    print(f"Running verify PaddlePaddle(TPU) program... "
+          f"({len(devs)} x {devs[0].platform})")
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    opt.step()
+    float(loss.numpy())  # force execution
+    print("PaddlePaddle(TPU) works well on 1 device.")
+
+    if len(devs) > 1:
+        from paddle_tpu.distributed.sharding_api import shard_tensor
+        from paddle_tpu.distributed.topology import create_mesh
+
+        mesh = create_mesh({"dp": len(devs)})
+        xt = paddle.to_tensor(np.ones((len(devs) * 2, 4), np.float32))
+        xs = shard_tensor(xt, mesh, ["dp", None])
+        ((lin(xs) ** 2).mean()).numpy()
+        print(f"PaddlePaddle(TPU) works well on {len(devs)} devices.")
+    print("PaddlePaddle(TPU) is installed successfully!")

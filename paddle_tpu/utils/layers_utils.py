@@ -1,0 +1,21 @@
+"""Nested-structure helpers (reference: utils/layers_utils.py flatten /
+map_structure / pack_sequence_as), backed by jax.tree_util."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["flatten", "map_structure", "pack_sequence_as"]
+
+
+def flatten(nest):
+    leaves, _ = jax.tree_util.tree_flatten(nest)
+    return leaves
+
+
+def map_structure(func, *structures):
+    return jax.tree_util.tree_map(func, *structures)
+
+
+def pack_sequence_as(structure, flat_sequence):
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)

@@ -1,0 +1,31 @@
+"""Version info (reference: generated python/paddle/version.py)."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+istaged = True
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn", "xpu"]
+
+
+def show() -> None:
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+
+
+def cuda() -> str:
+    return "False"  # TPU build: no CUDA
+
+
+def cudnn() -> str:
+    return "False"
+
+
+def xpu() -> str:
+    return "False"

@@ -1,0 +1,198 @@
+"""paddle_tpu.inference: Config, Predictor, and the PD_* C API.
+
+Mirrors the reference's inference test strategy (api/analysis_predictor
+tests + capi tests): save a model with jit.save, reload through the
+predictor, compare against the eager model, then drive the same artifact
+through the C ABI via ctypes.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.inference import Config, create_predictor
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(7)
+    model = _Net()
+    prefix = str(tmp_path_factory.mktemp("infer") / "net")
+    jit.save(model, prefix,
+             input_spec=[jit.InputSpec([None, 8], "float32", name="feats")])
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    expect = np.asarray(model(paddle.to_tensor(x)).numpy())
+    return prefix, x, expect
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_config_model_resolution(saved_model, tmp_path):
+    prefix, _, _ = saved_model
+    cfg = Config()
+    cfg.set_model(prefix)
+    assert cfg.model_prefix() == prefix
+
+    cfg2 = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    assert cfg2.model_prefix() == prefix
+    assert cfg2.prog_file() == prefix + ".pdmodel"
+
+    d = str(tmp_path / "modeldir")
+    os.makedirs(d)
+    for suf in (".pdmodel", ".pdiparams"):
+        with open(prefix + suf, "rb") as fsrc, \
+                open(os.path.join(d, "m" + suf), "wb") as fdst:
+            fdst.write(fsrc.read())
+    cfg3 = Config()
+    cfg3.set_model(d)
+    assert cfg3.model_prefix() == os.path.join(d, "m")
+
+    cfg.disable_gpu()
+    assert not cfg.use_gpu()
+    cfg.enable_use_gpu(100, 0)
+    assert cfg.use_gpu()
+    assert "model_prefix" in cfg.summary()
+
+
+def test_config_empty_raises():
+    with pytest.raises(ValueError, match="no model location"):
+        create_predictor(Config())
+
+
+# ---------------------------------------------------------------- predictor
+
+
+def test_predictor_matches_eager(saved_model):
+    prefix, x, expect = saved_model
+    cfg = Config()
+    cfg.set_model(prefix)
+    cfg.disable_gpu()  # CPU test environment
+    pred = create_predictor(cfg)
+
+    assert pred.get_input_names() == ["feats"]
+    h = pred.get_input_handle("feats")
+    h.reshape(x.shape)
+    h.copy_from_cpu(x)
+    (out,) = pred.run()
+    np.testing.assert_allclose(out, expect, rtol=2e-5, atol=1e-6)
+
+    # zero-copy output handle protocol
+    assert pred.get_output_names() == ["out0"]
+    oh = pred.get_output_handle("out0")
+    np.testing.assert_allclose(oh.copy_to_cpu(), expect, rtol=2e-5, atol=1e-6)
+    assert oh.shape() == [4, 3]
+
+    # polymorphic batch: the saved program accepts another batch size
+    x2 = np.random.default_rng(1).standard_normal((9, 8)).astype(np.float32)
+    (out2,) = pred.run([x2])
+    assert out2.shape == (9, 3)
+
+    with pytest.raises(KeyError):
+        pred.get_input_handle("nope")
+
+
+def test_predictor_positional_run(saved_model):
+    prefix, x, expect = saved_model
+    cfg = Config()
+    cfg.set_model(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, expect, rtol=2e-5, atol=1e-6)
+
+
+def test_input_not_set_raises(saved_model):
+    prefix, _, _ = saved_model
+    cfg = Config()
+    cfg.set_model(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    with pytest.raises(RuntimeError, match="not set"):
+        pred.run()
+
+
+# ---------------------------------------------------------------- C API
+
+
+def test_c_api_end_to_end(saved_model):
+    prefix, x, expect = saved_model
+    from paddle_tpu.native import load_library
+
+    lib = load_library("pd_inference_c")
+    lib.PD_Init.restype = ctypes.c_int
+    lib.PD_Init.argtypes = [ctypes.c_char_p]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    lib.PD_PredictorCreate.restype = ctypes.c_int64
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.PD_PredictorGetInputNames.restype = ctypes.c_int
+    lib.PD_PredictorSetInput.restype = ctypes.c_int
+    lib.PD_PredictorSetInput.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_char_p]
+    lib.PD_PredictorRun.restype = ctypes.c_int
+    lib.PD_PredictorRun.argtypes = [ctypes.c_int64]
+    lib.PD_PredictorGetOutputDims.restype = ctypes.c_int
+    lib.PD_PredictorGetOutputDims.argtypes = [
+        ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int]
+    lib.PD_PredictorGetOutputDtype.restype = ctypes.c_int
+    lib.PD_PredictorGetOutputDtype.argtypes = [
+        ctypes.c_int64, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.PD_PredictorCopyOutput.restype = ctypes.c_int64
+    lib.PD_PredictorCopyOutput.argtypes = [
+        ctypes.c_int64, ctypes.c_int, ctypes.c_void_p, ctypes.c_int64]
+
+    assert lib.PD_Init(b"") == 0, lib.PD_GetLastError().decode()
+    h = lib.PD_PredictorCreate(prefix.encode(), b"cpu")
+    assert h > 0, lib.PD_GetLastError().decode()
+
+    # input names round trip through caller-owned buffers
+    bufs = [ctypes.create_string_buffer(64) for _ in range(4)]
+    arr = (ctypes.c_char_p * 4)(*[ctypes.cast(b, ctypes.c_char_p)
+                                  for b in bufs])
+    n = lib.PD_PredictorGetInputNames(h, arr, 4, 64)
+    assert n == 1 and bufs[0].value == b"feats"
+
+    xc = np.ascontiguousarray(x)
+    dims = (ctypes.c_int64 * 2)(*xc.shape)
+    rc = lib.PD_PredictorSetInput(h, b"feats",
+                                  xc.ctypes.data_as(ctypes.c_void_p),
+                                  dims, 2, b"float32")
+    assert rc == 0, lib.PD_GetLastError().decode()
+
+    n_out = lib.PD_PredictorRun(h)
+    assert n_out == 1, lib.PD_GetLastError().decode()
+
+    odims = (ctypes.c_int64 * 8)()
+    ndim = lib.PD_PredictorGetOutputDims(h, 0, odims, 8)
+    assert ndim == 2 and list(odims[:2]) == [4, 3]
+    dt = ctypes.create_string_buffer(16)
+    assert lib.PD_PredictorGetOutputDtype(h, 0, dt, 16) == 0
+    assert dt.value == b"float32"
+
+    out = np.empty((4, 3), np.float32)
+    wrote = lib.PD_PredictorCopyOutput(h, 0,
+                                       out.ctypes.data_as(ctypes.c_void_p),
+                                       out.nbytes)
+    assert wrote == out.nbytes, lib.PD_GetLastError().decode()
+    np.testing.assert_allclose(out, expect, rtol=2e-5, atol=1e-6)
+
+    lib.PD_PredictorDestroy(h)
+    # error surface: bad prefix yields 0 + message
+    assert lib.PD_PredictorCreate(b"/nonexistent/model", b"cpu") == 0
+    assert b"nonexistent" in lib.PD_GetLastError() or \
+        lib.PD_GetLastError() != b""
