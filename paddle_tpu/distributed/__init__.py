@@ -10,13 +10,15 @@ annotated for the mesh.
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import communication  # noqa: F401
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
-    new_group, reduce, scatter, wait,
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    barrier, batch_isend_irecv, broadcast, gather, get_group, irecv, isend,
+    new_group, recv, reduce, reduce_scatter, scatter, send, wait,
 )
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
 from .parallel import (  # noqa: F401
@@ -41,5 +43,7 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
     "CommunicateTopology", "HybridCommunicateGroup", "create_mesh",
     "get_mesh", "set_mesh", "fleet", "group_sharded_parallel",
-    "rpc", "TCPStore", "ps", "spawn",
+    "rpc", "TCPStore", "ps", "spawn", "communication",
+    "reduce_scatter", "gather", "P2POp", "batch_isend_irecv", "isend",
+    "irecv", "send", "recv", "all_gather_object",
 ]

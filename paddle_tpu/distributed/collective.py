@@ -36,7 +36,8 @@ from .topology import _AxisGroup
 __all__ = [
     "ReduceOp", "new_group", "get_group", "all_reduce", "all_gather",
     "all_gather_object", "broadcast", "reduce", "scatter", "alltoall",
-    "barrier", "send", "recv", "wait", "split_axis",
+    "reduce_scatter", "gather", "P2POp", "batch_isend_irecv", "isend",
+    "irecv", "barrier", "send", "recv", "wait", "split_axis",
 ]
 
 
@@ -246,10 +247,134 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     )
 
 
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """reference: communication/reduce_scatter.py → lax.psum_scatter
+    (reduce across the axis, each rank keeps its shard — the ZeRO-2 grad
+    pattern). Paddle's list form passes per-destination chunks in
+    ``tensor_list``; the result lands in ``tensor`` (rebound in place)
+    and is also returned."""
+    if tensor_list is not None:
+        from ..ops import manipulation as _manip
+
+        src = _manip.concat([ensure_tensor(c) for c in tensor_list], axis=0)
+    else:
+        src = ensure_tensor(tensor)
+    ax = _axis_of(group)
+    if not _axis_bound(ax):
+        raise RuntimeError(
+            "eager reduce_scatter requires a shard_map region on TPU")
+    axis_name = _single_axis(ax, "reduce_scatter")
+    if op not in (ReduceOp.SUM, "sum", ReduceOp.AVG, "avg"):
+        raise ValueError("reduce_scatter supports SUM/AVG on TPU")
+
+    def _rs(v):
+        out = jax.lax.psum_scatter(v, axis_name, scatter_dimension=0,
+                                   tiled=True)
+        if op in (ReduceOp.AVG, "avg"):
+            out = out / jax.lax.axis_size(axis_name)
+        return out
+
+    out = apply_op(_rs, [src], name="reduce_scatter")
+    if tensor_list is not None and isinstance(tensor, Tensor):
+        from ..autograd.engine import inplace_rebind
+
+        inplace_rebind(tensor, out)
+        return tensor
+    return out
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    """reference: communication/gather.py. SPMD has no cheaper
+    gather-to-one than all_gather (the result is a mesh-global array
+    anyway); every rank observes the gathered stack and ``dst`` is
+    honored semantically, not in traffic."""
+    out = all_gather(None, tensor, group=group)
+    if gather_list is not None:
+        n = out.shape[0]
+        for i in range(n):
+            gather_list.append(out[i])
+        return None
+    return out
+
+
+class P2POp:
+    """One pending point-to-point op for batch_isend_irecv (reference:
+    communication/batch_isend_irecv.py P2POp)."""
+
+    def __init__(self, op, tensor, peer: int, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a matched set of sends/recvs as ONE lax.ppermute inside a
+    shard_map region (reference: batch_isend_irecv → grouped NCCL calls;
+    on TPU a permute IS the batched p2p — it rides ICI in one step).
+
+    Constraint of the SPMD redesign: the batch must contain exactly one
+    isend and one irecv per rank (a permutation), which is the pipeline /
+    ring pattern batch_isend_irecv exists for."""
+    sends = [o for o in p2p_op_list if o.op is isend]
+    recvs = [o for o in p2p_op_list if o.op is irecv]
+    if len(sends) != 1 or len(recvs) != 1:
+        raise ValueError(
+            "TPU batch_isend_irecv executes a permutation: pass exactly one "
+            "isend and one irecv per rank")
+    send_op, recv_op = sends[0], recvs[0]
+    # peers are RELATIVE offsets under SPMD; a consistent ring means
+    # "send to +k" pairs with "recv from -k" — anything else would hand
+    # the receiver a neighbor it did not ask for
+    if recv_op.peer != -send_op.peer:
+        raise ValueError(
+            f"inconsistent p2p batch: isend peer {send_op.peer} requires "
+            f"irecv peer {-send_op.peer} (got {recv_op.peer}); under SPMD "
+            "every rank runs the same program, so peers are relative "
+            "offsets and must describe one permutation")
+    ax = _axis_of(send_op.group)
+    if not _axis_bound(ax):
+        raise RuntimeError(
+            "batch_isend_irecv requires a shard_map region on TPU "
+            "(ppermute has no eager equivalent)")
+    axis_name = _single_axis(ax, "batch_isend_irecv")
+    t = ensure_tensor(send_op.tensor)
+
+    # peer is interpreted as a RELATIVE offset under SPMD (every rank runs
+    # the same program); pipeline/ring code passes next/prev = ±1
+    def _permute_rel(v):
+        n = jax.lax.axis_size(axis_name)
+        perm = [(s, (s + send_op.peer) % n) for s in range(n)]
+        return jax.lax.ppermute(v, axis_name, perm)
+
+    out = apply_op(_permute_rel, [t], name="batch_isend_irecv")
+    if isinstance(recv_op.tensor, Tensor):
+        from ..autograd.engine import inplace_rebind
+
+        inplace_rebind(recv_op.tensor, out)
+    return [out]
+
+
+def isend(tensor, dst: int, group=None):
+    raise RuntimeError(
+        "isend/irecv only execute batched (batch_isend_irecv → ppermute) "
+        "inside shard_map on TPU; lone p2p has no SPMD equivalent")
+
+
+def irecv(tensor, src: int, group=None):
+    raise RuntimeError(
+        "isend/irecv only execute batched (batch_isend_irecv → ppermute) "
+        "inside shard_map on TPU; lone p2p has no SPMD equivalent")
+
+
 def send(tensor, dst: int, group=None, sync_op=True):
     raise RuntimeError(
         "point-to-point send/recv maps to lax.ppermute inside shard_map on "
-        "TPU; use paddle_tpu.distributed.p2p helpers or pipeline layers"
+        "TPU; use batch_isend_irecv (one send + one recv per rank) or "
+        "pipeline layers"
     )
 
 

@@ -1,0 +1,38 @@
+"""Explicit-stream collective variants (reference:
+``communication/stream/``: async ops on a dedicated comm stream).
+
+On TPU there are no user-visible streams: XLA schedules collectives on
+the ICI DMA engines and overlaps them with compute during compilation,
+which is precisely what the reference's comm-stream machinery exists to
+do by hand. These wrappers therefore accept and ignore
+``sync_op``/``use_calc_stream`` and delegate to the mesh collectives —
+scripts written against the stream API run unchanged.
+"""
+import functools as _functools
+
+from ... import collective as _c
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "all_to_all",
+           "broadcast", "gather", "recv", "reduce", "reduce_scatter",
+           "scatter", "send"]
+
+
+def _stream_variant(fn):
+    @_functools.wraps(fn)
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+all_gather = _stream_variant(_c.all_gather)
+all_reduce = _stream_variant(_c.all_reduce)
+alltoall = _stream_variant(_c.alltoall)
+all_to_all = alltoall
+broadcast = _stream_variant(_c.broadcast)
+gather = _stream_variant(_c.gather)
+recv = _stream_variant(_c.recv)
+reduce = _stream_variant(_c.reduce)
+reduce_scatter = _stream_variant(_c.reduce_scatter)
+scatter = _stream_variant(_c.scatter)
+send = _stream_variant(_c.send)

@@ -45,7 +45,11 @@ class Config:
             return
         if os.path.isdir(model):
             self._model_dir = model
-            self._prefix = None  # clear any earlier prefix-form setting
+            # clear every earlier location form; model_prefix() prefers
+            # _prefix/_prog_file, so stale ones would win over this dir
+            self._prefix = None
+            self._prog_file = None
+            self._params_file = None
         else:
             self._model_dir = None
             self._prog_file = None

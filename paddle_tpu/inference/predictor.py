@@ -108,8 +108,10 @@ class Predictor:
                                    "get_input_handle(name).copy_from_cpu")
             xs.append(h._data)
 
+        from contextlib import nullcontext
+
         with jax.default_device(self._device) if self._device is not None \
-                else _nullcontext():
+                else nullcontext():
             out = self._layer(*xs)
         flat = out if isinstance(out, (list, tuple)) else [out]
         self._output_names = [f"out{i}" for i in range(len(flat))]
@@ -130,14 +132,6 @@ class Predictor:
         import gc
 
         gc.collect()
-
-
-class _nullcontext:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
 
 
 def create_predictor(config: Config) -> Predictor:

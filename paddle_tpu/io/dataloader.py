@@ -272,10 +272,10 @@ class _ShmProcessPoolIter:
                 loader.num_workers, initializer=_shm_worker_init,
                 initargs=(loader.dataset, loader.worker_init_fn,
                           self._channel.name))
+            self._fill()
         except Exception:
             self.close()
             raise
-        self._fill()
 
     def _fill(self):
         while (self._next_submit < len(self._indices)

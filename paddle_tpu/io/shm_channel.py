@@ -49,8 +49,13 @@ def _native():
 class ShmChannel:
     """Multi-producer single-consumer object channel over one shm ring."""
 
+    DEFAULT_CAPACITY = 256 << 20  # overridable: PADDLE_TPU_SHM_CAPACITY_MB
+
     def __init__(self, name: Optional[str] = None,
-                 capacity_bytes: int = 256 << 20, create: bool = True):
+                 capacity_bytes: Optional[int] = None, create: bool = True):
+        if capacity_bytes is None:
+            mb = os.environ.get("PADDLE_TPU_SHM_CAPACITY_MB")
+            capacity_bytes = (int(mb) << 20) if mb else self.DEFAULT_CAPACITY
         self.name = name or f"/pt_dl_{os.getpid()}_{id(self):x}"
         self._h = _native().pd_shm_ring_create(
             self.name.encode(), capacity_bytes, 1 if create else 0)
@@ -77,8 +82,9 @@ class ShmChannel:
         rc = _native().pd_shm_ring_push(self._h, arr, len(frame), timeout)
         if rc == -2:
             raise ValueError(
-                f"batch of {len(frame)} bytes exceeds shm ring capacity; "
-                "raise DataLoader's shm capacity or lower batch size")
+                f"batch of {len(frame)} bytes exceeds the shm ring capacity; "
+                "set PADDLE_TPU_SHM_CAPACITY_MB higher, lower the batch "
+                "size, or pass use_shared_memory=False to DataLoader")
         if rc == -1:
             raise TimeoutError("ShmChannel.put: ring full past timeout "
                                "(consumer stalled?)")

@@ -39,18 +39,36 @@ inv = inverse  # reference alias
 
 
 def cond(x, p=None, name=None):
-    """Condition number (reference: tensor/linalg.py cond)."""
+    """Condition number (reference: tensor/linalg.py cond). All branches
+    stay in jnp so the op traces under jit and flows on the tape."""
     x = ensure_tensor(x)
     p_ = 2 if p is None else p
+
     if p_ in (2, -2):
         def fn(v):
             s = jnp.linalg.svd(v, compute_uv=False)
-            return (s[..., 0] / s[..., -1]) if p_ == 2 else (s[..., -1] / s[..., 0])
+            return (s[..., 0] / s[..., -1]) if p_ == 2 \
+                else (s[..., -1] / s[..., 0])
         return apply_unary(fn, x, name="cond")
+
+    def _norm(v, p_val):
+        if p_val == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=(-2, -1)))
+        if p_val == "nuc":
+            return jnp.sum(jnp.linalg.svd(v, compute_uv=False), axis=-1)
+        if p_val == 1:
+            return jnp.max(jnp.sum(jnp.abs(v), axis=-2), axis=-1)
+        if p_val == -1:
+            return jnp.min(jnp.sum(jnp.abs(v), axis=-2), axis=-1)
+        if p_val == float("inf"):
+            return jnp.max(jnp.sum(jnp.abs(v), axis=-1), axis=-1)
+        if p_val == float("-inf"):
+            return jnp.min(jnp.sum(jnp.abs(v), axis=-1), axis=-1)
+        raise ValueError(f"unsupported p for cond: {p_val!r}")
+
     if p_ in ("fro", "nuc", 1, -1, float("inf"), float("-inf")):
         def fn(v):
-            import numpy as _np
-            return jnp.asarray(_np.linalg.cond(_np.asarray(v), p_))
+            return _norm(v, p_) * _norm(jnp.linalg.inv(v), p_)
         return apply_unary(fn, x, name="cond")
     raise ValueError(f"unsupported p for cond: {p!r}")
 
@@ -73,23 +91,42 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     x = ensure_tensor(x)
     y = ensure_tensor(y)
 
-    def fn(lu_packed, pivots):
+    def one(lu_packed, pivots):
+        import jax as _jax
+
         m, n = lu_packed.shape[-2], lu_packed.shape[-1]
         k = min(m, n)
-        L = jnp.tril(lu_packed[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_packed.dtype)
-        U = jnp.triu(lu_packed[..., :k, :])
+        L = jnp.tril(lu_packed[:, :k], -1) + jnp.eye(m, k,
+                                                     dtype=lu_packed.dtype)
+        U = jnp.triu(lu_packed[:k, :])
         # pivots (1-based sequential row swaps) → permutation matrix
         perm = jnp.arange(m)
         piv = pivots.astype(jnp.int32) - 1
+
         def body(i, perm):
             j = piv[i]
             pi, pj = perm[i], perm[j]
             perm = perm.at[i].set(pj).at[j].set(pi)
             return perm
-        import jax as _jax
+
         perm = _jax.lax.fori_loop(0, piv.shape[-1], body, perm)
         P = jnp.eye(m, dtype=lu_packed.dtype)[perm].T
         return P, L, U
+
+    def fn(lu_packed, pivots):
+        import jax as _jax
+
+        if lu_packed.ndim == 2:
+            return one(lu_packed, pivots)
+        # batched factorization: map the single-matrix unpack over the
+        # flattened leading dims
+        batch = lu_packed.shape[:-2]
+        lu_flat = lu_packed.reshape((-1,) + lu_packed.shape[-2:])
+        piv_flat = pivots.reshape((-1, pivots.shape[-1]))
+        P, L, U = _jax.vmap(one)(lu_flat, piv_flat)
+        return (P.reshape(batch + P.shape[-2:]),
+                L.reshape(batch + L.shape[-2:]),
+                U.reshape(batch + U.shape[-2:]))
 
     out = apply_op(fn, [x, y], name="lu_unpack")
     P, L, U = out

@@ -100,7 +100,8 @@ def _patch_tensor():
 
     # methods (paddle patches ~200; we patch everything in __all__ whose first
     # arg is a tensor, under both the op name and common aliases)
-    method_sources = [creation, linalg, logic, manipulation, math, random, stat]
+    method_sources = [creation, extras, linalg, logic, manipulation, math,
+                      random, stat]
     skip = {
         "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
         "logspace", "eye", "meshgrid", "tril_indices", "triu_indices",

@@ -96,7 +96,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * jnp.float32(scale)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
 
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -118,7 +119,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0]  # [bk, d]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, d]
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)  # [bq, d]
         acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
         m_ref[...] = m_new
         l_ref[...] = l_new
@@ -203,7 +205,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * jnp.float32(scale)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -215,11 +218,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)  # [bq, bk]
         ds = (p * (dp - dlt)).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -252,7 +257,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * jnp.float32(scale)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * jnp.float32(scale)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
@@ -267,14 +273,17 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
         # dv += pᵀ · do : contract the bq dim
         dv_acc[...] += jax.lax.dot_general(
             pl_, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)  # [bq, bk]
         ds = (p * (dp - dlt)).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
 
     @pl.when(qi == nq - 1)
     def _finish():

@@ -277,3 +277,82 @@ class TestCompiledDistributedStep:
                   for _ in range(6)]
         assert losses[-1] < losses[0]
         assert len(step._cache) == 1
+
+
+class TestNewCollectives:
+    def test_reduce_scatter_sum(self):
+        _init_fleet(dp=8)
+        g = dist.new_group(axis="dp")
+
+        def fn(x):
+            return dist.reduce_scatter(x, group=g)
+
+        wrapped = dist.shard_map_fn(fn, in_specs=(P("dp"),), out_specs=P("dp"))
+        # every rank holds the same [8] vector; reduce-scatter sums across
+        # ranks then leaves shard r on rank r
+        x = np.tile(np.arange(8, dtype="float32"), (8, 1)).reshape(64)
+        out = wrapped(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.arange(8, dtype="float32") * 8)
+
+    def test_reduce_scatter_avg(self):
+        _init_fleet(dp=8)
+        g = dist.new_group(axis="dp")
+
+        def fn(x):
+            return dist.reduce_scatter(x, op=dist.ReduceOp.AVG, group=g)
+
+        wrapped = dist.shard_map_fn(fn, in_specs=(P("dp"),), out_specs=P("dp"))
+        x = np.tile(np.arange(8, dtype="float32"), (8, 1)).reshape(64)
+        out = wrapped(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.arange(8, dtype="float32"))
+
+    def test_gather_matches_all_gather(self):
+        _init_fleet(dp=8)
+        g = dist.new_group(axis="dp")
+
+        def fn(x):
+            return dist.gather(x, dst=0, group=g)
+
+        wrapped = dist.shard_map_fn(fn, in_specs=(P("dp"),), out_specs=P())
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        out = wrapped(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1),
+                                   np.arange(8, dtype="float32"))
+
+    def test_batch_isend_irecv_ring_shift(self):
+        _init_fleet(dp=8)
+        g = dist.new_group(axis="dp")
+
+        def fn(x):
+            buf = x
+            ops = [dist.P2POp(dist.isend, x, 1, group=g),
+                   dist.P2POp(dist.irecv, buf, -1, group=g)]
+            (out,) = dist.batch_isend_irecv(ops)
+            return out
+
+        wrapped = dist.shard_map_fn(fn, in_specs=(P("dp"),), out_specs=P("dp"))
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        out = wrapped(x)
+        # rank r's value moves to rank r+1 (ring): output is rolled by one
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.roll(np.arange(8, dtype="float32"), 1))
+
+    def test_isend_alone_raises(self):
+        _init_fleet(dp=8)
+        with pytest.raises(RuntimeError, match="batch_isend_irecv"):
+            dist.isend(paddle.to_tensor(np.zeros(2, "float32")), 1)
+
+    def test_stream_namespace_delegates(self):
+        _init_fleet(dp=8)
+        g = dist.new_group(axis="dp")
+        from paddle_tpu.distributed import communication
+
+        def fn(x):
+            return communication.stream.all_reduce(x, group=g,
+                                                   use_calc_stream=True)
+
+        wrapped = dist.shard_map_fn(fn, in_specs=(P("dp"),), out_specs=P())
+        out = wrapped(paddle.to_tensor(np.ones(8, "float32")))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [8.0])

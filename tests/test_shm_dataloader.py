@@ -38,7 +38,7 @@ def test_shm_channel_timeout_and_oversize():
     try:
         with pytest.raises(TimeoutError):
             ch.get(timeout=0.2)
-        with pytest.raises(ValueError, match="exceeds shm ring capacity"):
+        with pytest.raises(ValueError, match="exceeds the shm ring capacity"):
             ch.put(np.zeros(1 << 20, np.uint8), timeout=0.5)
     finally:
         ch.close()

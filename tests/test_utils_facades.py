@@ -155,3 +155,25 @@ def test_version_and_sysconfig():
 def test_callbacks_facade():
     assert paddle.callbacks.EarlyStopping is not None
     assert paddle.callbacks.ModelCheckpoint is not None
+
+
+def test_linalg_cond_all_p_values_and_jit():
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((3, 3)).astype(np.float32)
+    a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    t = paddle.to_tensor(a)
+    for p in [None, 2, -2, "fro", "nuc", 1, -1, float("inf"), float("-inf")]:
+        ours = float(paddle.linalg.cond(t, p=p).numpy())
+        want = float(np.linalg.cond(a.astype(np.float64),
+                                    2 if p is None else p))
+        np.testing.assert_allclose(ours, want, rtol=1e-3)
+
+
+def test_linalg_lu_unpack_batched():
+    rng = np.random.default_rng(6)
+    a = rng.standard_normal((4, 5, 5)).astype(np.float32)
+    lu_packed, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_packed, piv)
+    recon = np.einsum("bij,bjk,bkl->bil", np.asarray(P.numpy()),
+                      np.asarray(L.numpy()), np.asarray(U.numpy()))
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-4)
