@@ -118,11 +118,12 @@ def run_bench(dev):
         steps = int(os.environ.get("BENCH_STEPS", 5))
     else:
         # GPT-medium-scale: ~355M params — saturates one v5e chip in bf16
-        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_position_embeddings=1024,
-                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
-        B = int(os.environ.get("BENCH_BATCH", 8))
         S = int(os.environ.get("BENCH_SEQ", 1024))
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position_embeddings=max(S, 1024),
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        recompute=os.environ.get("BENCH_RECOMPUTE") == "1")
+        B = int(os.environ.get("BENCH_BATCH", 8))
         steps = int(os.environ.get("BENCH_STEPS", 10))
 
     _log(f"config: h{cfg.hidden_size} l{cfg.num_layers} B{B} S{S} "

@@ -52,6 +52,10 @@ class GPTConfig:
     sequence_parallel: bool = False
     tie_word_embeddings: bool = True
     pp_num_microbatches: Optional[int] = None  # default: pp degree
+    # activation recompute per decoder block (fleet.recompute → jax.remat):
+    # trades ~1/3 more FLOPs for O(layers) less live activation memory —
+    # the standard lever for batching past HBM on one chip
+    recompute: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -275,6 +279,11 @@ class GPTModel(nn.Layer):
         if self._pp > 1:
             x = self.layers(
                 x, num_microbatches=self.config.pp_num_microbatches or self._pp)
+        elif self.config.recompute:
+            from ..distributed.fleet.recompute import recompute as _rc
+
+            for layer in self.layers:
+                x = _rc(layer, x)
         else:
             for layer in self.layers:
                 x = layer(x)

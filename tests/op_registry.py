@@ -726,3 +726,147 @@ CUSTOM["exponential_"] = _inplace_random("exponential_")
 
 # ops intentionally in neither REGISTRY nor CUSTOM, each with the reason
 EXCLUDED = {}
+
+
+# ───────────────────────── extras (top-level API tail) ─────────────────────
+S("logit", u(lambda x: np.log(x) - np.log1p(-x),
+             lambda dt: (np.abs(np.tanh(_rng().randn(2, 3))) * 0.4
+                         + 0.3).astype(dt)))
+S("heaviside", b2(np.heaviside))
+S("nan_to_num", u(np.nan_to_num, grad=False))
+S("sgn", u(np.sign, grad=False))
+S("rad2deg", u(np.rad2deg))
+S("deg2rad", u(np.deg2rad))
+S("gcd", b2(np.gcd,
+            mk1=lambda dt: (np.abs(_rng().randn(2, 3)) * 20 + 1).astype(dt),
+            mk2=lambda dt: (np.abs(_rng().randn(2, 3)) * 7 + 2).astype(dt),
+            dtypes=I, grad=False))
+S("lcm", b2(np.lcm,
+            mk1=lambda dt: (np.abs(_rng().randn(2, 3)) * 10 + 1).astype(dt),
+            mk2=lambda dt: (np.abs(_rng().randn(2, 3)) * 5 + 3).astype(dt),
+            dtypes=I, grad=False))
+S("count_nonzero", u(np.count_nonzero, dtypes=FI, grad=False))
+S("floor_mod", b2(np.mod, mk2=lambda dt: (np.abs(_rng().randn(2, 3)) * 2
+                                          + 0.5).astype(dt), grad=False))
+S("mv", Spec(None, lambda m, v: m @ v,
+             lambda dt: [r(3, 4)(dt), r(4)(dt)], grad=True))
+S("real", u(np.real, grad=False))
+S("imag", u(np.imag, grad=False))
+S("conj", u(np.conj))
+S("angle", u(np.angle, grad=False))
+S("reverse", Spec(lambda x: pt.reverse(x, 1), lambda x: np.flip(x, 1),
+                  lambda dt: [r(2, 3)(dt)], grad=True))
+S("renorm", Spec(lambda x: pt.renorm(x, 2.0, 0, 2.0),
+                 lambda x: x * np.minimum(
+                     1.0, 2.0 / np.maximum(
+                         np.sqrt((x * x).sum(axis=(1,))), 1e-12))[:, None],
+                 lambda dt: [r(3, 4)(dt)], grad=True))
+S("vander", Spec(lambda x: pt.vander(x, 4), lambda x: np.vander(x, 4),
+                 lambda dt: [r(5)(dt)], grad=False))
+S("take", Spec(None, lambda x, ix: np.take(x.reshape(-1), ix),
+               lambda dt: [r(3, 4)(dt),
+                           np.array([0, 5, 11], "int64")], grad=False))
+S("trapezoid", Spec(None, lambda y: np.trapezoid(y, dx=1.0, axis=-1),
+                    lambda dt: [r(3, 5)(dt)], grad=True))
+S("cumulative_trapezoid",
+  Spec(None,
+       lambda y: np.cumsum((y[..., :-1] + y[..., 1:]) * 0.5, axis=-1),
+       lambda dt: [r(3, 5)(dt)], grad=True))
+
+
+def _check_multiplex():
+    i1 = np.array([[1, 2], [3, 4]], "float32")
+    i2 = np.array([[5, 6], [7, 8]], "float32")
+    out = pt.multiplex([pt.to_tensor(i1), pt.to_tensor(i2)],
+                       pt.to_tensor(np.array([1, 0], "int32")))
+    np.testing.assert_array_equal(np.asarray(out.numpy()), [[5, 6], [3, 4]])
+
+
+def _check_index_add():
+    x = pt.to_tensor(np.zeros((3, 2), "float32"))
+    out = pt.index_add(x, pt.to_tensor(np.array([0, 2])), 0,
+                       pt.to_tensor(np.ones((2, 2), "float32")))
+    np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                  [[1, 1], [0, 0], [1, 1]])
+
+
+def _check_polar():
+    out = pt.polar(pt.to_tensor(np.array([2.0], "float32")),
+                   pt.to_tensor(np.array([0.0], "float32")))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [2 + 0j], atol=1e-6)
+
+
+def _check_frexp():
+    m, e = pt.frexp(pt.to_tensor(np.array([8.0, 0.5], "float32")))
+    nm, ne = np.frexp(np.array([8.0, 0.5], "float32"))
+    np.testing.assert_allclose(np.asarray(m.numpy()), nm)
+    np.testing.assert_array_equal(np.asarray(e.numpy()), ne)
+
+
+def _check_add_n():
+    ts = [pt.to_tensor(np.full((2, 2), float(i), "float32"))
+          for i in range(3)]
+    np.testing.assert_array_equal(np.asarray(pt.add_n(ts).numpy()),
+                                  np.full((2, 2), 3.0))
+
+
+def _check_scatter_nd():
+    out = pt.scatter_nd(pt.to_tensor(np.array([[1], [1]], "int64")),
+                        pt.to_tensor(np.array([2.0, 3.0], "float32")), [4])
+    np.testing.assert_array_equal(np.asarray(out.numpy()), [0, 5, 0, 0])
+
+
+def _check_broadcast_tensors():
+    a, b = pt.broadcast_tensors([pt.to_tensor(np.ones((1, 3), "float32")),
+                                 pt.to_tensor(np.ones((2, 1), "float32"))])
+    assert tuple(a.shape) == (2, 3) and tuple(b.shape) == (2, 3)
+
+
+def _check_vsplit():
+    parts = pt.vsplit(pt.to_tensor(np.arange(40, dtype="float32"
+                                             ).reshape(10, 4)), [2, 5])
+    assert [tuple(t.shape) for t in parts] == [(2, 4), (3, 4), (5, 4)]
+
+
+def _check_increment():
+    t = pt.to_tensor(np.array([1.0], "float32"))
+    pt.increment(t, 2.0)
+    np.testing.assert_allclose(np.asarray(t.numpy()), [3.0])
+
+
+def _check_multiplex_like_inplace(fn_name, build, expect):
+    def check():
+        t = build()
+        getattr(pt, fn_name)(t)
+        np.testing.assert_allclose(np.asarray(t.numpy()), expect)
+    return check
+
+
+CUSTOM["multiplex"] = _check_multiplex
+CUSTOM["index_add"] = _check_index_add
+CUSTOM["polar"] = _check_polar
+CUSTOM["frexp"] = _check_frexp
+CUSTOM["add_n"] = _check_add_n
+CUSTOM["scatter_nd"] = _check_scatter_nd
+CUSTOM["broadcast_tensors"] = _check_broadcast_tensors
+CUSTOM["vsplit"] = _check_vsplit
+CUSTOM["increment"] = _check_increment
+CUSTOM["tanh_"] = _check_multiplex_like_inplace(
+    "tanh_", lambda: pt.to_tensor(np.array([0.5], "float32")),
+    [np.tanh(0.5)])
+
+EXCLUDED.update({
+    # pure-python helpers over shapes/dtypes (no tensor math to check)
+    "broadcast_shape": "shape-arithmetic helper, no tensor compute",
+    "is_complex": "dtype predicate, covered by test_api_tail",
+    "is_integer": "dtype predicate, covered by test_api_tail",
+    "is_floating_point": "dtype predicate, covered by test_api_tail",
+    "rank": "metadata accessor, covered by test_api_tail",
+    "shape": "metadata accessor, covered by test_api_tail",
+    "tolist": "host conversion, covered by test_api_tail",
+    # in-place rebind variants of specced ops, covered by test_api_tail
+    "reshape_": "inplace alias of reshape",
+    "unsqueeze_": "inplace alias of unsqueeze",
+    "squeeze_": "inplace alias of squeeze",
+    "scatter_": "inplace alias of scatter",
+})

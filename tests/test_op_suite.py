@@ -51,10 +51,11 @@ def test_op_custom(name):
 
 def test_every_public_op_is_covered():
     """The harness gate: ops.__all__ ⊆ REGISTRY ∪ CUSTOM ∪ EXCLUDED."""
-    from paddle_tpu.ops import (creation, linalg, logic, manipulation, math,
-                                random, stat)
+    from paddle_tpu.ops import (creation, extras, linalg, logic,
+                                manipulation, math, random, stat)
     all_ops = set()
-    for m in (creation, linalg, logic, manipulation, math, random, stat):
+    for m in (creation, extras, linalg, logic, manipulation, math,
+              random, stat):
         all_ops |= set(m.__all__)
     covered = set(REGISTRY) | set(CUSTOM) | set(EXCLUDED)
     missing = sorted(all_ops - covered)
