@@ -7,3 +7,15 @@ these first-class here. Vision models live in paddle_tpu.vision.models.
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny, gpt3_1_3b  # noqa: F401
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1_3b"]
+from .llama import (  # noqa: F401,E402
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_tiny,
+)
+
+__all__ += ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny"]
+from .bert import (  # noqa: F401,E402
+    BertConfig, BertForPretraining, BertForSequenceClassification, BertModel,
+    bert_base, bert_tiny,
+)
+
+__all__ += ["BertConfig", "BertModel", "BertForPretraining",
+            "BertForSequenceClassification", "bert_tiny", "bert_base"]

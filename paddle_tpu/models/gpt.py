@@ -277,6 +277,14 @@ class GPTModel(nn.Layer):
             x = F.dropout(x, self.drop_p)
         x = self._seq_parallel(x)
         if self._pp > 1:
+            if self.config.recompute:
+                import warnings
+
+                warnings.warn(
+                    "GPTConfig.recompute is subsumed under pp>1: the "
+                    "pipeline schedule already remats each stage block "
+                    "(fleet/pipeline_schedule.py); the flag adds nothing",
+                    stacklevel=2)
             x = self.layers(
                 x, num_microbatches=self.config.pp_num_microbatches or self._pp)
         elif self.config.recompute:

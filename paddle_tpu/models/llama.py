@@ -1,0 +1,317 @@
+"""Llama model family — RoPE + RMSNorm + SwiGLU + grouped-query attention.
+
+Reference parity: PaddleNLP's llama modeling (the reference framework's
+flagship decoder family; the 4-D-parallel pretraining target in
+BASELINE.md). TPU-first construction mirrors models/gpt.py: Megatron
+column/row-parallel projections over the 'mp' mesh axis, optional ring
+attention over 'sep' for long context, per-block recompute, and a fully
+traceable forward so the whole train step compiles to one XLA program.
+
+GQA: ``num_key_value_heads < num_heads`` shrinks the KV projections and
+repeats KV per query group — on TPU this is a gather-free
+``jnp.repeat`` on the head axis that XLA fuses into the attention
+matmuls.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..autograd.engine import apply_op
+from ..distributed import topology
+from ..nn import functional as F
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny"]
+
+
+def _mesh_dim(name: str) -> int:
+    mesh = topology.get_mesh()
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.shape[name]
+
+
+def _normal_init(std: float):
+    return nn.initializer.Normal(mean=0.0, std=std)
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 22
+    num_heads: int = 16
+    num_key_value_heads: Optional[int] = None  # None → MHA; < heads → GQA
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    tie_word_embeddings: bool = False
+    recompute: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            # llama convention: 8/3 * h rounded up to a multiple of 256
+            self.intermediate_size = ((int(8 * self.hidden_size / 3) + 255)
+                                      // 256) * 256
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_heads
+        if self.hidden_size % self.num_heads:
+            raise ValueError("num_heads must divide hidden_size")
+        if self.num_heads % self.num_key_value_heads:
+            raise ValueError("num_key_value_heads must divide num_heads")
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    cfg = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+               num_key_value_heads=2, max_position_embeddings=128)
+    cfg.update(kw)
+    return LlamaConfig(**cfg)
+
+
+# ------------------------------------------------------------------ RoPE
+
+
+def _rope_tables(seq: int, dim: int, theta: float):
+    """cos/sin tables [S, dim/2] (precomputed per forward; XLA hoists the
+    constant computation out of the step)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32)
+                                / dim))
+    t = jnp.arange(seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, dim/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope(x, cos, sin):
+    """x: [B, S, H, D] — rotate pairs (x_even, x_odd)."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    out_even = x1 * c - x2 * s
+    out_odd = x1 * s + x2 * c
+    return jnp.stack([out_even, out_odd], axis=-1).reshape(x.shape)
+
+
+# ------------------------------------------------------------- attention
+
+
+class LlamaAttention(nn.Layer):
+    """RoPE + GQA causal attention; q/k/v column-parallel over 'mp',
+    output row-parallel (mp_layers.py layout, like GPTAttention)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.cfg = config
+        h = config.hidden_size
+        nh, nkv = config.num_heads, config.num_key_value_heads
+        self.head_dim = h // nh
+        mp = _mesh_dim("mp")
+        if nh % mp or nkv % mp:
+            raise ValueError(f"heads ({nh}) and kv heads ({nkv}) must be "
+                             f"divisible by mp degree {mp}")
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        q_out = nh * self.head_dim
+        kv_out = nkv * self.head_dim
+        if mp > 1:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+
+            def col(n_out, s):
+                return ColumnParallelLinear(
+                    h, n_out, gather_output=False, has_bias=False,
+                    weight_attr=nn.ParamAttr(initializer=_normal_init(s)))
+
+            self.q_proj = col(q_out, std)
+            self.k_proj = col(kv_out, std)
+            self.v_proj = col(kv_out, std)
+            self.o_proj = RowParallelLinear(
+                q_out, h, input_is_parallel=True, has_bias=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
+        else:
+            def lin(n_out, s):
+                return nn.Linear(h, n_out, bias_attr=False,
+                                 weight_attr=nn.ParamAttr(
+                                     initializer=_normal_init(s)))
+
+            self.q_proj = lin(q_out, std)
+            self.k_proj = lin(kv_out, std)
+            self.v_proj = lin(kv_out, std)
+            self.o_proj = nn.Linear(q_out, h, bias_attr=False,
+                                    weight_attr=nn.ParamAttr(
+                                        initializer=_normal_init(proj_std)))
+
+    def forward(self, x):
+        B, S, _ = x.shape
+        cfg = self.cfg
+        hd = self.head_dim
+        nh, nkv = cfg.num_heads, cfg.num_key_value_heads
+        groups = nh // nkv
+
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        def shape_rope_repeat(qv, kv, vv):
+            # per-shard head counts (mp shards the head axis)
+            nh_l = qv.shape[-1] // hd
+            nkv_l = kv.shape[-1] // hd
+            qh = qv.reshape(B, S, nh_l, hd)
+            kh = kv.reshape(B, S, nkv_l, hd)
+            vh = vv.reshape(B, S, nkv_l, hd)
+            cos, sin = _rope_tables(S, hd, cfg.rope_theta)
+            qh = _apply_rope(qh, cos, sin)
+            kh = _apply_rope(kh, cos, sin)
+            if groups > 1:  # GQA: repeat kv heads per query group
+                kh = jnp.repeat(kh, groups, axis=2)
+                vh = jnp.repeat(vh, groups, axis=2)
+            return qh, kh, vh
+
+        q, k, v = apply_op(shape_rope_repeat,
+                           [ensure_tensor(q), ensure_tensor(k),
+                            ensure_tensor(v)], name="llama_rope_gqa")
+
+        mesh = topology.get_mesh()
+        if (cfg.sequence_parallel and mesh is not None
+                and "sep" in mesh.axis_names and mesh.shape["sep"] > 1):
+            from ..distributed.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, causal=True, mesh=mesh)
+        elif cfg.use_flash_attention:
+            ctx = F.flash_attention(q, k, v, causal=True)
+        else:
+            ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if isinstance(ctx, tuple):
+            ctx = ctx[0]
+        merged = apply_op(lambda t: t.reshape(B, S, t.shape[2] * hd),
+                          [ensure_tensor(ctx)], name="merge_heads")
+        return self.o_proj(merged)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x)); gate/up column-parallel,
+    down row-parallel."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        mp = _mesh_dim("mp")
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        if mp > 1:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+
+            self.gate_proj = ColumnParallelLinear(
+                h, ff, gather_output=False, has_bias=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+            self.up_proj = ColumnParallelLinear(
+                h, ff, gather_output=False, has_bias=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+            self.down_proj = RowParallelLinear(
+                ff, h, input_is_parallel=True, has_bias=False,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
+        else:
+            self.gate_proj = nn.Linear(h, ff, bias_attr=False,
+                                       weight_attr=nn.ParamAttr(
+                                           initializer=_normal_init(std)))
+            self.up_proj = nn.Linear(h, ff, bias_attr=False,
+                                     weight_attr=nn.ParamAttr(
+                                         initializer=_normal_init(std)))
+            self.down_proj = nn.Linear(ff, h, bias_attr=False,
+                                       weight_attr=nn.ParamAttr(
+                                           initializer=_normal_init(proj_std)))
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        eps = config.rms_norm_eps
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        std = config.initializer_range
+        mp = _mesh_dim("mp")
+        if mp > 1:
+            from ..distributed.fleet import VocabParallelEmbedding
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+        else:
+            self.embed_tokens = nn.Embedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=_normal_init(std)))
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(ensure_tensor(input_ids))
+        if self.config.recompute:
+            from ..distributed.fleet.recompute import recompute as _rc
+
+            for layer in self.layers:
+                x = _rc(layer, x)
+        else:
+            for layer in self.layers:
+                x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(
+                config.hidden_size, config.vocab_size, bias_attr=False,
+                weight_attr=nn.ParamAttr(
+                    initializer=_normal_init(config.initializer_range)))
+
+    def logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        w = self.llama.embed_tokens.weight
+        return apply_op(lambda h, e: h @ e.T,
+                        [ensure_tensor(hidden), ensure_tensor(w)],
+                        name="tied_lm_head")
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape((-1, self.config.vocab_size)),
+            ensure_tensor(labels).reshape((-1,)))
+        return logits, loss

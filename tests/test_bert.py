@@ -1,0 +1,98 @@
+"""BERT family: embeddings, attention mask, MLM/NSP pretraining loss,
+sequence classification fine-tune loop."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.bert import (
+    BertConfig, BertForPretraining, BertForSequenceClassification, BertModel,
+    bert_tiny,
+)
+
+
+def test_bert_model_shapes_and_pooled():
+    paddle.seed(0)
+    model = BertModel(bert_tiny())
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(0, 512, (2, 24)))
+    seq, pooled = model(ids)
+    assert tuple(seq.shape) == (2, 24, 128)
+    assert tuple(pooled.shape) == (2, 128)
+    # pooled is tanh-bounded
+    assert np.all(np.abs(np.asarray(pooled.numpy())) <= 1.0)
+
+
+def test_attention_mask_blocks_padding():
+    paddle.seed(1)
+    model = BertModel(bert_tiny())
+    model.eval()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 512, (1, 16))
+    # identical content; second copy carries garbage in masked positions
+    ids2 = ids.copy()
+    ids2[0, 8:] = rng.integers(1, 512, 8)
+    mask = np.zeros((1, 16), np.int64)
+    mask[0, :8] = 1
+    out1, _ = model(paddle.to_tensor(ids),
+                    attention_mask=paddle.to_tensor(mask))
+    out2, _ = model(paddle.to_tensor(ids2),
+                    attention_mask=paddle.to_tensor(mask))
+    # masked-out positions cannot influence the visible ones
+    np.testing.assert_allclose(np.asarray(out1.numpy())[0, :8],
+                               np.asarray(out2.numpy())[0, :8],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pretraining_loss_and_ignore_index():
+    paddle.seed(2)
+    model = BertForPretraining(bert_tiny())
+    rng = np.random.default_rng(2)
+    ids = paddle.to_tensor(rng.integers(0, 512, (2, 16)))
+    mlm_labels = np.full((2, 16), -100, np.int64)
+    mlm_labels[:, 3] = 7  # one masked position per row
+    nsp = paddle.to_tensor(np.array([0, 1], np.int64))
+    (mlm, nsp_logits), loss = model(
+        ids, masked_lm_labels=paddle.to_tensor(mlm_labels),
+        next_sentence_labels=nsp)
+    assert tuple(mlm.shape) == (2, 16, 512)
+    assert tuple(nsp_logits.shape) == (2, 2)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_mlm_head_tied_to_embeddings():
+    paddle.seed(3)
+    model = BertForPretraining(bert_tiny())
+    # functional tie: writing to the embedding weight moves the MLM head
+    names = [n for n, _ in model.named_parameters()]
+    assert not any("lm_head" in n or "decoder" in n for n in names)
+    ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
+    seq, _ = model.bert(ids)
+    before = np.asarray(model.mlm_logits(seq).numpy())
+    w = model.bert.embeddings.word_embeddings.weight
+    w._set_value(w._value * 2.0)
+    after = np.asarray(model.mlm_logits(seq).numpy())
+    assert not np.allclose(before, after)
+
+
+def test_sequence_classification_trains():
+    from paddle_tpu import jit
+
+    paddle.seed(4)
+    model = BertForSequenceClassification(bert_tiny(), num_classes=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def step(ids, labels):
+        _, loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    sf = jit.StaticFunction(step, observe=[model, opt], warmup=False)
+    rng = np.random.default_rng(4)
+    # learnable rule: class = parity of first token
+    ids_np = rng.integers(0, 512, (8, 12))
+    labels_np = (ids_np[:, 0] % 2).astype(np.int64)
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(labels_np)
+    losses = [float(sf(ids, labels).numpy()) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
