@@ -62,6 +62,8 @@ from . import static  # noqa: E402
 from . import audio  # noqa: E402
 from . import geometric  # noqa: E402
 from . import callbacks  # noqa: E402
+from . import cost_model  # noqa: E402
+from . import dataset  # noqa: E402
 from . import hub  # noqa: E402
 from . import inference  # noqa: E402
 from . import linalg  # noqa: E402
@@ -73,6 +75,7 @@ from . import version  # noqa: E402
 from .utils.flops import flops  # noqa: E402
 from . import text  # noqa: E402
 from . import profiler  # noqa: E402
+from . import reader  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .framework.flags import get_flags, set_flags  # noqa: E402

@@ -1,0 +1,69 @@
+"""paddle.cost_model — measure/estimate op and program costs.
+
+Reference parity: ``python/paddle/cost_model/cost_model.py`` (CostModel:
+``profile_measure`` runs the program under the profiler and returns
+per-op time + the static op-benchmark table). TPU redesign: the cost
+oracle is XLA itself — ``profile_measure`` compiles the program and
+reads the compiler's cost analysis (flops, bytes accessed, estimated
+seconds when available), plus a wall-clock measurement of one real run.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def profile_measure(self, main_program=None, startup_program=None,
+                        device: str = "tpu",
+                        fetch_cost_list: Sequence[str] = ("time",),
+                        fn=None, example_args: tuple = ()) -> dict:
+        """Cost of one program execution.
+
+        Two entry forms: the reference's (static ``main_program`` built
+        under ``static.program_guard``) or a direct jittable ``fn`` +
+        ``example_args``.
+        Returns {"flops", "bytes_accessed", "wall_time_ms", ...}.
+        """
+        import jax
+
+        if fn is None:
+            if main_program is None:
+                raise ValueError("profile_measure needs main_program or fn")
+            from .. import static as _static
+
+            exe = _static.Executor()
+            if startup_program is not None:
+                exe.run(startup_program)
+            t0 = time.time()
+            exe.run(main_program)
+            wall_ms = (time.time() - t0) * 1000.0
+            cost = {"wall_time_ms": wall_ms}
+            analysis = getattr(main_program, "_cost_analysis", None)
+            if callable(analysis):
+                cost.update(analysis() or {})
+            return cost
+
+        jitted = jax.jit(fn)
+        lowered = jitted.lower(*example_args)
+        compiled = lowered.compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0] if analysis else {}
+        t0 = time.time()
+        out = jitted(*example_args)
+        jax.block_until_ready(out)
+        wall_ms = (time.time() - t0) * 1000.0
+        return {
+            "flops": int(analysis.get("flops", 0)),
+            "bytes_accessed": int(analysis.get("bytes accessed", 0)),
+            "wall_time_ms": wall_ms,
+            "device": jax.devices()[0].platform,
+        }
+
+    def static_cost_data(self) -> dict:
+        """The reference loads a pre-benchmarked op-cost table here; on
+        TPU the compiler's analysis replaces static tables."""
+        return {}

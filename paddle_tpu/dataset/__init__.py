@@ -1,0 +1,85 @@
+"""paddle.dataset — legacy reader-style dataset loaders.
+
+Reference parity: ``python/paddle/dataset/`` (mnist, cifar, imdb,
+uci_housing, ... exposing ``train()``/``test()`` readers). Thin facade
+over the first-class datasets in ``paddle_tpu.vision.datasets`` and
+``paddle_tpu.text``, re-shaped to the legacy contract: each loader is a
+zero-arg callable yielding samples. The zero-egress gating (local cache
+or FileNotFoundError) is inherited from those implementations.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb"]
+
+
+class _ReaderModule:
+    """Builds train()/test() readers over a Dataset class lazily."""
+
+    def __init__(self, factory, train_kw, test_kw):
+        self._factory = factory
+        self._train_kw = train_kw
+        self._test_kw = test_kw
+
+    def _reader(self, kw):
+        def reader():
+            ds = self._factory(**kw)
+            for i in range(len(ds)):
+                yield ds[i]
+
+        return reader
+
+    def train(self):
+        return self._reader(self._train_kw)
+
+    def test(self):
+        return self._reader(self._test_kw)
+
+
+def _mnist_factory(**kw):
+    from ..vision.datasets import MNIST
+
+    return MNIST(**kw)
+
+
+def _cifar_factory(**kw):
+    from ..vision.datasets import Cifar10
+
+    return Cifar10(**kw)
+
+
+mnist = _ReaderModule(_mnist_factory, {"mode": "train"}, {"mode": "test"})
+cifar = _ReaderModule(_cifar_factory, {"mode": "train"}, {"mode": "test"})
+
+
+class _UciHousing:
+    def train(self):
+        from ..text import UCIHousing
+
+        ds = UCIHousing(mode="train")
+        return lambda: iter(ds)
+
+    def test(self):
+        from ..text import UCIHousing
+
+        ds = UCIHousing(mode="test")
+        return lambda: iter(ds)
+
+
+class _Imdb:
+    def train(self, word_idx=None):
+        from ..text import Imdb
+
+        ds = Imdb(mode="train")
+        return lambda: iter(ds)
+
+    def test(self, word_idx=None):
+        from ..text import Imdb
+
+        ds = Imdb(mode="test")
+        return lambda: iter(ds)
+
+
+uci_housing = _UciHousing()
+imdb = _Imdb()

@@ -20,3 +20,32 @@ __all__ = [
     "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
     "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
 ]
+
+
+class WorkerInfo:
+    """Identity of the current DataLoader worker (reference:
+    fluid/dataloader/worker.py get_worker_info)."""
+
+    def __init__(self, id: int, num_workers: int, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+def get_worker_info():
+    """Inside a process worker: this worker's (id, num_workers, dataset);
+    in the main process: None (reference contract)."""
+    from .dataloader import _process_worker_state
+
+    st = _process_worker_state
+    if "dataset" not in st:
+        return None
+    return WorkerInfo(st.get("worker_id", 0), st.get("num_workers", 1),
+                      st.get("dataset"))
+
+
+__all__ += ["get_worker_info", "WorkerInfo"]

@@ -146,15 +146,26 @@ class _ThreadedPrefetchIter:
 _process_worker_state: dict = {}
 
 
-def _process_worker_init(dataset, init_fn):
+def _process_worker_init(dataset, init_fn, num_workers=1, id_counter=None):
     """Pool initializer: runs once per worker process (dataset pickled once,
-    not per batch)."""
+    not per batch). Worker ids come from a shared counter, NOT
+    mp.current_process()._identity — that is a parent-global counter that
+    never resets, so a second epoch's pool would see ids N..2N-1 and any
+    dataset sharding by worker id would silently go wrong."""
     _process_worker_state["dataset"] = dataset
-    if init_fn is not None:
+    _process_worker_state["num_workers"] = num_workers
+    if id_counter is not None:
+        with id_counter.get_lock():
+            wid = id_counter.value
+            id_counter.value += 1
+    else:
         import multiprocessing as mp
 
         ident = mp.current_process()._identity
-        init_fn((ident[0] - 1) if ident else 0)
+        wid = (ident[0] - 1) if ident else 0
+    _process_worker_state["worker_id"] = wid % max(num_workers, 1)
+    if init_fn is not None:
+        init_fn(_process_worker_state["worker_id"])
 
 
 def _process_fetch(indices):
@@ -181,7 +192,8 @@ class _ProcessPoolIter:
         ctx = mp.get_context("spawn")
         self._pool = ctx.Pool(
             loader.num_workers, initializer=_process_worker_init,
-            initargs=(loader.dataset, loader.worker_init_fn))
+            initargs=(loader.dataset, loader.worker_init_fn,
+                      loader.num_workers, ctx.Value("i", 0)))
         # bounded in-flight via apply_async (Pool.imap's task-feeder thread
         # drains the whole input eagerly — no backpressure, epoch-sized
         # result buildup); prefetch_factor * workers stays the cap like the
@@ -228,8 +240,9 @@ class _ProcessPoolIter:
             pass
 
 
-def _shm_worker_init(dataset, init_fn, channel_name):
-    _process_worker_init(dataset, init_fn)
+def _shm_worker_init(dataset, init_fn, channel_name, num_workers=1,
+                     id_counter=None):
+    _process_worker_init(dataset, init_fn, num_workers, id_counter)
     from .shm_channel import ShmChannel
 
     _process_worker_state["channel"] = ShmChannel(channel_name, create=False)
@@ -271,7 +284,8 @@ class _ShmProcessPoolIter:
             self._pool = ctx.Pool(
                 loader.num_workers, initializer=_shm_worker_init,
                 initargs=(loader.dataset, loader.worker_init_fn,
-                          self._channel.name))
+                          self._channel.name, loader.num_workers,
+                          ctx.Value("i", 0)))
             self._fill()
         except Exception:
             self.close()

@@ -217,3 +217,27 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: python/paddle/metric/metrics.py
+    accuracy): fraction of rows whose label is among the top-k logits."""
+    import jax.numpy as jnp
+
+    from ..autograd.engine import apply_op
+    from ..ops._apply import ensure_tensor
+
+    x = ensure_tensor(input)
+    y = ensure_tensor(label)
+
+    def fn(xv, yv):
+        import jax
+
+        _, idx = jax.lax.top_k(xv, k)
+        hit = (idx == yv.reshape(-1, 1).astype(idx.dtype)).any(axis=1)
+        return hit.astype(jnp.float32).mean(keepdims=True)
+
+    return apply_op(fn, [x, y], name="accuracy")
+
+
+__all__.append("accuracy")

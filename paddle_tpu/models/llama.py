@@ -272,8 +272,29 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size,
                                epsilon=config.rms_norm_eps)
 
+    def _seq_parallel(self, x):
+        """Pin the residual stream's sequence dim to the 'sep' axis (same
+        pattern as GPTModel._seq_parallel) — without this, ring attention's
+        shard_map boundary would reshard activations every layer."""
+        import jax
+
+        mesh = topology.get_mesh()
+        if (not self.config.sequence_parallel or mesh is None
+                or "sep" not in mesh.axis_names or mesh.shape["sep"] == 1):
+            return x
+
+        def fn(v):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(None, "sep", None)))
+
+        return apply_op(fn, [ensure_tensor(x)],
+                        name="seq_parallel_constraint")
+
     def forward(self, input_ids):
         x = self.embed_tokens(ensure_tensor(input_ids))
+        x = self._seq_parallel(x)
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute as _rc
 

@@ -11,6 +11,7 @@ from .layer_base import Layer  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 
 from .layer.activation import *  # noqa: F401,F403
+from .layer.extended import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403

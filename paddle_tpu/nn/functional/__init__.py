@@ -3,6 +3,7 @@
 reference parity: python/paddle/nn/functional/__init__.py.
 """
 from .activation import *  # noqa: F401,F403
+from .extended import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
@@ -10,9 +11,9 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 
-from . import activation, attention, common, conv, loss, norm, pooling
+from . import activation, attention, common, conv, extended, loss, norm, pooling
 
 __all__ = (
     activation.__all__ + attention.__all__ + common.__all__ + conv.__all__
-    + loss.__all__ + norm.__all__ + pooling.__all__
+    + extended.__all__ + loss.__all__ + norm.__all__ + pooling.__all__
 )

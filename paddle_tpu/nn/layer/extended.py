@@ -1,0 +1,186 @@
+"""Layer API tail: Softmax2D, HSigmoidLoss, MultiMarginLoss, RNNTLoss,
+BeamSearchDecoder + dynamic_decode.
+
+Reference parity: the remaining ``python/paddle/nn/__all__`` entries —
+activation.py Softmax2D, loss.py HSigmoidLoss/MultiMarginLoss/RNNTLoss,
+and the seq2seq decoding pair (``nn/decode.py`` BeamSearchDecoder :58 /
+dynamic_decode :1007). Decoding is a host-driven loop (the reference
+decodes step-by-step eagerly too); each step's math is jnp.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.engine import apply_op
+from ...ops._apply import ensure_tensor
+from ...tensor import Tensor
+from ..layer_base import Layer
+from ..functional import extended as FX
+
+__all__ = ["Softmax2D", "HSigmoidLoss", "MultiMarginLoss", "RNNTLoss",
+           "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        t = ensure_tensor(x)
+        if t.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects CHW or NCHW input")
+        axis = -3
+        return apply_op(lambda v: jax.nn.softmax(v, axis=axis), [t],
+                        name="softmax2d")
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference: nn/layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom: bool = False,
+                 is_sparse: bool = False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=None)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1, 1], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FX.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                                bias=self.bias, path_table=path_table,
+                                path_code=path_code)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p: int = 1, margin: float = 1.0, weight=None,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return FX.multi_margin_loss(input, label, p=self.p,
+                                    margin=self.margin, weight=self.weight,
+                                    reduction=self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank: int = 0, fastemit_lambda: float = 0.0,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return FX.rnnt_loss(input, label, input_lengths, label_lengths,
+                            blank=self.blank,
+                            fastemit_lambda=self.fastemit_lambda,
+                            reduction=self.reduction)
+
+
+class BeamSearchDecoder:
+    """Beam search over a step cell (reference: nn/decode.py:58).
+
+    ``cell``: callable (inputs [B*W, E], states) → (logits-or-hidden,
+    new_states); ``output_fn`` maps cell output to vocab logits when the
+    cell itself doesn't. Embeddings come from ``embedding_fn``.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -------------------------------------------------------------
+    def _tile(self, state, W):
+        def tile(v):
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            return jnp.repeat(v, W, axis=0)
+
+        return jax.tree_util.tree_map(tile, state)
+
+    def initialize(self, initial_states, batch_size: int):
+        W = self.beam_size
+        states = self._tile(initial_states, W)
+        tokens = jnp.full((batch_size * W,), self.start_token, jnp.int64)
+        # only beam 0 live at t=0 (all beams identical otherwise)
+        probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (W - 1), jnp.float32),
+            (batch_size,))
+        finished = jnp.zeros((batch_size * W,), bool)
+        return tokens, states, probs, finished
+
+    def step(self, tokens, states, log_probs, finished, batch_size: int):
+        W = self.beam_size
+        inputs = Tensor(tokens) if self.embedding_fn is None \
+            else self.embedding_fn(Tensor(tokens, stop_gradient=True))
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        lv = logits._value if isinstance(logits, Tensor) \
+            else jnp.asarray(logits)
+        logp = jax.nn.log_softmax(lv.astype(jnp.float32), axis=-1)
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at no cost
+        fin_mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], fin_mask[None, :], logp)
+        total = log_probs[:, None] + logp          # [B*W, V]
+        total = total.reshape(batch_size, W * V)
+        top, idx = jax.lax.top_k(total, W)         # [B, W]
+        beam_idx = idx // V                        # source beam per winner
+        token_idx = idx % V
+        flat_src = (jnp.arange(batch_size)[:, None] * W
+                    + beam_idx).reshape(-1)
+
+        def gather_state(v):
+            return v[flat_src]
+
+        new_states = jax.tree_util.tree_map(
+            lambda v: gather_state(v._value if isinstance(v, Tensor) else
+                                   jnp.asarray(v)), new_states)
+        tokens = token_idx.reshape(-1).astype(jnp.int64)
+        finished = finished[flat_src] | (tokens == self.end_token)
+        return tokens, new_states, top.reshape(-1), finished, flat_src
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 32, batch_size: int = 1,
+                   **kwargs):
+    """Run the decoder until every beam finishes or max steps (reference:
+    nn/decode.py dynamic_decode :1007). Returns (ids [B, W, T],
+    log_probs [B, W])."""
+    tokens, states, probs, finished = decoder.initialize(inits, batch_size)
+    W = decoder.beam_size
+    step_tokens = []
+    step_parents = []
+    for _ in range(max_step_num):
+        tokens, states, probs, finished, src = decoder.step(
+            tokens, states, probs, finished, batch_size)
+        step_tokens.append(tokens.reshape(batch_size, W))
+        # parent beam index within each batch row
+        step_parents.append(src.reshape(batch_size, W)
+                            - jnp.arange(batch_size)[:, None] * W)
+        if bool(jax.device_get(finished.all())):
+            break
+    ids = jnp.stack(step_tokens)                    # [T, B, W]
+    parents = jnp.stack(step_parents)               # [T, B, W]
+    full = FX.gather_tree(Tensor(ids), Tensor(parents))
+    ids_out = jnp.moveaxis(full._value, 0, -1)      # [B, W, T]
+    return (Tensor(ids_out),
+            Tensor(probs.reshape(batch_size, W)))

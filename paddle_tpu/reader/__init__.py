@@ -1,0 +1,247 @@
+"""paddle.reader — composable reader decorators (legacy data pipeline).
+
+Reference parity: ``python/paddle/reader/decorator.py`` (cache,
+map_readers, shuffle, chain, compose, buffered, firstn, xmap_readers,
+multiprocess_reader). A "reader" is a zero-arg callable returning an
+iterable of samples; decorators wrap readers into new readers. Kept for
+scripts written against the legacy pipeline — paddle_tpu.io.DataLoader
+is the first-class path.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Cache the full pass in memory; later passes replay it."""
+    all_data = []
+    filled = []
+
+    def reader_():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+
+    return reader_
+
+
+def map_readers(func, *readers):
+    """Zip readers and map ``func`` over the sample tuples."""
+
+    def reader_():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader_
+
+
+def shuffle(reader, buf_size):
+    """Reservoir-style windowed shuffle of ``buf_size`` samples."""
+
+    def reader_():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+
+    def reader_():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader_
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples; ``check_alignment=True`` (default)
+    raises ComposeNotAligned when lengths differ."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader_():
+        its = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*its):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader_
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer with a ``size``-bounded queue fed by a
+    daemon thread (keeps IO ahead of compute)."""
+
+    class _End:
+        pass
+
+    class _Error:
+        def __init__(self, tb):
+            self.tb = tb
+
+    def reader_():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(_End)
+            except Exception:
+                import traceback
+
+                q.put(_Error(traceback.format_exc()))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            if isinstance(item, _Error):
+                raise RuntimeError(f"buffered reader failed:\n{item.tb}")
+            yield item
+
+    return reader_
+
+
+def firstn(reader, n):
+    """First ``n`` samples only."""
+
+    def reader_():
+        return itertools.islice(reader(), n)
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with ``process_num`` worker threads
+    (reference uses threads here too; heavy decode belongs in
+    io.DataLoader's process workers)."""
+
+    def reader_():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is end:
+                    out_q.put(end)
+                    return
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            stash = {}
+            want = 0
+            while finished < process_num:
+                got = out_q.get()
+                if got is end:
+                    finished += 1
+                    continue
+                i, mapped = got
+                stash[i] = mapped
+                while want in stash:
+                    yield stash.pop(want)
+                    want += 1
+            for i in sorted(stash):
+                yield stash[i]
+        else:
+            while finished < process_num:
+                got = out_q.get()
+                if got is end:
+                    finished += 1
+                    continue
+                yield got[1]
+
+    return reader_
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave readers, each producing from its own process
+    (reference: decorator.py:499). Samples must be picklable."""
+    import multiprocessing as mp
+
+    def reader_():
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue(queue_size)
+        sentinel = "__PADDLE_TPU_READER_END__"
+
+        procs = [ctx.Process(target=_mp_feed, args=(r, q, sentinel),
+                             daemon=True) for r in readers]
+        for p in procs:
+            p.start()
+        ended = 0
+        error = None
+        while ended < len(readers):
+            item = q.get()
+            if isinstance(item, str) and item == sentinel:
+                ended += 1
+                continue
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == sentinel:
+                ended += 1
+                error = item[1]
+                continue
+            yield item
+        for p in procs:
+            p.join()
+        if error is not None:
+            raise RuntimeError(f"multiprocess reader failed:\n{error}")
+
+    return reader_
+
+
+def _mp_feed(reader, q, sentinel):
+    try:
+        for item in reader():
+            q.put(item)
+        q.put(sentinel)
+    except Exception:
+        import traceback
+
+        # sentinel ALWAYS lands (a silent child death would hang the
+        # consumer); the error rides along and re-raises parent-side
+        q.put((sentinel, traceback.format_exc()))

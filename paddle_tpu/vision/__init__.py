@@ -1,6 +1,8 @@
 """paddle_tpu.vision (reference: python/paddle/vision/ — models, transforms,
 datasets, ops; SURVEY.md §2.4)."""
 from . import datasets, models, ops, transforms  # noqa: F401
+from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
 from .models import *  # noqa: F401,F403
 
-__all__ = ["models", "transforms", "datasets", "ops"]
+__all__ = ["models", "transforms", "datasets", "ops",
+           "set_image_backend", "get_image_backend", "image_load"]

@@ -1,0 +1,47 @@
+"""Image backend selection + loading (reference: vision/image.py).
+
+Backends: 'pil' (default; requires Pillow) and 'cv2' (requires OpenCV).
+Neither is guaranteed in this image — backends import lazily and raise
+a clear error when absent; 'tensor'-style numpy loading always works
+for .npy files.
+"""
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    global _backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    _backend = backend
+
+
+def get_image_backend() -> str:
+    return _backend
+
+
+def image_load(path: str, backend=None):
+    """Load an image with the selected backend (PIL Image or cv2 ndarray);
+    .npy arrays load regardless of backend availability."""
+    b = backend or _backend
+    if path.endswith(".npy"):
+        import numpy as np
+
+        return np.load(path)
+    if b == "pil":
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise ImportError(
+                "image_load backend 'pil' needs Pillow; this image has no "
+                "network egress to install it — use .npy inputs or cv2"
+            ) from e
+        return Image.open(path)
+    try:
+        import cv2
+    except ImportError as e:
+        raise ImportError("image_load backend 'cv2' needs OpenCV") from e
+    return cv2.imread(path)
