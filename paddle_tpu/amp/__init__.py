@@ -264,3 +264,19 @@ class GradScaler:
         if "decr_count" in state:
             v = state["decr_count"]
             self._bad_steps._set_value(v._value if isinstance(v, Tensor) else jnp.int32(v))
+
+
+def is_float16_supported(device=None) -> bool:
+    """reference: amp/__init__ is_float16_supported. TPUs compute in
+    bf16; fp16 storage works but the MXU fast path is bf16."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is THE native TPU compute dtype; CPU XLA supports it too."""
+    return True
+
+
+__all__ += ["is_float16_supported", "is_bfloat16_supported"]

@@ -7,6 +7,11 @@ structured sparsity), ``optimizer`` (LookAhead / ModelAverage / LBFGS),
 ``autotune`` (kernel/layout/dataloader tuning config).
 """
 from . import asp  # noqa: F401
+from ._ops import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, segment_max, segment_mean, segment_min,
+    segment_sum, softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
@@ -16,4 +21,8 @@ from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
 
 __all__ = ["autograd", "distributed", "asp", "nn", "optimizer",
            "LookAhead", "ModelAverage", "LBFGS", "set_config",
-           "autotune_status"]
+           "autotune_status", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+           "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "identity_loss"]

@@ -1,0 +1,164 @@
+"""Incubate top-level ops (reference: python/paddle/incubate/__init__.py
+__all__): fused softmax-mask, graph ops (aliases of paddle.geometric's
+implementations — the reference later graduated them there too),
+segment reductions, identity_loss.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..ops._apply import ensure_tensor
+
+__all__ = [
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "identity_loss",
+]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one region (reference: fused softmax_mask
+    CUDA kernel; XLA fuses the add into the softmax)."""
+    return apply_op(
+        lambda v, m: jax.nn.softmax(
+            v.astype(jnp.float32) + m.astype(jnp.float32),
+            axis=-1).astype(v.dtype),
+        [ensure_tensor(x), ensure_tensor(mask)], name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference: softmax_mask_fuse_upper_triangle):
+    positions above the diagonal are masked out."""
+
+    def fn(v):
+        s = v.shape[-1]
+        causal = jnp.tril(jnp.ones((v.shape[-2], s), bool))
+        logits = jnp.where(causal, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+
+    return apply_op(fn, [ensure_tensor(x)],
+                    name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (reference: identity_loss op, IPU-oriented;
+    semantically a reduction passthrough)."""
+    t = ensure_tensor(x)
+    if reduction in (0, "sum"):
+        return t.sum()
+    if reduction in (1, "mean"):
+        return t.mean()
+    if reduction in (2, "none"):
+        return t
+    raise ValueError(f"bad reduction {reduction!r}")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    incubate/operators/graph_khop_sampler.py:109 — returns
+    ``(edge_src, edge_dst, sample_index, reindex_nodes)``: sampled edges
+    reindexed to local ids, the unique original node ids, and the input
+    nodes' local positions)."""
+    import numpy as np
+
+    import jax
+
+    from ..geometric import sample_neighbors
+    from ..tensor import Tensor
+
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True): edge-id tracking is not "
+            "implemented; sample without eids")
+
+    def host(x):
+        return np.asarray(jax.device_get(
+            x._value if isinstance(x, Tensor) else x))
+
+    nodes = host(input_nodes).astype(np.int64)
+    srcs, dsts = [], []
+    frontier = nodes
+    for size in sample_sizes:
+        out = sample_neighbors(row, colptr, frontier, sample_size=size)
+        neigh = host(out[0]).astype(np.int64)
+        counts = host(out[1]).astype(np.int64)
+        dst = np.repeat(frontier, counts)
+        srcs.append(neigh)
+        dsts.append(dst)
+        # next hop expands from the NEW nodes only (reference behavior:
+        # frontier grows without resampling already-expanded nodes)
+        frontier = np.setdiff1d(np.unique(neigh),
+                                np.concatenate([nodes, *srcs[:-1]])
+                                if srcs[:-1] else nodes)
+        if frontier.size == 0:
+            break
+    edge_src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    edge_dst = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+    # unique node table with input nodes first (their local ids = 0..n-1)
+    rest = np.setdiff1d(np.unique(np.concatenate([edge_src, edge_dst]))
+                        if edge_src.size else nodes, nodes)
+    sample_index = np.concatenate([nodes, rest])
+    lookup = {int(g): i for i, g in enumerate(sample_index)}
+    remap = np.vectorize(lambda g: lookup[int(g)], otypes=[np.int64])
+    edge_src_l = remap(edge_src) if edge_src.size else edge_src
+    edge_dst_l = remap(edge_dst) if edge_dst.size else edge_dst
+    reindex_nodes = np.arange(nodes.size, dtype=np.int64)
+    import jax.numpy as jnp
+
+    return (Tensor(jnp.asarray(edge_src_l)), Tensor(jnp.asarray(edge_dst_l)),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(reindex_nodes)))
+
+
+def segment_sum(data, segment_ids, name=None):
+    from ..geometric import segment_sum as _f
+
+    return _f(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..geometric import segment_mean as _f
+
+    return _f(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..geometric import segment_max as _f
+
+    return _f(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..geometric import segment_min as _f
+
+    return _f(data, segment_ids)

@@ -19,8 +19,12 @@ from .layer import (  # noqa: F401
     FusedTransformerEncoderLayer,
 )
 
+from . import functional  # noqa: F401
+from .layer import FusedEcMoe  # noqa: F401
+
 __all__ = [
     "FusedMultiHeadAttention", "FusedFeedForward",
     "FusedTransformerEncoderLayer", "FusedMultiTransformer", "FusedLinear",
-    "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+    "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+    "functional",
 ]

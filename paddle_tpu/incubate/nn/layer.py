@@ -302,3 +302,31 @@ class FusedMultiTransformer(Layer):
             x, nc = b(x, src_mask=attn_mask, cache=c)
             new_caches.append(nc)
         return x, new_caches
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE layer (reference: incubate/nn/layer/fused_ec_moe.py
+    FusedEcMoe). Holds gate + per-expert FFN weights; forward delegates to
+    the functional fused_ec_moe."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.act_type = act_type
+        self.bmm_weight0 = _uniform_param([num_experts, hidden_size,
+                                           inter_size], hidden_size)
+        self.bmm_bias0 = Parameter(jnp.zeros((num_experts, inter_size),
+                                             "float32"))
+        self.bmm_weight1 = _uniform_param([num_experts, inter_size,
+                                           hidden_size], inter_size)
+        self.bmm_bias1 = Parameter(jnp.zeros((num_experts, hidden_size),
+                                             "float32"))
+
+    def forward(self, x, gate):
+        # reference contract (fused_ec_moe.py:92): the gate logits tensor
+        # [bsz, seq, num_experts] comes from the caller's gate network
+        from .functional import fused_ec_moe
+
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1,
+                            act_type=self.act_type)

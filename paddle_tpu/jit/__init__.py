@@ -25,6 +25,7 @@ from .static_function import InputSpec, StaticFunction, _flatten_out, _rebuild_o
 __all__ = [
     "to_static", "not_to_static", "save", "load", "TranslatedLayer",
     "StaticFunction", "InputSpec", "enable_to_static", "ignore_module",
+    "set_code_level", "set_verbosity",
 ]
 
 _to_static_enabled = True
@@ -181,3 +182,18 @@ def load(path: str) -> TranslatedLayer:
         params = pickle.load(f)
     exported = jax.export.deserialize(prog["stablehlo"])
     return TranslatedLayer(exported, prog["out_spec"], params)
+
+
+_sot_config = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """reference: jit/sot set_code_level — controls dumping of generated
+    bytecode. This build traces through jax (no bytecode rewriting), so
+    the knob is recorded for API parity and feeds jit debug logging."""
+    _sot_config["code_level"] = int(level)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """reference: jit/sot set_verbosity — dy2static log verbosity."""
+    _sot_config["verbosity"] = int(level)

@@ -29,11 +29,31 @@ from ..ops._apply import ensure_tensor
 from ..tensor import Parameter, Tensor
 from .. import dtypes as _dtypes
 
+from .legacy import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy,
+    ExponentialMovingAverage, Print, Variable, WeightNormParamAttr,
+    accuracy, append_backward, auc, create_global_var, create_parameter,
+    ctr_metric_bundle, deserialize_persistables, deserialize_program,
+    device_guard, exponential_decay, gradients, load, load_from_file,
+    load_program_state, name_scope, normalize_program, py_func, save,
+    save_to_file, scope_guard, serialize_persistables, serialize_program,
+    set_program_state,
+)
+
 __all__ = [
     "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "InputSpec",
     "save_inference_model", "load_inference_model", "cpu_places",
     "cuda_places", "xpu_places", "global_scope",
+    "append_backward", "gradients", "scope_guard", "name_scope",
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram", "Print",
+    "py_func", "WeightNormParamAttr", "ExponentialMovingAverage",
+    "save", "load", "save_to_file", "load_from_file",
+    "serialize_program", "serialize_persistables", "deserialize_program",
+    "deserialize_persistables", "set_program_state", "normalize_program",
+    "Variable", "create_global_var", "create_parameter", "device_guard",
+    "load_program_state", "accuracy", "auc", "exponential_decay",
+    "ctr_metric_bundle",
 ]
 
 
@@ -62,6 +82,51 @@ class Program:
     @property
     def var_names(self):
         return list(self.placeholders)
+
+    # -- parameter snapshot (static/legacy.py save/load & serialization) ----
+    def _program_parameters(self) -> list:
+        """Parameters reachable from the declared objective."""
+        if self.optimizer is not None and \
+                getattr(self.optimizer, "_parameter_list", None):
+            return list(self.optimizer._parameter_list)
+        if self.loss is not None:
+            return _collect_parameters(self.loss)
+        return []
+
+    def _param_key(self, i: int, p) -> str:
+        name = getattr(p, "name", None)
+        return name if name else f"param_{i}"
+
+    def _param_state(self) -> dict:
+        import numpy as _np
+
+        return {self._param_key(i, p): _np.asarray(p._value)
+                for i, p in enumerate(self._program_parameters())}
+
+    def _set_param_state(self, state: dict) -> None:
+        import jax.numpy as _jnp
+
+        params = self._program_parameters()
+        used = set()
+        for i, p in enumerate(params):
+            for key in (self._param_key(i, p), f"param_{i}"):
+                if key in state:
+                    p._set_value(_jnp.asarray(state[key], p._value.dtype))
+                    used.add(key)
+                    break
+        unused = set(state) - used
+        if unused:
+            # reference set_program_state errors on unused keys — silent
+            # partial loads are how wrong checkpoints sneak into evals
+            raise ValueError(
+                f"state dict keys not matched to any program parameter: "
+                f"{sorted(unused)[:8]}{'...' if len(unused) > 8 else ''}")
+
+    def _placeholder_spec(self) -> dict:
+        return {name: {"shape": list(self.declared_shapes.get(
+                           name, tuple(t.shape))),
+                       "dtype": str(t.dtype)}
+                for name, t in self.placeholders.items()}
 
 
 _default_main = Program()

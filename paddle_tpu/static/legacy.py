@@ -1,0 +1,437 @@
+"""static facade tail — legacy program-manipulation API.
+
+Reference parity: the remainder of ``python/paddle/static/__all__`` —
+append_backward/gradients (fluid/backward.py), scope_guard/name_scope,
+CompiledProgram/BuildStrategy/ExecutionStrategy (program wrappers whose
+graph passes XLA performs), Print/py_func, WeightNormParamAttr,
+ExponentialMovingAverage, serialize/deserialize + save/load of programs.
+The Ipu* entries are deliberately absent: IPU hardware support is not a
+capability of this TPU framework (a loud ImportError beats a stub).
+"""
+from __future__ import annotations
+
+import pickle
+from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "append_backward", "gradients", "scope_guard", "name_scope",
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram", "Print",
+    "py_func", "WeightNormParamAttr", "ExponentialMovingAverage",
+    "save", "load", "save_to_file", "load_from_file",
+    "serialize_program", "serialize_persistables", "deserialize_program",
+    "deserialize_persistables", "set_program_state", "normalize_program",
+    "Variable", "create_global_var", "create_parameter", "device_guard",
+    "load_program_state", "accuracy", "auc", "exponential_decay",
+    "ctr_metric_bundle",
+]
+
+
+# ------------------------------------------------------------- autograd
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Record grads for a declarative loss (reference: fluid/backward.py
+    append_backward). In this build the tape IS the program: running
+    backward materializes grads on the parameters; returns
+    [(param, grad)] like the reference."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        from paddle_tpu.static import _collect_parameters
+
+        params = _collect_parameters(loss)
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic-style grads of targets w.r.t. inputs (reference:
+    static/gradients → paddle.grad under the hood here)."""
+    from ..autograd import grad
+
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+# ------------------------------------------------------------- scoping
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_scope_stack = [_Scope()]
+
+
+@contextmanager
+def scope_guard(scope):
+    """reference: static/scope_guard — variable scope isolation."""
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+_name_scope_stack = []
+
+
+@contextmanager
+def name_scope(prefix: str = None):
+    """reference: static/name_scope — op-name prefixes for debugging."""
+    _name_scope_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+# ----------------------------------------------------- program wrappers
+
+
+class BuildStrategy:
+    """Graph-build options (reference: BuildStrategy over the SSA graph
+    passes). XLA performs fusion/memory passes; the knobs are recorded
+    so reference configs parse."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.build_cuda_graph = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """reference: CompiledProgram — a Program + build strategy; execution
+    still goes through Executor (which jits either way)."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+# ------------------------------------------------------------ debug ops
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Host-side tensor print (reference: Print op). Eagerly prints and
+    returns the input (identity in the graph)."""
+    import jax
+
+    from ..tensor import Tensor
+
+    def cb(v):
+        head = message or "Print"
+        print(f"{head}: shape={list(v.shape)} dtype={v.dtype}")
+        flat = np.asarray(v).reshape(-1)
+        if summarize >= 0:
+            flat = flat[:summarize]
+        print(f"  data: {flat}")
+        return v
+
+    t = input if isinstance(input, Tensor) else Tensor(input)
+    if hasattr(t._value, "addressable_shards") or not isinstance(
+            t._value, jax.core.Tracer):
+        cb(jax.device_get(t._value))
+        return t
+    # under trace: host callback keeps the print in the compiled program
+    from ..autograd.engine import apply_op
+
+    def fn(v):
+        jax.debug.callback(cb, v)
+        return v
+
+    return apply_op(fn, [t], name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a python function inside the program (reference: py_func op).
+    Eager execution calls it directly; under jit it becomes a
+    jax.pure_callback with the declared output spec."""
+    import jax
+
+    from ..autograd.engine import apply_op
+    from ..ops._apply import ensure_tensor
+    from ..tensor import Tensor
+
+    xs = [ensure_tensor(t) for t in (x if isinstance(x, (list, tuple))
+                                     else [x])]
+    out_spec = out
+
+    def fn(*vals):
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            spec = jax.ShapeDtypeStruct(tuple(out_spec.shape), out_spec.dtype)
+            return jax.pure_callback(
+                lambda *a: np.asarray(func(*[Tensor(np.asarray(x_)) for x_
+                                             in a]).numpy()), spec, *vals)
+        res = func(*[Tensor(v) for v in vals])
+        return res._value if isinstance(res, Tensor) else res
+
+    return apply_op(fn, xs, name="py_func")
+
+
+# ------------------------------------------------------------- training
+
+
+class WeightNormParamAttr:
+    """reference: static/WeightNormParamAttr — ParamAttr triggering weight
+    normalization; maps onto nn.utils.weight_norm in this build."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: static/ExponentialMovingAverage):
+    ``update()`` after each step; ``apply()`` context swaps EMA weights
+    in for evaluation; ``restore()`` undoes."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema: dict = {}
+        self._backup: dict = {}
+        self._params: list = []
+        self._step = 0
+
+    def _track(self, params):
+        for p in params:
+            if p._uid not in self._ema:
+                self._params.append(p)
+                self._ema[p._uid] = p._value
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        if parameters is not None:
+            self._track(parameters)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            self._ema[p._uid] = (d * self._ema[p._uid]
+                                 + (1.0 - d) * p._value)
+
+    @contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[p._uid] = p._value
+            p._set_value(self._ema[p._uid])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if p._uid in self._backup:
+                p._set_value(self._backup.pop(p._uid))
+
+
+# ------------------------------------------------- program serialization
+
+
+class Variable:
+    """Lightweight named value descriptor (reference: framework Variable;
+    here the placeholders created by static.data serve the role — this
+    class types them for isinstance checks in ported code)."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
+    """Program metadata → bytes (reference: static/io.py
+    serialize_program). The compiled-artifact form of a program is
+    save_inference_model's StableHLO file; this serializes the
+    placeholder interface the way the reference serializes the
+    ProgramDesc."""
+    from . import default_main_program
+
+    prog = default_main_program()
+    return pickle.dumps({"program": prog._placeholder_spec()})
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs) -> bytes:
+    from . import default_main_program
+
+    prog = default_main_program()
+    return pickle.dumps(prog._param_state())
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)["program"]
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    return pickle.loads(data)
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_prefix: str, protocol: int = 4) -> None:
+    """reference: static/save — program + parameters to <prefix>.pdmodel/
+    .pdiparams (parameters via the tape's state snapshot)."""
+    state = program._param_state() if hasattr(program, "_param_state") else {}
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_prefix: str, executor=None, var_list=None) -> None:
+    with open(model_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    if hasattr(program, "_set_param_state"):
+        program._set_param_state(state)
+
+
+def set_program_state(program, state_dict) -> None:
+    if hasattr(program, "_set_param_state"):
+        program._set_param_state(state_dict)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: static/normalize_program — prune to the feed→fetch
+    subgraph; the recorded placeholder graph is already minimal."""
+    return program
+
+
+# ------------------------------------------------------------ var helpers
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: static/create_global_var — a persistent filled tensor."""
+    import jax.numpy as jnp
+
+    from ..dtypes import convert_dtype
+    from ..tensor import Tensor
+
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)), stop_gradient=False)
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.core_api import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+@contextmanager
+def device_guard(device=None):
+    """reference: static/device_guard — pin ops to a device. TPU build:
+    'cpu' pins to host, anything else stays on the default device."""
+    import jax
+
+    if device and str(device).startswith("cpu"):
+        with jax.default_device(jax.devices("cpu")[0]):
+            yield
+    else:
+        yield
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static/load_program_state — read a saved param state."""
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference: static/auc). Returns the AUC over this
+    batch's predictions (stateful accumulation lives in metric.Auc)."""
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    import numpy as np_
+
+    m.update(np.asarray(input.numpy() if hasattr(input, "numpy") else input),
+             np_.asarray(label.numpy() if hasattr(label, "numpy")
+                         else label))
+    from ..tensor import Tensor
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float64))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """reference: fluid/layers exponential_decay → an LR scheduler."""
+    from ..optimizer.lr import ExponentialDecay
+
+    del decay_steps, staircase  # per-epoch semantics in the LR API
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference: static/ctr_metric_bundle — (auc, precision-ish bundle)
+    for CTR models; returns (auc, sqrerr, abserr, prob, q, pos, total)."""
+    import numpy as np_
+
+    from ..tensor import Tensor
+    import jax.numpy as jnp
+
+    pred = np_.asarray(input.numpy() if hasattr(input, "numpy") else input
+                       ).reshape(-1)
+    lab = np_.asarray(label.numpy() if hasattr(label, "numpy") else label
+                      ).reshape(-1)
+    sqrerr = float(((pred - lab) ** 2).sum())
+    abserr = float(np_.abs(pred - lab).sum())
+    q = float(pred.sum())
+    pos = float(lab.sum())
+    total = float(lab.size)
+    auc_v = float(np_.asarray(auc(Tensor(jnp.asarray(pred)),
+                                  Tensor(jnp.asarray(lab.astype("int64")))
+                                  ).numpy()))
+    mk = lambda v: Tensor(jnp.asarray(v))
+    return (mk(auc_v), mk(sqrerr), mk(abserr), mk(q / max(total, 1)),
+            mk(q), mk(pos), mk(total))
