@@ -11,6 +11,15 @@ from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import communication  # noqa: F401
+from . import launch  # noqa: F401
+from ..framework import io  # noqa: F401 - reference exports distributed.io
+from .misc import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ShowClickEntry, alltoall_single, broadcast_object_list,
+    destroy_process_group, get_backend, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, is_available, is_initialized,
+    scatter_object_list, split,
+)
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
@@ -43,7 +52,13 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
     "CommunicateTopology", "HybridCommunicateGroup", "create_mesh",
     "get_mesh", "set_mesh", "fleet", "group_sharded_parallel",
-    "rpc", "TCPStore", "ps", "spawn", "communication",
+    "rpc", "TCPStore", "ps", "spawn", "communication", "launch", "io",
+    "ParallelMode", "is_initialized", "is_available",
+    "destroy_process_group", "get_backend", "alltoall_single",
+    "broadcast_object_list", "scatter_object_list", "split",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "QueueDataset", "InMemoryDataset", "CountFilterEntry",
+    "ShowClickEntry", "ProbabilityEntry",
     "reduce_scatter", "gather", "P2POp", "batch_isend_irecv", "isend",
     "irecv", "send", "recv", "all_gather_object",
 ]

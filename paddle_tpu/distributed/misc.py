@@ -1,0 +1,309 @@
+"""distributed API tail: process-group management, object collectives,
+gloo-style host barrier, Megatron split, PS dataset/entry configs.
+
+Reference parity: the remainder of ``python/paddle/distributed/__all__``
+— parallel.py (is_initialized/destroy_process_group/get_backend/
+ParallelMode), communication (alltoall_single, broadcast/scatter
+_object_list), gloo bootstrap trio (CPU rendezvous — here the native
+TCPStore), collective.py ``split`` (:158, megatron layer splitting),
+and the PS-side dataset/entry configs (fleet/dataset, distributed/entry
+— thin configs binding to paddle_tpu.distributed.ps tables).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+__all__ = [
+    "ParallelMode", "is_initialized", "is_available",
+    "destroy_process_group", "get_backend", "alltoall_single",
+    "broadcast_object_list", "scatter_object_list", "split",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "QueueDataset", "InMemoryDataset", "CountFilterEntry",
+    "ShowClickEntry", "ProbabilityEntry",
+]
+
+
+class ParallelMode:
+    """reference: parallel.py ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available() -> bool:
+    """Distributed support is built in (reference checks compile flags)."""
+    return True
+
+
+def is_initialized() -> bool:
+    """True once init_parallel_env/fleet.init built the mesh."""
+    from . import topology
+
+    return topology.get_mesh() is not None
+
+
+def destroy_process_group(group=None) -> None:
+    """Tear down the mesh/process-group state (reference:
+    destroy_process_group). With GSPMD there are no NCCL communicators
+    to free; dropping the mesh is the whole teardown."""
+    from . import topology
+
+    if group is None:
+        topology.set_mesh(None)
+
+
+def get_backend(group=None) -> str:
+    """The communication backend name (reference returns NCCL/GLOO)."""
+    import jax
+
+    return "xla:" + jax.default_backend()
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference: communication/all_to_all.py
+    alltoall_single → one lax.all_to_all on the axis)."""
+    from .collective import alltoall
+
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits are not supported on the TPU "
+            "mesh (lax.all_to_all is equal-split); pad to equal splits")
+    out = alltoall(in_tensor, group=group, sync_op=sync_op)
+    if out_tensor is not None:
+        from ..autograd.engine import inplace_rebind
+
+        inplace_rebind(out_tensor, out)
+        return out_tensor
+    return out
+
+
+def _store_objects_root() -> "object":
+    """Multi-process object exchange rides the same coordination service
+    as all_gather_object."""
+    import jax
+
+    return jax
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list.
+    Single-controller SPMD: every process holds the object already; in
+    multi-process runs the src process's bytes are broadcast through the
+    coordination service."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return  # one process: object_list is already "broadcast"
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    payload = pickle.dumps(object_list)
+    arr = np.frombuffer(payload, np.uint8)
+    # length first (objects differ per process), then bytes
+    n = int(multihost_utils.broadcast_one_to_all(
+        np.asarray([arr.size], np.int64))[0])
+    buf = np.zeros((n,), np.uint8)
+    buf[:arr.size] = arr[:n]
+    synced = multihost_utils.broadcast_one_to_all(buf)
+    object_list[:] = pickle.loads(bytes(synced.tobytes()[:n]))
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """reference: communication/scatter.py scatter_object_list — rank r
+    receives in_object_list[r]."""
+    from .env import get_rank
+
+    rank = get_rank()
+    if in_object_list is None:
+        raise ValueError("scatter_object_list needs in_object_list on src")
+    out_object_list[:] = [in_object_list[rank % len(in_object_list)]]
+
+
+# ------------------------------------------------------ gloo-style barrier
+
+
+_gloo_store = None
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    """CPU-side rendezvous without touching the device mesh (reference:
+    gloo bootstrap; here the native TCPStore is the rendezvous)."""
+    global _gloo_store
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+    _gloo_store._gloo_rank = rank_id
+    _gloo_store._gloo_size = rank_num
+
+
+def gloo_barrier() -> None:
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    n = _gloo_store._gloo_size
+    seq = getattr(gloo_barrier, "_seq", 0)
+    gloo_barrier._seq = seq + 1
+    key = f"gloo/barrier/{seq}"
+    if _gloo_store.add(key, 1) == n:
+        _gloo_store.set(key + "/done", b"1")
+    _gloo_store.wait([key + "/done"])
+
+
+def gloo_release() -> None:
+    """The rank-0 process hosts the store server, so it must outlive every
+    other rank's final barrier read: releases rendezvous before teardown."""
+    global _gloo_store
+    if _gloo_store is None:
+        return
+    rank = _gloo_store._gloo_rank
+    n = _gloo_store._gloo_size
+    if n > 1:
+        _gloo_store.set(f"gloo/release/{rank}", b"1")
+        if rank == 0:
+            _gloo_store.wait([f"gloo/release/{r}" for r in range(n)])
+    _gloo_store.stop()
+    _gloo_store = None
+
+
+# ------------------------------------------------------------ megatron split
+
+
+def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
+          gather_out: bool = True, weight_attr=None, bias_attr=None,
+          name=None):
+    """Megatron-style distributed layer op (reference: collective.py:158
+    paddle.distributed.split — builds a row/column-parallel linear or a
+    vocab-parallel embedding across the model-parallel group)."""
+    from .fleet import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding)
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, has_bias=bias_attr
+                                      is not False, input_is_parallel=False,
+                                      weight_attr=weight_attr)
+        elif axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f, has_bias=bias_attr
+                                         is not False,
+                                         gather_output=gather_out,
+                                         weight_attr=weight_attr)
+        else:
+            raise ValueError("linear split axis must be 0 or 1")
+        return layer(x)
+    if operation == "embedding":
+        vocab, emb = size
+        layer = VocabParallelEmbedding(vocab, emb, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError("operation must be 'linear' or 'embedding'")
+
+
+# -------------------------------------------------------- PS-side configs
+
+
+class _EntryConfig:
+    """Sparse-table entry/retention rule (reference: distributed/entry_attr
+    — controls which sparse features materialize rows)."""
+
+    def __init__(self, kind: str, **kw):
+        self.kind = kind
+        self.kw = kw
+
+    def _to_attr(self) -> str:
+        parts = [self.kind] + [f"{k}:{v}" for k, v in self.kw.items()]
+        return " ".join(parts)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.kw})"
+
+
+class ProbabilityEntry(_EntryConfig):
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        super().__init__("probability_entry", probability=probability)
+
+
+class CountFilterEntry(_EntryConfig):
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__("count_filter_entry", count_filter=count_filter)
+
+
+class ShowClickEntry(_EntryConfig):
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__("show_click_entry", show=show_name,
+                         click=click_name)
+
+
+class QueueDataset:
+    """Streaming dataset fed from files (reference: fleet/dataset
+    QueueDataset — the C++ data_feed pipeline). Host-side file streaming
+    into the io pipeline."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._parse_fn = None
+        self.batch_size = 1
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             thread_num=1, **kw):
+        self.batch_size = batch_size
+
+    def set_filelist(self, files: List[str]) -> None:
+        self._files = list(files)
+
+    def set_parse_ins_id(self, flag: bool) -> None:
+        pass
+
+    def set_parse_fn(self, fn) -> None:
+        self._parse_fn = fn
+
+    def _reader(self):
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse_fn(line) if self._parse_fn else line
+
+    def __iter__(self):
+        return self._reader()
+
+
+class InMemoryDataset(QueueDataset):
+    """reference: fleet/dataset InMemoryDataset — loads into memory,
+    supports shuffle before training."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List = []
+
+    def load_into_memory(self) -> None:
+        self._samples = list(self._reader())
+
+    def local_shuffle(self) -> None:
+        import random
+
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=1) -> None:
+        self.local_shuffle()  # single-controller: local IS global
+
+    def release_memory(self) -> None:
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def __iter__(self):
+        if self._samples:
+            return iter(self._samples)
+        return self._reader()

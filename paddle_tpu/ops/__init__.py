@@ -94,6 +94,22 @@ def _patch_tensor():
     T.__and__ = lambda s, o: logic.bitwise_and(s, o)
     T.__or__ = lambda s, o: logic.bitwise_or(s, o)
     T.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+    # linalg/meta methods the reference patches onto Tensor
+    from .. import linalg as _linalg_facade
+
+    T.cond = lambda s, p_=None, name=None: _linalg_facade.cond(s, p_)
+    T.multi_dot = lambda s, xs, name=None: _linalg_facade.multi_dot([s] + list(xs))
+    T.lu_unpack = lambda s, y, unpack_ludata=True, unpack_pivots=True, \
+        name=None: _linalg_facade.lu_unpack(s, y, unpack_ludata, unpack_pivots)
+    T.is_tensor = lambda s: True
+    T.create_parameter = staticmethod(
+        lambda *a, **k: __import__(
+            "paddle_tpu.framework.core_api", fromlist=["create_parameter"]
+        ).create_parameter(*a, **k))
+    T.create_tensor = staticmethod(
+        lambda dtype="float32", name=None, persistable=False: T(
+            __import__("jax.numpy", fromlist=["zeros"]).zeros((), dtype)))
+
     T.__getitem__ = getitem
     T.__setitem__ = setitem
     T.__hash__ = lambda s: id(s)

@@ -24,7 +24,10 @@ __all__ = [
     "cumulative_trapezoid", "polar", "vander", "broadcast_tensors",
     "broadcast_shape", "is_complex", "is_integer", "is_floating_point",
     "rank", "shape", "tolist", "tanh_", "reshape_", "unsqueeze_",
-    "squeeze_", "scatter_", "vsplit",
+    "squeeze_", "scatter_", "vsplit", "ceil_", "exp_", "floor_",
+    "reciprocal_", "round_", "rsqrt_", "sqrt_", "scale_", "remainder_",
+    "subtract_", "clip_", "flatten_", "lerp_", "erfinv_", "sigmoid_",
+    "put_along_axis_",
 ]
 
 
@@ -353,3 +356,72 @@ def scatter_(x, index, updates, overwrite=True, name=None):
     out = scatter(x, index, updates, overwrite=overwrite)
     inplace_rebind(x, out)
     return x
+
+
+# ----------------------------------------- remaining in-place variants
+# (reference: tensor_method_func trailing-underscore entries)
+
+
+def ceil_(x, name=None):
+    return _inplace("ceil", x)
+
+
+def exp_(x, name=None):
+    return _inplace("exp", x)
+
+
+def floor_(x, name=None):
+    return _inplace("floor", x)
+
+
+def reciprocal_(x, name=None):
+    return _inplace("reciprocal", x)
+
+
+def round_(x, name=None):
+    return _inplace("round", x)
+
+
+def rsqrt_(x, name=None):
+    return _inplace("rsqrt", x)
+
+
+def sqrt_(x, name=None):
+    return _inplace("sqrt", x)
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    return _inplace("scale", x, scale, bias, bias_after_scale, act)
+
+
+def remainder_(x, y, name=None):
+    return _inplace("mod", x, y)
+
+
+def subtract_(x, y, name=None):
+    return _inplace("subtract", x, y)
+
+
+def clip_(x, min=None, max=None, name=None):
+    return _inplace("clip", x, min, max)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _inplace("flatten", x, start_axis, stop_axis)
+
+
+def lerp_(x, y, weight, name=None):
+    return _inplace("lerp", x, y, weight)
+
+
+def erfinv_(x, name=None):
+    return _inplace("erfinv", x)
+
+
+def sigmoid_(x, name=None):
+    return _inplace("sigmoid", x)
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign", name=None):
+    return _inplace("put_along_axis", arr, indices, values, axis, reduce)

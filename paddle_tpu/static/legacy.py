@@ -127,6 +127,8 @@ class CompiledProgram:
         self._build_strategy = build_strategy or BuildStrategy()
 
     def __getattr__(self, item):
+        if item == "_program":  # absent during unpickling: avoid recursion
+            raise AttributeError(item)
         return getattr(self._program, item)
 
 
@@ -183,11 +185,22 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
     def fn(*vals):
         if any(isinstance(v, jax.core.Tracer) for v in vals):
-            spec = jax.ShapeDtypeStruct(tuple(out_spec.shape), out_spec.dtype)
-            return jax.pure_callback(
-                lambda *a: np.asarray(func(*[Tensor(np.asarray(x_)) for x_
-                                             in a]).numpy()), spec, *vals)
+            specs = (out_spec if isinstance(out_spec, (list, tuple))
+                     else [out_spec])
+            jspecs = [jax.ShapeDtypeStruct(tuple(sp.shape), sp.dtype)
+                      for sp in specs]
+
+            def host(*a):
+                res = func(*[Tensor(np.asarray(x_)) for x_ in a])
+                outs = res if isinstance(res, (list, tuple)) else [res]
+                return [np.asarray(o.numpy() if isinstance(o, Tensor)
+                                   else o) for o in outs]
+
+            out = jax.pure_callback(host, jspecs, *vals)
+            return out if isinstance(out_spec, (list, tuple)) else out[0]
         res = func(*[Tensor(v) for v in vals])
+        if isinstance(res, (list, tuple)):
+            return [o._value if isinstance(o, Tensor) else o for o in res]
         return res._value if isinstance(res, Tensor) else res
 
     return apply_op(fn, xs, name="py_func")
@@ -218,6 +231,7 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
         self._decay = float(decay)
+        self._thres_steps = thres_steps
         self._ema: dict = {}
         self._backup: dict = {}
         self._params: list = []
@@ -235,7 +249,12 @@ class ExponentialMovingAverage:
         if parameters is not None:
             self._track(parameters)
         self._step += 1
-        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        # reference (_get_ema_decay): the (1+t)/(10+t) warm-up ramp only
+        # applies when thres_steps is given; plain EMA uses decay as-is
+        if self._thres_steps is not None:
+            d = min(self._decay, (1 + self._step) / (10 + self._step))
+        else:
+            d = self._decay
         for p in self._params:
             self._ema[p._uid] = (d * self._ema[p._uid]
                                  + (1.0 - d) * p._value)
@@ -273,21 +292,31 @@ class Variable:
 
 def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
     """Program metadata → bytes (reference: static/io.py
-    serialize_program). The compiled-artifact form of a program is
-    save_inference_model's StableHLO file; this serializes the
-    placeholder interface the way the reference serializes the
-    ProgramDesc."""
-    from . import default_main_program
-
-    prog = default_main_program()
-    return pickle.dumps({"program": prog._placeholder_spec()})
+    serialize_program — derives the program from the passed vars, not
+    the ambient default). The compiled-artifact form of a program is
+    save_inference_model's StableHLO file; this serializes the feed
+    interface the way the reference serializes the ProgramDesc."""
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    spec = {getattr(v, "name", f"x{i}"): {
+                "shape": list(getattr(v, "shape", [])),
+                "dtype": str(getattr(v, "dtype", "float32"))}
+            for i, v in enumerate(feeds)}
+    return pickle.dumps({"program": spec})
 
 
 def serialize_persistables(feed_vars, fetch_vars, **kwargs) -> bytes:
-    from . import default_main_program
+    """Parameters reachable from the FETCH vars' tape (not whatever
+    program happens to be the ambient default)."""
+    import numpy as np_
 
-    prog = default_main_program()
-    return pickle.dumps(prog._param_state())
+    from . import _collect_parameters_multi
+
+    fetches = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+               else [fetch_vars])
+    params = _collect_parameters_multi(fetches, trainable_only=False)
+    return pickle.dumps({
+        (getattr(p, "name", None) or f"param_{i}"): np_.asarray(p._value)
+        for i, p in enumerate(params)})
 
 
 def deserialize_program(data: bytes):

@@ -866,3 +866,24 @@ EXCLUDED.update({
     "squeeze_": "inplace alias of squeeze",
     "scatter_": "inplace alias of scatter",
 })
+
+EXCLUDED.update({
+    # in-place rebind variants of specced ops; rebind semantics covered
+    # by test_api_tail.test_inplace_method_variants
+    "ceil_": "inplace alias of ceil",
+    "clip_": "inplace alias of clip",
+    "erfinv_": "inplace alias of erfinv",
+    "exp_": "inplace alias of exp",
+    "flatten_": "inplace alias of flatten",
+    "floor_": "inplace alias of floor",
+    "lerp_": "inplace alias of lerp",
+    "put_along_axis_": "inplace alias of put_along_axis",
+    "reciprocal_": "inplace alias of reciprocal",
+    "remainder_": "inplace alias of remainder",
+    "round_": "inplace alias of round",
+    "rsqrt_": "inplace alias of rsqrt",
+    "scale_": "inplace alias of scale",
+    "sigmoid_": "inplace alias of sigmoid",
+    "sqrt_": "inplace alias of sqrt",
+    "subtract_": "inplace alias of subtract",
+})

@@ -227,3 +227,76 @@ def test_vsplit_indices_semantics():
                                   np.arange(40).reshape(10, 4)[2:5])
     halves = paddle.vsplit(x, 2)
     assert [tuple(t.shape) for t in halves] == [(5, 4), (5, 4)]
+
+
+def test_distributed_namespace_parity():
+    import paddle_tpu.distributed as dist
+
+    src = open("/root/reference/python/paddle/distributed/__init__.py").read()
+    block = re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1)
+    names = re.findall(r'["\']([^"\']+)["\']', block)
+    assert len(names) > 30
+    missing = [n for n in names if not hasattr(dist, n)]
+    assert missing == [], missing
+
+
+def test_tensor_method_parity():
+    from paddle_tpu.tensor import Tensor
+
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    block = re.search(r"tensor_method_func = \[(.*?)\]", src, re.S).group(1)
+    meths = re.findall(r"'([^']+)'", block)
+    assert len(meths) > 200
+    missing = [n for n in meths if not hasattr(Tensor, n)]
+    assert missing == [], missing
+
+
+def test_inplace_method_variants():
+    x = paddle.to_tensor(np.array([4.0], np.float32))
+    x.sqrt_()
+    np.testing.assert_allclose(np.asarray(x.numpy()), [2.0])
+    x.exp_()
+    np.testing.assert_allclose(np.asarray(x.numpy()), [np.exp(2.0)],
+                               rtol=1e-6)
+    y = paddle.to_tensor(np.array([1.5, -0.5], np.float32))
+    y.clip_(0.0, 1.0)
+    np.testing.assert_array_equal(np.asarray(y.numpy()), [1.0, 0.0])
+    z = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    z.flatten_()
+    assert tuple(z.shape) == (2,)
+    w = paddle.to_tensor(np.array([7.0], np.float32))
+    w.subtract_(paddle.to_tensor(np.array([2.0], np.float32)))
+    np.testing.assert_array_equal(np.asarray(w.numpy()), [5.0])
+
+
+def test_distributed_misc_functions():
+    import paddle_tpu.distributed as dist
+
+    assert dist.is_available() is True
+    assert dist.get_backend().startswith("xla:")
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+    e = dist.CountFilterEntry(3)
+    assert "count_filter" in e._to_attr()
+    objs = [None]
+    dist.broadcast_object_list(objs)  # single-process: no-op
+    out = []
+    dist.scatter_object_list(out, [["a"], ["b"]])
+    assert out == [["a"]]
+
+
+def test_queue_and_inmemory_dataset(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    f = tmp_path / "data.txt"
+    f.write_text("1,2\n3,4\n5,6\n")
+    ds = dist.InMemoryDataset()
+    ds.set_filelist([str(f)])
+    ds.set_parse_fn(lambda line: [int(v) for v in line.split(",")])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle()
+    assert sorted(list(ds)) == [[1, 2], [3, 4], [5, 6]]
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
