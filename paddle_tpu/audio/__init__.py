@@ -6,6 +6,8 @@ power_to_db, create_dct) and ``features`` (Spectrogram, MelSpectrogram,
 LogMelSpectrogram, MFCC layers) built on the stft from paddle.signal.
 The dataset/backend IO tier is out of scope in a zero-egress image.
 """
-from . import features, functional  # noqa: F401
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 
-__all__ = ["features", "functional"]
+__all__ = ["features", "functional", "backends", "datasets",
+           "load", "info", "save"]

@@ -1,0 +1,89 @@
+"""Stdlib-wave audio backend (reference: audio/backends/wave_backend.py
+— 16-bit PCM WAV read/write without external deps)."""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "get_current_backend",
+           "list_available_backends", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+_current = "wave"
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def set_backend(backend_name: str) -> None:
+    global _current
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"audio backend {backend_name!r} unavailable; only the stdlib "
+            "'wave' backend ships in this zero-egress image")
+    _current = backend_name
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (Tensor [C, N] or [N, C], sample_rate)."""
+    from ...tensor import Tensor
+    import jax.numpy as jnp
+
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    if width != 2:
+        raise NotImplementedError("wave backend reads 16-bit PCM only")
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, ch)
+    if normalize:
+        data = data.astype(np.float32) / 32768.0
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_S",
+         bits_per_sample: int = 16) -> None:
+    if bits_per_sample != 16:
+        raise NotImplementedError("wave backend writes 16-bit PCM only")
+    data = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype("<i2")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(data.astype("<i2").tobytes())

@@ -1,0 +1,20 @@
+"""audio.datasets (reference: audio/datasets/ — ESC50/TESS download-based
+corpora). Zero-egress: constructors raise with the local-files recipe,
+matching the text datasets' contract."""
+__all__ = ["ESC50", "TESS"]
+
+
+class _ZeroEgressAudioDataset:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"{type(self).__name__} downloads its corpus from the network; "
+            "this environment is zero-egress. Provide local WAV files and "
+            "wrap them with paddle_tpu.io.Dataset + audio.backends.load.")
+
+
+class ESC50(_ZeroEgressAudioDataset):
+    pass
+
+
+class TESS(_ZeroEgressAudioDataset):
+    pass

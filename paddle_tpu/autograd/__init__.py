@@ -22,3 +22,30 @@ __all__ = [
     "PyLayer",
     "PyLayerContext",
 ]
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks on saved activations
+    (reference: autograd/saved_tensors_hooks.py — offload/compress saved
+    tensors). The tape records jax arrays; pack runs at record time,
+    unpack right before the backward uses the value."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import engine
+
+        engine._saved_tensor_hooks.append(
+            (self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import engine
+
+        engine._saved_tensor_hooks.pop()
+        return False
+
+
+__all__.append("saved_tensors_hooks")

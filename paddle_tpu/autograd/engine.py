@@ -128,6 +128,10 @@ amp_state = {
 check_nan_inf_enabled = False
 benchmark_sync_enabled = False
 
+# active saved_tensors_hooks (pack, unpack) stack — see
+# paddle_tpu.autograd.saved_tensors_hooks
+_saved_tensor_hooks: list = []
+
 
 def _nan_inf_sweep(outs, name: str):
     seq = outs if isinstance(outs, tuple) else (outs,)
@@ -185,7 +189,22 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
         return Tensor(outs, stop_gradient=True)
 
     f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
-    outs, vjp_fn = jax.vjp(f, *arrays)
+    if _saved_tensor_hooks:
+        # saved_tensors_hooks (reference: autograd/saved_tensors_hooks.py):
+        # pack() replaces residual storage at record time; backward unpacks
+        # and recomputes the vjp from the restored inputs. The jax.vjp
+        # residuals themselves are closure-held, so "saved tensors" here
+        # are the op inputs and recompute replaces residual retention.
+        pack, unpack = _saved_tensor_hooks[-1]
+        outs = f(*arrays)
+        packed = [pack(a) for a in arrays]
+
+        def vjp_fn(cotangents, _f=f, _packed=packed, _unpack=unpack):
+            vals = [_unpack(p) for p in _packed]
+            _, inner_vjp = jax.vjp(_f, *vals)
+            return inner_vjp(cotangents)
+    else:
+        outs, vjp_fn = jax.vjp(f, *arrays)
     if check_nan_inf_enabled:
         _nan_inf_sweep(outs, name)
     if benchmark_sync_enabled:

@@ -112,3 +112,148 @@ def memory_reserved(device=None) -> int:
 def max_memory_reserved(device=None) -> int:
     s = memory_stats(device)
     return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+# ------------------------------------------------- device API tail
+# (reference: device/__init__.py — compile-flag predicates, vendor
+# places, and the stream/event facade. On TPU, XLA owns scheduling: a
+# "stream" is the device's ordered execution queue, events are markers
+# realized by block_until_ready at sync points.)
+
+
+def get_cudnn_version():
+    """None: no cuDNN in the TPU build (reference returns None when
+    not compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = None) -> bool:
+    """TPU rides PJRT's plugin mechanism — the moral equivalent of the
+    reference's custom-device runtime."""
+    return device_type in (None, "tpu")
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+from ..framework.core_api import CPUPlace as _CPUPlace  # noqa: E402
+
+
+class XPUPlace(_CPUPlace):
+    def __init__(self, device_id: int = 0):
+        raise RuntimeError("XPU hardware is not supported by the TPU build")
+
+
+class IPUPlace(_CPUPlace):
+    def __init__(self, device_id: int = 0):
+        raise RuntimeError("IPU hardware is not supported by the TPU build")
+
+
+class Stream:
+    """Execution-queue handle (reference: device/cuda Stream). XLA
+    serializes per-device execution; wait/synchronize map to
+    block_until_ready barriers."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+        self._last = None
+
+    def record(self, obj):
+        self._last = obj
+
+    def wait_stream(self, other: "Stream") -> None:
+        if other._last is not None:
+            import jax
+
+            jax.block_until_ready(other._last)
+
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+
+class Event:
+    """Completion marker (reference: device/cuda Event)."""
+
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = None
+        import time as _t
+
+        self._time = _t.time
+
+    def record(self, stream: Stream = None) -> None:
+        self._recorded = self._time()
+
+    def query(self) -> bool:
+        return True  # device queue is serialized; recorded == done at sync
+
+    def synchronize(self) -> None:
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        if self._recorded is None or end._recorded is None:
+            raise RuntimeError("both events must be recorded")
+        return (end._recorded - self._recorded) * 1000.0
+
+
+_default_stream = Stream()
+_current_stream = [_default_stream]
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream[-1]
+
+
+def set_stream(stream: Stream) -> Stream:
+    prev = _current_stream[-1]
+    _current_stream[-1] = stream
+    return prev
+
+
+class stream_guard:
+    """Scoped stream switch (reference: device/__init__.py stream_guard)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

@@ -96,6 +96,11 @@ def broadcast_object_list(object_list: List, src: int = 0, group=None):
     coordination service."""
     import jax
 
+    if src != 0:
+        # multihost_utils.broadcast_one_to_all always sources process 0
+        raise NotImplementedError(
+            "broadcast_object_list on the TPU coordination service only "
+            "supports src=0 (the jax multihost broadcast root)")
     if jax.process_count() <= 1:
         return  # one process: object_list is already "broadcast"
     from jax.experimental import multihost_utils
@@ -103,11 +108,13 @@ def broadcast_object_list(object_list: List, src: int = 0, group=None):
 
     payload = pickle.dumps(object_list)
     arr = np.frombuffer(payload, np.uint8)
-    # length first (objects differ per process), then bytes
+    # src's length wins; other processes size their buffers to it (their
+    # own bytes are ignored by the broadcast anyway)
     n = int(multihost_utils.broadcast_one_to_all(
         np.asarray([arr.size], np.int64))[0])
     buf = np.zeros((n,), np.uint8)
-    buf[:arr.size] = arr[:n]
+    m = min(arr.size, n)
+    buf[:m] = arr[:m]
     synced = multihost_utils.broadcast_one_to_all(buf)
     object_list[:] = pickle.loads(bytes(synced.tobytes()[:n]))
 
@@ -115,13 +122,24 @@ def broadcast_object_list(object_list: List, src: int = 0, group=None):
 def scatter_object_list(out_object_list: List, in_object_list=None,
                         src: int = 0, group=None):
     """reference: communication/scatter.py scatter_object_list — rank r
-    receives in_object_list[r]."""
-    from .env import get_rank
+    receives in_object_list[r]. Single-controller SPMD: every rank holds
+    in_object_list, so the scatter is an index; the list must cover the
+    world size (a short list raises instead of silently wrapping)."""
+    from .env import get_rank, get_world_size
 
     rank = get_rank()
     if in_object_list is None:
-        raise ValueError("scatter_object_list needs in_object_list on src")
-    out_object_list[:] = [in_object_list[rank % len(in_object_list)]]
+        # single-controller: no transport exists to receive from src —
+        # the list must be present everywhere (documented divergence
+        # from the reference's src-only requirement)
+        raise ValueError(
+            "scatter_object_list requires in_object_list on every rank "
+            "under the single-controller model")
+    if rank >= len(in_object_list) or get_world_size() > len(in_object_list):
+        raise ValueError(
+            f"in_object_list has {len(in_object_list)} entries for "
+            f"world size {get_world_size()}")
+    out_object_list[:] = [in_object_list[rank]]
 
 
 # ------------------------------------------------------ gloo-style barrier

@@ -26,6 +26,7 @@ __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "reindex_graph", "sample_neighbors",
+    "weighted_sample_neighbors", "reindex_heter_graph",
 ]
 
 _REDUCERS = {
@@ -193,3 +194,73 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
               else np.empty((0,), "int64"))
         return result + (Tensor(jnp.asarray(fe), stop_gradient=True),)
     return result
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """reference: sampling/neighbors.py weighted_sample_neighbors —
+    neighbors drawn without replacement with probability proportional to
+    edge weight (the reference's A-Res weighted reservoir)."""
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    rowv = np.asarray(ensure_tensor(row).numpy()).astype("int64")
+    ptr = np.asarray(ensure_tensor(colptr).numpy()).astype("int64")
+    wv = np.asarray(ensure_tensor(edge_weight).numpy()).astype("float64")
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).astype("int64")
+    eidv = None if eids is None else np.asarray(
+        ensure_tensor(eids).numpy()).astype("int64")
+    key = default_generator.next_key()
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    out_neighbors, out_count, out_eids = [], [], []
+    for nd in nodes:
+        beg, end = int(ptr[nd]), int(ptr[nd + 1])
+        pos = np.arange(beg, end)
+        if sample_size > 0 and len(pos) > sample_size:
+            w = np.maximum(wv[pos], 1e-12)
+            p = w / w.sum()
+            pos = rng.choice(pos, size=sample_size, replace=False, p=p)
+        out_neighbors.append(rowv[pos])
+        out_count.append(len(pos))
+        if return_eids:
+            out_eids.append(eidv[pos])
+    nb = np.concatenate(out_neighbors) if out_neighbors else np.zeros(0, "int64")
+    cnt = np.asarray(out_count, "int64")
+    outs = [Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(cnt))]
+    if return_eids:
+        outs.append(Tensor(jnp.asarray(
+            np.concatenate(out_eids) if out_eids else np.zeros(0, "int64"))))
+    return tuple(outs)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference: reindex.py reindex_heter_graph — like reindex_graph but
+    over per-edge-type neighbor/count lists sharing ONE node id space."""
+    xs = ensure_tensor(x)
+    nbs = [ensure_tensor(n) for n in neighbors]
+    cnts = [ensure_tensor(c) for c in count]
+    xv = np.asarray(xs.numpy()).astype("int64")
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    out_nodes = list(xv)
+    reindexed = []
+    for nb in nbs:
+        nbv = np.asarray(nb.numpy()).astype("int64")
+        local = np.empty(len(nbv), "int64")
+        for i, g in enumerate(nbv):
+            gi = int(g)
+            if gi not in mapping:
+                mapping[gi] = len(out_nodes)
+                out_nodes.append(gi)
+            local[i] = mapping[gi]
+        reindexed.append(local)
+    # edge dst: each center repeated by its per-type counts
+    out_edges_src = [Tensor(jnp.asarray(r)) for r in reindexed]
+    out_edges_dst = []
+    for cnt in cnts:
+        cv = np.asarray(cnt.numpy()).astype("int64")
+        out_edges_dst.append(Tensor(jnp.asarray(
+            np.repeat(np.arange(len(xv), dtype="int64"), cv))))
+    return (out_edges_src, out_edges_dst,
+            Tensor(jnp.asarray(np.asarray(out_nodes, "int64"))))

@@ -97,7 +97,7 @@ def _patch_tensor():
     # linalg/meta methods the reference patches onto Tensor
     from .. import linalg as _linalg_facade
 
-    T.cond = lambda s, p_=None, name=None: _linalg_facade.cond(s, p_)
+    T.cond = lambda s, p=None, name=None: _linalg_facade.cond(s, p)
     T.multi_dot = lambda s, xs, name=None: _linalg_facade.multi_dot([s] + list(xs))
     T.lu_unpack = lambda s, y, unpack_ludata=True, unpack_pivots=True, \
         name=None: _linalg_facade.lu_unpack(s, y, unpack_ludata, unpack_pivots)

@@ -446,3 +446,19 @@ def benchmark() -> _Benchmark:
     except NameError:
         _global_benchmark = _Benchmark()
         return _global_benchmark
+
+
+class SummaryView:
+    """Summary view selector (reference: profiler/profiler.py:46)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+__all__.append("SummaryView")

@@ -326,3 +326,22 @@ class PTQ(Quantization):
             "Please set evaluation mode by model.eval().")
         _model = model if inplace else copy.deepcopy(model)
         return self._convert_layers(_model)
+
+
+def quanter(class_name: str):
+    """Class decorator registering a quanter under a factory name
+    (reference: quantization/factory.py:76 — lets QuantConfig reference
+    quanters by name)."""
+
+    def decorator(cls):
+        import sys
+
+        setattr(sys.modules[__name__], class_name, cls)
+        if class_name not in __all__:
+            __all__.append(class_name)
+        return cls
+
+    return decorator
+
+
+__all__.append("quanter")

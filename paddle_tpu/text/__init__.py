@@ -16,7 +16,9 @@ from ..nn.layer_base import Layer
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -127,3 +129,13 @@ class datasets:
 
     class Conll05st(_ZeroEgressDataset):
         pass
+
+
+# reference exports the dataset classes at paddle.text top level too
+Imdb = datasets.Imdb
+Imikolov = datasets.Imikolov
+Movielens = datasets.Movielens
+UCIHousing = datasets.UCIHousing
+WMT14 = datasets.WMT14
+WMT16 = datasets.WMT16
+Conll05st = datasets.Conll05st

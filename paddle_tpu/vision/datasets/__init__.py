@@ -18,7 +18,9 @@ import numpy as np
 
 from ...io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = [
+    "MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData", "DatasetFolder", "ImageFolder", "Flowers", "VOC2012",
+]
 
 
 class MNIST(Dataset):
@@ -147,3 +149,113 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class DatasetFolder(Dataset):
+    """Samples arranged class-per-directory (reference:
+    vision/datasets/folder.py DatasetFolder). Default loader reads .npy
+    arrays (no PIL dependency in this image); pass ``loader`` for other
+    formats."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions) if extensions else (
+            ".npy", ".jpg", ".jpeg", ".png", ".bmp", ".wav")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid sample files under {root!r}")
+
+    @staticmethod
+    def _default_loader(path):
+        import numpy as _np
+
+        if path.endswith(".npy"):
+            return _np.load(path)
+        from ..image import image_load
+
+        return image_load(path)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(DatasetFolder):
+    """Flat image folder without labels (reference: folder.py
+    ImageFolder): __getitem__ returns [sample]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions) if extensions else (
+            ".npy", ".jpg", ".jpeg", ".png", ".bmp")
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid sample files under {root!r}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py — download-based corpus;
+    zero-egress: local cache or a clear error (same contract as MNIST
+    above)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        raise RuntimeError(
+            "Flowers downloads its corpus from the network; this "
+            "environment is zero-egress. Arrange the images locally and "
+            "use DatasetFolder instead.")
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py — same zero-egress contract."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        raise RuntimeError(
+            "VOC2012 downloads its corpus from the network; this "
+            "environment is zero-egress. Arrange the images locally and "
+            "use DatasetFolder instead.")

@@ -4,7 +4,8 @@ from .extra_nets import (  # noqa: F401
     DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, densenet121, densenet161,
     densenet169, densenet201, densenet264, googlenet, inception_v3,
     shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
-    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
 )
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
@@ -34,6 +35,7 @@ __all__ = [
     "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
     "densenet264", "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_swish",
     "shufflenet_v2_x2_0", "GoogLeNet", "googlenet", "InceptionV3",
     "inception_v3",
 ]

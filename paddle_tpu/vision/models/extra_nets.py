@@ -124,19 +124,20 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_ch, out_ch, stride):
+    def __init__(self, in_ch, out_ch, stride, act_layer=None):
         super().__init__()
         self.stride = stride
+        act_layer = act_layer or nn.ReLU
         branch = out_ch // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
                 nn.Conv2D(branch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), act_layer(),
                 nn.Conv2D(branch, branch, 3, stride=1, padding=1, groups=branch,
                           bias_attr=False),
                 nn.BatchNorm2D(branch),
                 nn.Conv2D(branch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), act_layer(),
             )
         else:
             self.branch1 = nn.Sequential(
@@ -144,16 +145,16 @@ class _ShuffleUnit(nn.Layer):
                           groups=in_ch, bias_attr=False),
                 nn.BatchNorm2D(in_ch),
                 nn.Conv2D(in_ch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), act_layer(),
             )
             self.branch2 = nn.Sequential(
                 nn.Conv2D(in_ch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), act_layer(),
                 nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                           groups=branch, bias_attr=False),
                 nn.BatchNorm2D(branch),
                 nn.Conv2D(branch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), act_layer(),
             )
 
     def forward(self, x):
@@ -179,25 +180,28 @@ class ShuffleNetV2(nn.Layer):
     def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
         super().__init__()
         cfg = _SHUFFLE_CFG[scale]
+        act_layer = {"relu": nn.ReLU, "swish": nn.Swish}.get(act)
+        if act_layer is None:
+            raise ValueError(f"unsupported act {act!r}; use 'relu'/'swish'")
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, cfg[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(cfg[0]), nn.ReLU(),
+            nn.BatchNorm2D(cfg[0]), act_layer(),
         )
         self.maxpool = nn.MaxPool2D(3, 2, padding=1)
         stages = []
         in_ch = cfg[0]
         for i, (out_ch, repeat) in enumerate(zip(cfg[1:4], [4, 8, 4])):
-            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            units = [_ShuffleUnit(in_ch, out_ch, 2, act_layer)]
             for _ in range(repeat - 1):
-                units.append(_ShuffleUnit(out_ch, out_ch, 1))
+                units.append(_ShuffleUnit(out_ch, out_ch, 1, act_layer))
             stages.append(nn.Sequential(*units))
             in_ch = out_ch
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(in_ch, cfg[4], 1, bias_attr=False),
-            nn.BatchNorm2D(cfg[4]), nn.ReLU(),
+            nn.BatchNorm2D(cfg[4]), act_layer(),
         )
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D((1, 1))
@@ -243,6 +247,12 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return _shufflenet(2.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    """reference: shufflenet_v2_swish — the x1.0 net with swish
+    activations throughout."""
+    return _shufflenet(1.0, pretrained, act="swish", **kwargs)
 
 
 # -------------------------------------------------------------- GoogLeNet

@@ -18,7 +18,15 @@ from ..nn import functional as F  # noqa: F401 (parity surface)
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "box_area",
+from .ops_extra import (  # noqa: F401
+    PSRoIPool, decode_jpeg, distribute_fpn_proposals, generate_proposals,
+    matrix_nms, prior_box, psroi_pool, read_file, yolo_loss,
+)
+
+__all__ = ["yolo_loss", "prior_box", "matrix_nms", "psroi_pool", "PSRoIPool",
+           "distribute_fpn_proposals", "generate_proposals", "read_file",
+           "decode_jpeg",
+           "nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "box_area",
            "box_iou", "deform_conv2d", "DeformConv2D", "RoIAlign", "RoIPool"]
 
 

@@ -1,0 +1,472 @@
+"""vision.ops tail — detection ops completing the reference surface.
+
+Reference parity: ``python/paddle/vision/ops.py`` — yolo_loss, prior_box,
+matrix_nms, psroi_pool/PSRoIPool, distribute_fpn_proposals,
+generate_proposals, read_file, decode_jpeg. Detection post-processing
+is host-orchestrated the way the reference's CPU kernels are; the
+per-box math is jnp.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply_op
+from ..ops._apply import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["yolo_loss", "prior_box", "matrix_nms", "psroi_pool", "PSRoIPool",
+           "distribute_fpn_proposals", "generate_proposals", "read_file",
+           "decode_jpeg"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss → yolov3_loss op):
+    coordinate MSE/BCE + objectness/class BCE with per-anchor target
+    assignment by best-IoU; predictions above ignore_thresh with no
+    matched target are excluded from the noobj term."""
+    xt = ensure_tensor(x)
+    gb = ensure_tensor(gt_box)
+    gl = ensure_tensor(gt_label)
+    ins = [xt, gb, gl]
+    if gt_score is not None:
+        ins.append(ensure_tensor(gt_score))
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+
+    def fn(xv, boxes, labels, *rest):
+        scores = rest[0] if rest else None
+        B, C, H, W = xv.shape
+        xv = xv.reshape(B, na, 5 + class_num, H, W)
+        px = jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1) / 2          # [B,na,H,W]
+        py = jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        pw = xv[:, :, 2]
+        ph = xv[:, :, 3]
+        pobj = xv[:, :, 4]
+        pcls = xv[:, :, 5:]                 # [B,na,cls,H,W]
+
+        img_size = float(downsample_ratio * H)
+        anchors_all = jnp.asarray(an)       # [A,2]
+        anchors_used = anchors_all[jnp.asarray(mask)]  # [na,2]
+
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        bx = (px + gx) / W                  # normalized center
+        by = (py + gy) / H
+        bw = jnp.exp(pw) * anchors_used[None, :, 0, None, None] / img_size
+        bh = jnp.exp(ph) * anchors_used[None, :, 1, None, None] / img_size
+
+        # gt boxes [B,N,4] normalized xywh; label 0 padding rows have w==0
+        gt_valid = boxes[..., 2] > 0        # [B,N]
+        # best anchor per gt by wh-IoU against ALL anchors
+        gw = boxes[..., 2] * img_size
+        gh = boxes[..., 3] * img_size
+        inter = (jnp.minimum(gw[..., None], anchors_all[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], anchors_all[None, None, :, 1]))
+        union = (gw * gh)[..., None] + (anchors_all[:, 0]
+                                        * anchors_all[:, 1])[None, None] - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [B,N]
+
+        gi = jnp.clip((boxes[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((boxes[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # build dense targets by scatter (padding rows scatter to cell 0 of
+        # anchor best_anchor with weight 0 via gt_valid mask)
+        def per_image(args):
+            (pobj_i, pcls_i, px_i, py_i, pw_i, ph_i, boxes_i, labels_i,
+             valid_i, ba_i, gi_i, gj_i, score_i) = args
+            obj_t = jnp.zeros((na, H, W))
+            tx = jnp.zeros((na, H, W))
+            ty = jnp.zeros((na, H, W))
+            tw = jnp.zeros((na, H, W))
+            th = jnp.zeros((na, H, W))
+            tcls = jnp.zeros((na, class_num, H, W))
+            wgt = jnp.zeros((na, H, W))
+            mask_arr = jnp.asarray(mask)
+            # anchor index within this level (-1 → not this level)
+            ai = jnp.argmax(ba_i[:, None] == mask_arr[None, :], axis=1)
+            on_level = (ba_i[:, None] == mask_arr[None, :]).any(axis=1)
+            w_ok = valid_i & on_level
+            wvals = jnp.where(w_ok, score_i, 0.0)
+            obj_t = obj_t.at[ai, gj_i, gi_i].max(wvals)
+            wgt = wgt.at[ai, gj_i, gi_i].max(
+                jnp.where(w_ok, 2.0 - boxes_i[:, 2] * boxes_i[:, 3], 0.0))
+            tx = tx.at[ai, gj_i, gi_i].add(
+                jnp.where(w_ok, boxes_i[:, 0] * W - gi_i, 0.0))
+            ty = ty.at[ai, gj_i, gi_i].add(
+                jnp.where(w_ok, boxes_i[:, 1] * H - gj_i, 0.0))
+            anchor_wh = anchors_all[ba_i]
+            tw = tw.at[ai, gj_i, gi_i].add(jnp.where(
+                w_ok, jnp.log(jnp.maximum(
+                    boxes_i[:, 2] * img_size / anchor_wh[:, 0], 1e-9)), 0.0))
+            th = th.at[ai, gj_i, gi_i].add(jnp.where(
+                w_ok, jnp.log(jnp.maximum(
+                    boxes_i[:, 3] * img_size / anchor_wh[:, 1], 1e-9)), 0.0))
+            smooth = (1.0 / class_num if use_label_smooth and class_num > 1
+                      else 0.0)
+            onehot = jax.nn.one_hot(labels_i.reshape(-1), class_num)
+            onehot = onehot * (1.0 - smooth) + smooth / class_num
+            tcls = tcls.at[ai, :, gj_i, gi_i].add(
+                jnp.where(w_ok[:, None], onehot, 0.0))
+            return obj_t, tx, ty, tw, th, tcls, wgt
+
+        score_in = (scores if scores is not None
+                    else jnp.ones(boxes.shape[:2]))
+        obj_t, tx, ty, tw, th, tcls, wgt = jax.vmap(per_image)(
+            (pobj, pcls, px, py, pw, ph, boxes, labels, gt_valid,
+             best_anchor, gi, gj, score_in))
+
+        bce = lambda lg, tgt: jax.nn.softplus(lg) - tgt * lg
+        pos = obj_t > 0
+        loss_xy = (wgt * (bce(xv[:, :, 0], tx) + bce(xv[:, :, 1], ty))
+                   * pos).sum((1, 2, 3))
+        loss_wh = (wgt * ((pw - tw) ** 2 + (ph - th) ** 2)
+                   * pos * 0.5).sum((1, 2, 3))
+        # ignore mask: predicted boxes with IoU>thresh against any gt
+        pb = jnp.stack([bx, by, bw, bh], -1).reshape(B, -1, 4)
+
+        def iou_pred_gt(pred, gt, valid):
+            px1 = pred[:, 0] - pred[:, 2] / 2
+            py1 = pred[:, 1] - pred[:, 3] / 2
+            px2 = pred[:, 0] + pred[:, 2] / 2
+            py2 = pred[:, 1] + pred[:, 3] / 2
+            gx1 = gt[:, 0] - gt[:, 2] / 2
+            gy1 = gt[:, 1] - gt[:, 3] / 2
+            gx2 = gt[:, 0] + gt[:, 2] / 2
+            gy2 = gt[:, 1] + gt[:, 3] / 2
+            iw = jnp.maximum(jnp.minimum(px2[:, None], gx2[None])
+                             - jnp.maximum(px1[:, None], gx1[None]), 0)
+            ih = jnp.maximum(jnp.minimum(py2[:, None], gy2[None])
+                             - jnp.maximum(py1[:, None], gy1[None]), 0)
+            inter = iw * ih
+            uni = ((px2 - px1) * (py2 - py1))[:, None] \
+                + ((gx2 - gx1) * (gy2 - gy1))[None] - inter
+            iou = inter / jnp.maximum(uni, 1e-9)
+            return (iou * valid[None]).max(axis=1)
+
+        best_iou = jax.vmap(iou_pred_gt)(pb, boxes, gt_valid)
+        ignore = (best_iou > ignore_thresh).reshape(B, na, H, W)
+        noobj = (~pos) & (~ignore)
+        loss_obj = (bce(pobj, obj_t) * (pos | noobj)).sum((1, 2, 3))
+        loss_cls = (bce(pcls, tcls) * pos[:, :, None]).sum((1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return apply_op(fn, ins, name="yolo_loss")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference: vision/ops.py prior_box)."""
+    inp = ensure_tensor(input)
+    img = ensure_tensor(image)
+    H, W = int(inp.shape[2]), int(inp.shape[3])
+    img_h, img_w = int(img.shape[2]), int(img.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+
+    ratios = []
+    for ar in aspect_ratios:
+        ratios.append(ar)
+        if flip and ar != 1.0:
+            ratios.append(1.0 / ar)
+
+    boxes = []
+    for s in min_sizes:
+        boxes.append((s, s))
+        if 1.0 in ratios or not min_max_aspect_ratios_order:
+            pass
+    whs = []
+    for s in min_sizes:
+        whs.append((s, s))
+        for ar in ratios:
+            if ar == 1.0:
+                continue
+            whs.append((s * math.sqrt(ar), s / math.sqrt(ar)))
+    if max_sizes:
+        for smin, smax in zip(min_sizes, max_sizes):
+            whs.append((math.sqrt(smin * smax), math.sqrt(smin * smax)))
+    num_priors = len(whs)
+
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)
+    out = np.zeros((H, W, num_priors, 4), np.float32)
+    for k, (bw, bh) in enumerate(whs):
+        out[..., k, 0] = (gx - bw / 2) / img_w
+        out[..., k, 1] = (gy - bh / 2) / img_h
+        out[..., k, 2] = (gx + bw / 2) / img_w
+        out[..., k, 3] = (gy + bh / 2) / img_h
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: vision/ops.py matrix_nms — SOLOv2's soft
+    suppression: each box's score decays by its max-IoU overlap with
+    higher-scored boxes of the same class)."""
+    bv = np.asarray(ensure_tensor(bboxes).numpy())    # [B, M, 4]
+    sv = np.asarray(ensure_tensor(scores).numpy())    # [B, C, M]
+    all_out, all_idx, rois_num = [], [], []
+    B, C, M = sv.shape
+    for b in range(B):
+        outs, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = sv[b, c]
+            keep = sc > score_threshold
+            if not keep.any():
+                continue
+            cand = np.nonzero(keep)[0]
+            order = cand[np.argsort(-sc[cand])][:nms_top_k]
+            boxes = bv[b, order]
+            s = sc[order]
+            x1, y1, x2, y2 = boxes.T
+            off = 0.0 if normalized else 1.0
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            iw = np.maximum(np.minimum(x2[:, None], x2[None])
+                            - np.maximum(x1[:, None], x1[None]) + off, 0)
+            ih = np.maximum(np.minimum(y2[:, None], y2[None])
+                            - np.maximum(y1[:, None], y1[None]) + off, 0)
+            inter = iw * ih
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-9)
+            iou = np.triu(iou, 1)  # overlap with higher-scored boxes only
+            iou_max_col = iou.max(axis=0)          # per-box max overlap
+            comp = iou.max(axis=1, initial=0.0)
+            if use_gaussian:
+                decay = np.exp(-(iou_max_col ** 2 - comp ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou_max_col) / np.maximum(1 - comp, 1e-9)
+            decay = np.minimum(decay, 1.0)
+            ds = s * decay
+            ok = ds > post_threshold
+            for i in np.nonzero(ok)[0]:
+                outs.append([c, ds[i], *boxes[i]])
+                idxs.append(b * M + order[i])
+        outs = np.asarray(outs, np.float32).reshape(-1, 6)
+        idxs = np.asarray(idxs, np.int64)
+        if keep_top_k > 0 and len(outs) > keep_top_k:
+            top = np.argsort(-outs[:, 1])[:keep_top_k]
+            outs, idxs = outs[top], idxs[top]
+        all_out.append(outs)
+        all_idx.append(idxs)
+        rois_num.append(len(outs))
+    out = Tensor(jnp.asarray(np.concatenate(all_out)
+                             if all_out else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.concatenate(all_idx) if all_idx else np.zeros(0, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool — R-FCN): channel group (i,j) pools from spatial bin
+    (i,j) of the RoI."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    xt = ensure_tensor(x)
+    bt = ensure_tensor(boxes)
+    C = int(xt.shape[1])
+    if C % (oh * ow):
+        raise ValueError(f"channels {C} not divisible by output bins "
+                         f"{oh}x{ow}")
+    out_c = C // (oh * ow)
+
+    def fn(xv, bx):
+        n_boxes = bx.shape[0]
+
+        def one(box):
+            x1, y1, x2, y2 = box * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1) / oh
+            rw = jnp.maximum(x2 - x1, 0.1) / ow
+            H, W = xv.shape[2], xv.shape[3]
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            outs = []
+            feat = xv[0]  # single-image assumption per reference boxes_num
+            for i in range(oh):
+                for j in range(ow):
+                    y_lo = y1 + i * rh
+                    y_hi = y1 + (i + 1) * rh
+                    x_lo = x1 + j * rw
+                    x_hi = x1 + (j + 1) * rw
+                    my = ((ys >= jnp.floor(y_lo))
+                          & (ys < jnp.ceil(y_hi))).astype(jnp.float32)
+                    mx = ((xs >= jnp.floor(x_lo))
+                          & (xs < jnp.ceil(x_hi))).astype(jnp.float32)
+                    m = my[:, None] * mx[None, :]
+                    denom = jnp.maximum(m.sum(), 1.0)
+                    grp = feat[(i * ow + j) * out_c:(i * ow + j + 1) * out_c]
+                    outs.append((grp * m[None]).sum((1, 2)) / denom)
+            return jnp.stack(outs, 1).reshape(out_c, oh, ow)
+
+        return jax.vmap(one)(bx)
+
+    return apply_op(fn, [xt, bt], name="psroi_pool")
+
+
+class PSRoIPool:
+    """Layer form (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals): level = floor(refer + log2(sqrt(area)/
+    refer_scale))."""
+    rv = np.asarray(ensure_tensor(fpn_rois).numpy())
+    off = 1.0 if pixel_offset else 0.0
+    w = rv[:, 2] - rv[:, 0] + off
+    h = rv[:, 3] - rv[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-9))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rv[idx])))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore_ind = np.empty_like(order)
+    restore_ind[order] = np.arange(len(order))
+    rois_num_per_level = None
+    if rois_num is not None:
+        rois_num_per_level = [Tensor(jnp.asarray(
+            np.asarray([len(np.nonzero(lvl == L)[0])], np.int32)))
+            for L in range(min_level, max_level + 1)]
+    out = (multi_rois, Tensor(jnp.asarray(restore_ind[:, None])))
+    if rois_num_per_level is not None:
+        out = out + (rois_num_per_level,)
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals): decode anchors with deltas, clip, filter small,
+    NMS, top-k."""
+    from .ops import nms as _nms
+
+    sv = np.asarray(ensure_tensor(scores).numpy())        # [B, A, H, W]
+    dv = np.asarray(ensure_tensor(bbox_deltas).numpy())   # [B, 4A, H, W]
+    iv = np.asarray(ensure_tensor(img_size).numpy())      # [B, 2]
+    av = np.asarray(ensure_tensor(anchors).numpy()).reshape(-1, 4)
+    vv = np.asarray(ensure_tensor(variances).numpy()).reshape(-1, 4)
+    B, A, H, W = sv.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_nums, all_scores = [], [], []
+    for b in range(B):
+        s = sv[b].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = dv[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anc = np.broadcast_to(av.reshape(1, 1, A, 4), (H, W, A, 4)
+                              ).reshape(-1, 4) if av.shape[0] == A else av
+        var = np.broadcast_to(vv.reshape(1, 1, A, 4), (H, W, A, 4)
+                              ).reshape(-1, 4) if vv.shape[0] == A else vv
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        ih, iw = iv[b]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s2 = boxes[order], s[order]
+        wv2 = boxes[:, 2] - boxes[:, 0] + off
+        hv2 = boxes[:, 3] - boxes[:, 1] + off
+        ok = (wv2 >= min_size) & (hv2 >= min_size)
+        boxes, s2 = boxes[ok], s2[ok]
+        if len(boxes):
+            keep = np.asarray(_nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                                   scores=Tensor(jnp.asarray(s2))).numpy())
+            keep = keep[:post_nms_top_n]
+            boxes, s2 = boxes[keep], s2[keep]
+        all_rois.append(boxes)
+        all_scores.append(s2)
+        all_nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    rscores = Tensor(jnp.asarray(
+        np.concatenate(all_scores) if all_scores
+        else np.zeros((0,), np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.asarray(all_nums, np.int32)))
+    return rois, rscores
+
+
+def read_file(filename: str, name=None) -> Tensor:
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None) -> Tensor:
+    """Decode JPEG bytes to CHW uint8 (reference: vision/ops.py
+    decode_jpeg → nvjpeg). Requires Pillow; raises a clear error in this
+    zero-egress image when it is absent."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            "decode_jpeg needs Pillow, which is not in this zero-egress "
+            "image; decode offline and feed .npy arrays instead") from e
+    import io as _io
+
+    raw = bytes(np.asarray(ensure_tensor(x).numpy(), np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

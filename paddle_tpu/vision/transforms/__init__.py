@@ -3,8 +3,8 @@
 only: transforms run in DataLoader workers and must never touch the device
 backend (generator.host_rng pattern)."""
 from .functional import (  # noqa: F401
-    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
-    normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip,
+    adjust_brightness, adjust_contrast, adjust_hue, affine, center_crop,
+    crop, erase, hflip, normalize, perspective, pad, resize, rotate, to_grayscale, to_tensor, vflip,
 )
 from .transforms import (  # noqa: F401
     BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
@@ -22,4 +22,8 @@ __all__ = [
     "to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
     "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
     "adjust_contrast", "adjust_hue",
+    "RandomAffine", "RandomPerspective", "affine",
+    "perspective", "erase",
 ]
+
+from .transforms import RandomAffine, RandomErasing, RandomPerspective  # noqa: F401,E402

@@ -11,7 +11,7 @@ import numpy as np
 __all__ = [
     "to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
     "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
-    "adjust_contrast", "adjust_hue",
+    "adjust_contrast", "adjust_hue", "affine", "perspective", "erase",
 ]
 
 
@@ -182,3 +182,109 @@ def _clip_like(arr, ref):
     if dt == np.uint8:
         return np.clip(arr, 0, 255).astype(np.uint8)
     return arr.astype("float32")
+
+
+def _affine_sample(img: np.ndarray, matrix: np.ndarray,
+                   interpolation: str = "nearest",
+                   fill=0) -> np.ndarray:
+    """Sample HWC image at inverse-affine-mapped coordinates (shared by
+    affine/perspective/rotate-family transforms)."""
+    H, W = img.shape[:2]
+    ys, xs = np.meshgrid(np.arange(H, dtype=np.float64),
+                         np.arange(W, dtype=np.float64), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = matrix @ coords
+    if matrix.shape[0] == 3:  # perspective: homogeneous divide
+        src = src[:2] / np.maximum(np.abs(src[2:3]), 1e-9) * np.sign(
+            src[2:3])
+    sx, sy = src[0].reshape(H, W), src[1].reshape(H, W)
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = sx - x0
+        wy = sy - y0
+        out = np.zeros_like(img, dtype=np.float64)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi = np.clip(x0 + dx, 0, W - 1)
+                yi = np.clip(y0 + dy, 0, H - 1)
+                wgt = ((wx if dx else 1 - wx) * (wy if dy else 1 - wy))
+                out += img[yi, xi].astype(np.float64) * wgt[..., None]
+    else:
+        xi = np.clip(np.round(sx).astype(np.int64), 0, W - 1)
+        yi = np.clip(np.round(sy).astype(np.int64), 0, H - 1)
+        out = img[yi, xi].astype(np.float64)
+    oob = (sx < 0) | (sx > W - 1) | (sy < 0) | (sy > H - 1)
+    out[oob] = fill
+    return out.astype(img.dtype)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform (reference: functional.affine — rotate/translate/
+    scale/shear about the center)."""
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    cx, cy = center if center is not None else ((W - 1) / 2, (H - 1) / 2)
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix: T(center+translate) R(rot) Shear Scale T(-center)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    M = np.array([[a * scale, b * scale,
+                   cx + translate[0] - (a * scale) * cx - (b * scale) * cy],
+                  [c * scale, d * scale,
+                   cy + translate[1] - (c * scale) * cx - (d * scale) * cy]],
+                 np.float64)
+    # sample with the INVERSE mapping
+    Mi = np.linalg.inv(np.vstack([M, [0, 0, 1]]))[:2]
+    return _affine_sample(arr, Mi, interpolation, fill)
+
+
+def _perspective_coeffs(startpoints, endpoints) -> np.ndarray:
+    """Solve the 8-dof homography mapping endpoints -> startpoints."""
+    A = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coef = np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(b, np.float64))
+    return np.vstack([coef[:6].reshape(2, 3),
+                      [coef[6], coef[7], 1.0]])
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective transform (reference: functional.perspective)."""
+    arr = _as_hwc(img)
+    M = _perspective_coeffs(startpoints, endpoints)
+    return _affine_sample(arr, M, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (reference: functional.erase). Accepts
+    HWC numpy or CHW tensors (erased in the layout given)."""
+    from ...tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        arr = img._value
+        if not inplace:
+            arr = jnp.asarray(arr)
+        val = jnp.asarray(v, arr.dtype)
+        out = arr.at[..., i:i + h, j:j + w].set(
+            val if val.ndim == 0 else val)
+        if inplace:
+            img._set_value(out)
+            return img
+        return Tensor(out)
+    arr = np.asarray(img) if inplace else np.array(img)
+    arr[i:i + h, j:j + w] = v
+    return arr

@@ -17,6 +17,7 @@ __all__ = [
     "Normalize", "Transpose", "Pad", "RandomRotation", "ColorJitter",
     "Grayscale", "BrightnessTransform", "ContrastTransform", "HueTransform",
     "SaturationTransform", "RandomErasing",
+    "RandomAffine", "RandomPerspective",
 ]
 
 
@@ -313,3 +314,76 @@ class RandomErasing(BaseTransform):
                     arr[top:top + h, left:left + w] = self.value
                 return arr
         return arr
+
+
+class RandomAffine(BaseTransform):
+    """Random affine transform (reference: transforms.py RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, (int, float)) else degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        rng = host_rng()  # paddle.seed-reproducible (module pattern)
+
+        angle = rng.uniform(*self.degrees)
+        h, w = np.asarray(img).shape[:2]
+        if self.translate is not None:
+            tx = rng.uniform(-self.translate[0], self.translate[0]) * w
+            ty = rng.uniform(-self.translate[1], self.translate[1]) * h
+            translate = (tx, ty)
+        else:
+            translate = (0.0, 0.0)
+        scale = (rng.uniform(*self.scale) if self.scale is not None
+                 else 1.0)
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, (int, float)):
+                shear = (rng.uniform(-sh, sh), 0.0)
+            elif len(sh) == 2:
+                shear = (rng.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (rng.uniform(sh[0], sh[1]),
+                         rng.uniform(sh[2], sh[3]))
+        else:
+            shear = (0.0, 0.0)
+        return F.affine(img, angle, translate, scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random perspective distortion (reference: transforms.py
+    RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        rng = host_rng()
+
+        if rng.random() >= self.prob:
+            return np.asarray(img)
+        h, w = np.asarray(img).shape[:2]
+        d = self.distortion_scale
+        hd = int(d * h / 2)
+        wd = int(d * w / 2)
+        ri = lambda hi: int(rng.integers(0, hi + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(ri(wd), ri(hd)),
+               (w - 1 - ri(wd), ri(hd)),
+               (w - 1 - ri(wd), h - 1 - ri(hd)),
+               (ri(wd), h - 1 - ri(hd))]
+        return F.perspective(img, start, end, self.interpolation, self.fill)
