@@ -151,6 +151,25 @@ class FakeData(Dataset):
         return self.size
 
 
+def _scan_files(root, exts, is_valid_file):
+    """Walk ``root`` collecting files by extension/validator (shared by
+    DatasetFolder and ImageFolder)."""
+    import os
+
+    found = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(exts))
+            if ok:
+                found.append(path)
+    return found
+
+
+_IMG_EXTS = (".npy", ".jpg", ".jpeg", ".png", ".bmp")
+
+
 class DatasetFolder(Dataset):
     """Samples arranged class-per-directory (reference:
     vision/datasets/folder.py DatasetFolder). Default loader reads .npy
@@ -164,24 +183,18 @@ class DatasetFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or self._default_loader
-        exts = tuple(extensions) if extensions else (
-            ".npy", ".jpg", ".jpeg", ".png", ".bmp", ".wav")
+        exts = tuple(extensions) if extensions else _IMG_EXTS
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         if not classes:
             raise RuntimeError(f"no class directories under {root!r}")
         self.classes = classes
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
-        self.samples = []
-        for c in classes:
-            cdir = os.path.join(root, c)
-            for dirpath, _, files in sorted(os.walk(cdir)):
-                for fname in sorted(files):
-                    path = os.path.join(dirpath, fname)
-                    ok = (is_valid_file(path) if is_valid_file
-                          else fname.lower().endswith(exts))
-                    if ok:
-                        self.samples.append((path, self.class_to_idx[c]))
+        self.samples = [
+            (path, self.class_to_idx[c])
+            for c in classes
+            for path in _scan_files(os.path.join(root, c), exts,
+                                    is_valid_file)]
         if not self.samples:
             raise RuntimeError(f"no valid sample files under {root!r}")
 
@@ -217,16 +230,8 @@ class ImageFolder(DatasetFolder):
         self.root = root
         self.transform = transform
         self.loader = loader or self._default_loader
-        exts = tuple(extensions) if extensions else (
-            ".npy", ".jpg", ".jpeg", ".png", ".bmp")
-        self.samples = []
-        for dirpath, _, files in sorted(os.walk(root)):
-            for fname in sorted(files):
-                path = os.path.join(dirpath, fname)
-                ok = (is_valid_file(path) if is_valid_file
-                      else fname.lower().endswith(exts))
-                if ok:
-                    self.samples.append(path)
+        exts = tuple(extensions) if extensions else _IMG_EXTS
+        self.samples = _scan_files(root, exts, is_valid_file)
         if not self.samples:
             raise RuntimeError(f"no valid sample files under {root!r}")
 

@@ -180,21 +180,26 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         if flip and ar != 1.0:
             ratios.append(1.0 / ar)
 
-    boxes = []
-    for s in min_sizes:
-        boxes.append((s, s))
-        if 1.0 in ratios or not min_max_aspect_ratios_order:
-            pass
+    # prior order per min_size (reference prior_box kernel):
+    #   False: min box, ratio boxes, max box
+    #   True:  min box, max box, ratio boxes (SSD's trained-channel order)
     whs = []
-    for s in min_sizes:
+    for i, s in enumerate(min_sizes):
         whs.append((s, s))
-        for ar in ratios:
-            if ar == 1.0:
-                continue
-            whs.append((s * math.sqrt(ar), s / math.sqrt(ar)))
-    if max_sizes:
-        for smin, smax in zip(min_sizes, max_sizes):
-            whs.append((math.sqrt(smin * smax), math.sqrt(smin * smax)))
+        max_wh = None
+        if max_sizes:
+            m = math.sqrt(s * max_sizes[i])
+            max_wh = (m, m)
+        ratio_whs = [(s * math.sqrt(ar), s / math.sqrt(ar))
+                     for ar in ratios if ar != 1.0]
+        if min_max_aspect_ratios_order:
+            if max_wh:
+                whs.append(max_wh)
+            whs.extend(ratio_whs)
+        else:
+            whs.extend(ratio_whs)
+            if max_wh:
+                whs.append(max_wh)
     num_priors = len(whs)
 
     cx = (np.arange(W) + offset) * step_w
@@ -247,15 +252,18 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             inter = iw * ih
             iou = inter / np.maximum(area[:, None] + area[None] - inter,
                                      1e-9)
-            iou = np.triu(iou, 1)  # overlap with higher-scored boxes only
-            iou_max_col = iou.max(axis=0)          # per-box max overlap
-            comp = iou.max(axis=1, initial=0.0)
+            iou = np.triu(iou, 1)  # iou[i, j] for suppressor i < candidate j
+            # compensate_i: suppressor i's own max overlap with boxes
+            # scored above IT (how suppressed the suppressor itself is)
+            comp = iou.max(axis=0)                 # [n] column max
             if use_gaussian:
-                decay = np.exp(-(iou_max_col ** 2 - comp ** 2)
-                               / gaussian_sigma)
+                decay_m = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                                 / gaussian_sigma)
             else:
-                decay = (1 - iou_max_col) / np.maximum(1 - comp, 1e-9)
-            decay = np.minimum(decay, 1.0)
+                decay_m = (1 - iou) / np.maximum(1 - comp[:, None], 1e-9)
+            # candidate j decays by its WORST suppressor (min over i<j);
+            # rows i>=j carry iou=0 → decay 1/exp(+comp²)>=1, masked by min
+            decay = np.minimum(decay_m, 1.0).min(axis=0)
             ds = s * decay
             ok = ds > post_threshold
             for i in np.nonzero(ok)[0]:
@@ -355,20 +363,31 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(np.maximum(w * h, 1e-9))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
-    multi_rois, restore = [], []
-    order = []
+    # image id per roi from the per-image counts (rois are concatenated)
+    if rois_num is not None:
+        counts = np.asarray(ensure_tensor(rois_num).numpy()).astype(np.int64)
+        img_of = np.repeat(np.arange(len(counts)), counts)
+    else:
+        counts = None
+        img_of = np.zeros(len(rv), np.int64)
+    multi_rois, order = [], []
+    rois_num_per_level = [] if counts is not None else None
     for L in range(min_level, max_level + 1):
-        idx = np.nonzero(lvl == L)[0]
+        on_level = lvl == L
+        # within a level, keep image-major order so per-image counts are
+        # contiguous (the reference's per-level LoD)
+        idx = np.nonzero(on_level)[0]
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
         multi_rois.append(Tensor(jnp.asarray(rv[idx])))
         order.append(idx)
+        if counts is not None:
+            per_img = np.asarray(
+                [int((img_of[idx] == b).sum()) for b in range(len(counts))],
+                np.int32)
+            rois_num_per_level.append(Tensor(jnp.asarray(per_img)))
     order = np.concatenate(order) if order else np.zeros(0, np.int64)
     restore_ind = np.empty_like(order)
     restore_ind[order] = np.arange(len(order))
-    rois_num_per_level = None
-    if rois_num is not None:
-        rois_num_per_level = [Tensor(jnp.asarray(
-            np.asarray([len(np.nonzero(lvl == L)[0])], np.int32)))
-            for L in range(min_level, max_level + 1)]
     out = (multi_rois, Tensor(jnp.asarray(restore_ind[:, None])))
     if rois_num_per_level is not None:
         out = out + (rois_num_per_level,)

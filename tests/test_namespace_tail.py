@@ -282,3 +282,79 @@ def test_saved_tensors_hooks_pack_unpack():
     z = (x * x).sum()
     z.backward()
     assert events == []
+
+
+def test_gloo_trio_two_process(tmp_path):
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    worker = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import paddle_tpu.distributed as dist
+        rank = int(sys.argv[1])
+        dist.gloo_init_parallel_env(rank, 2, "127.0.0.1:{port}")
+        for _ in range(2):
+            dist.gloo_barrier()
+        dist.gloo_release()
+        print(f"GLOO{{rank}}OK")
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen([sys.executable, "-c", worker, str(r)],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for r in range(2)]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0 and f"GLOO{r}OK" in out, out
+
+
+def test_matrix_nms_compensation():
+    # C's only suppressor B is itself crushed by A, so C must survive
+    # (the Matrix-NMS compensation term — a plain soft-NMS would kill C)
+    A = [0, 0, 10, 10]
+    B = [1, 0, 11, 10]
+    C = [9.2, 0, 19.2, 10]
+    bb = t(np.array([[A, B, C]], np.float32))
+    sc = t(np.array([[[0.9, 0.85, 0.8]]], np.float32))
+    out, num = paddle.vision.ops.matrix_nms(
+        bb, sc, score_threshold=0.1, post_threshold=0.3, nms_top_k=10,
+        keep_top_k=10, background_label=-1)
+    kept = np.round(np.asarray(out.numpy())[:, 1], 3)
+    assert 0.9 in kept          # A untouched
+    assert kept.min() > 0.5     # C compensated, not crushed to ~0.12
+
+
+def test_distribute_fpn_proposals_per_image_counts():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 200, 200],   # image 0
+                     [0, 0, 12, 12]], np.float32)         # image 1
+    multi, restore, nums = paddle.vision.ops.distribute_fpn_proposals(
+        t(rois), min_level=2, max_level=5, refer_level=4, refer_scale=224,
+        rois_num=t(np.array([2, 1], np.int64)))
+    # every level reports a per-image vector of length 2
+    for n in nums:
+        assert tuple(n.shape) == (2,)
+    total = np.stack([np.asarray(n.numpy()) for n in nums]).sum(axis=0)
+    np.testing.assert_array_equal(total, [2, 1])
+
+
+def test_prior_box_min_max_order():
+    feat = t(np.zeros((1, 8, 1, 1), np.float32))
+    img = t(np.zeros((1, 3, 32, 32), np.float32))
+    kw = dict(min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[1.0, 2.0])
+    b_false, _ = paddle.vision.ops.prior_box(feat, img, **kw)
+    b_true, _ = paddle.vision.ops.prior_box(
+        feat, img, min_max_aspect_ratios_order=True, **kw)
+    bf = np.asarray(b_false.numpy())[0, 0]
+    bt = np.asarray(b_true.numpy())[0, 0]
+    assert bf.shape[0] == bt.shape[0] == 3  # min, ratio, max
+    np.testing.assert_allclose(bf[0], bt[0])       # min box first in both
+    np.testing.assert_allclose(bf[-1], bt[1])      # max box moves to slot 1
+    np.testing.assert_allclose(bf[1], bt[-1])      # ratio box moves last
