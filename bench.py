@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Flagship benchmark: GPT causal-LM pretraining throughput on one TPU chip.
+"""Benchmarks: GPT pretraining (flagship), BERT-base finetune, ResNet-50.
 
 Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}
 (+extras). All diagnostics go to stderr. The reference publishes no numbers
-(BASELINE.md) — the metric is tokens/sec/chip on a GPT-medium-scale config
-with bf16 AMP and a fully compiled train step (forward+backward+AdamW in one
-XLA program), plus the MFU against the chip's advertised bf16 peak.
+(BASELINE.md) — each config's first TPU measurement IS the baseline.
+
+Model selection: ``--model gpt|bert|resnet50`` or ``BENCH_MODEL`` env
+(default gpt — the driver's headline metric stays tokens/sec/chip + MFU).
 
 Backend acquisition is retried with backoff (round 1 recorded a transient
 "Unable to initialize backend 'axon': UNAVAILABLE" with zero resilience —
@@ -13,8 +14,14 @@ VERDICT.md weak #1). If the TPU backend stays down past the budget, the
 benchmark re-execs itself into a scrubbed CPU-only environment so a JSON
 line is ALWAYS produced (device field says which path ran).
 
-Env knobs: BENCH_SMALL=1 (tiny config for CPU smoke), BENCH_STEPS, BENCH_BATCH,
-BENCH_SEQ, BENCH_BACKEND_WAIT (seconds, default 600).
+Every successful measurement is ALSO appended to BENCH_NOTES_r03.json
+(JSON-lines) next to this file — round 2's real numbers lived only in prose
+and were lost to a tunnel wedge (VERDICT r2 weak #1); the machine-readable
+trail survives one.
+
+Env knobs: BENCH_SMALL=1 (tiny config for CPU smoke), BENCH_STEPS,
+BENCH_BATCH, BENCH_SEQ, BENCH_RECOMPUTE=1, BENCH_BACKEND_WAIT (seconds,
+default 600), BENCH_MODEL.
 """
 import json
 import os
@@ -26,9 +33,24 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+_NOTES_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_NOTES_r03.json")
+
 
 def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _emit(record: dict):
+    """Print the driver JSON line AND persist it to the round notes file."""
+    print(json.dumps(record), flush=True)
+    try:
+        record = dict(record)
+        record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(_NOTES_PATH, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:  # pragma: no cover
+        _log(f"could not persist to {_NOTES_PATH}: {e}")
 
 
 def _probe_backend_subprocess(timeout_s: float) -> bool:
@@ -97,18 +119,52 @@ def _reexec_cpu_fallback():
     env["BENCH_SMALL"] = "1"
     env["BENCH_CPU_FALLBACK"] = "1"
     _log("re-exec into CPU-only fallback (scrubbed env)")
-    rc = subprocess.call([sys.executable, os.path.abspath(__file__)], env=env)
+    rc = subprocess.call([sys.executable, os.path.abspath(__file__)]
+                         + sys.argv[1:], env=env)
     sys.exit(rc)
 
 
-def run_bench(dev):
+def _time_steps(step, args, steps):
+    """Per-step blocking timing; slowest ~20% dropped as relay stragglers.
+    Returns mean step seconds over the kept set."""
+    _log("compiling...")
+    t0 = time.time()
+    out = step(*args)
+    _first_leaf(out).value.block_until_ready()
+    compile_s = time.time() - t0
+    _log(f"compiled in {compile_s:.1f}s; warming 2 steps...")
+    for _ in range(2):
+        _first_leaf(step(*args)).value.block_until_ready()
+    _log(f"timing {steps} steps...")
+    step_times = []
+    for _ in range(steps):
+        t0 = time.time()
+        out = step(*args)
+        _first_leaf(out).value.block_until_ready()
+        step_times.append(time.time() - t0)
+    step_times.sort()
+    kept = step_times[: max(1, len(step_times) - len(step_times) // 5)]
+    _log("step times (s): " + " ".join(f"{t:.3f}" for t in step_times))
+    return sum(kept) / len(kept), compile_s, out
+
+
+def _first_leaf(out):
+    return out[0] if isinstance(out, (tuple, list)) else out
+
+
+def _mfu(achieved_tflops, on_tpu):
+    peak = 197.0  # v5e bf16 peak TFLOP/s
+    return round(achieved_tflops / peak, 4) if on_tpu else None
+
+
+# ------------------------------------------------------------------- GPT
+
+def bench_gpt(dev, small):
     import paddle_tpu as paddle
     from paddle_tpu import amp, jit
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
     on_tpu = dev.platform in ("tpu", "axon")
-    small = os.environ.get("BENCH_SMALL") == "1" or not on_tpu
-
     if small:
         cfg = GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
                         num_heads=8, max_position_embeddings=512,
@@ -122,12 +178,13 @@ def run_bench(dev):
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=max(S, 1024),
                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                        recompute=os.environ.get("BENCH_RECOMPUTE") == "1")
+                        recompute=os.environ.get("BENCH_RECOMPUTE") == "1",
+                        fused_loss=os.environ.get("BENCH_FUSED_CE") == "1")
         B = int(os.environ.get("BENCH_BATCH", 8))
         steps = int(os.environ.get("BENCH_STEPS", 10))
 
-    _log(f"config: h{cfg.hidden_size} l{cfg.num_layers} B{B} S{S} "
-         f"steps={steps} device={dev.platform}")
+    _log(f"gpt config: h{cfg.hidden_size} l{cfg.num_layers} B{B} S{S} "
+         f"steps={steps} recompute={cfg.recompute} device={dev.platform}")
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -143,62 +200,193 @@ def run_bench(dev):
         return loss
 
     step = jit.StaticFunction(train_fn, observe=[model, opt], warmup=False)
-
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)))
     labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
 
-    _log("compiling train step...")
-    t0 = time.time()
-    loss = step(ids, labels)
-    loss.value.block_until_ready()
-    compile_s = time.time() - t0
-    _log(f"compiled in {compile_s:.1f}s; warming 2 steps...")
-    for _ in range(2):
-        step(ids, labels).value.block_until_ready()
-    _log(f"timing {steps} steps...")
-
-    # block every step: through the axon relay, letting dispatches queue up
-    # measured ~10x slower than the same program stepped synchronously (the
-    # relay round-trips the donated state chain), and per-step blocking is
-    # also the honest steady-state number
-    step_times = []
-    for _ in range(steps):
-        t0 = time.time()
-        loss = step(ids, labels)
-        loss.value.block_until_ready()
-        step_times.append(time.time() - t0)
-    step_times.sort()
-    # drop the slowest ~20% as relay-hiccup stragglers; keep at least one
-    kept = step_times[: max(1, len(step_times) - len(step_times) // 5)]
-    dt = sum(kept) / len(kept) * steps
-    _log("step times (s): " + " ".join(f"{t:.3f}" for t in step_times))
-
-    tokens_per_s = B * S * steps / dt
+    dt, compile_s, loss = _time_steps(step, (ids, labels), steps)
+    tokens_per_s = B * S / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params  # fwd+bwd dense-transformer convention
-    achieved_tflops = flops_per_token * tokens_per_s / 1e12
-    peak = 197.0 if on_tpu else float("nan")  # v5e bf16 peak TFLOP/s
-    mfu = achieved_tflops / peak if on_tpu else None
-
-    print(json.dumps({
+    achieved = flops_per_token * tokens_per_s / 1e12
+    _emit({
         "metric": "gpt_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
-        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md): this run IS the baseline
-        "config": f"gpt-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}-bf16",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+        "config": f"gpt-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}-bf16"
+                  + ("-rc" if cfg.recompute else "")
+                  + ("-fce" if cfg.fused_loss else ""),
         "params_m": round(n_params / 1e6, 1),
         "loss": float(np.asarray(loss.numpy(), dtype="float32")),
-        "step_ms": round(1000 * dt / steps, 1),
+        "step_ms": round(1000 * dt, 1),
         "compile_s": round(compile_s, 1),
-        "achieved_tflops_per_s": round(achieved_tflops, 2),
-        "mfu_vs_v5e_peak": round(mfu, 4) if mfu is not None else None,
+        "achieved_tflops_per_s": round(achieved, 2),
+        "mfu_vs_v5e_peak": _mfu(achieved, on_tpu),
         "device": str(dev.platform),
         "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
-    }), flush=True)
+    })
+
+
+# ------------------------------------------------------------------ BERT
+
+def bench_bert(dev, small):
+    """BERT-base MLM+NSP pretraining-style step (BASELINE.md config 2)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit
+    from paddle_tpu.models import BertForPretraining, bert_base, bert_tiny
+
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small:
+        cfg = bert_tiny()
+        B = int(os.environ.get("BENCH_BATCH", 4))
+        S = int(os.environ.get("BENCH_SEQ", 128))
+        steps = int(os.environ.get("BENCH_STEPS", 5))
+    else:
+        cfg = bert_base()
+        B = int(os.environ.get("BENCH_BATCH", 32))
+        S = int(os.environ.get("BENCH_SEQ", 128))
+        steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    _log(f"bert config: h{cfg.hidden_size} l{cfg.num_layers} "
+         f"B{B} S{S} steps={steps} device={dev.platform}")
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(ids, mlm_labels, nsp_labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            _, loss = model(ids, masked_lm_labels=mlm_labels,
+                            next_sentence_labels=nsp_labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = jit.StaticFunction(train_fn, observe=[model, opt], warmup=False)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)))
+    mlm = np.asarray(ids.numpy()).copy()
+    keep = rng.random((B, S)) > 0.15
+    mlm[keep] = -100  # ignore index: loss on the 15% masked positions
+    mlm_labels = paddle.to_tensor(mlm)
+    nsp = paddle.to_tensor(rng.integers(0, 2, (B,)))
+
+    dt, compile_s, loss = _time_steps(step, (ids, mlm_labels, nsp), steps)
+    tokens_per_s = B * S / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    achieved = 6 * n_params * tokens_per_s / 1e12
+    _emit({
+        "metric": "bert_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "config": f"bert-h{cfg.hidden_size}-l{cfg.num_layers}"
+                  f"-b{B}-s{S}-bf16",
+        "params_m": round(n_params / 1e6, 1),
+        "loss": float(np.asarray(loss.numpy(), dtype="float32")),
+        "step_ms": round(1000 * dt, 1),
+        "compile_s": round(compile_s, 1),
+        "achieved_tflops_per_s": round(achieved, 2),
+        "mfu_vs_v5e_peak": _mfu(achieved, on_tpu),
+        "device": str(dev.platform),
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    })
+
+
+# --------------------------------------------------------------- ResNet-50
+
+def bench_resnet50(dev, small):
+    """ResNet-50 ImageNet-shape training step (BASELINE.md config 1).
+    FLOPs/step come from XLA's own cost analysis of the compiled program
+    (StaticFunction.cost_analysis) — convs don't fit the 6N convention."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import amp, jit
+    from paddle_tpu.vision.models import resnet18, resnet50
+
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small:
+        model_fn, name = resnet18, "resnet18"
+        B = int(os.environ.get("BENCH_BATCH", 2))
+        H = 64
+        steps = int(os.environ.get("BENCH_STEPS", 3))
+    else:
+        model_fn, name = resnet50, "resnet50"
+        B = int(os.environ.get("BENCH_BATCH", 64))
+        H = 224
+        steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    _log(f"{name} config: B{B} {H}x{H} steps={steps} device={dev.platform}")
+    paddle.seed(0)
+    model = model_fn(num_classes=1000)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(images, labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            logits = model(images)
+            loss = F.cross_entropy(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = jit.StaticFunction(train_fn, observe=[model, opt], warmup=False)
+    rng = np.random.default_rng(0)
+    images = paddle.to_tensor(
+        rng.standard_normal((B, 3, H, H)).astype("float32"))
+    labels = paddle.to_tensor(rng.integers(0, 1000, (B,)))
+
+    dt, compile_s, loss = _time_steps(step, (images, labels), steps)
+    imgs_per_s = B / dt
+
+    flops_per_step = None
+    flops_source = "analytic"
+    try:
+        cost = step.cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_step = float(cost["flops"])
+            flops_source = "xla_cost_analysis"
+    except Exception as e:  # pragma: no cover
+        _log(f"cost_analysis unavailable: {type(e).__name__}: {e}")
+    if flops_per_step is None:
+        # analytic fallback: ~4.1 GFLOPs fwd @224 x3 for fwd+bwd
+        flops_per_step = (12.3e9 if name == "resnet50" else 5.4e9) \
+            * B * (H / 224.0) ** 2
+    achieved = flops_per_step * (1.0 / dt) / 1e12
+    _emit({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(imgs_per_s, 1),
+        "unit": "imgs/s",
+        "vs_baseline": 1.0,
+        "config": f"{name}-b{B}-{H}x{H}-bf16",
+        "loss": float(np.asarray(loss.numpy(), dtype="float32")),
+        "step_ms": round(1000 * dt, 1),
+        "compile_s": round(compile_s, 1),
+        "achieved_tflops_per_s": round(achieved, 2),
+        "mfu_vs_v5e_peak": _mfu(achieved, on_tpu),
+        "flops_source": flops_source,
+        "device": str(dev.platform),
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    })
+
+
+_MODELS = {"gpt": bench_gpt, "bert": bench_bert, "resnet50": bench_resnet50}
 
 
 def main():
+    model = os.environ.get("BENCH_MODEL", "gpt")
+    if "--model" in sys.argv:
+        model = sys.argv[sys.argv.index("--model") + 1]
+    if model not in _MODELS:
+        _log(f"unknown model {model!r}; choose from {sorted(_MODELS)}")
+        sys.exit(2)
+    os.environ["BENCH_MODEL"] = model  # survives the CPU-fallback re-exec
+
     max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", 600))
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         max_wait = 60.0
@@ -209,7 +397,9 @@ def main():
             sys.exit(1)
         _reexec_cpu_fallback()
         return
-    run_bench(dev)
+    on_tpu = dev.platform in ("tpu", "axon")
+    small = os.environ.get("BENCH_SMALL") == "1" or not on_tpu
+    _MODELS[model](dev, small)
 
 
 if __name__ == "__main__":

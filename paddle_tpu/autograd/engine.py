@@ -160,6 +160,13 @@ def _amp_cast(arrays, name):
     ]
 
 
+# Observers consulted with every op's input tensors. Used by
+# static.nn.control_flow's capture discovery (finding which pre-existing
+# tensors a branch callable closes over) — the tape-level counterpart of the
+# reference's block-input analysis in conditional_block's assign pass.
+_op_input_observers: list = []
+
+
 def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
              differentiable: bool = True, name: str = "") -> "Tensor | tuple":
     """Run one op through the tape.
@@ -169,6 +176,9 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
     under ``jax.vjp`` and a GradNode is recorded on the outputs — the
     counterpart of the generated ``xxx_ad_func`` forwards (eager_gen.py:1291).
     """
+    if _op_input_observers:
+        for _obs in _op_input_observers:
+            _obs(tensors)
     attrs = attrs or {}
     arrays = [t._value for t in tensors]
     if amp_state["enabled"]:

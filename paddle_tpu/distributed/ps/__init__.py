@@ -12,11 +12,13 @@ with key-space sharding across servers. ``SparseEmbedding`` plugs the
 client into the eager autograd tape so a dense TPU model can train
 against a host-resident embedding table that never enters HBM.
 """
-from .table import DenseTable, SparseTable, TableConfig  # noqa: F401
+from .table import (  # noqa: F401
+    DenseTable, SparseTable, SSDSparseTable, TableConfig,
+)
 from .service import PSClient, PSServer  # noqa: F401
 from .layers import SparseEmbedding  # noqa: F401
 
 __all__ = [
-    "TableConfig", "SparseTable", "DenseTable",
+    "TableConfig", "SparseTable", "DenseTable", "SSDSparseTable",
     "PSServer", "PSClient", "SparseEmbedding",
 ]

@@ -15,7 +15,7 @@ import numpy as np
 
 from ...native import load_library
 
-__all__ = ["TableConfig", "SparseTable", "DenseTable"]
+__all__ = ["TableConfig", "SparseTable", "DenseTable", "SSDSparseTable"]
 
 _OPT_KINDS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
@@ -53,6 +53,22 @@ def _native():
         lib.pd_ps_dense_push.argtypes = [ctypes.c_void_p, f32p]
         lib.pd_ps_dense_size.restype = ctypes.c_int64
         lib.pd_ps_dense_size.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_file_create.restype = ctypes.c_void_p
+        lib.pd_ps_file_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_int64]
+        lib.pd_ps_file_free.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_file_pull.argtypes = [ctypes.c_void_p, u64p,
+                                        ctypes.c_int64, f32p]
+        lib.pd_ps_file_push.argtypes = [ctypes.c_void_p, u64p,
+                                        ctypes.c_int64, f32p]
+        lib.pd_ps_file_size.restype = ctypes.c_int64
+        lib.pd_ps_file_size.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_file_mem_rows.restype = ctypes.c_int64
+        lib.pd_ps_file_mem_rows.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_file_flush.restype = ctypes.c_int
+        lib.pd_ps_file_flush.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -128,6 +144,69 @@ class SparseTable:
     def __del__(self):  # pragma: no cover
         try:
             _native().pd_ps_sparse_free(self._h)
+        except Exception:
+            pass
+
+
+class SSDSparseTable:
+    """Disk-backed sparse table with a bounded hot-row cache.
+
+    Reference parity: paddle/fluid/distributed/ps/table/ssd_sparse_table.cc
+    (RocksDB-backed). Here: a fixed-record file + in-memory index
+    (native/src/ps_table.cc FileSparseTable). Rows beyond ``max_mem_rows``
+    are evicted to disk; reopening the same path restores the table, so
+    embedding tables larger than host RAM and durable across restarts both
+    work.
+    """
+
+    def __init__(self, config: TableConfig, path: str,
+                 max_mem_rows: int = 100_000):
+        self.config = config
+        self.path = path
+        self._h = _native().pd_ps_file_create(
+            config.dim, config._opt_kind(), config.learning_rate,
+            config.beta1, config.beta2, config.epsilon, config.init_range,
+            config.seed, path.encode(), int(max_mem_rows))
+        if not self._h:
+            raise IOError(f"SSDSparseTable: cannot open {path!r}")
+
+    @property
+    def dim(self) -> int:
+        return self.config.dim
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty((keys.size, self.dim), dtype=np.float32)
+        _native().pd_ps_file_pull(self._h, _u64(keys), keys.size, _f32(out))
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        if grads.shape != (keys.size, self.dim):
+            raise ValueError(f"push grads shape {grads.shape} != "
+                             f"({keys.size}, {self.dim})")
+        _native().pd_ps_file_push(self._h, _u64(keys), keys.size, _f32(grads))
+
+    def __len__(self) -> int:
+        return int(_native().pd_ps_file_size(self._h))
+
+    @property
+    def mem_rows(self) -> int:
+        return int(_native().pd_ps_file_mem_rows(self._h))
+
+    def flush(self) -> None:
+        if _native().pd_ps_file_flush(self._h) != 0:
+            raise IOError(f"SSDSparseTable.flush() to {self.path!r} failed")
+
+    def close(self) -> None:
+        if self._h:
+            _native().pd_ps_file_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
         except Exception:
             pass
 

@@ -21,11 +21,15 @@ from ..autograd import no_grad
 from ..nn.layer_base import Layer
 from ..tensor import Tensor
 from .static_function import InputSpec, StaticFunction, _flatten_out, _rebuild_out
+from .bucketing import (  # noqa: F401
+    BucketedFunction, bucket_for, pad_to_bucket, pow2_buckets,
+)
 
 __all__ = [
     "to_static", "not_to_static", "save", "load", "TranslatedLayer",
     "StaticFunction", "InputSpec", "enable_to_static", "ignore_module",
     "set_code_level", "set_verbosity",
+    "BucketedFunction", "bucket_for", "pad_to_bucket", "pow2_buckets",
 ]
 
 _to_static_enabled = True
@@ -54,13 +58,16 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """Compile an imperative function/Layer per input signature
     (reference: paddle.jit.to_static, python/paddle/jit/api.py:232)."""
 
+    warmup = kwargs.pop("warmup", True)
+
     def decorate(obj):
         if not _to_static_enabled:
             return obj
         if isinstance(obj, Layer):
-            obj.forward = StaticFunction(obj.forward, input_spec, observe=[obj])
+            obj.forward = StaticFunction(obj.forward, input_spec,
+                                         observe=[obj], warmup=warmup)
             return obj
-        return StaticFunction(obj, input_spec)
+        return StaticFunction(obj, input_spec, warmup=warmup)
 
     if function is None:
         return decorate

@@ -213,13 +213,22 @@ def _closure_objects(fn: Callable):
     glob = getattr(f, "__globals__", None)
     if code is not None and glob is not None:
         import dis
+        import types
 
         # only names actually loaded as globals — co_names also lists
-        # attribute names, which could collide with unrelated module globals
-        loaded = {
-            ins.argval for ins in dis.get_instructions(code)
-            if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME")
-        }
+        # attribute names, which could collide with unrelated module globals.
+        # Recurse into nested code objects (lambdas / inner defs): a branch
+        # callable passed to static.nn.cond reaches its globals too.
+        loaded = set()
+        stack = [code]
+        while stack:
+            c = stack.pop()
+            loaded.update(
+                ins.argval for ins in dis.get_instructions(c)
+                if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME")
+            )
+            stack.extend(k for k in c.co_consts
+                         if isinstance(k, types.CodeType))
         for name in loaded:
             if name in glob:
                 objs.append(glob[name])

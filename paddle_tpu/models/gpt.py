@@ -56,6 +56,11 @@ class GPTConfig:
     # trades ~1/3 more FLOPs for O(layers) less live activation memory —
     # the standard lever for batching past HBM on one chip
     recompute: bool = False
+    # fused chunked linear+CE (ops/fused_loss.py): never materializes the
+    # [B·S, V] logits — O(N·V) loss memory drops to O(N·chunk), unlocking
+    # larger per-chip batches. forward(labels=...) then returns (None, loss)
+    # since full logits are deliberately never formed.
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -322,6 +327,18 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, position_ids=None, labels=None):
         hidden = self.gpt(input_ids, position_ids)
+        if labels is not None and self.config.fused_loss \
+                and self.lm_head is None and _mesh_mp() == 1:
+            from ..ops.fused_loss import fused_linear_cross_entropy
+
+            H = self.config.hidden_size
+            loss = apply_op(
+                lambda h, w, y: fused_linear_cross_entropy(
+                    h.reshape(-1, H), w, y.reshape(-1)),
+                [ensure_tensor(hidden), self.gpt.embeddings.weight,
+                 ensure_tensor(labels)],
+                name="fused_linear_cross_entropy")
+            return None, loss
         logits = self.logits(hidden)
         if labels is None:
             return logits

@@ -12,10 +12,14 @@
 //
 // C ABI only (ctypes-bound; no pybind11 in this image).
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -267,6 +271,187 @@ class DenseTable {
   std::vector<float> slots_;
 };
 
+// Disk-backed sparse table (reference parity:
+// paddle/fluid/distributed/ps/table/ssd_sparse_table.cc — hot rows in
+// memory, cold rows on SSD via RocksDB). TPU-framework redesign without a
+// RocksDB dependency: a single fixed-record file ([8-byte key | row floats]
+// per slot) with an in-memory key->slot index, plus a FIFO-bounded hot-row
+// cache. Rows evicted from the cache are written to their slot; rows pulled
+// back in are read with pread. Reopening an existing file rebuilds the
+// index by scanning records, so the table is durable across restarts.
+class FileSparseTable {
+ public:
+  // validated 24-byte header: reopening with a mismatched dim/optimizer
+  // must fail loudly, not stride the file at the wrong record size
+  static constexpr uint64_t kMagic = 0x5053464255ull;  // "PSFBU"
+  static constexpr int64_t kHeader = 24;  // magic u64 | dim i32 | rw i32 | pad
+
+  FileSparseTable(int dim, OptConfig opt, float init_range, uint64_t seed,
+                  const char* path, int64_t max_mem_rows)
+      : dim_(dim),
+        opt_(opt),
+        row_width_(dim + SlotWidth(opt, dim)),
+        rec_size_(8 + static_cast<int64_t>(row_width_) * sizeof(float)),
+        init_range_(init_range),
+        seed_(seed),
+        max_mem_rows_(max_mem_rows > 0 ? max_mem_rows : 1) {
+    fd_ = ::open(path, O_RDWR | O_CREAT, 0644);
+    if (fd_ < 0) return;
+    off_t end = ::lseek(fd_, 0, SEEK_END);
+    char hdr[kHeader] = {};
+    if (end == 0) {  // fresh file: stamp the header
+      std::memcpy(hdr, &kMagic, 8);
+      std::memcpy(hdr + 8, &dim_, 4);
+      std::memcpy(hdr + 12, &row_width_, 4);
+      if (::pwrite(fd_, hdr, kHeader, 0) != kHeader) {
+        ::close(fd_);
+        fd_ = -1;
+        return;
+      }
+      return;
+    }
+    uint64_t magic = 0;
+    int fdim = 0, frw = 0;
+    if (::pread(fd_, hdr, kHeader, 0) != kHeader) {
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    std::memcpy(&magic, hdr, 8);
+    std::memcpy(&fdim, hdr + 8, 4);
+    std::memcpy(&frw, hdr + 12, 4);
+    if (magic != kMagic || fdim != dim_ || frw != row_width_) {
+      ::close(fd_);  // config mismatch -> loud open failure
+      fd_ = -1;
+      return;
+    }
+    // rebuild the key->slot index from the existing records
+    int64_t n = (end - kHeader) / rec_size_;
+    std::vector<char> rec(rec_size_);
+    for (int64_t s = 0; s < n; ++s) {
+      if (::pread(fd_, rec.data(), rec_size_, kHeader + s * rec_size_) !=
+          rec_size_)
+        break;
+      uint64_t key;
+      std::memcpy(&key, rec.data(), 8);
+      slot_[key] = s;
+    }
+    next_slot_ = n;
+  }
+
+  ~FileSparseTable() {
+    if (fd_ >= 0) {
+      FlushLocked();
+      ::close(fd_);
+    }
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  void Pull(const uint64_t* keys, int64_t n, float* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (int64_t i = 0; i < n; ++i) {
+      std::vector<float>& row = RowLocked(keys[i]);
+      std::memcpy(out + i * dim_, row.data(), dim_ * sizeof(float));
+    }
+  }
+
+  void Push(const uint64_t* keys, int64_t n, const float* grads) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (int64_t i = 0; i < n; ++i) {
+      std::vector<float>& row = RowLocked(keys[i]);
+      ApplyUpdate(opt_, dim_, row.data(), row.data() + dim_,
+                  grads + i * dim_);
+    }
+  }
+
+  int64_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t on_disk = static_cast<int64_t>(slot_.size());
+    for (const auto& kv : mem_)
+      if (slot_.find(kv.first) == slot_.end()) ++on_disk;
+    return on_disk;
+  }
+
+  int64_t MemRows() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int64_t>(mem_.size());
+  }
+
+  bool Flush() {
+    std::lock_guard<std::mutex> g(mu_);
+    return FlushLocked();
+  }
+
+ private:
+  std::vector<float>& RowLocked(uint64_t key) {
+    auto it = mem_.find(key);
+    if (it != mem_.end()) return it->second;
+    EvictLocked();
+    std::vector<float> row(row_width_, 0.0f);
+    auto st = slot_.find(key);
+    if (st != slot_.end()) {
+      ::pread(fd_, row.data(), row_width_ * sizeof(float),
+              kHeader + st->second * rec_size_ + 8);
+    } else {
+      for (int i = 0; i < dim_; ++i) {
+        row[i] = UniformFromBits(SplitMix64(key ^ seed_ ^ (0x9E37ull * i)),
+                                 init_range_);
+      }
+    }
+    it = mem_.emplace(key, std::move(row)).first;
+    fifo_.push_back(key);
+    return it->second;
+  }
+
+  void EvictLocked() {
+    while (static_cast<int64_t>(mem_.size()) >= max_mem_rows_ &&
+           !fifo_.empty()) {
+      uint64_t victim = fifo_.front();
+      fifo_.pop_front();
+      auto it = mem_.find(victim);
+      if (it == mem_.end()) continue;  // already evicted duplicate
+      WriteRowLocked(victim, it->second);
+      mem_.erase(it);
+    }
+  }
+
+  void WriteRowLocked(uint64_t key, const std::vector<float>& row) {
+    auto st = slot_.find(key);
+    int64_t s = (st != slot_.end()) ? st->second : next_slot_++;
+    slot_[key] = s;
+    std::vector<char> rec(rec_size_);
+    std::memcpy(rec.data(), &key, 8);
+    std::memcpy(rec.data() + 8, row.data(), row_width_ * sizeof(float));
+    if (::pwrite(fd_, rec.data(), rec_size_, kHeader + s * rec_size_) !=
+        rec_size_) {
+      // eviction write failed (ENOSPC, short write): the slot now holds
+      // garbage. Poison the table — Flush() reports it and Python raises.
+      io_error_ = true;
+    }
+  }
+
+  bool FlushLocked() {
+    for (const auto& kv : mem_) WriteRowLocked(kv.first, kv.second);
+    return !io_error_ && ::fsync(fd_) == 0;
+  }
+
+  const int dim_;
+  const OptConfig opt_;
+  const int row_width_;
+  const int64_t rec_size_;
+  const float init_range_;
+  const uint64_t seed_;
+  const int64_t max_mem_rows_;
+  int fd_ = -1;
+  bool io_error_ = false;
+  int64_t next_slot_ = 0;
+  std::mutex mu_;
+  std::unordered_map<uint64_t, std::vector<float>> mem_;
+  std::unordered_map<uint64_t, int64_t> slot_;
+  std::deque<uint64_t> fifo_;
+};
+
 }  // namespace
 
 extern "C" {
@@ -323,6 +508,43 @@ void pd_ps_dense_push(void* h, const float* grad) {
 
 int64_t pd_ps_dense_size(void* h) {
   return static_cast<DenseTable*>(h)->Size();
+}
+
+void* pd_ps_file_create(int dim, int opt_kind, float lr, float beta1,
+                        float beta2, float eps, float init_range,
+                        uint64_t seed, const char* path,
+                        int64_t max_mem_rows) {
+  OptConfig opt{static_cast<OptKind>(opt_kind), lr, beta1, beta2, eps};
+  auto* t = new FileSparseTable(dim, opt, init_range, seed, path,
+                                max_mem_rows);
+  if (!t->ok()) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+void pd_ps_file_free(void* h) { delete static_cast<FileSparseTable*>(h); }
+
+void pd_ps_file_pull(void* h, const uint64_t* keys, int64_t n, float* out) {
+  static_cast<FileSparseTable*>(h)->Pull(keys, n, out);
+}
+
+void pd_ps_file_push(void* h, const uint64_t* keys, int64_t n,
+                     const float* grads) {
+  static_cast<FileSparseTable*>(h)->Push(keys, n, grads);
+}
+
+int64_t pd_ps_file_size(void* h) {
+  return static_cast<FileSparseTable*>(h)->Size();
+}
+
+int64_t pd_ps_file_mem_rows(void* h) {
+  return static_cast<FileSparseTable*>(h)->MemRows();
+}
+
+int pd_ps_file_flush(void* h) {
+  return static_cast<FileSparseTable*>(h)->Flush() ? 0 : 1;
 }
 
 }  // extern "C"

@@ -43,14 +43,32 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
 except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", 512))
+DEFAULT_BLOCK_K = int(os.environ.get("PADDLE_TPU_FLASH_BK", 512))
 NEG_INF = -1e30
 LANES = 128
 
 
 def _interpret() -> bool:
     return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+
+
+def _compiler_params():
+    """Mosaic dimension semantics: batch×head and the q-block axis are
+    parallel (no cross-iteration carries), the innermost axis is 'arbitrary'
+    (the online-softmax / accumulator carry rides it). Without this Mosaic
+    assumes every grid dim may carry state and serializes the whole grid."""
+    if _interpret() or not _HAS_PLTPU:
+        return {}
+    sem = ("parallel", "parallel", "arbitrary")
+    cp = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cp is not None:
+        try:
+            return {"compiler_params": cp(dimension_semantics=sem)}
+        except TypeError:  # pragma: no cover - older ctor signature
+            pass
+    return {"compiler_params": dict(mosaic=dict(dimension_semantics=sem))}
 
 
 def _i32(x):
@@ -173,6 +191,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
             pltpu.VMEM((bq, LANES), jnp.float32),
         ],
         interpret=_interpret(),
+        **_compiler_params(),
     )(qp, kp, vp)
     return out[:, :sq], lse[:, :sq, 0]
 
@@ -341,6 +360,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
         out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
+        **_compiler_params(),
     )(qp, kp, vp, dop, lse_b, dlt_b)
 
     dk, dv = pl.pallas_call(
@@ -367,6 +387,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
+        **_compiler_params(),
     )(kp, vp, qp, dop, lse_b, dlt_b)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
@@ -400,36 +421,43 @@ def _from_bh(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal: bool, scale: float):
-    o, _ = _fwd(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal: bool, scale: float,
+                     block_q: int, block_k: int):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k)
     return o
 
 
-def _fwd(q, k, v, causal, scale):
+def _fwd(q, k, v, causal, scale, block_q, block_k):
     b, sq, h, d = q.shape
-    of, lse = _flash_fwd_bhsd(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale)
+    of, lse = _flash_fwd_bhsd(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
+                              block_q=block_q, block_k=block_k)
     o = _from_bh(of, b, h)
     return o, (q, k, v, o, lse)
 
 
-def _bwd(causal, scale, res, g):
+def _bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, o, lse = res
     b, sq, h, d = q.shape
     dq, dk, dv = _flash_bwd_bhsd(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
-        causal, scale)
+        causal, scale, block_q=block_q, block_k=block_k)
     return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
 
 
 _flash_attention.defvjp(_fwd, _bwd)
 
 
-def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None):
+def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None,
+                         block_q: int = None, block_k: int = None):
     """Flash attention, paddle layout [B, S, H, D]. Fwd and bwd are both
-    Pallas flash kernels (no [S,S] materialization in either direction)."""
+    Pallas flash kernels (no [S,S] materialization in either direction).
+    Block sizes default to the measured-best ladder (PADDLE_TPU_FLASH_BQ/BK
+    env overrides; explicit args win — the sweep harness uses them)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PLTPU:
         return _ref_attention_bshd(q, k, v, causal, scale)
-    return _flash_attention(q, k, v, causal, scale)
+    return _flash_attention(q, k, v, causal, scale,
+                            block_q or DEFAULT_BLOCK_Q,
+                            block_k or DEFAULT_BLOCK_K)

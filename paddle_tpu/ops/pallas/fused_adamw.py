@@ -1,0 +1,119 @@
+"""Fused multi-tensor AdamW as a Pallas TPU kernel.
+
+Reference parity: paddle/phi/kernels/gpu/fused_adam_kernel.cu (multi-tensor
+Adam: one launch updates every parameter chunk) — the reference motivation is
+amortizing per-tensor kernel-launch overhead.
+
+TPU framing: inside a jitted train step there are no per-tensor launches to
+amortize (XLA already fuses the elementwise updates), so the only possible
+win is scheduling: one Pallas kernel streams w/m/v/g through VMEM in a
+single pass with explicit double-buffering instead of whatever fusion
+grouping XLA picks across 100+ parameter tensors. Whether that wins is an
+empirical question — tools/bench_adamw.py measures it on chip, and the
+optimizer only routes through this kernel if it measured faster
+(the VERDICT r2 #6 contract: keep it only with a measured win).
+
+Layout: the caller flattens all params into ONE fp32 vector per state
+(w, m, v, grad) — the multi-tensor part — padded to a multiple of the
+(8, 128) f32 tile and viewed [rows, 1024].
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+__all__ = ["fused_adamw_flat"]
+
+LANE = 1024          # flat view: [rows, 1024] f32
+BLOCK_ROWS = 256     # 256x1024 f32 = 1MB per operand block in VMEM
+
+
+def _interpret() -> bool:
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+
+
+def _adamw_kernel(w_ref, m_ref, v_ref, g_ref, lr_ref, t_ref,
+                  wo_ref, mo_ref, vo_ref, *, beta1, beta2, eps,
+                  weight_decay):
+    w = w_ref[...]
+    m = m_ref[...]
+    v = v_ref[...]
+    g = g_ref[...]
+    lr = lr_ref[0, 0]
+    t = t_ref[0, 0]
+    b1 = jnp.float32(beta1)
+    b2 = jnp.float32(beta2)
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    bc1 = 1.0 - jnp.power(b1, t)
+    bc2 = 1.0 - jnp.power(b2, t)
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + jnp.float32(eps))
+    wo_ref[...] = w - lr * (update + jnp.float32(weight_decay) * w)
+    mo_ref[...] = m_new
+    vo_ref[...] = v_new
+
+
+def fused_adamw_flat(w, m, v, g, lr, step, *, beta1=0.9, beta2=0.999,
+                     eps=1e-8, weight_decay=0.01):
+    """One AdamW step over flat fp32 vectors. Returns (w', m', v').
+
+    w/m/v/g: [N] f32 (N padded to 8·1024 by the caller or here);
+    lr: scalar f32; step: scalar f32 (1-based).
+    """
+    n = w.shape[0]
+    pad = (-n) % (8 * LANE)
+    if pad:
+        w, m, v, g = (jnp.pad(x, (0, pad)) for x in (w, m, v, g))
+    rows = w.shape[0] // LANE
+    shape2 = (rows, LANE)
+    w2, m2, v2, g2 = (x.reshape(shape2) for x in (w, m, v, g))
+    br = min(BLOCK_ROWS, rows)
+    while rows % br:
+        br //= 2
+    br = max(br, 1)
+    grid = (rows // br,)
+
+    lr2 = jnp.full((1, 1), lr, jnp.float32)
+    t2 = jnp.full((1, 1), step, jnp.float32)
+
+    blk = pl.BlockSpec((br, LANE), lambda i: (i, 0))
+    scal = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM) \
+        if (_HAS_PLTPU and not _interpret()) \
+        else pl.BlockSpec((1, 1), lambda i: (0, 0))
+    wo, mo, vo = pl.pallas_call(
+        functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2, eps=eps,
+                          weight_decay=weight_decay),
+        grid=grid,
+        in_specs=[blk, blk, blk, blk, scal, scal],
+        out_specs=[blk, blk, blk],
+        out_shape=[jax.ShapeDtypeStruct(shape2, jnp.float32)] * 3,
+        interpret=_interpret(),
+    )(w2, m2, v2, g2, lr2, t2)
+    out = (wo.reshape(-1), mo.reshape(-1), vo.reshape(-1))
+    if pad:
+        out = tuple(x[:n] for x in out)
+    return out
+
+
+def xla_adamw_flat(w, m, v, g, lr, step, *, beta1=0.9, beta2=0.999,
+                   eps=1e-8, weight_decay=0.01):
+    """The same update as plain XLA ops — the A/B baseline."""
+    b1, b2 = jnp.float32(beta1), jnp.float32(beta2)
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    bc1 = 1.0 - jnp.power(b1, jnp.float32(step))
+    bc2 = 1.0 - jnp.power(b2, jnp.float32(step))
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + jnp.float32(eps))
+    w_new = w - lr * (update + jnp.float32(weight_decay) * w)
+    return w_new, m_new, v_new

@@ -420,3 +420,9 @@ _scope = {}
 
 def global_scope():
     return _scope
+
+
+# imported last: static.nn's layers build on the facade above
+from . import nn  # noqa: F401,E402
+
+__all__.append("nn")

@@ -1,0 +1,143 @@
+"""StaticRNN — build the step once, compile the time loop as ``lax.scan``.
+
+Reference parity: python/paddle/fluid/layers (StaticRNN) — the reference
+records the step body into a sub-block and a ``recurrent`` op's interpreter
+walks it T times, with ``recurrent_grad`` replaying it backwards off an
+activation stack.
+
+TPU-native redesign: the user's ``with rnn.step():`` block executes ONCE
+eagerly against step-0 slices, recording its ops on the tape. ``rnn()`` then
+rebuilds that subgraph as a pure jax function (the same tape replay the
+static Executor uses, incubate/autograd/_replay_function) and runs it under
+``lax.scan`` over the time axis — one compiled XLA loop with sequence inputs
+time-major ``[T, B, ...]``, memories as the scan carry, and full reverse-mode
+AD through the scan (no hand-written grad op, no activation stack: XLA
+rematerializes or saves per its own schedule).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._apply import apply_op
+from ...tensor import Tensor
+
+__all__ = ["StaticRNN"]
+
+
+class StaticRNN:
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or "static_rnn"
+        self.status = self.BEFORE_RNN
+        self._seq: List[tuple] = []        # (full sequence Tensor, step ph)
+        self._mems: List[list] = []        # [placeholder, init Tensor, new]
+        self._outputs: List[Tensor] = []
+
+    @contextmanager
+    def step(self):
+        if self.status != self.BEFORE_RNN:
+            raise RuntimeError("StaticRNN.step() may only be entered once")
+        self.status = self.IN_RNN
+        try:
+            yield
+        finally:
+            self.status = self.AFTER_RNN
+
+    def _require_in_rnn(self, what):
+        if self.status != self.IN_RNN:
+            raise RuntimeError(f"StaticRNN.{what} must be called inside "
+                               "`with rnn.step():`")
+
+    def step_input(self, x: Tensor) -> Tensor:
+        """Register a time-major ``[T, B, ...]`` sequence; returns the
+        per-step ``[B, ...]`` view the body computes on."""
+        self._require_in_rnn("step_input")
+        if self._seq and x.shape[0] != self._seq[0][0].shape[0]:
+            raise ValueError("all StaticRNN step inputs must share sequence "
+                             f"length; got {x.shape[0]} vs "
+                             f"{self._seq[0][0].shape[0]}")
+        ph = Tensor(x._value[0], stop_gradient=False)
+        self._seq.append((x, ph))
+        return ph
+
+    def memory(self, init: Optional[Tensor] = None, shape=None,
+               batch_ref: Optional[Tensor] = None, init_value: float = 0.0,
+               init_batch_dim_idx: int = 0, ref_batch_dim_idx: int = 1):
+        """A carried state; ``init`` tensor or zeros/[init_value] of
+        ``shape`` with -1 resolved from ``batch_ref``'s batch dim."""
+        self._require_in_rnn("memory")
+        if init is not None:
+            init_t = init if isinstance(init, Tensor) else Tensor(init)
+        else:
+            if shape is None or batch_ref is None:
+                raise ValueError("StaticRNN.memory needs `init` or both "
+                                 "`shape` and `batch_ref`")
+            concrete = [batch_ref.shape[0] if int(d) < 0 else int(d)
+                        for d in shape]
+            init_t = Tensor(jnp.full(concrete, init_value,
+                                     batch_ref._value.dtype))
+        ph = Tensor(init_t._value, stop_gradient=False)
+        self._mems.append([ph, init_t, None])
+        return ph
+
+    def update_memory(self, mem: Tensor, var: Tensor):
+        self._require_in_rnn("update_memory")
+        for rec in self._mems:
+            if rec[0] is mem:
+                rec[2] = var
+                return
+        raise ValueError("update_memory: unknown memory (pass the tensor "
+                         "returned by StaticRNN.memory)")
+
+    def step_output(self, o: Tensor):
+        self._require_in_rnn("step_output")
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        if self.status != self.AFTER_RNN:
+            raise RuntimeError("finish the `with rnn.step():` block before "
+                               "calling the StaticRNN")
+        if not self._outputs:
+            raise ValueError("StaticRNN has no step_output")
+        from ...incubate.autograd import _replay_function
+        from .. import _collect_parameters_multi
+
+        new_mems = [rec[2] if rec[2] is not None else rec[0]
+                    for rec in self._mems]
+        fetches = list(self._outputs) + new_mems
+        seq_ph = [ph for _, ph in self._seq]
+        mem_ph = [rec[0] for rec in self._mems]
+        params = _collect_parameters_multi(fetches, trainable_only=False)
+        fn, _ = _replay_function(fetches, seq_ph + mem_ph + params)
+
+        n_seq, n_mem, n_out = len(seq_ph), len(mem_ph), len(self._outputs)
+
+        def pure(*arrays):
+            seqs = arrays[:n_seq]
+            mem0 = arrays[n_seq:n_seq + n_mem]
+            pvals = arrays[n_seq + n_mem:]
+
+            def body(carry, xs):
+                outs = fn(*xs, *carry, *pvals)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+            _, ys = jax.lax.scan(body, tuple(mem0), tuple(seqs))
+            return ys  # each [T, B, ...] time-major, reference layout
+
+        ins = [x for x, _ in self._seq] + [rec[1] for rec in self._mems] \
+            + params
+        res = apply_op(pure, ins, name=self.name)
+        res = res if isinstance(res, tuple) else (res,)
+        return res[0] if len(res) == 1 else list(res)

@@ -1,0 +1,237 @@
+"""Sequence (legacy LoD) ops, dense TPU redesign.
+
+Reference parity: python/paddle/static/nn/sequence_lod.py — the reference
+operates on LoD (ragged level-of-detail) tensors whose row offsets live
+host-side. Ragged shapes defeat XLA's static tiling, so the TPU-native
+redesign uses the padded-dense convention the rest of this framework (and
+modern paddle itself) uses: a sequence batch is ``[B, T, ...]`` with time on
+axis 1, optional per-row ``length`` tensors where the reference consumed LoD
+offsets, and masking instead of ragged storage. Each function documents the
+reference op it covers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._apply import apply_op, ensure_tensor
+from ...nn.initializer import Constant, XavierNormal
+from ...tensor import Tensor
+from ..legacy import create_parameter
+
+__all__ = [
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse",
+]
+
+
+def sequence_conv(input, num_filters: int, filter_size: int = 3,
+                  filter_stride: int = 1, padding: bool = True,
+                  padding_start: Optional[int] = None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """reference: sequence_lod.py sequence_conv — context-window conv over
+    time: im2col the window then one MXU matmul."""
+    x = ensure_tensor(input)
+    D = x.shape[-1]
+    k = int(filter_size)
+    start = -((k - 1) // 2) if padding_start is None else int(padding_start)
+    w = create_parameter([k * D, num_filters], x.dtype, attr=param_attr,
+                         default_initializer=XavierNormal())
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], x.dtype, attr=bias_attr, is_bias=True,
+        default_initializer=Constant(0.0))
+    ins = [x, w] + ([b] if b is not None else [])
+
+    def sc(v, wv, *rest):
+        T = v.shape[1]
+        lo, hi = max(0, -start), max(0, start + k - 1)
+        vp = jnp.pad(v, ((0, 0), (lo, hi), (0, 0)))
+        cols = jnp.concatenate(
+            [vp[:, i:i + T] for i in range(k)], axis=-1)  # [B, T, k*D]
+        out = cols @ wv
+        return out + rest[0] if rest else out
+
+    out = apply_op(sc, ins, name="sequence_conv")
+    if act is not None:
+        from ...nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_softmax(input, use_cudnn: bool = False, name=None):
+    """reference: sequence_lod.py sequence_softmax — softmax within each
+    sequence (dense: over the time axis)."""
+    x = ensure_tensor(input)
+    axis = 1 if x.ndim > 1 else 0
+    return apply_op(lambda v: jnp.exp(v - jnp.max(v, axis, keepdims=True))
+                    / jnp.sum(jnp.exp(v - jnp.max(v, axis, keepdims=True)),
+                              axis, keepdims=True),
+                    [x], name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type: str, is_test: bool = False,
+                  pad_value: float = 0.0):
+    """reference: sequence_lod.py sequence_pool — average/sum/sqrt/max/
+    last/first over each sequence's time steps."""
+    x = ensure_tensor(input)
+    pt = pool_type.lower()
+
+    def pool(v):
+        if pt == "average":
+            return jnp.mean(v, axis=1)
+        if pt == "sum":
+            return jnp.sum(v, axis=1)
+        if pt == "sqrt":
+            return jnp.sum(v, axis=1) / np.sqrt(v.shape[1])
+        if pt == "max":
+            return jnp.max(v, axis=1)
+        if pt == "last":
+            return v[:, -1]
+        if pt == "first":
+            return v[:, 0]
+        raise ValueError(f"sequence_pool: bad pool_type {pool_type!r}")
+
+    return apply_op(pool, [x], name=f"sequence_pool_{pt}")
+
+
+def sequence_concat(input, name=None):
+    """reference: sequence_lod.py sequence_concat — joins sequences
+    time-wise (dense: concat on axis 1)."""
+    xs = [ensure_tensor(v) for v in input]
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=1), xs,
+                    name="sequence_concat")
+
+
+def sequence_first_step(input):
+    """reference: sequence_lod.py sequence_first_step."""
+    return apply_op(lambda v: v[:, 0], [ensure_tensor(input)],
+                    name="sequence_first_step")
+
+
+def sequence_last_step(input):
+    """reference: sequence_lod.py sequence_last_step."""
+    return apply_op(lambda v: v[:, -1], [ensure_tensor(input)],
+                    name="sequence_last_step")
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference: sequence_lod.py sequence_slice — per-sequence sub-span.
+    Dense: one shared (offset, length) span along time; scalar or
+    per-row-equal tensors accepted (ragged spans don't tile on TPU)."""
+    x = ensure_tensor(input)
+    off = int(np.asarray(ensure_tensor(offset)._value).reshape(-1)[0])
+    ln = int(np.asarray(ensure_tensor(length)._value).reshape(-1)[0])
+    return apply_op(lambda v: v[:, off:off + ln], [x],
+                    name="sequence_slice")
+
+
+def sequence_expand(x, y, ref_level: int = -1, name=None):
+    """reference: sequence_lod.py sequence_expand — repeat x's rows per y's
+    LoD. Dense: broadcast x's time axis to y's time length."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def exp(xv, yv):
+        T = yv.shape[1]
+        if xv.ndim == 2:
+            xv = xv[:, None, :]
+        if T % xv.shape[1]:
+            raise ValueError(
+                f"sequence_expand: y's time length {T} is not a multiple of "
+                f"x's {xv.shape[1]} — a silent truncation here surfaces as a "
+                "shape error far downstream")
+        reps = [1] * xv.ndim
+        reps[1] = T // xv.shape[1]
+        return jnp.tile(xv, reps)
+
+    return apply_op(exp, [xt, yt], name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference: sequence_lod.py sequence_expand_as."""
+    return sequence_expand(x, y, name=name)
+
+
+def sequence_pad(x, pad_value, maxlen: Optional[int] = None, name=None):
+    """reference: sequence_lod.py sequence_pad — returns (padded, lengths).
+    Dense input is already rectangular; pads time to ``maxlen``."""
+    xt = ensure_tensor(x)
+    pv = ensure_tensor(pad_value)
+    T = xt.shape[1]
+    target = int(maxlen) if maxlen is not None else T
+
+    def pad(v, p):
+        if target <= T:
+            return v[:, :target]
+        cfg = [(0, 0)] * v.ndim
+        cfg[1] = (0, target - T)
+        return jnp.pad(v, cfg, constant_values=p.reshape(()))
+
+    padded = apply_op(pad, [xt, pv], name="sequence_pad")
+    lengths = Tensor(jnp.full((xt.shape[0],), min(T, target), jnp.int64))
+    return padded, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: sequence_lod.py sequence_unpad — zero out positions past
+    each row's length and trim to the longest row."""
+    import jax
+
+    xt, lt = ensure_tensor(x), ensure_tensor(length)
+    max_len = xt.shape[1] if isinstance(lt._value, jax.core.Tracer) \
+        else int(np.asarray(lt._value).max())
+
+    def unpad(v, ln):
+        pos = jnp.arange(v.shape[1])
+        mask = pos[None, :] < ln.reshape(-1, 1)
+        mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        return jnp.where(mask, v, 0)[:, :max_len]
+
+    return apply_op(unpad, [xt, lt], name="sequence_unpad")
+
+
+def sequence_reshape(input, new_dim: int, name=None):
+    """reference: sequence_lod.py sequence_reshape — refold time×feature
+    so the feature width becomes ``new_dim``."""
+    x = ensure_tensor(input)
+    return apply_op(lambda v: v.reshape(v.shape[0], -1, new_dim), [x],
+                    name="sequence_reshape")
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference: sequence_lod.py sequence_scatter — adds updates at the
+    given time positions per row."""
+    x, idx, upd = (ensure_tensor(input), ensure_tensor(index),
+                   ensure_tensor(updates))
+
+    def scat(v, iv, uv):
+        iv = iv.reshape(v.shape[0], -1).astype(jnp.int32)
+        uv = uv.reshape(iv.shape + v.shape[2:])
+        rows = jnp.arange(v.shape[0])[:, None].repeat(iv.shape[1], axis=1)
+        return v.at[rows, iv].add(uv)
+
+    return apply_op(scat, [x, idx, upd], name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size: int, pad_value: int = 0, name=None):
+    """reference: sequence_lod.py sequence_enumerate — all length-
+    ``win_size`` subsequences, padded at the tail."""
+    x = ensure_tensor(input)
+
+    def enum(v):
+        T = v.shape[1]
+        vp = jnp.pad(v, ((0, 0), (0, win_size - 1)),
+                     constant_values=pad_value)
+        return jnp.stack([vp[:, i:i + T] for i in range(win_size)], axis=-1)
+
+    return apply_op(enum, [x], name="sequence_enumerate")
+
+
+def sequence_reverse(x, name=None):
+    """reference: sequence_lod.py sequence_reverse — flip time."""
+    return apply_op(lambda v: jnp.flip(v, axis=1), [ensure_tensor(x)],
+                    name="sequence_reverse")

@@ -35,7 +35,7 @@ def test_forward_matches_reference(causal, sq, sk):
     k = _rand((b, sk, h, d), 1)
     v = _rand((b, sk, h, d), 2)
     scale = 1.0 / np.sqrt(d)
-    out = fa._flash_attention(q, k, v, causal, scale)
+    out = fa._flash_attention(q, k, v, causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
     ref = fa._ref_attention_bshd(q, k, v, causal, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -51,7 +51,7 @@ def test_backward_matches_reference(causal, sq):
     scale = 1.0 / np.sqrt(d)
 
     def loss_flash(q, k, v):
-        return jnp.sum(fa._flash_attention(q, k, v, causal, scale) ** 2)
+        return jnp.sum(fa._flash_attention(q, k, v, causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(fa._ref_attention_bshd(q, k, v, causal, scale) ** 2)
@@ -71,7 +71,7 @@ def test_cross_attention_backward():
     v = _rand((b, sk, h, d), 8)
     scale = 1.0 / np.sqrt(d)
     g_flash = jax.grad(
-        lambda q, k, v: jnp.sum(fa._flash_attention(q, k, v, True, scale)),
+        lambda q, k, v: jnp.sum(fa._flash_attention(q, k, v, True, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)),
         argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(
         lambda q, k, v: jnp.sum(fa._ref_attention_bshd(q, k, v, True, scale)),
@@ -92,7 +92,7 @@ def test_backward_jaxpr_has_no_SxS_intermediate():
 
     jaxpr = jax.make_jaxpr(
         jax.grad(lambda q, k, v: jnp.sum(
-            fa._flash_attention(q, k, v, True, 0.125))),
+            fa._flash_attention(q, k, v, True, 0.125, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K))),
     )(q, k, v)
     for eqn in jaxpr.jaxpr.eqns:
         if eqn.primitive.name == "pallas_call":
@@ -102,3 +102,26 @@ def test_backward_jaxpr_has_no_SxS_intermediate():
             assert not (len(shape) >= 2 and shape[-1] == s
                         and shape[-2] == s), (
                 f"[S,S] intermediate {shape} from {eqn.primitive.name}")
+
+
+def test_fused_adamw_kernel_matches_xla():
+    """ops/pallas/fused_adamw.py — interpret-mode numerics (the on-chip A/B
+    decides whether the optimizer routes through it; tools/bench_adamw.py)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.fused_adamw import (fused_adamw_flat,
+                                                   xla_adamw_flat)
+
+    rng = np.random.default_rng(0)
+    n = 10000  # not tile-aligned: exercises the pad path
+    w = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32) * 1e-3
+    got = fused_adamw_flat(w, m, v, g, jnp.float32(1e-3), jnp.float32(5.0),
+                           weight_decay=0.01)
+    want = xla_adamw_flat(w, m, v, g, jnp.float32(1e-3), jnp.float32(5.0),
+                          weight_decay=0.01)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)

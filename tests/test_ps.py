@@ -204,3 +204,78 @@ def test_sparse_embedding_trains(two_servers):
     ((out - target) ** 2).mean().backward()
     np.testing.assert_array_equal(
         client.pull_sparse(3, np.array([1, 2, 9], np.uint64)), before)
+
+
+# ----------------------------------------------------- SSD (file-backed)
+
+def test_ssd_table_bounded_memory_and_eviction(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable, TableConfig
+
+    cfg = TableConfig(dim=4, optimizer="sgd", learning_rate=1.0, seed=7)
+    t = SSDSparseTable(cfg, str(tmp_path / "emb.pst"), max_mem_rows=8)
+    keys = np.arange(64, dtype=np.uint64)
+    first = t.pull(keys)                       # forces 64 rows through an 8-row cache
+    assert t.mem_rows <= 8
+    assert len(t) >= 56                        # evicted rows live on disk
+    # push a grad of -1 to key 3: SGD lr=1 -> w += 1
+    t.push(np.array([3], np.uint64), -np.ones((1, 4), np.float32))
+    # touch many other keys so key 3 is evicted to disk...
+    t.pull(np.arange(100, 164, dtype=np.uint64))
+    assert t.mem_rows <= 8
+    # ...then read it back from disk: update must have survived eviction
+    np.testing.assert_allclose(t.pull(np.array([3], np.uint64)),
+                               first[3:4] + 1.0, rtol=1e-6)
+    t.close()
+
+
+def test_ssd_table_durable_across_reopen(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable, TableConfig
+
+    path = str(tmp_path / "emb.pst")
+    cfg = TableConfig(dim=3, optimizer="sgd", learning_rate=0.5, seed=1)
+    t = SSDSparseTable(cfg, path, max_mem_rows=4)
+    keys = np.array([10, 20, 30, 40, 50], np.uint64)
+    t.push(keys, np.ones((5, 3), np.float32))
+    vals = t.pull(keys)
+    t.close()                                   # flushes hot rows
+
+    t2 = SSDSparseTable(cfg, path, max_mem_rows=4)
+    assert len(t2) == 5
+    np.testing.assert_allclose(t2.pull(keys), vals, rtol=1e-6)
+    t2.close()
+
+
+def test_ssd_table_adam_matches_memory_table(tmp_path):
+    from paddle_tpu.distributed.ps import (SparseTable, SSDSparseTable,
+                                           TableConfig)
+
+    cfg = TableConfig(dim=4, optimizer="adam", learning_rate=0.1, seed=3)
+    mem = SparseTable(cfg)
+    ssd = SSDSparseTable(cfg, str(tmp_path / "emb.pst"), max_mem_rows=2)
+    keys = np.array([1, 2, 3, 4, 5, 6], np.uint64)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        g = rng.randn(6, 4).astype(np.float32)
+        mem.push(keys, g)
+        ssd.push(keys, g)   # rows cycle through the 2-row cache
+    np.testing.assert_allclose(ssd.pull(keys), mem.pull(keys), rtol=1e-5)
+    ssd.close()
+
+
+def test_ssd_table_rejects_mismatched_reopen(tmp_path):
+    """Header-validated reopen: a dim/optimizer mismatch must fail loudly,
+    never stride the file at the wrong record size."""
+    from paddle_tpu.distributed.ps import SSDSparseTable, TableConfig
+
+    path = str(tmp_path / "emb.pst")
+    t = SSDSparseTable(TableConfig(dim=4, optimizer="sgd"), path)
+    t.push(np.array([1, 2], np.uint64), np.ones((2, 4), np.float32))
+    t.close()
+    with pytest.raises(IOError):
+        SSDSparseTable(TableConfig(dim=8, optimizer="sgd"), path)
+    with pytest.raises(IOError):
+        SSDSparseTable(TableConfig(dim=4, optimizer="adam"), path)
+    # matching config still opens
+    t2 = SSDSparseTable(TableConfig(dim=4, optimizer="sgd"), path)
+    assert len(t2) == 2
+    t2.close()

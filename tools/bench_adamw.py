@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""A/B: fused AdamW Pallas kernel vs XLA elementwise update (VERDICT r2 #6).
+
+Run ON the TPU. 355M-param-scale flat buffers (the bench model's size).
+Appends the result to BENCH_NOTES_r03.json.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+_NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                      "BENCH_NOTES_r03.json")
+
+
+def _bench(fn, args, iters=30):
+    import jax
+    jax.block_until_ready(fn(*args))
+    for _ in range(3):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    kept = ts[: max(1, len(ts) - len(ts) // 5)]
+    return sum(kept) / len(kept)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.fused_adamw import (fused_adamw_flat,
+                                                   xla_adamw_flat)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    n = int(os.environ.get("BENCH_ADAMW_N", 355_000_000 if on_tpu
+                           else 1_000_000))
+    print(f"device={dev.platform} n={n}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32) * 1e-3
+    lr = jnp.float32(1e-4)
+    t = jnp.float32(10.0)
+
+    f_pl = jax.jit(fused_adamw_flat)
+    f_x = jax.jit(xla_adamw_flat)
+
+    # correctness first
+    o_pl = f_pl(w, m, v, g, lr, t)
+    o_x = f_x(w, m, v, g, lr, t)
+    for a, b in zip(o_pl, o_x):
+        np.testing.assert_allclose(np.asarray(a[:4096]), np.asarray(b[:4096]),
+                                   rtol=1e-6, atol=1e-7)
+    print("numerics match", file=sys.stderr)
+
+    t_pl = _bench(f_pl, (w, m, v, g, lr, t))
+    t_x = _bench(f_x, (w, m, v, g, lr, t))
+    gb = n * 4 * 7 / 1e9  # r: w,m,v,g  w: w,m,v
+    rec = {
+        "metric": "fused_adamw_ab", "n_params": n,
+        "pallas_ms": round(t_pl * 1e3, 3), "xla_ms": round(t_x * 1e3, 3),
+        "pallas_gbps": round(gb / t_pl, 1), "xla_gbps": round(gb / t_x, 1),
+        "pallas_wins": bool(t_pl < t_x), "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if on_tpu:
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(_NOTES, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

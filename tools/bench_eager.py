@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Eager-mode per-op dispatch overhead vs jit (SURVEY §7 hard part #4).
+
+The reference benchmarks its eager dispatch in
+test/cpp/eager/performance_tests/benchmark_utils.cc (scale-sum loops through
+the C++ ad_func path). Here every eager op is a Python apply_op -> jax.vjp
+dispatch; under jit the same chain traces away. This measures both:
+
+  1. eager small-op loop: y = x*2 + 1 over a (8,) tensor, N times
+     (tape on: the realistic training-debug path)
+  2. eager with no_grad (tape off: pure dispatch cost)
+  3. the same loop inside ONE StaticFunction (compiled; the deploy path)
+  4. raw jax eager for reference (what the dispatch layer adds on top)
+
+Appends a JSON line to BENCH_NOTES_r03.json. Run with no args.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def _bench(fn, n, warmup=20):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+
+    dev = jax.devices()[0]
+    N = int(os.environ.get("BENCH_EAGER_ITERS", 300))
+    OPS_PER_ITER = 2  # mul + add
+
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    x.stop_gradient = False
+
+    def eager_tape():
+        return (x * 2.0 + 1.0).value.block_until_ready()
+
+    def eager_nograd():
+        with paddle.no_grad():
+            return (x * 2.0 + 1.0).value.block_until_ready()
+
+    xj = jnp.ones(8, jnp.float32)
+
+    def raw_jax():
+        return ((xj * 2.0) + 1.0).block_until_ready()
+
+    def chain(v):
+        for _ in range(OPS_PER_ITER * 50):  # 100 small ops in one program
+            v = v * 2.0 + 1.0
+        return v
+
+    compiled = jit.StaticFunction(chain, warmup=False)
+    y = compiled(paddle.to_tensor(np.ones(8, np.float32)))  # compile
+    y.value.block_until_ready()
+
+    def jit_chain():
+        return compiled(x).value.block_until_ready()
+
+    t_tape = _bench(eager_tape, N) / OPS_PER_ITER
+    t_nograd = _bench(eager_nograd, N) / OPS_PER_ITER
+    t_raw = _bench(raw_jax, N) / OPS_PER_ITER
+    t_jit = _bench(jit_chain, max(20, N // 10)) / (OPS_PER_ITER * 50 * 2)
+
+    rec = {
+        "metric": "eager_dispatch_overhead",
+        "unit": "us/op",
+        "device": str(dev.platform),
+        "eager_tape_us": round(t_tape * 1e6, 1),
+        "eager_nograd_us": round(t_nograd * 1e6, 1),
+        "raw_jax_us": round(t_raw * 1e6, 1),
+        "jit_us_per_op": round(t_jit * 1e6, 2),
+        "tape_overhead_us": round((t_tape - t_raw) * 1e6, 1),
+        "jit_speedup_x": round(t_tape / max(t_jit, 1e-12), 1),
+    }
+    print(json.dumps(rec), flush=True)
+    notes = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                         "BENCH_NOTES_r03.json")
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(notes, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

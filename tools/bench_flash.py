@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Flash-attention A/B: Pallas kernel (block-size sweep) vs XLA fused
+attention, fwd and fwd+bwd, S ∈ {512, 1024, 2048, 4096} (VERDICT r2 #2).
+
+Run ON the TPU (no env scrubbing). Appends one JSON line per (S, impl,
+blocks, direction) to BENCH_NOTES_r03.json and prints a summary table to
+stderr, plus a final recommendation line: the measured per-S dispatch
+threshold for nn/functional/attention.py's pallas_flash_min_seq.
+
+Usage: python tools/bench_flash.py [--quick]
+"""
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+_NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                      "BENCH_NOTES_r03.json")
+
+
+def _log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def _persist(rec):
+    rec = dict(rec, ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _bench(fn, args, iters=20):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # warm
+    for _ in range(3):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    kept = ts[: max(1, len(ts) - len(ts) // 5)]  # drop relay stragglers
+    return sum(kept) / len(kept)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    quick = "--quick" in sys.argv
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    _log(f"device: {dev.platform} (tpu={on_tpu})")
+    if not on_tpu:
+        _log("WARNING: not on TPU — numbers are meaningless for dispatch "
+             "thresholds; refusing to persist")
+
+    H, D = 16, 64  # flagship head geometry (GPT-355M: 16 heads x 64)
+    seqs = [1024] if quick else [512, 1024, 2048, 4096]
+    blocks = [(256, 512), (512, 512), (1024, 512), (512, 1024),
+              (1024, 1024), (256, 1024)]
+    causal, scale = True, 1.0 / np.sqrt(D)
+
+    def xla_attn(q, k, v):
+        return fa._ref_attention_bshd(q, k, v, causal, scale)
+
+    results = {}
+    for S in seqs:
+        B = max(1, 8 * 1024 // S)  # constant token budget ~8k
+        rng = np.random.default_rng(0)
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+
+        # XLA reference, fwd and fwd+bwd
+        f_x = jax.jit(xla_attn)
+        g_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            xla_attn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2)))
+        t_fwd = _bench(f_x, (q, k, v))
+        t_bwd = _bench(g_x, (q, k, v))
+        results[(S, "xla", None)] = (t_fwd, t_bwd)
+        _log(f"S={S} B={B} xla          fwd {t_fwd*1e3:7.2f}ms  "
+             f"fwd+bwd {t_bwd*1e3:7.2f}ms")
+        if on_tpu:
+            _persist({"metric": "flash_ab", "impl": "xla", "S": S, "B": B,
+                      "fwd_ms": round(t_fwd * 1e3, 2),
+                      "fwdbwd_ms": round(t_bwd * 1e3, 2),
+                      "device": dev.platform})
+
+        for bq, bk in blocks:
+            if bq > S or bk > S:
+                continue
+
+            def pallas_attn(q, k, v, _bq=bq, _bk=bk):
+                return fa._flash_attention(q, k, v, causal, scale, _bq, _bk)
+
+            try:
+                f_p = jax.jit(pallas_attn)
+                g_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+                    pallas_attn(q, k, v).astype(jnp.float32)),
+                    argnums=(0, 1, 2)))
+                t_fwd = _bench(f_p, (q, k, v))
+                t_bwd = _bench(g_p, (q, k, v))
+            except Exception as e:
+                _log(f"S={S} pallas bq{bq}/bk{bk} FAILED: "
+                     f"{type(e).__name__}: {str(e)[:160]}")
+                if on_tpu:
+                    _persist({"metric": "flash_ab", "impl": "pallas",
+                              "S": S, "bq": bq, "bk": bk,
+                              "error": f"{type(e).__name__}: {str(e)[:300]}",
+                              "device": dev.platform})
+                continue
+            results[(S, "pallas", (bq, bk))] = (t_fwd, t_bwd)
+            _log(f"S={S} B={B} pallas {bq:4d}/{bk:<4d} fwd {t_fwd*1e3:7.2f}ms"
+                 f"  fwd+bwd {t_bwd*1e3:7.2f}ms")
+            if on_tpu:
+                _persist({"metric": "flash_ab", "impl": "pallas", "S": S,
+                          "B": B, "bq": bq, "bk": bk,
+                          "fwd_ms": round(t_fwd * 1e3, 2),
+                          "fwdbwd_ms": round(t_bwd * 1e3, 2),
+                          "device": dev.platform})
+
+    # recommendation: per S, best pallas config vs xla on fwd+bwd
+    _log("\n=== summary (fwd+bwd) ===")
+    rec = {}
+    for S in seqs:
+        xla = results.get((S, "xla", None))
+        if xla is None:
+            continue
+        pl_best = None
+        for (s2, impl, blk), (tf, tb) in results.items():
+            if s2 == S and impl == "pallas" and (
+                    pl_best is None or tb < pl_best[1][1]):
+                pl_best = (blk, (tf, tb))
+        if pl_best is None:
+            continue
+        win = pl_best[1][1] < xla[1]
+        rec[S] = {"xla_ms": round(xla[1] * 1e3, 2),
+                  "pallas_ms": round(pl_best[1][1] * 1e3, 2),
+                  "best_blocks": list(pl_best[0]), "pallas_wins": bool(win)}
+        _log(f"S={S}: xla {xla[1]*1e3:.2f}ms vs pallas "
+             f"{pl_best[1][1]*1e3:.2f}ms @bq/bk={pl_best[0]} "
+             f"-> {'PALLAS' if win else 'XLA'}")
+    wins = sorted(s for s, r in rec.items() if r["pallas_wins"])
+    threshold = wins[0] if wins else None
+    _log(f"recommended pallas_flash_min_seq = {threshold}")
+    if on_tpu:
+        _persist({"metric": "flash_ab_summary", "per_seq": rec,
+                  "recommended_min_seq": threshold, "device": dev.platform})
+    print(json.dumps({"metric": "flash_ab_summary", "per_seq": rec,
+                      "recommended_min_seq": threshold}))
+
+
+if __name__ == "__main__":
+    main()
