@@ -213,6 +213,19 @@ def apply_op(fn: Callable, tensors: Sequence[Tensor], attrs: dict = None,
             vals = [_unpack(p) for p in _packed]
             _, inner_vjp = jax.vjp(_f, *vals)
             return inner_vjp(cotangents)
+    elif not any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # Deferred linearization (measured in BENCH_NOTES.md r3): eager-time
+        # jax.vjp costs ~1.4ms/op vs ~36µs for the plain forward, so concrete
+        # dispatches run the forward alone and linearize lazily at backward —
+        # ops never reached by backward (eval forwards, pruned branches) pay
+        # nothing. Under a trace (tracer inputs) the eager jax.vjp stays:
+        # lazy re-linearization there would duplicate the traced graph and
+        # lean on XLA CSE to clean it up.
+        outs = f(*arrays)
+
+        def vjp_fn(cotangents, _f=f, _vals=tuple(arrays)):
+            _, inner_vjp = jax.vjp(_f, *_vals)
+            return inner_vjp(cotangents)
     else:
         outs, vjp_fn = jax.vjp(f, *arrays)
     if check_nan_inf_enabled:

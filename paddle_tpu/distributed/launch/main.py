@@ -55,8 +55,16 @@ def _parse_args(argv):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the job up to K times if a worker fails")
     p.add_argument("--run_mode", type=str, default="collective",
-                   help="only 'collective' is supported (ps/rpc are "
-                        "out of scope on TPU)")
+                   help="'collective' (default), 'ps' (parameter-server "
+                        "servers+trainers) or 'rpc'")
+    p.add_argument("--server_num", type=int, default=None,
+                   help="ps mode: number of server processes on this node")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: number of trainer processes on this node")
+    p.add_argument("--servers", type=str, default="",
+                   help="ps mode: comma list of server endpoints")
+    p.add_argument("--trainers", type=str, default="",
+                   help="ps mode: comma list of trainer endpoints")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for reference-CLI compat; TPU visibility "
                         "is managed by the runtime")
@@ -151,13 +159,145 @@ def _supervise(workers: List[_Worker]) -> int:
         return 130
 
 
+def _spawn_role(args, script_env: dict, count: int, role: str, log_dir: str,
+                endpoints: List[str], base_rank: int = 0) -> List[_Worker]:
+    """Spawn ``count`` processes of one PS role with the reference env
+    contract (launch/controllers/ps.py: TRAINING_ROLE, POD_IP, PADDLE_PORT)."""
+    os.makedirs(log_dir, exist_ok=True)
+    workers = []
+    for i in range(count):
+        rank = base_rank + i
+        ep = endpoints[rank]
+        env = dict(script_env)
+        env.update({
+            "TRAINING_ROLE": role,
+            "POD_IP": ep.split(":")[0],
+            "PADDLE_PORT": ep.split(":")[1],
+            "PADDLE_TRAINER_ID": str(rank),
+        })
+        log_path = os.path.join(log_dir, f"{role.lower()}log.{rank}")
+        with open(log_path, "w") as out:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", args.training_script]
+                + args.training_script_args,
+                env=env, stdout=out, stderr=subprocess.STDOUT)
+        workers.append(_Worker(proc, rank, log_path))
+    return workers
+
+
+def _launch_ps(args) -> int:
+    """PS job: servers + trainers from ONE script branching on TRAINING_ROLE
+    (reference: launch/controllers/ps.py PSController). Servers are
+    terminated when every trainer exits cleanly."""
+    host = "127.0.0.1"
+    if args.servers and args.trainers:
+        server_eps = args.servers.split(",")
+        trainer_eps = args.trainers.split(",")
+    else:
+        ns = args.server_num or 1
+        nt = args.trainer_num or 1
+        server_eps = [f"{host}:{_free_port()}" for _ in range(ns)]
+        trainer_eps = [f"{host}:{_free_port()}" for _ in range(nt)]
+
+    base_env = dict(os.environ)
+    base_env.update({
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(trainer_eps),
+        "PADDLE_TRAINERS_NUM": str(len(trainer_eps)),
+    })
+    print(f"[launch] ps mode: {len(server_eps)} servers + "
+          f"{len(trainer_eps)} trainers", file=sys.stderr, flush=True)
+    servers = _spawn_role(args, base_env, len(server_eps), "PSERVER",
+                          args.log_dir, server_eps)
+    trainers = _spawn_role(args, base_env, len(trainer_eps), "TRAINER",
+                           args.log_dir, trainer_eps)
+
+    def _stop(procs):
+        for s in procs:
+            if s.proc.poll() is None:
+                s.proc.terminate()
+        for s in procs:
+            try:
+                s.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                s.proc.kill()
+
+    # supervise BOTH pods (reference PSController.watch): a dead server is a
+    # job failure; trainer completion ends the job and stops the servers
+    try:
+        while True:
+            for s in servers:
+                rc = s.proc.poll()
+                if rc is not None and rc != 0:
+                    print(f"[launch] ps server {s.rank} failed rc={rc} "
+                          f"(log: {s.log_path}); terminating job",
+                          file=sys.stderr, flush=True)
+                    _stop(trainers)
+                    _stop(servers)
+                    return rc
+            done = [w.proc.poll() for w in trainers]
+            for w, rc in zip(trainers, done):
+                if rc is not None and rc != 0:
+                    print(f"[launch] trainer {w.rank} failed rc={rc} "
+                          f"(log: {w.log_path}); terminating job",
+                          file=sys.stderr, flush=True)
+                    _stop(trainers)
+                    _stop(servers)
+                    return rc
+            if all(rc == 0 for rc in done):
+                _stop(servers)
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _stop(trainers)
+        _stop(servers)
+        return 130
+
+
+def _launch_rpc(args) -> int:
+    """RPC job (reference: launch/controllers/rpc.py): N workers with the
+    env contract distributed/rpc/rpc.py:init_rpc consumes."""
+    nproc = args.nproc_per_node or 2
+    host = "127.0.0.1"
+    master = args.master or f"{host}:{_free_port()}"
+    endpoints = [f"{host}:{_free_port()}" for _ in range(nproc)]
+    os.makedirs(args.log_dir, exist_ok=True)
+    print(f"[launch] rpc mode: {nproc} workers master={master}",
+          file=sys.stderr, flush=True)
+    workers = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_WORKER_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER_ENDPOINT": master,
+        })
+        log_path = os.path.join(args.log_dir, f"rpclog.{rank}")
+        if rank == 0:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", args.training_script]
+                + args.training_script_args, env=env)
+        else:
+            with open(log_path, "w") as out:
+                proc = subprocess.Popen(
+                    [sys.executable, "-u", args.training_script]
+                    + args.training_script_args,
+                    env=env, stdout=out, stderr=subprocess.STDOUT)
+        workers.append(_Worker(proc, rank, log_path))
+    return _supervise(workers)
+
+
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if (args.run_mode == "ps" or args.server_num or args.servers
+            or args.trainer_num or args.trainers):
+        return _launch_ps(args)
+    if args.run_mode == "rpc":
+        return _launch_rpc(args)
     if args.run_mode != "collective":
-        raise SystemExit(
-            f"run_mode={args.run_mode!r} is not supported: the brpc "
-            "parameter-server stack is GPU/CPU-recsys specific "
-            "(SURVEY.md §7); only collective jobs run on TPU")
+        raise SystemExit(f"unknown run_mode={args.run_mode!r}: choose "
+                         "collective, ps or rpc")
     nnodes = int(str(args.nnodes).split(":")[0])
     nproc = args.nproc_per_node if args.nproc_per_node is not None else 1
     if nnodes > 1 and not args.master:

@@ -1,0 +1,423 @@
+"""dy2static: AST rewrite of Python ``if``/``while`` on tensor values.
+
+Reference parity: python/paddle/jit/dy2static/ (ast_transformer.py,
+ifelse_transformer.py, loop_transformer.py, convert_operators.py) — the
+pipeline that lets ``to_static`` compile functions whose control flow
+depends on tensor values.
+
+TPU-native collapse: the reference needs ~30 transformer passes because its
+static graph has no eager fallback — everything must become Program ops.
+Here the eager tape IS the fallback, and static/nn/control_flow.py already
+dispatches at runtime (concrete predicate → plain Python branch on the tape;
+traced predicate → lax.cond / lax.while_loop). So the AST pass only has to
+make the *syntax* dispatchable: rewrite
+
+    if t:  A  else:  B        →   (vars) = _jst.convert_ifelse(t, fT, fF)
+    while t:  body            →   (vars) = _jst.convert_while(c, b, vars)
+    a and b   (in a test)     →   _jst.convert_logical_and(a, lambda: b)
+
+with branch/loop bodies lifted into nested functions returning the names
+they assign. When the predicate is a Python bool the converted code runs
+the same branch Python would — transformation is semantics-preserving for
+non-tensor control flow, so it is safe to apply to every to_static target.
+
+Deliberately NOT converted (left as plain Python, same behavior as before
+the pass): ``if``/``while`` containing ``break``/``continue``/``return``
+(except the common both-branches-return-an-expression ``if``), ``for``
+loops (concrete ranges unroll fine under trace), and anything whose source
+is unavailable (lambdas, REPL) — the transform then no-ops.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+import warnings
+from typing import List, Sequence
+
+__all__ = ["ast_transform", "convert_ifelse", "convert_while",
+           "convert_logical_and", "convert_logical_or", "convert_logical_not",
+           "UNDEFINED", "ld"]
+
+
+class _Undefined:
+    """Sentinel for names unbound before a converted branch assigns them
+    (reference: dy2static UndefinedVar)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):  # pragma: no cover
+        return "<dy2static.UNDEFINED>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on this control-flow path (assigned in "
+            "only one branch of a converted if/while)")
+
+
+UNDEFINED = _Undefined()
+
+
+def ld(local_ns: dict, name: str):
+    """Load ``name`` from a locals() snapshot, UNDEFINED when unbound."""
+    return local_ns.get(name, UNDEFINED)
+
+
+def _is_tensor(x) -> bool:
+    from ..tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _is_traced_tensor(x) -> bool:
+    import jax
+
+    return _is_tensor(x) and isinstance(x._value, jax.core.Tracer)
+
+
+# ------------------------------------------------------------- converters
+
+def convert_ifelse(pred, true_fn, false_fn, args=()):
+    """Runtime dispatch for a rewritten ``if`` (reference:
+    convert_operators.py convert_ifelse). ``args`` are the current values of
+    the names either branch assigns — passed as parameters so a branch that
+    both reads and writes a name doesn't trip UnboundLocalError."""
+    if _is_traced_tensor(pred):
+        from ..static.nn import cond as _cond
+
+        return _cond(pred, lambda: true_fn(*args), lambda: false_fn(*args))
+    taken = true_fn if (bool(pred.numpy().reshape(())) if _is_tensor(pred)
+                        else bool(pred)) else false_fn
+    return taken(*args)
+
+
+def convert_while(cond_fn, body_fn, vals: Sequence):
+    """Runtime dispatch for a rewritten ``while``. ``vals`` are the
+    candidate loop variables (UNDEFINED for names unbound before the loop —
+    pure per-iteration temps)."""
+    probe = cond_fn(*vals)
+    if not _is_traced_tensor(probe):
+        # eager regime: plain Python loop on the tape
+        vals = list(vals)
+        first = probe
+        while (bool(first.numpy().reshape(())) if _is_tensor(first)
+               else bool(first)):
+            vals = list(body_fn(*vals))
+            first = cond_fn(*vals)
+        return tuple(vals)
+
+    from ..static.nn import while_loop as _while_loop
+
+    carried = [i for i, v in enumerate(vals) if v is not UNDEFINED]
+    if not carried:
+        raise ValueError(
+            "while on a traced predicate needs at least one loop variable "
+            "bound before the loop")
+
+    def merge(cvals):
+        full = list(vals)
+        for i, v in zip(carried, cvals):
+            full[i] = v
+        return full
+
+    def cond2(*cvals):
+        return cond_fn(*merge(cvals))
+
+    def body2(*cvals):
+        out = list(body_fn(*merge(cvals)))
+        return [out[i] for i in carried]
+
+    finals = _while_loop(cond2, body2, [vals[i] for i in carried])
+    full = [UNDEFINED] * len(vals)  # temps are dead after a compiled loop
+    for i, v in zip(carried, finals):
+        full[i] = v
+    return tuple(full)
+
+
+def convert_logical_and(x, y_fn):
+    """``a and b`` with short-circuit preserved for Python values
+    (reference: convert_operators.py convert_logical_and)."""
+    if _is_tensor(x):
+        from ..ops import logic as _logic
+
+        return _logic.logical_and(x, y_fn())
+    return x and y_fn()
+
+
+def convert_logical_or(x, y_fn):
+    if _is_tensor(x):
+        from ..ops import logic as _logic
+
+        return _logic.logical_or(x, y_fn())
+    return x or y_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensor(x):
+        from ..ops import logic as _logic
+
+        return _logic.logical_not(x)
+    return not x
+
+
+_JST = "__paddle_jst__"
+
+
+# ----------------------------------------------------------- AST analysis
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+
+
+def _assigned_names(nodes: Sequence[ast.stmt]) -> List[str]:
+    """Plain Names stored at this function's scope within ``nodes``."""
+    out = []
+
+    def walk(n):
+        if isinstance(n, _SCOPE_NODES):
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            if n.id not in out:
+                out.append(n.id)
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for n in nodes:
+        walk(n)
+    return out
+
+
+def _has_flow_escape(nodes: Sequence[ast.stmt]) -> bool:
+    """break/continue/return/yield at this scope inside ``nodes``."""
+    found = False
+
+    def walk(n):
+        nonlocal found
+        if found or isinstance(n, _SCOPE_NODES):
+            return
+        if isinstance(n, (ast.Break, ast.Continue, ast.Return, ast.Yield,
+                          ast.YieldFrom)):
+            found = True
+            return
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for n in nodes:
+        walk(n)
+    return found
+
+
+def _jst_call(attr: str, args: List[ast.expr]) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """Rewrites and/or/not inside a converted test expression so tensor
+    operands don't hit Tracer.__bool__ (reference: logical_transformer.py)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = node.values[-1]
+        for prev in reversed(node.values[:-1]):
+            expr = _jst_call(fn, [prev, ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self._uid = 0
+
+    def _next(self, tag):
+        self._uid += 1
+        return f"__jst_{tag}_{self._uid}"
+
+    def _locals_snapshot(self, names):
+        """stmts binding each unbound name to UNDEFINED via a locals() read."""
+        snap = self._next("locals")
+        stmts = [ast.Assign(
+            targets=[_name(snap, ast.Store())],
+            value=ast.Call(func=_name("locals"), args=[], keywords=[]))]
+        for n in names:
+            stmts.append(ast.Assign(
+                targets=[_name(n, ast.Store())],
+                value=_jst_call("ld", [_name(snap),
+                                       ast.Constant(value=n)])))
+        return stmts
+
+    def _make_fn(self, fname, argnames, body, ret_names):
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in ret_names], ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=a) for a in argnames],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=list(body) + [ret],
+            decorator_list=[])
+
+    # ------------------------------------------------------------------ if
+    def visit_If(self, node):
+        self.generic_visit(node)
+        test = _TestTransformer().visit(node.test)
+        # common early-return shape: both branches are a single `return e`
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Return)
+                and node.body[0].value is not None
+                and len(node.orelse) == 1
+                and isinstance(node.orelse[0], ast.Return)
+                and node.orelse[0].value is not None):
+            self.changed = True
+            lam = lambda e: ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=e)
+            return ast.Return(value=_jst_call(
+                "convert_ifelse",
+                [test, lam(node.body[0].value), lam(node.orelse[0].value)]))
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node  # leave plain Python (concrete predicates only)
+        targets = _assigned_names(node.body + node.orelse)
+        self.changed = True
+        tname, fname = self._next("true"), self._next("false")
+        stmts = self._locals_snapshot(targets)
+        stmts.append(self._make_fn(tname, targets, node.body or [ast.Pass()],
+                                   targets))
+        stmts.append(self._make_fn(fname, targets,
+                                   node.orelse or [ast.Pass()], targets))
+        call = _jst_call("convert_ifelse",
+                         [test, _name(tname), _name(fname),
+                          ast.Tuple(elts=[_name(n) for n in targets],
+                                    ctx=ast.Load())])
+        if targets:
+            stmts.append(ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in targets],
+                                   ctx=ast.Store())],
+                value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        return stmts
+
+    # --------------------------------------------------------------- while
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        test = _TestTransformer().visit(node.test)
+        loop_vars = _assigned_names(node.body)
+        if not loop_vars:
+            return node
+        self.changed = True
+        cname, bname = self._next("cond"), self._next("body")
+        stmts = self._locals_snapshot(loop_vars)
+        stmts.append(self._make_fn(
+            cname, loop_vars,
+            [ast.Return(value=test)], []))
+        # cond returns the test, not a tuple — fix the trailing return
+        stmts[-1].body = [ast.Return(value=test)]
+        stmts.append(self._make_fn(bname, loop_vars, node.body, loop_vars))
+        call = _jst_call("convert_while", [
+            _name(cname), _name(bname),
+            ast.Tuple(elts=[_name(n) for n in loop_vars], ctx=ast.Load())])
+        stmts.append(ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                     for n in loop_vars],
+                               ctx=ast.Store())],
+            value=call))
+        return stmts
+
+
+# ------------------------------------------------------------- entry point
+
+def ast_transform(fn):
+    """Return ``fn`` rewritten for tensor control flow, or ``fn`` unchanged
+    when nothing needs rewriting or the source is unavailable."""
+    bound_self = None
+    if inspect.ismethod(fn):
+        bound_self = fn.__self__
+        fn = fn.__func__
+    if not isinstance(fn, types.FunctionType):
+        return fn if bound_self is None else fn.__get__(bound_self)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn if bound_self is None else fn.__get__(bound_self)
+    if not tree.body or not isinstance(tree.body[0],
+                                       (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+        return fn if bound_self is None else fn.__get__(bound_self)
+
+    fdef = tree.body[0]
+    fdef.decorator_list = []
+    tr = _ControlFlowTransformer()
+    try:
+        tree = tr.visit(tree)
+        if not tr.changed:
+            return fn if bound_self is None else fn.__get__(bound_self)
+        ast.fix_missing_locations(tree)
+
+        from . import dy2static as _jst_mod
+
+        # exec against the LIVE module globals (not a snapshot): late-bound
+        # helpers, monkeypatching, and self-recursion must keep working.
+        # _JST is a reserved dunder, injected once.
+        glb = fn.__globals__
+        glb[_JST] = _jst_mod
+
+        free = fn.__code__.co_freevars
+        if free:
+            factory = ast.parse(
+                f"def __jst_factory__({', '.join(free)}):\n pass").body[0]
+            factory.body = [tree.body[0],
+                            ast.Return(value=_name(fdef.name))]
+            mod = ast.Module(body=[factory], type_ignores=[])
+            ast.fix_missing_locations(mod)
+            ns = {}
+            exec(compile(mod, f"<dy2static:{fn.__name__}>", "exec"), glb, ns)
+            cells = [c.cell_contents for c in fn.__closure__]
+            new_fn = ns["__jst_factory__"](*cells)
+        else:
+            ns = {}
+            exec(compile(tree, f"<dy2static:{fn.__name__}>", "exec"), glb, ns)
+            new_fn = ns[fdef.name]
+    except Exception as e:  # pragma: no cover — conservative fallback
+        warnings.warn(f"dy2static transform of {fn.__qualname__} failed "
+                      f"({type(e).__name__}: {e}); running untransformed",
+                      stacklevel=2)
+        return fn if bound_self is None else fn.__get__(bound_self)
+
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__qualname__ = fn.__qualname__
+    new_fn.__doc__ = fn.__doc__
+    new_fn.__dy2static_original__ = fn
+    if bound_self is not None:
+        return new_fn.__get__(bound_self)
+    return new_fn

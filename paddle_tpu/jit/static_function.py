@@ -24,6 +24,7 @@ parameters in place (the buffer-donation answer to the reference's inplace
 from __future__ import annotations
 
 import gc
+import os
 import weakref
 from typing import Any, Callable, Optional, Sequence
 
@@ -384,7 +385,15 @@ class StaticFunction:
 
     def __init__(self, function: Callable, input_spec=None, build_strategy=None,
                  property=False, full_graph=True, observe: Sequence[Any] = (),
-                 warmup: bool = True):
+                 warmup: bool = True, dy2static: bool = True):
+        if dy2static and os.environ.get("PADDLE_TPU_DY2STATIC") != "0":
+            # AST pass rewriting Python if/while on tensor values into
+            # static.nn control flow (jit/dy2static.py — reference:
+            # jit/dy2static/ast_transformer.py). Semantics-preserving for
+            # Python-bool control flow; no-ops when source is unavailable.
+            from .dy2static import ast_transform
+
+            function = ast_transform(function)
         self._fn = function
         self._input_spec = input_spec
         self._observe = list(observe)

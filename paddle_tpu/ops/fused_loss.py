@@ -34,10 +34,8 @@ DEFAULT_CHUNK = 8192
 
 
 def _pick_chunk(v: int, chunk: int) -> int:
-    chunk = min(chunk, v)
-    while v % chunk:
-        chunk //= 2
-    return max(chunk, 128) if v % max(chunk, 128) == 0 else v
+    """Chunk size actually used for a (possibly padded) vocab of v rows."""
+    return min(chunk, v)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -53,14 +51,21 @@ def fused_linear_cross_entropy(hidden, weight, labels, chunk: int = DEFAULT_CHUN
 
 
 def _chunks(weight, chunk):
+    """Split W [V, H] into [n, C, H]; V not divisible by C gets zero-row
+    padding (the scan masks the padded tail, so the O(N·C) memory bound
+    holds for EVERY vocab size — silently falling back to C=V would
+    re-materialize exactly the [N, V] block this module exists to avoid)."""
     v, h = weight.shape
     c = _pick_chunk(v, chunk)
-    return weight.reshape(v // c, c, h), c
+    pad = (-v) % c
+    if pad:
+        weight = jnp.pad(weight, ((0, pad), (0, 0)))
+    return weight.reshape((v + pad) // c, c, h), c, v
 
 
 def _fwd(hidden, weight, labels, chunk, ignore_index):
     n, h = hidden.shape
-    wch, c = _chunks(weight, chunk)
+    wch, c, v = _chunks(weight, chunk)
     hid32 = hidden.astype(jnp.float32)
     valid = labels != ignore_index
     lab = jnp.where(valid, labels, 0).astype(jnp.int32)
@@ -69,6 +74,8 @@ def _fwd(hidden, weight, labels, chunk, ignore_index):
         m, l, lab_logit = carry
         w_c, base = xs
         logits = hid32 @ w_c.astype(jnp.float32).T  # [N, C]
+        col_ok = base + jnp.arange(c, dtype=jnp.int32) < v
+        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
         m_cur = jnp.max(logits, axis=1)
         m_new = jnp.maximum(m, m_cur)
         l = l * jnp.exp(m - m_new) + jnp.sum(
@@ -96,7 +103,7 @@ def _fwd(hidden, weight, labels, chunk, ignore_index):
 def _bwd(chunk, ignore_index, res, g):
     hidden, weight, lab, valid, lse, denom = res
     n, h = hidden.shape
-    wch, c = _chunks(weight, chunk)
+    wch, c, v = _chunks(weight, chunk)
     hid32 = hidden.astype(jnp.float32)
     scale = (g / denom) * valid.astype(jnp.float32)  # [N]
 
@@ -104,7 +111,9 @@ def _bwd(chunk, ignore_index, res, g):
         w_c, base = xs
         w32 = w_c.astype(jnp.float32)
         logits = hid32 @ w32.T                        # [N, C]
-        p = jnp.exp(logits - lse[:, None])            # softmax chunk
+        col_ok = base + jnp.arange(c, dtype=jnp.int32) < v
+        p = jnp.where(col_ok[None, :],
+                      jnp.exp(logits - lse[:, None]), 0.0)  # softmax chunk
         idx = lab - base
         in_chunk = (idx >= 0) & (idx < c)
         onehot = (jnp.arange(c, dtype=jnp.int32)[None, :]
@@ -118,7 +127,8 @@ def _bwd(chunk, ignore_index, res, g):
     bases = jnp.arange(wch.shape[0], dtype=jnp.int32) * c
     dh, dwch = jax.lax.scan(body, jnp.zeros((n, h), jnp.float32),
                             (wch, bases))
-    return (dh.astype(hidden.dtype), dwch.reshape(weight.shape), None)
+    dw = dwch.reshape(-1, h)[:v]  # drop the zero-padded tail rows
+    return (dh.astype(hidden.dtype), dw, None)
 
 
 fused_linear_cross_entropy.defvjp(_fwd, _bwd)

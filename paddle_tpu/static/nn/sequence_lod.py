@@ -48,11 +48,15 @@ def sequence_conv(input, num_filters: int, filter_size: int = 3,
     ins = [x, w] + ([b] if b is not None else [])
 
     def sc(v, wv, *rest):
+        # window for output t is input rows {t+start, ..., t+start+k-1};
+        # pad both ends so every tap indexes in-bounds, then slice with the
+        # start offset folded in
         T = v.shape[1]
         lo, hi = max(0, -start), max(0, start + k - 1)
         vp = jnp.pad(v, ((0, 0), (lo, hi), (0, 0)))
         cols = jnp.concatenate(
-            [vp[:, i:i + T] for i in range(k)], axis=-1)  # [B, T, k*D]
+            [vp[:, lo + start + i:lo + start + i + T] for i in range(k)],
+            axis=-1)  # [B, T, k*D]
         out = cols @ wv
         return out + rest[0] if rest else out
 
@@ -66,12 +70,10 @@ def sequence_conv(input, num_filters: int, filter_size: int = 3,
 def sequence_softmax(input, use_cudnn: bool = False, name=None):
     """reference: sequence_lod.py sequence_softmax — softmax within each
     sequence (dense: over the time axis)."""
+    from ...nn import functional as F
+
     x = ensure_tensor(input)
-    axis = 1 if x.ndim > 1 else 0
-    return apply_op(lambda v: jnp.exp(v - jnp.max(v, axis, keepdims=True))
-                    / jnp.sum(jnp.exp(v - jnp.max(v, axis, keepdims=True)),
-                              axis, keepdims=True),
-                    [x], name="sequence_softmax")
+    return F.softmax(x, axis=1 if x.ndim > 1 else 0)
 
 
 def sequence_pool(input, pool_type: str, is_test: bool = False,

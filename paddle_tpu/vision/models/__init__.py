@@ -1,5 +1,12 @@
 """paddle_tpu.vision.models — the vision model zoo (reference:
 python/paddle/vision/models/__init__.py inventory, SURVEY.md §2.4)."""
+from .detection import (  # noqa: F401
+    CSPPAN, CSPResNet, PPYOLOE, PPYOLOEHead, ppyoloe_l, ppyoloe_m,
+    ppyoloe_s,
+)
+from .ocr import (  # noqa: F401
+    CRNN, DBHead, DBNet, crnn_ctc, db_loss, db_mobilenet_v3,
+)
 from .extra_nets import (  # noqa: F401
     DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, densenet121, densenet161,
     densenet169, densenet201, densenet264, googlenet, inception_v3,
@@ -23,6 +30,9 @@ from .simple_nets import (  # noqa: F401
 )
 
 __all__ = [
+    "PPYOLOE", "ppyoloe_s", "ppyoloe_m", "ppyoloe_l", "CSPResNet",
+    "CSPPAN", "PPYOLOEHead", "DBNet", "DBHead", "CRNN", "db_mobilenet_v3",
+    "crnn_ctc", "db_loss",
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d", "resnext50_64x4d",
     "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",

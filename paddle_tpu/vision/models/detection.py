@@ -1,0 +1,340 @@
+"""PP-YOLOE detection model family (BASELINE.md config 5).
+
+Reference parity: the reference repo ships the detection *ops* in-tree
+(vision/ops.py: yolo_box, matrix_nms, …) while the PP-YOLOE model lives in
+PaddleDetection (ppdet/modeling/architectures/yolo.py,
+backbones/cspresnet.py, necks/custom_pan.py, heads/ppyoloe_head.py). As with
+the LLM zoo (models/gpt.py), the flagship benchmark model is made
+first-class here.
+
+TPU-native shape: anchor-free, fully static shapes — every level predicts a
+dense [H·W] grid (no dynamic proposal lists, which XLA can't tile), and NMS
+runs as the existing static-shape kernels in vision/ops.py. Training loss is
+the PP-YOLOE recipe in compact form: varifocal-style BCE on classification,
+GIoU on decoded boxes, and Distribution Focal Loss on the discretized
+offsets, with a center-based positive assignment (a static simplification of
+TAL that keeps the [N_gt, H·W] assignment dense).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from ...nn import functional as F
+from ...ops.manipulation import concat as paddle_concat
+from ...ops._apply import apply_op, ensure_tensor
+from ...tensor import Tensor
+
+__all__ = ["CSPResNet", "CSPPAN", "PPYOLOEHead", "PPYOLOE",
+           "ppyoloe_s", "ppyoloe_m", "ppyoloe_l"]
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1, act="silu"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.silu(x) if self.act == "silu" else x
+
+
+class CSPBlock(nn.Layer):
+    """CSPResNet basic block: split, residual convs, concat, fuse
+    (ppdet backbones/cspresnet.py BasicBlock + CSPResStage, compacted)."""
+
+    def __init__(self, ch, n=1):
+        super().__init__()
+        mid = ch // 2
+        self.left = ConvBNAct(ch, mid, 1)
+        self.right = ConvBNAct(ch, mid, 1)
+        self.blocks = nn.LayerList([
+            nn.Sequential(ConvBNAct(mid, mid, 3), ConvBNAct(mid, mid, 3))
+            for _ in range(n)])
+        self.fuse = ConvBNAct(2 * mid, ch, 1)
+
+    def forward(self, x):
+        left = self.left(x)
+        y = self.right(x)
+        for b in self.blocks:
+            y = y + b(y)
+        return self.fuse(paddle_concat([left, y], axis=1))
+
+
+class CSPResNet(nn.Layer):
+    """Backbone emitting strides {8, 16, 32} feature maps."""
+
+    def __init__(self, width=0.50, depth=0.33, in_channels=3):
+        super().__init__()
+        chs = [int(c * width) for c in (64, 128, 256, 512, 1024)]
+        n = max(1, round(3 * depth))
+        self.stem = nn.Sequential(
+            ConvBNAct(in_channels, chs[0], 3, stride=2),
+            ConvBNAct(chs[0], chs[0], 3))
+        self.stages = nn.LayerList()
+        for i in range(4):
+            self.stages.append(nn.Sequential(
+                ConvBNAct(chs[i], chs[i + 1], 3, stride=2),
+                CSPBlock(chs[i + 1], n)))
+        self.out_channels = chs[2:]
+
+    def forward(self, x):
+        x = self.stem(x)
+        outs = []
+        for i, stage in enumerate(self.stages):
+            x = stage(x)
+            if i >= 1:
+                outs.append(x)
+        return outs  # [C3/8, C4/16, C5/32]
+
+
+class CSPPAN(nn.Layer):
+    """PAN neck: top-down then bottom-up fusion
+    (ppdet necks/custom_pan.py CustomCSPPAN, compacted)."""
+
+    def __init__(self, in_channels: Sequence[int]):
+        super().__init__()
+        c3, c4, c5 = in_channels
+        self.reduce5 = ConvBNAct(c5, c4, 1)
+        self.td4 = CSPBlock(2 * c4)
+        self.merge4 = ConvBNAct(2 * c4, c4, 1)
+        self.reduce4 = ConvBNAct(c4, c3, 1)
+        self.td3 = CSPBlock(2 * c3)
+        self.merge3 = ConvBNAct(2 * c3, c3, 1)
+        self.down3 = ConvBNAct(c3, c3, 3, stride=2)
+        self.bu4 = ConvBNAct(c3 + c4, c4, 1)
+        self.down4 = ConvBNAct(c4, c4, 3, stride=2)
+        self.bu5 = ConvBNAct(c4 + c4, c4, 1)
+        self.out_channels = [c3, c4, c4]
+
+    def forward(self, feats):
+        c3, c4, c5 = feats
+        p5 = self.reduce5(c5)
+        up5 = F.interpolate(p5, scale_factor=2, mode="nearest")
+        p4 = self.merge4(self.td4(paddle_concat([up5, c4], axis=1)))
+        p4r = self.reduce4(p4)
+        up4 = F.interpolate(p4r, scale_factor=2, mode="nearest")
+        p3 = self.merge3(self.td3(paddle_concat([up4, c3], axis=1)))
+        n4 = self.bu4(paddle_concat([self.down3(p3), p4], axis=1))
+        n5 = self.bu5(paddle_concat([self.down4(n4), p5], axis=1))
+        return [p3, n4, n5]
+
+
+class PPYOLOEHead(nn.Layer):
+    """Anchor-free decoupled head with DFL regression
+    (ppdet heads/ppyoloe_head.py, compact: ESE attention dropped)."""
+
+    def __init__(self, in_channels: Sequence[int], num_classes: int = 80,
+                 reg_max: int = 16, strides=(8, 16, 32)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.strides = list(strides)
+        self.stem_cls = nn.LayerList(
+            [ConvBNAct(c, c, 1) for c in in_channels])
+        self.stem_reg = nn.LayerList(
+            [ConvBNAct(c, c, 1) for c in in_channels])
+        self.pred_cls = nn.LayerList(
+            [nn.Conv2D(c, num_classes, 3, padding=1) for c in in_channels])
+        self.pred_reg = nn.LayerList(
+            [nn.Conv2D(c, 4 * (reg_max + 1), 3, padding=1)
+             for c in in_channels])
+        # DFL projection: discretized offset bins -> expectation
+        self.proj = Tensor(jnp.arange(reg_max + 1, dtype=jnp.float32),
+                           stop_gradient=True)
+
+    def forward(self, feats):
+        """Returns per-level (cls_logits [B,HW,C], reg_logits
+        [B,HW,4,reg_max+1], anchor centers [HW,2], stride)."""
+        outs = []
+        for i, f in enumerate(feats):
+            B = f.shape[0]
+            H, W = f.shape[2], f.shape[3]
+            cls = self.pred_cls[i](self.stem_cls[i](f) + f)
+            reg = self.pred_reg[i](self.stem_reg[i](f))
+            cls = cls.transpose([0, 2, 3, 1]).reshape([B, H * W,
+                                                       self.num_classes])
+            reg = reg.transpose([0, 2, 3, 1]).reshape(
+                [B, H * W, 4, self.reg_max + 1])
+            s = self.strides[i]
+            yy, xx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+            centers = Tensor(jnp.asarray(
+                np.stack([(xx.reshape(-1) + 0.5) * s,
+                          (yy.reshape(-1) + 0.5) * s], axis=-1),
+                jnp.float32), stop_gradient=True)
+            outs.append((cls, reg, centers, s))
+        return outs
+
+    def decode(self, reg, centers, stride):
+        """DFL expectation -> ltrb distances -> xyxy boxes."""
+        probs = F.softmax(reg, axis=-1)
+        dist = apply_op(
+            lambda p, pr: jnp.einsum("bnkr,r->bnk", p, pr),
+            [probs, self.proj], name="dfl_project")  # [B, HW, 4]
+
+        def mk(dv, cv):
+            lt, rb = dv[..., :2], dv[..., 2:]
+            return jnp.concatenate([cv[None] - lt * stride,
+                                    cv[None] + rb * stride], axis=-1)
+
+        return apply_op(mk, [dist, centers], name="dfl_decode")
+
+
+def _giou(a, b):
+    """GIoU between [N,4] xyxy box arrays (jnp)."""
+    lt = jnp.maximum(a[..., :2], b[..., :2])
+    rb = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * \
+        jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * \
+        jnp.clip(b[..., 3] - b[..., 1], 0)
+    union = area_a + area_b - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    clt = jnp.minimum(a[..., :2], b[..., :2])
+    crb = jnp.maximum(a[..., 2:], b[..., 2:])
+    cwh = jnp.clip(crb - clt, 0)
+    chull = jnp.maximum(cwh[..., 0] * cwh[..., 1], 1e-9)
+    return iou - (chull - union) / chull
+
+
+class PPYOLOE(nn.Layer):
+    """PP-YOLOE: CSPResNet + CSPPAN + ET-head.
+
+    forward(images) -> per-level raw predictions;
+    loss(preds, gt_boxes, gt_labels, gt_mask) -> scalar training loss;
+    predict(images, ...) -> (boxes [N,4], scores [N], labels [N]) via the
+    static-shape NMS kernels in vision/ops.py.
+    """
+
+    def __init__(self, num_classes: int = 80, width: float = 0.50,
+                 depth: float = 0.33, reg_max: int = 16):
+        super().__init__()
+        self.backbone = CSPResNet(width=width, depth=depth)
+        self.neck = CSPPAN(self.backbone.out_channels)
+        self.head = PPYOLOEHead(self.neck.out_channels, num_classes,
+                                reg_max=reg_max)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        return self.head(self.neck(self.backbone(images)))
+
+    # -------------------------------------------------------------- loss
+    def loss(self, preds, gt_boxes, gt_labels, gt_mask):
+        """gt_boxes [B, M, 4] xyxy; gt_labels [B, M] int; gt_mask [B, M]
+        (1 = real box, 0 = padding). Center-inside positive assignment."""
+        gt_boxes = ensure_tensor(gt_boxes)
+        gt_labels = ensure_tensor(gt_labels)
+        gt_mask = ensure_tensor(gt_mask)
+        total = None
+        for cls, reg, centers, stride in preds:
+            boxes = self.head.decode(reg, centers, stride)
+            lvl = apply_op(
+                lambda c, r, bx, gb, gl, gm, _centers=centers._value,
+                       _stride=stride: _ppyoloe_level_loss(
+                    c, r, bx, gb, gl, gm, _centers, _stride,
+                    self.num_classes, self.head.reg_max),
+                [cls, reg, boxes, gt_boxes, gt_labels, gt_mask],
+                name="ppyoloe_loss")
+            total = lvl if total is None else total + lvl
+        return total
+
+    # ----------------------------------------------------------- predict
+    def predict(self, images, score_thresh: float = 0.3,
+                iou_thresh: float = 0.5, top_k: Optional[int] = 100):
+        from ..ops import nms
+
+        preds = self.forward(images)
+        all_boxes, all_scores, all_labels = [], [], []
+        for cls, reg, centers, stride in preds:
+            boxes = self.head.decode(reg, centers, stride)
+            scores = F.sigmoid(cls)
+            all_boxes.append(boxes)
+            all_scores.append(scores)
+        boxes = paddle_concat(all_boxes, axis=1)[0]          # [N, 4]
+        scores = paddle_concat(all_scores, axis=1)[0]        # [N, C]
+        best = scores.max(axis=-1)
+        label = scores.argmax(axis=-1)
+        keepable = np.asarray((best > score_thresh).numpy())
+        idx = np.nonzero(keepable)[0]
+        if idx.size == 0:
+            return (np.zeros((0, 4), np.float32), np.zeros(0, np.float32),
+                    np.zeros(0, np.int64))
+        b = Tensor(boxes._value[idx])
+        s = Tensor(best._value[idx])
+        kept = nms(b, iou_threshold=iou_thresh, scores=s, top_k=top_k)
+        ki = np.asarray(kept.numpy())
+        return (np.asarray(b.numpy())[ki], np.asarray(s.numpy())[ki],
+                np.asarray(label.numpy())[idx][ki])
+
+
+def _ppyoloe_level_loss(cls_logits, reg_logits, boxes, gt_boxes, gt_labels,
+                        gt_mask, centers, stride, num_classes, reg_max):
+    """One level's loss, pure jnp (runs under apply_op/vjp)."""
+    B, N, C = cls_logits.shape
+    M = gt_boxes.shape[1]
+    cx = centers[None, None, :, 0]                       # [1,1,N]
+    cy = centers[None, None, :, 1]
+    inside = ((cx >= gt_boxes[..., 0:1]) & (cx <= gt_boxes[..., 2:3])
+              & (cy >= gt_boxes[..., 1:2]) & (cy <= gt_boxes[..., 3:4]))
+    inside = inside & (gt_mask[..., None] > 0)           # [B,M,N]
+    # each anchor takes the smallest-area gt containing it
+    area = ((gt_boxes[..., 2] - gt_boxes[..., 0])
+            * (gt_boxes[..., 3] - gt_boxes[..., 1]))     # [B,M]
+    big = jnp.float32(1e12)
+    cand = jnp.where(inside, area[..., None], big)       # [B,M,N]
+    gt_idx = jnp.argmin(cand, axis=1)                    # [B,N]
+    pos = jnp.min(cand, axis=1) < big                    # [B,N]
+
+    tgt_box = jnp.take_along_axis(
+        gt_boxes, gt_idx[..., None].repeat(4, -1), axis=1)   # [B,N,4]
+    tgt_lab = jnp.take_along_axis(gt_labels, gt_idx, axis=1)  # [B,N]
+
+    # classification: BCE with IoU-weighted positives (varifocal-lite)
+    iou = jax.lax.stop_gradient(_giou(boxes, tgt_box) * 0.5 + 0.5)
+    onehot = jax.nn.one_hot(tgt_lab, C) * jnp.where(pos, iou, 0.0)[..., None]
+    p = jax.nn.sigmoid(cls_logits)
+    bce = -(onehot * jnp.log(jnp.clip(p, 1e-9))
+            + (1 - onehot) * jnp.log(jnp.clip(1 - p, 1e-9)))
+    cls_loss = bce.sum() / jnp.maximum(pos.sum(), 1)
+
+    # regression on positives: GIoU + DFL
+    giou_loss = jnp.where(pos, 1.0 - _giou(boxes, tgt_box), 0.0).sum() \
+        / jnp.maximum(pos.sum(), 1)
+    # DFL: distance targets in bins
+    lt = jnp.stack([(cx[0, 0] - tgt_box[..., 0]) / stride,
+                    (cy[0, 0] - tgt_box[..., 1]) / stride,
+                    (tgt_box[..., 2] - cx[0, 0]) / stride,
+                    (tgt_box[..., 3] - cy[0, 0]) / stride], axis=-1)
+    tgt = jnp.clip(lt, 0, reg_max - 0.01)                # [B,N,4]
+    tl = jnp.floor(tgt)
+    wr = tgt - tl
+    logp = jax.nn.log_softmax(reg_logits, axis=-1)
+    li = tl.astype(jnp.int32)
+    dfl = -(jnp.take_along_axis(logp, li[..., None], -1)[..., 0] * (1 - wr)
+            + jnp.take_along_axis(logp, (li + 1)[..., None], -1)[..., 0] * wr)
+    dfl_loss = jnp.where(pos[..., None], dfl, 0.0).sum() \
+        / jnp.maximum(pos.sum() * 4, 1)
+    return cls_loss + 2.0 * giou_loss + 0.5 * dfl_loss
+
+
+def ppyoloe_s(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, width=0.50, depth=0.33, **kw)
+
+
+def ppyoloe_m(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, width=0.75, depth=0.67, **kw)
+
+
+def ppyoloe_l(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, width=1.0, depth=1.0, **kw)

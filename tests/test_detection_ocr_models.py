@@ -1,0 +1,111 @@
+"""PP-YOLOE + PP-OCR model families (vision/models/detection.py, ocr.py):
+forward shapes, trainable losses, host-side postprocess (VERDICT r2 model-zoo
+gap — BASELINE.md config 5)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import (CRNN, DBNet, PPYOLOE, crnn_ctc,
+                                      db_loss, db_mobilenet_v3, ppyoloe_s)
+
+rng = np.random.RandomState(0)
+
+
+def _det_inputs():
+    imgs = paddle.to_tensor(rng.randn(2, 3, 64, 64).astype(np.float32))
+    gt_boxes = paddle.to_tensor(np.array(
+        [[[4., 4., 30., 30.], [32., 32., 60., 60.]],
+         [[10., 10., 50., 50.], [0., 0., 0., 0.]]], np.float32))
+    gt_labels = paddle.to_tensor(np.array([[1, 2], [3, 0]]))
+    gt_mask = paddle.to_tensor(np.array([[1., 1.], [1., 0.]], np.float32))
+    return imgs, gt_boxes, gt_labels, gt_mask
+
+
+def test_ppyoloe_forward_shapes():
+    paddle.seed(0)
+    m = ppyoloe_s(num_classes=4)
+    imgs, *_ = _det_inputs()
+    preds = m(imgs)
+    assert [p[3] for p in preds] == [8, 16, 32]
+    for cls, reg, centers, s in preds:
+        hw = (64 // s) ** 2
+        assert cls.shape == [2, hw, 4]
+        assert reg.shape == [2, hw, 4, m.head.reg_max + 1]
+        assert centers.shape == [hw, 2]
+
+
+def test_ppyoloe_trains_and_predicts():
+    paddle.seed(0)
+    m = ppyoloe_s(num_classes=4)
+    imgs, gt_boxes, gt_labels, gt_mask = _det_inputs()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    l0 = lN = None
+    for _ in range(4):
+        loss = m.loss(m(imgs), gt_boxes, gt_labels, gt_mask)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lN = float(np.asarray(loss.numpy()))
+        if l0 is None:
+            l0 = lN
+    assert np.isfinite(lN) and lN < l0
+    boxes, scores, labels = m.predict(imgs[:1], score_thresh=0.05)
+    assert boxes.ndim == 2 and boxes.shape[1] == 4
+    assert scores.shape[0] == boxes.shape[0] == labels.shape[0]
+
+
+def test_dbnet_maps_loss_and_postprocess():
+    paddle.seed(0)
+    det = db_mobilenet_v3(scale=0.5)
+    imgs = paddle.to_tensor(rng.randn(1, 3, 64, 64).astype(np.float32))
+    p, t, b = det(imgs)
+    assert p.shape == t.shape == b.shape == [1, 1, 64, 64]
+    gt_shrink = paddle.to_tensor(
+        (rng.rand(1, 64, 64) > 0.8).astype(np.float32))
+    gt_thresh = paddle.to_tensor(rng.rand(1, 64, 64).astype(np.float32))
+    gt_mask = paddle.to_tensor(np.ones((1, 64, 64), np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=det.parameters())
+    l0 = lN = None
+    for _ in range(3):
+        p, t, b = det(imgs)
+        loss = db_loss(p, t, b, gt_shrink, gt_thresh, gt_mask)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lN = float(np.asarray(loss.numpy()))
+        if l0 is None:
+            l0 = lN
+    assert lN < l0
+    boxes = det.postprocess(p, thresh=0.4)
+    assert len(boxes) == 1 and boxes[0].shape[1] == 4
+
+
+def test_crnn_ctc_trains():
+    paddle.seed(0)
+    rec = crnn_ctc(num_classes=37)
+    crops = paddle.to_tensor(rng.randn(2, 3, 32, 100).astype(np.float32))
+    lp = rec(crops)
+    assert lp.shape == [25, 2, 37]  # [T, B, C]: W/4 timesteps
+    labels = paddle.to_tensor(rng.randint(1, 37, (2, 8)))
+    lens = paddle.to_tensor(np.array([8, 5], np.int32))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=rec.parameters())
+    l0 = lN = None
+    for _ in range(3):
+        loss = rec.loss(rec(crops), labels, lens).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lN = float(np.asarray(loss.numpy()))
+        if l0 is None:
+            l0 = lN
+    assert lN < l0
+
+
+def test_exports():
+    from paddle_tpu.vision import models
+
+    for name in ("PPYOLOE", "ppyoloe_s", "ppyoloe_m", "ppyoloe_l", "DBNet",
+                 "CRNN", "db_mobilenet_v3", "crnn_ctc"):
+        assert hasattr(models, name), name

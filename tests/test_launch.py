@@ -96,9 +96,78 @@ def test_failed_worker_terminates_job(tmp_path):
     assert r.returncode == 3
 
 
-def test_ps_mode_rejected(tmp_path):
+PS_SCRIPT = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+role = os.environ["TRAINING_ROLE"]
+info = dict(role=role,
+            rank=int(os.environ["PADDLE_TRAINER_ID"]),
+            servers=os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(","),
+            n_trainers=int(os.environ["PADDLE_TRAINERS_NUM"]),
+            port=os.environ["PADDLE_PORT"])
+with open(os.path.join({out!r}, f"{{role}}{{info['rank']}}.json"), "w") as f:
+    json.dump(info, f)
+if role == "PSERVER":
+    time.sleep(600)   # servers run until the launcher stops them
+"""
+
+
+def test_ps_mode_servers_and_trainers(tmp_path):
+    """PS controller (reference: launch/controllers/ps.py): one script,
+    role from TRAINING_ROLE; servers terminated after trainers finish."""
+    import json
+    import time
+
+    script = tmp_path / "ps.py"
+    script.write_text(PS_SCRIPT.format(repo=REPO, out=str(tmp_path)))
+    t0 = time.time()
+    r = _run_launch(script, tmp_path,
+                    extra=("--run_mode", "ps", "--server_num", "2",
+                           "--trainer_num", "2"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert time.time() - t0 < 120  # servers did not outlive the trainers
+    roles = {}
+    for f in tmp_path.glob("*.json"):
+        info = json.loads(f.read_text())
+        roles.setdefault(info["role"], []).append(info)
+    assert len(roles.get("PSERVER", [])) == 2
+    assert len(roles.get("TRAINER", [])) == 2
+    assert all(len(i["servers"]) == 2 for i in roles["TRAINER"])
+    assert all(i["n_trainers"] == 2 for i in roles["TRAINER"])
+
+
+RPC_SCRIPT = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+info = dict(rank=int(os.environ["PADDLE_TRAINER_ID"]),
+            world=int(os.environ["PADDLE_TRAINERS_NUM"]),
+            endpoint=os.environ["PADDLE_WORKER_ENDPOINT"],
+            master=os.environ["PADDLE_MASTER_ENDPOINT"])
+with open(os.path.join({out!r}, f"rpc{{info['rank']}}.json"), "w") as f:
+    json.dump(info, f)
+"""
+
+
+def test_rpc_mode_env_contract(tmp_path):
+    """RPC controller (reference: launch/controllers/rpc.py): the env
+    contract init_rpc consumes (distributed/rpc/rpc.py:174)."""
+    import json
+
+    script = tmp_path / "rpc.py"
+    script.write_text(RPC_SCRIPT.format(repo=REPO, out=str(tmp_path)))
+    r = _run_launch(script, tmp_path, extra=("--run_mode", "rpc"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    infos = [json.loads((tmp_path / f"rpc{i}.json").read_text())
+             for i in range(2)]
+    assert [i["rank"] for i in infos] == [0, 1]
+    assert all(i["world"] == 2 for i in infos)
+    assert infos[0]["master"] == infos[1]["master"]
+    assert infos[0]["endpoint"] != infos[1]["endpoint"]
+
+
+def test_unknown_run_mode_rejected(tmp_path):
     script = tmp_path / "x.py"
     script.write_text("pass\n")
-    r = _run_launch(script, tmp_path, extra=("--run_mode", "ps"))
+    r = _run_launch(script, tmp_path, extra=("--run_mode", "bogus"))
     assert r.returncode != 0
-    assert "parameter-server" in r.stderr or "collective" in r.stderr
+    assert "collective" in r.stderr

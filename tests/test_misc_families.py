@@ -192,3 +192,55 @@ class TestHubOnnx:
         import os
 
         assert any(f.startswith("model") for f in os.listdir(tmp_path))
+
+
+# ------------------------------------------------- audio IO multi-format
+
+def test_audio_io_all_bit_depths(tmp_path):
+    """RIFF parser round-trips 8/16/24/32-bit PCM + float32 (reference:
+    the soundfile backend's coverage; weak #7 of VERDICT r2)."""
+    import numpy as np
+    from paddle_tpu.audio.backends import wave_backend as wb
+
+    sig = np.sin(np.linspace(0, 20 * np.pi, 2000)).astype(np.float32)
+    stereo = np.stack([sig, 0.5 * sig])  # [C, N]
+
+    for enc, bits, tol in [("PCM_U", 8, 2e-2), ("PCM_S", 16, 1e-3),
+                           ("PCM_S", 24, 1e-5), ("PCM_S", 32, 1e-6),
+                           ("PCM_F", 32, 1e-7)]:
+        p = str(tmp_path / f"t_{enc}_{bits}.wav")
+        wb.save(p, stereo, 16000, encoding=enc, bits_per_sample=bits)
+        meta = wb.info(p)
+        assert meta.sample_rate == 16000
+        assert meta.num_channels == 2
+        assert meta.bits_per_sample == bits
+        assert meta.encoding == enc
+        out, sr = wb.load(p)
+        assert sr == 16000
+        np.testing.assert_allclose(np.asarray(out.numpy()), stereo,
+                                   atol=tol)
+
+
+def test_audio_io_offset_and_frames(tmp_path):
+    import numpy as np
+    from paddle_tpu.audio.backends import wave_backend as wb
+
+    sig = np.arange(100, dtype=np.float32)[None, :] / 200.0
+    p = str(tmp_path / "o.wav")
+    wb.save(p, sig, 8000, encoding="PCM_F", bits_per_sample=32)
+    out, _ = wb.load(p, frame_offset=10, num_frames=5)
+    np.testing.assert_allclose(np.asarray(out.numpy()), sig[:, 10:15],
+                               atol=1e-7)
+
+
+def test_audio_save_integer_input_casts_to_declared_width(tmp_path):
+    import numpy as np
+    from paddle_tpu.audio.backends import wave_backend as wb
+
+    data = np.array([[1000, -2000, 30000]], dtype=np.int64)  # [C, N]
+    p = str(tmp_path / "i.wav")
+    wb.save(p, data, 8000, bits_per_sample=16)
+    meta = wb.info(p)
+    assert meta.num_samples == 3 and meta.bits_per_sample == 16
+    out, _ = wb.load(p, normalize=False)
+    np.testing.assert_array_equal(np.asarray(out.numpy()), data)

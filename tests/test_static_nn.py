@@ -336,3 +336,16 @@ def test_static_rnn_misuse_raises():
         rnn.step_input(t(np.zeros((2, 2), np.float32)))
     with pytest.raises(RuntimeError):
         rnn()
+
+
+def test_sequence_conv_padding_start_window():
+    """padding_start=1, filter_size=1 is a pure one-step lookahead: output t
+    must equal input t+1 (review finding: positive starts were clamped)."""
+    xv = np.arange(8, dtype=np.float32).reshape(1, 8, 1)
+    out = nn.sequence_conv(t(xv), 1, filter_size=1, padding_start=1,
+                           bias_attr=False,
+                           param_attr=paddle.ParamAttr(
+                               initializer=paddle.nn.initializer.Constant(1.0)))
+    got = np.asarray(out.numpy())[0, :, 0]
+    want = np.concatenate([xv[0, 1:, 0], [0.0]])  # shifted left, zero tail
+    np.testing.assert_allclose(got, want)
