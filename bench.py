@@ -53,10 +53,11 @@ def _emit(record: dict):
         _log(f"could not persist to {_NOTES_PATH}: {e}")
 
 
-def _probe_backend_subprocess(timeout_s: float) -> bool:
+def _probe_backend_subprocess(timeout_s: float, require_tpu: bool = False):
     """Probe backend init in a KILLABLE subprocess — the axon plugin can
     hang (not error) inside client init, which no in-process retry loop
-    survives. Returns True when `jax.devices()` + a tiny computation work."""
+    survives. Returns True when `jax.devices()` + a tiny computation work
+    (and, with require_tpu, the platform is an accelerator, not cpu)."""
     import subprocess
     code = ("import jax, jax.numpy as jnp;"
             "d=jax.devices();"
@@ -66,6 +67,12 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
                            capture_output=True, text=True)
         ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+        platform = ""
+        if ok:
+            platform = [ln for ln in r.stdout.splitlines()
+                        if "PROBE_OK" in ln][-1].split()[1]
+        if require_tpu and platform == "cpu":
+            ok = False
         tail = (r.stdout + r.stderr).strip().splitlines()[-3:]
         _log(f"probe rc={r.returncode} ok={ok}: {' | '.join(tail)}")
         return ok
@@ -378,6 +385,60 @@ def bench_resnet50(dev, small):
 _MODELS = {"gpt": bench_gpt, "bert": bench_bert, "resnet50": bench_resnet50}
 
 
+def _run_ladder(model: str) -> bool:
+    """On-TPU escalation ladder: bank the proven config first, then try the
+    untested-on-chip MFU levers, each in its OWN subprocess (an OOM or
+    Mosaic failure in a lever run must not cost the round's number —
+    round 2 lost its official TPU record to exactly that class of accident).
+    Emits the best run's JSON line. Returns False if nothing succeeded."""
+    import subprocess
+
+    ladder = [
+        ("b8-proven", {}),
+        ("b16-fused-ce", {"BENCH_BATCH": "16", "BENCH_FUSED_CE": "1"}),
+        ("b32-fce-recompute", {"BENCH_BATCH": "32", "BENCH_FUSED_CE": "1",
+                               "BENCH_RECOMPUTE": "1"}),
+    ]
+    results = []
+    for desc, overrides in ladder:
+        env = dict(os.environ)
+        env["BENCH_LADDER"] = "0"
+        env["BENCH_BACKEND_WAIT"] = "240"  # tunnel already probed healthy
+        env.update(overrides)
+        _log(f"ladder[{desc}]: launching")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--model", model],
+                env=env, capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            _log(f"ladder[{desc}]: TIMED OUT (killed); stopping escalation")
+            break  # a hung chip claim must not cascade (tunnel-wedge rule)
+        line = None
+        for ln in reversed(r.stdout.strip().splitlines()):
+            if ln.startswith("{"):
+                line = ln
+                break
+        if r.returncode == 0 and line:
+            rec = json.loads(line)
+            _log(f"ladder[{desc}]: {rec.get('value')} {rec.get('unit')} "
+                 f"mfu={rec.get('mfu_vs_v5e_peak')} dev={rec.get('device')}")
+            if rec.get("device") != "cpu":
+                results.append(rec)
+            else:
+                _log(f"ladder[{desc}]: fell back to CPU; stopping")
+                break
+        else:
+            tail = (r.stdout + r.stderr).strip().splitlines()[-4:]
+            _log(f"ladder[{desc}]: FAILED rc={r.returncode}: "
+                 + " | ".join(tail))
+    if not results:
+        return False
+    best = max(results, key=lambda r: r.get("value", 0.0))
+    best["ladder"] = [r.get("config") for r in results]
+    print(json.dumps(best), flush=True)
+    return True
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "gpt")
     if "--model" in sys.argv:
@@ -386,6 +447,20 @@ def main():
         _log(f"unknown model {model!r}; choose from {sorted(_MODELS)}")
         sys.exit(2)
     os.environ["BENCH_MODEL"] = model  # survives the CPU-fallback re-exec
+
+    if (model == "gpt"
+            and os.environ.get("BENCH_LADDER") != "0"
+            and os.environ.get("BENCH_CPU_FALLBACK") != "1"
+            and os.environ.get("BENCH_SMALL") != "1"
+            and not any(os.environ.get(k) for k in
+                        ("BENCH_BATCH", "BENCH_FUSED_CE", "BENCH_RECOMPUTE",
+                         "BENCH_SEQ"))
+            and _probe_backend_subprocess(150.0, require_tpu=True)):
+        # TPU is reachable: run the config ladder (each config claims the
+        # chip in its own subprocess; this parent never initializes jax)
+        if _run_ladder(model):
+            return
+        _log("ladder produced nothing; falling through to the single run")
 
     max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", 600))
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":

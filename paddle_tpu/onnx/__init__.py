@@ -1,29 +1,78 @@
 """paddle.onnx parity surface.
 
 Reference parity: python/paddle/onnx/export.py — a thin wrapper over the
-external ``paddle2onnx`` converter. That converter consumes the reference's
-Program protobuf; this framework's deploy IR is StableHLO (jit.save /
-jax.export), for which the ecosystem path is StableHLO→ONNX via onnx-mlir
-or IREE tooling. ``export`` therefore always produces the StableHLO artifact at the
-requested path and then raises NotImplementedError naming it — direct
-ONNX graph emission is not implemented, and a silent wrong-format success
-would be worse than the loud gap.
+external ``paddle2onnx`` converter, which walks the reference Program's
+OpDescs. Here the deploy IR is the traced jaxpr, and conversion walks it
+directly (onnx/convert.py) emitting ModelProto in raw protobuf wire format
+(onnx/wire.py — the ``onnx`` package is not in this zero-egress image).
+
+Coverage is the inference surface of the model zoo (matmul/conv/pool/
+elementwise/activation/reshape/reduce chains); an unmapped primitive raises
+NotImplementedError naming it. The StableHLO artifact (jit.save) remains
+the full-fidelity deploy path.
 """
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path: str, input_spec=None, opset_version: int = 9,
+def export(layer, path: str, input_spec=None, opset_version: int = 18,
            **configs):
-    """reference: onnx/export.py export(layer, path, input_spec, ...)."""
-    from .. import jit
+    """reference: onnx/export.py export(layer, path, input_spec, ...).
+    Writes ``path`` + '.onnx' and returns the file path."""
+    import jax
+
+    from ..tensor import Tensor
+    from ..autograd.engine import no_grad
+    from .convert import jaxpr_to_model
 
     if input_spec is None:
         raise ValueError("paddle_tpu.onnx.export requires input_spec")
-    jit.save(layer, path, input_spec=input_spec)
-    raise NotImplementedError(
-        "direct ONNX graph emission is not implemented; the portable "
-        f"StableHLO program + params were written to {path}.* (jit.save "
-        "format — convertible with stablehlo->onnx tooling such as "
-        "onnx-mlir/IREE).")
+    if opset_version < 18:
+        # the converter emits axes-as-input reduce/squeeze forms, legal
+        # only from opset 18 — stamping an older opset would write a model
+        # every checker rejects
+        raise NotImplementedError(
+            f"opset_version={opset_version} is not supported: this exporter "
+            "emits opset>=18 op forms (ReduceMax/Squeeze with axes inputs)")
+
+    specs = input_spec if isinstance(input_spec, (list, tuple)) \
+        else [input_spec]
+    example = []
+    for s in specs:
+        if isinstance(s, Tensor):
+            example.append(np.asarray(s.numpy()))
+        else:  # InputSpec: None dims -> 1 for the trace
+            shape = [1 if d is None or int(d) < 0 else int(d)
+                     for d in s.shape]
+            example.append(np.zeros(shape, getattr(s, "dtype", "float32")))
+
+    # call through Layer.__call__ so forward-pre/post hooks run (weight_norm
+    # and spectral_norm recompute their weights in pre-hooks)
+    fwd = layer if callable(layer) else layer.forward
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+
+    def pure(*arrays):
+        with no_grad():
+            out = fwd(*[Tensor(a) for a in arrays])
+        leaves = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._value if isinstance(o, Tensor) else o
+                     for o in leaves)
+
+    try:
+        closed = jax.make_jaxpr(pure)(*example)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    names = [getattr(s, "name", None) or f"input_{i}"
+             for i, s in enumerate(specs)]
+    model = jaxpr_to_model(closed, names, example, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

@@ -1,0 +1,261 @@
+"""jaxpr → ONNX GraphProto conversion.
+
+Reference parity: python/paddle/onnx/export.py delegates to paddle2onnx,
+which walks the Program's OpDescs and maps each to ONNX nodes. The
+TPU-native counterpart walks the traced **jaxpr** (this framework's graph
+IR) and maps each primitive to ONNX ops — same architecture, different IR.
+
+Covered primitives: the inference surface of the model zoo (matmul/conv/
+pool/norm folds/elementwise/activations/reshape/transpose/reduce/softmax
+chains). Anything else raises NotImplementedError naming the primitive —
+a loud gap beats a silently wrong graph.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from . import wire
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "rsqrt": None, "abs": "Abs", "erf": "Erf",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(jax var) -> onnx name
+        self._uid = 0
+
+    def fresh(self, tag="t"):
+        self._uid += 1
+        return f"{tag}_{self._uid}"
+
+    def name_of(self, v):
+        if type(v).__name__ == "Literal":  # jax.core.Literal (path varies)
+            return self.const(np.asarray(v.val))
+        key = id(v)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    def const(self, arr: np.ndarray, name=None) -> str:
+        name = name or self.fresh("const")
+        self.initializers.append(wire.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op, inputs, n_out=1, attrs=()):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(wire.node_proto(op, inputs, outs,
+                                          name=self.fresh("n"),
+                                          attrs=list(attrs)))
+        return outs
+
+    # ------------------------------------------------------------ primitives
+    def convert_eqn(self, eqn):
+        prim = eqn.primitive.name
+        ins = [self.name_of(v) for v in eqn.invars]
+        outv = eqn.outvars
+
+        def bind(node_outs):
+            for v, o in zip(outv, node_outs):
+                self.names[id(v)] = o
+
+        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                    "custom_vjp_call", "custom_vjp_call_jaxpr",
+                    "remat", "checkpoint"):
+            inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+                or eqn.params.get("fun_jaxpr")
+            consts = getattr(inner, "consts", [])
+            inner = getattr(inner, "jaxpr", inner)
+            # bind the inner jaxpr's closed-over constants BEFORE walking it
+            for cv, cval in zip(inner.constvars, consts):
+                self.names[id(cv)] = self.const(np.asarray(cval))
+            for iv, name in zip(inner.invars, ins):
+                self.names[id(iv)] = name
+            self.convert_jaxpr(inner)
+            for ov, jv in zip(outv, inner.outvars):
+                self.names[id(ov)] = self.name_of(jv)
+            return
+
+        if prim in _SIMPLE and _SIMPLE[prim]:
+            bind(self.emit(_SIMPLE[prim], ins))
+        elif prim == "rsqrt":
+            (s,) = self.emit("Sqrt", ins)
+            bind(self.emit("Reciprocal", [s]))
+        elif prim == "integer_pow":
+            p = self.const(np.asarray(float(eqn.params["y"]), np.float32))
+            bind(self.emit("Pow", [ins[0], p]))
+        elif prim == "dot_general":
+            bind(self._dot_general(eqn, ins))
+        elif prim == "broadcast_in_dim":
+            bind(self._broadcast(eqn, ins))
+        elif prim == "reshape":
+            shape = self.const(np.asarray(eqn.params["new_sizes"], np.int64))
+            bind(self.emit("Reshape", [ins[0], shape]))
+        elif prim == "squeeze":
+            axes = self.const(np.asarray(eqn.params["dimensions"], np.int64))
+            bind(self.emit("Squeeze", [ins[0], axes]))
+        elif prim == "transpose":
+            bind(self.emit("Transpose", ins,
+                           attrs=[wire.attr_ints(
+                               "perm", eqn.params["permutation"])]))
+        elif prim == "convert_element_type":
+            to = wire.onnx_dtype(np.dtype(eqn.params["new_dtype"]))
+            bind(self.emit("Cast", ins, attrs=[wire.attr_int("to", to)]))
+        elif prim in ("reduce_sum", "reduce_max", "reduce_min",
+                      "reduce_mean", "reduce_prod"):
+            op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+                  "reduce_min": "ReduceMin", "reduce_mean": "ReduceMean",
+                  "reduce_prod": "ReduceProd"}[prim]
+            axes = self.const(np.asarray(eqn.params["axes"], np.int64))
+            bind(self.emit(op, [ins[0], axes],
+                           attrs=[wire.attr_int("keepdims", 0)]))
+        elif prim == "slice":
+            p = eqn.params
+            starts = self.const(np.asarray(p["start_indices"], np.int64))
+            ends = self.const(np.asarray(p["limit_indices"], np.int64))
+            axes = self.const(np.arange(len(p["start_indices"]),
+                                        dtype=np.int64))
+            strides = p.get("strides") or [1] * len(p["start_indices"])
+            steps = self.const(np.asarray(strides, np.int64))
+            bind(self.emit("Slice", [ins[0], starts, ends, axes, steps]))
+        elif prim == "pad":
+            p = eqn.params["padding_config"]
+            if any(int(interior) for _, _, interior in p):
+                raise NotImplementedError(
+                    "onnx export: interior (dilation) padding")
+            pads = self.const(np.asarray(
+                [lo for lo, _, _ in p] + [hi for _, hi, _ in p], np.int64))
+            bind(self.emit("Pad", [ins[0], pads, ins[1]],
+                           attrs=[wire.attr_str("mode", "constant")]))
+        elif prim == "clamp":
+            # clamp(min, x, max) -> Clip(x, min, max)
+            bind(self.emit("Clip", [ins[1], ins[0], ins[2]]))
+        elif prim == "conv_general_dilated":
+            bind(self._conv(eqn, ins))
+        elif prim == "reduce_window_max":
+            bind(self._maxpool(eqn, ins))
+        elif prim == "select_n":
+            # select_n(pred, false, true) -> Where(pred, true, false)
+            bind(self.emit("Where", [ins[0], ins[2], ins[1]]))
+        elif prim == "concatenate":
+            bind(self.emit("Concat", ins,
+                           attrs=[wire.attr_int("axis",
+                                                eqn.params["dimension"])]))
+        elif prim in ("gt", "lt", "ge", "le", "eq", "ne"):
+            op = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+                  "le": "LessOrEqual", "eq": "Equal", "ne": None}[prim]
+            if prim == "ne":
+                (e,) = self.emit("Equal", ins)
+                bind(self.emit("Not", [e]))
+            else:
+                bind(self.emit(op, ins))
+        else:
+            raise NotImplementedError(
+                f"onnx export: jaxpr primitive {prim!r} has no ONNX "
+                "mapping yet (file the model's trace for triage)")
+
+    def _dot_general(self, eqn, ins):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        la = eqn.invars[0].aval
+        ra = eqn.invars[1].aval
+        # standard matmul layouts (jnp.matmul / linear): contract last of
+        # lhs with second-to-last (or only) dim of rhs, no batch mixing
+        if (list(lb) == list(rb) == list(range(len(lb)))
+                and list(lc) == [la.ndim - 1]
+                and list(rc) == [max(len(rb), ra.ndim - 2)]):
+            return self.emit("MatMul", ins)
+        if la.ndim == 2 and ra.ndim == 2 and not lb:
+            l_in, r_in = ins
+            if list(lc) == [0]:  # lhs transposed
+                (l_in,) = self.emit("Transpose", [l_in],
+                                    attrs=[wire.attr_ints("perm", [1, 0])])
+            if list(rc) == [1]:  # rhs transposed (x @ W.T)
+                (r_in,) = self.emit("Transpose", [r_in],
+                                    attrs=[wire.attr_ints("perm", [1, 0])])
+            return self.emit("MatMul", [l_in, r_in])
+        raise NotImplementedError(
+            f"onnx export: dot_general layout {eqn.params['dimension_numbers']}")
+
+    def _broadcast(self, eqn, ins):
+        shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        in_aval = eqn.invars[0].aval
+        # reshape to insert singleton dims at the right axes, then Expand
+        mid = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            mid[dst] = in_aval.shape[src]
+        cur = ins[0]
+        if tuple(mid) != tuple(in_aval.shape):
+            s = self.const(np.asarray(mid, np.int64))
+            (cur,) = self.emit("Reshape", [cur, s])
+        tgt = self.const(np.asarray(shape, np.int64))
+        return self.emit("Expand", [cur, tgt])
+
+    def _conv(self, eqn, ins):
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
+            raise NotImplementedError("onnx export: conv layouts other than "
+                                      "NCHW are not mapped")
+        attrs = [
+            wire.attr_ints("strides", p["window_strides"]),
+            wire.attr_ints("dilations", p["rhs_dilation"]),
+            wire.attr_int("group", p["feature_group_count"]),
+            wire.attr_ints("pads", [pp for pair in zip(*p["padding"])
+                                    for pp in pair]),
+        ]
+        return self.emit("Conv", ins, attrs=attrs)
+
+    def _maxpool(self, eqn, ins):
+        p = eqn.params
+        wd = p["window_dimensions"]
+        ws = p["window_strides"]
+        pads = p["padding"]
+        attrs = [
+            wire.attr_ints("kernel_shape", wd[2:]),
+            wire.attr_ints("strides", ws[2:]),
+            wire.attr_ints("pads", [pp for pair in zip(*pads[2:])
+                                    for pp in pair]),
+        ]
+        return self.emit("MaxPool", ins, attrs=attrs)
+
+    # -------------------------------------------------------------- driver
+    def convert_jaxpr(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            self.convert_eqn(eqn)
+
+
+def jaxpr_to_model(closed_jaxpr, input_names, example_args,
+                   graph_name="paddle_tpu_graph", opset=18) -> bytes:
+    """ClosedJaxpr → serialized ONNX ModelProto bytes."""
+    conv = _Converter()
+    jaxpr = closed_jaxpr.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        conv.names[id(cv)] = conv.const(np.asarray(cval))
+    inputs = []
+    for v, name, arg in zip(jaxpr.invars, input_names, example_args):
+        conv.names[id(v)] = name
+        inputs.append(wire.value_info(name, np.asarray(arg).dtype,
+                                      np.asarray(arg).shape))
+    for eqn in jaxpr.eqns:
+        conv.convert_eqn(eqn)
+    outputs = []
+    for i, v in enumerate(jaxpr.outvars):
+        oname = conv.name_of(v)
+        aval = v.aval
+        outputs.append(wire.value_info(oname, np.dtype(aval.dtype),
+                                       aval.shape))
+    graph = wire.graph_proto(graph_name, conv.nodes, inputs, outputs,
+                             conv.initializers)
+    return wire.model_proto(graph, opset=opset)

@@ -125,3 +125,24 @@ def test_fused_adamw_kernel_matches_xla():
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 256), (256, 128), (256, 256)])
+def test_flash_block_config_matrix(bq, bk):
+    """Every block config the on-chip sweep (tools/bench_flash.py) exercises
+    must already be numerically right in interpret mode."""
+    q = _rand((1, 256, 2, 32), 5)
+    k = _rand((1, 256, 2, 32), 6)
+    v = _rand((1, 256, 2, 32), 7)
+    scale = 1.0 / np.sqrt(32)
+    out = fa._flash_attention(q, k, v, True, scale, bq, bk)
+    ref = fa._ref_attention_bshd(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    # backward too: the sweep times fwd+bwd
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        fa._flash_attention(q, k, v, True, scale, bq, bk)
+        .astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+    for arr in g:
+        assert np.all(np.isfinite(np.asarray(arr, np.float32)))
