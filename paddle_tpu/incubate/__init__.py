@@ -17,10 +17,13 @@ from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .autotune import autotune_status, set_config  # noqa: F401
-from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LBFGS, DistributedFusedLamb, LookAhead, ModelAverage,
+)
 
 __all__ = ["autograd", "distributed", "asp", "nn", "optimizer",
-           "LookAhead", "ModelAverage", "LBFGS", "set_config",
+           "LookAhead", "ModelAverage", "LBFGS", "DistributedFusedLamb",
+           "set_config",
            "autotune_status", "softmax_mask_fuse",
            "softmax_mask_fuse_upper_triangle", "graph_send_recv",
            "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",

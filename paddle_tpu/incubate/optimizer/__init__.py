@@ -8,5 +8,7 @@ the slow/fast interpolation and window averaging stay on-device.
 from .lookahead import LookAhead  # noqa: F401
 from .modelaverage import ModelAverage  # noqa: F401
 from .lbfgs import LBFGS  # noqa: F401
+from .fused_lamb import DistributedFusedLamb  # noqa: F401
 
-__all__ = ["LookAhead", "ModelAverage", "LBFGS"]
+__all__ = ["LookAhead", "ModelAverage", "LBFGS",
+           "DistributedFusedLamb"]

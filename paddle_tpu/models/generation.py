@@ -1,0 +1,128 @@
+"""Autoregressive generation with KV caches — shared by the LLM zoo.
+
+Reference ecosystem parity: PaddleNLP's GenerationMixin.generate (the
+reference repo ships only ops; the LLM zoo is first-class here,
+models/__init__.py).
+
+TPU-native shape: ONE compiled prefill program (prompt length) and ONE
+compiled decode program reused for every step. The cache write position
+rides in as DATA (``lax.dynamic_update_slice`` with a tensor index), so
+there is no per-position recompilation; greedy (temperature=0) or
+temperature/top-k sampling runs inside the compiled step via
+``jax.random.categorical`` on a threaded PRNG key.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["GenerationMixin"]
+
+
+class GenerationMixin:
+    """Requires on the host class:
+    - ``_decode_trunk()`` → trunk module whose forward accepts
+      ``(ids, caches=..., cur_len=...)`` and returns (hidden, new_caches)
+    - ``logits(hidden)`` → [B, S, V]
+    - ``_cache_spec()`` → (num_layers, cached_heads, head_dim)
+    - ``config.max_position_embeddings``
+    """
+
+    @staticmethod
+    def _sample(logits_row, temperature, top_k, key):
+        """One sampling step, pure jnp: [B, V] logits -> [B] token ids."""
+        if temperature == 0.0:
+            return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
+        logits_row = logits_row / jnp.float32(max(temperature, 1e-6))
+        if top_k:
+            kth = jnp.sort(logits_row, axis=-1)[:, -int(top_k)][:, None]
+            logits_row = jnp.where(logits_row < kth, -1e30, logits_row)
+        return jax.random.categorical(key, logits_row,
+                                      axis=-1).astype(jnp.int32)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Returns [B, prompt+generated] token ids (generation stops early
+        when every row emitted ``eos_token_id``)."""
+        import numpy as np
+
+        from .. import jit
+        from ..autograd.engine import no_grad
+
+        cfg = self.config
+        trunk = self._decode_trunk()
+        n_layers, nh_c, hd = self._cache_spec()
+        ids = ensure_tensor(input_ids)
+        B, S0 = ids.shape
+        total = S0 + max_new_tokens
+        if total > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {S0} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+        was_training = self.training
+        self.eval()
+
+        def step_fn(tok, cur, key, *flat_caches):
+            caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
+                      for i in range(n_layers)]
+            with no_grad():
+                hidden, ncs = trunk(tok, caches=caches, cur_len=cur)
+                logits = self.logits(hidden)
+            last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
+                            [ensure_tensor(logits)], name="last_logits")
+            nxt = apply_op(
+                lambda lv, kv: self._sample(lv, temperature, top_k, kv),
+                [last, ensure_tensor(key)], name="sample")
+            flat = [t for c in ncs for t in c]
+            return (nxt, *flat)
+
+        # compiled prefill/decode are cached on the model per signature:
+        # repeated generate() calls pay tracing+compilation once
+        gen_key = (B, S0, total, float(temperature), int(top_k))
+        cache_map = getattr(self, "_generation_programs", None)
+        if cache_map is None:
+            cache_map = self._generation_programs = {}
+        progs = cache_map.get(gen_key)
+        if progs is None:
+            progs = (jit.StaticFunction(step_fn, observe=[self],
+                                        warmup=False, dy2static=False),
+                     jit.StaticFunction(step_fn, observe=[self],
+                                        warmup=False, dy2static=False))
+            cache_map[gen_key] = progs
+        prefill, decode = progs
+
+        flat = [t for _ in range(n_layers)
+                for t in (Tensor(jnp.zeros((B, total, nh_c, hd),
+                                           jnp.float32)),
+                          Tensor(jnp.zeros((B, total, nh_c, hd),
+                                           jnp.float32)))]
+        rng_key = jax.random.PRNGKey(seed)
+        out = [np.asarray(ids.numpy())]
+
+        k0, rng_key = jax.random.split(rng_key)
+        res = prefill(ids, Tensor(jnp.zeros((), jnp.int32)), Tensor(k0),
+                      *flat)
+        nxt, flat = res[0], list(res[1:])
+        tokens = np.asarray(nxt.numpy()).reshape(B, 1)
+        out.append(tokens)
+
+        for step in range(1, max_new_tokens):
+            if eos_token_id is not None and np.all(tokens == eos_token_id):
+                break
+            k, rng_key = jax.random.split(rng_key)
+            res = decode(Tensor(jnp.asarray(tokens, jnp.int32)),
+                         Tensor(jnp.asarray(S0 + step - 1, jnp.int32)),
+                         Tensor(k), *flat)
+            nxt, flat = res[0], list(res[1:])
+            tokens = np.asarray(nxt.numpy()).reshape(B, 1)
+            out.append(tokens)
+
+        if was_training:
+            self.train()
+        return Tensor(jnp.asarray(np.concatenate(out, axis=1)))

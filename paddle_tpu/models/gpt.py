@@ -31,6 +31,7 @@ from ..nn import functional as F
 from ..distributed import topology
 from ..distributed.sharding_api import shard_tensor
 from ..ops._apply import apply_op, ensure_tensor
+from .generation import GenerationMixin
 from ..tensor import Tensor
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1_3b"]
@@ -131,7 +132,7 @@ class GPTAttention(nn.Layer):
                 h, h, weight_attr=nn.ParamAttr(initializer=_normal_init(proj_std)))
         self.attn_drop_p = config.attention_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cur_len=None):
         B, S, H = x.shape
         nh, hd = self.cfg.num_heads, self.head_dim
         qkv = self.qkv_proj(x)  # [B, S, 3H] (H possibly mp-sharded)
@@ -143,6 +144,43 @@ class GPTAttention(nn.Layer):
             return tuple(t.reshape(B, S, nh, hd) for t in (q, k, v_))
 
         q, k, v = apply_op(split_heads, [ensure_tensor(qkv)], name="split_heads")
+        if cache is not None:
+            # KV-cache decode path (generation): write this call's k/v at
+            # cur_len and attend over the whole buffer with a position mask.
+            # cur_len is a TENSOR so one compiled step serves every position.
+            k_buf, v_buf = cache
+            scale = 1.0 / math.sqrt(hd)
+
+            def cached_attn(qv, kv, vv, kb, vb, cl):
+                cl = cl.astype(jnp.int32).reshape(())
+                z = jnp.int32(0)
+                start = (z, cl, z, z)
+                kb = jax.lax.dynamic_update_slice(kb, kv.astype(kb.dtype),
+                                                  start)
+                vb = jax.lax.dynamic_update_slice(vb, vv.astype(vb.dtype),
+                                                  start)
+                L = kb.shape[1]
+                qh = jnp.swapaxes(qv, 1, 2)            # [B, nh, S, hd]
+                kh = jnp.swapaxes(kb, 1, 2)            # [B, nh, L, hd]
+                vh = jnp.swapaxes(vb, 1, 2)
+                s = jnp.einsum("bhqd,bhkd->bhqk", qh,
+                               kh.astype(qh.dtype)) * scale
+                rows = cl + jnp.arange(S)[:, None]     # absolute q positions
+                cols = jnp.arange(L)[None, :]
+                mask = cols <= rows                    # causal over buffer
+                s = jnp.where(mask[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(p.dtype))
+                return jnp.swapaxes(ctx, 1, 2), kb, vb
+
+            ctx, new_k, new_v = apply_op(
+                cached_attn,
+                [q, k, v, ensure_tensor(k_buf), ensure_tensor(v_buf),
+                 ensure_tensor(cur_len)],
+                name="cached_attention")
+            merged = apply_op(lambda t: t.reshape(B, S, nh * hd),
+                              [ensure_tensor(ctx)], name="merge_heads")
+            return self.out_proj(merged), (new_k, new_v)
         mesh = topology.get_mesh()
         if (self.cfg.sequence_parallel and mesh is not None
                 and "sep" in mesh.axis_names and mesh.shape["sep"] > 1
@@ -205,7 +243,13 @@ class GPTDecoderLayer(nn.Layer):
         self.mlp = GPTMLP(config)
         self.drop_p = config.hidden_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cur_len=None):
+        if cache is not None:
+            h, new_cache = self.attn(self.ln1(x), cache=cache,
+                                     cur_len=cur_len)
+            x = x + h
+            x = x + self.mlp(self.ln2(x))
+            return x, new_cache
         h = self.attn(self.ln1(x))
         if self.drop_p and self.training:
             h = F.dropout(h, self.drop_p)
@@ -271,9 +315,27 @@ class GPTModel(nn.Layer):
 
         return apply_op(fn, [ensure_tensor(x)], name="seq_parallel_constraint")
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cur_len=None):
         ids = ensure_tensor(input_ids)
         B, S = ids.shape
+        if caches is not None:
+            if self._pp > 1:
+                raise NotImplementedError(
+                    "KV-cache decode requires pp=1 (generation is a "
+                    "single-program path; pipeline decode is out of scope)")
+            # absolute positions: cur_len .. cur_len+S-1 (a tensor, so one
+            # compiled decode step serves every position)
+            position_ids = apply_op(
+                lambda cl: (jnp.arange(S, dtype=jnp.int32)[None, :]
+                            + cl.astype(jnp.int32)).repeat(B, axis=0),
+                [ensure_tensor(cur_len)], name="decode_positions")
+            x = self.embeddings(ids) + self.position_embeddings(position_ids)
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, nc = layer(x, cache=cache, cur_len=cur_len)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         if position_ids is None:
             pos_val = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
             position_ids = Tensor(pos_val, stop_gradient=True)
@@ -303,9 +365,10 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     """LM head on the trunk; weight-tied to the input embedding by default
     (one parameter cell — SharedLayerDesc semantics without the allreduce).
+    ``generate()`` comes from GenerationMixin (KV-cache decode).
     """
 
     def __init__(self, config: GPTConfig):
@@ -355,3 +418,14 @@ class GPTForCausalLM(nn.Layer):
             return logits, _math.mean(loss)
         loss = F.cross_entropy(flat_logits, flat_labels)
         return logits, loss
+
+    # ------------------------------------------------- generation hooks
+    def _decode_trunk(self):
+        if self.gpt._pp > 1:
+            raise NotImplementedError("generate requires pp=1")
+        return self.gpt
+
+    def _cache_spec(self):
+        cfg = self.config
+        return (cfg.num_layers, cfg.num_heads,
+                cfg.hidden_size // cfg.num_heads)

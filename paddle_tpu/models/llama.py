@@ -18,6 +18,7 @@ import math
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -26,6 +27,7 @@ from ..distributed import topology
 from ..nn import functional as F
 from ..ops._apply import ensure_tensor
 from ..tensor import Tensor
+from .generation import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny"]
 
@@ -151,7 +153,7 @@ class LlamaAttention(nn.Layer):
                                     weight_attr=nn.ParamAttr(
                                         initializer=_normal_init(proj_std)))
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cur_len=None):
         B, S, _ = x.shape
         cfg = self.cfg
         hd = self.head_dim
@@ -161,6 +163,61 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x)
         k = self.k_proj(x)
         v = self.v_proj(x)
+
+        if cache is not None:
+            # KV-cache decode: rope at ABSOLUTE positions (tables for the
+            # full buffer, sliced at cur_len), write k/v into the buffer,
+            # attend with a position mask. See models/generation.py.
+            k_buf, v_buf = cache
+            L = k_buf.shape[1]
+            scale = 1.0 / math.sqrt(hd)
+
+            def cached_attn(qv, kv, vv, kb, vb, cl):
+                cl = cl.astype(jnp.int32).reshape(())
+                z = jnp.int32(0)
+                nh_l = qv.shape[-1] // hd
+                nkv_l = kv.shape[-1] // hd
+                qh = qv.reshape(B, S, nh_l, hd)
+                kh = kv.reshape(B, S, nkv_l, hd)
+                vh = vv.reshape(B, S, nkv_l, hd)
+                cos_f, sin_f = _rope_tables(L, hd, cfg.rope_theta)
+                cos = jax.lax.dynamic_slice(cos_f, (cl, z),
+                                            (S, cos_f.shape[1]))
+                sin = jax.lax.dynamic_slice(sin_f, (cl, z),
+                                            (S, sin_f.shape[1]))
+                qh = _apply_rope(qh, cos, sin)
+                kh = _apply_rope(kh, cos, sin)
+                # cache stores PRE-repeat kv heads (nkv): repeating at read
+                # time keeps GQA's memory saving (the whole point of GQA)
+                kb = jax.lax.dynamic_update_slice(
+                    kb, kh.astype(kb.dtype), (z, cl, z, z))
+                vb = jax.lax.dynamic_update_slice(
+                    vb, vh.astype(vb.dtype), (z, cl, z, z))
+                kr, vr = kb, vb
+                if groups > 1:
+                    kr = jnp.repeat(kb, groups, axis=2)
+                    vr = jnp.repeat(vb, groups, axis=2)
+                qt = jnp.swapaxes(qh, 1, 2)
+                kt = jnp.swapaxes(kr, 1, 2)
+                vt = jnp.swapaxes(vr, 1, 2)
+                s = jnp.einsum("bhqd,bhkd->bhqk", qt,
+                               kt.astype(qt.dtype)) * scale
+                rows = cl + jnp.arange(S)[:, None]
+                cols = jnp.arange(L)[None, :]
+                s = jnp.where((cols <= rows)[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.swapaxes(
+                    jnp.einsum("bhqk,bhkd->bhqd", p, vt.astype(p.dtype)),
+                    1, 2)
+                return ctx.reshape(B, S, nh_l * hd), kb, vb
+
+            merged, new_k, new_v = apply_op(
+                cached_attn,
+                [ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+                 ensure_tensor(k_buf), ensure_tensor(v_buf),
+                 ensure_tensor(cur_len)],
+                name="llama_cached_attention")
+            return self.o_proj(merged), (new_k, new_v)
 
         def shape_rope_repeat(qv, kv, vv):
             # per-shard head counts (mp shards the head axis)
@@ -246,7 +303,12 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cur_len=None):
+        if cache is not None:
+            h, nc = self.self_attn(self.input_layernorm(x), cache=cache,
+                                   cur_len=cur_len)
+            x = x + h
+            return x + self.mlp(self.post_attention_layernorm(x)), nc
         x = x + self.self_attn(self.input_layernorm(x))
         return x + self.mlp(self.post_attention_layernorm(x))
 
@@ -292,8 +354,14 @@ class LlamaModel(nn.Layer):
         return apply_op(fn, [ensure_tensor(x)],
                         name="seq_parallel_constraint")
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, cur_len=None):
         x = self.embed_tokens(ensure_tensor(input_ids))
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, nc = layer(x, cache=cache, cur_len=cur_len)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         x = self._seq_parallel(x)
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute as _rc
@@ -306,7 +374,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -326,6 +394,15 @@ class LlamaForCausalLM(nn.Layer):
         return apply_op(lambda h, e: h @ e.T,
                         [ensure_tensor(hidden), ensure_tensor(w)],
                         name="tied_lm_head")
+
+    def _decode_trunk(self):
+        return self.llama
+
+    def _cache_spec(self):
+        cfg = self.config
+        # pre-repeat kv heads: GQA's memory saving applies to the cache too
+        return (cfg.num_layers, cfg.num_key_value_heads,
+                cfg.hidden_size // cfg.num_heads)
 
     def forward(self, input_ids, labels=None):
         hidden = self.llama(input_ids)

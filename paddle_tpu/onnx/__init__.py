@@ -41,12 +41,15 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
     specs = input_spec if isinstance(input_spec, (list, tuple)) \
         else [input_spec]
     example = []
+    declared_dims = []  # per input: dims with None preserved (-> dim_param)
     for s in specs:
         if isinstance(s, Tensor):
             example.append(np.asarray(s.numpy()))
-        else:  # InputSpec: None dims -> 1 for the trace
-            shape = [1 if d is None or int(d) < 0 else int(d)
-                     for d in s.shape]
+            declared_dims.append(list(example[-1].shape))
+        else:  # InputSpec: None dims -> 1 for the trace, dim_param in the model
+            declared_dims.append([None if (d is None or int(d) < 0) else
+                                  int(d) for d in s.shape])
+            shape = [1 if d is None else d for d in declared_dims[-1]]
             example.append(np.zeros(shape, getattr(s, "dtype", "float32")))
 
     # call through Layer.__call__ so forward-pre/post hooks run (weight_norm
@@ -71,7 +74,8 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
 
     names = [getattr(s, "name", None) or f"input_{i}"
              for i, s in enumerate(specs)]
-    model = jaxpr_to_model(closed, names, example, opset=opset_version)
+    model = jaxpr_to_model(closed, names, example, opset=opset_version,
+                           input_dims=declared_dims)
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     with open(out_path, "wb") as f:
         f.write(model)

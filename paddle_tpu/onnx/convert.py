@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import wire
@@ -146,7 +147,12 @@ class _Converter:
         elif prim == "reduce_window_max":
             bind(self._maxpool(eqn, ins))
         elif prim == "select_n":
-            # select_n(pred, false, true) -> Where(pred, true, false)
+            # select_n(pred, false, true) -> Where(pred, true, false);
+            # only the 2-case boolean form maps
+            if len(ins) != 3 or eqn.invars[0].aval.dtype != jnp.bool_:
+                raise NotImplementedError(
+                    "onnx export: select_n with an integer selector or "
+                    f"{len(ins) - 1} cases has no Where mapping")
             bind(self.emit("Where", [ins[0], ins[2], ins[1]]))
         elif prim == "concatenate":
             bind(self.emit("Concat", ins,
@@ -205,9 +211,15 @@ class _Converter:
     def _conv(self, eqn, ins):
         p = eqn.params
         dn = p["dimension_numbers"]
-        if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
+        if dn.lhs_spec != tuple(range(len(dn.lhs_spec))) \
+                or dn.rhs_spec != tuple(range(len(dn.rhs_spec))) \
+                or dn.out_spec != tuple(range(len(dn.out_spec))):
             raise NotImplementedError("onnx export: conv layouts other than "
-                                      "NCHW are not mapped")
+                                      "NCHW/OIHW are not mapped")
+        if any(int(d) != 1 for d in p.get("lhs_dilation", ())):
+            raise NotImplementedError(
+                "onnx export: input-dilated (transposed) conv is not mapped "
+                "to ONNX Conv — use ConvTranspose support when added")
         attrs = [
             wire.attr_ints("strides", p["window_strides"]),
             wire.attr_ints("dilations", p["rhs_dilation"]),
@@ -237,17 +249,20 @@ class _Converter:
 
 
 def jaxpr_to_model(closed_jaxpr, input_names, example_args,
-                   graph_name="paddle_tpu_graph", opset=18) -> bytes:
+                   graph_name="paddle_tpu_graph", opset=18,
+                   input_dims=None) -> bytes:
     """ClosedJaxpr → serialized ONNX ModelProto bytes."""
     conv = _Converter()
     jaxpr = closed_jaxpr.jaxpr
     for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
         conv.names[id(cv)] = conv.const(np.asarray(cval))
     inputs = []
-    for v, name, arg in zip(jaxpr.invars, input_names, example_args):
+    if input_dims is None:
+        input_dims = [np.asarray(a).shape for a in example_args]
+    for v, name, arg, dims in zip(jaxpr.invars, input_names, example_args,
+                                  input_dims):
         conv.names[id(v)] = name
-        inputs.append(wire.value_info(name, np.asarray(arg).dtype,
-                                      np.asarray(arg).shape))
+        inputs.append(wire.value_info(name, np.asarray(arg).dtype, dims))
     for eqn in jaxpr.eqns:
         conv.convert_eqn(eqn)
     outputs = []

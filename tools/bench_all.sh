@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# One-command TPU bench battery — run the moment the tunnel is healthy.
+# Persists every result to BENCH_NOTES_r03.json (each tool appends).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== gpt ladder (proven + levers) ==="
+python bench.py --model gpt
+
+echo "=== bert-base ==="
+python bench.py --model bert
+
+echo "=== resnet50 ==="
+python bench.py --model resnet50
+
+echo "=== flash-attention A/B + block sweep ==="
+python tools/bench_flash.py
+
+echo "=== fused AdamW A/B ==="
+python tools/bench_adamw.py
+
+echo "=== eager dispatch (TPU) ==="
+python tools/bench_eager.py
+
+echo "done — see BENCH_NOTES_r03.json"
