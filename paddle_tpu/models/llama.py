@@ -59,6 +59,11 @@ class LlamaConfig:
     sequence_parallel: bool = False
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # chunked linear+CE (ops/fused_loss.py): never materializes the
+    # [B·S, V] logits; forward(labels=...) returns (None, loss).
+    # mp==1 only — under tensor parallelism the vocab shard math belongs to
+    # ParallelCrossEntropy; forward warns and uses the dense path there.
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -406,6 +411,32 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
 
     def forward(self, input_ids, labels=None):
         hidden = self.llama(input_ids)
+        if labels is not None and self.config.fused_loss:
+            if _mesh_dim("mp") > 1:
+                import warnings
+
+                warnings.warn(
+                    "LlamaConfig.fused_loss is mp==1 only (vocab-sharded "
+                    "loss is ParallelCrossEntropy's job); using the dense "
+                    "path — expect the [B·S, V] logits memory peak",
+                    stacklevel=2)
+            else:
+                from ..ops.fused_loss import fused_linear_cross_entropy
+
+                w = self.lm_head.weight if self.lm_head is not None \
+                    else self.llama.embed_tokens.weight
+                H = self.config.hidden_size
+                # lm_head.weight is [H, V] (Linear layout); fused CE wants
+                # [V, H]; the tied embedding is [V, H] already
+                needs_t = self.lm_head is not None
+                loss = apply_op(
+                    lambda h, wv, y: fused_linear_cross_entropy(
+                        h.reshape(-1, H), wv.T if needs_t else wv,
+                        y.reshape(-1)),
+                    [ensure_tensor(hidden), ensure_tensor(w),
+                     ensure_tensor(labels)],
+                    name="fused_linear_cross_entropy")
+                return None, loss
         logits = self.logits(hidden)
         if labels is None:
             return logits

@@ -114,3 +114,53 @@ def test_gpt_fused_loss_matches_dense_path():
     # trains: backward reaches the tied embedding
     fused_loss.backward()
     assert fused.gpt.embeddings.weight.grad is not None
+
+
+def test_llama_fused_loss_matches_dense_path():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    kw = dict(vocab_size=384, hidden_size=64, num_layers=2, num_heads=4,
+              num_key_value_heads=2, max_position_embeddings=32)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 384, (2, 32))
+    labels = np.roll(ids, -1, axis=1)
+
+    pt.seed(0)
+    dense = LlamaForCausalLM(LlamaConfig(**kw))
+    _, dense_loss = dense(pt.to_tensor(ids), labels=pt.to_tensor(labels))
+
+    pt.seed(0)
+    fused = LlamaForCausalLM(LlamaConfig(fused_loss=True, **kw))
+    none_logits, fused_loss = fused(pt.to_tensor(ids),
+                                    labels=pt.to_tensor(labels))
+    assert none_logits is None
+    np.testing.assert_allclose(float(np.asarray(fused_loss.numpy())),
+                               float(np.asarray(dense_loss.numpy())),
+                               rtol=1e-4)
+    fused_loss.backward()
+    assert fused.lm_head.weight.grad is not None
+
+
+def test_llama_fused_loss_tied_embeddings():
+    """The tied-embedding branch uses the [V, H] table without transpose."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    kw = dict(vocab_size=384, hidden_size=64, num_layers=2, num_heads=4,
+              num_key_value_heads=2, max_position_embeddings=32,
+              tie_word_embeddings=True)
+    rng = np.random.RandomState(6)
+    ids = rng.randint(0, 384, (2, 32))
+    labels = np.roll(ids, -1, axis=1)
+
+    pt.seed(0)
+    dense = LlamaForCausalLM(LlamaConfig(**kw))
+    _, dense_loss = dense(pt.to_tensor(ids), labels=pt.to_tensor(labels))
+
+    pt.seed(0)
+    fused = LlamaForCausalLM(LlamaConfig(fused_loss=True, **kw))
+    _, fused_loss = fused(pt.to_tensor(ids), labels=pt.to_tensor(labels))
+    np.testing.assert_allclose(float(np.asarray(fused_loss.numpy())),
+                               float(np.asarray(dense_loss.numpy())),
+                               rtol=1e-4)
+    fused_loss.backward()
+    assert fused.llama.embed_tokens.weight.grad is not None
