@@ -113,6 +113,12 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
         fn = fn.dygraph_function
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (list of InputSpec/Tensor)")
+    # the export trace must see the same dy2static rewrite to_static applies:
+    # a forward with Python tensor control flow otherwise fails at trace time
+    if os.environ.get("PADDLE_TPU_DY2STATIC") != "0":
+        from .dy2static import ast_transform
+
+        fn = ast_transform(fn)
 
     state = layer.state_dict() if isinstance(layer, Layer) else {}
     names = list(state.keys())

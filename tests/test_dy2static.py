@@ -174,3 +174,49 @@ def test_late_bound_globals_and_monkeypatch_work():
             np.asarray(g(t(np.array([2.0], np.float32))).numpy()), [200.0])
     finally:
         mod._helper_defined_later = orig
+
+
+class _GatedLayer(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = paddle.nn.Linear(4, 4)
+        self.b = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.sum() > 0:            # tensor branch -> lax.cond via dy2static
+            y = self.a(x)
+        else:
+            y = self.b(x)
+        return y
+
+
+def test_layer_method_with_tensor_branch_compiles_and_saves(tmp_path):
+    """A Layer.forward with Python tensor control flow compiles under
+    to_static AND round-trips through jit.save/load — via the LAYER save
+    path so the parameter serialization (.pdiparams → TranslatedLayer
+    Parameters) is exercised, not constant-folded weights."""
+    paddle.seed(0)
+    m = _GatedLayer()
+    sf = jit.to_static(m.forward, warmup=False)
+    x = t(np.ones((2, 4), np.float32))
+    neg = t(-np.ones((2, 4), np.float32))
+    out_pos = np.asarray(sf(x).numpy())
+    out_neg = np.asarray(sf(neg).numpy())
+    assert len(sf._cache) == 1  # both branches in one program
+    assert not np.allclose(out_pos, out_neg)
+
+    jit.save(m, str(tmp_path / "gated"),
+             input_spec=[jit.InputSpec((2, 4), "float32")])
+    loaded = jit.load(str(tmp_path / "gated"))
+
+    def _val(r):
+        return np.asarray(r.numpy() if hasattr(r, "numpy") else r)
+
+    np.testing.assert_allclose(_val(loaded(x)), out_pos, rtol=1e-5)
+    np.testing.assert_allclose(_val(loaded(neg)), out_neg, rtol=1e-5)
+    # the Layer path serialized real parameters
+    import os
+
+    assert any(f.endswith(".pdiparams") and
+               os.path.getsize(os.path.join(tmp_path, f)) > 100
+               for f in os.listdir(tmp_path))
