@@ -366,7 +366,7 @@ def bench_resnet50(dev, small):
             * B * (H / 224.0) ** 2
     achieved = flops_per_step * (1.0 / dt) / 1e12
     _emit({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": f"{name}_images_per_sec_per_chip",
         "value": round(imgs_per_s, 1),
         "unit": "imgs/s",
         "vs_baseline": 1.0,

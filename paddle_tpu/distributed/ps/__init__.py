@@ -17,8 +17,10 @@ from .table import (  # noqa: F401
 )
 from .service import PSClient, PSServer  # noqa: F401
 from .layers import SparseEmbedding  # noqa: F401
+from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
 
 __all__ = [
     "TableConfig", "SparseTable", "DenseTable", "SSDSparseTable",
     "PSServer", "PSClient", "SparseEmbedding",
+    "AsyncCommunicator", "GeoCommunicator",
 ]

@@ -1,0 +1,208 @@
+"""Trainer-side communicators: async grad merging and geo-SGD deltas.
+
+Reference parity: ``Communicator`` / ``AsyncCommunicator`` /
+``GeoCommunicator`` (``paddle/fluid/distributed/ps/service/communicator/
+communicator.h`` — grad send queues, merge-by-key, geo delta push).
+Redesigned for this framework: instead of the reference's brpc send
+queues and dense-var batching, a background flush thread drains a
+host-side accumulation buffer into the existing :class:`PSClient`
+verbs; the TPU-side dense model never blocks on the push.
+
+Two modes, matching the reference's ``sync/async/geo``:
+
+- :class:`AsyncCommunicator` — trainers accumulate sparse/dense grads
+  locally, merge by key (sum), and a background thread flushes them to
+  the servers every ``send_interval_s`` (or every ``send_steps`` steps).
+  The server applies its fused optimizer on arrival. This is the
+  reference's async mode: stale-but-cheap, no barrier between trainers.
+- :class:`GeoCommunicator` — trainers train on a *local* copy of dense
+  params with a local optimizer; every ``send_steps`` steps the trainer
+  pushes ``delta = local - base`` to the server (server adds it
+  atomically) and pulls the merged global value back, absorbing other
+  trainers' progress. This is geo-SGD (the reference's geo mode for
+  cross-DC training).
+
+Sync mode needs no communicator object: call ``PSClient.push_*``
+directly in step (that is the default ``SparseEmbedding`` path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .service import PSClient
+
+__all__ = ["AsyncCommunicator", "GeoCommunicator"]
+
+
+class AsyncCommunicator:
+    """Merge-by-key gradient accumulator with a background flush thread.
+
+    Usage: route ``SparseEmbedding`` pushes through
+    :meth:`push_sparse_async` (or call it from a grad hook), and call
+    :meth:`stop` (or use as a context manager) to drain on exit.
+    """
+
+    def __init__(self, client: PSClient, send_steps: int = 4,
+                 send_interval_s: float = 0.5):
+        self._client = client
+        self._send_steps = max(1, int(send_steps))
+        self._interval = float(send_interval_s)
+        self._lock = threading.Lock()
+        # table_id -> {key -> accumulated grad row}
+        self._sparse_acc: Dict[int, Dict[int, np.ndarray]] = {}
+        # table_id -> accumulated dense grad
+        self._dense_acc: Dict[int, np.ndarray] = {}
+        self._pending_steps = 0
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ps-async-communicator")
+        self._thread.start()
+
+    # -- trainer-facing -----------------------------------------------------
+    def push_sparse_async(self, table_id: int, keys: np.ndarray,
+                          grads: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        if len(grads) != keys.size:
+            raise ValueError(f"push_sparse_async: {keys.size} keys but "
+                             f"{len(grads)} grad rows")
+        with self._lock:
+            acc = self._sparse_acc.setdefault(table_id, {})
+            for k, g in zip(keys.tolist(), grads):
+                prev = acc.get(k)
+                acc[k] = g.copy() if prev is None else prev + g
+            self._note_step_locked()
+
+    def push_dense_async(self, table_id: int, grad: np.ndarray) -> None:
+        grad = np.ascontiguousarray(grad, dtype=np.float32).ravel()
+        with self._lock:
+            prev = self._dense_acc.get(table_id)
+            self._dense_acc[table_id] = (grad.copy() if prev is None
+                                         else prev + grad)
+            self._note_step_locked()
+
+    def _note_step_locked(self) -> None:
+        self._pending_steps += 1
+        if self._pending_steps >= self._send_steps:
+            self._wake.set()
+
+    # -- flush machinery ----------------------------------------------------
+    def _drain(self) -> Tuple[list, list]:
+        with self._lock:
+            sparse = [(tid, acc) for tid, acc in self._sparse_acc.items()
+                      if acc]
+            dense = list(self._dense_acc.items())
+            self._sparse_acc = {}
+            self._dense_acc = {}
+            self._pending_steps = 0
+        return sparse, dense
+
+    def flush(self) -> None:
+        """Synchronously send everything accumulated so far. On a mid-flush
+        failure the unsent portion is re-merged into the accumulators (new
+        grads that arrived meanwhile sum with it), then the error raises."""
+        sparse, dense = self._drain()
+        try:
+            while sparse:
+                tid, acc = sparse[0]
+                keys = np.fromiter(acc.keys(), dtype=np.uint64,
+                                   count=len(acc))
+                grads = np.stack([acc[k] for k in keys.tolist()])
+                self._client.push_sparse(tid, keys, grads)
+                sparse.pop(0)
+            while dense:
+                tid, grad = dense[0]
+                self._client.push_dense(tid, grad)
+                dense.pop(0)
+        except Exception:
+            with self._lock:
+                for tid, acc in sparse:
+                    live = self._sparse_acc.setdefault(tid, {})
+                    for k, g in acc.items():
+                        prev = live.get(k)
+                        live[k] = g if prev is None else prev + g
+                for tid, grad in dense:
+                    prev = self._dense_acc.get(tid)
+                    self._dense_acc[tid] = (grad if prev is None
+                                            else prev + grad)
+            raise
+
+    def _loop(self) -> None:
+        import logging
+        while not self._stop_evt.is_set():
+            self._wake.wait(timeout=self._interval)
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception:
+                if self._stop_evt.is_set():
+                    break
+                # transient server errors must not kill the flush thread:
+                # grads were re-queued by flush(); retry next interval
+                logging.getLogger(__name__).warning(
+                    "async PS flush failed; grads re-queued for retry",
+                    exc_info=True)
+
+    def stop(self) -> None:
+        """Drain remaining grads and join the flush thread."""
+        self._stop_evt.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+        self.flush()
+
+    def __enter__(self) -> "AsyncCommunicator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class GeoCommunicator:
+    """Geo-SGD delta trainer for dense tables.
+
+    The trainer registers a dense table, trains on :attr:`local` (a numpy
+    view it owns — apply any local optimizer to it), and calls
+    :meth:`step`. Every ``send_steps`` steps the communicator pushes the
+    local delta and pulls the merged global value; between syncs training
+    is fully local (zero network traffic), which is the point of geo.
+    """
+
+    def __init__(self, client: PSClient, send_steps: int = 10):
+        self._client = client
+        self._send_steps = max(1, int(send_steps))
+        self._steps: Dict[int, int] = {}
+        self._base: Dict[int, np.ndarray] = {}
+        self.local: Dict[int, np.ndarray] = {}
+
+    def register_dense(self, table_id: int, init: np.ndarray) -> np.ndarray:
+        """Create (or join) the server table; returns the local copy."""
+        init = np.ascontiguousarray(init, dtype=np.float32).ravel()
+        self._client.create_dense_table(table_id, init.size, init=init)
+        server_val = self._client.pull_dense(table_id)
+        self._base[table_id] = server_val.copy()
+        self.local[table_id] = server_val.copy()
+        self._steps[table_id] = 0
+        return self.local[table_id]
+
+    def step(self, table_id: int) -> bool:
+        """Count a local train step; sync if the send window elapsed.
+        Returns True when a sync happened (local now holds merged value)."""
+        self._steps[table_id] += 1
+        if self._steps[table_id] < self._send_steps:
+            return False
+        self.sync(table_id)
+        return True
+
+    def sync(self, table_id: int) -> None:
+        delta = self.local[table_id] - self._base[table_id]
+        merged = self._client.geo_push_dense(table_id, delta)
+        self._base[table_id] = merged.copy()
+        # in place: the array register_dense() handed out stays the live
+        # trainable view — rebinding would silently orphan the caller's ref
+        self.local[table_id][:] = merged
+        self._steps[table_id] = 0

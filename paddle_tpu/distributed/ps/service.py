@@ -44,6 +44,9 @@ class PSServer:
         # node's fabric IP explicitly
         self._tables_sparse: Dict[int, SparseTable] = {}
         self._tables_dense: Dict[int, DenseTable] = {}
+        # geo deltas are read-modify-write on the dense block; handler
+        # threads must serialize them (native push/pull lock per-call only)
+        self._geo_lock = threading.Lock()
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -58,15 +61,18 @@ class PSServer:
     def _dispatch(self, op: str, args: tuple):
         if op == "create_sparse":
             tid, cfg = args
-            self._tables_sparse.setdefault(tid, SparseTable(cfg))
+            with self._geo_lock:  # create-or-join must be atomic across
+                if tid not in self._tables_sparse:  # handler threads
+                    self._tables_sparse[tid] = SparseTable(cfg)
             return None
         if op == "create_dense":
             tid, size, cfg, init = args
-            if tid not in self._tables_dense:
-                t = DenseTable(size, cfg)
-                if init is not None:
-                    t.set(init)
-                self._tables_dense[tid] = t
+            with self._geo_lock:
+                if tid not in self._tables_dense:
+                    t = DenseTable(size, cfg)
+                    if init is not None:
+                        t.set(init)
+                    self._tables_dense[tid] = t
             return None
         if op == "pull_sparse":
             tid, keys = args
@@ -82,6 +88,16 @@ class PSServer:
             tid, grad = args
             self._tables_dense[tid].push(grad)
             return None
+        if op == "geo_push_dense":
+            # geo-SGD: add the trainer's local delta and return the merged
+            # global value in one atomic round trip (reference:
+            # communicator.h GeoCommunicator's SendDense/RecvDense pair)
+            tid, delta = args
+            with self._geo_lock:
+                t = self._tables_dense[tid]
+                merged = t.pull() + np.asarray(delta, dtype=np.float32)
+                t.set(merged)
+            return merged
         if op == "set_dense":
             tid, vals = args
             self._tables_dense[tid].set(vals)
@@ -232,6 +248,11 @@ class PSClient:
 
     def push_dense(self, table_id: int, grad: np.ndarray) -> None:
         self._call(table_id % self.num_servers, "push_dense", table_id, grad)
+
+    def geo_push_dense(self, table_id: int, delta: np.ndarray) -> np.ndarray:
+        """Add a geo delta server-side; returns the merged global value."""
+        return self._call(table_id % self.num_servers, "geo_push_dense",
+                          table_id, np.ascontiguousarray(delta, np.float32))
 
     def set_dense(self, table_id: int, values: np.ndarray) -> None:
         self._call(table_id % self.num_servers, "set_dense", table_id, values)

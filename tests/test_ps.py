@@ -279,3 +279,105 @@ def test_ssd_table_rejects_mismatched_reopen(tmp_path):
     t2 = SSDSparseTable(TableConfig(dim=4, optimizer="sgd"), path)
     assert len(t2) == 2
     t2.close()
+
+
+# ---------------------------------------------------- communicators (geo)
+
+
+def test_async_communicator_merges_and_flushes(two_servers):
+    from paddle_tpu.distributed.ps import AsyncCommunicator
+
+    client = two_servers
+    cfg = TableConfig(dim=2, optimizer="sgd", learning_rate=1.0,
+                      init_range=0.0)
+    client.create_sparse_table(20, cfg)
+    # huge interval + huge send_steps: nothing flushes until stop()
+    comm = AsyncCommunicator(client, send_steps=1000, send_interval_s=60.0)
+    keys = np.array([7, 8], np.uint64)
+    comm.push_sparse_async(20, keys, np.ones((2, 2), np.float32))
+    comm.push_sparse_async(20, keys, np.ones((2, 2), np.float32))
+    # accumulated but not yet sent
+    np.testing.assert_array_equal(client.pull_sparse(20, keys), 0.0)
+    comm.stop()
+    # merged grad of 2.0 applied once (sgd lr=1 -> w = -2)
+    np.testing.assert_allclose(client.pull_sparse(20, keys), -2.0)
+
+
+def test_async_communicator_step_trigger(two_servers):
+    import time
+    from paddle_tpu.distributed.ps import AsyncCommunicator
+
+    client = two_servers
+    client.create_dense_table(21, 3, TableConfig(optimizer="sgd",
+                                                 learning_rate=1.0),
+                              init=np.zeros(3, np.float32))
+    comm = AsyncCommunicator(client, send_steps=2, send_interval_s=60.0)
+    comm.push_dense_async(21, np.ones(3, np.float32))
+    comm.push_dense_async(21, np.ones(3, np.float32))  # hits send_steps
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if np.allclose(client.pull_dense(21), -2.0):
+            break
+        time.sleep(0.02)
+    np.testing.assert_allclose(client.pull_dense(21), -2.0)
+    comm.stop()
+
+
+def test_geo_communicator_two_trainers_converge(two_servers):
+    """Two geo trainers train local copies; deltas merge on the server and
+    each trainer absorbs the other's progress at sync (geo-SGD semantics:
+    final value reflects BOTH trainers' updates)."""
+    from paddle_tpu.distributed.ps import GeoCommunicator
+
+    client = two_servers
+    init = np.zeros(4, np.float32)
+    a = GeoCommunicator(client, send_steps=5)
+    b = GeoCommunicator(client, send_steps=5)
+    a.register_dense(30, init)
+    b.register_dense(30, init)
+
+    # trainer a adds +0.1/step, trainer b adds -0.02/step, 10 steps each
+    for _ in range(10):
+        a.local[30] += 0.1
+        a.step(30)
+    for _ in range(10):
+        b.local[30] += -0.02
+        b.step(30)
+
+    a.sync(30)
+    b.sync(30)
+    want = 10 * 0.1 + 10 * -0.02
+    np.testing.assert_allclose(client.pull_dense(30), want, atol=1e-6)
+    np.testing.assert_allclose(a.local[30], want, atol=1e-6)
+    np.testing.assert_allclose(b.local[30], want, atol=1e-6)
+
+
+def test_geo_communicator_local_steps_do_not_touch_server(two_servers):
+    from paddle_tpu.distributed.ps import GeoCommunicator
+
+    client = two_servers
+    g = GeoCommunicator(client, send_steps=100)
+    g.register_dense(31, np.zeros(2, np.float32))
+    for _ in range(50):
+        g.local[31] += 1.0
+        assert not g.step(31)
+    np.testing.assert_array_equal(client.pull_dense(31), 0.0)  # no traffic yet
+    g.sync(31)
+    np.testing.assert_allclose(client.pull_dense(31), 50.0)
+
+
+def test_geo_communicator_handle_stays_live_across_sync(two_servers):
+    """register_dense() returns the trainable view; it must remain the live
+    array after sync() (regression: rebinding orphaned the caller's ref)."""
+    from paddle_tpu.distributed.ps import GeoCommunicator
+
+    client = two_servers
+    g = GeoCommunicator(client, send_steps=2)
+    w = g.register_dense(32, np.zeros(3, np.float32))
+    for _ in range(2):
+        w += 1.0
+        g.step(32)          # first sync happens here
+    w += 1.0                # training CONTINUES on the original handle
+    w += 1.0
+    g.sync(32)
+    np.testing.assert_allclose(client.pull_dense(32), 4.0, atol=1e-6)
