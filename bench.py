@@ -382,7 +382,117 @@ def bench_resnet50(dev, small):
     })
 
 
-_MODELS = {"gpt": bench_gpt, "bert": bench_bert, "resnet50": bench_resnet50}
+# ----------------------------------------------------------------- Llama
+
+def bench_llama(dev, small):
+    """Llama-family single-chip training step (BASELINE.md config 4's
+    family at a size one v5e chip holds: ~0.76B params + AdamW fp32
+    state ~= 10.6 GB, headroom for activations at B8 S1024)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tiny
+
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small:
+        cfg = llama_tiny(recompute=False, fused_loss=True)
+        B = int(os.environ.get("BENCH_BATCH", 2))
+        S = int(os.environ.get("BENCH_SEQ", 128))
+        steps = int(os.environ.get("BENCH_STEPS", 3))
+    else:
+        S = int(os.environ.get("BENCH_SEQ", 1024))
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, num_layers=12,
+                          num_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=max(S, 1024),
+                          recompute=os.environ.get("BENCH_RECOMPUTE") == "1",
+                          fused_loss=os.environ.get("BENCH_FUSED_CE", "1")
+                          == "1")
+        B = int(os.environ.get("BENCH_BATCH", 8))
+        steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    _log(f"llama config: h{cfg.hidden_size} l{cfg.num_layers} B{B} S{S} "
+         f"steps={steps} recompute={cfg.recompute} "
+         f"fused_loss={cfg.fused_loss} device={dev.platform}")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(ids, labels):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            _, loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = jit.StaticFunction(train_fn, observe=[model, opt], warmup=False)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)))
+    labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
+
+    dt, compile_s, loss = _time_steps(step, (ids, labels), steps)
+    tokens_per_s = B * S / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    achieved = 6 * n_params * tokens_per_s / 1e12
+    _emit({
+        "metric": "llama_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "config": f"llama-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}"
+                  f"-bf16" + ("-rc" if cfg.recompute else "")
+                  + ("-fce" if cfg.fused_loss else ""),
+        "params_m": round(n_params / 1e6, 1),
+        "loss": float(np.asarray(loss.numpy(), dtype="float32")),
+        "step_ms": round(1000 * dt, 1),
+        "compile_s": round(compile_s, 1),
+        "achieved_tflops_per_s": round(achieved, 2),
+        "mfu_vs_v5e_peak": _mfu(achieved, on_tpu),
+        "device": str(dev.platform),
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    })
+
+
+def bench_llama7b(dev, small):
+    """Llama-2 7B (BASELINE.md config 4). Needs >= 8 chips; joins the
+    real ladder when a pod slice is attached. On fewer devices it runs
+    the compile-only budget (tools/llama7b_budget.py) and emits the
+    staged row LOUDLY marked compile_only."""
+    import subprocess
+
+    import jax
+
+    n = len(jax.devices())
+    if n >= 8 and not small:
+        # real 8-chip run: ZeRO-3 + recompute + fused CE, B8 S4096
+        os.environ.setdefault("BENCH_BATCH", "8")
+        os.environ.setdefault("BENCH_SEQ", "4096")
+        os.environ.setdefault("BENCH_RECOMPUTE", "1")
+        raise NotImplementedError(
+            "llama7b 8-chip bench: attach a pod slice and wire the mesh "
+            "config here (tools/llama7b_budget.py has the exact recipe)")
+    _log(f"llama7b: {n} device(s) < 8 — running compile-only budget")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "llama7b_budget.py")
+    args = [sys.executable, tool, "--no-write"]
+    if small:
+        args.append("--smoke")
+    r = subprocess.run(args, capture_output=True, text=True, timeout=7200)
+    line = next((ln for ln in reversed(r.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if r.returncode not in (0, 1) or line is None:
+        raise RuntimeError(f"budget tool failed rc={r.returncode}: "
+                           f"{r.stderr[-500:]}")
+    rec = json.loads(line)
+    rec.update({"compile_only": True, "device": str(dev.platform),
+                "vs_baseline": 1.0,
+                "note": "7B needs an 8-chip slice; this certifies fit+compile"})
+    _emit(rec)
+
+
+_MODELS = {"gpt": bench_gpt, "bert": bench_bert, "resnet50": bench_resnet50,
+           "llama": bench_llama, "llama7b": bench_llama7b}
 
 
 def _run_ladder(model: str) -> bool:

@@ -430,6 +430,39 @@ class StaticFunction:
             cost = cost[0] if cost else {}
         return dict(cost) if cost else {}
 
+    def lower(self, *args, **kwargs):
+        """AOT trace + lower WITHOUT executing (reference counterpart: the
+        build-program-only half of Executor.run; jax answer: jax.stages).
+        Returns the ``jax.stages.Lowered`` for this signature — call
+        ``.compile()`` on it for cost/memory analysis. No step runs, so no
+        gradient/activation buffers are ever allocated: this is the
+        memory-budget path for models too big to step on the host
+        (tools/llama7b_budget.py). State shardings (ZeRO/TP annotations on
+        the live params) are carried into the lowering."""
+        if not self._warmed_up:
+            if self._do_warmup:
+                # structural scan would miss the in-place-written cells the
+                # eager warmup records; silently downgrading state discovery
+                # would corrupt later real calls
+                raise RuntimeError(
+                    "StaticFunction.lower() before the first call requires "
+                    "warmup=False (structural state discovery); either call "
+                    "the function once first, or construct with "
+                    "warmup=False and list state in observe=")
+            self._setup_no_warmup()
+        arrays, meta, spec = _flatten_args((args, kwargs))
+        key = (
+            _spec_key(spec, arrays, meta),
+            tuple(l.training for l in self._layers),
+        )
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(spec, tuple(meta))
+            self._cache[key] = compiled
+        state_vals = _unalias([s.get() for s in self._slots], arrays)
+        lr_vals = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._opts]
+        return compiled.jitted.lower(state_vals, lr_vals, list(arrays))
+
     # -- paddle API surface --------------------------------------------------
     @property
     def dygraph_function(self):

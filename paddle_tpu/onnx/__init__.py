@@ -15,7 +15,74 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["export"]
+__all__ = ["export", "export_decode"]
+
+
+def export_decode(model, path: str, batch: int = 1, step_len: int = 1,
+                  opset_version: int = 18):
+    """Export a GenerationMixin model's greedy KV-cache DECODE STEP as an
+    ONNX graph: ``(tokens, cur_len, k_0, v_0, ...) -> (next_token,
+    new_k_0, new_v_0, ...)`` — the standard past-key-values serving shape
+    (the host loops tokens; each step is one graph run, mirroring how
+    ``generate()`` drives one compiled XLA decode program,
+    models/generation.py:115).
+
+    Reference counterpart: paddle2onnx's decoder export with
+    past_key_values I/O. Sampling is greedy (argmax) — temperature/top-k
+    belong to the serving host.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..tensor import Tensor
+    from ..autograd.engine import no_grad
+    from ..ops._apply import apply_op, ensure_tensor
+
+    cfg = model.config
+    trunk = model._decode_trunk()
+    n_layers, nh_c, hd = model._cache_spec()
+    total = cfg.max_position_embeddings
+    was_training = model.training
+    model.eval()
+
+    def step(tok, cur, *flat_caches):
+        caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
+                  for i in range(n_layers)]
+        with no_grad():
+            hidden, ncs = trunk(tok, caches=caches, cur_len=cur)
+            logits = model.logits(hidden)
+        nxt = apply_op(
+            lambda lv: jnp.argmax(lv[:, -1, :].astype(jnp.float32),
+                                  axis=-1).astype(jnp.int32),
+            [ensure_tensor(logits)], name="greedy_next")
+        flat = [t for c in ncs for t in c]
+        return (nxt, *flat)
+
+    specs = [Tensor(np.zeros((batch, step_len), np.int64)),
+             Tensor(np.zeros((), np.int32))]
+    names = ["tokens", "cur_len"]
+    for i in range(n_layers):
+        for kv in ("k", "v"):
+            specs.append(Tensor(np.zeros((batch, total, nh_c, hd),
+                                         np.float32)))
+            names.append(f"past_{kv}_{i}")
+    try:
+        return export(_NamedInputs(step, names), path, input_spec=specs,
+                      opset_version=opset_version)
+    finally:
+        if was_training:
+            model.train()
+
+
+class _NamedInputs:
+    """Callable wrapper carrying input names for export()."""
+
+    def __init__(self, fn, names):
+        self._fn = fn
+        self.input_names = names
+
+    def __call__(self, *args):
+        return self._fn(*args)
 
 
 def export(layer, path: str, input_spec=None, opset_version: int = 18,
@@ -72,8 +139,9 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
         if was_training and hasattr(layer, "train"):
             layer.train()
 
-    names = [getattr(s, "name", None) or f"input_{i}"
-             for i, s in enumerate(specs)]
+    names = getattr(layer, "input_names", None) or [
+        getattr(s, "name", None) or f"input_{i}"
+        for i, s in enumerate(specs)]
     model = jaxpr_to_model(closed, names, example, opset=opset_version,
                            input_dims=declared_dims)
     out_path = path if path.endswith(".onnx") else path + ".onnx"

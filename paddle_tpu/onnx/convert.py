@@ -166,6 +166,67 @@ class _Converter:
                 bind(self.emit("Not", [e]))
             else:
                 bind(self.emit(op, ins))
+        elif prim in ("sin", "cos"):
+            bind(self.emit({"sin": "Sin", "cos": "Cos"}[prim], ins))
+        elif prim == "square":
+            bind(self.emit("Mul", [ins[0], ins[0]]))
+        elif prim == "erfc":
+            (e,) = self.emit("Erf", ins)
+            one = self.const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
+            bind(self.emit("Sub", [one, e]))
+        elif prim == "log1p":
+            one = self.const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
+            (s,) = self.emit("Add", [ins[0], one])
+            bind(self.emit("Log", [s]))
+        elif prim == "expm1":
+            one = self.const(np.asarray(
+                1.0, np.dtype(eqn.invars[0].aval.dtype)))
+            (e,) = self.emit("Exp", ins)
+            bind(self.emit("Sub", [e, one]))
+        elif prim == "split":
+            sizes = self.const(np.asarray(eqn.params["sizes"], np.int64))
+            bind(self.emit("Split", [ins[0], sizes], n_out=len(outv),
+                           attrs=[wire.attr_int("axis",
+                                                eqn.params["axis"])]))
+        elif prim in ("and", "or", "xor", "not"):
+            bind(self.emit({"and": "And", "or": "Or", "xor": "Xor",
+                            "not": "Not"}[prim], ins))
+        elif prim == "rem":
+            fmod = 1 if np.issubdtype(
+                np.dtype(eqn.invars[0].aval.dtype), np.floating) else 0
+            bind(self.emit("Mod", ins, attrs=[wire.attr_int("fmod", fmod)]))
+        elif prim == "iota":
+            # static shape: bake the ramp as an initializer
+            p = eqn.params
+            dim = p["dimension"]
+            shape = tuple(p["shape"])
+            ramp = np.arange(shape[dim], dtype=np.dtype(p["dtype"]))
+            view = [1] * len(shape)
+            view[dim] = shape[dim]
+            # const only the 1-D ramp; Expand broadcasts — a dense const
+            # for e.g. a [S, S] position grid would bloat the ModelProto
+            c = self.const(ramp.reshape(view))
+            tgt = self.const(np.asarray(shape, np.int64))
+            bind(self.emit("Expand", [c, tgt]))
+        elif prim in ("argmax", "argmin"):
+            bind(self._argminmax(eqn, ins,
+                                 "ArgMax" if prim == "argmax" else "ArgMin"))
+        elif prim == "dynamic_slice":
+            bind(self._dynamic_slice(eqn, ins))
+        elif prim == "dynamic_update_slice":
+            bind(self._dynamic_update_slice(eqn, ins))
+        elif prim == "gather":
+            bind(self._gather(eqn, ins))
+        elif prim == "cumsum":
+            axis = self.const(np.asarray([eqn.params["axis"]], np.int64))
+            bind(self.emit("CumSum", [ins[0], axis],
+                           attrs=[wire.attr_int(
+                               "reverse", int(eqn.params.get("reverse",
+                                                             False)))]))
+        elif prim == "device_put":
+            bind(self.emit("Identity", ins))
         else:
             raise NotImplementedError(
                 f"onnx export: jaxpr primitive {prim!r} has no ONNX "
@@ -190,6 +251,17 @@ class _Converter:
                 (r_in,) = self.emit("Transpose", [r_in],
                                     attrs=[wire.attr_ints("perm", [1, 0])])
             return self.emit("MatMul", [l_in, r_in])
+        # batched q @ k^T (attention scores): leading batch dims, both
+        # operands contracting their LAST dim -> transpose rhs + MatMul
+        if (list(lb) == list(rb) == list(range(len(lb)))
+                and la.ndim == ra.ndim
+                and list(lc) == [la.ndim - 1]
+                and list(rc) == [ra.ndim - 1]):
+            perm = list(range(ra.ndim))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            (r_t,) = self.emit("Transpose", [ins[1]],
+                               attrs=[wire.attr_ints("perm", perm)])
+            return self.emit("MatMul", [ins[0], r_t])
         raise NotImplementedError(
             f"onnx export: dot_general layout {eqn.params['dimension_numbers']}")
 
@@ -228,6 +300,97 @@ class _Converter:
                                     for pp in pair]),
         ]
         return self.emit("Conv", ins, attrs=attrs)
+
+    # ---- decode-path primitives (KV-cache generate() programs) ----------
+    # Reference counterpart: paddle2onnx's coverage of the dynamic ops the
+    # reference decode graphs use (gather/scatter/slice-with-tensor-starts);
+    # here they arise from lax.dynamic_slice / dynamic_update_slice / iota.
+
+    def _i64_starts_vec(self, start_names, eqn, first_idx):
+        """Concat N scalar start operands into one int64 [N] tensor."""
+        one = self.const(np.asarray([1], np.int64))
+        parts = []
+        for i, s in enumerate(start_names):
+            if np.dtype(eqn.invars[first_idx + i].aval.dtype) != np.int64:
+                (s,) = self.emit("Cast", [s],
+                                 attrs=[wire.attr_int(
+                                     "to", wire.onnx_dtype(np.int64))])
+            (r,) = self.emit("Reshape", [s, one])
+            parts.append(r)
+        if len(parts) == 1:
+            return parts[0]
+        (vec,) = self.emit("Concat", parts,
+                           attrs=[wire.attr_int("axis", 0)])
+        return vec
+
+    def _dynamic_slice(self, eqn, ins):
+        """dynamic_slice(x, *starts) -> Slice with runtime starts.
+        (jax clamps out-of-bounds starts; exported graphs must keep starts
+        in bounds — true for the rope-table/cache reads that produce this.)"""
+        sizes = eqn.params["slice_sizes"]
+        starts = self._i64_starts_vec(ins[1:], eqn, 1)
+        sizes_c = self.const(np.asarray(sizes, np.int64))
+        (ends,) = self.emit("Add", [starts, sizes_c])
+        axes = self.const(np.arange(len(sizes), dtype=np.int64))
+        return self.emit("Slice", [ins[0], starts, ends, axes])
+
+    def _dynamic_update_slice(self, eqn, ins):
+        """dynamic_update_slice(x, upd, *starts) -> ScatterND: a static
+        index grid over upd's shape, shifted by the runtime starts."""
+        upd = eqn.invars[1].aval
+        grid = np.stack(
+            np.meshgrid(*[np.arange(s, dtype=np.int64) for s in upd.shape],
+                        indexing="ij"),
+            axis=-1) if upd.ndim else np.zeros((0,), np.int64)
+        base = self.const(grid)
+        starts = self._i64_starts_vec(ins[2:], eqn, 2)
+        (indices,) = self.emit("Add", [base, starts])  # broadcast last dim
+        return self.emit("ScatterND", [ins[0], indices, ins[1]])
+
+    def _gather(self, eqn, ins):
+        """Embedding-lookup form only: take(x, ids, axis=0) — jax gather
+        with start_index_map=(0,), collapsed_slice_dims=(0,), full slices
+        on the remaining dims -> ONNX Gather(axis=0)."""
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        op = eqn.invars[0].aval
+        sizes = list(p["slice_sizes"])
+        full = [s == d for s, d in zip(sizes, op.shape)]
+        if (len(dn.start_index_map) == 1
+                and tuple(dn.collapsed_slice_dims) == tuple(dn.start_index_map)
+                and not dn.operand_batching_dims
+                and sizes[dn.start_index_map[0]] == 1
+                and all(full[d] for d in range(op.ndim)
+                        if d != dn.start_index_map[0])):
+            axis = int(dn.start_index_map[0])
+            idx = eqn.invars[1].aval
+            out_ndim = op.ndim - 1 + (idx.ndim - 1)
+            want_offsets = (tuple(range(axis))
+                            + tuple(range(axis + idx.ndim - 1, out_ndim)))
+            if tuple(dn.offset_dims) != want_offsets:
+                raise NotImplementedError(
+                    f"onnx export: gather offset_dims {dn.offset_dims} "
+                    "don't match ONNX Gather's index placement")
+            (flat_idx,) = self.emit("Squeeze", [
+                ins[1], self.const(np.asarray([idx.ndim - 1], np.int64))])
+            return self.emit("Gather", [ins[0], flat_idx],
+                             attrs=[wire.attr_int("axis", axis)])
+        raise NotImplementedError(
+            f"onnx export: gather dimension_numbers {dn} beyond the "
+            "take-along-one-axis form")
+
+    def _argminmax(self, eqn, ins, op):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise NotImplementedError(f"onnx export: {op} over {axes}")
+        (raw,) = self.emit(op, ins, attrs=[
+            wire.attr_int("axis", int(axes[0])),
+            wire.attr_int("keepdims", 0)])
+        want = np.dtype(eqn.params["index_dtype"])
+        if want == np.int64:
+            return [raw]
+        return self.emit("Cast", [raw],
+                         attrs=[wire.attr_int("to", wire.onnx_dtype(want))])
 
     def _maxpool(self, eqn, ins):
         p = eqn.params

@@ -148,6 +148,9 @@ def _patch_tensor():
     T.prod = math.prod
     T.reshape = manipulation.reshape
     T.transpose = manipulation.transpose
+    # x.T reverses all dims (reference: fluid/framework.py:2015 Variable.T)
+    T.T = property(lambda s: manipulation.transpose(
+        s, list(range(s.ndim))[::-1]))
     T.unsqueeze = manipulation.unsqueeze
     T.squeeze = manipulation.squeeze
     T.flatten = manipulation.flatten

@@ -1,0 +1,43 @@
+"""API signature fingerprint gate.
+
+Reference parity: the ``paddle/fluid/API.spec`` CI gate
+(``/root/reference/tools/print_signatures.py`` — "Print all signatures of
+a python module in alphabet order" + the CI diff that blocks silent API
+changes). The other parity gates check ``__all__`` *membership*; this one
+pins every public callable's *signature*, so an arg rename, reorder, or
+default change fails CI instead of shipping silently.
+
+On an intentional API change, regenerate:
+    python tools/print_signatures.py > API.spec
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_fingerprints_match_spec():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from print_signatures import fingerprint_lines
+    finally:
+        sys.path.pop(0)
+
+    with open(os.path.join(REPO, "API.spec")) as f:
+        want = [ln.rstrip("\n") for ln in f if ln.strip()]
+    got = fingerprint_lines()
+
+    want_set, got_set = set(want), set(got)
+    removed = sorted(want_set - got_set)
+    added = sorted(got_set - want_set)
+    msg = []
+    if removed:
+        msg.append("signatures changed or removed (first 20):\n  "
+                   + "\n  ".join(removed[:20]))
+    if added:
+        msg.append("new/changed signatures not in API.spec (first 20):\n  "
+                   + "\n  ".join(added[:20]))
+    assert not msg, (
+        "\n".join(msg)
+        + "\n\nIf intentional: python tools/print_signatures.py > API.spec"
+    )

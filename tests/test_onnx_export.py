@@ -76,7 +76,7 @@ def test_unmapped_primitive_raises_loudly(tmp_path):
         def forward(self, x):
             import paddle_tpu
 
-            return paddle_tpu.cumsum(x, axis=0)  # cumsum has no mapping
+            return paddle_tpu.linalg.cholesky(x)  # no ONNX mapping
 
     with pytest.raises(NotImplementedError, match="primitive"):
         onnx.export(Weird(), str(tmp_path / "w"),
@@ -115,3 +115,56 @@ def test_opset_below_18_rejected(tmp_path):
                     input_spec=[paddle.to_tensor(
                         np.zeros((1, 2), np.float32))],
                     opset_version=9)
+
+
+def test_mobilenet_v2_exports_719_nodes(tmp_path):
+    """Pins the ROUND3.md claim: MobileNetV2 exports end-to-end (52 convs,
+    719 nodes at 64x64 input)."""
+    from paddle_tpu.vision.models import mobilenet_v2
+
+    paddle.seed(0)
+    net = mobilenet_v2()
+    net.eval()
+    p = onnx.export(net, str(tmp_path / "mbv2"),
+                    input_spec=[paddle.to_tensor(
+                        np.zeros((1, 3, 64, 64), np.float32))])
+    _, graph = _graph(p)
+    ops = _ops(graph)
+    assert len(ops) == 719, len(ops)
+    assert ops.count("Conv") == 52
+
+
+def _decode_graph_checks(path, n_layers):
+    model, graph = _graph(path)
+    ops = _ops(graph)
+    # KV-cache decode signature: tokens + cur_len + 2 caches per layer in,
+    # next_token + 2 caches per layer out
+    assert len(graph[11]) == 2 + 2 * n_layers
+    assert len(graph[12]) == 1 + 2 * n_layers
+    assert "ArgMax" in ops          # greedy sampling compiled into the graph
+    return ops
+
+
+def test_gpt_decode_step_exports(tmp_path):
+    """generate()-style KV-cache decode program exports (VERDICT r3 missing
+    #5): dynamic_update_slice -> ScatterND, dynamic_slice -> Slice with
+    runtime starts, iota -> baked ramp, argmax -> ArgMax."""
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny(max_position_embeddings=32))
+    p = onnx.export_decode(model, str(tmp_path / "gpt_decode"), batch=1)
+    ops = _decode_graph_checks(p, n_layers=model.config.num_layers)
+    assert "ScatterND" in ops       # cache writes at a runtime position
+
+
+def test_llama_decode_step_exports(tmp_path):
+    """Llama adds rope (Sin/Cos + dynamic Slice of the tables) and GQA
+    head-repeat (Gather along the head axis)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    p = onnx.export_decode(model, str(tmp_path / "llama_decode"), batch=1)
+    ops = _decode_graph_checks(p, n_layers=model.config.num_layers)
+    assert "ScatterND" in ops and "Sin" in ops and "Gather" in ops

@@ -19,4 +19,5 @@ exec python -m pytest -q \
   tests/test_legacy_tail.py \
   tests/test_nn_tail.py \
   tests/test_static_nn.py::test_static_nn_parity_gate \
+  tests/test_api_fingerprint.py \
   "$@"
