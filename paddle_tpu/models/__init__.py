@@ -19,3 +19,6 @@ from .bert import (  # noqa: F401,E402
 
 __all__ += ["BertConfig", "BertModel", "BertForPretraining",
             "BertForSequenceClassification", "bert_tiny", "bert_base"]
+from .convert_hf import load_hf_llama, load_hf_gpt2, load_hf_bert  # noqa: F401,E402
+
+__all__ += ["load_hf_llama", "load_hf_gpt2", "load_hf_bert"]

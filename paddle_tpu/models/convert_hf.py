@@ -1,0 +1,245 @@
+"""HuggingFace checkpoint → paddle_tpu model weight conversion.
+
+Reference-ecosystem parity: PaddleNLP's ``from_pretrained`` converters
+(torch state dict → paddle params, with the per-architecture transpose
+and layout fixes). Zero-egress: takes an in-memory ``state_dict`` (from
+``torch.load`` on a local file, or a live ``transformers`` model's
+``.state_dict()``) — no hub download path.
+
+Layout rules encoded here:
+- torch ``nn.Linear`` stores ``[out, in]``; this framework's ``nn.Linear``
+  stores ``[in, out]`` → transpose.
+- HF Llama q/k projections are stored for the rotate-half (half-split)
+  rope convention; this framework's rope is interleaved (Meta layout,
+  llama.py:_apply_rope). The inverse of the transformers conversion
+  permute restores interleaved rows, so logits match exactly.
+- HF GPT-2 uses ``Conv1D`` (already ``[in, out]``) → no transpose; its
+  fused ``c_attn`` maps 1:1 onto this framework's fused ``qkv_proj``.
+
+Every ``load_hf_*`` asserts exact shape agreement and returns the list of
+consumed keys; unconsumed non-buffer keys raise (a silently half-loaded
+checkpoint is worse than an error).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["load_hf_llama", "load_hf_gpt2", "load_hf_bert"]
+
+
+def _np(v) -> np.ndarray:
+    if hasattr(v, "detach"):  # torch tensor, incl. bf16 (numpy lacks bf16)
+        v = v.detach().cpu().float().numpy()
+    return np.asarray(v, dtype=np.float32)
+
+
+def _set(param, value: np.ndarray, name: str):
+    if tuple(param.shape) != tuple(value.shape):
+        raise ValueError(f"{name}: checkpoint shape {value.shape} != "
+                         f"model shape {tuple(param.shape)}")
+    from ..autograd.engine import no_grad
+
+    with no_grad():
+        param._set_value(value.astype(np.float32))
+
+
+def _assert_tied(head: np.ndarray, emb: np.ndarray) -> None:
+    """A tied model can only absorb a checkpoint whose head IS the
+    embedding; silently dropping a distinct trained head would corrupt
+    logits with no error."""
+    if head.shape != emb.shape or not np.array_equal(head, emb):
+        raise ValueError(
+            "checkpoint has an untied lm_head.weight but the target model "
+            "ties word embeddings — rebuild with tie_word_embeddings=False")
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """[out, in] HF rotate-half rows → interleaved (Meta) rows; inverse of
+    transformers' convert_llama_weights_to_hf permute."""
+    out, inn = w.shape
+    return (w.reshape(n_heads, 2, out // n_heads // 2, inn)
+            .transpose(0, 2, 1, 3).reshape(out, inn))
+
+
+class _SD:
+    """Tracks consumed keys so leftovers are loud."""
+
+    def __init__(self, state_dict: Dict):
+        self.d = dict(state_dict)
+        self.used = set()
+
+    def take(self, key: str) -> np.ndarray:
+        if key not in self.d:
+            raise KeyError(f"checkpoint is missing {key!r} "
+                           f"(has {len(self.d)} keys)")
+        self.used.add(key)
+        return _np(self.d[key])
+
+    def finish(self, ignore_substrings=("rotary_emb.inv_freq",
+                                        "masked_bias", ".attn.bias",
+                                        "position_ids")) -> List[str]:
+        left = [k for k in self.d if k not in self.used
+                and not any(s in k for s in ignore_substrings)]
+        if left:
+            raise ValueError(
+                f"{len(left)} checkpoint keys were not consumed (first 8): "
+                f"{left[:8]} — architecture/config mismatch?")
+        return sorted(self.used)
+
+
+def load_hf_llama(model, state_dict: Dict) -> List[str]:
+    """Load a HF ``LlamaForCausalLM`` state dict into
+    :class:`paddle_tpu.models.LlamaForCausalLM`. Returns consumed keys."""
+    cfg = model.config
+    nh, nkv = cfg.num_heads, cfg.num_key_value_heads
+    sd = _SD(state_dict)
+
+    _set(model.llama.embed_tokens.weight, sd.take("model.embed_tokens.weight"),
+         "embed_tokens")
+    for i, layer in enumerate(model.llama.layers):
+        p = f"model.layers.{i}."
+        a = layer.self_attn
+        _set(a.q_proj.weight,
+             _unpermute_rope(sd.take(p + "self_attn.q_proj.weight"), nh).T,
+             p + "q_proj")
+        _set(a.k_proj.weight,
+             _unpermute_rope(sd.take(p + "self_attn.k_proj.weight"), nkv).T,
+             p + "k_proj")
+        _set(a.v_proj.weight, sd.take(p + "self_attn.v_proj.weight").T,
+             p + "v_proj")
+        _set(a.o_proj.weight, sd.take(p + "self_attn.o_proj.weight").T,
+             p + "o_proj")
+        _set(layer.mlp.gate_proj.weight,
+             sd.take(p + "mlp.gate_proj.weight").T, p + "gate_proj")
+        _set(layer.mlp.up_proj.weight,
+             sd.take(p + "mlp.up_proj.weight").T, p + "up_proj")
+        _set(layer.mlp.down_proj.weight,
+             sd.take(p + "mlp.down_proj.weight").T, p + "down_proj")
+        _set(layer.input_layernorm.weight,
+             sd.take(p + "input_layernorm.weight"), p + "input_ln")
+        _set(layer.post_attention_layernorm.weight,
+             sd.take(p + "post_attention_layernorm.weight"), p + "post_ln")
+    _set(model.llama.norm.weight, sd.take("model.norm.weight"), "norm")
+    if model.lm_head is not None:
+        key = ("lm_head.weight" if "lm_head.weight" in sd.d
+               else "model.embed_tokens.weight")
+        _set(model.lm_head.weight, sd.take(key).T, "lm_head")
+    elif "lm_head.weight" in sd.d:
+        _assert_tied(sd.take("lm_head.weight"),
+                     _np(sd.d["model.embed_tokens.weight"]))
+    return sd.finish()
+
+
+def load_hf_gpt2(model, state_dict: Dict,
+                 expect_gelu_new: bool = True) -> List[str]:
+    """Load a HF ``GPT2LMHeadModel`` state dict into
+    :class:`paddle_tpu.models.GPTForCausalLM` (Conv1D: no transpose).
+
+    Real GPT-2 checkpoints use the tanh-approximate gelu ("gelu_new"), so
+    the target must be built with ``GPTConfig(gelu_approximate=True)`` —
+    enforced here because the resulting ~1e-3 logits drift would be
+    silent. Pass ``expect_gelu_new=False`` for a checkpoint whose HF
+    config says ``activation_function="gelu"``."""
+    if expect_gelu_new and not model.config.gelu_approximate:
+        raise ValueError(
+            "HF gpt2 checkpoints use gelu_new (tanh approximation); build "
+            "the model with GPTConfig(gelu_approximate=True), or pass "
+            "expect_gelu_new=False if the source config used exact gelu")
+    sd = _SD(state_dict)
+    gpt = model.gpt
+
+    _set(gpt.embeddings.weight, sd.take("transformer.wte.weight"), "wte")
+    _set(gpt.position_embeddings.weight, sd.take("transformer.wpe.weight"),
+         "wpe")
+    for i, layer in enumerate(gpt.layers):
+        p = f"transformer.h.{i}."
+        _set(layer.ln1.weight, sd.take(p + "ln_1.weight"), p + "ln1.w")
+        _set(layer.ln1.bias, sd.take(p + "ln_1.bias"), p + "ln1.b")
+        _set(layer.attn.qkv_proj.weight, sd.take(p + "attn.c_attn.weight"),
+             p + "qkv.w")
+        _set(layer.attn.qkv_proj.bias, sd.take(p + "attn.c_attn.bias"),
+             p + "qkv.b")
+        _set(layer.attn.out_proj.weight, sd.take(p + "attn.c_proj.weight"),
+             p + "attn_out.w")
+        _set(layer.attn.out_proj.bias, sd.take(p + "attn.c_proj.bias"),
+             p + "attn_out.b")
+        _set(layer.ln2.weight, sd.take(p + "ln_2.weight"), p + "ln2.w")
+        _set(layer.ln2.bias, sd.take(p + "ln_2.bias"), p + "ln2.b")
+        _set(layer.mlp.fc1.weight, sd.take(p + "mlp.c_fc.weight"),
+             p + "fc1.w")
+        _set(layer.mlp.fc1.bias, sd.take(p + "mlp.c_fc.bias"), p + "fc1.b")
+        _set(layer.mlp.fc2.weight, sd.take(p + "mlp.c_proj.weight"),
+             p + "fc2.w")
+        _set(layer.mlp.fc2.bias, sd.take(p + "mlp.c_proj.bias"), p + "fc2.b")
+    _set(gpt.ln_f.weight, sd.take("transformer.ln_f.weight"), "ln_f.w")
+    _set(gpt.ln_f.bias, sd.take("transformer.ln_f.bias"), "ln_f.b")
+    if getattr(model, "lm_head", None) is not None:
+        key = ("lm_head.weight" if "lm_head.weight" in sd.d
+               else "transformer.wte.weight")
+        _set(model.lm_head.weight, sd.take(key).T, "lm_head")
+    elif "lm_head.weight" in sd.d:
+        _assert_tied(sd.take("lm_head.weight"),
+                     _np(sd.d["transformer.wte.weight"]))
+    return sd.finish()
+
+
+def load_hf_bert(model, state_dict: Dict,
+                 layer_norm_eps: float = 1e-12) -> List[str]:
+    """Load a HF ``BertModel`` (or the ``bert.`` submodule of a
+    ``BertFor*`` head model — head weights are ignored) state dict into
+    :class:`paddle_tpu.models.BertModel` (torch Linear: transpose).
+    ``layer_norm_eps`` must be the HF config's value (HF default 1e-12);
+    it is applied to every LayerNorm so hidden states match exactly."""
+    sd = _SD(state_dict)
+    emb = model.embeddings
+
+    def tk(k):
+        # accept both bare BertModel ("embeddings...") and BertFor* dumps
+        # ("bert.embeddings...")
+        return sd.take(k if k in sd.d else "bert." + k)
+
+    _set(emb.word_embeddings.weight,
+         tk("embeddings.word_embeddings.weight"), "word_emb")
+    _set(emb.position_embeddings.weight,
+         tk("embeddings.position_embeddings.weight"), "pos_emb")
+    _set(emb.token_type_embeddings.weight,
+         tk("embeddings.token_type_embeddings.weight"), "type_emb")
+    _set(emb.layer_norm.weight, tk("embeddings.LayerNorm.weight"), "emb_ln.w")
+    _set(emb.layer_norm.bias, tk("embeddings.LayerNorm.bias"), "emb_ln.b")
+    # the TransformerEncoderLayer default eps is 1e-5 — align to the HF
+    # checkpoint's so hidden states match to float tolerance
+    eps = layer_norm_eps
+    emb.layer_norm._epsilon = eps
+    for i, layer in enumerate(model.encoder.layers):
+        p = f"encoder.layer.{i}."
+
+        def lin(dst, src, tag):
+            _set(dst.weight, tk(p + src + ".weight").T, p + tag + ".w")
+            _set(dst.bias, tk(p + src + ".bias"), p + tag + ".b")
+
+        lin(layer.self_attn.q_proj, "attention.self.query", "q")
+        lin(layer.self_attn.k_proj, "attention.self.key", "k")
+        lin(layer.self_attn.v_proj, "attention.self.value", "v")
+        lin(layer.self_attn.out_proj, "attention.output.dense", "attn_out")
+        _set(layer.norm1.weight,
+             tk(p + "attention.output.LayerNorm.weight"), p + "attn_ln.w")
+        _set(layer.norm1.bias,
+             tk(p + "attention.output.LayerNorm.bias"), p + "attn_ln.b")
+        lin(layer.linear1, "intermediate.dense", "fc1")
+        lin(layer.linear2, "output.dense", "fc2")
+        _set(layer.norm2.weight, tk(p + "output.LayerNorm.weight"),
+             p + "ffn_ln.w")
+        _set(layer.norm2.bias, tk(p + "output.LayerNorm.bias"),
+             p + "ffn_ln.b")
+        layer.norm1._epsilon = eps
+        layer.norm2._epsilon = eps
+    if getattr(model, "pooler", None) is not None \
+            and ("pooler.dense.weight" in sd.d
+                 or "bert.pooler.dense.weight" in sd.d):
+        _set(model.pooler.weight, tk("pooler.dense.weight").T, "pooler.w")
+        _set(model.pooler.bias, tk("pooler.dense.bias"), "pooler.b")
+    # BertFor* dumps carry task-head keys this BertModel has no slot for
+    return sd.finish(ignore_substrings=("position_ids", "cls.",
+                                        "classifier."))

@@ -49,6 +49,9 @@ class GPTConfig:
     attention_dropout_prob: float = 0.1
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
+    # tanh-approximate gelu (HF gpt2's "gelu_new") — set when loading HF
+    # gpt2 checkpoints (models/convert_hf.py) so logits match exactly
+    gelu_approximate: bool = False
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     tie_word_embeddings: bool = True
@@ -226,9 +229,10 @@ class GPTMLP(nn.Layer):
                 initializer=_normal_init(std)))
             self.fc2 = nn.Linear(ff, h, weight_attr=nn.ParamAttr(
                 initializer=_normal_init(proj_std)))
+        self._gelu_approx = config.gelu_approximate
 
     def forward(self, x):
-        return self.fc2(F.gelu(self.fc1(x)))
+        return self.fc2(F.gelu(self.fc1(x), approximate=self._gelu_approx))
 
 
 class GPTDecoderLayer(nn.Layer):

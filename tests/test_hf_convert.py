@@ -1,0 +1,203 @@
+"""HF checkpoint conversion: LOGITS PARITY against torch/transformers.
+
+The strongest correctness evidence the model zoo can have: build a
+randomly-initialized HF model (offline — torch + transformers are local),
+convert its state dict with models/convert_hf.py, and require this
+framework's fp32 logits to match torch's to float tolerance. Covers the
+rope-convention permute (HF rotate-half vs our interleaved), GQA, the
+GPT-2 Conv1D no-transpose rule, and BERT's 1e-12 LayerNorm eps.
+
+Reference-ecosystem parity: PaddleNLP from_pretrained converters.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import paddle_tpu as paddle  # noqa: E402
+
+
+def _logits_close(ours, theirs, rtol=2e-4, atol=2e-4):
+    ours = np.asarray(ours, dtype=np.float32)
+    theirs = np.asarray(theirs, dtype=np.float32)
+    np.testing.assert_allclose(ours, theirs, rtol=rtol, atol=atol)
+
+
+def test_llama_logits_match_hf():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    ours = LlamaForCausalLM(LlamaConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=172, num_layers=2,
+        num_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False))
+    used = load_hf_llama(ours, hf.state_dict())
+    assert len(used) >= 2 + 9 * 2  # emb+norm+head + 9 tensors/layer
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 160, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    ours.eval()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    _logits_close(got, want)
+
+
+def test_llama_generate_matches_hf_greedy():
+    """Greedy decoding through OUR KV-cache generate() must pick the same
+    tokens as HF greedy — validates the decode path end-to-end, not just
+    one forward."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, tie_word_embeddings=False,
+        attention_bias=False)
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ours = LlamaForCausalLM(LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88, num_layers=2,
+        num_heads=4, num_key_value_heads=4, max_position_embeddings=32,
+        tie_word_embeddings=False))
+    load_hf_llama(ours, hf.state_dict())
+
+    ids = np.array([[5, 11, 42]], dtype=np.int64)
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    got = np.asarray(
+        ours.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                      temperature=0.0).numpy())
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gpt2_logits_match_hf():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, load_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=160, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        activation_function="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0, layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    ours = GPTForCausalLM(GPTConfig(
+        vocab_size=160, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0, layer_norm_epsilon=1e-5,
+        tie_word_embeddings=True, gelu_approximate=True))
+    load_hf_gpt2(ours, hf.state_dict())
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 160, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    ours.eval()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    _logits_close(got, want)
+
+
+def test_bert_hidden_states_match_hf():
+    from paddle_tpu.models import BertConfig, BertModel, load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=200, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12, hidden_act="gelu")
+    torch.manual_seed(0)
+    hf = transformers.BertModel(hf_cfg, add_pooling_layer=True).eval()
+
+    ours = BertModel(BertConfig(
+        vocab_size=200, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=256, max_position_embeddings=64,
+        type_vocab_size=2, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+    load_hf_bert(ours, hf.state_dict())
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 200, (2, 9))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).last_hidden_state.numpy()
+    ours.eval()
+    seq, _pooled = ours(paddle.to_tensor(ids))
+    _logits_close(np.asarray(seq.numpy()), want)
+
+
+def test_shape_mismatch_raises():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    wrong = LlamaForCausalLM(LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=88, num_layers=2,
+        num_heads=4, max_position_embeddings=32, tie_word_embeddings=False))
+    with pytest.raises((ValueError, KeyError)):
+        load_hf_llama(wrong, hf.state_dict())
+
+
+def test_untied_checkpoint_into_tied_model_raises():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, tie_word_embeddings=False,
+        attention_bias=False)
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg)   # untied: distinct head
+    tied = LlamaForCausalLM(LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88, num_layers=2,
+        num_heads=4, max_position_embeddings=32, tie_word_embeddings=True))
+    with pytest.raises(ValueError, match="untied"):
+        load_hf_llama(tied, hf.state_dict())
+
+
+def test_gpt2_load_requires_gelu_new_config():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, load_hf_gpt2
+
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=1, n_head=4))
+    wrong = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_layers=1, num_heads=4,
+        max_position_embeddings=32))       # gelu_approximate defaults False
+    with pytest.raises(ValueError, match="gelu_new"):
+        load_hf_gpt2(wrong, hf.state_dict())
+
+
+def test_bert_head_model_dump_loads_into_bare_bert():
+    from paddle_tpu.models import BertConfig, BertModel, load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.BertForSequenceClassification(hf_cfg).eval()
+    ours = BertModel(BertConfig(
+        vocab_size=120, hidden_size=32, num_layers=1, num_heads=4,
+        intermediate_size=64, max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_dropout_prob=0.0))
+    load_hf_bert(ours, hf.state_dict())    # classifier.* ignored
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 120, (1, 7))
+    with torch.no_grad():
+        want = hf.bert(torch.tensor(ids)).last_hidden_state.numpy()
+    ours.eval()
+    seq, _ = ours(paddle.to_tensor(ids))
+    _logits_close(np.asarray(seq.numpy()), want)

@@ -2,7 +2,7 @@
 """A/B: fused AdamW Pallas kernel vs XLA elementwise update (VERDICT r2 #6).
 
 Run ON the TPU. 355M-param-scale flat buffers (the bench model's size).
-Appends the result to BENCH_NOTES_r03.json.
+Appends the result to BENCH_NOTES_r04.json.
 """
 import json
 import os
@@ -15,7 +15,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                      "BENCH_NOTES_r03.json")
+                      "BENCH_NOTES_r04.json")
 
 
 def _bench(fn, args, iters=30):

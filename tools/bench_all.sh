@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # One-command TPU bench battery — run the moment the tunnel is healthy.
-# Persists every result to BENCH_NOTES_r03.json (each tool appends).
+# Persists every result to BENCH_NOTES_r04.json (each tool appends).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -13,6 +13,12 @@ python bench.py --model bert
 echo "=== resnet50 ==="
 python bench.py --model resnet50
 
+echo "=== llama 0.76B single-chip ==="
+python bench.py --model llama
+
+echo "=== llama7b (8-chip run, or compile-only fit certificate) ==="
+python bench.py --model llama7b
+
 echo "=== flash-attention A/B + block sweep ==="
 python tools/bench_flash.py
 
@@ -22,4 +28,4 @@ python tools/bench_adamw.py
 echo "=== eager dispatch (TPU) ==="
 python tools/bench_eager.py
 
-echo "done — see BENCH_NOTES_r03.json"
+echo "done — see BENCH_NOTES_r04.json"
