@@ -6,10 +6,13 @@ OpDescs. Here the deploy IR is the traced jaxpr, and conversion walks it
 directly (onnx/convert.py) emitting ModelProto in raw protobuf wire format
 (onnx/wire.py — the ``onnx`` package is not in this zero-egress image).
 
-Coverage is the inference surface of the model zoo (matmul/conv/pool/
-elementwise/activation/reshape/reduce chains); an unmapped primitive raises
-NotImplementedError naming it. The StableHLO artifact (jit.save) remains
-the full-fidelity deploy path.
+Coverage: the model zoo's inference surface (matmul/conv/pool/elementwise/
+activation/reshape/reduce chains), KV-cache decode programs
+(``export_decode`` — dynamic_update_slice→ScatterND, runtime-start Slice,
+argmax), and structured control flow (lax.scan / lax.while_loop → ONNX
+Loop, covering StaticRNN and static.nn.while_loop). An unmapped primitive
+raises NotImplementedError naming it. The StableHLO artifact (jit.save)
+remains the full-fidelity deploy path.
 """
 from __future__ import annotations
 

@@ -227,6 +227,10 @@ class _Converter:
                                                              False)))]))
         elif prim == "device_put":
             bind(self.emit("Identity", ins))
+        elif prim == "scan":
+            bind(self._scan(eqn, ins))
+        elif prim == "while":
+            bind(self._while(eqn, ins))
         else:
             raise NotImplementedError(
                 f"onnx export: jaxpr primitive {prim!r} has no ONNX "
@@ -378,6 +382,142 @@ class _Converter:
         raise NotImplementedError(
             f"onnx export: gather dimension_numbers {dn} beyond the "
             "take-along-one-axis form")
+
+    # ---- structured control flow → ONNX Loop ----------------------------
+    # Reference counterpart: paddle2onnx's while_op → Loop export. jax's
+    # lax.scan / lax.while_loop (what StaticRNN and static.nn.while_loop
+    # compile to) both map onto ONNX Loop; subgraphs reference outer-scope
+    # names for captured constants (legal per the ONNX spec).
+
+    def _subgraph_nodes(self, build):
+        """Run ``build()`` with self.nodes redirected to a fresh list;
+        returns that list. Initializers/consts still land on the OUTER
+        graph — subgraphs may reference outer-scope names."""
+        saved, self.nodes = self.nodes, []
+        try:
+            build()
+            return self.nodes
+        finally:
+            self.nodes = saved
+
+    def _body_io(self, avals, tag):
+        names, infos = [], []
+        for a in avals:
+            nm = self.fresh(tag)
+            names.append(nm)
+            infos.append(wire.value_info(nm, np.dtype(a.dtype), a.shape))
+        return names, infos
+
+    def _scan(self, eqn, ins):
+        """lax.scan → Loop(M=length): carries thread; each x is gathered
+        at the iteration index; stacked ys are Loop scan-outputs."""
+        p = eqn.params
+        if p.get("reverse"):
+            raise NotImplementedError("onnx export: reverse scan")
+        nc, ncar = p["num_consts"], p["num_carry"]
+        closed = p["jaxpr"]
+        inner = getattr(closed, "jaxpr", closed)
+        consts = getattr(closed, "consts", [])
+        const_ins, carry_ins, xs_ins = (ins[:nc], ins[nc:nc + ncar],
+                                        ins[nc + ncar:])
+
+        iter_nm = self.fresh("iter")
+        cond_in = self.fresh("cond_in")
+        carry_nms, carry_infos = self._body_io(
+            [v.aval for v in inner.invars[nc:nc + ncar]], "carry")
+
+        for cv, cval in zip(inner.constvars, consts):
+            self.names[id(cv)] = self.const(np.asarray(cval))
+        for v, nm in zip(inner.invars[:nc], const_ins):
+            self.names[id(v)] = nm          # outer-scope reference
+        for v, nm in zip(inner.invars[nc:nc + ncar], carry_nms):
+            self.names[id(v)] = nm
+
+        def build():
+            for v, xs_nm in zip(inner.invars[nc + ncar:], xs_ins):
+                (x_t,) = self.emit("Gather", [xs_nm, iter_nm],
+                                   attrs=[wire.attr_int("axis", 0)])
+                self.names[id(v)] = x_t
+            self.convert_jaxpr(inner)
+            # every body output must be PRODUCED by a body node — a
+            # pass-through carry / literal y would otherwise name a
+            # subgraph input or outer initializer, which checkers reject
+            build.outs = [self.emit("Identity", [nm])[0] for nm in
+                          [cond_in] + [self.name_of(v)
+                                       for v in inner.outvars]]
+
+        body_nodes = self._subgraph_nodes(build)
+        out_infos = [wire.value_info(build.outs[0], np.dtype(np.bool_), ())]
+        for v, nm in zip(inner.outvars, build.outs[1:]):
+            # per-iteration slice shape for ys; carry shape for carries
+            out_infos.append(wire.value_info(nm, np.dtype(v.aval.dtype),
+                                             v.aval.shape))
+        body = wire.graph_proto(
+            self.fresh("scan_body"), body_nodes,
+            [wire.value_info(iter_nm, np.dtype(np.int64), ()),
+             wire.value_info(cond_in, np.dtype(np.bool_), ())]
+            + carry_infos,
+            out_infos, [])
+        trip = self.const(np.asarray(p["length"], np.int64))
+        cond0 = self.const(np.asarray(True))
+        n_out = len(inner.outvars)
+        return self.emit("Loop", [trip, cond0] + list(carry_ins),
+                         n_out=n_out,
+                         attrs=[wire.attr_graph("body", body)])
+
+    def _while(self, eqn, ins):
+        """lax.while_loop → Loop(cond-driven): the initial condition runs
+        inline on the outer graph; the body re-evaluates the cond jaxpr on
+        the fresh carry each iteration."""
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cond_closed, body_closed = p["cond_jaxpr"], p["body_jaxpr"]
+        cond_consts, body_consts, init = ins[:cn], ins[cn:cn + bn], \
+            ins[cn + bn:]
+
+        def bind_and_walk(closed, const_nms, carry_nms):
+            inner = getattr(closed, "jaxpr", closed)
+            for cv, cval in zip(inner.constvars,
+                                getattr(closed, "consts", [])):
+                if id(cv) not in self.names:  # cond walks twice; one const
+                    self.names[id(cv)] = self.const(np.asarray(cval))
+            for v, nm in zip(inner.invars[:len(const_nms)], const_nms):
+                self.names[id(v)] = nm
+            for v, nm in zip(inner.invars[len(const_nms):], carry_nms):
+                self.names[id(v)] = nm
+            self.convert_jaxpr(inner)
+            return [self.name_of(v) for v in inner.outvars]
+
+        # initial condition, evaluated on the outer graph
+        (cond0,) = bind_and_walk(cond_closed, cond_consts, list(init))
+
+        iter_nm = self.fresh("iter")
+        cond_in = self.fresh("cond_in")
+        body_inner = getattr(body_closed, "jaxpr", body_closed)
+        carry_nms, carry_infos = self._body_io(
+            [v.aval for v in body_inner.invars[bn:]], "wcarry")
+
+        def build():
+            new_carry = bind_and_walk(body_closed, body_consts, carry_nms)
+            (cond_out,) = bind_and_walk(cond_closed, cond_consts, new_carry)
+            # produced-inside-the-body guarantee (see _scan)
+            build.outs = [self.emit("Identity", [nm])[0]
+                          for nm in [cond_out] + new_carry]
+
+        body_nodes = self._subgraph_nodes(build)
+        out_infos = [wire.value_info(build.outs[0], np.dtype(np.bool_), ())]
+        for v, nm in zip(body_inner.invars[bn:], build.outs[1:]):
+            out_infos.append(wire.value_info(nm, np.dtype(v.aval.dtype),
+                                             v.aval.shape))
+        body = wire.graph_proto(
+            self.fresh("while_body"), body_nodes,
+            [wire.value_info(iter_nm, np.dtype(np.int64), ()),
+             wire.value_info(cond_in, np.dtype(np.bool_), ())]
+            + carry_infos,
+            out_infos, [])
+        return self.emit("Loop", ["", cond0] + list(init),
+                         n_out=len(init),
+                         attrs=[wire.attr_graph("body", body)])
 
     def _argminmax(self, eqn, ins, op):
         axes = eqn.params["axes"]

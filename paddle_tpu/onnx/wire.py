@@ -121,6 +121,10 @@ def attr_tensor(name: str, t: bytes) -> bytes:
     return field_str(1, name) + field_bytes(5, t) + field_varint(20, 4)
 
 
+def attr_graph(name: str, g: bytes) -> bytes:
+    return field_str(1, name) + field_bytes(6, g) + field_varint(20, 5)
+
+
 def node_proto(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
                name: str = "", attrs: Sequence[bytes] = ()) -> bytes:
     out = b""

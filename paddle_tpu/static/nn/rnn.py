@@ -45,8 +45,15 @@ class StaticRNN:
         if self.status != self.BEFORE_RNN:
             raise RuntimeError("StaticRNN.step() may only be entered once")
         self.status = self.IN_RNN
+        # the scan body is REBUILT from the tape the step block records —
+        # under no_grad (eval loops, onnx export) recording is off and the
+        # replayed body would degenerate to step-0 constants (silently
+        # broadcasting h0 over time); force recording for the block
+        from ...autograd.engine import enable_grad
+
         try:
-            yield
+            with enable_grad():
+                yield
         finally:
             self.status = self.AFTER_RNN
 

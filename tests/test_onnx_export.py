@@ -168,3 +168,90 @@ def test_llama_decode_step_exports(tmp_path):
     p = onnx.export_decode(model, str(tmp_path / "llama_decode"), batch=1)
     ops = _decode_graph_checks(p, n_layers=model.config.num_layers)
     assert "ScatterND" in ops and "Sin" in ops and "Gather" in ops
+
+
+def _loop_body_ops(path):
+    _, graph = _graph(path)
+    for n in graph[1]:
+        nd = wire.read_message(n)
+        if nd[4][0].decode() == "Loop":
+            attr = wire.read_message(nd[5][0])
+            body = wire.read_message(attr[6][0])
+            return ([wire.read_message(bn)[4][0].decode() for bn in body[1]],
+                    len(body[11]), len(body[12]))
+    return None, 0, 0
+
+
+def test_while_loop_exports_as_onnx_loop(tmp_path):
+    """static.nn.while_loop (lax.while) -> ONNX Loop: initial cond inline,
+    body re-evaluates the cond on the fresh carry (paddle2onnx's while_op
+    -> Loop export, the reference deploy path for dynamic control flow)."""
+    from paddle_tpu.static import nn as snn
+
+    class Counter(nn.Layer):
+        def forward(self, x):
+            i0 = paddle.to_tensor(np.int32(0))
+            _, v = snn.while_loop(lambda i, v: i < 4,
+                                  lambda i, v: [i + 1, v * 1.5 + 0.1],
+                                  [i0, x])
+            return v
+
+    p = onnx.export(Counter(), str(tmp_path / "w"),
+                    input_spec=[paddle.to_tensor(np.ones(3, np.float32))])
+    assert "Loop" in _ops(_graph(p)[1])
+    body_ops, n_in, n_out = _loop_body_ops(p)
+    assert "Mul" in body_ops and "Less" in body_ops  # body + re-evaled cond
+    assert n_in == 2 + 2 and n_out == 1 + 2          # iter+cond+carries
+
+
+def test_static_rnn_scan_exports_as_onnx_loop(tmp_path):
+    from paddle_tpu.static import nn as snn
+
+    class RNN(nn.Layer):
+        def forward(self, x):
+            rnn = snn.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, 4], batch_ref=xt,
+                                  init_value=0.0)
+                h = prev + xt
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            return rnn()
+
+    p = onnx.export(RNN(), str(tmp_path / "rnn"),
+                    input_spec=[paddle.to_tensor(
+                        np.ones((5, 3, 4), np.float32))])
+    body_ops, n_in, n_out = _loop_body_ops(p)
+    # body gathers x_t at the iteration index, computes, threads the carry
+    assert body_ops and "Gather" in body_ops and "Add" in body_ops
+    assert n_in == 2 + 1 and n_out == 1 + 2   # cond + carry + scan output
+
+
+def test_while_loop_passthrough_carry_body_output_is_produced(tmp_path):
+    """A carry the body never touches must still be PRODUCED inside the
+    Loop body (Identity), not alias the subgraph input — checkers reject
+    outputs no body node produces."""
+    from paddle_tpu.static import nn as snn
+
+    class M(nn.Layer):
+        def forward(self, x):
+            i0 = paddle.to_tensor(np.int32(0))
+            _, v = snn.while_loop(lambda i, v: i < 3,
+                                  lambda i, v: [i + 1, v],  # v untouched
+                                  [i0, x])
+            return v
+
+    p = onnx.export(M(), str(tmp_path / "pt"),
+                    input_spec=[paddle.to_tensor(np.ones(2, np.float32))])
+    _, graph = _graph(p)
+    for n in graph[1]:
+        nd = wire.read_message(n)
+        if nd[4][0].decode() == "Loop":
+            body = wire.read_message(wire.read_message(nd[5][0])[6][0])
+            produced = set()
+            for bn in body[1]:
+                for o in wire.read_message(bn).get(2, []):
+                    produced.add(o.decode())
+            outs = [wire.read_message(o)[1][0].decode() for o in body[12]]
+            assert all(o in produced for o in outs), (outs, produced)

@@ -349,3 +349,21 @@ def test_sequence_conv_padding_start_window():
     got = np.asarray(out.numpy())[0, :, 0]
     want = np.concatenate([xv[0, 1:, 0], [0.0]])  # shifted left, zero tail
     np.testing.assert_allclose(got, want)
+
+
+def test_static_rnn_correct_under_no_grad():
+    """Regression: the step block's tape recording must survive no_grad —
+    the replayed scan body used to degenerate to step-0 constants and
+    silently broadcast h0 over time (found exporting StaticRNN to ONNX)."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(5, 3, 4).astype(np.float32)
+    with paddle.no_grad():
+        rnn = nn.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(t(xv))
+            prev = rnn.memory(shape=[-1, 4], batch_ref=xt, init_value=0.0)
+            h = prev + xt
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    np.testing.assert_allclose(out.numpy(), np.cumsum(xv, axis=0), rtol=1e-5)
