@@ -34,7 +34,8 @@ class SparseEmbedding(Layer):
     def __init__(self, client: PSClient, table_id: int,
                  embedding_dim: int,
                  config: Optional[TableConfig] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 communicator=None):
         super().__init__()
         cfg = config or TableConfig(dim=embedding_dim)
         if cfg.dim != embedding_dim:
@@ -43,6 +44,10 @@ class SparseEmbedding(Layer):
         self._client = client
         self._table_id = table_id
         self._dim = embedding_dim
+        # async mode (reference: Communicator async): grads accumulate in
+        # the communicator and flush on its schedule instead of blocking
+        # the backward pass on a server round trip
+        self._communicator = communicator
         client.create_sparse_table(table_id, cfg)
 
     def forward(self, ids) -> Tensor:
@@ -53,10 +58,14 @@ class SparseEmbedding(Layer):
 
         if self.training:
             client, tid = self._client, self._table_id
+            comm = self._communicator
 
             def _push(grad):
-                client.push_sparse(tid, flat,
-                                   np.asarray(grad.numpy(), np.float32))
+                g = np.asarray(grad.numpy(), np.float32)
+                if comm is not None:
+                    comm.push_sparse_async(tid, flat, g)
+                else:
+                    client.push_sparse(tid, flat, g)
                 return grad
 
             rows.register_hook(_push)

@@ -381,3 +381,28 @@ def test_geo_communicator_handle_stays_live_across_sync(two_servers):
     w += 1.0
     g.sync(32)
     np.testing.assert_allclose(client.pull_dense(32), 4.0, atol=1e-6)
+
+
+def test_sparse_embedding_async_communicator_mode(two_servers):
+    """SparseEmbedding(communicator=...) routes grads through the async
+    merge-and-flush path instead of blocking backward on the server."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.ps import AsyncCommunicator
+
+    client = two_servers
+    comm = AsyncCommunicator(client, send_steps=1000, send_interval_s=60.0)
+    emb = SparseEmbedding(client, table_id=40, embedding_dim=4,
+                          config=TableConfig(dim=4, optimizer="sgd",
+                                             learning_rate=0.5,
+                                             init_range=0.0),
+                          communicator=comm)
+    ids = np.array([[1, 2]], np.int64)
+    target = paddle.to_tensor(np.ones((1, 2, 4), np.float32))
+    out = emb(ids)
+    ((out - target) ** 2).mean().backward()
+    # grads held in the communicator, server untouched so far
+    np.testing.assert_array_equal(
+        client.pull_sparse(40, np.array([1, 2], np.uint64)), 0.0)
+    comm.stop()  # drain
+    after = client.pull_sparse(40, np.array([1, 2], np.uint64))
+    assert np.abs(after).max() > 0  # update landed on flush
