@@ -10,6 +10,7 @@ runs — same math, XLA-fused.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional
 
 import jax
@@ -49,12 +50,16 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, key=None):
 # attention off the Pallas kernel for debugging/numerics comparison
 pallas_flash_enabled = True
 
-# Below this sequence length XLA's fused attention wins on the MXU (the
-# [S,S] block still fits HBM comfortably and XLA's schedule beats the
-# hand kernel — measured ~20.6k vs ~16.1k tok/s on GPT-355M at S=1024 on
-# v5e); at long S the Pallas kernel's O(S) memory is what makes training
-# possible at all. Tunable for experiments.
-pallas_flash_min_seq = 2048
+# Measured dispatch threshold (v5e, r4, tools/bench_flash.py with chained
+# data-dependent timing): the Pallas kernel wins fwd+bwd at EVERY swept
+# length — S=512: 1.93 vs 1.99ms, S=1024: 1.73 vs 5.07ms, S=2048: 3.71 vs
+# 11.11ms, S=4096: 6.09 vs 32.57ms (naive attention is HBM-bound on the
+# [S,S] score tensor; flash never materializes it). r2's "XLA wins at
+# S=1024" was an artifact of per-call wall timing that the axon tunnel's
+# async dispatch made meaningless. Below 512 the [S,S] block is small
+# enough that XLA's fusion ties and dispatch overhead dominates.
+# Env override lets the bench ladder A/B the threshold without code edits.
+pallas_flash_min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 512))
 
 
 def _use_pallas(q_value, seq_len: int) -> bool:

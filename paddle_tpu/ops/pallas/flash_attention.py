@@ -43,8 +43,11 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
 except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
-DEFAULT_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", 512))
-DEFAULT_BLOCK_K = int(os.environ.get("PADDLE_TPU_FLASH_BK", 512))
+# Measured-best blocks (v5e, r4 sweep): (1024, 1024) wins or ties at every
+# S >= 1024 fwd+bwd; _pick_block clamps to S below that, which lands on the
+# measured-best (512, 512) at S=512.
+DEFAULT_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", 1024))
+DEFAULT_BLOCK_K = int(os.environ.get("PADDLE_TPU_FLASH_BK", 1024))
 NEG_INF = -1e30
 LANES = 128
 

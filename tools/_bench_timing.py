@@ -1,0 +1,73 @@
+"""Shared timing methodology for the on-chip A/B harnesses.
+
+Under the axon tunnel `block_until_ready` does not reliably wait for remote
+execution (r4: measured 0.02ms "runs" of a kernel with a 0.2ms analytic
+floor), so per-call wall timing is garbage. Every harness therefore times
+ITERS chained data-dependent calls inside ONE jit, fetches a scalar derived
+from the result (the device_get cannot return before every iteration ran),
+and subtracts the measured scalar round-trip. Both A/B sides of every
+decision (flash dispatch threshold, fused-adamw retirement) must use this
+same clock — keep it here, not copy-pasted per tool.
+"""
+from __future__ import annotations
+
+import time
+
+_RT_BASELINE = None
+
+
+def roundtrip_baseline(log=None):
+    """Measured cost of one scalar fetch through the tunnel (min of 5)."""
+    global _RT_BASELINE
+    if _RT_BASELINE is None:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.zeros((), jnp.float32)
+        float(jax.device_get(x))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(jax.device_get(x + 0.0))
+            ts.append(time.perf_counter() - t0)
+        _RT_BASELINE = min(ts)
+        if log:
+            log(f"scalar round-trip baseline: {_RT_BASELINE*1e3:.2f}ms")
+    return _RT_BASELINE
+
+
+def bench_chained(step, carry, consts, iters=32, reps=3, log=None,
+                  donate=False):
+    """Time `step(carry, *consts) -> carry` chained ITERS times in one jit.
+
+    `carry` may be any pytree; returns (seconds_per_iter, final_carry) —
+    final_carry matters when the caller donates buffers into the chain
+    (donate=True aliases the carry in-place; required when the carry is a
+    multi-GB state that would otherwise double in HBM).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def many(carry, *consts):
+        def body(_, c):
+            return step(c, *consts)
+        return jax.lax.fori_loop(0, iters, body, carry)
+
+    def _sync(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(jax.device_get(jnp.sum(
+            jnp.ravel(leaf)[:8].astype(jnp.float32))))
+
+    out = many(carry, *consts)
+    _sync(out)  # compile + settle
+    rt = roundtrip_baseline(log)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = many(out, *consts)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return max(best - rt, 1e-9) / iters, out

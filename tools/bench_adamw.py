@@ -3,6 +3,13 @@
 
 Run ON the TPU. 355M-param-scale flat buffers (the bench model's size).
 Appends the result to BENCH_NOTES_r04.json.
+
+Timing: chained data-dependent iterations inside one jit + terminal scalar
+fetch, minus the measured scalar round-trip — under the axon tunnel
+`block_until_ready` does not reliably wait for remote execution (r4), so
+per-call wall timing is garbage. Correctness is checked at small N first;
+the timed run holds only one (w, m, v) chain to stay inside HBM
+(355M x 4 states x f32 in+out with both impls' outputs live OOMed r4).
 """
 import json
 import os
@@ -18,19 +25,21 @@ _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                       "BENCH_NOTES_r04.json")
 
 
-def _bench(fn, args, iters=30):
-    import jax
-    jax.block_until_ready(fn(*args))
-    for _ in range(3):
-        jax.block_until_ready(fn(*args))
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    kept = ts[: max(1, len(ts) - len(ts) // 5)]
-    return sum(kept) / len(kept)
+from _bench_timing import bench_chained  # noqa: E402  (shared clock — both
+#   A/B harnesses must time identically; see _bench_timing.py)
+
+
+def _bench(update, w, m, v, g, lr, t, iters=20, reps=3):
+    # donate=True: the loop carry aliases the (w, m, v) state — without it,
+    # inputs + carry + outputs tripled the 4.3GB state and OOMed a 16GB
+    # chip (measured r4). The final carry is handed back so the next impl
+    # can be benchmarked on the same buffers.
+    def step(c, g):
+        return update(c[0], c[1], c[2], g, lr, t)
+
+    return bench_chained(step, (w, m, v), (g,), iters=iters, reps=reps,
+                         log=lambda m_: print(m_, file=sys.stderr),
+                         donate=True)
 
 
 def main():
@@ -44,28 +53,34 @@ def main():
     on_tpu = dev.platform in ("tpu", "axon")
     n = int(os.environ.get("BENCH_ADAMW_N", 355_000_000 if on_tpu
                            else 1_000_000))
+    n -= n % 8192  # tile-aligned: the kernel's pad path would otherwise
+    #                copy all four flat buffers every loop iteration
     print(f"device={dev.platform} n={n}", file=sys.stderr)
     rng = np.random.default_rng(0)
+    lr = jnp.float32(1e-4)
+    t = jnp.float32(10.0)
+
+    # correctness first, at a size where both impls' outputs fit comfortably
+    ns = min(n, 2_000_000)
+    ws = jnp.asarray(rng.standard_normal(ns), jnp.float32)
+    ms = jnp.zeros(ns, jnp.float32)
+    vs = jnp.zeros(ns, jnp.float32)
+    gs = jnp.asarray(rng.standard_normal(ns), jnp.float32) * 1e-3
+    o_pl = jax.jit(fused_adamw_flat)(ws, ms, vs, gs, lr, t)
+    o_x = jax.jit(xla_adamw_flat)(ws, ms, vs, gs, lr, t)
+    for a, b in zip(o_pl, o_x):
+        np.testing.assert_allclose(np.asarray(a[:4096]), np.asarray(b[:4096]),
+                                   rtol=1e-6, atol=1e-7)
+    del o_pl, o_x, ws, ms, vs, gs
+    print("numerics match", file=sys.stderr)
+
     w = jnp.asarray(rng.standard_normal(n), jnp.float32)
     m = jnp.zeros(n, jnp.float32)
     v = jnp.zeros(n, jnp.float32)
     g = jnp.asarray(rng.standard_normal(n), jnp.float32) * 1e-3
-    lr = jnp.float32(1e-4)
-    t = jnp.float32(10.0)
 
-    f_pl = jax.jit(fused_adamw_flat)
-    f_x = jax.jit(xla_adamw_flat)
-
-    # correctness first
-    o_pl = f_pl(w, m, v, g, lr, t)
-    o_x = f_x(w, m, v, g, lr, t)
-    for a, b in zip(o_pl, o_x):
-        np.testing.assert_allclose(np.asarray(a[:4096]), np.asarray(b[:4096]),
-                                   rtol=1e-6, atol=1e-7)
-    print("numerics match", file=sys.stderr)
-
-    t_pl = _bench(f_pl, (w, m, v, g, lr, t))
-    t_x = _bench(f_x, (w, m, v, g, lr, t))
+    t_pl, (w, m, v) = _bench(fused_adamw_flat, w, m, v, g, lr, t)
+    t_x, _ = _bench(xla_adamw_flat, w, m, v, g, lr, t)
     gb = n * 4 * 7 / 1e9  # r: w,m,v,g  w: w,m,v
     rec = {
         "metric": "fused_adamw_ab", "n_params": n,
