@@ -138,28 +138,56 @@ def _reexec_cpu_fallback():
     sys.exit(rc)
 
 
-def _time_steps(step, args, steps):
-    """Per-step blocking timing; slowest ~20% dropped as relay stragglers.
-    Returns mean step seconds over the kept set."""
+def _timing():
+    """The shared tunnel clock (tools/_bench_timing.py) — model-step
+    numbers and the kernel A/B numbers must use the same methodology."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import _bench_timing
+    return _bench_timing
+
+
+def _sync(out):
+    """Force REAL completion: fetch a tiny host slice. Under the axon
+    tunnel `block_until_ready` alone does not reliably wait for remote
+    execution (measured r4), and a per-step fetch costs a ~63ms round
+    trip — so sync once per timed block, never per step."""
+    _timing().sync_fetch(_first_leaf(out).value)
+
+
+def _roundtrip_s():
+    return _timing().roundtrip_baseline(log=_log)
+
+
+def _time_steps(step, args, steps, reps=3):
+    """Block timing: `steps` back-to-back calls (successive train steps are
+    data-dependent through the donated optimizer state, so none can be
+    elided or reordered) with ONE terminal sync, minus the measured scalar
+    round-trip; best of `reps` blocks. Per-step blocking timing (the r2/r3
+    method) paid the tunnel round-trip every step — ~90ms/step of harness
+    overhead billed to the model (measured r4: 320ms/step per-step-sync vs
+    227ms/step chained on the same program)."""
     _log("compiling...")
     t0 = time.time()
     out = step(*args)
-    _first_leaf(out).value.block_until_ready()
+    _sync(out)
     compile_s = time.time() - t0
     _log(f"compiled in {compile_s:.1f}s; warming 2 steps...")
     for _ in range(2):
-        _first_leaf(step(*args)).value.block_until_ready()
-    _log(f"timing {steps} steps...")
-    step_times = []
-    for _ in range(steps):
-        t0 = time.time()
         out = step(*args)
-        _first_leaf(out).value.block_until_ready()
-        step_times.append(time.time() - t0)
-    step_times.sort()
-    kept = step_times[: max(1, len(step_times) - len(step_times) // 5)]
-    _log("step times (s): " + " ".join(f"{t:.3f}" for t in step_times))
-    return sum(kept) / len(kept), compile_s, out
+    _sync(out)
+    rt = _roundtrip_s()
+    _log(f"timing {reps}x{steps} steps (round-trip baseline {rt*1e3:.1f}ms)")
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(*args)
+        _sync(out)
+        block = time.perf_counter() - t0 - rt
+        _log(f"block: {block:.3f}s ({block/steps*1e3:.1f}ms/step)")
+        best = min(best, block)
+    return max(best, 1e-9) / steps, compile_s, out
 
 
 def _first_leaf(out):
@@ -193,6 +221,8 @@ def bench_gpt(dev, small):
                         num_heads=16, max_position_embeddings=max(S, 1024),
                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                         recompute=os.environ.get("BENCH_RECOMPUTE") == "1",
+                        recompute_policy=os.environ.get("BENCH_RC_POLICY")
+                        or None,
                         fused_loss=os.environ.get("BENCH_FUSED_CE") == "1")
         B = int(os.environ.get("BENCH_BATCH", 8))
         steps = int(os.environ.get("BENCH_STEPS", 10))
@@ -229,7 +259,9 @@ def bench_gpt(dev, small):
         "unit": "tokens/s",
         "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
         "config": f"gpt-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}-bf16"
-                  + ("-rc" if cfg.recompute else "")
+                  + (("-rc" + (f":{cfg.recompute_policy}"
+                               if cfg.recompute_policy else ""))
+                     if cfg.recompute else "")
                   + ("-fce" if cfg.fused_loss else ""),
         "params_m": round(n_params / 1e6, 1),
         "loss": float(np.asarray(loss.numpy(), dtype="float32")),
@@ -411,6 +443,8 @@ def bench_llama(dev, small):
                           num_heads=16, num_key_value_heads=16,
                           max_position_embeddings=max(S, 1024),
                           recompute=os.environ.get("BENCH_RECOMPUTE") == "1",
+                          recompute_policy=os.environ.get("BENCH_RC_POLICY")
+                          or None,
                           fused_loss=os.environ.get("BENCH_FUSED_CE", "1")
                           == "1")
         B = int(os.environ.get("BENCH_BATCH", 8))
@@ -448,7 +482,9 @@ def bench_llama(dev, small):
         "unit": "tokens/s",
         "vs_baseline": 1.0,
         "config": f"llama-h{cfg.hidden_size}-l{cfg.num_layers}-b{B}-s{S}"
-                  f"-bf16" + ("-rc" if cfg.recompute else "")
+                  f"-bf16" + (("-rc" + (f":{cfg.recompute_policy}"
+                                        if cfg.recompute_policy else ""))
+                              if cfg.recompute else "")
                   + ("-fce" if cfg.fused_loss else ""),
         "params_m": round(n_params / 1e6, 1),
         "loss": float(np.asarray(loss.numpy(), dtype="float32")),
@@ -538,9 +574,15 @@ def _run_ladder(model: str) -> bool:
     Mosaic failure in a lever run must not cost the round's number —
     round 2 lost its official TPU record to exactly that class of accident).
     Emits the best run's JSON line. Returns False if nothing succeeded."""
+    # r4 measured map (GPT-355M S1024, flash default): B8 plain wins —
+    # 36.3k tok/s / 39.25% MFU; every memory lever that buys a bigger batch
+    # (fce −12%, dots-remat, full remat) costs more than the batch gains
+    # (B16-dots-fce 29.2%, B32-rc-fce 24.8%). The lever rungs stay as
+    # regression tripwires for that conclusion, not as contenders.
     ladder = [
         ("b8-proven", {}),
-        ("b16-fused-ce", {"BENCH_BATCH": "16", "BENCH_FUSED_CE": "1"}),
+        ("b16-dots-fce", {"BENCH_BATCH": "16", "BENCH_FUSED_CE": "1",
+                          "BENCH_RECOMPUTE": "1", "BENCH_RC_POLICY": "dots"}),
         ("b32-fce-recompute", {"BENCH_BATCH": "32", "BENCH_FUSED_CE": "1",
                                "BENCH_RECOMPUTE": "1"}),
     ]
@@ -585,20 +627,24 @@ def _run_bonus_battery():
     must not burn hours of job budget or bank CPU rows as TPU evidence)."""
     here = os.path.dirname(os.path.abspath(__file__))
     jobs = [
+        # rc=1: plain B8 llama OOMs (10.6G optimizer state + no-remat
+        # activations, measured r4); full remat + fused-CE fits with room
         ("llama-0.76b", [sys.executable, os.path.abspath(__file__),
-                         "--model", "llama"], 2400),
+                         "--model", "llama"], 2400,
+         {"BENCH_BATCH": "8", "BENCH_RECOMPUTE": "1"}),
         ("flash-sweep", [sys.executable,
                          os.path.join(here, "tools", "bench_flash.py")],
-         3600),
+         3600, {}),
         ("adamw-ab", [sys.executable,
-                      os.path.join(here, "tools", "bench_adamw.py")], 1200),
+                      os.path.join(here, "tools", "bench_adamw.py")], 1200,
+         {}),
     ]
-    for desc, cmd, budget in jobs:
+    for desc, cmd, budget, extra in jobs:
         if not _probe_backend_subprocess(150.0, require_tpu=True):
             _log(f"bonus[{desc}]: tunnel no longer healthy; stopping battery")
             break
         res = _launch_banked(f"bonus[{desc}]", cmd, budget,
-                             {"BENCH_NO_CPU_FALLBACK": "1"})
+                             {"BENCH_NO_CPU_FALLBACK": "1", **extra})
         if res is None:
             _log("bonus: stopping battery (tunnel-wedge rule: no stacked "
                  "hung claims)")

@@ -102,8 +102,38 @@ def _discover_cells(function, params: Sequence = None) -> List:
     return cells
 
 
+#: named remat policies (the reference's recompute is all-or-nothing; on
+#: TPU a policy that saves MXU (matmul) outputs and recomputes only the
+#: cheap VPU elementwise ops buys most of the memory back for a few % of
+#: step time — measured r4 on GPT-355M)
+_POLICIES = {
+    None: None,
+    "full": None,  # recompute everything inside the segment
+    "dots": "dots_saveable",
+    "dots_saveable": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    "dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _resolve_policy(policy):
+    if policy is None or callable(policy):
+        return policy
+    name = _POLICIES.get(policy, policy)
+    if name is None:
+        return None
+    fn = getattr(jax.checkpoint_policies, name, None)
+    if fn is None:
+        raise ValueError(
+            f"unknown recompute policy {policy!r}; named options: "
+            f"{sorted(k for k in _POLICIES if isinstance(k, str))} "
+            "or any jax.checkpoint_policies attribute / callable")
+    return fn
+
+
 def recompute(function: Callable, *args, preserve_rng_state: bool = True,
-              use_reentrant: bool = True, params: Sequence = None, **kwargs):
+              use_reentrant: bool = True, params: Sequence = None,
+              policy=None, **kwargs):
     """reference: recompute.py:332 — run ``function(*args)`` WITHOUT keeping
     its intermediate activations; they are recomputed during backward.
 
@@ -111,8 +141,12 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
     Layers (auto-discovered); pass ``params=`` explicitly for anything more
     exotic. ``preserve_rng_state``/``use_reentrant`` are accepted for API
     parity (both behaviors are inherent here — see module docstring).
+    ``policy``: None/'full' (recompute everything), a named policy from
+    ``_POLICIES`` ('dots' saves matmul outputs, recomputing only the cheap
+    elementwise ops), or any ``jax.checkpoint_policies`` callable.
     """
     cells = _discover_cells(function, params)
+    ckpt_policy = _resolve_policy(policy)
 
     arg_tensors = [ensure_tensor(a) for a in args]
     n_args = len(arg_tensors)
@@ -135,8 +169,9 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
             return tuple(o._value if isinstance(o, Tensor) else o for o in out)
         return out._value if isinstance(out, Tensor) else out
 
-    return apply_op(jax.checkpoint(pure), arg_tensors + cells,
-                    name="recompute")
+    ckpt = (jax.checkpoint(pure, policy=ckpt_policy) if ckpt_policy
+            else jax.checkpoint(pure))
+    return apply_op(ckpt, arg_tensors + cells, name="recompute")
 
 
 def recompute_sequential(ctx: dict, functions, *args, **kwargs):

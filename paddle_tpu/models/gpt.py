@@ -60,6 +60,10 @@ class GPTConfig:
     # trades ~1/3 more FLOPs for O(layers) less live activation memory —
     # the standard lever for batching past HBM on one chip
     recompute: bool = False
+    # remat policy (fleet/recompute.py _POLICIES): None/'full' recomputes
+    # everything; 'dots' saves matmul outputs and recomputes only the cheap
+    # VPU elementwise ops — most of the memory for a few % of step time
+    recompute_policy: Optional[str] = None
     # fused chunked linear+CE (ops/fused_loss.py): never materializes the
     # [B·S, V] logits — O(N·V) loss memory drops to O(N·chunk), unlocking
     # larger per-chip batches. forward(labels=...) then returns (None, loss)
@@ -362,7 +366,7 @@ class GPTModel(nn.Layer):
             from ..distributed.fleet.recompute import recompute as _rc
 
             for layer in self.layers:
-                x = _rc(layer, x)
+                x = _rc(layer, x, policy=self.config.recompute_policy)
         else:
             for layer in self.layers:
                 x = layer(x)

@@ -59,6 +59,9 @@ class LlamaConfig:
     sequence_parallel: bool = False
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # remat policy (fleet/recompute.py _POLICIES): None/'full' recomputes
+    # everything; 'dots' saves matmul outputs, recomputing only elementwise
+    recompute_policy: Optional[str] = None
     # chunked linear+CE (ops/fused_loss.py): never materializes the
     # [B·S, V] logits; forward(labels=...) returns (None, loss).
     # mp==1 only — under tensor parallelism the vocab shard math belongs to
@@ -372,7 +375,7 @@ class LlamaModel(nn.Layer):
             from ..distributed.fleet.recompute import recompute as _rc
 
             for layer in self.layers:
-                x = _rc(layer, x)
+                x = _rc(layer, x, policy=self.config.recompute_policy)
         else:
             for layer in self.layers:
                 x = layer(x)

@@ -43,9 +43,13 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
 except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
-# Measured-best blocks (v5e, r4 sweep): (1024, 1024) wins or ties at every
-# S >= 1024 fwd+bwd; _pick_block clamps to S below that, which lands on the
-# measured-best (512, 512) at S=512.
+# Measured blocks (v5e, r4 sweeps): every config beats XLA, but the two
+# r4 sweeps disagree on the best S=1024 blocks — quick sweep: (1024,1024)
+# 1.17ms; full sweep: (1024,512) 1.73ms with (1024,1024) at 2.20ms — i.e.
+# the spread between large-block configs is within run-to-run noise.
+# (1024, 1024) is the default pending a higher-rep tie-break
+# (tools/bench_flash.py --s 1024 --reps N); _pick_block clamps to S below
+# 1024, landing on the measured-best (512, 512) at S=512.
 DEFAULT_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FLASH_BQ", 1024))
 DEFAULT_BLOCK_K = int(os.environ.get("PADDLE_TPU_FLASH_BK", 1024))
 NEG_INF = -1e30

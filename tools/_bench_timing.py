@@ -16,6 +16,15 @@ import time
 _RT_BASELINE = None
 
 
+def sync_fetch(x):
+    """Force REAL completion of jax array `x`: fetch a tiny host slice
+    derived from it (block_until_ready alone is not trustworthy here)."""
+    import jax
+    import jax.numpy as jnp
+
+    float(jax.device_get(jnp.sum(jnp.ravel(x)[:8].astype(jnp.float32))))
+
+
 def roundtrip_baseline(log=None):
     """Measured cost of one scalar fetch through the tunnel (min of 5)."""
     global _RT_BASELINE
@@ -57,9 +66,7 @@ def bench_chained(step, carry, consts, iters=32, reps=3, log=None,
         return jax.lax.fori_loop(0, iters, body, carry)
 
     def _sync(out):
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        float(jax.device_get(jnp.sum(
-            jnp.ravel(leaf)[:8].astype(jnp.float32))))
+        sync_fetch(jax.tree_util.tree_leaves(out)[0])
 
     out = many(carry, *consts)
     _sync(out)  # compile + settle

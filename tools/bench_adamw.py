@@ -53,8 +53,12 @@ def main():
     on_tpu = dev.platform in ("tpu", "axon")
     n = int(os.environ.get("BENCH_ADAMW_N", 355_000_000 if on_tpu
                            else 1_000_000))
-    n -= n % 8192  # tile-aligned: the kernel's pad path would otherwise
-    #                copy all four flat buffers every loop iteration
+    # align to BLOCK_ROWS*LANE (256*1024): the kernel's pad path would
+    # otherwise copy all four flat buffers every loop iteration, and a
+    # rows count not divisible by BLOCK_ROWS makes fused_adamw_flat halve
+    # its block (8192-alignment benched a crippled 16x1024 blocking — the
+    # kernel must be timed at its designed 256x1024)
+    n -= n % (256 * 1024)
     print(f"device={dev.platform} n={n}", file=sys.stderr)
     rng = np.random.default_rng(0)
     lr = jnp.float32(1e-4)

@@ -52,6 +52,12 @@ def main():
     from paddle_tpu.ops.pallas import flash_attention as fa
 
     quick = "--quick" in sys.argv
+    argv = sys.argv
+    # tie-break mode: --s 1024 --reps 9 restricts the sweep and raises
+    # repetitions (the r4 sweeps' large-block S=1024 configs differed by
+    # less than run-to-run noise at reps=3)
+    only_s = (int(argv[argv.index("--s") + 1]) if "--s" in argv else None)
+    reps = (int(argv[argv.index("--reps") + 1]) if "--reps" in argv else 3)
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
     _log(f"device: {dev.platform} (tpu={on_tpu})")
@@ -64,6 +70,8 @@ def main():
 
     H, D = 16, 64  # flagship head geometry (GPT-355M: 16 heads x 64)
     seqs = [1024] if quick else [512, 1024, 2048, 4096]
+    if only_s is not None:
+        seqs = [only_s]
     blocks = [(256, 512), (512, 512), (1024, 512), (512, 1024),
               (1024, 1024), (256, 1024)]
     causal, scale = True, 1.0 / np.sqrt(D)
@@ -114,8 +122,8 @@ def main():
             return step
 
         # XLA reference, fwd and fwd+bwd
-        t_fwd = _bench(_chain_fwd(xla_attn), q, k, v)
-        t_bwd = _bench(_chain_bwd(xla_attn), q, k, v)
+        t_fwd = _bench(_chain_fwd(xla_attn), q, k, v, reps=reps)
+        t_bwd = _bench(_chain_bwd(xla_attn), q, k, v, reps=reps)
         results[(S, "xla", None)] = (t_fwd, t_bwd)
         _log(f"S={S} B={B} xla          fwd {t_fwd*1e3:7.2f}ms  "
              f"fwd+bwd {t_bwd*1e3:7.2f}ms")
@@ -133,8 +141,8 @@ def main():
                 return fa._flash_attention(q, k, v, causal, scale, _bq, _bk)
 
             try:
-                t_fwd = _bench(_chain_fwd(pallas_attn), q, k, v)
-                t_bwd = _bench(_chain_bwd(pallas_attn), q, k, v)
+                t_fwd = _bench(_chain_fwd(pallas_attn), q, k, v, reps=reps)
+                t_bwd = _bench(_chain_bwd(pallas_attn), q, k, v, reps=reps)
             except Exception as e:
                 _log(f"S={S} pallas bq{bq}/bk{bk} FAILED: "
                      f"{type(e).__name__}: {str(e)[:160]}")
