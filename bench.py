@@ -638,6 +638,9 @@ def _run_bonus_battery():
         ("adamw-ab", [sys.executable,
                       os.path.join(here, "tools", "bench_adamw.py")], 1200,
          {}),
+        ("decode", [sys.executable,
+                    os.path.join(here, "tools", "bench_decode.py")], 1800,
+         {}),
     ]
     for desc, cmd, budget, extra in jobs:
         if not _probe_backend_subprocess(150.0, require_tpu=True):

@@ -47,9 +47,22 @@ class GenerationMixin:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0,
-                 eos_token_id: Optional[int] = None, seed: int = 0):
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 device_loop: Optional[bool] = None):
         """Returns [B, prompt+generated] token ids (generation stops early
-        when every row emitted ``eos_token_id``)."""
+        when every row emitted ``eos_token_id``).
+
+        ``device_loop``: run the whole decode as ONE compiled program — a
+        ``lax.while_loop`` whose carry holds the token buffer, KV caches,
+        PRNG key, and a stop flag (set when a step's tokens are ALL
+        ``eos_token_id``, the host loop's exact semantics — rows that hit
+        EOS early keep sampling until every row stops, as in the host
+        loop) — instead of one host-driven call per token. On TPU the host loop pays a device↔host round trip per
+        token (~63ms through the axon tunnel — more than the decode step
+        itself); the device loop pays one. Default: on for TPU backends,
+        off elsewhere (the host loop is easier to debug and can stop the
+        moment EOS lands instead of at the compiled cond check).
+        """
         import numpy as np
 
         from .. import jit
@@ -67,6 +80,9 @@ class GenerationMixin:
                 f"max_position_embeddings {cfg.max_position_embeddings}")
         was_training = self.training
         self.eval()
+        if device_loop is None:
+            # "axon" is the tunneled-TPU PJRT platform name
+            device_loop = jax.default_backend() in ("tpu", "axon")
 
         def step_fn(tok, cur, key, *flat_caches):
             caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
@@ -112,17 +128,96 @@ class GenerationMixin:
         tokens = np.asarray(nxt.numpy()).reshape(B, 1)
         out.append(tokens)
 
-        for step in range(1, max_new_tokens):
-            if eos_token_id is not None and np.all(tokens == eos_token_id):
-                break
+        if device_loop and max_new_tokens > 1:
+            # eos rides in as DATA (sentinel -1 = none): one compiled
+            # program serves every stop id
+            loop_key = ("loop",) + gen_key
+            loop = cache_map.get(loop_key)
+            if loop is None:
+                loop = jit.StaticFunction(
+                    self._make_device_loop(trunk, n_layers, B, S0,
+                                           max_new_tokens, temperature,
+                                           top_k),
+                    observe=[self], warmup=False, dy2static=False)
+                cache_map[loop_key] = loop
             k, rng_key = jax.random.split(rng_key)
-            res = decode(Tensor(jnp.asarray(tokens, jnp.int32)),
-                         Tensor(jnp.asarray(S0 + step - 1, jnp.int32)),
-                         Tensor(k), *flat)
-            nxt, flat = res[0], list(res[1:])
-            tokens = np.asarray(nxt.numpy()).reshape(B, 1)
-            out.append(tokens)
+            eos_t = Tensor(jnp.int32(eos_token_id
+                                     if eos_token_id is not None else -1),
+                           stop_gradient=True)
+            buf, n_gen = loop(nxt, Tensor(k), eos_t, *flat)
+            # one batched fetch — each host sync costs a tunnel round trip
+            buf_v, n_v = jax.device_get((buf._value, n_gen._value))
+            out[-1] = np.asarray(buf_v)[:, :int(n_v)]
+        else:
+            for step in range(1, max_new_tokens):
+                if eos_token_id is not None and np.all(
+                        tokens == eos_token_id):
+                    break
+                k, rng_key = jax.random.split(rng_key)
+                res = decode(Tensor(jnp.asarray(tokens, jnp.int32)),
+                             Tensor(jnp.asarray(S0 + step - 1, jnp.int32)),
+                             Tensor(k), *flat)
+                nxt, flat = res[0], list(res[1:])
+                tokens = np.asarray(nxt.numpy()).reshape(B, 1)
+                out.append(tokens)
 
         if was_training:
             self.train()
         return Tensor(jnp.asarray(np.concatenate(out, axis=1)))
+
+    def _make_device_loop(self, trunk, n_layers, B, S0, max_new_tokens,
+                          temperature, top_k):
+        """Build the whole-decode-in-one-program fn: carry = (token buffer
+        [B, max_new_tokens], count, PRNG key, stop, *flat KV caches);
+        stops at the buffer end or when a step's tokens are ALL ``eos``
+        (the host loop's exact early-exit semantics). ``eos`` is a data
+        operand (-1 = no stop id) so one program serves every stop id."""
+        from ..autograd.engine import no_grad
+
+        def loop_fn(first_tok, key, eos, *flat_caches):
+            def run(tok0_v, key_v, eos_v, *cache_vals):
+                eos_i = eos_v.astype(jnp.int32).reshape(())
+                buf0 = jnp.zeros((B, max_new_tokens), jnp.int32)
+                z0 = jnp.int32(0)
+                buf0 = jax.lax.dynamic_update_slice(
+                    buf0, tok0_v.reshape(B, 1).astype(jnp.int32), (z0, z0))
+
+                def cond(carry):
+                    buf, i, _, stop = carry[0], carry[1], carry[2], carry[3]
+                    return (i < max_new_tokens) & ~stop
+
+                def body(carry):
+                    buf, i, kv, stop = (carry[0], carry[1], carry[2],
+                                        carry[3])
+                    cvals = carry[4:]
+                    z = jnp.int32(0)  # literal ints trace i64 under x64
+                    tok = jax.lax.dynamic_slice(buf, (z, i - 1), (B, 1))
+                    caches = [(Tensor(cvals[2 * l], stop_gradient=True),
+                               Tensor(cvals[2 * l + 1], stop_gradient=True))
+                              for l in range(n_layers)]
+                    with no_grad():
+                        hidden, ncs = trunk(
+                            Tensor(tok, stop_gradient=True), caches=caches,
+                            cur_len=Tensor(S0 + i - 1, stop_gradient=True))
+                        logits = self.logits(hidden)
+                    last = logits._value[:, -1, :].astype(jnp.float32)
+                    kv, sub = jax.random.split(kv)
+                    nxt = self._sample(last, temperature, top_k, sub)
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, nxt.reshape(B, 1), (z, i))
+                    stop = (eos_i >= 0) & jnp.all(nxt == eos_i)
+                    new_cvals = tuple(t._value for c in ncs for t in c)
+                    return (buf, i + 1, kv, stop) + new_cvals
+
+                stop0 = (eos_i >= 0) & jnp.all(
+                    tok0_v.astype(jnp.int32) == eos_i)
+                init = (buf0, jnp.int32(1), key_v, stop0, *cache_vals)
+                fin = jax.lax.while_loop(cond, body, init)
+                return fin[0], fin[1]  # token buffer, count generated
+
+            return apply_op(run, [ensure_tensor(first_tok),
+                                  ensure_tensor(key), ensure_tensor(eos),
+                                  *[ensure_tensor(c) for c in flat_caches]],
+                            name="generate_device_loop")
+
+        return loop_fn

@@ -25,6 +25,9 @@ python tools/bench_flash.py
 echo "=== fused AdamW A/B ==="
 python tools/bench_adamw.py
 
+echo "=== decode throughput (device-side while_loop) ==="
+python tools/bench_decode.py
+
 echo "=== eager dispatch (TPU) ==="
 python tools/bench_eager.py
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Autoregressive decode throughput (KV-cache, device-side while_loop).
+
+GPT-355M greedy decode on one chip: B8, prompt 128, 128 new tokens — the
+whole decode is ONE compiled program (models/generation.py device loop),
+so the measurement is real device time, not 63ms-per-token tunnel round
+trips. Appends the result to BENCH_NOTES_r04.json.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+_NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                      "BENCH_NOTES_r04.json")
+
+
+def main():
+    import jax
+
+    from _bench_timing import roundtrip_baseline
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    if not on_tpu:
+        print("not on TPU — aborting (decode numbers are tunnel-specific)",
+              file=sys.stderr)
+        sys.exit(2)
+
+    B = int(os.environ.get("BENCH_BATCH", 8))
+    prompt = int(os.environ.get("BENCH_PROMPT", 128))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", 128))
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=16, max_position_embeddings=prompt + new,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, prompt)))
+
+    t0 = time.time()
+    out = model.generate(ids, max_new_tokens=new, temperature=0.0,
+                         device_loop=True)
+    compile_s = time.time() - t0
+    rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, temperature=0.0,
+                             device_loop=True)
+        best = min(best, time.perf_counter() - t0 - rt)
+    # generate() fetches the result (host concat) — already synced
+    tok_s = B * new / best
+    rec = {
+        "metric": "gpt_decode_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
+        "config": f"gpt-355m-decode-b{B}-p{prompt}-n{new}-greedy",
+        "total_s": round(best, 3), "compile_s": round(compile_s, 1),
+        "per_token_ms": round(1e3 * best / new, 2),
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
