@@ -52,12 +52,15 @@ class GenerationMixin:
         """Returns [B, prompt+generated] token ids (generation stops early
         when every row emitted ``eos_token_id``).
 
+        EOS semantics (both loops, PaddleNLP/HF style): a row that emits
+        ``eos_token_id`` is frozen — every later position in that row is
+        filled with ``eos_token_id`` — and generation stops once ALL rows
+        have finished (or at ``max_new_tokens``).
+
         ``device_loop``: run the whole decode as ONE compiled program — a
         ``lax.while_loop`` whose carry holds the token buffer, KV caches,
-        PRNG key, and a stop flag (set when a step's tokens are ALL
-        ``eos_token_id``, the host loop's exact semantics — rows that hit
-        EOS early keep sampling until every row stops, as in the host
-        loop) — instead of one host-driven call per token. On TPU the host loop pays a device↔host round trip per
+        PRNG key, and per-row done flags — instead of one host-driven
+        call per token. On TPU the host loop pays a device↔host round trip per
         token (~63ms through the axon tunnel — more than the decode step
         itself); the device loop pays one. Default: on for TPU backends,
         off elsewhere (the host loop is easier to debug and can stop the
@@ -149,9 +152,10 @@ class GenerationMixin:
             buf_v, n_v = jax.device_get((buf._value, n_gen._value))
             out[-1] = np.asarray(buf_v)[:, :int(n_v)]
         else:
+            done = (tokens[:, 0] == eos_token_id) if eos_token_id is not None \
+                else np.zeros(B, bool)
             for step in range(1, max_new_tokens):
-                if eos_token_id is not None and np.all(
-                        tokens == eos_token_id):
+                if eos_token_id is not None and done.all():
                     break
                 k, rng_key = jax.random.split(rng_key)
                 res = decode(Tensor(jnp.asarray(tokens, jnp.int32)),
@@ -159,6 +163,10 @@ class GenerationMixin:
                              Tensor(k), *flat)
                 nxt, flat = res[0], list(res[1:])
                 tokens = np.asarray(nxt.numpy()).reshape(B, 1)
+                if eos_token_id is not None:
+                    # frozen rows keep emitting eos (HF/PaddleNLP padding)
+                    tokens = np.where(done[:, None], eos_token_id, tokens)
+                    done = done | (tokens[:, 0] == eos_token_id)
                 out.append(tokens)
 
         if was_training:
@@ -169,9 +177,10 @@ class GenerationMixin:
                           temperature, top_k):
         """Build the whole-decode-in-one-program fn: carry = (token buffer
         [B, max_new_tokens], count, PRNG key, stop, *flat KV caches);
-        stops at the buffer end or when a step's tokens are ALL ``eos``
-        (the host loop's exact early-exit semantics). ``eos`` is a data
-        operand (-1 = no stop id) so one program serves every stop id."""
+        stops at the buffer end or when every row has emitted ``eos``
+        (per-row freeze: finished rows pad with eos — the host loop's
+        exact semantics). ``eos`` is a data operand (-1 = no stop id) so
+        one program serves every stop id."""
         from ..autograd.engine import no_grad
 
         def loop_fn(first_tok, key, eos, *flat_caches):
@@ -183,11 +192,12 @@ class GenerationMixin:
                     buf0, tok0_v.reshape(B, 1).astype(jnp.int32), (z0, z0))
 
                 def cond(carry):
-                    buf, i, _, stop = carry[0], carry[1], carry[2], carry[3]
-                    return (i < max_new_tokens) & ~stop
+                    i, done = carry[1], carry[3]
+                    return (i < max_new_tokens) & ~(
+                        (eos_i >= 0) & jnp.all(done))
 
                 def body(carry):
-                    buf, i, kv, stop = (carry[0], carry[1], carry[2],
+                    buf, i, kv, done = (carry[0], carry[1], carry[2],
                                         carry[3])
                     cvals = carry[4:]
                     z = jnp.int32(0)  # literal ints trace i64 under x64
@@ -203,15 +213,17 @@ class GenerationMixin:
                     last = logits._value[:, -1, :].astype(jnp.float32)
                     kv, sub = jax.random.split(kv)
                     nxt = self._sample(last, temperature, top_k, sub)
+                    # frozen rows keep emitting eos (HF/PaddleNLP padding)
+                    nxt = jnp.where((eos_i >= 0) & done, eos_i, nxt)
+                    done = done | ((eos_i >= 0) & (nxt == eos_i))
                     buf = jax.lax.dynamic_update_slice(
                         buf, nxt.reshape(B, 1), (z, i))
-                    stop = (eos_i >= 0) & jnp.all(nxt == eos_i)
                     new_cvals = tuple(t._value for c in ncs for t in c)
-                    return (buf, i + 1, kv, stop) + new_cvals
+                    return (buf, i + 1, kv, done) + new_cvals
 
-                stop0 = (eos_i >= 0) & jnp.all(
-                    tok0_v.astype(jnp.int32) == eos_i)
-                init = (buf0, jnp.int32(1), key_v, stop0, *cache_vals)
+                done0 = (eos_i >= 0) & (tok0_v.astype(jnp.int32).reshape(B)
+                                        == eos_i)
+                init = (buf0, jnp.int32(1), key_v, done0, *cache_vals)
                 fin = jax.lax.while_loop(cond, body, init)
                 return fin[0], fin[1]  # token buffer, count generated
 

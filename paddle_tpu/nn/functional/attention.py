@@ -93,14 +93,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         rng_key = default_generator.next_key()
 
     seq_len = int(query.shape[1]) if len(query.shape) >= 2 else 0
-    if attn_mask is None and drop == 0.0 and _use_pallas(query._value,
-                                                         seq_len):
+    if attn_mask is None and _use_pallas(query._value, seq_len):
         from ...ops.pallas import flash_attention as fa
 
-        def fn(q, k, v):
-            return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+        # dropout runs INSIDE the kernel (counter-based hash mask — no
+        # [S,S] mask materialization; the naive path's u32 bernoulli draw
+        # is 512MB/layer at B8 S1024 H16). The seed is derived from the
+        # framework RNG key as DATA — under StaticFunction tracing the key
+        # is traced state, so a host int would be a TracerArrayConversion
+        # error (and a retrace per step even if it weren't).
+        ins = [query, key, value]
+        if drop > 0.0:
+            from ...tensor import Tensor
 
-        return apply_op(fn, [query, key, value], name="flash_attention")
+            seed_val = jax.random.randint(
+                rng_key, (), 0, 1 << 24).astype(jnp.float32)
+            ins.append(Tensor(seed_val, stop_gradient=True))
+
+        def fn(q, k, v, *s, _p=drop):
+            return fa.flash_attention_bshd(
+                q, k, v, causal=is_causal, dropout_p=_p,
+                dropout_seed=(s[0] if s else 0))
+
+        return apply_op(fn, ins, name="flash_attention")
 
     ins = [query, key, value]
     has_mask = attn_mask is not None

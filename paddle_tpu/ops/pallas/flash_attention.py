@@ -90,13 +90,47 @@ def _pick_block(seq: int, block: int) -> int:
     return block
 
 
+def _keep_mask(seed, bh, qi, ki, block_q: int, block_k: int,
+               drop_p: float):
+    """Deterministic dropout keep-mask for score block (bh, qi, ki).
+
+    Counter-based hash (xorshift-multiply rounds) on the GLOBAL element
+    coordinates in plain i32 jnp ops: the same (seed, batch-head, row,
+    col) always yields the same bit, so the dq and dkv kernels reproduce
+    the forward's mask exactly — regardless of their different grid
+    orders or block shapes — with no PRNG-state plumbing, and it runs
+    under interpret mode (pltpu.prng_seed has no CPU lowering).
+
+    ``seed`` is a DATA value (f32 scalar holding an int < 2^24, exact in
+    f32): under StaticFunction tracing the framework RNG key is traced
+    state, so the seed cannot be a static python int."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    seed_i = seed.astype(jnp.int32) if hasattr(seed, "astype") \
+        else jnp.int32(seed)
+    x = (rows * jnp.int32(-1640531527)          # 0x9E3779B9
+         ^ cols * jnp.int32(-2048144789)        # 0x85EBCA6B
+         ^ (seed_i + bh * jnp.int32(668265263)))  # 0x27D4EB2F
+    x = x ^ (x >> 15)
+    x = x * jnp.int32(-2045495917)              # 0x85EBCA77^... odd const
+    x = x ^ (x >> 13)
+    x = x * jnp.int32(-1028477387)              # 0xC2B2AE35
+    x = x ^ (x >> 16)
+    u = (x & jnp.int32(0xFFFFFF)).astype(jnp.float32) / 16777216.0
+    return u >= jnp.float32(drop_p)
+
+
 # ───────────────────────────── forward ─────────────────────────────
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+def _attn_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc_ref,
+                 m_ref, l_ref, *,
                  causal: bool, scale: float, block_q: int, block_k: int,
-                 seq_q: int, seq_k: int):
-    qi = pl.program_id(1)
+                 seq_q: int, seq_k: int, drop_p: float = 0.0):
+    bh = pl.program_id(0)  # read at kernel top: program_id inside a
+    qi = pl.program_id(1)  # pl.when body escapes the interpret context
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -142,6 +176,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_new = alpha * l_prev + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
         v = v_ref[0]  # [bk, d]
+        if drop_p > 0.0:
+            # after-softmax dropout: l (the softmax denominator) uses the
+            # UNmasked p, so mask∘(p/l) == (mask∘p)/l — apply to the pv
+            # accumulation only
+            keep = _keep_mask(seed_ref[0, 0], bh, qi, ki,
+                              block_q, block_k, drop_p)
+            p = jnp.where(keep, p, 0.0) / jnp.float32(1.0 - drop_p)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -158,9 +199,19 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0] = m_ref[...] + jnp.log(l_fin)
 
 
+def _scalar_spec():
+    """(1,1) scalar block: SMEM on the real TPU backend, plain VMEM-ish
+    block under interpret (SMEM has no interpret support)."""
+    if _HAS_PLTPU and not _interpret():
+        return pl.BlockSpec((1, 1), lambda *_: (_i32(0), _i32(0)),
+                            memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), lambda *_: (_i32(0), _i32(0)))
+
+
 def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    drop_p: float = 0.0, drop_seed=0):
     """q,k,v: [BH, S, D] → (out [BH, Sq, D], lse [BH, Sq] f32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -175,14 +226,17 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
     nk = kp.shape[1] // bk
 
     grid = (bh, nq, nk)
+    seed2 = jnp.full((1, 1), drop_seed, jnp.float32)
     out, lse = pl.pallas_call(
         functools.partial(_attn_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk, seq_q=sq, seq_k=sk),
+                          block_q=bq, block_k=bk, seq_q=sq, seq_k=sk,
+                          drop_p=drop_p),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
+            _scalar_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
@@ -199,16 +253,17 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
         ],
         interpret=_interpret(),
         **_compiler_params(),
-    )(qp, kp, vp)
+    )(qp, kp, vp, seed2)
     return out[:, :sq], lse[:, :sq, 0]
 
 
 # ───────────────────────────── backward ─────────────────────────────
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
-               dq_acc, *, causal: bool, scale: float, block_q: int,
-               block_k: int, seq_q: int, seq_k: int):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, seed_ref,
+               dq_ref, dq_acc, *, causal: bool, scale: float, block_q: int,
+               block_k: int, seq_q: int, seq_k: int, drop_p: float = 0.0):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -246,6 +301,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)  # [bq, bk]
+        if drop_p > 0.0:
+            # dL/dp routes only through kept positions (same mask as fwd);
+            # note Δ = rowsum(do∘o) already equals rowsum(p∘dp_eff)
+            keep = _keep_mask(seed_ref[0, 0], bh, qi, ki,
+                              block_q, block_k, drop_p)
+            dp = jnp.where(keep, dp, 0.0) / jnp.float32(1.0 - drop_p)
         ds = (p * (dp - dlt)).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -257,9 +318,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
         dq_ref[0] = (dq_acc[...] * jnp.float32(scale)).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
-                dv_ref, dk_acc, dv_acc, *, causal: bool, scale: float,
-                block_q: int, block_k: int, seq_q: int, seq_k: int):
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, seed_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                scale: float, block_q: int, block_k: int, seq_q: int,
+                seq_k: int, drop_p: float = 0.0):
+    bh = pl.program_id(0)
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -294,9 +357,19 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
         if causal:
             mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
-        pl_ = p.astype(do.dtype)
+        if drop_p > 0.0:
+            # same (seed, b, row, col) hash as the fwd — the dkv grid
+            # iterates (b, kj, qi) but the mask depends only on global
+            # coordinates, so order is irrelevant
+            keep = _keep_mask(seed_ref[0, 0], bh, qi, kj,
+                              block_q, block_k, drop_p)
+            inv = jnp.float32(1.0 - drop_p)
+            p_eff = jnp.where(keep, p, 0.0) / inv
+        else:
+            keep, inv, p_eff = None, None, p
+        pl_ = p_eff.astype(do.dtype)
 
-        # dv += pᵀ · do : contract the bq dim
+        # dv += p_effᵀ · do : contract the bq dim (dropout: out = p_eff·v)
         dv_acc[...] += jax.lax.dot_general(
             pl_, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -305,6 +378,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)  # [bq, bk]
+        if drop_p > 0.0:
+            dp = jnp.where(keep, dp, 0.0) / inv
         ds = (p * (dp - dlt)).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -319,7 +394,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, dk_ref,
 
 def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    drop_p: float = 0.0, drop_seed=0):
     """All [BH, S, D] (lse [BH, Sq]) → (dq, dk, dv)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -350,7 +426,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
     nq = qp.shape[1] // bq
     nk = kp.shape[1] // bk
     kw = dict(causal=causal, scale=scale, block_q=bq, block_k=bk,
-              seq_q=sq, seq_k=sk)
+              seq_q=sq, seq_k=sk, drop_p=drop_p)
+    seed2 = jnp.full((1, 1), drop_seed, jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **kw),
@@ -362,13 +439,14 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
+            _scalar_spec(),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
         out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
         **_compiler_params(),
-    )(qp, kp, vp, dop, lse_b, dlt_b)
+    )(qp, kp, vp, dop, lse_b, dlt_b, seed2)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, **kw),
@@ -380,6 +458,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
+            _scalar_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
@@ -395,7 +474,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
         ],
         interpret=_interpret(),
         **_compiler_params(),
-    )(kp, vp, qp, dop, lse_b, dlt_b)
+    )(kp, vp, qp, dop, lse_b, dlt_b, seed2)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
@@ -428,43 +507,65 @@ def _from_bh(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal: bool, scale: float,
-                     block_q: int, block_k: int):
-    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, drop_seed, causal: bool, scale: float,
+                     block_q: int, block_k: int, drop_p: float = 0.0):
+    # drop_seed is an f32 scalar OPERAND (position 3): under StaticFunction
+    # tracing the framework RNG is traced state, so the seed cannot be a
+    # static python int without retracing per step
+    o, _ = _fwd(q, k, v, drop_seed, causal, scale, block_q, block_k, drop_p)
     return o
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
+def _fwd(q, k, v, drop_seed, causal, scale, block_q, block_k, drop_p=0.0):
     b, sq, h, d = q.shape
     of, lse = _flash_fwd_bhsd(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
-                              block_q=block_q, block_k=block_k)
+                              block_q=block_q, block_k=block_k,
+                              drop_p=drop_p, drop_seed=drop_seed)
     o = _from_bh(of, b, h)
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, drop_seed, o, lse)
 
 
-def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, o, lse = res
+def _bwd(causal, scale, block_q, block_k, drop_p, res, g):
+    q, k, v, drop_seed, o, lse = res
     b, sq, h, d = q.shape
     dq, dk, dv = _flash_bwd_bhsd(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
-        causal, scale, block_q=block_q, block_k=block_k)
-    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
+        causal, scale, block_q=block_q, block_k=block_k,
+        drop_p=drop_p, drop_seed=drop_seed)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
+            jnp.zeros_like(drop_seed))
 
 
 _flash_attention.defvjp(_fwd, _bwd)
 
 
 def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None,
-                         block_q: int = None, block_k: int = None):
+                         block_q: int = None, block_k: int = None,
+                         dropout_p: float = 0.0, dropout_seed: int = 0):
     """Flash attention, paddle layout [B, S, H, D]. Fwd and bwd are both
     Pallas flash kernels (no [S,S] materialization in either direction).
     Block sizes default to the measured-best ladder (PADDLE_TPU_FLASH_BQ/BK
-    env overrides; explicit args win — the sweep harness uses them)."""
+    env overrides; explicit args win — the sweep harness uses them).
+
+    ``dropout_p``: after-softmax attention dropout INSIDE the kernel (the
+    reference's flash_attn dropout — flash_attn_kernel.cu takes a
+    dropout rate). The keep-mask is a counter-based hash of the global
+    (seed, batch-head, row, col), so fwd and both bwd kernels reproduce
+    it exactly without materializing an [S, S] mask. ``dropout_seed`` is
+    DATA (int or traced scalar < 2^24; exact in the f32 it rides in), so
+    a fresh per-step seed costs no retrace."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PLTPU:
+        if dropout_p > 0.0:
+            raise NotImplementedError(
+                "flash_attention_bshd dropout requires the pallas TPU "
+                "backend (this build lacks jax.experimental.pallas.tpu); "
+                "silently training without dropout would be worse")
         return _ref_attention_bshd(q, k, v, causal, scale)
-    return _flash_attention(q, k, v, causal, scale,
+    seed_f = jnp.asarray(dropout_seed, jnp.float32)
+    return _flash_attention(q, k, v, seed_f, causal, scale,
                             block_q or DEFAULT_BLOCK_Q,
-                            block_k or DEFAULT_BLOCK_K)
+                            block_k or DEFAULT_BLOCK_K,
+                            float(dropout_p))

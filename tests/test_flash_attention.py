@@ -35,7 +35,7 @@ def test_forward_matches_reference(causal, sq, sk):
     k = _rand((b, sk, h, d), 1)
     v = _rand((b, sk, h, d), 2)
     scale = 1.0 / np.sqrt(d)
-    out = fa._flash_attention(q, k, v, causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
+    out = fa._flash_attention(q, k, v, jnp.float32(0), causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
     ref = fa._ref_attention_bshd(q, k, v, causal, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -51,7 +51,7 @@ def test_backward_matches_reference(causal, sq):
     scale = 1.0 / np.sqrt(d)
 
     def loss_flash(q, k, v):
-        return jnp.sum(fa._flash_attention(q, k, v, causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K) ** 2)
+        return jnp.sum(fa._flash_attention(q, k, v, jnp.float32(0), causal, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(fa._ref_attention_bshd(q, k, v, causal, scale) ** 2)
@@ -71,7 +71,7 @@ def test_cross_attention_backward():
     v = _rand((b, sk, h, d), 8)
     scale = 1.0 / np.sqrt(d)
     g_flash = jax.grad(
-        lambda q, k, v: jnp.sum(fa._flash_attention(q, k, v, True, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)),
+        lambda q, k, v: jnp.sum(fa._flash_attention(q, k, v, jnp.float32(0), True, scale, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)),
         argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(
         lambda q, k, v: jnp.sum(fa._ref_attention_bshd(q, k, v, True, scale)),
@@ -92,7 +92,7 @@ def test_backward_jaxpr_has_no_SxS_intermediate():
 
     jaxpr = jax.make_jaxpr(
         jax.grad(lambda q, k, v: jnp.sum(
-            fa._flash_attention(q, k, v, True, 0.125, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K))),
+            fa._flash_attention(q, k, v, jnp.float32(0), True, 0.125, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K))),
     )(q, k, v)
     for eqn in jaxpr.jaxpr.eqns:
         if eqn.primitive.name == "pallas_call":
@@ -135,14 +135,14 @@ def test_flash_block_config_matrix(bq, bk):
     k = _rand((1, 256, 2, 32), 6)
     v = _rand((1, 256, 2, 32), 7)
     scale = 1.0 / np.sqrt(32)
-    out = fa._flash_attention(q, k, v, True, scale, bq, bk)
+    out = fa._flash_attention(q, k, v, jnp.float32(0), True, scale, bq, bk)
     ref = fa._ref_attention_bshd(q, k, v, True, scale)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=2e-2, atol=2e-2)
     # backward too: the sweep times fwd+bwd
     g = jax.grad(lambda q, k, v: jnp.sum(
-        fa._flash_attention(q, k, v, True, scale, bq, bk)
+        fa._flash_attention(q, k, v, jnp.float32(0), True, scale, bq, bk)
         .astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
     for arr in g:
         assert np.all(np.isfinite(np.asarray(arr, np.float32)))
@@ -168,3 +168,77 @@ def test_causality_no_future_leak(d):
     # and the final row DOES see its own (non-future) key: sanity that the
     # probe can detect a change at all
     assert float(jnp.max(jnp.abs(out2[:, -1] - out[:, -1]))) > 1e-3
+
+
+def test_dropout_zero_matches_no_dropout():
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = _rand((b, s, h, d), 20), _rand((b, s, h, d), 21), _rand((b, s, h, d), 22)
+    base = fa.flash_attention_bshd(q, k, v, causal=True)
+    zero = fa.flash_attention_bshd(q, k, v, causal=True, dropout_p=0.0,
+                                   dropout_seed=123)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+
+
+def test_dropout_statistics_and_determinism():
+    """In-kernel dropout: deterministic given a seed, different across
+    seeds, and ~E[out] preserved (inverted-dropout scaling)."""
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = _rand((b, s, h, d), 23), _rand((b, s, h, d), 24), _rand((b, s, h, d), 25)
+    a1 = np.asarray(fa.flash_attention_bshd(q, k, v, dropout_p=0.3,
+                                            dropout_seed=7))
+    a2 = np.asarray(fa.flash_attention_bshd(q, k, v, dropout_p=0.3,
+                                            dropout_seed=7))
+    a3 = np.asarray(fa.flash_attention_bshd(q, k, v, dropout_p=0.3,
+                                            dropout_seed=8))
+    np.testing.assert_array_equal(a1, a2)
+    assert np.abs(a1 - a3).max() > 1e-4, "seed has no effect"
+    ref = np.asarray(fa.flash_attention_bshd(q, k, v))
+    # inverted dropout preserves the mean output magnitude (loose bound:
+    # attention rows are convex combos, dropping 30% adds variance)
+    assert np.abs(a1.mean() - ref.mean()) < 0.1
+
+
+def test_dropout_backward_consistent_with_forward():
+    """The bwd kernels must reproduce the fwd's hash mask exactly: check
+    d/dq, d/dk AND d/dv against finite differences of the kernel's own
+    (deterministic) forward. dv exercises the p_eff·do path; dq/dk
+    exercise the subtler ds = p·(dp_eff − Δ) path (mask applied to dp but
+    not p, Δ = rowsum(do∘o) = rowsum(p∘dp_eff))."""
+    b, s, h, d = 1, 128, 1, 64
+    q = _rand((b, s, h, d), 26)
+    k = _rand((b, s, h, d), 27)
+    v = _rand((b, s, h, d), 28)
+
+    def f(qq, kk, vv):
+        return jnp.sum(fa.flash_attention_bshd(
+            qq, kk, vv, causal=True, dropout_p=0.4, dropout_seed=99)
+            .astype(jnp.float32) * 1.7)
+
+    grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    eps = 1e-2
+    rng = np.random.default_rng(0)
+    for argn, (name, arr) in enumerate([("dq", q), ("dk", k), ("dv", v)]):
+        g = grads[argn]
+        for _ in range(4):
+            i = tuple(rng.integers(0, dim) for dim in arr.shape)
+            args_p = [q, k, v]
+            args_m = [q, k, v]
+            args_p[argn] = arr.at[i].add(eps)
+            args_m[argn] = arr.at[i].add(-eps)
+            fd = (f(*args_p) - f(*args_m)) / (2 * eps)
+            assert abs(float(g[i]) - float(fd)) < 5e-2, (
+                f"{name} mismatch at {i}: analytic {float(g[i])} "
+                f"vs fd {float(fd)}")
+
+
+def test_dropout_mask_block_layout_invariant():
+    """The hash mask depends on global coordinates only: different block
+    configs must produce the SAME dropped positions."""
+    b, s, h, d = 1, 256, 1, 64
+    q, k, v = _rand((b, s, h, d), 29), _rand((b, s, h, d), 30), _rand((b, s, h, d), 31)
+    seed = jnp.float32(42)
+    a = np.asarray(fa._flash_attention(q, k, v, seed, False, 0.125,
+                                       128, 128, 0.25))
+    bb = np.asarray(fa._flash_attention(q, k, v, seed, False, 0.125,
+                                        256, 128, 0.25))
+    np.testing.assert_allclose(a, bb, atol=2e-5, rtol=2e-5)

@@ -66,6 +66,41 @@ def test_device_loop_eos_early_exit():
     np.testing.assert_array_equal(host, dev)
 
 
+def test_per_row_eos_freeze():
+    """A row that emits EOS is frozen (pads with EOS) while other rows
+    keep generating — HF/PaddleNLP semantics, identical in both loops."""
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=96, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+    ids = paddle.to_tensor(
+        np.random.default_rng(3).integers(0, 128, (2, 8)))
+    full = np.asarray(m.generate(ids, max_new_tokens=10, temperature=0.0,
+                                 device_loop=False).numpy())
+    gen = full[:, 8:]
+    # pick an eos only ONE row emits (and not at the same step as the other)
+    eos = None
+    for tok in gen[0]:
+        if tok not in gen[1]:
+            eos = int(tok)
+            break
+    assert eos is not None, "degenerate sample: rows identical"
+    host = np.asarray(m.generate(ids, max_new_tokens=10, temperature=0.0,
+                                 eos_token_id=eos,
+                                 device_loop=False).numpy())
+    dev = np.asarray(m.generate(ids, max_new_tokens=10, temperature=0.0,
+                                eos_token_id=eos,
+                                device_loop=True).numpy())
+    np.testing.assert_array_equal(host, dev)
+    # after row 0's first eos, every row-0 token must be eos
+    row0 = host[0, 8:]
+    first = int(np.argmax(row0 == eos))
+    assert (row0[first:] == eos).all()
+    # row 1 is unaffected up to the shared stopping point
+    np.testing.assert_array_equal(host[1], full[1, :host.shape[1]])
+
+
 def test_device_loop_sampled_is_plausible():
     """Sampled (temperature>0) device-loop generation returns in-vocab
     tokens of the right shape (exact RNG parity with the host loop is not

@@ -138,7 +138,7 @@ def main():
                 continue
 
             def pallas_attn(q, k, v, _bq=bq, _bk=bk):
-                return fa._flash_attention(q, k, v, causal, scale, _bq, _bk)
+                return fa._flash_attention(q, k, v, jnp.float32(0), causal, scale, _bq, _bk)
 
             try:
                 t_fwd = _bench(_chain_fwd(pallas_attn), q, k, v, reps=reps)

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Tunnel-return battery, most-valuable-first so a re-wedge costs least.
+# Each step runs under its own timeout; a hang kills only that step.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
+timeout 1800 python tools/bisect_llama_tpu.py
+echo "bisect rc=$?"
+
+echo "=== 2. resnet50 re-measure (old row is suspect-high) ==="
+BENCH_SMALL=0 timeout 900 python bench.py --model resnet50
+
+echo "=== 3. fused AdamW re-verdict at designed 256x1024 blocking ==="
+timeout 900 python tools/bench_adamw.py
+
+echo "=== 4. flash S=1024 block tie-break (reps=9) ==="
+timeout 1200 python tools/bench_flash.py --s 1024 --reps 9
+
+echo "=== 5. bert re-measure with chained clock ==="
+timeout 900 python bench.py --model bert
+
+echo "=== 6. decode throughput (device-side while_loop) ==="
+timeout 1800 python tools/bench_decode.py
+
+echo "=== 7. bert B64 batch probe ==="
+BENCH_BATCH=64 timeout 900 python bench.py --model bert
+
+echo "done — see BENCH_NOTES_r04.json"
