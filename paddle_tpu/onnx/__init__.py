@@ -125,6 +125,13 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
     # call through Layer.__call__ so forward-pre/post hooks run (weight_norm
     # and spectral_norm recompute their weights in pre-hooks)
     fwd = layer if callable(layer) else layer.forward
+    # a to_static-wrapped forward carries a jit trace cache keyed on avals,
+    # not on the flash flag below — a model already run on TPU would replay
+    # a cached jaxpr containing pallas_call. Trace the underlying dygraph
+    # function instead.
+    dyfn = getattr(getattr(layer, "forward", None), "dygraph_function", None)
+    if dyfn is not None:
+        fwd = dyfn
     was_training = getattr(layer, "training", False)
     if hasattr(layer, "eval"):
         layer.eval()
@@ -136,9 +143,16 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
         return tuple(o._value if isinstance(o, Tensor) else o
                      for o in leaves)
 
+    # on a TPU host the attention dispatch would stage a pallas_call into
+    # the jaxpr, which has no ONNX mapping — trace with the XLA path
+    from ..nn.functional import attention as _attn
+
+    prev_flash = _attn.pallas_flash_enabled
+    _attn.pallas_flash_enabled = False
     try:
         closed = jax.make_jaxpr(pure)(*example)
     finally:
+        _attn.pallas_flash_enabled = prev_flash
         if was_training and hasattr(layer, "train"):
             layer.train()
 

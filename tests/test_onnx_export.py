@@ -255,3 +255,33 @@ def test_while_loop_passthrough_carry_body_output_is_produced(tmp_path):
                     produced.add(o.decode())
             outs = [wire.read_message(o)[1][0].decode() for o in body[12]]
             assert all(o in produced for o in outs), (outs, produced)
+
+
+def test_export_to_static_wrapped_layer(tmp_path):
+    """onnx.export of a to_static-wrapped Layer must trace the underlying
+    dygraph function, not the cached jit program (a TPU-host cache would
+    replay a jaxpr containing pallas_call, which has no ONNX mapping)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, nn, onnx
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return paddle.nn.functional.relu(self.fc(x))
+
+    m = M()
+    m = jit.to_static(m)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (2, 8)).astype("float32"))
+    _ = m(x)  # populate the jit trace cache
+    assert hasattr(m.forward, "dygraph_function")
+    path = onnx.export(m, str(tmp_path / "m"), input_spec=[x])
+    assert path.endswith(".onnx")
+    import os
+
+    assert os.path.getsize(path) > 100
