@@ -93,7 +93,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         rng_key = default_generator.next_key()
 
     seq_len = int(query.shape[1]) if len(query.shape) >= 2 else 0
-    if attn_mask is None and _use_pallas(query._value, seq_len):
+
+    def _as_key_padding(mask, batch):
+        """ONLY the unambiguous [B, 1, 1, Sk] BOOL form (True = attend)
+        → [B, Sk] keep array; anything else returns None and stays on
+        the XLA path. 2D/3D bool masks are NOT accepted: under XLA's
+        trailing-dim broadcast a [Sq, Sk] or [B/H-aligned, Sk] mask means
+        per-query/per-head masking, which is not key padding — routing
+        them to the kernel would silently change semantics per device.
+        The batch dim must match exactly (a broadcast [1,1,1,Sk] with
+        B>1 would under-fill the kernel's [B·H] grid)."""
+        if mask is None or mask.dtype != jnp.bool_:
+            return None
+        shp = tuple(int(x) for x in mask.shape)
+        if (len(shp) == 4 and shp[0] == batch and shp[1] == 1
+                and shp[2] == 1):
+            return mask.reshape(shp[0], shp[3])
+        return None
+
+    mask_val = ensure_tensor(attn_mask)._value if attn_mask is not None \
+        else None
+    kpad = _as_key_padding(mask_val, int(query.shape[0]))
+    if ((attn_mask is None or kpad is not None)
+            and _use_pallas(query._value, seq_len)):
         from ...ops.pallas import flash_attention as fa
 
         # dropout runs INSIDE the kernel (counter-based hash mask — no
@@ -102,18 +124,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         # framework RNG key as DATA — under StaticFunction tracing the key
         # is traced state, so a host int would be a TracerArrayConversion
         # error (and a retrace per step even if it weren't).
-        ins = [query, key, value]
-        if drop > 0.0:
-            from ...tensor import Tensor
+        from ...tensor import Tensor
 
+        ins = [query, key, value]
+        has_seed = drop > 0.0
+        if has_seed:
             seed_val = jax.random.randint(
                 rng_key, (), 0, 1 << 24).astype(jnp.float32)
             ins.append(Tensor(seed_val, stop_gradient=True))
+        has_kpad = kpad is not None
+        if has_kpad:
+            ins.append(Tensor(kpad, stop_gradient=True))
 
-        def fn(q, k, v, *s, _p=drop):
+        def fn(q, k, v, *extra, _p=drop, _hs=has_seed, _hk=has_kpad):
+            seed = extra[0] if _hs else 0
+            kp = extra[-1] if _hk else None
             return fa.flash_attention_bshd(
                 q, k, v, causal=is_causal, dropout_p=_p,
-                dropout_seed=(s[0] if s else 0))
+                dropout_seed=seed, key_padding_mask=kp)
 
         return apply_op(fn, ins, name="flash_attention")
 

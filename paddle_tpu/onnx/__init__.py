@@ -123,15 +123,22 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
             example.append(np.zeros(shape, getattr(s, "dtype", "float32")))
 
     # call through Layer.__call__ so forward-pre/post hooks run (weight_norm
-    # and spectral_norm recompute their weights in pre-hooks)
+    # and spectral_norm recompute their weights in pre-hooks).
+    # A to_static wrap carries a jit trace cache keyed on avals, not on the
+    # flash flag below — a model already run on TPU would replay a cached
+    # jaxpr containing pallas_call. For Layers, temporarily rebind .forward
+    # to the underlying dygraph function (Layer.__call__ still runs the
+    # hooks); for bare StaticFunctions, trace the dygraph function directly
+    # (the jit.save pattern, jit/__init__.py).
     fwd = layer if callable(layer) else layer.forward
-    # a to_static-wrapped forward carries a jit trace cache keyed on avals,
-    # not on the flash flag below — a model already run on TPU would replay
-    # a cached jaxpr containing pallas_call. Trace the underlying dygraph
-    # function instead.
-    dyfn = getattr(getattr(layer, "forward", None), "dygraph_function", None)
-    if dyfn is not None:
-        fwd = dyfn
+    restore_forward = None
+    sf = getattr(layer, "forward", None)
+    if getattr(sf, "dygraph_function", None) is not None:
+        restore_forward = sf
+        layer.forward = sf.dygraph_function
+        fwd = layer
+    elif getattr(layer, "dygraph_function", None) is not None:
+        fwd = layer.dygraph_function
     was_training = getattr(layer, "training", False)
     if hasattr(layer, "eval"):
         layer.eval()
@@ -153,6 +160,8 @@ def export(layer, path: str, input_spec=None, opset_version: int = 18,
         closed = jax.make_jaxpr(pure)(*example)
     finally:
         _attn.pallas_flash_enabled = prev_flash
+        if restore_forward is not None:
+            layer.forward = restore_forward
         if was_training and hasattr(layer, "train"):
             layer.train()
 

@@ -125,10 +125,11 @@ def _keep_mask(seed, bh, qi, ki, block_q: int, block_k: int,
 # ───────────────────────────── forward ─────────────────────────────
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc_ref,
-                 m_ref, l_ref, *,
+def _attn_kernel(q_ref, k_ref, v_ref, seed_ref, kp_ref, o_ref, lse_ref,
+                 acc_ref, m_ref, l_ref, *,
                  causal: bool, scale: float, block_q: int, block_k: int,
-                 seq_q: int, seq_k: int, drop_p: float = 0.0):
+                 seq_q: int, seq_k: int, drop_p: float = 0.0,
+                 has_kpad: bool = False):
     bh = pl.program_id(0)  # read at kernel top: program_id inside a
     qi = pl.program_id(1)  # pl.when body escapes the interpret context
     ki = pl.program_id(2)
@@ -161,6 +162,9 @@ def _attn_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc_ref,
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < seq_k  # padded keys
+        if has_kpad:
+            # caller-supplied per-key padding mask (f32 0/1, [1, bk])
+            mask = mask & (kp_ref[0] > 0.5)[None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -211,8 +215,12 @@ def _scalar_spec():
 def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    drop_p: float = 0.0, drop_seed=0):
-    """q,k,v: [BH, S, D] → (out [BH, Sq, D], lse [BH, Sq] f32)."""
+                    drop_p: float = 0.0, drop_seed=0, kpad=None,
+                    kpad_heads: int = 1):
+    """q,k,v: [BH, S, D] → (out [BH, Sq, D], lse [BH, Sq] f32).
+    ``kpad``: optional per-key keep mask [B, Sk] f32 0/1 (key padding);
+    ``kpad_heads`` is H, so block b of the [B·H] grid reads row b // H —
+    no H-fold mask copy is ever materialized."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(sq, block_q)
@@ -227,16 +235,25 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
 
     grid = (bh, nq, nk)
     seed2 = jnp.full((1, 1), drop_seed, jnp.float32)
+    has_kpad = kpad is not None
+    if has_kpad:
+        kp2 = jnp.pad(kpad, ((0, 0), (0, pad_k))) if pad_k else kpad
+        _h = kpad_heads
+        kp_spec = pl.BlockSpec((1, bk), lambda b, i, j: (b // _i32(_h), j))
+    else:
+        kp2 = jnp.ones((1, bk), jnp.float32)
+        kp_spec = pl.BlockSpec((1, bk), lambda b, i, j: (_i32(0), _i32(0)))
     out, lse = pl.pallas_call(
         functools.partial(_attn_kernel, causal=causal, scale=scale,
                           block_q=bq, block_k=bk, seq_q=sq, seq_k=sk,
-                          drop_p=drop_p),
+                          drop_p=drop_p, has_kpad=has_kpad),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _i32(0))),
             _scalar_spec(),
+            kp_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
@@ -253,7 +270,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
         ],
         interpret=_interpret(),
         **_compiler_params(),
-    )(qp, kp, vp, seed2)
+    )(qp, kp, vp, seed2, kp2)
     return out[:, :sq], lse[:, :sq, 0]
 
 
@@ -261,8 +278,9 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, seed_ref,
-               dq_ref, dq_acc, *, causal: bool, scale: float, block_q: int,
-               block_k: int, seq_q: int, seq_k: int, drop_p: float = 0.0):
+               kp_ref, dq_ref, dq_acc, *, causal: bool, scale: float,
+               block_q: int, block_k: int, seq_q: int, seq_k: int,
+               drop_p: float = 0.0, has_kpad: bool = False):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -293,6 +311,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, seed_ref,
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < seq_k
+        if has_kpad:
+            mask = mask & (kp_ref[0] > 0.5)[None, :]
         if causal:
             mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
@@ -319,9 +339,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, seed_ref,
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, seed_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                kp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
                 scale: float, block_q: int, block_k: int, seq_q: int,
-                seq_k: int, drop_p: float = 0.0):
+                seq_k: int, drop_p: float = 0.0, has_kpad: bool = False):
     bh = pl.program_id(0)
     kj = pl.program_id(1)
     qi = pl.program_id(2)
@@ -354,6 +374,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, seed_ref,
             jnp.int32, (block_q, block_k), 1)
         # padded q rows must not contribute to dk/dv sums
         mask = (k_pos < seq_k) & (q_pos < seq_q)
+        if has_kpad:
+            mask = mask & (kp_ref[0] > 0.5)[None, :]
         if causal:
             mask = mask & (q_pos + (seq_k - seq_q) >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
@@ -395,7 +417,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dlt_ref, seed_ref,
 def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    drop_p: float = 0.0, drop_seed=0):
+                    drop_p: float = 0.0, drop_seed=0, kpad=None,
+                    kpad_heads: int = 1):
     """All [BH, S, D] (lse [BH, Sq]) → (dq, dk, dv)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -425,9 +448,23 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
 
     nq = qp.shape[1] // bq
     nk = kp.shape[1] // bk
+    has_kpad = kpad is not None
     kw = dict(causal=causal, scale=scale, block_q=bq, block_k=bk,
-              seq_q=sq, seq_k=sk, drop_p=drop_p)
+              seq_q=sq, seq_k=sk, drop_p=drop_p, has_kpad=has_kpad)
     seed2 = jnp.full((1, 1), drop_seed, jnp.float32)
+    if has_kpad:
+        kp2 = jnp.pad(kpad, ((0, 0), (0, pad_k))) if pad_k else kpad
+        _h = kpad_heads
+        kp_spec_q = pl.BlockSpec((1, bk),
+                                 lambda b, i, j: (b // _i32(_h), j))
+        kp_spec_k = pl.BlockSpec((1, bk),
+                                 lambda b, j, i: (b // _i32(_h), j))
+    else:
+        kp2 = jnp.ones((1, bk), jnp.float32)
+        kp_spec_q = pl.BlockSpec((1, bk),
+                                 lambda b, i, j: (_i32(0), _i32(0)))
+        kp_spec_k = pl.BlockSpec((1, bk),
+                                 lambda b, j, i: (_i32(0), _i32(0)))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **kw),
@@ -440,13 +477,14 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
             _scalar_spec(),
+            kp_spec_q,
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _i32(0))),
         out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
         **_compiler_params(),
-    )(qp, kp, vp, dop, lse_b, dlt_b, seed2)
+    )(qp, kp, vp, dop, lse_b, dlt_b, seed2, kp2)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, **kw),
@@ -459,6 +497,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
             pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, _i32(0))),
             _scalar_spec(),
+            kp_spec_k,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _i32(0))),
@@ -474,7 +513,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, causal: bool, scale: float,
         ],
         interpret=_interpret(),
         **_compiler_params(),
-    )(kp, vp, qp, dop, lse_b, dlt_b, seed2)
+    )(kp, vp, qp, dop, lse_b, dlt_b, seed2, kp2)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
@@ -540,9 +579,47 @@ def _bwd(causal, scale, block_q, block_k, drop_p, res, g):
 _flash_attention.defvjp(_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_kpad(q, k, v, drop_seed, kpad, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          drop_p: float = 0.0):
+    """Key-padding variant: ``kpad`` [B*H, Sk] f32 0/1 rides as an
+    operand (separate custom_vjp so the unmasked hot path's signature
+    stays untouched)."""
+    o, _ = _fwd_kpad(q, k, v, drop_seed, kpad, causal, scale, block_q,
+                     block_k, drop_p)
+    return o
+
+
+def _fwd_kpad(q, k, v, drop_seed, kpad, causal, scale, block_q, block_k,
+              drop_p=0.0):
+    b, sq, h, d = q.shape
+    of, lse = _flash_fwd_bhsd(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
+                              block_q=block_q, block_k=block_k,
+                              drop_p=drop_p, drop_seed=drop_seed, kpad=kpad,
+                              kpad_heads=h)
+    o = _from_bh(of, b, h)
+    return o, (q, k, v, drop_seed, kpad, o, lse)
+
+
+def _bwd_kpad(causal, scale, block_q, block_k, drop_p, res, g):
+    q, k, v, drop_seed, kpad, o, lse = res
+    b, sq, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bhsd(
+        _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
+        causal, scale, block_q=block_q, block_k=block_k,
+        drop_p=drop_p, drop_seed=drop_seed, kpad=kpad, kpad_heads=h)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
+            jnp.zeros_like(drop_seed), jnp.zeros_like(kpad))
+
+
+_flash_attention_kpad.defvjp(_fwd_kpad, _bwd_kpad)
+
+
 def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None,
                          block_q: int = None, block_k: int = None,
-                         dropout_p: float = 0.0, dropout_seed: int = 0):
+                         dropout_p: float = 0.0, dropout_seed: int = 0,
+                         key_padding_mask=None):
     """Flash attention, paddle layout [B, S, H, D]. Fwd and bwd are both
     Pallas flash kernels (no [S,S] materialization in either direction).
     Block sizes default to the measured-best ladder (PADDLE_TPU_FLASH_BQ/BK
@@ -558,13 +635,22 @@ def flash_attention_bshd(q, k, v, causal: bool = False, scale: float = None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PLTPU:
-        if dropout_p > 0.0:
+        if dropout_p > 0.0 or key_padding_mask is not None:
             raise NotImplementedError(
-                "flash_attention_bshd dropout requires the pallas TPU "
-                "backend (this build lacks jax.experimental.pallas.tpu); "
-                "silently training without dropout would be worse")
+                "flash_attention_bshd dropout/key-padding requires the "
+                "pallas TPU backend (this build lacks "
+                "jax.experimental.pallas.tpu); silently ignoring them "
+                "would be worse")
         return _ref_attention_bshd(q, k, v, causal, scale)
     seed_f = jnp.asarray(dropout_seed, jnp.float32)
+    if key_padding_mask is not None:
+        # [B, Sk] bool/0-1 keep mask — the kernels index row b // H, no
+        # H-fold copy is materialized (nor saved in the vjp residuals)
+        kpad = key_padding_mask.astype(jnp.float32)
+        return _flash_attention_kpad(q, k, v, seed_f, kpad, causal, scale,
+                                     block_q or DEFAULT_BLOCK_Q,
+                                     block_k or DEFAULT_BLOCK_K,
+                                     float(dropout_p))
     return _flash_attention(q, k, v, seed_f, causal, scale,
                             block_q or DEFAULT_BLOCK_Q,
                             block_k or DEFAULT_BLOCK_K,

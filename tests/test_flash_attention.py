@@ -242,3 +242,49 @@ def test_dropout_mask_block_layout_invariant():
     bb = np.asarray(fa._flash_attention(q, k, v, seed, False, 0.125,
                                         256, 128, 0.25))
     np.testing.assert_allclose(a, bb, atol=2e-5, rtol=2e-5)
+
+
+def test_key_padding_mask_matches_reference():
+    """Per-key padding inside the kernel (reference: flash_attn's padded
+    batches) must equal dense attention with -inf on masked keys — fwd
+    and all grads, causal and not."""
+    b, s, h, d = 2, 256, 2, 64
+    q = _rand((b, s, h, d), 40)
+    k = _rand((b, s, h, d), 41)
+    v = _rand((b, s, h, d), 42)
+    scale = 1.0 / np.sqrt(d)
+    lengths = np.array([s - 37, s - 120])
+    keep = (np.arange(s)[None, :] < lengths[:, None])
+    kpad = jnp.asarray(keep, jnp.bool_)
+
+    for causal in (False, True):
+        def f_flash(q, k, v):
+            return fa.flash_attention_bshd(q, k, v, causal=causal,
+                                           key_padding_mask=kpad)
+
+        def f_ref(q, k, v):
+            qh = jnp.swapaxes(q, 1, 2)
+            kh = jnp.swapaxes(k, 1, 2)
+            vh = jnp.swapaxes(v, 1, 2)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+            m = jnp.asarray(keep)[:, None, None, :]
+            if causal:
+                cm = jnp.tril(jnp.ones((s, s), bool))
+                m = m & cm[None, None]
+            logits = jnp.where(m, logits, fa.NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.swapaxes(
+                jnp.einsum("bhqk,bhkd->bhqd", probs, vh), 1, 2)
+
+        out = np.asarray(f_flash(q, k, v))
+        ref = np.asarray(f_ref(q, k, v))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+        gf = jax.grad(lambda *a: jnp.sum(f_flash(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(f_ref(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, bb, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name} mismatch (causal={causal})")

@@ -285,3 +285,65 @@ def test_export_to_static_wrapped_layer(tmp_path):
     import os
 
     assert os.path.getsize(path) > 100
+
+
+def test_export_to_static_layer_runs_pre_hooks(tmp_path):
+    """Export of a to_static Layer must still fire forward-pre hooks
+    (weight_norm recomputes `weight` from weight_g/weight_v there) —
+    rebinding .forward to the dygraph fn keeps Layer.__call__ in the
+    loop, unlike tracing the raw function."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, nn, onnx
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.utils.weight_norm(nn.Linear(8, 4))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (2, 8)).astype("float32"))
+    want = np.asarray(m(x).numpy())
+    # perturb weight_g so the pre-hook's recompute is observable
+    with paddle.no_grad():
+        m.fc.weight_g._set_value(m.fc.weight_g * 2.0)
+    want2 = np.asarray(m(x).numpy())
+    assert not np.allclose(want, want2), "weight_norm hook not observable"
+
+    m2 = jit.to_static(m)
+    _ = m2(x)
+    path = onnx.export(m2, str(tmp_path / "wn"), input_spec=[x])
+    import os
+
+    assert os.path.getsize(path) > 100
+    # the StaticFunction must be restored after export
+    assert hasattr(m2.forward, "dygraph_function")
+
+
+def test_export_to_static_bare_function(tmp_path):
+    """A bare to_static function (no Layer) must also trace its dygraph
+    function, not a cached jit program."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, onnx
+
+    w = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+        (8, 4)).astype("float32"))
+
+    @jit.to_static
+    def f(x):
+        return paddle.matmul(x, w)
+
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+        (2, 8)).astype("float32"))
+    _ = f(x)  # populate the jit cache
+    path = onnx.export(f, str(tmp_path / "fn"), input_spec=[x])
+    import os
+
+    assert os.path.getsize(path) > 100
