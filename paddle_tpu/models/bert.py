@@ -114,15 +114,18 @@ class BertModel(nn.Layer):
                 attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         if attention_mask is not None:
-            # [B, S] 1/0 mask → additive [B, 1, 1, S] bias
+            # [B, S] 1/0 mask → BOOL [B, 1, 1, S] (True = attend): the
+            # form scaled_dot_product_attention consumes natively and
+            # the one that routes padded batches onto the flash kernel
+            # (additive -1e4 bias would fall back to naive [S,S] math)
             am = ensure_tensor(attention_mask)
 
-            def to_bias(m):
+            def to_bool(m):
                 import jax.numpy as jnp
 
-                return (1.0 - m[:, None, None, :].astype(jnp.float32)) * -1e4
+                return (m[:, None, None, :].astype(jnp.float32) > 0.5)
 
-            attention_mask = apply_op(to_bias, [am], name="bert_attn_mask")
+            attention_mask = apply_op(to_bool, [am], name="bert_attn_mask")
         sequence_output = self.encoder(x, src_mask=attention_mask)
         pooled = F.tanh(self.pooler(sequence_output[:, 0]))
         return sequence_output, pooled

@@ -107,12 +107,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             return None
         shp = tuple(int(x) for x in mask.shape)
         if (len(shp) == 4 and shp[0] == batch and shp[1] == 1
-                and shp[2] == 1):
+                and shp[2] == 1 and shp[3] == klen):
+            # klen must match exactly: a stale-length mask would be
+            # silently truncated/mis-padded by the kernel but fail
+            # loudly on the XLA broadcast — keep both paths failing the
+            # same way
             return mask.reshape(shp[0], shp[3])
         return None
 
     mask_val = ensure_tensor(attn_mask)._value if attn_mask is not None \
         else None
+    klen = int(key.shape[1]) if len(key.shape) >= 2 else 0
     kpad = _as_key_padding(mask_val, int(query.shape[0]))
     if ((attn_mask is None or kpad is not None)
             and _use_pallas(query._value, seq_len)):

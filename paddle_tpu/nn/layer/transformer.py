@@ -29,17 +29,16 @@ __all__ = [
 
 
 def _convert_attention_mask(attn_mask, dtype):
-    """bool mask (True=keep) → additive; already-additive passes through
-    (reference: transformer.py _convert_attention_mask)."""
+    """Normalize the mask for scaled_dot_product_attention. The reference
+    (transformer.py _convert_attention_mask) rewrites bool → additive
+    -1e9 because its kernels only take additive bias; OUR sdpa consumes
+    bool masks natively (where(mask, logits, -inf)) — and a bool
+    [B, 1, 1, Sk] key-padding mask is what routes attention onto the
+    Pallas flash kernel (attention.py _as_key_padding), so bool passes
+    through unchanged. Additive masks also pass through."""
     if attn_mask is None:
         return None
-    attn_mask = ensure_tensor(attn_mask)
-    if attn_mask.dtype == jnp.bool_:
-        from ...ops import cast, scale, where
-
-        neg = Tensor(jnp.where(attn_mask._value, 0.0, -1e9).astype(dtype))
-        return neg
-    return attn_mask
+    return ensure_tensor(attn_mask)
 
 
 import collections

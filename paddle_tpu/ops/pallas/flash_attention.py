@@ -583,9 +583,10 @@ _flash_attention.defvjp(_fwd, _bwd)
 def _flash_attention_kpad(q, k, v, drop_seed, kpad, causal: bool,
                           scale: float, block_q: int, block_k: int,
                           drop_p: float = 0.0):
-    """Key-padding variant: ``kpad`` [B*H, Sk] f32 0/1 rides as an
-    operand (separate custom_vjp so the unmasked hot path's signature
-    stays untouched)."""
+    """Key-padding variant: ``kpad`` [B, Sk] f32 0/1 rides as an operand
+    (separate custom_vjp so the unmasked hot path's signature stays
+    untouched). The kernels index row b // H — do NOT H-fold the mask;
+    a [B*H, Sk] array would be silently mis-read (rows 0..B-1 only)."""
     o, _ = _fwd_kpad(q, k, v, drop_seed, kpad, causal, scale, block_q,
                      block_k, drop_p)
     return o

@@ -288,3 +288,33 @@ def test_key_padding_mask_matches_reference():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(bb), atol=5e-4, rtol=5e-4,
                 err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_bert_padding_mask_routes_to_flash(monkeypatch):
+    """BERT's [B, S] padding mask must reach the flash kernel as bool
+    [B,1,1,S] key padding (bert.py to_bool + transformer bool
+    pass-through + attention _as_key_padding) and match the XLA path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertModel
+    from paddle_tpu.nn.functional import attention as A
+
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=2, intermediate_size=128,
+                     max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    m = BertModel(cfg)
+    m.eval()
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 128, (2, 64)))
+    am = paddle.to_tensor((np.arange(64)[None, :]
+                           < np.array([50, 30])[:, None]).astype("int64"))
+
+    monkeypatch.setattr(A, "pallas_flash_enabled", False)
+    ref, _ = m(ids, attention_mask=am)
+    monkeypatch.setattr(A, "pallas_flash_enabled", True)
+    monkeypatch.setattr(A, "_use_pallas", lambda qv, s: True)
+    out, _ = m(ids, attention_mask=am)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()),
+                               atol=5e-5, rtol=5e-5)
