@@ -21,11 +21,16 @@ they assign. When the predicate is a Python bool the converted code runs
 the same branch Python would — transformation is semantics-preserving for
 non-tensor control flow, so it is safe to apply to every to_static target.
 
+``for <name> in range(...)`` is ALSO converted (→ convert_for_range): a
+tensor bound compiles to one lax.while_loop; concrete bounds dispatch to
+the plain Python loop at runtime (the old unroll behavior, bit-identical).
+
 Deliberately NOT converted (left as plain Python, same behavior as before
-the pass): ``if``/``while`` containing ``break``/``continue``/``return``
-(except the common both-branches-return-an-expression ``if``), ``for``
-loops (concrete ranges unroll fine under trace), and anything whose source
-is unavailable (lambdas, REPL) — the transform then no-ops.
+the pass): ``if``/``while``/``for`` containing ``break``/``continue``/
+``return`` (except the common both-branches-return-an-expression ``if``),
+``for`` over non-range iterables or with tuple targets / ``else``, and
+anything whose source is unavailable (lambdas, REPL) — the transform then
+no-ops.
 """
 from __future__ import annotations
 
@@ -37,8 +42,8 @@ import warnings
 from typing import List, Sequence
 
 __all__ = ["ast_transform", "convert_ifelse", "convert_while",
-           "convert_logical_and", "convert_logical_or", "convert_logical_not",
-           "UNDEFINED", "ld"]
+           "convert_for_range", "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "UNDEFINED", "ld"]
 
 
 class _Undefined:
@@ -138,6 +143,108 @@ def convert_while(cond_fn, body_fn, vals: Sequence):
     for i, v in zip(carried, finals):
         full[i] = v
     return tuple(full)
+
+
+def convert_for_range(range_args, body_fn, vals: Sequence,
+                      tgt_index: int = -1, range_obj=range):
+    """Runtime dispatch for a rewritten ``for <tgt> in range(...)``.
+
+    ``body_fn(hdr, *vals)`` binds the loop target to ``hdr`` as its first
+    statement and returns the loop variables. Concrete bounds run the
+    plain Python loop (trace-time unroll — previous behavior,
+    bit-identical CPython semantics); a traced bound compiles to ONE
+    lax.while_loop via convert_while with carry ``(hdr, *vals)``.
+
+    Compiled-regime semantics corners (documented):
+    - the header is carried as int32 (a Python loop index is weakly
+      typed, so int32 minimizes dtype promotion of accumulators that mix
+      with the target; bounds beyond int32 are not supported compiled);
+    - after a ZERO-iteration compiled loop the target reads as ``start``
+      (a compiled carry cannot be conditionally unbound; CPython leaves
+      it unbound — concrete ranges keep the CPython behavior);
+    - a TRACED step is not supported (raise, rather than a tracer leak).
+    """
+    import builtins
+    import operator
+
+    if range_obj is not builtins.range:
+        # the AST match is syntactic — a shadowed `range` must keep plain
+        # Python semantics: iterate whatever it returns
+        vals = list(vals)
+        for h in range_obj(*range_args):
+            vals = list(body_fn(h, *vals))
+        return tuple(vals)
+
+    args = list(range_args)
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+    if _is_traced_tensor(step):
+        raise NotImplementedError(
+            "for-range with a TRACED step is not supported under "
+            "to_static — make the step a Python int (or a concrete "
+            "tensor); traced start/stop are fine")
+    if _is_tensor(step):
+        step = int(step.numpy().reshape(()))
+    else:
+        step = operator.index(step)  # CPython: range() rejects floats
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    # CPython-parity validation for concrete bounds (floats must raise
+    # loudly, not silently truncate the trip count)
+    for b in (start, stop):
+        if not _is_tensor(b):
+            operator.index(b)
+
+    vals = list(vals)
+    if not any(_is_traced_tensor(b) for b in (start, stop)):
+        # fully concrete: exact CPython semantics — bounds become plain
+        # Python ints (weak typing and all), the loop is a Python loop
+        s0 = int(start.numpy().reshape(())) if _is_tensor(start) else start
+        s1 = int(stop.numpy().reshape(())) if _is_tensor(stop) else stop
+        for h in range(s0, s1, step):
+            vals = list(body_fn(h, *vals))
+        return tuple(vals)
+
+    # a bound is traced: the loop compiles. The while_loop carries Tensors
+    # only — carry the header as int32 regardless of the bound's dtype (an
+    # int64 header would promote int32 accumulators touched by the target,
+    # breaking carry type stability vs. the weak-int unrolled regime).
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    if not _is_tensor(start):
+        start = Tensor(jnp.asarray(start, jnp.int32), stop_gradient=True)
+    elif start._value.dtype != jnp.int32:
+        start = Tensor(start._value.astype(jnp.int32), stop_gradient=True)
+    # the target must be IN the compiled carry even when unbound before
+    # the loop (body_fn rebinds it at iteration entry, and the caller
+    # reads it back from the returned vals). Seed with a DISTINCT Tensor:
+    # the loop capture bookkeeping is id()-based
+    # (static/nn/control_flow.py), and one object in two carry slots
+    # silently corrupts the slot mapping (measured: wrong results or a
+    # non-terminating compiled loop).
+    if 0 <= tgt_index < len(vals) and vals[tgt_index] is UNDEFINED:
+        vals[tgt_index] = Tensor(jnp.asarray(start._value),
+                                 stop_gradient=True)
+
+    if step > 0:
+        def cond_fn(h, *vs):
+            return h < stop
+    else:
+        def cond_fn(h, *vs):
+            return h > stop
+
+    def body2(h, *vs):
+        out = body_fn(h, *vs)
+        return (h + step, *out)
+
+    res = convert_while(cond_fn, body2, (start, *vals))
+    return res[1:]
 
 
 def convert_logical_and(x, y_fn):
@@ -322,6 +429,48 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=call))
         else:
             stmts.append(ast.Expr(value=call))
+        return stmts
+
+    # ----------------------------------------------------------------- for
+    def visit_For(self, node):
+        """``for <name> in range(...)`` → convert_for_range: a TENSOR
+        range bound compiles to one lax.while_loop instead of failing to
+        trace. Concrete bounds keep the unroll (dispatched at runtime).
+        Anything else — non-range iterables, tuple targets, break/
+        continue/return, for-else — stays plain Python."""
+        self.generic_visit(node)
+        if (node.orelse or _has_flow_escape(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range")
+                or node.iter.keywords
+                or any(isinstance(a, ast.Starred) for a in node.iter.args)):
+            return node
+        tgt = node.target.id
+        loop_vars = list(dict.fromkeys(_assigned_names(node.body) + [tgt]))
+        self.changed = True
+        bname = self._next("forbody")
+        hdr = self._next("hdr")
+        stmts = self._locals_snapshot(loop_vars)
+        body = [ast.Assign(targets=[_name(tgt, ast.Store())],
+                           value=_name(hdr))] + list(node.body)
+        stmts.append(self._make_fn(bname, [hdr] + loop_vars, body,
+                                   loop_vars))
+        call = _jst_call("convert_for_range", [
+            ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+            _name(bname),
+            ast.Tuple(elts=[_name(n) for n in loop_vars], ctx=ast.Load()),
+            ast.Constant(value=loop_vars.index(tgt)),
+            # `range` resolved in the FUNCTION's scope at runtime: a
+            # shadowed range falls back to the plain-Python loop inside
+            # convert_for_range instead of being silently hijacked
+            _name("range")])
+        stmts.append(ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                     for n in loop_vars],
+                               ctx=ast.Store())],
+            value=call))
         return stmts
 
     # --------------------------------------------------------------- while

@@ -35,7 +35,8 @@ def _convert_attention_mask(attn_mask, dtype):
     bool masks natively (where(mask, logits, -inf)) — and a bool
     [B, 1, 1, Sk] key-padding mask is what routes attention onto the
     Pallas flash kernel (attention.py _as_key_padding), so bool passes
-    through unchanged. Additive masks also pass through."""
+    through unchanged. Additive masks also pass through. ``dtype`` is
+    kept for reference API parity but unused here (nothing is cast)."""
     if attn_mask is None:
         return None
     return ensure_tensor(attn_mask)

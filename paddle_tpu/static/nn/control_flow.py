@@ -114,17 +114,26 @@ def _discover(fn: Callable, args: Sequence[Tensor] = (),
 
 def _run_substituted(fn: Callable, ext: List[Tensor], ext_vals,
                      args: Sequence[Tensor] = (), arg_tensors=(),
-                     arg_vals=()):
+                     arg_vals=(), extract: Callable = None):
     """Re-run ``fn`` as a pure function: temporarily swap the captured (and
     loop-var) tensors' payloads for the supplied trace values, execute under
-    no_grad, restore. Single-threaded by construction (one tape)."""
+    no_grad, restore. Single-threaded by construction (one tape).
+
+    ``extract`` runs on the output INSIDE the swapped state — required
+    whenever the caller reads tensor payloads from the result: a body that
+    returns one of the substituted tensor OBJECTS (e.g. a while body
+    passing a carry arg through to a different output slot) would
+    otherwise have its payload restored to the stale pre-swap value before
+    the caller looks at it (r4 bug: the for-range loop target read back
+    as its seed)."""
     swap = list(zip(ext, ext_vals)) + list(zip(arg_tensors, arg_vals))
     olds = [t._value for t, _ in swap]
     for t, v in swap:
         t._value = v
     try:
         with no_grad():
-            return fn(*args)
+            out = fn(*args)
+            return extract(out) if extract is not None else out
     finally:
         for (t, _), old in zip(swap, olds):
             t._value = old
@@ -169,9 +178,10 @@ def _traced_multiway(selector, fns: Sequence[Callable], name: str):
     def pure(*ext_arrays):
         def make_branch(fn):
             def br(ops):
-                out = _run_substituted(fn, ext, ops)
-                flat, _ = jax.tree_util.tree_flatten(out)
-                return tuple(_leaf_value(v) for v in flat)
+                def ex(out):
+                    flat, _ = jax.tree_util.tree_flatten(out)
+                    return tuple(_leaf_value(v) for v in flat)
+                return _run_substituted(fn, ext, ops, extract=ex)
             return br
 
         branches = [make_branch(fn) for fn in fns]
@@ -277,16 +287,20 @@ def while_loop(cond, body, loop_vars, is_test: bool = False,
         lv0, ext_arrays = arrays[:n], arrays[n:]
 
         def c_fn(carry):
-            out = _run_substituted(cond, ext, ext_arrays, args=loop_vars,
-                                   arg_tensors=lv_tensors, arg_vals=carry)
-            return jnp.reshape(_leaf_value(out), ()).astype(jnp.bool_)
+            return _run_substituted(
+                cond, ext, ext_arrays, args=loop_vars,
+                arg_tensors=lv_tensors, arg_vals=carry,
+                extract=lambda out: jnp.reshape(
+                    _leaf_value(out), ()).astype(jnp.bool_))
 
         def b_fn(carry):
-            out = _run_substituted(body, ext, ext_arrays, args=loop_vars,
-                                   arg_tensors=lv_tensors, arg_vals=carry)
-            out = list(out) if isinstance(out, (list, tuple)) else [out]
-            flat, _ = jax.tree_util.tree_flatten(out)
-            return tuple(_leaf_value(v) for v in flat)
+            def ex(out):
+                out = list(out) if isinstance(out, (list, tuple)) else [out]
+                flat, _ = jax.tree_util.tree_flatten(out)
+                return tuple(_leaf_value(v) for v in flat)
+            return _run_substituted(body, ext, ext_arrays, args=loop_vars,
+                                    arg_tensors=lv_tensors, arg_vals=carry,
+                                    extract=ex)
 
         return jax.lax.while_loop(c_fn, b_fn, tuple(lv0))
 

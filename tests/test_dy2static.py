@@ -220,3 +220,144 @@ def test_layer_method_with_tensor_branch_compiles_and_saves(tmp_path):
     assert any(f.endswith(".pdiparams") and
                os.path.getsize(os.path.join(tmp_path, f)) > 100
                for f in os.listdir(tmp_path))
+
+
+def _tensor_for_range(x, n):
+    s = x
+    for i in range(n):
+        s = s + i
+    return s
+
+
+def _concrete_for_range(x):
+    s = x
+    for i in range(3):
+        s = s * 2.0
+    return s
+
+
+def _for_range_start_step(x, n):
+    s = x
+    for i in range(2, n, 3):
+        s = s + i
+    return s
+
+
+def test_for_over_tensor_range_compiles():
+    """for i in range(n) with a TENSOR n compiles to one while_loop
+    instead of failing to trace (previously: for-range left as plain
+    Python, which concretization-errors on a traced bound)."""
+    f = jit.to_static(_tensor_for_range)
+    x = t(np.float32(1.0))
+    for n in (0, 1, 5):
+        got = float(np.asarray(f(x, t(np.int64(n))).numpy()))
+        want = 1.0 + sum(range(n))
+        assert got == want, (n, got, want)
+
+
+def test_for_concrete_range_still_unrolls():
+    f = jit.to_static(_concrete_for_range)
+    got = float(np.asarray(f(t(np.float32(2.0))).numpy()))
+    assert got == 16.0
+
+
+def test_for_range_start_step():
+    f = jit.to_static(_for_range_start_step)
+    x = t(np.float32(0.0))
+    for n in (2, 3, 9, 10):
+        got = float(np.asarray(f(x, t(np.int64(n))).numpy()))
+        want = float(sum(range(2, n, 3)))
+        assert got == want, (n, got, want)
+
+
+def _for_read_target_after(x, n):
+    s = x
+    for i in range(n):
+        s = s + 1.0
+    return s + i  # noqa: F821  (target read after the loop)
+
+
+def test_for_target_readable_after_compiled_loop():
+    """Reading the loop target after a tensor-bound for must work in the
+    compiled regime (the target rides the carry; review r4 finding)."""
+    f = jit.to_static(_for_read_target_after)
+    x = t(np.float32(0.0))
+    for _ in range(2):  # second call exercises the compiled path
+        got = float(np.asarray(f(x, t(np.int64(4))).numpy()))
+        assert got == 4.0 + 3.0, got
+
+
+def _for_int32_accumulator(x, n):
+    s = paddle.to_tensor(np.int32(0))
+    for i in range(n):
+        s = s + i
+    return s
+
+
+def test_for_header_does_not_promote_int32_accumulator():
+    """int32 accumulators mixing with the target must stay int32 (the
+    header is carried as int32, like the weak Python int it replaces)."""
+    f = jit.to_static(_for_int32_accumulator)
+    for _ in range(2):
+        out = f(t(np.float32(0.0)), t(np.int64(5)))
+        assert str(out.dtype).endswith("int32"), out.dtype
+        assert int(np.asarray(out.numpy())) == 10
+
+
+def _for_traced_step(x, st):
+    s = x
+    for i in range(0, 6, st):
+        s = s + 1.0
+    return s
+
+
+def test_for_traced_step_raises_clearly():
+    f = jit.to_static(_for_traced_step)
+    with pytest.raises(Exception, match="TRACED step"):
+        f(t(np.float32(0.0)), t(np.int64(2)))
+        f(t(np.float32(0.0)), t(np.int64(2)))  # compiled call
+
+
+def _shadowed_range(x):
+    range = lambda n: [10, 20]  # noqa: E731, A001
+    s = x
+    for i in range(None):
+        s = s + i
+    return s
+
+
+def test_for_shadowed_range_keeps_python_semantics():
+    f = jit.to_static(_shadowed_range)
+    got = float(np.asarray(f(t(np.float32(0.0))).numpy()))
+    assert got == 30.0, got
+
+
+def _float_range(x):
+    s = x
+    for i in range(2.5):  # CPython: TypeError
+        s = s + 1.0
+    return s
+
+
+def test_for_float_bound_raises_like_cpython():
+    f = jit.to_static(_float_range)
+    with pytest.raises(TypeError):
+        f(t(np.float32(0.0)))
+
+
+def _for_tensor_start_and_stop(x, a, n):
+    s = paddle.to_tensor(np.int32(0))
+    for i in range(a, n):
+        s = s + i
+    return s
+
+
+def test_for_tensor_start_int32_header():
+    """A concrete/traced int64 tensor START must still carry an int32
+    header (the documented contract; otherwise int32 accumulators
+    promote and the compiled carry dtype destabilizes)."""
+    f = jit.to_static(_for_tensor_start_and_stop)
+    for _ in range(2):
+        out = f(t(np.float32(0.0)), t(np.int64(2)), t(np.int64(6)))
+        assert str(out.dtype).endswith("int32"), out.dtype
+        assert int(np.asarray(out.numpy())) == 2 + 3 + 4 + 5

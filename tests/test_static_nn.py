@@ -367,3 +367,38 @@ def test_static_rnn_correct_under_no_grad():
             rnn.step_output(h)
         out = rnn()
     np.testing.assert_allclose(out.numpy(), np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_while_loop_passthrough_carry_slot():
+    """A body may return one of its CARRY ARG tensors in a different
+    output slot (e.g. `return h+1, s2, h`): the returned slot must hold
+    the substituted trace value, not the tensor object's stale pre-loop
+    payload (r4 bug: _run_substituted restored payloads before the
+    caller read the outputs — the for-range loop target came back as its
+    seed)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.static.nn import while_loop
+    from paddle_tpu.tensor import Tensor
+
+    def fn(n):
+        h = Tensor(jnp.asarray(0, jnp.int32), stop_gradient=True)
+        s = paddle.to_tensor(np.float32(0.0))
+        i = Tensor(jnp.asarray(0, jnp.int32), stop_gradient=True)
+
+        def cond(h, s, i):
+            return h < n
+
+        def body(h, s, i):
+            return (h + 1, s + 1.0, h)  # slot 2 passes the carry arg through
+
+        _, s2, i2 = while_loop(cond, body, (h, s, i))
+        return s2 + 0, i2 + 0
+
+    f = jit.StaticFunction(fn, warmup=False)
+    for _ in range(2):
+        s, i = f(paddle.to_tensor(np.int64(4)))
+        assert float(np.asarray(s.numpy())) == 4.0
+        assert int(np.asarray(i.numpy())) == 3
