@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Probe the tunnel on a spaced cadence (killable subprocess probes, never
+# stacked — the wedge discipline) and run the r4 rerun battery the moment
+# a probe succeeds. One-shot: exits after the battery (or max probes).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+MAX_PROBES=${1:-40}
+SLEEP_S=${2:-420}
+
+for n in $(seq 1 "$MAX_PROBES"); do
+  if timeout 140 python - <<'EOF'
+import subprocess, sys
+r = subprocess.run(
+    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
+     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
+    capture_output=True, text=True, timeout=120)
+sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
+EOF
+  then
+    echo "[watch] probe $n OK — running battery $(date -u +%H:%M:%S)"
+    bash tools/rerun_r04.sh 2>&1 | tail -80
+    echo "[watch] battery done $(date -u +%H:%M:%S)"
+    exit 0
+  fi
+  echo "[watch] probe $n wedged $(date -u +%H:%M:%S); sleeping ${SLEEP_S}s"
+  sleep "$SLEEP_S"
+done
+echo "[watch] gave up after $MAX_PROBES probes"
+exit 1
