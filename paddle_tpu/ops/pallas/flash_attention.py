@@ -216,8 +216,11 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     drop_p: float = 0.0, drop_seed=0, kpad=None,
-                    kpad_heads: int = 1):
+                    kpad_heads: int = 1, vma=None):
     """q,k,v: [BH, S, D] → (out [BH, Sq, D], lse [BH, Sq] f32).
+    ``vma``: varying-mesh-axes metadata for the out_shapes — required when
+    the kernel runs inside a shard_map manual region (the vma checker
+    rejects ShapeDtypeStructs without it).
     ``kpad``: optional per-key keep mask [B, Sk] f32 0/1 (key padding);
     ``kpad_heads`` is H, so block b of the [B·H] grid reads row b // H —
     no H-fold mask copy is ever materialized."""
@@ -260,8 +263,10 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, _i32(0))),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype),
-            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], d), q.dtype,
+                                 **({"vma": vma} if vma else {})),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32,
+                                 **({"vma": vma} if vma else {})),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),

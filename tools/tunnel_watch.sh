@@ -19,9 +19,12 @@ sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
 EOF
   then
     echo "[watch] probe $n OK — running battery $(date -u +%H:%M:%S)"
-    bash tools/rerun_r04.sh 2>&1 | tail -80
-    echo "[watch] battery done $(date -u +%H:%M:%S)"
-    exit 0
+    if bash tools/rerun_r04.sh 2>&1 | tail -80; then
+      echo "[watch] battery done $(date -u +%H:%M:%S)"
+      exit 0
+    fi
+    echo "[watch] battery FAILED $(date -u +%H:%M:%S)"
+    exit 2
   fi
   echo "[watch] probe $n wedged $(date -u +%H:%M:%S); sleeping ${SLEEP_S}s"
   sleep "$SLEEP_S"
