@@ -10,10 +10,14 @@ Design (Ring Attention, Liu et al. 2023, re-derived for ICI): q/k/v
 keeps its q block resident and streams every k/v block through the ring
 with ``ppermute`` (one neighbor hop per step — bandwidth-optimal on a
 torus), folding each block into a running flash-style log-sum-exp
-softmax. Peak memory per device is O(S/P) and the P-step loop overlaps
-each block's compute with the next block's transfer under XLA's async
-collective-permute. Backward differentiates through the scan+ppermute
-(ppermute transposes to the reverse rotation), so grads are exact.
+softmax. On TPU each block runs through the Pallas flash kernel, so the
+forward is truly O(S/P) per device (nothing [C, C]-shaped ever
+materializes); the einsum fallback (CPU / tiny shards) and the backward
+recompute hold one transient [C, C] score block per step. The P-step
+loop overlaps each block's compute with the next block's transfer under
+XLA's async collective-permute. Backward differentiates through the
+scan+ppermute (ppermute transposes to the reverse rotation; the flash
+path's custom bwd recomputes via the einsum VJP), so grads are exact.
 """
 from __future__ import annotations
 
