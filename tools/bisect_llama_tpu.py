@@ -12,6 +12,7 @@ process (run it under timeout; it claims the chip once):
 
 Prints one verdict line per probe. Exit code 1 if any probe fails.
 """
+import json
 import os
 import sys
 import time
@@ -20,6 +21,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 import numpy as np
+
+_NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                      "BENCH_NOTES_r04.json")
+
+
+def _persist(rec):
+    """Verdicts must survive pipe buffers and SIGKILL — append
+    immediately (r4: a completed bisect's output was lost to a killed
+    tail pipeline when the tunnel re-wedged)."""
+    rec = dict(rec, metric="llama_bisect", ts=time.strftime("%H:%M:%S"))
+    try:
+        with open(_NOTES, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
 
 
 def probe_kernel_causality():
@@ -48,6 +64,8 @@ def probe_kernel_causality():
         bad = bad or not ok
         print(f"kernel D={D}: err_vs_ref={err:.4f} future_leak={leak:.6f} "
               f"{'OK' if ok else 'FAIL'}", flush=True)
+        _persist({"probe": "kernel_causality", "D": D, "err": err,
+                  "leak": leak, "ok": ok})
     return not bad
 
 
@@ -87,6 +105,9 @@ def llama_trajectory(tag, *, flash, rc, fce, steps=10):
         losses.append(float(np.asarray(l.numpy(), dtype="float32")))
     print(f"llama[{tag}]: first={losses[0]:.3f} last={losses[-1]:.4f} "
           f"traj={[round(x, 2) for x in losses]}", flush=True)
+    _persist({"probe": "trajectory", "tag": tag,
+              "first": round(losses[0], 4), "last": round(losses[-1], 5),
+              "traj": [round(x, 3) for x in losses]})
     # random-token CE floor is ~ln(32000)=10.37; losing >3 nats in 10
     # same-batch steps at lr 1e-4 means the model is reading the answer
     return losses[-1] > 7.0

@@ -3,6 +3,10 @@
 # Each step runs under its own timeout; a hang kills only that step.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+# everything also lands in a line-buffered log — pipe buffers lose
+# output when a re-wedge gets steps SIGKILLed (happened r4)
+exec > >(stdbuf -oL tee -a rerun_r04.log) 2>&1
+echo "=== battery start $(date -u +%H:%M:%S) ==="
 
 echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
 timeout 1800 python tools/bisect_llama_tpu.py
