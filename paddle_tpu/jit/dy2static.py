@@ -187,17 +187,31 @@ def convert_for_range(range_args, body_fn, vals: Sequence,
             "for-range with a TRACED step is not supported under "
             "to_static — make the step a Python int (or a concrete "
             "tensor); traced start/stop are fine")
+    # CPython-parity validation (floats must raise loudly, not silently
+    # truncate the trip count) — for TENSOR values too: int(float_tensor)
+    # truncates just as silently as int(float) would. bool stays legal
+    # (CPython: bool is an int subclass, range(True) is valid).
+    def _check_integral(b, what):
+        if not _is_tensor(b):
+            return operator.index(b)
+        import jax.numpy as jnp
+        dt = b._value.dtype
+        if not (jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_):
+            raise TypeError(
+                f"'{dt}' tensor cannot be interpreted as an integer "
+                f"range {what} (cast explicitly if truncation is "
+                "intended)")
+        return b
+
     if _is_tensor(step):
+        _check_integral(step, "step")
         step = int(step.numpy().reshape(()))
     else:
         step = operator.index(step)  # CPython: range() rejects floats
     if step == 0:
         raise ValueError("range() arg 3 must not be zero")
-    # CPython-parity validation for concrete bounds (floats must raise
-    # loudly, not silently truncate the trip count)
     for b in (start, stop):
-        if not _is_tensor(b):
-            operator.index(b)
+        _check_integral(b, "bound")
 
     vals = list(vals)
     if not any(_is_traced_tensor(b) for b in (start, stop)):

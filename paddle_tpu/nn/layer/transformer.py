@@ -29,14 +29,31 @@ __all__ = [
 
 
 def _convert_attention_mask(attn_mask, dtype):
-    """Normalize the mask for scaled_dot_product_attention. The reference
-    (transformer.py _convert_attention_mask) rewrites bool → additive
-    -1e9 because its kernels only take additive bias; OUR sdpa consumes
-    bool masks natively (where(mask, logits, -inf)) — and a bool
-    [B, 1, 1, Sk] key-padding mask is what routes attention onto the
-    Pallas flash kernel (attention.py _as_key_padding), so bool passes
-    through unchanged. Additive masks also pass through. ``dtype`` is
-    kept for reference API parity but unused here (nothing is cast)."""
+    """reference: nn/layer/transformer.py _convert_attention_mask — bool
+    masks become ADDITIVE bias in ``dtype`` (-1e9 where masked, 0 where
+    kept) so user code following the reference pattern of adding the
+    result to attention scores keeps exact semantics. Internal layers use
+    :func:`_normalize_attention_mask` instead, which passes bool through
+    (our sdpa consumes bool natively, and a bool [B, 1, 1, Sk]
+    key-padding mask is what routes onto the Pallas flash kernel)."""
+    if attn_mask is None:
+        return None
+    attn_mask = ensure_tensor(attn_mask)
+    if attn_mask._value.dtype == jnp.bool_:
+        from ...dtypes import convert_dtype
+        dt = convert_dtype(dtype) or jnp.float32
+        m = attn_mask._value
+        return Tensor(jnp.where(m, jnp.asarray(0.0, dt),
+                                jnp.asarray(-1e9, dt)),
+                      stop_gradient=True)
+    return attn_mask
+
+
+def _normalize_attention_mask(attn_mask):
+    """Internal mask path: bool AND additive masks pass through unchanged
+    — sdpa takes bool natively (where(mask, logits, -inf)), which is both
+    cheaper than materializing a -1e9 bias and the form the flash-kernel
+    key-padding route (attention.py _as_key_padding) requires."""
     if attn_mask is None:
         return None
     return ensure_tensor(attn_mask)
@@ -106,7 +123,7 @@ class MultiHeadAttention(Layer):
                     k = concat([ck, k], axis=1)
                     v = concat([cv, v], axis=1)
                 new_cache = _Cache(k, v)
-        mask = _convert_attention_mask(attn_mask, jnp.float32)
+        mask = _normalize_attention_mask(attn_mask)
         if mask is not None:
             # broadcast to [B, H, Sq, Sk]
             m = mask

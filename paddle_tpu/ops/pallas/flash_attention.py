@@ -177,6 +177,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, seed_ref, kp_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
         alpha = jnp.exp(m_prev - m_new)  # [bq, 128]
         p = jnp.exp(s - m_new[:, :1])  # [bq, bk]
+        # a row with ZERO valid keys in every block so far has m_new still
+        # at neg_inf, so exp(s - m_new) = exp(0) = 1 for masked positions —
+        # zero them so such rows emit 0 (l clamps to 1e-30 in _finish),
+        # consistent with the backward kernels' p=0 reconstruction
+        p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
         v = v_ref[0]  # [bk, d]

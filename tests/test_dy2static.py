@@ -361,3 +361,19 @@ def test_for_tensor_start_int32_header():
         out = f(t(np.float32(0.0)), t(np.int64(2)), t(np.int64(6)))
         assert str(out.dtype).endswith("int32"), out.dtype
         assert int(np.asarray(out.numpy())) == 2 + 3 + 4 + 5
+
+
+def _float_tensor_range(x, n):
+    s = x
+    for i in range(n):  # n is a float TENSOR: must raise like CPython
+        s = s + 1.0
+    return s
+
+
+def test_for_float_tensor_bound_raises_like_cpython():
+    """ADVICE r4: a concrete float-dtype Tensor bound was silently
+    truncated via int(...) while a plain Python float raised — same user
+    error must validate the same way."""
+    f = jit.to_static(_float_tensor_range)
+    with pytest.raises(TypeError):
+        f(t(np.float32(0.0)), paddle.to_tensor(np.float32(2.5)))

@@ -318,3 +318,36 @@ def test_bert_padding_mask_routes_to_flash(monkeypatch):
     np.testing.assert_allclose(np.asarray(out.numpy()),
                                np.asarray(ref.numpy()),
                                atol=5e-5, rtol=5e-5)
+
+
+def test_fully_masked_rows_emit_zero():
+    """A query row with ZERO valid keys (all-padded batch row) must emit
+    zeros, not a uniform average over masked values (ADVICE r4: running
+    max stuck at neg_inf made p=exp(0)=1 for every masked position), and
+    its gradients must be zero — consistent with the backward kernels'
+    p=0 reconstruction."""
+    b, s, h, d = 2, 128, 2, 64
+    q = _rand((b, s, h, d), 50)
+    k = _rand((b, s, h, d), 51)
+    v = _rand((b, s, h, d), 52)
+    # batch row 1: every key padded out
+    keep = np.ones((b, s), bool)
+    keep[1, :] = False
+    kpad = jnp.asarray(keep)
+
+    for causal in (False, True):
+        out, vjp = jax.vjp(
+            lambda q, k, v: fa.flash_attention_bshd(
+                q, k, v, causal=causal, key_padding_mask=kpad), q, k, v)
+        o = np.asarray(out)
+        assert np.all(np.isfinite(o))
+        np.testing.assert_allclose(o[1], 0.0, atol=1e-6)
+        # valid rows keep matching the dense reference
+        ref = np.asarray(fa._ref_attention_bshd(
+            q[:1], k[:1], v[:1], causal, 1.0 / np.sqrt(d)))
+        np.testing.assert_allclose(o[:1], ref, atol=5e-5, rtol=5e-5)
+        dq, dk, dv = vjp(jnp.ones_like(out))
+        for g in (dq, dk, dv):
+            ga = np.asarray(g)
+            assert np.all(np.isfinite(ga))
+            np.testing.assert_allclose(ga[1], 0.0, atol=1e-6)

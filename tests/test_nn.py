@@ -393,3 +393,27 @@ class TestClip:
         assert "weight_g" in names and "weight_v" in names
         nn.utils.remove_weight_norm(lin)
         assert "weight" in dict(lin.named_parameters())
+
+
+class TestConvertAttentionMask:
+    def test_bool_becomes_additive_reference_semantics(self):
+        """reference _convert_attention_mask: bool -> 0 / -1e9 in dtype,
+        so user code that ADDS the result to attention scores keeps exact
+        reference semantics (ADVICE r4: pass-through silently added 0/1).
+        The internal layer path uses _normalize_attention_mask instead."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.layer.transformer import (
+            _convert_attention_mask, _normalize_attention_mask,
+        )
+
+        m = paddle.to_tensor(np.array([[True, False, True]]))
+        out = _convert_attention_mask(m, "float32")
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[0.0, -1e9, 0.0]])
+        assert out._value.dtype == jnp.float32
+        # additive masks pass through unchanged
+        add = paddle.to_tensor(np.zeros((1, 3), "float32"))
+        assert _convert_attention_mask(add, "float32") is add
+        # internal path keeps bool (flash key-padding route)
+        assert _normalize_attention_mask(m)._value.dtype == jnp.bool_

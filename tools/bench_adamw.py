@@ -2,7 +2,7 @@
 """A/B: fused AdamW Pallas kernel vs XLA elementwise update (VERDICT r2 #6).
 
 Run ON the TPU. 355M-param-scale flat buffers (the bench model's size).
-Appends the result to BENCH_NOTES_r04.json.
+Appends the result to BENCH_NOTES_r05.json.
 
 Timing: chained data-dependent iterations inside one jit + terminal scalar
 fetch, minus the measured scalar round-trip — under the axon tunnel
@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                      "BENCH_NOTES_r04.json")
+                      "BENCH_NOTES_r05.json")
 
 
 from _bench_timing import bench_chained  # noqa: E402  (shared clock — both

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # One-command TPU bench battery — run the moment the tunnel is healthy.
-# Persists every result to BENCH_NOTES_r04.json (each tool appends).
+# Persists every result to BENCH_NOTES_r05.json (each tool appends).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -31,4 +31,4 @@ python tools/bench_decode.py
 echo "=== eager dispatch (TPU) ==="
 python tools/bench_eager.py
 
-echo "done — see BENCH_NOTES_r04.json"
+echo "done — see BENCH_NOTES_r05.json"

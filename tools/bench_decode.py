@@ -4,7 +4,7 @@
 GPT-355M greedy decode on one chip: B8, prompt 128, 128 new tokens — the
 whole decode is ONE compiled program (models/generation.py device loop),
 so the measurement is real device time, not 63ms-per-token tunnel round
-trips. Appends the result to BENCH_NOTES_r04.json.
+trips. Appends the result to BENCH_NOTES_r05.json.
 """
 import json
 import os
@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                      "BENCH_NOTES_r04.json")
+                      "BENCH_NOTES_r05.json")
 
 
 def main():

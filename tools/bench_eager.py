@@ -12,7 +12,7 @@ dispatch; under jit the same chain traces away. This measures both:
   3. the same loop inside ONE StaticFunction (compiled; the deploy path)
   4. raw jax eager for reference (what the dispatch layer adds on top)
 
-Appends a JSON line to BENCH_NOTES_r04.json. Run with no args.
+Appends a JSON line to BENCH_NOTES_r05.json. Run with no args.
 """
 import json
 import os
@@ -90,7 +90,7 @@ def main():
     }
     print(json.dumps(rec), flush=True)
     notes = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                         "BENCH_NOTES_r04.json")
+                         "BENCH_NOTES_r05.json")
     rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     with open(notes, "a") as f:
         f.write(json.dumps(rec) + "\n")

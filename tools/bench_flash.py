@@ -3,7 +3,7 @@
 attention, fwd and fwd+bwd, S ∈ {512, 1024, 2048, 4096} (VERDICT r2 #2).
 
 Run ON the TPU (no env scrubbing). Appends one JSON line per (S, impl,
-blocks, direction) to BENCH_NOTES_r04.json and prints a summary table to
+blocks, direction) to BENCH_NOTES_r05.json and prints a summary table to
 stderr, plus a final recommendation line: the measured per-S dispatch
 threshold for nn/functional/attention.py's pallas_flash_min_seq.
 
@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                      "BENCH_NOTES_r04.json")
+                      "BENCH_NOTES_r05.json")
 
 
 def _log(m):

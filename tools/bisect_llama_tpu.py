@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                      "BENCH_NOTES_r04.json")
+                      "BENCH_NOTES_r05.json")
 
 
 def _persist(rec):

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-5 tunnel-return battery, most-valuable-first so a re-wedge costs
+# least. Order tracks VERDICT.md r4 "Next round":
+#   1. llama bisect (the quarantine is the #1 open item)
+#   2. headline GPT ladder (banks the official TPU artifact evidence)
+#   3. gpt13 — the 1.3B north-star config (>=40% MFU target)
+#   4+ BASELINE.md cleanup re-measures + decode row
+# Each step runs under its own timeout; a hang kills only that step.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+# everything also lands in a line-buffered log — pipe buffers lose
+# output when a re-wedge gets steps SIGKILLed (happened r4)
+exec > >(stdbuf -oL tee -a rerun_r05.log) 2>&1
+echo "=== r5 battery start $(date -u +%H:%M:%S) ==="
+
+echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
+timeout 1800 python tools/bisect_llama_tpu.py
+echo "bisect rc=$?"
+
+# ladder outer timeouts: worst case = rungs x 1800s inner budget + probe
+# slack (the outer kill must never beat the ladder's own per-rung kills,
+# or the combined best-line artifact is lost mid-ladder)
+echo "=== 2. headline GPT ladder (official artifact evidence) ==="
+BENCH_BONUS=0 timeout 5700 python bench.py --model gpt
+
+echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
+BENCH_BONUS=0 timeout 7500 python bench.py --model gpt13
+
+echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
+BENCH_SMALL=0 timeout 900 python bench.py --model resnet50
+
+echo "=== 5. fused AdamW re-verdict at designed 256x1024 blocking ==="
+timeout 900 python tools/bench_adamw.py
+
+echo "=== 6. flash S=1024 block tie-break (reps=9) ==="
+timeout 1200 python tools/bench_flash.py --s 1024 --reps 9
+
+echo "=== 7. bert re-measure with chained clock ==="
+timeout 900 python bench.py --model bert
+
+echo "=== 8. decode throughput (device-side while_loop) ==="
+timeout 1800 python tools/bench_decode.py
+
+echo "=== 9. bert B64 batch probe ==="
+BENCH_BATCH=64 timeout 900 python bench.py --model bert
+
+echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
+BENCH_BATCH=8 BENCH_RECOMPUTE=1 timeout 2400 python bench.py --model llama
+
+echo "done — see BENCH_NOTES_r05.json"
