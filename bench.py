@@ -511,6 +511,173 @@ def bench_resnet50(dev, small):
     })
 
 
+# -------------------------------------------------- dynamic-shape vision
+
+def _time_stream(step, batches, reps):
+    """Chained timing over a HETEROGENEOUS batch stream (the dynamic-shape
+    benches): every batch every rep, ONE terminal sync per rep, minus the
+    scalar round-trip — same methodology as _time_steps."""
+    _log(f"warmup pass over {len(batches)} batches (compiles each bucket)")
+    t0 = time.time()
+    out = None
+    for b in batches:
+        out = step(*b)
+    _sync(out)
+    compile_s = time.time() - t0
+    rt = _roundtrip_s()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for b in batches:
+            out = step(*b)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0 - rt)
+        _log(f"stream pass: {best:.3f}s")
+    return max(best, 1e-9), compile_s
+
+
+def bench_yoloe(dev, small):
+    """PP-YOLOE-s dynamic-shape training (BASELINE.md config 5): images
+    arrive at varying resolutions and gt counts; jit.BucketedFunction pads
+    onto a bucket ladder so XLA compiles once per bucket, not per shape.
+    Reports imgs/s + the recompile count on the shape stream."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit
+    from paddle_tpu.vision.models import ppyoloe_s
+
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small:
+        sizes, B, M_max, reps = [64, 96], 2, 8, 2
+    else:
+        sizes, B, M_max, reps = [320, 416, 512], 8, 16, 3
+    B = int(os.environ.get("BENCH_BATCH", B))
+
+    paddle.seed(0)
+    model = ppyoloe_s(num_classes=80)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(imgs, gt_boxes, gt_labels, gt_mask):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = model.loss(model(imgs), gt_boxes, gt_labels, gt_mask)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    mladder = [M_max // 2, M_max]
+    step = jit.BucketedFunction(
+        train_fn,
+        axes={0: {2: sizes, 3: sizes},
+              1: {1: mladder}, 2: {1: mladder}, 3: {1: mladder}},
+        pad_values={1: 0.0, 2: 0, 3: 0.0},
+        observe=[model, opt])
+
+    # a seeded stream of 8 batches at varied (H, W, M) — the dynamic-shape
+    # workload the reference feeds PP-YOLOE (multi-scale training)
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(8):
+        H = int(rng.choice(sizes))
+        W = int(rng.choice(sizes))
+        M = int(rng.integers(2, M_max))
+        imgs = paddle.to_tensor(
+            rng.standard_normal((B, 3, H, W)).astype("float32"))
+        xy = rng.uniform(0, min(H, W) * 0.6, (B, M, 2)).astype("float32")
+        wh = rng.uniform(8, min(H, W) * 0.3, (B, M, 2)).astype("float32")
+        boxes = np.concatenate([xy, xy + wh], -1)
+        batches.append((imgs, paddle.to_tensor(boxes),
+                        paddle.to_tensor(rng.integers(0, 80, (B, M))),
+                        paddle.to_tensor(np.ones((B, M), "float32"))))
+    distinct = len({tuple(b[0].shape) + tuple(b[1].shape) for b in batches})
+
+    stream_s, compile_s = _time_stream(step, batches, reps)
+    imgs_per_s = len(batches) * B / stream_s
+    _emit({
+        "metric": "yoloe_images_per_sec_per_chip",
+        "value": round(imgs_per_s, 1),
+        "unit": "imgs/s",
+        "vs_baseline": 1.0,
+        "config": f"ppyoloe_s-b{B}-sizes{sizes}-bf16-bucketed",
+        "distinct_input_shapes": distinct,
+        "recompiles": step.compile_count,
+        "stream_batches": len(batches),
+        "compile_s": round(compile_s, 1),
+        "mfu_vs_v5e_peak": None,
+        "device": str(dev.platform),
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    })
+
+
+def bench_ocr(dev, small):
+    """PP-OCR CRNN recognition training (BASELINE.md config 5's second
+    half): variable-width text crops + variable-length labels, bucket-
+    padded (CTC ignores padded frames via the blank path). imgs/s +
+    recompile count."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit
+    from paddle_tpu.vision.models import CRNN
+
+    on_tpu = dev.platform in ("tpu", "axon")
+    if small:
+        widths, B, L_max, reps = [64, 96], 4, 8, 2
+    else:
+        widths, B, L_max, reps = [96, 160, 256, 320], 32, 24, 3
+    B = int(os.environ.get("BENCH_BATCH", B))
+
+    paddle.seed(0)
+    model = CRNN(num_classes=97)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def train_fn(imgs, labels, label_lengths):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            log_probs = model(imgs)
+            loss = model.loss(log_probs, labels, label_lengths)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    lladder = [L_max // 2, L_max]
+    step = jit.BucketedFunction(
+        train_fn,
+        axes={0: {3: widths}, 1: {1: lladder}},
+        pad_values={1: 0},
+        observe=[model, opt])
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(8):
+        W = int(rng.choice(widths))
+        L = int(rng.integers(2, L_max))
+        imgs = paddle.to_tensor(
+            rng.standard_normal((B, 3, 32, W)).astype("float32"))
+        labels = paddle.to_tensor(rng.integers(1, 97, (B, L)))
+        lengths = paddle.to_tensor(np.full((B,), L, "int64"))
+        batches.append((imgs, labels, lengths))
+    distinct = len({tuple(b[0].shape) + tuple(b[1].shape) for b in batches})
+
+    stream_s, compile_s = _time_stream(step, batches, reps)
+    imgs_per_s = len(batches) * B / stream_s
+    _emit({
+        "metric": "ocr_images_per_sec_per_chip",
+        "value": round(imgs_per_s, 1),
+        "unit": "imgs/s",
+        "vs_baseline": 1.0,
+        "config": f"crnn-b{B}-w{widths}-bf16-bucketed",
+        "distinct_input_shapes": distinct,
+        "recompiles": step.compile_count,
+        "stream_batches": len(batches),
+        "compile_s": round(compile_s, 1),
+        "mfu_vs_v5e_peak": None,
+        "device": str(dev.platform),
+        "cpu_fallback": os.environ.get("BENCH_CPU_FALLBACK") == "1",
+    })
+
+
 # ----------------------------------------------------------------- Llama
 
 def bench_llama(dev, small):
@@ -626,7 +793,8 @@ def bench_llama7b(dev, small):
 
 _MODELS = {"gpt": bench_gpt, "gpt13": bench_gpt13, "bert": bench_bert,
            "resnet50": bench_resnet50, "llama": bench_llama,
-           "llama7b": bench_llama7b}
+           "llama7b": bench_llama7b, "yoloe": bench_yoloe,
+           "ocr": bench_ocr}
 
 
 def _launch_banked(desc: str, cmd, budget: float, overrides: dict):

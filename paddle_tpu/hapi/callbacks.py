@@ -11,7 +11,8 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "CallbackList", "config_callbacks"]
+           "LRScheduler", "CallbackList", "config_callbacks", "VisualDL",
+           "WandbCallback"]
 
 
 class Callback:
@@ -218,3 +219,223 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or [],
     })
     return lst
+
+
+class _ScalarWriter:
+    """Append-only JSONL scalar event log — the native telemetry sink.
+
+    One line per scalar: {"tag", "step", "value", "wall_time"}. Chosen
+    over binary event formats because (a) this image ships neither
+    visualdl nor tensorboard, (b) JSONL greps/streams/imports anywhere,
+    and (c) an append is one syscall — nothing that can stall a TPU step.
+    """
+
+    def __init__(self, log_dir: str, filename: str = "scalars.jsonl"):
+        import json
+        self._json = json
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, filename)
+        self._f = open(self._path, "a", buffering=1)  # line-buffered
+
+    def add_scalar(self, tag, value, step):
+        self._f.write(self._json.dumps(
+            {"tag": str(tag), "step": int(step), "value": float(value),
+             "wall_time": time.time()}) + "\n")
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _metric_names(metrics):
+    """Flatten params['metrics'] entries to names — a Metric.name() may
+    return a LIST (Accuracy with topk) — and lead with 'loss' (the
+    reference Model's metric-name list starts with loss)."""
+    names = []
+    for m in metrics or []:
+        for n in (m if isinstance(m, (list, tuple)) else [m]):
+            if isinstance(n, str) and n not in names:
+                names.append(n)
+    return names if "loss" in names else ["loss"] + names
+
+
+def _scalar_logs(logs, metrics):
+    """(tag, value) pairs for the metric keys present in logs — list/tuple
+    metric values log their first element (reference VisualDL._updates)."""
+    out = []
+    for k in metrics:
+        if k not in (logs or {}):
+            continue
+        v = logs[k]
+        if isinstance(v, (list, tuple)):
+            v = v[0] if v else None
+        if isinstance(v, numbers.Number):
+            out.append((k, float(v)))
+    return out
+
+
+class _TelemetryBase(Callback):
+    """Shared train/eval bookkeeping for the telemetry callbacks
+    (reference: callbacks.py VisualDL — same hook set and step math)."""
+
+    def __init__(self):
+        super().__init__()
+        self.epoch = 0
+        self.train_step = 0
+        self._is_fit = False
+
+    def _is_write(self):
+        from ..distributed import ParallelEnv
+        return ParallelEnv().local_rank == 0
+
+    def _write_scalar(self, tag, value, step):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _updates(self, logs, mode):
+        if not self._is_write():
+            return
+        metrics = getattr(self, f"{mode}_metrics", None) or []
+        step = self.train_step if mode == "train" else self.epoch
+        for k, v in _scalar_logs(logs, metrics):
+            self._write_scalar(f"{mode}/{k}", v, step)
+
+    def on_train_begin(self, logs=None):
+        self.train_metrics = _metric_names(self.params.get("metrics"))
+        self._is_fit = True
+        self.train_step = 0
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch or 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        self._updates(logs or {}, "train")
+
+    def on_eval_begin(self, logs=None):
+        logs = logs or {}
+        self.eval_metrics = _metric_names(
+            logs.get("metrics") or self.params.get("metrics"))
+
+    def on_eval_end(self, logs=None):
+        self._updates(logs or {}, "eval")
+        if not self._is_fit:
+            self._close()
+
+    def on_train_end(self, logs=None):
+        self._close()
+
+    def _close(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class VisualDL(_TelemetryBase):
+    """reference: hapi/callbacks.py:883 VisualDL — scalar telemetry into
+    ``log_dir`` with the reference's tags (``train/<metric>`` per train
+    step, ``eval/<metric>`` per epoch) and rank-0 gating.
+
+    Sink: the real ``visualdl.LogWriter`` when the package is importable;
+    otherwise the native JSONL writer (documented divergence — this image
+    ships no visualdl; the reference raises ImportError instead. Same
+    tags/steps either way, so dashboards can be rebuilt from the JSONL)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+
+    def _ensure_writer(self):
+        if self._writer is None:
+            try:
+                import visualdl
+                self._writer = visualdl.LogWriter(self.log_dir)
+                self._native = False
+            except ImportError:
+                self._writer = _ScalarWriter(self.log_dir)
+                self._native = True
+        return self._writer
+
+    def _write_scalar(self, tag, value, step):
+        w = self._ensure_writer()
+        if self._native:
+            w.add_scalar(tag, value, step)
+        else:
+            w.add_scalar(tag=tag, value=value, step=step)
+
+    def _close(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class WandbCallback(_TelemetryBase):
+    """reference: hapi/callbacks.py:999 WandbCallback — Weights & Biases
+    run tracking with the reference's constructor surface.
+
+    When ``wandb`` is importable the real client is used (reusing an
+    in-progress run exactly like the reference). Otherwise falls back to
+    an OFFLINE native run directory (``<dir>/wandb-offline/<name>`` with
+    config.json + scalars.jsonl) instead of raising — this image has no
+    network egress, and a hard ImportError would make the callback dead
+    weight (divergence documented)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        self.wandb_args = {"project": project, "name": name,
+                           "entity": entity, "dir": dir, "mode": mode,
+                           "job_type": job_type}
+        self.wandb_args.update(kwargs)
+        self._run = None
+        self._wandb = None
+        self._writer = None
+        try:
+            import wandb
+            self._wandb = wandb
+        except ImportError:
+            pass
+
+    @property
+    def run(self):
+        if not self._is_write():
+            return None
+        if self._wandb is not None and self._run is None:
+            if self._wandb.run is not None:
+                import warnings
+                warnings.warn(
+                    "There is a wandb run already in progress; this "
+                    "WandbCallback will reuse it. Call wandb.finish() "
+                    "first if that is not desired.")
+                self._run = self._wandb.run
+            else:
+                self._run = self._wandb.init(
+                    **{k: v for k, v in self.wandb_args.items()
+                       if v is not None})
+        return self._run
+
+    def _ensure_writer(self):
+        if self._writer is None:
+            import json
+            base = self.wandb_args.get("dir") or "wandb"
+            name = self.wandb_args.get("name") or "run"
+            run_dir = os.path.join(base, "wandb-offline", str(name))
+            self._writer = _ScalarWriter(run_dir)
+            with open(os.path.join(run_dir, "config.json"), "w") as f:
+                json.dump({k: v for k, v in self.wandb_args.items()
+                           if v is not None}, f)
+        return self._writer
+
+    def _write_scalar(self, tag, value, step):
+        if self._wandb is not None:
+            if self.run is not None:
+                self.run.log({tag: value}, step=step)
+        else:
+            self._ensure_writer().add_scalar(tag, value, step)
+
+    def _close(self):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -186,6 +186,12 @@ class Model:
             cbks.on_epoch_end(epoch, logs)
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                # reference fit loop brackets evaluation with
+                # on_eval_begin({'steps', 'metrics'}) / on_eval_end(logs)
+                cbks.on_eval_begin({
+                    "steps": None,
+                    "metrics": ["loss"] + [m.name()
+                                           for m in self._metrics]})
                 eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
                                           verbose=0, num_workers=num_workers,
                                           callbacks=cbks,

@@ -25,16 +25,28 @@ non-tensor control flow, so it is safe to apply to every to_static target.
 tensor bound compiles to one lax.while_loop; concrete bounds dispatch to
 the plain Python loop at runtime (the old unroll behavior, bit-identical).
 
+``break``/``continue``/``return`` ARE converted (reference:
+break_continue_transformer.py:88, return_transformer.py) by two pre-passes:
+pass R rewrites nested ``return`` into single-exit form — an ``if`` with
+returns becomes CPS (``return convert_ifelse(t, fT, fF)`` with the
+continuation folded into the falling-through branch); a loop with returns
+gets a retval/flag guard-carry plus ``break``. Pass B lifts ``break``/
+``continue`` into concrete-bool-Tensor guard flags carried by the loop
+(the loop condition gains ``and not brk``; statements after a possible
+escape are wrapped in flag-guarded ifs) so tensor-predicate loops with
+breaks compile to one lax.while_loop.
+
 Deliberately NOT converted (left as plain Python, same behavior as before
-the pass): ``if``/``while``/``for`` containing ``break``/``continue``/
-``return`` (except the common both-branches-return-an-expression ``if``),
-``for`` over non-range iterables or with tuple targets / ``else``, and
-anything whose source is unavailable (lambdas, REPL) — the transform then
-no-ops.
+the pass): escapes under ``try``/``with``-with-return, generators,
+loop-``else`` clauses, ``for`` over non-range iterables or with tuple
+targets, ``return`` inside a COMPILED loop whose value structure cannot
+merge (loud error at trace time; eager regime is exact), and anything
+whose source is unavailable (lambdas, REPL) — the transform then no-ops.
 """
 from __future__ import annotations
 
 import ast
+import copy
 import inspect
 import textwrap
 import types
@@ -43,7 +55,7 @@ from typing import List, Sequence
 
 __all__ = ["ast_transform", "convert_ifelse", "convert_while",
            "convert_for_range", "convert_logical_and", "convert_logical_or",
-           "convert_logical_not", "UNDEFINED", "ld"]
+           "convert_logical_not", "UNDEFINED", "ld", "true_", "false_"]
 
 
 class _Undefined:
@@ -72,6 +84,50 @@ UNDEFINED = _Undefined()
 def ld(local_ns: dict, name: str):
     """Load ``name`` from a locals() snapshot, UNDEFINED when unbound."""
     return local_ns.get(name, UNDEFINED)
+
+
+_FLAG_VALUES = None
+
+
+def _flag_values():
+    """Lazily-cached (True, False) jnp scalars — flags are created per
+    loop entry/iteration, so the underlying arrays are shared while each
+    call still returns a FRESH Tensor cell (a shared cell in two carry
+    slots would corrupt the id()-based substitution bookkeeping)."""
+    global _FLAG_VALUES
+    if _FLAG_VALUES is None:
+        import jax.numpy as jnp
+        _FLAG_VALUES = (jnp.asarray(True), jnp.asarray(False))
+    return _FLAG_VALUES
+
+
+def true_():
+    """Concrete scalar bool Tensor — break/continue/return guard flags are
+    seeded as TENSORS (not Python bools) so a compiled loop can carry them
+    (while_loop rejects Python-scalar carries as silent constants) while
+    the eager regime still just reads them concretely."""
+    from ..tensor import Tensor
+    return Tensor(_flag_values()[0], stop_gradient=True)
+
+
+def false_():
+    from ..tensor import Tensor
+    return Tensor(_flag_values()[1], stop_gradient=True)
+
+
+def _flag_set(v) -> bool:
+    """Best-effort early exit for the unrolled (concrete-bound) regime:
+    True when the break flag is readably set. A TRACED flag (everything
+    is a tracer under jit, even `false_()` constants) returns False — the
+    loop keeps unrolling, which stays CORRECT because pass B wraps the
+    whole for-body (loop-target assignment included) in the ``not brk``
+    guard; the broken-out iterations compile to no-op conds. Only the
+    early-exit optimization is lost."""
+    if _is_traced_tensor(v):
+        return False
+    if _is_tensor(v):
+        return bool(v._value)
+    return bool(v)
 
 
 def _is_tensor(x) -> bool:
@@ -105,7 +161,10 @@ def convert_ifelse(pred, true_fn, false_fn, args=()):
 def convert_while(cond_fn, body_fn, vals: Sequence):
     """Runtime dispatch for a rewritten ``while``. ``vals`` are the
     candidate loop variables (UNDEFINED for names unbound before the loop —
-    pure per-iteration temps)."""
+    pure per-iteration temps). Compiled-regime corner: Python-scalar loop
+    vars are lifted into the carry as int32/weak-float Tensors (same
+    policy as the for-range header) — ints beyond int32 are not supported
+    compiled; the eager regime keeps exact Python arithmetic."""
     probe = cond_fn(*vals)
     if not _is_traced_tensor(probe):
         # eager regime: plain Python loop on the tape
@@ -118,6 +177,20 @@ def convert_while(cond_fn, body_fn, vals: Sequence):
         return tuple(vals)
 
     from ..static.nn import while_loop as _while_loop
+
+    # loop vars bound to plain Python scalars (`i = -1` before the loop)
+    # are genuine carries here — the rewritten body rebinds them — so
+    # lift them to Tensors; raw while_loop rightly refuses the ambiguity
+    # (int32 for ints, matching the for-range header policy)
+    vals = list(vals)
+    for idx, v in enumerate(vals):
+        if isinstance(v, (bool, int, float)):
+            import jax.numpy as jnp
+
+            from ..tensor import Tensor
+            dt = (jnp.int32 if isinstance(v, int)
+                  and not isinstance(v, bool) else None)
+            vals[idx] = Tensor(jnp.asarray(v, dt), stop_gradient=True)
 
     carried = [i for i, v in enumerate(vals) if v is not UNDEFINED]
     if not carried:
@@ -146,7 +219,8 @@ def convert_while(cond_fn, body_fn, vals: Sequence):
 
 
 def convert_for_range(range_args, body_fn, vals: Sequence,
-                      tgt_index: int = -1, range_obj=range):
+                      tgt_index: int = -1, range_obj=range,
+                      brk_index: int = -1):
     """Runtime dispatch for a rewritten ``for <tgt> in range(...)``.
 
     ``body_fn(hdr, *vals)`` binds the loop target to ``hdr`` as its first
@@ -173,6 +247,8 @@ def convert_for_range(range_args, body_fn, vals: Sequence,
         vals = list(vals)
         for h in range_obj(*range_args):
             vals = list(body_fn(h, *vals))
+            if brk_index >= 0 and _flag_set(vals[brk_index]):
+                break
         return tuple(vals)
 
     args = list(range_args)
@@ -219,8 +295,17 @@ def convert_for_range(range_args, body_fn, vals: Sequence,
         # Python ints (weak typing and all), the loop is a Python loop
         s0 = int(start.numpy().reshape(())) if _is_tensor(start) else start
         s1 = int(stop.numpy().reshape(())) if _is_tensor(stop) else stop
+        if (brk_index >= 0 and 0 <= tgt_index < len(vals)
+                and vals[tgt_index] is UNDEFINED):
+            # a lifted break puts the target INSIDE the guard if — when
+            # the flag is traced that if compiles, and its branch merge
+            # needs a defined other-path value (same seeding rule as the
+            # compiled path; zero-iteration divergence documented there)
+            vals[tgt_index] = s0
         for h in range(s0, s1, step):
             vals = list(body_fn(h, *vals))
+            if brk_index >= 0 and _flag_set(vals[brk_index]):
+                break
         return tuple(vals)
 
     # a bound is traced: the loop compiles. The while_loop carries Tensors
@@ -247,11 +332,24 @@ def convert_for_range(range_args, body_fn, vals: Sequence,
                                  stop_gradient=True)
 
     if step > 0:
-        def cond_fn(h, *vs):
+        def cond_hdr(h):
             return h < stop
     else:
-        def cond_fn(h, *vs):
+        def cond_hdr(h):
             return h > stop
+
+    if brk_index >= 0:
+        def cond_fn(h, *vs):
+            # the break flag rides the carry: loop while in-range AND the
+            # body hasn't raised the flag (reference:
+            # break_continue_transformer.py:88 folds the flag into the
+            # loop condition the same way)
+            from ..ops import logic as _logic
+            return _logic.logical_and(cond_hdr(h),
+                                      _logic.logical_not(vs[brk_index]))
+    else:
+        def cond_fn(h, *vs):
+            return cond_hdr(h)
 
     def body2(h, *vs):
         out = body_fn(h, *vs)
@@ -261,10 +359,28 @@ def convert_for_range(range_args, body_fn, vals: Sequence,
     return res[1:]
 
 
+def _concrete_scalar_bool(x):
+    """bool(x) when x is a CONCRETE scalar tensor, else None. Lets the
+    logical converters keep CPython short-circuit semantics in the eager
+    regime (``a and b`` with a concrete falsy scalar must not evaluate
+    b — a converted while cond like ``not brk and arr[i] > 0`` relies on
+    it to skip the out-of-range read after a break, exactly as CPython
+    skips the test after a break)."""
+    if (_is_tensor(x) and not _is_traced_tensor(x)
+            and getattr(x._value, "size", 0) == 1):
+        return bool(x._value)
+    return None
+
+
 def convert_logical_and(x, y_fn):
-    """``a and b`` with short-circuit preserved for Python values
-    (reference: convert_operators.py convert_logical_and)."""
+    """``a and b`` with short-circuit preserved for Python values AND
+    concrete scalar tensors (reference: convert_operators.py
+    convert_logical_and); traced/array operands lower to the elementwise
+    op (both sides evaluate — inherent to compiled control flow)."""
     if _is_tensor(x):
+        xb = _concrete_scalar_bool(x)
+        if xb is not None:
+            return y_fn() if xb else x
         from ..ops import logic as _logic
 
         return _logic.logical_and(x, y_fn())
@@ -273,6 +389,9 @@ def convert_logical_and(x, y_fn):
 
 def convert_logical_or(x, y_fn):
     if _is_tensor(x):
+        xb = _concrete_scalar_bool(x)
+        if xb is not None:
+            return x if xb else y_fn()
         from ..ops import logic as _logic
 
         return _logic.logical_or(x, y_fn())
@@ -298,14 +417,20 @@ _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
 
 
 def _assigned_names(nodes: Sequence[ast.stmt]) -> List[str]:
-    """Plain Names stored at this function's scope within ``nodes``."""
+    """Plain Names stored at this function's scope within ``nodes``.
+    Generated locals()-snapshot temps are excluded: they are dicts
+    assigned+consumed within one statement run and must never become
+    branch targets or loop carries (a dict leaf poisons a compiled
+    carry; an UNDEFINED one poisons a traced branch merge)."""
     out = []
 
     def walk(n):
         if isinstance(n, _SCOPE_NODES):
             return
         if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
-            if n.id not in out:
+            if (n.id not in out
+                    and not n.id.startswith(("__jst_locals_",
+                                             "__jst_rloc_"))):
                 out.append(n.id)
         for c in ast.iter_child_nodes(n):
             walk(c)
@@ -333,6 +458,414 @@ def _has_flow_escape(nodes: Sequence[ast.stmt]) -> bool:
     for n in nodes:
         walk(n)
     return found
+
+
+def _escapes_at_level(nodes: Sequence[ast.stmt], *, into_loops: bool):
+    """Which flow escapes occur at this level: a set of
+    {'break','continue','return','yield','try'}. break/continue bind to
+    the nearest LOOP, so the walk never descends into nested loops for
+    them; return/yield escape the FUNCTION, so with ``into_loops=True``
+    the walk descends into loops too (but never nested scopes). A 'try'
+    marker is reported when an escape sits inside a Try at this level —
+    guard-wrapping across exception scopes is not attempted."""
+    found = set()
+
+    def walk(n, in_try):
+        if isinstance(n, _SCOPE_NODES):
+            return
+        if isinstance(n, ast.Break):
+            found.add("try" if in_try else "break")
+            return
+        if isinstance(n, ast.Continue):
+            found.add("try" if in_try else "continue")
+            return
+        if isinstance(n, ast.Return):
+            found.add("try" if in_try else "return")
+            return
+        if isinstance(n, (ast.Yield, ast.YieldFrom)):
+            found.add("yield")
+            return
+        if isinstance(n, (ast.While, ast.For, ast.AsyncFor)):
+            if into_loops:
+                for c in ast.iter_child_nodes(n):
+                    walk(c, in_try)
+            return
+        in_try = in_try or isinstance(n, (ast.Try,))
+        for c in ast.iter_child_nodes(n):
+            walk(c, in_try)
+
+    for n in nodes:
+        walk(n, False)
+    return found
+
+
+class _Bail(Exception):
+    """Internal: abort a rewrite pass, leaving the function as-is."""
+
+
+def _assign(name: str, value: ast.expr) -> ast.Assign:
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _locals_snapshot_stmts(uid_fn, names, tag: str):
+    """stmts binding each unbound name to UNDEFINED via ONE locals() read
+    — shared by every pass that lifts names into generated functions.
+    The snapshot temp's name must stay on _assigned_names' exclusion list."""
+    snap = uid_fn(tag)
+    stmts = [_assign(snap, ast.Call(func=_name("locals"), args=[],
+                                    keywords=[]))]
+    for n in names:
+        stmts.append(_assign(n, _jst_call(
+            "ld", [_name(snap), ast.Constant(value=n)])))
+    return stmts
+
+
+def _fn_def(fname, argnames, body, ret_names=None):
+    """A generated nested function. ``ret_names`` appends a tuple-return
+    of those names; None leaves the body's own returns in charge (a CPS
+    branch falling off the end returns None, like CPython)."""
+    body = list(body) or [ast.Pass()]
+    if ret_names is not None:
+        body = body + [ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in ret_names], ctx=ast.Load()))]
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[])
+
+
+def _all_paths_return(stmts: Sequence[ast.stmt]) -> bool:
+    """Conservative: True when every path through ``stmts`` ends in a
+    Return (chains of if/else with returning branches count; raise and
+    infinite loops deliberately don't)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (bool(last.orelse) and _all_paths_return(last.body)
+                and _all_paths_return(last.orelse))
+    return False
+
+
+class _ReturnRewriter:
+    """Pass R (reference: return_transformer.py): rewrite early/nested
+    ``return`` so the remaining control flow is convertible.
+
+    - ``if`` containing returns → CPS: the if becomes
+      ``return convert_ifelse(t, fT, fF)`` where each branch function ends
+      the function (the statements AFTER the if — the continuation — are
+      folded into the branch(es) that fall through). Under a traced
+      predicate both branches must produce the same return structure
+      (loud _traced_multiway error otherwise); concrete predicates keep
+      exact CPython semantics.
+    - a loop containing returns → guard-carry: ``return e`` becomes
+      retval/flag assignments + ``break`` (pass B then converts the
+      break), and the loop is followed by
+      ``return convert_ifelse(flag, lambda: retval, rest_fn)``.
+      Compiled (tensor-predicate) loops reject this shape loudly today —
+      the retval cannot be carried without a pre-seeded structure; the
+      eager regime is exact.
+    Raises _Bail for shapes it won't touch (returns under Try/With,
+    generators) — the function then keeps its previous behavior.
+    """
+
+    _NODE_BUDGET = 20_000  # CPS duplicates continuations; cap the blowup
+
+    def __init__(self, uid_fn):
+        self._next = uid_fn
+        self._rv = self._next("rv")
+        self._rf = self._next("rf")
+        self._nodes = 0
+        self.changed = False
+
+    # -- helpers -------------------------------------------------------
+    def _charge(self, stmts):
+        self._nodes += sum(len(list(ast.walk(s))) for s in stmts)
+        if self._nodes > self._NODE_BUDGET:
+            raise _Bail("return-CPS continuation duplication too large")
+
+    def _may_return(self, st) -> bool:
+        esc = _escapes_at_level([st], into_loops=True)
+        if "yield" in esc:
+            raise _Bail("yield")
+        if "try" in esc:
+            raise _Bail("return under try")
+        return "return" in esc
+
+    def _branch_fn(self, fname, argnames, body):
+        return _fn_def(fname, argnames, body)
+
+    def _locals_snapshot(self, names):
+        return _locals_snapshot_stmts(self._next, names, "rloc")
+
+    def _cps_if(self, node: ast.If, rest: List[ast.stmt]) -> List[ast.stmt]:
+        """(if + continuation) → single Return of convert_ifelse."""
+        self.changed = True
+        t_apr = _all_paths_return(node.body)
+        f_apr = _all_paths_return(node.orelse) if node.orelse else False
+        t_body = list(node.body) + ([] if t_apr
+                                    else [copy.deepcopy(s) for s in rest])
+        f_body = list(node.orelse) + ([] if f_apr else list(rest))
+        if not (t_apr and f_apr):
+            self._charge(rest)
+        t_body = self.transform_block(t_body)
+        f_body = self.transform_block(f_body)
+        targets = list(dict.fromkeys(
+            _assigned_names(t_body) + _assigned_names(f_body)))
+        tname, fname = self._next("retT"), self._next("retF")
+        out = self._locals_snapshot(targets)
+        out.append(self._branch_fn(tname, targets, t_body))
+        out.append(self._branch_fn(fname, targets, f_body))
+        out.append(ast.Return(value=_jst_call(
+            "convert_ifelse",
+            [_TestTransformer().visit(node.test), _name(tname),
+             _name(fname),
+             ast.Tuple(elts=[_name(n) for n in targets], ctx=ast.Load())])))
+        return out
+
+    def _rewrite_loop_returns(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+        """Inside a loop body: return e → rv/rf set + break; statements
+        after the return in the same block are dropped (unreachable).
+        Nested loops were already processed bottom-up, so a remaining
+        Return at this walk belongs to the enclosing function."""
+        out = []
+        for st in stmts:
+            if isinstance(st, ast.Return):
+                out.append(_assign(self._rv,
+                                   st.value if st.value is not None
+                                   else ast.Constant(value=None)))
+                out.append(_assign(self._rf, _jst_call("true_", [])))
+                out.append(ast.Break())
+                break
+            if isinstance(st, ast.If):
+                st = ast.If(test=st.test,
+                            body=self._rewrite_loop_returns(list(st.body)),
+                            orelse=self._rewrite_loop_returns(
+                                list(st.orelse)))
+            elif isinstance(st, ast.With):
+                st = ast.With(items=st.items,
+                              body=self._rewrite_loop_returns(list(st.body)))
+            elif isinstance(st, (ast.While, ast.For)):
+                st = self._process_loop(st, inner=True)
+                if isinstance(st, list):
+                    out.extend(st)
+                    continue
+            elif self._may_return(st):
+                raise _Bail(f"return inside {type(st).__name__}")
+            out.append(st)
+        return out
+
+    def _process_loop(self, node, *, inner: bool):
+        """Rewrite returns within one loop. ``inner=True``: a loop nested
+        inside another return-carrying loop — after it, propagate the
+        flag outward with ``if rf: break`` (pass B converts that break at
+        the enclosing level)."""
+        if not self._may_return(node):
+            return node
+        if node.orelse:
+            raise _Bail("return in a loop with an else clause")
+        body = self._rewrite_loop_returns(list(node.body))
+        new = (ast.While(test=node.test, body=body, orelse=[])
+               if isinstance(node, ast.While) else
+               ast.For(target=node.target, iter=node.iter, body=body,
+                       orelse=[]))
+        if not inner:
+            return new
+        # propagation: the enclosing loop must also stop
+        return [new, ast.If(test=_name(self._rf),
+                            body=[ast.Break()], orelse=[])]
+
+    def transform_block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+        out = []
+        for i, st in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if isinstance(st, ast.Return):
+                out.append(st)      # block-terminal return: fine as-is
+                return out          # anything after is unreachable
+            if not self._may_return(st):
+                out.append(st)
+                continue
+            if isinstance(st, ast.If):
+                out.extend(self._cps_if(st, rest))
+                return out
+            if isinstance(st, (ast.While, ast.For)):
+                processed = self._process_loop(st, inner=False)
+                loop_stmts = (processed if isinstance(processed, list)
+                              else [processed])
+                # init the flag BEFORE the loop so it is a carried loop var
+                out.append(_assign(self._rf, _jst_call("false_", [])))
+                out.extend(loop_stmts)
+                rest_t = self.transform_block(list(rest))
+                targets = list(dict.fromkeys(_assigned_names(rest_t)))
+                vname, rname = self._next("retV"), self._next("retRest")
+                out.extend(self._locals_snapshot(targets))
+                out.append(self._branch_fn(
+                    vname, targets, [ast.Return(value=_name(self._rv))]))
+                out.append(self._branch_fn(rname, targets, rest_t))
+                out.append(ast.Return(value=_jst_call(
+                    "convert_ifelse",
+                    [_name(self._rf), _name(vname), _name(rname),
+                     ast.Tuple(elts=[_name(n) for n in targets],
+                               ctx=ast.Load())])))
+                return out
+            raise _Bail(f"return inside {type(st).__name__}")
+        return out
+
+
+class _BreakContinueRewriter(ast.NodeTransformer):
+    """Pass B (reference: break_continue_transformer.py:88): lift
+    ``break``/``continue`` in convertible loops into boolean guard-carry
+    flags so the loop itself becomes convertible.
+
+    - break    → ``__jst_brk_N = true_()`` (+ the loop condition gains
+                 ``and not __jst_brk_N``; for-range loops get the flag's
+                 carry index plumbed through ``brk_index``)
+    - continue → ``__jst_cnt_N = true_()`` (reset at iteration start)
+    - statements AFTER a possibly-escaping statement are wrapped in
+      ``if not (flag or ...):`` guards, which the main transformer then
+      converts like any other if.
+    Flags are concrete bool TENSORS (true_/false_) so compiled loops can
+    carry them. Loops the main pass would not convert (for over
+    non-range, loop-else, escapes under Try, yields) are left alone.
+    """
+
+    def __init__(self, uid_fn):
+        self._next = uid_fn
+        self.changed = False
+
+    # -- analysis ------------------------------------------------------
+    @staticmethod
+    def _loop_escapes(body):
+        return _escapes_at_level(body, into_loops=False)
+
+    @staticmethod
+    def _for_is_convertible(node) -> bool:
+        """Mirror of visit_For's shape gate (minus the escape check)."""
+        return (not node.orelse
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.iter.args))
+
+    # -- rewrite -------------------------------------------------------
+    def _guard(self, flags: List[str], body: List[ast.stmt]) -> ast.If:
+        test: ast.expr = _name(flags[0])
+        for f in flags[1:]:
+            test = ast.BoolOp(op=ast.Or(),
+                              values=[test, _name(f)])
+        return ast.If(test=ast.UnaryOp(op=ast.Not(), operand=test),
+                      body=body, orelse=[])
+
+    def _rewrite_block(self, stmts, brk, cnt):
+        """Replace break/continue with flag sets; wrap trailing statements
+        of a block in a not-escaped guard. Recurses into if/with blocks
+        (break/continue cannot escape a nested loop)."""
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(_assign(brk, _jst_call("true_", [])))
+                return out, {"break"}
+            if isinstance(st, ast.Continue):
+                out.append(_assign(cnt, _jst_call("true_", [])))
+                return out, {"continue"}
+            escapes = set()
+            if isinstance(st, ast.If):
+                b, eb = self._rewrite_block(list(st.body), brk, cnt)
+                o, eo = self._rewrite_block(list(st.orelse), brk, cnt)
+                st = ast.If(test=st.test, body=b, orelse=o)
+                escapes = eb | eo
+            elif isinstance(st, ast.With):
+                b, escapes = self._rewrite_block(list(st.body), brk, cnt)
+                st = ast.With(items=st.items, body=b)
+            out.append(st)
+            if escapes and i + 1 < len(stmts):
+                rest, er = self._rewrite_block(stmts[i + 1:], brk, cnt)
+                flags = [f for f, e in ((brk, "break"), (cnt, "continue"))
+                         if e in escapes]
+                out.append(self._guard(flags, rest))
+                return out, escapes | er
+            if escapes:
+                return out, escapes
+        return out, set()
+
+    def _rewrite_loop(self, node):
+        escapes = self._loop_escapes(node.body)
+        if not escapes & {"break", "continue"}:
+            return node
+        if escapes - {"break", "continue"}:
+            # an unhandled escape (return pass R bailed on, yield, try)
+            # would leave the loop unconvertible downstream — rewriting
+            # only break/continue would then STRIP the for-range's break
+            # semantics (the plain-Python fallback loop has no flag
+            # check). All-or-nothing: leave the loop alone.
+            return node
+        brk, cnt = self._next("brk"), self._next("cnt")
+        body, _ = self._rewrite_block(list(node.body), brk, cnt)
+        if _has_flow_escape(body):
+            # escapes remain that the downstream converter will refuse —
+            # e.g. a nested NON-convertible loop keeping its own literal
+            # break (for-over-list), or a return pass R bailed on. The
+            # main pass would then leave the loop plain Python, and a
+            # half-rewritten for-range would reference a header name that
+            # is never defined (r5 review repro: NameError). Gate must
+            # match visit_For/_While exactly: all-or-nothing.
+            return node
+        self.changed = True
+        pre = []
+        if "continue" in escapes:
+            body = [_assign(cnt, _jst_call("false_", []))] + body
+        if "break" in escapes:
+            pre = [_assign(brk, _jst_call("false_", []))]
+            if isinstance(node, ast.While):
+                # flag FIRST: `not brk and test` — after a break CPython
+                # never re-evaluates the test, and the converters
+                # short-circuit concrete scalar flags, so a raising/
+                # side-effecting test (arr[i] after i walked off the end)
+                # is skipped exactly like CPython skips it
+                node = ast.While(
+                    test=ast.BoolOp(op=ast.And(), values=[
+                        ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                        node.test]),
+                    body=body, orelse=[])
+            else:
+                # a for-range has no condition slot, so the WHOLE body is
+                # guarded: once the flag is up every further iteration is
+                # a no-op. This keeps the unrolled regime correct even
+                # when the flag is a tracer (under jit every constant is)
+                # — _flag_set's early exit is just an optimization. The
+                # compiled regime additionally stops via brk_index in the
+                # loop condition (convert_for_range).
+                body = [ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                    body=body, orelse=[])]
+                node = ast.For(target=node.target, iter=node.iter,
+                               body=body, orelse=[])
+                node._jst_brk_name = brk
+        else:
+            node = (ast.While(test=node.test, body=body, orelse=[])
+                    if isinstance(node, ast.While) else
+                    ast.For(target=node.target, iter=node.iter, body=body,
+                            orelse=[]))
+        return pre + [node] if pre else node
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        return self._rewrite_loop(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if not self._for_is_convertible(node):
+            return node
+        return self._rewrite_loop(node)
 
 
 def _jst_call(attr: str, args: List[ast.expr]) -> ast.Call:
@@ -379,29 +912,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return f"__jst_{tag}_{self._uid}"
 
     def _locals_snapshot(self, names):
-        """stmts binding each unbound name to UNDEFINED via a locals() read."""
-        snap = self._next("locals")
-        stmts = [ast.Assign(
-            targets=[_name(snap, ast.Store())],
-            value=ast.Call(func=_name("locals"), args=[], keywords=[]))]
-        for n in names:
-            stmts.append(ast.Assign(
-                targets=[_name(n, ast.Store())],
-                value=_jst_call("ld", [_name(snap),
-                                       ast.Constant(value=n)])))
-        return stmts
+        return _locals_snapshot_stmts(self._next, names, "locals")
 
     def _make_fn(self, fname, argnames, body, ret_names):
-        ret = ast.Return(value=ast.Tuple(
-            elts=[_name(n) for n in ret_names], ctx=ast.Load()))
-        return ast.FunctionDef(
-            name=fname,
-            args=ast.arguments(
-                posonlyargs=[],
-                args=[ast.arg(arg=a) for a in argnames],
-                kwonlyargs=[], kw_defaults=[], defaults=[]),
-            body=list(body) + [ret],
-            decorator_list=[])
+        return _fn_def(fname, argnames, body, ret_names)
 
     # ------------------------------------------------------------------ if
     def visit_If(self, node):
@@ -452,6 +966,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         trace. Concrete bounds keep the unroll (dispatched at runtime).
         Anything else — non-range iterables, tuple targets, break/
         continue/return, for-else — stays plain Python."""
+        # pass B wrapped a breaking loop's WHOLE body in `if not brk:`;
+        # the loop target must be assigned INSIDE that guard (broken-out
+        # unrolled iterations must not keep advancing it past CPython's
+        # value) — insert BEFORE generic_visit converts the guard if.
+        # pass B only marks shapes that pass every gate below, so the
+        # conversion is guaranteed to proceed once the marker exists.
+        brk_name = getattr(node, "_jst_brk_name", None)
+        hdr = None
+        if brk_name:
+            hdr = self._next("hdr")
+            guard = node.body[-1]
+            assert isinstance(guard, ast.If), "pass B guard invariant"
+            guard.body.insert(0, ast.Assign(
+                targets=[_name(node.target.id, ast.Store())],
+                value=_name(hdr)))
         self.generic_visit(node)
         if (node.orelse or _has_flow_escape(node.body)
                 or not isinstance(node.target, ast.Name)
@@ -465,10 +994,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         loop_vars = list(dict.fromkeys(_assigned_names(node.body) + [tgt]))
         self.changed = True
         bname = self._next("forbody")
-        hdr = self._next("hdr")
+        # the flag's slot index rides to the runtime so both the unrolled
+        # and the compiled regime stop on the lifted break
+        brk_index = loop_vars.index(brk_name) if brk_name else -1
         stmts = self._locals_snapshot(loop_vars)
-        body = [ast.Assign(targets=[_name(tgt, ast.Store())],
-                           value=_name(hdr))] + list(node.body)
+        if hdr is None:
+            hdr = self._next("hdr")
+            body = [ast.Assign(targets=[_name(tgt, ast.Store())],
+                               value=_name(hdr))] + list(node.body)
+        else:
+            body = list(node.body)  # target assign already in the guard
         stmts.append(self._make_fn(bname, [hdr] + loop_vars, body,
                                    loop_vars))
         call = _jst_call("convert_for_range", [
@@ -479,7 +1014,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             # `range` resolved in the FUNCTION's scope at runtime: a
             # shadowed range falls back to the plain-Python loop inside
             # convert_for_range instead of being silently hijacked
-            _name("range")])
+            _name("range"),
+            ast.Constant(value=brk_index)])
         stmts.append(ast.Assign(
             targets=[ast.Tuple(elts=[_name(n, ast.Store())
                                      for n in loop_vars],
@@ -539,10 +1075,34 @@ def ast_transform(fn):
 
     fdef = tree.body[0]
     fdef.decorator_list = []
+    pre_changed = False
+    # pass R: single-exit return rewrite (reference return_transformer) —
+    # best-effort: a _Bail (returns under try, generators, CPS blowup)
+    # keeps the function's previous behavior
+    uid_counter = [0]
+
+    def _uid(tag):
+        uid_counter[0] += 1
+        return f"__jst_{tag}_{uid_counter[0]}"
+
     tr = _ControlFlowTransformer()
     try:
+        try:
+            rr = _ReturnRewriter(_uid)
+            new_body = rr.transform_block(copy.deepcopy(fdef.body))
+            if rr.changed:
+                fdef.body = new_body
+                pre_changed = True
+        except _Bail:
+            pass
+        # pass B: break/continue → guard-carry flags (reference
+        # break_continue_transformer); makes the loops convertible below
+        bc = _BreakContinueRewriter(_uid)
+        tree = bc.visit(tree)
+        pre_changed = pre_changed or bc.changed
+
         tree = tr.visit(tree)
-        if not tr.changed:
+        if not (tr.changed or pre_changed):
             return fn if bound_self is None else fn.__get__(bound_self)
         ast.fix_missing_locations(tree)
 

@@ -264,6 +264,16 @@ def while_loop(cond, body, loop_vars, is_test: bool = False,
                 "while_loop under trace: every loop_vars leaf must be a "
                 f"Tensor (got {type(v).__name__}) — a Python scalar would "
                 "compile to a constant, not a carried value")
+    # FRESH carry cells: an initial loop var may be identity-aliased with
+    # a tensor the body ALSO reads through its closure (`s = x` before the
+    # loop, then `s + x` inside it). Carry substitution swaps the shared
+    # cell's payload, silently turning the closure read into the carry
+    # (`s + x` became `s + s`, measured r5). With fresh cells the aliased
+    # closure read is discovered as a normal capture and keeps its own
+    # value — matching the eager regime, where the cell is never mutated.
+    flat_lv = [Tensor(t._value, stop_gradient=t.stop_gradient)
+               for t in flat_lv]
+    loop_vars = jax.tree_util.tree_unflatten(lv_tree, flat_lv)
     lv_tensors = list(flat_lv)
     _, cap_c = _discover(cond, args=loop_vars, exclude=lv_tensors)
     body_out, cap_b = _discover(body, args=loop_vars, exclude=lv_tensors)

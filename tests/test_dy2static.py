@@ -377,3 +377,303 @@ def test_for_float_tensor_bound_raises_like_cpython():
     f = jit.to_static(_float_tensor_range)
     with pytest.raises(TypeError):
         f(t(np.float32(0.0)), paddle.to_tensor(np.float32(2.5)))
+
+
+# ---------------------------------------------------- break/continue/return
+# (VERDICT r4 missing #2 — reference break_continue_transformer.py:88,
+#  return_transformer.py)
+
+def _while_break(x, limit):
+    s = x
+    i = paddle.to_tensor(np.int64(0))
+    while i < limit:
+        s = s + x
+        i = i + 1
+        if s.sum() > 10.0:
+            break
+    return s
+
+
+def test_while_break_compiles_and_matches_eager():
+    ref = []
+    for lim in (100, 3):
+        r = _while_break(t(np.array([1.0], np.float32)), t(np.int64(lim)))
+        ref.append(float(np.asarray(r.numpy())))
+    assert ref == [11.0, 4.0]  # sanity: breaks at 11, or runs out at 4
+
+    sf = jit.StaticFunction(ast_transform(_while_break), warmup=False)
+    for lim, want in ((100, 11.0), (3, 4.0)):
+        got = float(np.asarray(
+            sf(t(np.array([1.0], np.float32)), t(np.int64(lim))).numpy()))
+        assert got == want, (lim, got)
+    assert len(sf._cache) == 1  # break point is DATA, not a retrace
+
+
+def _for_break(x, n):
+    s = x
+    for i in range(n):
+        s = s + 1.0
+        if s.sum() > 5.0:
+            break
+    return s
+
+
+def test_for_range_tensor_bound_break_compiles():
+    sf = jit.StaticFunction(ast_transform(_for_break), warmup=False)
+    for n, want in ((100, 6.0), (2, 2.0)):
+        got = float(np.asarray(
+            sf(t(np.array([0.0], np.float32)), t(np.int64(n))).numpy()))
+        assert got == want, (n, got)
+    assert len(sf._cache) == 1
+
+
+def test_for_range_concrete_bound_break_matches_cpython():
+    g = ast_transform(_for_break)
+    # concrete bound + concrete break predicate: unrolled, exact semantics
+    got = float(np.asarray(
+        g(t(np.array([0.0], np.float32)), 100).numpy()))
+    assert got == 6.0, got
+
+
+def test_concrete_bound_traced_break_still_correct():
+    # a traced break predicate cannot STOP an unrolled concrete-bound
+    # loop early, but the whole-body guard keeps it CORRECT: post-break
+    # iterations compile to no-op conds (early exit is an optimization,
+    # correctness never depends on it)
+    sf = jit.StaticFunction(ast_transform(_for_break), warmup=False)
+    got = float(np.asarray(
+        sf(t(np.array([0.0], np.float32)), 20).numpy()))
+    assert got == 6.0, got
+
+
+def _for_continue(x, n):
+    s = x
+    for i in range(n):
+        if i % 2 == 0:
+            continue
+        s = s + i
+    return s
+
+
+def test_for_continue_compiles_and_matches_eager():
+    want = float(sum(k for k in range(7) if k % 2))  # 1+3+5 = 9
+    g = ast_transform(_for_continue)
+    got_e = float(np.asarray(
+        g(t(np.array([0.0], np.float32)), 7).numpy()))
+    assert got_e == want, got_e
+    sf = jit.StaticFunction(ast_transform(_for_continue), warmup=False)
+    got_c = float(np.asarray(
+        sf(t(np.array([0.0], np.float32)), t(np.int64(7))).numpy()))
+    assert got_c == want, got_c
+
+
+def _while_continue(x, n):
+    s = x
+    i = paddle.to_tensor(np.int64(0))
+    while i < n:
+        i = i + 1
+        if i % 2 == 0:
+            continue
+        s = s + 1.0
+    return s
+
+
+def test_while_continue_compiles():
+    sf = jit.StaticFunction(ast_transform(_while_continue), warmup=False)
+    got = float(np.asarray(
+        sf(t(np.array([0.0], np.float32)), t(np.int64(6))).numpy()))
+    assert got == 3.0, got  # odd i only: 1, 3, 5
+
+
+def _nested_break(x, n):
+    s = x
+    for i in range(n):
+        for j in range(3):
+            s = s + 1.0
+            if j >= 1:
+                break  # binds the INNER loop only
+    return s
+
+
+def test_nested_loops_break_binds_inner():
+    g = ast_transform(_nested_break)
+    got = float(np.asarray(
+        g(t(np.array([0.0], np.float32)), 4).numpy()))
+    assert got == 8.0, got  # 2 per outer iteration
+    sf = jit.StaticFunction(ast_transform(_nested_break), warmup=False)
+    got_c = float(np.asarray(
+        sf(t(np.array([0.0], np.float32)), t(np.int64(4))).numpy()))
+    assert got_c == 8.0, got_c
+
+
+def _stmt_after_break_if(x, n):
+    s = x
+    for i in range(n):
+        if s.sum() > 2.0:
+            break
+        s = s + 1.0   # must be skipped once the flag is up
+        s = s * 1.0
+    return s
+
+
+def test_statements_after_break_are_guarded():
+    sf = jit.StaticFunction(ast_transform(_stmt_after_break_if),
+                            warmup=False)
+    got = float(np.asarray(
+        sf(t(np.array([0.0], np.float32)), t(np.int64(50))).numpy()))
+    assert got == 3.0, got
+
+
+def _loop_return(n):
+    acc = 0
+    for i in range(n):
+        acc = acc + i
+        if acc > 5:
+            return acc * 10
+    return acc
+
+
+def test_return_in_loop_eager_exact():
+    g = ast_transform(_loop_return)
+    assert int(g(2)) == 1       # no return path: 0+1
+    assert int(g(5)) == 60      # 0+1+2+3=6 > 5 -> 60
+    assert int(_loop_return(2)) == 1 and int(_loop_return(5)) == 60
+
+
+def _partial_return(x):
+    if x.sum() > 0:
+        return x * 10.0
+    y = x + 1.0
+    return y * 2.0
+
+
+def test_partial_early_return_compiles_one_program():
+    sf = jit.StaticFunction(ast_transform(_partial_return), warmup=False)
+    np.testing.assert_allclose(
+        np.asarray(sf(t(np.array([2.0], np.float32))).numpy()), [20.0])
+    np.testing.assert_allclose(
+        np.asarray(sf(t(np.array([-1.0], np.float32))).numpy()), [0.0])
+    assert len(sf._cache) == 1
+
+
+def _nested_partial_return(x):
+    if x.sum() > 0:
+        if x.sum() > 10:
+            return x * 100.0
+        x = x + 1.0
+    return x * 2.0
+
+
+def test_nested_partial_return_compiles():
+    sf = jit.StaticFunction(ast_transform(_nested_partial_return),
+                            warmup=False)
+    cases = [([20.0], [2000.0]), ([2.0], [6.0]), ([-3.0], [-6.0])]
+    for inp, want in cases:
+        np.testing.assert_allclose(
+            np.asarray(sf(t(np.array(inp, np.float32))).numpy()), want)
+    assert len(sf._cache) == 1
+
+
+def _ret_none(flag):
+    if flag:
+        return 5
+
+
+def test_return_none_fallthrough_concrete():
+    g = ast_transform(_ret_none)
+    assert g(True) == 5
+    assert g(False) is None
+
+
+def _return_in_compiled_loop(x, n):
+    s = x
+    for i in range(n):
+        s = s + 1.0
+        if s.sum() > 3.0:
+            return s * 5.0
+    return s
+
+
+def test_return_in_compiled_loop_is_loud_not_silent():
+    # eager regime: exact semantics
+    g = ast_transform(_return_in_compiled_loop)
+    got = float(np.asarray(
+        g(t(np.array([0.0], np.float32)), 10).numpy()))
+    assert got == 20.0, got
+    # compiled regime: the return value cannot ride the carry without a
+    # pre-seeded structure — must raise loudly, never return garbage
+    sf = jit.StaticFunction(ast_transform(_return_in_compiled_loop),
+                            warmup=False)
+    with pytest.raises(Exception):
+        sf(t(np.array([0.0], np.float32)), t(np.int64(10)))
+
+
+def _target_after_break(x, n):
+    i = -1
+    for i in range(n):
+        x = x + 1.0
+        if x.sum() > 3.0:
+            break
+    return i
+
+
+def test_loop_target_frozen_after_break():
+    """The loop target must hold the BREAK iteration's value — in the
+    unrolled-traced regime broken-out iterations must not keep advancing
+    it (r5 review repro: returned n-1 instead of CPython's value)."""
+    assert int(_target_after_break(t(np.array([0.0], np.float32)), 20)) == 3
+    g = ast_transform(_target_after_break)
+    got = g(t(np.array([0.0], np.float32)), 20)
+    assert int(np.asarray(got.numpy() if hasattr(got, "numpy") else got)) == 3
+    sf = jit.StaticFunction(ast_transform(_target_after_break), warmup=False)
+    got_c = sf(t(np.array([0.0], np.float32)), 20)
+    assert int(np.asarray(got_c.numpy()
+                          if hasattr(got_c, "numpy") else got_c)) == 3
+    # compiled (tensor bound) too
+    got_t = sf(t(np.array([0.0], np.float32)), t(np.int64(20)))
+    assert int(np.asarray(got_t.numpy()
+                          if hasattr(got_t, "numpy") else got_t)) == 3
+
+
+def _while_index_break(arr):
+    i = 0
+    while arr[i] > 0:
+        i = i + 1
+        if i >= len(arr):
+            break
+    return i
+
+
+def test_while_test_not_reevaluated_after_break():
+    """CPython never re-evaluates the while test after a break; the
+    converted loop must short-circuit the flag first or arr[len(arr)]
+    raises IndexError (r5 review repro)."""
+    arr = [1.0, 2.0, 3.0]
+    assert _while_index_break(arr) == 3
+    g = ast_transform(_while_index_break)
+    got = g(arr)
+    assert int(np.asarray(got.numpy() if hasattr(got, "numpy") else got)) == 3
+
+
+def _outer_break_inner_plain_loop(x, n):
+    for i in range(n):
+        x = x + 1.0
+        if x.sum() > 3.0:
+            break
+        for item in [1, 2]:     # non-range loop: stays plain Python
+            if item > 1:
+                break
+            x = x + item
+    return x
+
+
+def test_outer_break_with_nested_plain_loop_stays_plain():
+    """Pass B must not half-rewrite a loop the main pass will refuse
+    (nested non-convertible loop keeps a literal break) — r5 review
+    repro: NameError on an undefined header name."""
+    g = ast_transform(_outer_break_inner_plain_loop)
+    got = float(np.asarray(
+        g(t(np.array([0.0], np.float32)), 10).numpy()))
+    want = float(np.asarray(_outer_break_inner_plain_loop(
+        t(np.array([0.0], np.float32)), 10).numpy()))
+    assert got == want == 5.0, (got, want)

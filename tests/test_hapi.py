@@ -144,3 +144,47 @@ def test_auc_against_rank_reference():
     auc.update(np.stack([1 - pos_prob, pos_prob], 1), labels)
     ref = _ref_auc(pos_prob, labels)
     assert auc.accumulate() == pytest.approx(ref, abs=5e-3)
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    """reference hapi/callbacks.py:883 VisualDL — train/<metric> per step,
+    eval/<metric> per epoch; native JSONL sink when visualdl is absent."""
+    import json
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    model = _model()
+    train, val = ToyClassification(32, 0), ToyClassification(16, 1)
+    log_dir = str(tmp_path / "vdl")
+    model.fit(train, val, batch_size=16, epochs=2, verbose=0,
+              callbacks=[VisualDL(log_dir)])
+    path = os.path.join(log_dir, "scalars.jsonl")
+    assert os.path.exists(path)
+    rows = [json.loads(l) for l in open(path)]
+    tags = {r["tag"] for r in rows}
+    assert any(t.startswith("train/") for t in tags), tags
+    assert any(t.startswith("eval/") for t in tags), tags
+    train_rows = [r for r in rows if r["tag"] == "train/loss"]
+    assert len(train_rows) >= 4  # 2 epochs x 2 steps
+    assert all(isinstance(r["value"], float) for r in rows)
+    steps = [r["step"] for r in train_rows]
+    assert steps == sorted(steps)
+
+
+def test_wandb_callback_offline_fallback(tmp_path):
+    """reference hapi/callbacks.py:999 WandbCallback — without the wandb
+    package, scalars land in an offline run dir with the config."""
+    import json
+
+    from paddle_tpu.hapi.callbacks import WandbCallback
+
+    model = _model()
+    train = ToyClassification(32, 0)
+    cb = WandbCallback(project="p", name="r1", dir=str(tmp_path / "wb"))
+    model.fit(train, batch_size=16, epochs=1, verbose=0, callbacks=[cb])
+    run_dir = tmp_path / "wb" / "wandb-offline" / "r1"
+    assert os.path.exists(run_dir / "scalars.jsonl")
+    cfg = json.load(open(run_dir / "config.json"))
+    assert cfg["project"] == "p"
+    rows = [json.loads(l) for l in open(run_dir / "scalars.jsonl")]
+    assert rows and all(r["tag"].startswith("train/") for r in rows)

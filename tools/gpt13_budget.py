@@ -32,50 +32,20 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 V5E_HBM_GB = 16.0
 GB = 1024 ** 3
 
 
 def _reexec_scrubbed() -> None:
-    if os.environ.get("_GPT13_BUDGET_CHILD") == "1":
-        return
-    env = dict(os.environ)
-    env["_GPT13_BUDGET_CHILD"] = "1"
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env.pop("PJRT_LIBRARY_PATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = re.sub(
-        r"--xla_force_host_platform_device_count=\d+", "",
-        env.get("XLA_FLAGS", "")).strip()
-    os.execve(sys.executable, [sys.executable, "-u"] + sys.argv, env)
+    from _budget_common import reexec_scrubbed
+    reexec_scrubbed("_GPT13_BUDGET_CHILD")
 
 
 def _zero_init_parameters() -> None:
-    """Zero-init create_parameter (same rationale as llama7b_budget:
-    values never matter — nothing executes)."""
-    import jax.numpy as jnp
-
-    from paddle_tpu import dtypes
-    from paddle_tpu.nn.layer_base import Layer
-    from paddle_tpu.nn.param_attr import ParamAttr
-    from paddle_tpu.tensor import Parameter
-
-    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
-                         default_initializer=None):
-        a = ParamAttr._to_attr(attr)
-        if a is False:
-            return None
-        dt = dtypes.convert_dtype(dtype) or self._dtype
-        p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dt),
-                      trainable=not (a is not None and not a.trainable),
-                      name=(a.name if a is not None and a.name else None))
-        if a is not None:
-            p.optimize_attr["learning_rate"] = a.learning_rate
-            p.regularizer = a.regularizer
-        return p
-
-    Layer.create_parameter = create_parameter
+    from _budget_common import zero_init_parameters
+    zero_init_parameters()
 
 
 def measure(combo: dict, smoke: bool) -> dict:

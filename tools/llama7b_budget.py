@@ -31,54 +31,19 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 V5E_HBM_GB = 16.0
 
 
 def _reexec_scrubbed(n_devices: int) -> None:
-    """Re-exec into a CPU-only env (axon plugin gated off) — same pattern
-    as __graft_entry__.dryrun_multichip."""
-    if os.environ.get("_LLAMA7B_BUDGET_CHILD") == "1":
-        return
-    env = dict(os.environ)
-    env["_LLAMA7B_BUDGET_CHILD"] = "1"
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env.pop("PJRT_LIBRARY_PATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", ""))
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n_devices}"
-    ).strip()
-    os.execve(sys.executable, [sys.executable, "-u"] + sys.argv, env)
+    from _budget_common import reexec_scrubbed
+    reexec_scrubbed("_LLAMA7B_BUDGET_CHILD", n_devices)
 
 
 def _zero_init_parameters() -> None:
-    """Patch Layer.create_parameter to zero-init: 7B fp32 params are 27 GB
-    of host zeros (fine) but 7B RNG normals on one core are minutes of
-    wasted compute. Values are irrelevant — nothing executes."""
-    import jax.numpy as jnp
-
-    from paddle_tpu import dtypes
-    from paddle_tpu.nn.layer_base import Layer
-    from paddle_tpu.nn.param_attr import ParamAttr
-    from paddle_tpu.tensor import Parameter
-
-    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
-                         default_initializer=None):
-        a = ParamAttr._to_attr(attr)
-        if a is False:
-            return None
-        dt = dtypes.convert_dtype(dtype) or self._dtype
-        p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dt),
-                      trainable=not (a is not None and not a.trainable),
-                      name=(a.name if a is not None and a.name else None))
-        if a is not None:
-            p.optimize_attr["learning_rate"] = a.learning_rate
-            p.regularizer = a.regularizer
-        return p
-
-    Layer.create_parameter = create_parameter
+    from _budget_common import zero_init_parameters
+    zero_init_parameters()
 
 
 def _analytic_rows(n_params: int, n_layers: int, hidden: int, batch: int,

@@ -47,4 +47,8 @@ BENCH_BATCH=64 timeout 900 python bench.py --model bert
 echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
 BENCH_BATCH=8 BENCH_RECOMPUTE=1 timeout 2400 python bench.py --model llama
 
+echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
+timeout 2400 python bench.py --model yoloe
+timeout 1200 python bench.py --model ocr
+
 echo "done — see BENCH_NOTES_r05.json"
