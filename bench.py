@@ -545,7 +545,6 @@ def bench_yoloe(dev, small):
     from paddle_tpu import amp, jit
     from paddle_tpu.vision.models import ppyoloe_s
 
-    on_tpu = dev.platform in ("tpu", "axon")
     if small:
         sizes, B, M_max, reps = [64, 96], 2, 8, 2
     else:
@@ -619,7 +618,6 @@ def bench_ocr(dev, small):
     from paddle_tpu import amp, jit
     from paddle_tpu.vision.models import CRNN
 
-    on_tpu = dev.platform in ("tpu", "axon")
     if small:
         widths, B, L_max, reps = [64, 96], 4, 8, 2
     else:
@@ -903,6 +901,18 @@ def _run_bonus_battery():
     must not burn hours of job budget or bank CPU rows as TPU evidence)."""
     here = os.path.dirname(os.path.abspath(__file__))
     jobs = [
+        # the r4 quarantine answer comes before any other bonus evidence
+        # (VERDICT r5 #1) — but after the ladder banked the headline: the
+        # driver's stdout is the official artifact and must not be risked
+        ("llama-bisect", [sys.executable,
+                          os.path.join(here, "tools",
+                                       "bisect_llama_tpu.py")], 1800, {}),
+        # full gpt13 ladder (BENCH_LADDER=1 overrides _launch_banked's
+        # recursion guard; BENCH_BONUS=0 stops the child re-entering this
+        # battery); budget covers 4 rungs x 1800s
+        ("gpt13-north-star", [sys.executable, os.path.abspath(__file__),
+                              "--model", "gpt13"], 7500,
+         {"BENCH_LADDER": "1", "BENCH_BONUS": "0"}),
         # rc=1: plain B8 llama OOMs (10.6G optimizer state + no-remat
         # activations, measured r4); full remat + fused-CE fits with room
         ("llama-0.76b", [sys.executable, os.path.abspath(__file__),

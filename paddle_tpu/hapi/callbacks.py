@@ -428,7 +428,10 @@ class WandbCallback(_TelemetryBase):
     def _write_scalar(self, tag, value, step):
         if self._wandb is not None:
             if self.run is not None:
-                self.run.log({tag: value}, step=step)
+                # no step= kwarg (reference does the same): eval scalars
+                # use epoch-steps which are NOT monotonic vs train steps,
+                # and wandb silently drops non-monotonic steps
+                self.run.log({tag: value})
         else:
             self._ensure_writer().add_scalar(tag, value, step)
 

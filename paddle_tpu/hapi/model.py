@@ -204,17 +204,33 @@ class Model:
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 2, num_workers: int = 0, callbacks=None,
                  num_samples: Optional[int] = None, _inner_callbacks=False):
-        """reference: model.py evaluate — returns {metric_name: value}."""
+        """reference: model.py evaluate — returns {metric_name: value}.
+        Standalone user callbacks are honored with the reference's
+        on_eval_begin/on_eval_batch_*/on_eval_end bracket (fit() drives
+        its own callback list and passes _inner_callbacks=True)."""
+        cbks = None
+        if callbacks is not None and not _inner_callbacks:
+            from .callbacks import CallbackList
+            cbks = CallbackList(callbacks if isinstance(callbacks, list)
+                                else [callbacks])
+            cbks.set_model(self)
+            cbks.on_eval_begin({
+                "steps": None,
+                "metrics": ["loss"] + [m.name() for m in self._metrics]})
         loader = self._make_loader(eval_data, batch_size, False, num_workers)
         for m in self._metrics:
             m.reset()
         losses = []
         for step, batch in enumerate(loader):
+            if cbks is not None:
+                cbks.on_eval_batch_begin(step)
             x, y = (batch[0], batch[1]) if isinstance(
                 batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
             r = self.eval_batch(x, y)
             if r and self._loss is not None:
                 losses.append(r[0])
+            if cbks is not None:
+                cbks.on_eval_batch_end(step)
         logs = {}
         if losses:
             logs["loss"] = float(np.mean(losses))
@@ -223,6 +239,8 @@ class Model:
             logs[names[0]] = m.accumulate()
         if verbose:
             print(" - ".join(f"{k}: {v}" for k, v in logs.items()), flush=True)
+        if cbks is not None:
+            cbks.on_eval_end(logs)
         return logs
 
     def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,

@@ -1,11 +1,15 @@
 """PP-YOLOE + PP-OCR model families (vision/models/detection.py, ocr.py):
 forward shapes, trainable losses, host-side postprocess (VERDICT r2 model-zoo
 gap — BASELINE.md config 5)."""
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.vision.models import (CRNN, DBNet, PPYOLOE, crnn_ctc,
                                       db_loss, db_mobilenet_v3, ppyoloe_s)
+
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
 
 rng = np.random.RandomState(0)
 

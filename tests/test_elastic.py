@@ -8,6 +8,8 @@ import pytest
 from paddle_tpu.distributed.fleet.elastic import (
     ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, FileStore)
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 def test_exit_code_contract():
     assert ELASTIC_EXIT_CODE == 101

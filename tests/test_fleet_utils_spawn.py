@@ -10,6 +10,8 @@ from paddle_tpu.distributed.fleet.utils import (
     LocalFS,
 )
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 def test_local_fs_roundtrip(tmp_path):
     fs = LocalFS()

@@ -188,3 +188,20 @@ def test_wandb_callback_offline_fallback(tmp_path):
     assert cfg["project"] == "p"
     rows = [json.loads(l) for l in open(run_dir / "scalars.jsonl")]
     assert rows and all(r["tag"].startswith("train/") for r in rows)
+
+
+def test_standalone_evaluate_drives_callbacks(tmp_path):
+    """model.evaluate(callbacks=[...]) must bracket with on_eval_begin/
+    on_eval_end (r5 review: the eval-only telemetry path was dead)."""
+    import json
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    model = _model()
+    val = ToyClassification(16, 1)
+    log_dir = str(tmp_path / "vdl_eval")
+    model.evaluate(val, batch_size=8, verbose=0,
+                   callbacks=[VisualDL(log_dir)])
+    rows = [json.loads(l)
+            for l in open(os.path.join(log_dir, "scalars.jsonl"))]
+    assert rows and all(r["tag"].startswith("eval/") for r in rows), rows

@@ -17,6 +17,8 @@ transformers = pytest.importorskip("transformers")
 
 import paddle_tpu as paddle  # noqa: E402
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 def _logits_close(ours, theirs, rtol=2e-4, atol=2e-4):
     ours = np.asarray(ours, dtype=np.float32)

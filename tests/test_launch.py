@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = """
@@ -138,6 +140,7 @@ def test_ps_mode_servers_and_trainers(tmp_path):
 
 RPC_SCRIPT = """
 import json, os, sys
+
 sys.path.insert(0, {repo!r})
 info = dict(rank=int(os.environ["PADDLE_TRAINER_ID"]),
             world=int(os.environ["PADDLE_TRAINERS_NUM"]),

@@ -18,6 +18,8 @@ from paddle_tpu.incubate.distributed.models.moe import (
 from paddle_tpu.nn.layer.container import LayerList
 from paddle_tpu.tensor import Tensor
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 D_MODEL, D_HIDDEN, E = 8, 16, 4
 
 

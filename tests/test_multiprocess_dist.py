@@ -17,6 +17,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 _CHILD = r'''
 import json, os, sys
 import numpy as np

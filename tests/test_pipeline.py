@@ -12,6 +12,8 @@ from paddle_tpu import jit
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.fleet.pipeline_schedule import StackedPipelineBlocks
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def _reset_mesh():

@@ -10,6 +10,8 @@ import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.distributed import fleet, ring_attention
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 @pytest.fixture(autouse=True)
 def _reset_mesh():

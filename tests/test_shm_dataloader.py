@@ -4,6 +4,8 @@ import pytest
 
 from paddle_tpu.io.shm_channel import ShmChannel
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 def test_shm_channel_object_round_trip():
     ch = ShmChannel(capacity_bytes=1 << 20)

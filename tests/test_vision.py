@@ -6,6 +6,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 from paddle_tpu.vision import datasets, models, ops, transforms as T
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow'
+
 
 def _img(n=1, c=3, h=64, w=64, seed=0):
     return paddle.to_tensor(

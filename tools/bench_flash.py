@@ -58,6 +58,9 @@ def main():
     # less than run-to-run noise at reps=3)
     only_s = (int(argv[argv.index("--s") + 1]) if "--s" in argv else None)
     reps = (int(argv[argv.index("--reps") + 1]) if "--reps" in argv else 3)
+    # --d 128: the gpt13/llama head geometry (16 heads x 128) — block
+    # timings at D=64 don't transfer (VMEM tile footprint doubles)
+    only_d = (int(argv[argv.index("--d") + 1]) if "--d" in argv else None)
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
     _log(f"device: {dev.platform} (tpu={on_tpu})")
@@ -69,6 +72,8 @@ def main():
         sys.exit(2)
 
     H, D = 16, 64  # flagship head geometry (GPT-355M: 16 heads x 64)
+    if only_d is not None:
+        D = only_d
     seqs = [1024] if quick else [512, 1024, 2048, 4096]
     if only_s is not None:
         seqs = [only_s]
@@ -128,7 +133,7 @@ def main():
         _log(f"S={S} B={B} xla          fwd {t_fwd*1e3:7.2f}ms  "
              f"fwd+bwd {t_bwd*1e3:7.2f}ms")
         if on_tpu:
-            _persist({"metric": "flash_ab", "impl": "xla", "S": S, "B": B,
+            _persist({"metric": "flash_ab", "impl": "xla", "S": S, "B": B, "H": H, "D": D,
                       "fwd_ms": round(t_fwd * 1e3, 2),
                       "fwdbwd_ms": round(t_bwd * 1e3, 2),
                       "device": dev.platform})
@@ -157,7 +162,7 @@ def main():
                  f"  fwd+bwd {t_bwd*1e3:7.2f}ms")
             if on_tpu:
                 _persist({"metric": "flash_ab", "impl": "pallas", "S": S,
-                          "B": B, "bq": bq, "bk": bk,
+                          "B": B, "H": H, "D": D, "bq": bq, "bk": bk,
                           "fwd_ms": round(t_fwd * 1e3, 2),
                           "fwdbwd_ms": round(t_bwd * 1e3, 2),
                           "device": dev.platform})
@@ -187,7 +192,7 @@ def main():
     threshold = wins[0] if wins else None
     _log(f"recommended pallas_flash_min_seq = {threshold}")
     if on_tpu:
-        _persist({"metric": "flash_ab_summary", "per_seq": rec,
+        _persist({"metric": "flash_ab_summary", "per_seq": rec, "D": D,
                   "recommended_min_seq": threshold, "device": dev.platform})
     print(json.dumps({"metric": "flash_ab_summary", "per_seq": rec,
                       "recommended_min_seq": threshold}))

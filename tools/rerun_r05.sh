@@ -35,6 +35,9 @@ timeout 900 python tools/bench_adamw.py
 echo "=== 6. flash S=1024 block tie-break (reps=9) ==="
 timeout 1200 python tools/bench_flash.py --s 1024 --reps 9
 
+echo "=== 6b. flash D=128 block sweep (gpt13/llama head geometry) ==="
+timeout 1200 python tools/bench_flash.py --d 128 --s 1024 --reps 5
+
 echo "=== 7. bert re-measure with chained clock ==="
 timeout 900 python bench.py --model bert
 
