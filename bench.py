@@ -921,6 +921,10 @@ def _run_bonus_battery():
         ("flash-sweep", [sys.executable,
                          os.path.join(here, "tools", "bench_flash.py")],
          3600, {}),
+        ("flash-d128", [sys.executable,
+                        os.path.join(here, "tools", "bench_flash.py"),
+                        "--d", "128", "--s", "1024", "--reps", "5"],
+         1200, {}),
         ("adamw-ab", [sys.executable,
                       os.path.join(here, "tools", "bench_adamw.py")], 1200,
          {}),
