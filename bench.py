@@ -849,6 +849,11 @@ _LADDERS = {
                          "BENCH_RC_POLICY": "dots"}),
         ("b16-dots-fce", {"BENCH_BATCH": "16", "BENCH_RECOMPUTE": "1",
                           "BENCH_RC_POLICY": "dots"}),
+        # insurance: D=128 raises the kernel's per-block VMEM footprint
+        # vs the D=64 headline config — if the (1024,1024) default trips
+        # Mosaic, this rung still lands a gpt13 number on smaller blocks
+        ("b8-fce-bq512", {"BENCH_BATCH": "8", "PADDLE_TPU_FLASH_BQ": "512",
+                          "PADDLE_TPU_FLASH_BK": "512"}),
     ],
 }
 
@@ -909,9 +914,9 @@ def _run_bonus_battery():
                                        "bisect_llama_tpu.py")], 1800, {}),
         # full gpt13 ladder (BENCH_LADDER=1 overrides _launch_banked's
         # recursion guard; BENCH_BONUS=0 stops the child re-entering this
-        # battery); budget covers 4 rungs x 1800s
+        # battery); budget covers 5 rungs x 1800s
         ("gpt13-north-star", [sys.executable, os.path.abspath(__file__),
-                              "--model", "gpt13"], 7500,
+                              "--model", "gpt13"], 9300,
          {"BENCH_LADDER": "1", "BENCH_BONUS": "0"}),
         # rc=1: plain B8 llama OOMs (10.6G optimizer state + no-remat
         # activations, measured r4); full remat + fused-CE fits with room

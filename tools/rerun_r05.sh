@@ -24,7 +24,7 @@ echo "=== 2. headline GPT ladder (official artifact evidence) ==="
 BENCH_BONUS=0 timeout 5700 python bench.py --model gpt
 
 echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
-BENCH_BONUS=0 timeout 7500 python bench.py --model gpt13
+BENCH_BONUS=0 timeout 9500 python bench.py --model gpt13
 
 echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
 BENCH_SMALL=0 timeout 900 python bench.py --model resnet50
