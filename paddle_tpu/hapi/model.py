@@ -201,6 +201,14 @@ class Model:
                     break
         cbks.on_train_end(logs if steps else None)
 
+    def _wrap_callbacks(self, callbacks):
+        """Standalone-callback wrapping shared by evaluate/predict."""
+        from .callbacks import CallbackList
+        cbks = CallbackList(callbacks if isinstance(callbacks, (list, tuple))
+                            else [callbacks])
+        cbks.set_model(self)
+        return cbks
+
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 2, num_workers: int = 0, callbacks=None,
                  num_samples: Optional[int] = None, _inner_callbacks=False):
@@ -210,10 +218,7 @@ class Model:
         its own callback list and passes _inner_callbacks=True)."""
         cbks = None
         if callbacks is not None and not _inner_callbacks:
-            from .callbacks import CallbackList
-            cbks = CallbackList(callbacks if isinstance(callbacks, list)
-                                else [callbacks])
-            cbks.set_model(self)
+            cbks = self._wrap_callbacks(callbacks)
             cbks.on_eval_begin({
                 "steps": None,
                 "metrics": ["loss"] + [m.name() for m in self._metrics]})
@@ -246,18 +251,29 @@ class Model:
     def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
                 stack_outputs: bool = False, verbose: int = 1, callbacks=None):
         """reference: model.py predict — list of per-batch outputs (or
-        stacked arrays)."""
+        stacked arrays). User callbacks get the reference's
+        on_predict_begin/batch/end bracket."""
+        cbks = None
+        if callbacks is not None:
+            cbks = self._wrap_callbacks(callbacks)
+            cbks.on_predict_begin()
         loader = self._make_loader(test_data, batch_size, False, num_workers)
         outs = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            if cbks is not None:
+                cbks.on_predict_batch_begin(step)
             x = batch[0] if isinstance(batch, (list, tuple)) else batch
             o = self.predict_batch(x)
             o = o if isinstance(o, (list, tuple)) else [o]
             outs.append([np.asarray(t.numpy()) for t in o])
+            if cbks is not None:
+                cbks.on_predict_batch_end(step)
         n_out = len(outs[0]) if outs else 0
         grouped = [[b[i] for b in outs] for i in range(n_out)]
         if stack_outputs:
             grouped = [np.concatenate(g, axis=0) for g in grouped]
+        if cbks is not None:
+            cbks.on_predict_end()
         return grouped
 
     # ------------------------------------------------------------ persist

@@ -24,6 +24,13 @@ non-tensor control flow, so it is safe to apply to every to_static target.
 ``for <name> in range(...)`` is ALSO converted (→ convert_for_range): a
 tensor bound compiles to one lax.while_loop; concrete bounds dispatch to
 the plain Python loop at runtime (the old unroll behavior, bit-identical).
+``for <name> in <expr>`` over anything else (→ convert_for_iter):
+a TENSOR iterates its first axis (reference loop_transformer semantics;
+static shapes make the trip count static), every other iterable keeps
+the plain Python iteration protocol at runtime. Converted-loop caveat
+(applies to every rewritten loop here and in the reference's own
+function-lifting transform): closures over the loop variable capture a
+fresh per-iteration cell, not CPython's shared cell.
 
 ``break``/``continue``/``return`` ARE converted (reference:
 break_continue_transformer.py:88, return_transformer.py) by two pre-passes:
@@ -38,10 +45,11 @@ breaks compile to one lax.while_loop.
 
 Deliberately NOT converted (left as plain Python, same behavior as before
 the pass): escapes under ``try``/``with``-with-return, generators,
-loop-``else`` clauses, ``for`` over non-range iterables or with tuple
-targets, ``return`` inside a COMPILED loop whose value structure cannot
-merge (loud error at trace time; eager regime is exact), and anything
-whose source is unavailable (lambdas, REPL) — the transform then no-ops.
+loop-``else`` clauses, ``for`` with tuple targets, ``break``/``continue``
+in non-range ``for`` loops, ``return`` inside a COMPILED loop whose value
+structure cannot merge (loud error at trace time; eager regime is exact),
+and anything whose source is unavailable (lambdas, REPL) — the transform
+then no-ops.
 """
 from __future__ import annotations
 
@@ -370,6 +378,31 @@ def _concrete_scalar_bool(x):
             and getattr(x._value, "size", 0) == 1):
         return bool(x._value)
     return None
+
+
+def convert_for_iter(iterable, body_fn, vals: Sequence):
+    """Runtime dispatch for a rewritten ``for <name> in <expr>`` where
+    the iterable is NOT a range call (reference: loop_transformer's
+    tensor-iteration support). A Tensor iterates its first axis — shapes
+    are static under XLA, so the trip count is static and the loop
+    unrolls with ``it[i]`` slices (traced slices inside jit, eager
+    slices outside — both exact paddle semantics). Anything else runs
+    the plain-Python iteration protocol (generators consumed once, dict
+    keys, StopIteration — untouched). One documented divergence shared
+    by EVERY converted loop (the reference's function-lifting rewrite
+    has it too): the body runs in a fresh frame per iteration, so
+    closures over the loop variable capture per-iteration cells, not
+    CPython's single shared cell."""
+    vals = list(vals)
+    if _is_tensor(iterable):
+        if not len(iterable.shape):
+            raise TypeError("iteration over a 0-d Tensor")
+        for i in range(int(iterable.shape[0])):
+            vals = list(body_fn(iterable[i], *vals))
+        return tuple(vals)
+    for h in iterable:
+        vals = list(body_fn(h, *vals))
+    return tuple(vals)
 
 
 def convert_logical_and(x, y_fn):
@@ -744,7 +777,13 @@ class _BreakContinueRewriter(ast.NodeTransformer):
 
     @staticmethod
     def _for_is_convertible(node) -> bool:
-        """Mirror of visit_For's shape gate (minus the escape check)."""
+        """INTENTIONALLY range-only — narrower than visit_For, which
+        also converts non-range iterables. Break-lifting needs a loop
+        condition to fold the flag into; a non-range for has none
+        (convert_for_iter has no brk_index), so marking one here would
+        produce exactly the half-rewritten NameError _rewrite_loop's
+        all-or-nothing gate guards against. Do not 'sync' this with
+        visit_For's wider gate."""
         return (not node.orelse
                 and isinstance(node.target, ast.Name)
                 and isinstance(node.iter, ast.Call)
@@ -983,13 +1022,37 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=_name(hdr)))
         self.generic_visit(node)
         if (node.orelse or _has_flow_escape(node.body)
-                or not isinstance(node.target, ast.Name)
-                or not (isinstance(node.iter, ast.Call)
-                        and isinstance(node.iter.func, ast.Name)
-                        and node.iter.func.id == "range")
-                or node.iter.keywords
-                or any(isinstance(a, ast.Starred) for a in node.iter.args)):
+                or not isinstance(node.target, ast.Name)):
             return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.iter.args)):
+            # non-range iterable → convert_for_iter: a TENSOR iterates
+            # its first axis (static trip count under XLA); plain
+            # iterables keep the exact Python protocol at runtime
+            tgt = node.target.id
+            loop_vars = list(dict.fromkeys(
+                _assigned_names(node.body) + [tgt]))
+            self.changed = True
+            bname = self._next("foriter")
+            ihdr = self._next("hdr")
+            stmts = self._locals_snapshot(loop_vars)
+            body = [ast.Assign(targets=[_name(tgt, ast.Store())],
+                               value=_name(ihdr))] + list(node.body)
+            stmts.append(self._make_fn(bname, [ihdr] + loop_vars, body,
+                                       loop_vars))
+            stmts.append(ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in loop_vars],
+                                   ctx=ast.Store())],
+                value=_jst_call("convert_for_iter", [
+                    node.iter, _name(bname),
+                    ast.Tuple(elts=[_name(n) for n in loop_vars],
+                              ctx=ast.Load())])))
+            return stmts
         tgt = node.target.id
         loop_vars = list(dict.fromkeys(_assigned_names(node.body) + [tgt]))
         self.changed = True

@@ -677,3 +677,69 @@ def test_outer_break_with_nested_plain_loop_stays_plain():
     want = float(np.asarray(_outer_break_inner_plain_loop(
         t(np.array([0.0], np.float32)), 10).numpy()))
     assert got == want == 5.0, (got, want)
+
+
+# ------------------------------------------------------- tensor iteration
+
+def _iter_tensor_rows(m):
+    s = paddle.to_tensor(np.float32(0.0))
+    for row in m:                  # Tensor: iterate axis 0
+        s = s + row.sum()
+    return s
+
+
+def test_for_over_tensor_iterates_rows():
+    """reference loop_transformer: `for x in tensor` slices axis 0 —
+    eager and under jit (static shapes → static trip count)."""
+    m_np = np.arange(6, dtype=np.float32).reshape(3, 2)
+    g = ast_transform(_iter_tensor_rows)
+    got = float(np.asarray(g(t(m_np)).numpy()))
+    assert got == 15.0, got
+    sf = jit.StaticFunction(ast_transform(_iter_tensor_rows), warmup=False)
+    got_c = float(np.asarray(sf(t(m_np)).numpy()))
+    assert got_c == 15.0, got_c
+
+
+def _iter_plain_things(xs, d):
+    s = 0.0
+    for k in d:                    # dict: keys, exact python
+        s = s + d[k]
+    for v in xs:                   # list
+        s = s + v
+    for g in (i * 2 for i in range(3)):   # generator
+        s = s + g
+    return s
+
+
+def test_for_over_plain_iterables_exact():
+    g = ast_transform(_iter_plain_things)
+    want = _iter_plain_things([1.0, 2.0], {"a": 10.0, "b": 20.0})
+    got = g([1.0, 2.0], {"a": 10.0, "b": 20.0})
+    assert got == want == 39.0, (got, want)
+
+
+def _iter_params_like(ws, x):
+    out = x
+    for w in ws:                   # list of tensors (parameters pattern)
+        out = out * w
+    return out
+
+
+def test_for_over_tensor_list():
+    ws = [t(np.float32(2.0)), t(np.float32(3.0))]
+    g = ast_transform(_iter_params_like)
+    got = float(np.asarray(g(ws, t(np.float32(1.0))).numpy()))
+    assert got == 6.0, got
+
+
+def _iter_scalar(s0):
+    acc = paddle.to_tensor(np.float32(0.0))
+    for v in s0:               # 0-d tensor: must raise like paddle
+        acc = acc + v
+    return acc
+
+
+def test_for_over_0d_tensor_raises():
+    g = ast_transform(_iter_scalar)
+    with pytest.raises(TypeError, match="0-d"):
+        g(t(np.float32(3.0)))

@@ -205,3 +205,28 @@ def test_standalone_evaluate_drives_callbacks(tmp_path):
     rows = [json.loads(l)
             for l in open(os.path.join(log_dir, "scalars.jsonl"))]
     assert rows and all(r["tag"].startswith("eval/") for r in rows), rows
+
+
+def test_predict_drives_callbacks():
+    """predict(callbacks=[...]) brackets with on_predict_begin/batch/end
+    (same class as the evaluate gap — the argument was accepted and
+    ignored)."""
+    from paddle_tpu.hapi.callbacks import Callback
+
+    calls = []
+
+    class Spy(Callback):
+        def on_predict_begin(self, logs=None):
+            calls.append("begin")
+
+        def on_predict_batch_end(self, step, logs=None):
+            calls.append(("batch", step))
+
+        def on_predict_end(self, logs=None):
+            calls.append("end")
+
+    model = _model()
+    val = ToyClassification(16, 1)
+    model.predict(val, batch_size=8, verbose=0, callbacks=[Spy()])
+    assert calls[0] == "begin" and calls[-1] == "end"
+    assert ("batch", 0) in calls and ("batch", 1) in calls
