@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Digest BENCH_NOTES_r05.json into a human-readable summary: latest row
+per metric, llama-bisect verdicts, flash A/B recommendations. The battery
+runs it last so rerun_r05.log ends with the round's evidence at a glance.
+"""
+import json
+import os
+import sys
+
+NOTES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_NOTES_r05.json")
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else NOTES
+    if not os.path.exists(path):
+        print(f"no notes file at {path}")
+        return 1
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"  (skipping malformed line: {line[:60]}...)")
+    if not rows:
+        print("notes file is empty")
+        return 1
+
+    print(f"=== digest of {os.path.basename(path)} ({len(rows)} rows) ===")
+
+    # latest row per headline metric (TPU rows preferred)
+    latest = {}
+    for r in rows:
+        m = r.get("metric")
+        if m and m not in ("llama_bisect", "flash_ab", "flash_ab_summary"):
+            latest[m] = r  # file is append-ordered: last wins
+    for m in sorted(latest):
+        r = latest[m]
+        dev = r.get("device", "?")
+        flag = " [CPU-FALLBACK]" if r.get("cpu_fallback") else ""
+        mfu = r.get("mfu_vs_v5e_peak")
+        mfu_s = f"  mfu={mfu:.2%}" if isinstance(mfu, (int, float)) else ""
+        print(f"  {m}: {r.get('value')} {r.get('unit', '')} "
+              f"({r.get('config', r.get('combo', ''))}, {dev}){mfu_s}{flag}")
+
+    bisect = [r for r in rows if r.get("metric") == "llama_bisect"]
+    if bisect:
+        print(f"\n  llama_bisect: {len(bisect)} rows")
+        for r in bisect:
+            if r.get("probe") == "kernel_causality":
+                print(f"    kernel D={r.get('D')}: err={r.get('err')} "
+                      f"leak={r.get('leak')} "
+                      f"{'OK' if r.get('ok') else 'FAIL'}")
+            else:
+                print(f"    traj[{r.get('tag')}]: first={r.get('first')} "
+                      f"last={r.get('last')}")
+    else:
+        print("\n  llama_bisect: NO ROWS (quarantine unresolved)")
+
+    summaries = [r for r in rows if r.get("metric") == "flash_ab_summary"]
+    for r in summaries:
+        print(f"\n  flash_ab_summary (D={r.get('D', 64)}): "
+              f"min_seq={r.get('recommended_min_seq')} "
+              f"per_seq={json.dumps(r.get('per_seq', {}))[:200]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,4 +54,7 @@ echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
 timeout 2400 python bench.py --model yoloe
 timeout 1200 python bench.py --model ocr
 
+echo "=== 12. digest ==="
+python tools/notes_digest.py
+
 echo "done — see BENCH_NOTES_r05.json"
