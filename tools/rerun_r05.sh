@@ -4,8 +4,12 @@
 #   1. llama bisect (the quarantine is the #1 open item)
 #   2. headline GPT ladder (banks the official TPU artifact evidence)
 #   3. gpt13 — the 1.3B north-star config (>=40% MFU target)
-#   4+ BASELINE.md cleanup re-measures + decode row
+#   4+ BASELINE.md cleanup re-measures + decode row + vision configs
 # Each step runs under its own timeout; a hang kills only that step.
+# Between steps a killable probe checks the tunnel is still healthy —
+# a mid-battery re-wedge (the r4 failure mode) must abort the battery
+# (not burn hours of sequential step timeouts) and re-arm the watcher
+# so the remaining steps ride the next healthy window.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # everything also lands in a line-buffered log — pipe buffers lose
@@ -13,43 +17,75 @@ cd "$(dirname "$0")/.."
 exec > >(stdbuf -oL tee -a rerun_r05.log) 2>&1
 echo "=== r5 battery start $(date -u +%H:%M:%S) ==="
 
+probe() {
+  timeout 140 python - <<'EOF'
+import subprocess, sys
+r = subprocess.run(
+    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
+     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
+    capture_output=True, text=True, timeout=120)
+sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
+EOF
+}
+
+gate() {
+  if ! probe; then
+    echo "[battery] tunnel unhealthy before: $1 ($(date -u +%H:%M:%S)) — "
+    echo "[battery] aborting battery, re-arming watcher for the next window"
+    nohup bash tools/tunnel_watch.sh 60 420 > tunnel_watch.log 2>&1 &
+    python tools/notes_digest.py || true
+    exit 3
+  fi
+}
+
 echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
 timeout 1800 python tools/bisect_llama_tpu.py
 echo "bisect rc=$?"
 
+gate "2. gpt ladder"
 # ladder outer timeouts: worst case = rungs x 1800s inner budget + probe
 # slack (the outer kill must never beat the ladder's own per-rung kills,
 # or the combined best-line artifact is lost mid-ladder)
 echo "=== 2. headline GPT ladder (official artifact evidence) ==="
 BENCH_BONUS=0 timeout 5700 python bench.py --model gpt
 
+gate "3. gpt13"
 echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
 BENCH_BONUS=0 timeout 9500 python bench.py --model gpt13
 
+gate "4. resnet50"
 echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
 BENCH_SMALL=0 timeout 900 python bench.py --model resnet50
 
+gate "5. adamw"
 echo "=== 5. fused AdamW re-verdict at designed 256x1024 blocking ==="
 timeout 900 python tools/bench_adamw.py
 
+gate "6. flash tie-break"
 echo "=== 6. flash S=1024 block tie-break (reps=9) ==="
 timeout 1200 python tools/bench_flash.py --s 1024 --reps 9
 
+gate "6b. flash d128"
 echo "=== 6b. flash D=128 block sweep (gpt13/llama head geometry) ==="
 timeout 1200 python tools/bench_flash.py --d 128 --s 1024 --reps 5
 
+gate "7. bert"
 echo "=== 7. bert re-measure with chained clock ==="
 timeout 900 python bench.py --model bert
 
+gate "8. decode"
 echo "=== 8. decode throughput (device-side while_loop) ==="
 timeout 1800 python tools/bench_decode.py
 
+gate "9. bert B64"
 echo "=== 9. bert B64 batch probe ==="
 BENCH_BATCH=64 timeout 900 python bench.py --model bert
 
+gate "10. llama"
 echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
 BENCH_BATCH=8 BENCH_RECOMPUTE=1 timeout 2400 python bench.py --model llama
 
+gate "11. vision"
 echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
 timeout 2400 python bench.py --model yoloe
 timeout 1200 python bench.py --model ocr
