@@ -743,3 +743,120 @@ def test_for_over_0d_tensor_raises():
     g = ast_transform(_iter_scalar)
     with pytest.raises(TypeError, match="0-d"):
         g(t(np.float32(3.0)))
+
+
+# ----------------------------------------------- adversarial escape shapes
+
+def _break_and_continue(x, n):
+    s = x
+    i = paddle.to_tensor(np.int64(0))
+    while i < n:
+        i = i + 1
+        if i % 2 == 0:
+            continue
+        if s.sum() > 4.0:
+            break
+        s = s + 1.0
+    return s, i
+
+
+def test_break_and_continue_same_loop():
+    want = _break_and_continue(t(np.array([0.0], np.float32)),
+                               t(np.int64(100)))
+    want = (float(np.asarray(want[0].numpy())), int(want[1].numpy()))
+    sf = jit.StaticFunction(ast_transform(_break_and_continue),
+                            warmup=False)
+    s, i = sf(t(np.array([0.0], np.float32)), t(np.int64(100)))
+    got = (float(np.asarray(s.numpy())), int(i.numpy()))
+    assert got == want, (got, want)
+
+
+def _two_breaks_two_depths(x, n):
+    s = x
+    i = paddle.to_tensor(np.int64(0))
+    while i < n:
+        i = i + 1
+        if s.sum() > 50.0:
+            break
+        s = s + 1.0
+        if i > 5:
+            if s.sum() > 3.0:
+                break
+    return s
+
+
+def test_breaks_at_two_depths():
+    want = float(np.asarray(_two_breaks_two_depths(
+        t(np.array([0.0], np.float32)), t(np.int64(100))).numpy()))
+    sf = jit.StaticFunction(ast_transform(_two_breaks_two_depths),
+                            warmup=False)
+    got = float(np.asarray(sf(
+        t(np.array([0.0], np.float32)), t(np.int64(100))).numpy()))
+    assert got == want == 6.0, (got, want)
+
+
+def _sequential_break_loops(x, n):
+    s = x
+    for i in range(n):
+        s = s + 1.0
+        if s.sum() > 2.0:
+            break
+    for j in range(n):
+        s = s + 10.0
+        if s.sum() > 25.0:
+            break
+    return s
+
+
+def test_sequential_break_loops_distinct_flags():
+    want = float(np.asarray(_sequential_break_loops(
+        t(np.array([0.0], np.float32)), 100).numpy()))
+    sf = jit.StaticFunction(ast_transform(_sequential_break_loops),
+                            warmup=False)
+    got = float(np.asarray(sf(
+        t(np.array([0.0], np.float32)), t(np.int64(100))).numpy()))
+    assert got == want == 33.0, (got, want)
+
+
+def _nested_while_breaks(x, n):
+    s = x
+    i = paddle.to_tensor(np.int64(0))
+    while i < n:
+        i = i + 1
+        j = paddle.to_tensor(np.int64(0))
+        while j < n:
+            j = j + 1
+            s = s + 1.0
+            if s.sum() % 3.0 < 0.5:
+                break   # inner only
+        if s.sum() > 8.0:
+            break
+    return s, i
+
+
+def test_nested_while_breaks_bind_correct_loops():
+    a = _nested_while_breaks(t(np.array([0.0], np.float32)),
+                             t(np.int64(50)))
+    want = (float(np.asarray(a[0].numpy())), int(a[1].numpy()))
+    sf = jit.StaticFunction(ast_transform(_nested_while_breaks),
+                            warmup=False)
+    s, i = sf(t(np.array([0.0], np.float32)), t(np.int64(50)))
+    got = (float(np.asarray(s.numpy())), int(i.numpy()))
+    assert got == want, (got, want)
+
+
+def _return_in_else(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        return x * -1.0
+    return y + 1.0
+
+
+def test_return_in_else_branch_compiles():
+    sf = jit.StaticFunction(ast_transform(_return_in_else), warmup=False)
+    np.testing.assert_allclose(
+        np.asarray(sf(t(np.array([3.0], np.float32))).numpy()), [7.0])
+    np.testing.assert_allclose(
+        np.asarray(sf(t(np.array([-3.0], np.float32))).numpy()), [3.0])
+    assert len(sf._cache) == 1

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# THE tunnel health probe — single source of truth for watcher + battery.
+# Killable subprocess probe (never stacked; the wedge discipline): exit 0
+# iff jax sees a real accelerator within the budget.
+timeout 140 python - <<'EOF'
+import subprocess, sys
+r = subprocess.run(
+    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
+     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
+    capture_output=True, text=True, timeout=120)
+sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
+EOF

@@ -6,10 +6,12 @@
 #   3. gpt13 — the 1.3B north-star config (>=40% MFU target)
 #   4+ BASELINE.md cleanup re-measures + decode row + vision configs
 # Each step runs under its own timeout; a hang kills only that step.
-# Between steps a killable probe checks the tunnel is still healthy —
-# a mid-battery re-wedge (the r4 failure mode) must abort the battery
-# (not burn hours of sequential step timeouts) and re-arm the watcher
-# so the remaining steps ride the next healthy window.
+# Between steps a killable probe (tools/probe_tunnel.sh — shared with the
+# watcher) checks the tunnel is still healthy: a mid-battery re-wedge
+# (the r4 failure mode) aborts the battery instead of burning hours of
+# sequential step timeouts, re-arms the watcher, and — because every
+# completed step leaves a done-marker — the NEXT window resumes at the
+# first un-done step instead of replaying banked measurements.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # everything also lands in a line-buffered log — pipe buffers lose
@@ -17,78 +19,101 @@ cd "$(dirname "$0")/.."
 exec > >(stdbuf -oL tee -a rerun_r05.log) 2>&1
 echo "=== r5 battery start $(date -u +%H:%M:%S) ==="
 
-probe() {
-  timeout 140 python - <<'EOF'
-import subprocess, sys
-r = subprocess.run(
-    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
-     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
-    capture_output=True, text=True, timeout=120)
-sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
-EOF
-}
+DONE_DIR=.battery_done_r05
+mkdir -p "$DONE_DIR"
 
 gate() {
-  if ! probe; then
-    echo "[battery] tunnel unhealthy before: $1 ($(date -u +%H:%M:%S)) — "
-    echo "[battery] aborting battery, re-arming watcher for the next window"
-    nohup bash tools/tunnel_watch.sh 60 420 > tunnel_watch.log 2>&1 &
+  if ! bash tools/probe_tunnel.sh; then
+    echo "[battery] tunnel unhealthy before: $1 ($(date -u +%H:%M:%S))"
+    echo "[battery] aborting; re-arming watcher for the next window"
+    if ! pgrep -f "tunnel_watch.sh" > /dev/null; then
+      nohup bash tools/tunnel_watch.sh 60 420 >> tunnel_watch.log 2>&1 &
+    else
+      echo "[battery] a watcher is already running — not stacking another"
+    fi
     python tools/notes_digest.py || true
     exit 3
   fi
 }
 
+# run_step <marker> <timeout_s> <cmd...>: skip when already banked this
+# round; mark done on success (rc==0) so a resumed battery starts at the
+# first un-done step.
+run_step() {
+  local marker=$1 budget=$2
+  shift 2
+  if [ -e "$DONE_DIR/$marker" ]; then
+    echo "[battery] $marker already done — skipping"
+    return 0
+  fi
+  timeout "$budget" "$@"
+  local rc=$?
+  echo "[battery] $marker rc=$rc"
+  if [ "$rc" -eq 0 ]; then
+    touch "$DONE_DIR/$marker"
+  fi
+  return 0
+}
+
+gate "1. bisect"
 echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
-timeout 1800 python tools/bisect_llama_tpu.py
-echo "bisect rc=$?"
+# done = verdict rows exist, whatever the exit code (exit 1 means a probe
+# FAILED its assertion — that IS a completed bisect with an answer)
+if grep -q llama_bisect BENCH_NOTES_r05.json 2>/dev/null; then
+  echo "[battery] bisect rows already present — skipping"
+else
+  timeout 1800 python tools/bisect_llama_tpu.py
+  echo "bisect rc=$?"
+  grep -q llama_bisect BENCH_NOTES_r05.json 2>/dev/null \
+    && touch "$DONE_DIR/01-bisect"
+fi
 
 gate "2. gpt ladder"
-# ladder outer timeouts: worst case = rungs x 1800s inner budget + probe
-# slack (the outer kill must never beat the ladder's own per-rung kills,
-# or the combined best-line artifact is lost mid-ladder)
 echo "=== 2. headline GPT ladder (official artifact evidence) ==="
-BENCH_BONUS=0 timeout 5700 python bench.py --model gpt
+# ladder outer timeouts: worst case = rungs x 1800s inner budget + probe
+# slack (the outer kill must never beat the ladder's own per-rung kills)
+BENCH_BONUS=0 run_step 02-gpt-ladder 5700 python bench.py --model gpt
 
 gate "3. gpt13"
 echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
-BENCH_BONUS=0 timeout 9500 python bench.py --model gpt13
+BENCH_BONUS=0 run_step 03-gpt13 9500 python bench.py --model gpt13
 
 gate "4. resnet50"
 echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
-BENCH_SMALL=0 timeout 900 python bench.py --model resnet50
+BENCH_SMALL=0 run_step 04-resnet50 900 python bench.py --model resnet50
 
 gate "5. adamw"
 echo "=== 5. fused AdamW re-verdict at designed 256x1024 blocking ==="
-timeout 900 python tools/bench_adamw.py
+run_step 05-adamw 900 python tools/bench_adamw.py
 
 gate "6. flash tie-break"
 echo "=== 6. flash S=1024 block tie-break (reps=9) ==="
-timeout 1200 python tools/bench_flash.py --s 1024 --reps 9
+run_step 06-flash-tiebreak 1200 python tools/bench_flash.py --s 1024 --reps 9
 
 gate "6b. flash d128"
 echo "=== 6b. flash D=128 block sweep (gpt13/llama head geometry) ==="
-timeout 1200 python tools/bench_flash.py --d 128 --s 1024 --reps 5
+run_step 06b-flash-d128 1200 python tools/bench_flash.py --d 128 --s 1024 --reps 5
 
 gate "7. bert"
 echo "=== 7. bert re-measure with chained clock ==="
-timeout 900 python bench.py --model bert
+run_step 07-bert 900 python bench.py --model bert
 
 gate "8. decode"
 echo "=== 8. decode throughput (device-side while_loop) ==="
-timeout 1800 python tools/bench_decode.py
+run_step 08-decode 1800 python tools/bench_decode.py
 
 gate "9. bert B64"
 echo "=== 9. bert B64 batch probe ==="
-BENCH_BATCH=64 timeout 900 python bench.py --model bert
+BENCH_BATCH=64 run_step 09-bert-b64 900 python bench.py --model bert
 
 gate "10. llama"
 echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
-BENCH_BATCH=8 BENCH_RECOMPUTE=1 timeout 2400 python bench.py --model llama
+BENCH_BATCH=8 BENCH_RECOMPUTE=1 run_step 10-llama 2400 python bench.py --model llama
 
 gate "11. vision"
 echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
-timeout 2400 python bench.py --model yoloe
-timeout 1200 python bench.py --model ocr
+run_step 11-yoloe 2400 python bench.py --model yoloe
+run_step 11-ocr 1200 python bench.py --model ocr
 
 echo "=== 12. digest ==="
 python tools/notes_digest.py

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Probe the tunnel on a spaced cadence (killable subprocess probes, never
-# stacked — the wedge discipline) and run the r4 rerun battery the moment
-# a probe succeeds. One-shot: exits after the battery (or max probes).
+# stacked — the wedge discipline) and run the r5 battery the moment a
+# probe succeeds. Battery exit 3 means "tunnel re-wedged mid-battery"
+# (tools/rerun_r05.sh gate): resume probing — completed steps left
+# done-markers, so the next window resumes where it stopped.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -9,21 +11,19 @@ MAX_PROBES=${1:-40}
 SLEEP_S=${2:-420}
 
 for n in $(seq 1 "$MAX_PROBES"); do
-  if timeout 140 python - <<'EOF'
-import subprocess, sys
-r = subprocess.run(
-    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
-     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
-    capture_output=True, text=True, timeout=120)
-sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
-EOF
-  then
+  if bash tools/probe_tunnel.sh; then
     echo "[watch] probe $n OK — running battery $(date -u +%H:%M:%S)"
-    if bash tools/rerun_r04.sh 2>&1 | tail -80; then
+    rc=0
+    bash tools/rerun_r05.sh || rc=$?
+    if [ "$rc" -eq 0 ]; then
       echo "[watch] battery done $(date -u +%H:%M:%S)"
       exit 0
+    elif [ "$rc" -eq 3 ]; then
+      echo "[watch] battery hit a re-wedge (rc=3) — resuming probes"
+      sleep "$SLEEP_S"
+      continue
     fi
-    echo "[watch] battery FAILED $(date -u +%H:%M:%S)"
+    echo "[watch] battery FAILED rc=$rc $(date -u +%H:%M:%S)"
     exit 2
   fi
   echo "[watch] probe $n wedged $(date -u +%H:%M:%S); sleeping ${SLEEP_S}s"
