@@ -936,6 +936,10 @@ def _run_bonus_battery():
         ("decode", [sys.executable,
                     os.path.join(here, "tools", "bench_decode.py")], 1800,
          {}),
+        ("yoloe", [sys.executable, os.path.abspath(__file__),
+                   "--model", "yoloe"], 2400, {}),
+        ("ocr", [sys.executable, os.path.abspath(__file__),
+                 "--model", "ocr"], 1200, {}),
     ]
     for desc, cmd, budget, extra in jobs:
         if not _probe_backend_subprocess(150.0, require_tpu=True):
