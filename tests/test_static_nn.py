@@ -402,3 +402,30 @@ def test_while_loop_passthrough_carry_slot():
         s, i = f(paddle.to_tensor(np.int64(4)))
         assert float(np.asarray(s.numpy())) == 4.0
         assert int(np.asarray(i.numpy())) == 3
+
+
+def test_while_loop_carry_aliased_with_closure_capture():
+    """An initial carry value identity-aliased with a tensor the body
+    reads through its CLOSURE must keep its own value (r5: payload
+    substitution turned `s + x` into `s + s` — 1,2,4,8,16 doubling).
+    Compiled must match eager, where the cell is never mutated."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    def loop(x, n):
+        s = x            # s IS x (same Tensor object) at loop entry
+        i = paddle.to_tensor(np.int64(0))
+        while i < n:
+            s = s + x    # closure read of x must stay the INITIAL x
+            i = i + 1
+        return s
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    eager = float(np.asarray(
+        ast_transform(loop)(x, paddle.to_tensor(np.int64(4))).numpy()))
+    assert eager == 5.0, eager
+    sf = jit.StaticFunction(ast_transform(loop), warmup=False)
+    got = float(np.asarray(
+        sf(x, paddle.to_tensor(np.int64(4))).numpy()))
+    assert got == 5.0, got
