@@ -280,12 +280,14 @@ def bench_gpt13(dev, small):
     """GPT-3 1.3B (BASELINE.json north star: h2048 l24 heads16, the GPT-3
     paper's "XL" row — d_head 128) single-chip training step at S=1024.
 
-    Fit (GPT13_BUDGET.md): fp32 master weights alone put AdamW state at
+    Fit (GPT13_BUDGET.md): fp32 master weights put AdamW state at
     ~18.4 GiB > 16 GiB HBM, so this config runs amp O2 with
-    master_weight=False (paddle's own multi_precision default — bf16
-    params + fp32 m/v, ~13.2 GiB state) + fused chunked CE; recompute
-    policy and batch come from the ladder. Override with BENCH_MASTER=1
-    to run the (non-fitting) master-weights control."""
+    master_weight=False (paddle's own multi_precision default): the
+    accumulators are zeros_like(param), so bf16 params carry bf16 m/v —
+    6 B/param, ~7.3 GiB persistent state (measured: the AOT sweep's
+    argument_gb 7.34 = 3 bf16 param-sized buffers) + fused chunked CE;
+    recompute policy and batch come from the ladder. Override with
+    BENCH_MASTER=1 to run the (non-fitting) master-weights control."""
     import paddle_tpu as paddle
     from paddle_tpu import amp, jit
     from paddle_tpu.models import GPTConfig, GPTForCausalLM

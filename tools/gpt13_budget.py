@@ -11,8 +11,11 @@ and prints XLA's per-chip peak, worst-first-screened so the bench ladder
 (bench.py --model gpt13) ranks only configs that actually fit.
 
 Levers swept:
-  master  — amp O2 fp32 master weights on/off (off = paddle's
-            multi_precision default; bf16 params + fp32 m/v = 10 B/param)
+  master  — amp O2 fp32 master weights on/off. Off (paddle's
+            multi_precision default) the accumulators are zeros_like(param)
+            — bf16 params give bf16 m/v: 6 B/param, ~7.3 GiB state at
+            1.3B (the sweep's measured argument_gb = 7.34 = 3 x 2.45
+            confirms all three are bf16)
   rc      — recompute off / 'dots' (save MXU outputs) / full
   fce     — fused chunked linear+CE (never materializes [B*S, 50304])
   B       — per-chip batch at S=1024
@@ -217,9 +220,11 @@ def main() -> int:
             "on one virtual device — same flow as LLAMA7B_BUDGET.md.",
             "",
             "`nomaster` = amp O2 with master_weight=False (paddle's "
-            "multi_precision default): bf16 params + fp32 m/v = 10 B/param "
-            "(~13.2 GiB state) vs 14 B/param (~18.4 GiB) with masters — "
-            "the master-weights control cannot fit one 16 GiB chip.",
+            "multi_precision default): accumulators are zeros_like(param), "
+            "so bf16 params give bf16 m+v = 6 B/param (~7.3 GiB state — "
+            "the measured argument_gb 7.34 = 3 x 2.45 GiB bf16 buffers) "
+            "vs ~18.4 GiB with fp32 masters+moments, which cannot fit "
+            "one 16 GiB chip.",
             "",
             "| combo | peak GiB | args GiB | temps GiB | fits 16 GiB |",
             "|---|---|---|---|---|",
