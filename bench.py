@@ -868,7 +868,13 @@ def _run_ladder(model: str) -> bool:
     Emits the best run's JSON line. Returns False if nothing succeeded."""
     ladder = _LADDERS[model]
     results = []
-    for desc, overrides in ladder:
+    for i, (desc, overrides) in enumerate(ladder):
+        if i > 0 and not _probe_backend_subprocess(150.0, require_tpu=True):
+            # tunnel died mid-ladder: bank what's measured instead of
+            # letting the next rung burn its whole budget hanging
+            _log(f"ladder[{desc}]: tunnel no longer healthy; "
+                 "banking completed rungs")
+            break
         res = _launch_banked(
             f"ladder[{desc}]",
             [sys.executable, os.path.abspath(__file__), "--model", model],
