@@ -523,7 +523,12 @@ class StaticFunction:
             new_state = [slot.get() for slot in slots]
             return out_arrays, new_state
 
-        holder.jitted = jax.jit(_functional, donate_argnums=(0,))
+        # State buffers are donated so XLA reuses them for the updated state
+        # (in-place optimizer semantics, reference: inplace op pass). CPU
+        # silently ignores donation, so a donation-induced wrongness would be
+        # TPU-only — PADDLE_TPU_NO_DONATE=1 disables it as a bisect axis.
+        donate = () if os.environ.get("PADDLE_TPU_NO_DONATE") == "1" else (0,)
+        holder.jitted = jax.jit(_functional, donate_argnums=donate)
         return holder
 
     # -- call ----------------------------------------------------------------
