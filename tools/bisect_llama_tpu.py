@@ -56,17 +56,28 @@ RANDOM_FLOOR = float(np.log(32000))  # ~10.37 nats
 # producing the target distribution (memorized or leaked), not exploring
 COLLAPSE_T = RANDOM_FLOOR - 3.4  # ~7.0
 
+# use_flash_attention=False alone is VACUOUS as a control: it routes to
+# F.scaled_dot_product_attention, which itself dispatches to the Pallas
+# flash kernel at S>=512 (nn/functional/attention.py:_use_pallas — the r4
+# "flash is the production default" change). Caught r5 when the noflash
+# trajectory matched flash to 4 decimals at every step. The env knob
+# raises the dispatch threshold above S so sdpa stays on the XLA path.
+_NO_FLASH_ENV = {"PADDLE_TPU_FLASH_MIN_SEQ": "99999"}
+
 PROBES = {
     # tag -> (flash, rc, fce, env, optimizer)
     "plain-flash": dict(flash=True, rc=False, fce=False),
-    "plain-noflash": dict(flash=False, rc=False, fce=False),
+    "plain-noflash": dict(flash=False, rc=False, fce=False,
+                          env=_NO_FLASH_ENV),
     "interp-flash": dict(flash=True, rc=False, fce=False,
                          env={"PADDLE_TPU_PALLAS_INTERPRET": "1"}),
     "fce-flash": dict(flash=True, rc=False, fce=True),
     "rc-fce-flash": dict(flash=True, rc=True, fce=True),
     "nodonate-noflash": dict(flash=False, rc=False, fce=False,
-                             env={"PADDLE_TPU_NO_DONATE": "1"}),
-    "fp32-noflash": dict(flash=False, rc=False, fce=False, amp=False),
+                             env={"PADDLE_TPU_NO_DONATE": "1",
+                                  **_NO_FLASH_ENV}),
+    "fp32-noflash": dict(flash=False, rc=False, fce=False, amp=False,
+                         env=_NO_FLASH_ENV),
     "sgd-flash": dict(flash=True, rc=False, fce=False, opt="sgd"),
 }
 
@@ -184,6 +195,12 @@ def llama_trajectory(tag, *, flash, rc, fce, amp_on=True, opt_name="adamw",
         loss_swap = float(np.asarray(
             _loss(ids, wrong).numpy(), dtype="float32"))
 
+    # routing ground truth, persisted so a stale row banked under the
+    # WRONG routing (r5: the pre-fix vacuous noflash control) can never
+    # satisfy _already_done for a tag that demands the other routing
+    no_flash_routing = (not flash) and int(
+        os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "512")) > 1024
+
     collapsed = losses[-1] < COLLAPSE_T
     # weight-wired memorization: fresh stays at the random floor and
     # arbitrary labels score WORSE than floor (model confidently predicts
@@ -198,14 +215,21 @@ def llama_trajectory(tag, *, flash, rc, fce, amp_on=True, opt_name="adamw",
               "loss_fresh_batch": round(loss_fresh, 4),
               "loss_swapped_labels": round(loss_swap, 4),
               "collapsed": collapsed, "input_leak": leak_fresh or leak_swap,
+              "no_flash_routing": no_flash_routing,
               "traj": [round(x, 3) for x in losses]})
     return {"tag": tag, "last": losses[-1], "fresh": loss_fresh,
             "swap": loss_swap, "collapsed": collapsed,
             "input_leak": leak_fresh or leak_swap}
 
 
+_consecutive_timeouts = 0
+
+
 def _run_child(tag, timeout_s=1500):
-    """One probe, one subprocess, one fresh chip claim."""
+    """One probe, one subprocess, one fresh chip claim. Tracks consecutive
+    timeouts so a wedged tunnel (every chip claim hangs) aborts the probe
+    sequence instead of burning timeout_s per remaining probe."""
+    global _consecutive_timeouts
     spec = PROBES[tag]
     env = dict(os.environ)
     env.update(spec.get("env", {}))
@@ -213,8 +237,10 @@ def _run_child(tag, timeout_s=1500):
     print(f"--- probe {tag} (subprocess) ---", flush=True)
     try:
         r = subprocess.run(cmd, env=env, timeout=timeout_s)
+        _consecutive_timeouts = 0
         return r.returncode == 0
     except subprocess.TimeoutExpired:
+        _consecutive_timeouts += 1
         print(f"llama[{tag}]: TIMEOUT {timeout_s}s", flush=True)
         _persist({"probe": "trajectory", "tag": tag, "error": "timeout"})
         return False
@@ -222,6 +248,10 @@ def _run_child(tag, timeout_s=1500):
 
 def _child_main(tag):
     spec = PROBES[tag]
+    # direct --probe invocation must behave like the parent's dispatch:
+    # the tag's distinguishing env (interpret mode, no-donate) applies
+    # here too, not only via subprocess env inheritance
+    os.environ.update(spec.get("env", {}))
     try:
         llama_trajectory(tag, flash=spec["flash"], rc=spec["rc"],
                          fce=spec["fce"], amp_on=spec.get("amp", True),
@@ -253,7 +283,15 @@ def _already_done(tag):
                         # this bisects a TPU-only anomaly: rows banked by a
                         # CPU-fallback run (donation ignored, Mosaic never
                         # lowered) must not satisfy a TPU verdict
-                        and rec.get("device") in ("tpu", "axon")):
+                        and rec.get("device") in ("tpu", "axon")
+                        # a *-noflash tag demands a row proven to have run
+                        # with flash dispatch OFF (and vice versa) — guards
+                        # against rows banked under wrong/vacuous routing.
+                        # Missing field (rows predating the check) defaults
+                        # False: flash rows stay valid, unproven noflash
+                        # rows are rejected and re-run.
+                        and rec.get("no_flash_routing", False)
+                        == ("noflash" in tag)):
                     found = rec
     except OSError:
         pass
@@ -313,21 +351,33 @@ def main():
                   flush=True)
             results[tag] = _norm(done)
             continue
+        if _consecutive_timeouts >= 2:
+            print(f"llama[{tag}]: SKIPPED — 2 consecutive probe timeouts "
+                  "(wedged-tunnel signature); aborting the sequence",
+                  flush=True)
+            continue
         results[tag] = _run_fresh(tag)
 
     # conditional discriminators: only needed if the collapse survives
     # with flash out of the loop (model-level branch)
+    def _run_conditional(tag):
+        done = None if args.force else _already_done(tag)
+        if done:
+            return _norm(done)
+        if _consecutive_timeouts >= 2:  # wedge abort covers these too
+            print(f"llama[{tag}]: SKIPPED — wedged-tunnel abort", flush=True)
+            return None
+        return _run_fresh(tag)
+
     nf = results.get("plain-noflash") or {}
     if nf.get("collapsed"):
         for tag in ["nodonate-noflash", "fp32-noflash"]:
-            done = None if args.force else _already_done(tag)
-            results[tag] = _norm(done) if done else _run_fresh(tag)
+            results[tag] = _run_conditional(tag)
     pf = results.get("plain-flash") or {}
     if pf.get("collapsed") and not pf.get("input_leak"):
         # collapse without input leakage = honest memorization speed; the
         # sgd probe quantifies how much of that speed is Adam
-        done = None if args.force else _already_done("sgd-flash")
-        results["sgd-flash"] = _norm(done) if done else _run_fresh("sgd-flash")
+        results["sgd-flash"] = _run_conditional("sgd-flash")
 
     # verdict: which branch of the ROUND5.md decision tree. A missing core
     # row (probe errored/timed out) means NO verdict — never un-quarantine

@@ -32,11 +32,17 @@ def main():
 
     print(f"=== digest of {os.path.basename(path)} ({len(rows)} rows) ===")
 
-    # latest row per headline metric (TPU rows preferred)
+    # latest row per headline metric; a TPU row is never displaced by a
+    # later CPU row (local smokes/fallbacks append after real evidence)
     latest = {}
     for r in rows:
         m = r.get("metric")
         if m and m not in ("llama_bisect", "flash_ab", "flash_ab_summary"):
+            prev = latest.get(m)
+            if (prev is not None
+                    and prev.get("device") in ("tpu", "axon")
+                    and r.get("device") not in ("tpu", "axon")):
+                continue
             latest[m] = r  # file is append-ordered: last wins
     for m in sorted(latest):
         r = latest[m]
@@ -49,15 +55,40 @@ def main():
 
     bisect = [r for r in rows if r.get("metric") == "llama_bisect"]
     if bisect:
+        # a partial row is only news when no full trajectory row for the
+        # same tag landed later (the partial is banked BEFORE the
+        # discriminator evals; the full row supersedes it)
+        full_tags = {r.get("tag") for r in bisect
+                     if r.get("probe") == "trajectory"}
         print(f"\n  llama_bisect: {len(bisect)} rows")
         for r in bisect:
-            if r.get("probe") == "kernel_causality":
-                print(f"    kernel D={r.get('D')}: err={r.get('err')} "
-                      f"leak={r.get('leak')} "
-                      f"{'OK' if r.get('ok') else 'FAIL'}")
+            probe = r.get("probe")
+            if (probe == "trajectory_partial"
+                    and r.get("tag") in full_tags):
+                continue
+            if probe == "kernel_causality":
+                if r.get("error"):
+                    print(f"    kernel: ERROR {r['error']}")
+                else:
+                    print(f"    kernel D={r.get('D')}: err={r.get('err')} "
+                          f"leak={r.get('leak')} "
+                          f"{'OK' if r.get('ok') else 'FAIL'}")
+            elif probe == "verdict":
+                status = "complete" if r.get("complete") else "INCOMPLETE"
+                print(f"    VERDICT ({status}): {r.get('branch')}")
+            elif probe == "trajectory_partial":
+                print(f"    traj-partial[{r.get('tag')}]: "
+                      f"first={r.get('first')} last={r.get('last')} "
+                      f"(discriminator evals did not land)")
+            elif r.get("error"):
+                print(f"    traj[{r.get('tag')}]: ERROR {r['error']}")
             else:
                 print(f"    traj[{r.get('tag')}]: first={r.get('first')} "
-                      f"last={r.get('last')}")
+                      f"last={r.get('last')} "
+                      f"fresh={r.get('loss_fresh_batch')} "
+                      f"swap={r.get('loss_swapped_labels')} "
+                      f"leak={r.get('input_leak')}")
+        # a full trajectory row supersedes its partial twin — note overlap
     else:
         print("\n  llama_bisect: NO ROWS (quarantine unresolved)")
 

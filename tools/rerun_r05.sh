@@ -57,15 +57,23 @@ run_step() {
 
 gate "1. bisect"
 echo "=== 1. llama anomaly bisect (answers the quarantine) ==="
-# done = verdict rows exist, whatever the exit code (exit 1 means a probe
-# FAILED its assertion — that IS a completed bisect with an answer)
-if grep -q llama_bisect BENCH_NOTES_r05.json 2>/dev/null; then
-  echo "[battery] bisect rows already present — skipping"
+# done = a COMPLETE verdict row exists — an INCOMPLETE verdict (some core
+# probe errored) must re-run next window; individual probe rows are NOT
+# done-ness either: r5's first window banked two kernel rows + one
+# trajectory before its controls OOM'd, and the old any-row grep would
+# have skipped the fixed bisect forever. Probes skip their own banked
+# rows, so a resumed bisect only pays for what's missing. Healthy-tunnel
+# cold run is ~35-40 min; the timeout covers the pathological case
+# (kernel 600s + 8 x 1500s probe timeouts = 12600s, though 2 consecutive
+# timeouts abort the sequence early).
+if grep -q '"probe": "verdict", .*"complete": true' BENCH_NOTES_r05.json \
+    2>/dev/null; then
+  echo "[battery] complete bisect verdict already banked — skipping"
 else
-  timeout 1800 python tools/bisect_llama_tpu.py
+  timeout 14400 python tools/bisect_llama_tpu.py
   echo "bisect rc=$?"
-  grep -q llama_bisect BENCH_NOTES_r05.json 2>/dev/null \
-    && touch "$DONE_DIR/01-bisect"
+  grep -q '"probe": "verdict", .*"complete": true' BENCH_NOTES_r05.json \
+    2>/dev/null && touch "$DONE_DIR/01-bisect"
 fi
 
 gate "2. gpt ladder"
