@@ -20,9 +20,12 @@ Layout: the caller flattens all params into ONE fp32 vector per state
 RETIRED from the hot path (r4, measured on v5e at 355M params with chained
 data-dependent timing): XLA 14.9ms (667 GB/s, ~81% of HBM peak) vs this
 kernel 42.9ms (232 GB/s). The update is purely memory-bound and XLA's
-fusion already streams it near roofline; the Pallas version's fixed
-[256, 1024] blocking pays extra HBM round-trips. Kept as reference code
-and for the A/B harness (tools/bench_adamw.py); optimizers use the XLA
+fusion already streams it near roofline. The r4 run was later found to
+have timed a crippled 16x1024 blocking (alignment bug in the harness),
+and the intended 256x1024 design point turns out not to compile on v5e
+at all (exceeds scoped VMEM, r5) — the honest A/B runs at the largest
+compilable blocking via ``block_rows`` (tools/bench_adamw.py sweeps it).
+Kept as reference code and for the A/B harness; optimizers use the XLA
 path.
 """
 from __future__ import annotations
@@ -44,7 +47,12 @@ except Exception:  # pragma: no cover
 __all__ = ["fused_adamw_flat"]
 
 LANE = 1024          # flat view: [rows, 1024] f32
-BLOCK_ROWS = 256     # 256x1024 f32 = 1MB per operand block in VMEM
+# 128x1024 f32 = 0.5MB per operand block: 4 block inputs + 3 block
+# outputs (the lr/bc scalars live in SMEM) double-buffered ~= 7MB,
+# inside v5e's 16MB scoped VMEM. The original 256-row design point never
+# compiled on real v5e — 16.79M > 16M scoped-vmem limit, measured r5 —
+# so 256 exists only as a sweep point on hardware with more headroom.
+BLOCK_ROWS = 128
 
 
 def _interpret() -> bool:
@@ -74,7 +82,8 @@ def _adamw_kernel(w_ref, m_ref, v_ref, g_ref, lr_ref, bc1_ref, bc2_ref,
     vo_ref[...] = v_new
 
 
-def fused_adamw_flat(w, m, v, g, lr, step, *, beta1=0.9, beta2=0.999,
+def fused_adamw_flat(w, m, v, g, lr, step, *, block_rows=None,
+                     beta1=0.9, beta2=0.999,
                      eps=1e-8, weight_decay=0.01):
     """One AdamW step over flat fp32 vectors. Returns (w', m', v').
 
@@ -88,7 +97,7 @@ def fused_adamw_flat(w, m, v, g, lr, step, *, beta1=0.9, beta2=0.999,
     rows = w.shape[0] // LANE
     shape2 = (rows, LANE)
     w2, m2, v2, g2 = (x.reshape(shape2) for x in (w, m, v, g))
-    br = min(BLOCK_ROWS, rows)
+    br = min(block_rows or BLOCK_ROWS, rows)
     while rows % br:
         br //= 2
     br = max(br, 1)
