@@ -63,6 +63,14 @@ def auto_cast(enable: bool = True, custom_white_list: Optional[Sequence] = None,
               dtype: str = "bfloat16", use_promote: bool = True):
     """reference: paddle.amp.auto_cast (amp/auto_cast.py:636).
 
+    Examples:
+        >>> layer = paddle.nn.Linear(4, 4)
+        >>> x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        >>> with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        ...     out = layer(x)
+        >>> str(out.dtype)
+        'bfloat16'
+
     O1: ops on the white list compute in ``dtype``; black list pinned fp32;
     everything else runs in its input dtype. O2: everything except the black
     list computes in ``dtype``.

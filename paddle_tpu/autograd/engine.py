@@ -512,6 +512,13 @@ def grad(
 ):
     """reference: paddle.grad (eager GeneralGrad, eager/general_grad.h).
 
+    Examples:
+        >>> x = paddle.to_tensor(2.0, stop_gradient=False)
+        >>> y = x * x
+        >>> (gx,) = paddle.grad(y, x)
+        >>> float(gx)
+        4.0
+
     ``create_graph=True`` returns grads that are themselves on the tape
     (differentiable — the double-grad path), re-deriving each op's VJP from
     its recorded forward; see ``_run_backward_create_graph``. Forward-mode /

@@ -51,7 +51,16 @@ def _from_saved(obj: Any) -> Any:
 
 
 def save(obj: Any, path: str, protocol: int = 4, **configs):
-    """reference: paddle.save (framework/io.py:646)."""
+    """reference: paddle.save (framework/io.py:646).
+
+    Examples:
+        >>> import tempfile, os
+        >>> layer = paddle.nn.Linear(2, 2)
+        >>> with tempfile.TemporaryDirectory() as d:
+        ...     path = os.path.join(d, "linear.pdparams")
+        ...     paddle.save(layer.state_dict(), path)
+        ...     layer.set_state_dict(paddle.load(path))
+    """
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -373,7 +373,19 @@ class _IterableDatasetIter:
 
 
 class DataLoader:
-    """reference: paddle.io.DataLoader (fluid/reader.py:311)."""
+    """reference: paddle.io.DataLoader (fluid/reader.py:311).
+
+    Examples:
+        >>> class Squares(paddle.io.Dataset):
+        ...     def __len__(self):
+        ...         return 8
+        ...     def __getitem__(self, i):
+        ...         return np.float32(i), np.float32(i * i)
+        >>> loader = paddle.io.DataLoader(Squares(), batch_size=4)
+        >>> xs, ys = next(iter(loader))
+        >>> xs.shape
+        [4]
+    """
 
     def __init__(
         self,

@@ -56,7 +56,16 @@ def not_to_static(function: Callable) -> Callable:
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
     """Compile an imperative function/Layer per input signature
-    (reference: paddle.jit.to_static, python/paddle/jit/api.py:232)."""
+    (reference: paddle.jit.to_static, python/paddle/jit/api.py:232).
+
+    Examples:
+        >>> @paddle.jit.to_static
+        ... def f(x):
+        ...     return x * 2 + 1
+        >>> out = f(paddle.to_tensor([1.0, 2.0]))
+        >>> [float(v) for v in out]
+        [3.0, 5.0]
+    """
 
     warmup = kwargs.pop("warmup", True)
 

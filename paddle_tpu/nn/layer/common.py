@@ -26,7 +26,14 @@ class Identity(Layer):
 
 
 class Linear(Layer):
-    """y = xW + b, weight [in, out] (reference: nn/layer/common.py Linear)."""
+    """y = xW + b, weight [in, out] (reference: nn/layer/common.py Linear).
+
+    Examples:
+        >>> layer = paddle.nn.Linear(4, 3)
+        >>> out = layer(paddle.to_tensor(np.ones((2, 4), "float32")))
+        >>> out.shape
+        [2, 3]
+    """
 
     def __init__(self, in_features: int, out_features: int,
                  weight_attr=None, bias_attr=None, name=None):

@@ -18,6 +18,16 @@ __all__ = [
 
 
 class CrossEntropyLoss(Layer):
+    """reference: paddle.nn.CrossEntropyLoss (nn/layer/loss.py).
+
+    Examples:
+        >>> loss_fn = paddle.nn.CrossEntropyLoss()
+        >>> logits = paddle.to_tensor(np.zeros((2, 5), "float32"))
+        >>> labels = paddle.to_tensor([1, 3])
+        >>> round(float(loss_fn(logits, labels)), 4)
+        1.6094
+    """
+
     def __init__(self, weight=None, ignore_index: int = -100, reduction: str = "mean",
                  soft_label: bool = False, axis: int = -1, use_softmax: bool = True,
                  label_smoothing: float = 0.0, name=None):

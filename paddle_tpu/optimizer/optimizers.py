@@ -91,7 +91,18 @@ class Adam(Optimizer):
 
 class AdamW(Adam):
     """reference: python/paddle/optimizer/adamw.py — decoupled weight decay
-    applied directly to the parameter, gated by apply_decay_param_fun."""
+    applied directly to the parameter, gated by apply_decay_param_fun.
+
+    Examples:
+        >>> model = paddle.nn.Linear(4, 2)
+        >>> opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+        ...                              parameters=model.parameters())
+        >>> x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        >>> loss = model(x).mean()
+        >>> loss.backward()
+        >>> opt.step()
+        >>> opt.clear_grad()
+    """
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,

@@ -198,6 +198,17 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._value.shape[0]
 
+    def __iter__(self):
+        """Iterate the first axis (reference: Tensor.__iter__ slicing
+        along axis 0). Without this, Python falls back to the legacy
+        __getitem__(0,1,2,...) protocol, which never terminates on a jax
+        backend — jax CLAMPS out-of-range integer indices instead of
+        raising IndexError (found r5: ``for v in tensor`` span forever)."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self._value.shape[0]):
+            yield self[i]
+
     def __bool__(self):
         return bool(self._value)
 
@@ -245,7 +256,16 @@ class Parameter(Tensor):
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
-    """reference: paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    """reference: paddle.to_tensor (python/paddle/tensor/creation.py).
+
+    Examples:
+        >>> x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        >>> x.shape
+        [2, 2]
+        >>> y = paddle.to_tensor(np.arange(4), dtype="float32")
+        >>> float(y.sum())
+        6.0
+    """
     del place  # device placement is managed by jax / shardings
     dtype = dtypes.convert_dtype(dtype)
     if isinstance(data, Tensor):

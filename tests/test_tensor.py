@@ -115,3 +115,18 @@ def test_detach_and_clone():
     assert d.stop_gradient is True
     c = x.clone()
     assert not c.stop_gradient
+
+
+def test_iteration_terminates():
+    """for v in tensor must iterate axis 0 and STOP (r5 regression: the
+    legacy __getitem__ iteration protocol never terminated — jax clamps
+    out-of-range indices instead of raising IndexError)."""
+    x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    rows = list(x)
+    assert len(rows) == 3
+    assert rows[1].shape == [2]
+    np.testing.assert_allclose(rows[2].numpy(), [5.0, 6.0])
+    vals = [float(v) for v in pt.to_tensor([7.0, 8.0])]
+    assert vals == [7.0, 8.0]
+    with pytest.raises(TypeError):
+        iter(pt.to_tensor(1.0)).__next__()
