@@ -903,6 +903,9 @@ _LADDERS = {
     # headline first even if the tunnel dies mid-ladder.
     "gpt13": [
         ("b4-fce", {"BENCH_BATCH": "4"}),
+        # b8->b4 gained +3.3 MFU pts (less HBM pressure); probe whether
+        # the trend continues or B2 under-fills the MXU
+        ("b2-fce", {"BENCH_BATCH": "2"}),
         ("b8-fce", {"BENCH_BATCH": "8"}),
         ("b8-dots-fce", {"BENCH_BATCH": "8", "BENCH_RECOMPUTE": "1",
                          "BENCH_RC_POLICY": "dots"}),

@@ -203,11 +203,19 @@ class Tensor:
         along axis 0). Without this, Python falls back to the legacy
         __getitem__(0,1,2,...) protocol, which never terminates on a jax
         backend — jax CLAMPS out-of-range integer indices instead of
-        raising IndexError (found r5: ``for v in tensor`` span forever)."""
+        raising IndexError (found r5: ``for v in tensor`` span forever).
+
+        The 0-d check runs EAGERLY (iter() raises, like numpy), not on
+        first next() — duck-typing callers probe iterability via iter().
+        """
         if self.ndim == 0:
             raise TypeError("iteration over a 0-d tensor")
-        for i in range(self._value.shape[0]):
-            yield self[i]
+
+        def _gen(n):
+            for i in range(n):
+                yield self[i]
+
+        return _gen(self._value.shape[0])
 
     def __bool__(self):
         return bool(self._value)

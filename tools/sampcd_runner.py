@@ -65,7 +65,15 @@ def iter_sample_blocks(prefixes=()):
                 if not doc or ">>>" not in doc:
                     continue
                 name = getattr(node, "name", "<module>")
-                examples = parser.get_examples(doc)
+                try:
+                    examples = parser.get_examples(doc)
+                except ValueError as e:
+                    # malformed sample (inconsistent indentation etc.):
+                    # report it as a failing block with attribution
+                    # instead of killing the whole discovery walk
+                    yield (f"{rel}:{name}",
+                           f"raise ValueError({str(e)[:120]!r})")
+                    continue
                 if not examples:
                     continue
                 block = "".join(e.source for e in examples)
