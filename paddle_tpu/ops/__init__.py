@@ -9,6 +9,7 @@ methods and operator overloads — the counterpart of the reference's
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..autograd.engine import apply_op
 from ..tensor import Tensor
@@ -42,7 +43,47 @@ def _prep_index(item):
     return item
 
 
+def _check_int_bounds(shape, item):
+    """Reference/numpy semantics: out-of-range CONCRETE int indices raise
+    IndexError. jax silently CLAMPS them — r5 found `for v in tensor`
+    never terminating because of exactly this; a user typo like x[5] on a
+    size-3 axis deserves the same loudness as numpy. Applies only to
+    plain Python ints (static shapes make the check valid under tracing);
+    slices keep Python clamping, and any array/Tensor index disables the
+    check for the whole subscript (advanced indexing keeps documented jax
+    gather semantics, incl. bool masks consuming several axes)."""
+    # NB: builtins `any`/`all`/`sum` are SHADOWED here by the paddle
+    # reduction ops (star-imports above) — this function avoids them
+    items = item if isinstance(item, tuple) else (item,)
+    for i in items:
+        if isinstance(i, (Tensor, np.ndarray, jnp.ndarray, list)):
+            return
+    # None (newaxis) and scalar bools (0-d masks, numpy semantics) ADD an
+    # axis and consume none — both are excluded from axis tracking
+    positional = [i for i in items
+                  if i is not None and not isinstance(i, (bool, np.bool_))]
+    ndim = len(shape)
+    remaining = 0
+    for i in positional:
+        if i is not Ellipsis:
+            remaining += 1
+    axis = 0
+    for i in positional:
+        if i is Ellipsis:
+            axis = ndim - remaining
+            continue
+        remaining -= 1
+        if (isinstance(i, int) and not isinstance(i, bool)
+                and 0 <= axis < ndim):
+            dim = shape[axis]
+            if not -dim <= i < dim:
+                raise IndexError(f"index {i} is out of bounds for axis "
+                                 f"{axis} with size {dim}")
+        axis += 1
+
+
 def getitem(x, item):
+    _check_int_bounds(x.shape, item)
     idx = _prep_index(item)
     return unary(lambda a: a[idx], x, name="getitem")
 
@@ -52,6 +93,7 @@ def setitem(x, item, value):
     Routes through the tape via inplace_rebind so autograd stays correct."""
     from ..autograd.engine import inplace_rebind
 
+    _check_int_bounds(x.shape, item)
     idx = _prep_index(item)
     if isinstance(value, Tensor):
         out = apply_op(lambda a, v: a.at[idx].set(v.astype(a.dtype)), [x, value], name="setitem")

@@ -130,3 +130,29 @@ def test_iteration_terminates():
     assert vals == [7.0, 8.0]
     with pytest.raises(TypeError):
         iter(pt.to_tensor(1.0)).__next__()
+
+
+def test_out_of_range_int_index_raises():
+    """Reference/numpy semantics: concrete out-of-range int indices raise
+    IndexError (jax would silently clamp — r5 hardening alongside the
+    __iter__ fix; slices keep Python clamping, array indices keep jax
+    gather semantics)."""
+    x = pt.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    for bad in (lambda: x[3], lambda: x[-4], lambda: x[0, 9],
+                lambda: x[..., 4], lambda: x[2, -5]):
+        with pytest.raises(IndexError):
+            bad()
+    # legal forms unchanged
+    assert float(x[-1, -1]) == 11.0
+    assert x[0:99].shape == [3, 4]
+    assert x[pt.to_tensor([0, 2])].shape == [2, 4]
+    y = x.clone()
+    with pytest.raises(IndexError):
+        y[3, 0] = 1.0
+
+
+def test_scalar_bool_index_adds_axis():
+    x = pt.to_tensor(np.zeros((5, 2), np.float32))
+    assert x[True, 3].shape == [1, 2]
+    with pytest.raises(IndexError):
+        x[True, 9]
