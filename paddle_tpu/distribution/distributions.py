@@ -58,7 +58,16 @@ class ExponentialFamily(Distribution):
 
 # --------------------------------------------------------------------- Normal
 class Normal(ExponentialFamily):
-    """reference: normal.py Normal(loc, scale)."""
+    """reference: normal.py Normal(loc, scale).
+
+    Examples:
+        >>> d = paddle.distribution.Normal(0.0, 1.0)
+        >>> s = d.sample([3])
+        >>> s.shape
+        [3]
+        >>> round(float(d.log_prob(paddle.to_tensor(0.0))), 4)
+        -0.9189
+    """
 
     def __init__(self, loc, scale, name=None):
         self.loc = _t(loc)

@@ -62,7 +62,16 @@ class Metric(metaclass=abc.ABCMeta):
 
 
 class Accuracy(Metric):
-    """reference: metrics.py:187 — top-k accuracy."""
+    """reference: metrics.py:187 — top-k accuracy.
+
+    Examples:
+        >>> m = paddle.metric.Accuracy()
+        >>> logits = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+        >>> labels = paddle.to_tensor([[1], [1]])
+        >>> m.update(m.compute(logits, labels))
+        >>> float(m.accumulate())
+        0.5
+    """
 
     def __init__(self, topk=(1,), name=None, *args, **kwargs):
         super().__init__()

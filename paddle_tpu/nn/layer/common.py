@@ -91,7 +91,14 @@ class AlphaDropout(Layer):
 
 class Embedding(Layer):
     """Lookup table, weight [num_embeddings, embedding_dim]
-    (reference: nn/layer/common.py Embedding)."""
+    (reference: nn/layer/common.py Embedding).
+
+    Examples:
+        >>> emb = paddle.nn.Embedding(10, 4)
+        >>> out = emb(paddle.to_tensor([[1, 2], [3, 4]]))
+        >>> out.shape
+        [2, 2, 4]
+    """
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  padding_idx: Optional[int] = None, sparse: bool = False,

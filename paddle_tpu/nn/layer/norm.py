@@ -141,6 +141,15 @@ class SyncBatchNorm(_BatchNormBase):
 
 
 class LayerNorm(Layer):
+    """reference: paddle.nn.LayerNorm (nn/layer/norm.py).
+
+    Examples:
+        >>> ln = paddle.nn.LayerNorm(4)
+        >>> out = ln(paddle.to_tensor(np.ones((2, 4), "float32")))
+        >>> out.shape
+        [2, 4]
+    """
+
     def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
                  bias_attr=None, name=None):
         super().__init__()

@@ -62,6 +62,15 @@ class ToTensor(BaseTransform):
 
 
 class Resize(BaseTransform):
+    """reference: paddle.vision.transforms.Resize.
+
+    Examples:
+        >>> t = paddle.vision.transforms.Resize((8, 8))
+        >>> img = np.zeros((16, 12, 3), "uint8")
+        >>> t(img).shape
+        (8, 8, 3)
+    """
+
     def __init__(self, size, interpolation="bilinear", keys=None):
         super().__init__(keys)
         self.size = size
