@@ -1,9 +1,11 @@
 """hapi — high-level Model API (reference: python/paddle/hapi/)."""
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL, WandbCallback)
 from .model import Model  # noqa: F401
 from .summary import summary  # noqa: F401
 
 __all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
-           "ModelCheckpoint", "EarlyStopping", "LRScheduler", "summary"]
+           "ModelCheckpoint", "EarlyStopping", "LRScheduler", "VisualDL",
+           "WandbCallback", "summary"]

@@ -68,7 +68,7 @@ class Accuracy(Metric):
         >>> m = paddle.metric.Accuracy()
         >>> logits = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
         >>> labels = paddle.to_tensor([[1], [1]])
-        >>> m.update(m.compute(logits, labels))
+        >>> _ = m.update(m.compute(logits, labels))
         >>> float(m.accumulate())
         0.5
     """

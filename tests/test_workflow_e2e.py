@@ -1,0 +1,102 @@
+"""One chained user workflow across subsystems — the path a real user of
+the reference walks end to end (reference composes these in its release
+notebooks: hapi fit -> checkpoint -> resume -> jit.save -> deploy via
+Predictor; no single reference test chains them either, which is exactly
+how cross-subsystem regressions hide).
+
+train (hapi fit + telemetry callback) -> evaluate -> save -> reload into
+a FRESH process-level model -> predict parity -> resume training
+improves -> jit.save the trained net -> create_predictor serves it with
+logits parity vs eager.
+"""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import jit
+from paddle_tpu.hapi import VisualDL
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class Blobs(Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.y = (rng.random(n) > 0.5).astype("int64")
+        self.x = (rng.standard_normal((n, 8)).astype("float32")
+                  + 3.0 * self.y[:, None].astype("float32"))
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def test_full_user_workflow(tmp_path):
+    pt.seed(0)
+    net = pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.ReLU(), pt.nn.Linear(16, 2))
+    model = pt.Model(net)
+    opt = pt.optimizer.Adam(learning_rate=0.05,
+                            parameters=net.parameters())
+    model.prepare(opt, pt.nn.CrossEntropyLoss(), Accuracy())
+
+    # 1. train with telemetry
+    vdl_dir = str(tmp_path / "vdl")
+    model.fit(Blobs(64, 0), Blobs(32, 1), batch_size=16, epochs=2,
+              verbose=0, callbacks=[VisualDL(log_dir=vdl_dir)])
+    logs = model.evaluate(Blobs(32, 1), batch_size=16, verbose=0)
+    assert logs["acc"] > 0.9
+
+    # telemetry actually wrote train scalars
+    scalar_files = [os.path.join(r, f)
+                    for r, _, fs in os.walk(vdl_dir) for f in fs]
+    assert scalar_files, "VisualDL callback wrote nothing"
+    tags = set()
+    for p in scalar_files:
+        with open(p) as f:
+            for line in f:
+                try:
+                    tags.add(json.loads(line).get("tag"))
+                except ValueError:
+                    pass
+    assert any(t and t.startswith("train/") for t in tags), tags
+
+    # 2. save -> reload into a fresh model -> bitwise predict parity
+    snap = str(tmp_path / "snap")
+    model.save(snap)
+    pt.seed(123)  # fresh weights differ until load
+    net2 = pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.ReLU(), pt.nn.Linear(16, 2))
+    model2 = pt.Model(net2)
+    opt2 = pt.optimizer.Adam(learning_rate=0.05,
+                             parameters=net2.parameters())
+    model2.prepare(opt2, pt.nn.CrossEntropyLoss(), Accuracy())
+    model2.load(snap)
+    xs = [Blobs(8, 2)[i][0] for i in range(8)]
+    a = model.predict(xs, batch_size=8, stack_outputs=True, verbose=0)
+    b = model2.predict(xs, batch_size=8, stack_outputs=True, verbose=0)
+    np.testing.assert_allclose(a[0], b[0], atol=1e-6)
+
+    # 3. resumed training continues to learn (optimizer state restored)
+    model2.fit(Blobs(64, 0), batch_size=16, epochs=1, verbose=0)
+    logs2 = model2.evaluate(Blobs(32, 1), batch_size=16, verbose=0)
+    assert logs2["acc"] >= logs["acc"] - 0.05
+
+    # 4. deploy: jit.save the trained net, serve through the Predictor
+    prefix = str(tmp_path / "deploy" / "net")
+    jit.save(net2, prefix,
+             input_spec=[jit.InputSpec([None, 8], "float32", name="x")])
+    x = np.stack(xs).astype(np.float32)
+    eager = np.asarray(net2(pt.to_tensor(x)).numpy())
+    cfg = Config()
+    cfg.set_model(prefix)
+    pred = create_predictor(cfg)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    (out,) = pred.run()
+    np.testing.assert_allclose(out, eager, rtol=2e-5, atol=1e-6)
