@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Autoregressive decode throughput (KV-cache, device-side while_loop).
 
-GPT-355M greedy decode on one chip: B8, prompt 128, 128 new tokens — the
-whole decode is ONE compiled program (models/generation.py device loop),
-so the measurement is real device time, not 63ms-per-token tunnel round
-trips. Appends the result to BENCH_NOTES_r05.json.
+Greedy decode on one chip: B8, prompt 128, 128 new tokens — the whole
+decode is ONE compiled program (models/generation.py device loop), so
+the measurement is real device time, not 63ms-per-token tunnel round
+trips. Covers GPT-355M and Llama-0.76B (set BENCH_DECODE_MODELS to a
+comma list to narrow). Appends each row to BENCH_NOTES_r05.json.
 """
 import json
 import os
@@ -20,58 +21,126 @@ _NOTES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                       "BENCH_NOTES_r05.json")
 
 
-def main():
-    import jax
-
-    from _bench_timing import roundtrip_baseline
-
+def _build(model_name, prompt, new, small):
     import paddle_tpu as paddle
-    from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform in ("tpu", "axon")
-    if not on_tpu:
-        print("not on TPU — aborting (decode numbers are tunnel-specific)",
-              file=sys.stderr)
-        sys.exit(2)
+    if model_name == "gpt":
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    B = int(os.environ.get("BENCH_BATCH", 8))
-    prompt = int(os.environ.get("BENCH_PROMPT", 128))
-    new = int(os.environ.get("BENCH_NEW_TOKENS", 128))
-    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                    num_heads=16, max_position_embeddings=prompt + new,
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        cfg = GPTConfig(vocab_size=128 if small else 50304,
+                        hidden_size=64 if small else 1024,
+                        num_layers=2 if small else 24,
+                        num_heads=4 if small else 16,
+                        max_position_embeddings=prompt + new,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        paddle.seed(0)
+        return GPTForCausalLM(cfg), cfg.vocab_size, "gpt-355m"
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128 if small else 32000,
+                      hidden_size=64 if small else 2048,
+                      num_layers=2 if small else 12,
+                      num_heads=4 if small else 16,
+                      num_key_value_heads=4 if small else 16,
+                      max_position_embeddings=prompt + new)
     paddle.seed(0)
-    model = GPTForCausalLM(cfg)
+    return LlamaForCausalLM(cfg), cfg.vocab_size, "llama-0.76b"
+
+
+def _already_banked(metric):
+    """Resume safety: a partial failure exits 1, the battery re-runs the
+    whole tool, and append-only notes would duplicate the model that
+    succeeded — skip rows already banked on silicon this round."""
+    try:
+        with open(_NOTES) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (rec.get("metric") == metric
+                        and rec.get("device") in ("tpu", "axon")):
+                    return True
+    except OSError:
+        pass
+    return False
+
+
+def _bench_one(model_name, rt, B, prompt, new, dev, small):
+    import paddle_tpu as paddle
+
+    metric = f"{model_name}_decode_tokens_per_sec_per_chip"
+    if not small and _already_banked(metric):
+        print(f"decode[{model_name}]: already banked this round — skipping",
+              file=sys.stderr)
+        return
+    model, vocab, label = _build(model_name, prompt, new, small)
     model.eval()
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, prompt)))
+    ids = paddle.to_tensor(rng.integers(0, vocab, (B, prompt)))
 
     t0 = time.time()
-    out = model.generate(ids, max_new_tokens=new, temperature=0.0,
-                         device_loop=True)
+    model.generate(ids, max_new_tokens=new, temperature=0.0,
+                   device_loop=True)
     compile_s = time.time() - t0
-    rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        out = model.generate(ids, max_new_tokens=new, temperature=0.0,
-                             device_loop=True)
+        model.generate(ids, max_new_tokens=new, temperature=0.0,
+                       device_loop=True)
         best = min(best, time.perf_counter() - t0 - rt)
     # generate() fetches the result (host concat) — already synced
     tok_s = B * new / best
     rec = {
-        "metric": "gpt_decode_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
-        "config": f"gpt-355m-decode-b{B}-p{prompt}-n{new}-greedy",
+        "config": f"{label}-decode-b{B}-p{prompt}-n{new}-greedy",
         "total_s": round(best, 3), "compile_s": round(compile_s, 1),
         "per_token_ms": round(1e3 * best / new, 2),
         "device": str(dev.platform),
     }
     print(json.dumps(rec))
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
     rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     with open(_NOTES, "a") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def main():
+    import jax
+
+    from _bench_timing import roundtrip_baseline
+
+    small = os.environ.get("BENCH_DECODE_SMALL") == "1"
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    if not on_tpu and not small:
+        print("not on TPU — aborting (decode numbers are tunnel-specific; "
+              "BENCH_DECODE_SMALL=1 for a CPU smoke)", file=sys.stderr)
+        sys.exit(2)
+
+    B = int(os.environ.get("BENCH_BATCH", 8))
+    prompt = int(os.environ.get("BENCH_PROMPT", 128))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", 128))
+    models = [m.strip() for m in
+              os.environ.get("BENCH_DECODE_MODELS", "gpt,llama").split(",")
+              if m.strip()]
+    known = {"gpt", "llama"}
+    if not models or not set(models) <= known:
+        print(f"BENCH_DECODE_MODELS must name models from {sorted(known)}; "
+              f"got {models!r}", file=sys.stderr)
+        sys.exit(2)
+    rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
+    failures = 0
+    for name in models:
+        try:
+            _bench_one(name, rt, B, prompt, new, dev, small)
+        except Exception as e:  # one model's OOM must not lose the other's
+            failures += 1
+            print(f"decode[{name}]: {type(e).__name__}: {str(e)[:160]}",
+                  file=sys.stderr)
+    sys.exit(1 if failures else 0)
 
 
 if __name__ == "__main__":
