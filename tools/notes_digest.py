@@ -32,18 +32,29 @@ def main():
 
     print(f"=== digest of {os.path.basename(path)} ({len(rows)} rows) ===")
 
-    # latest row per headline metric; a TPU row is never displaced by a
-    # later CPU row (local smokes/fallbacks append after real evidence)
+    # BEST row per headline metric (ladders append every rung — the last
+    # rung is rarely the best); a TPU row is never displaced by a CPU row
+    # (local smokes/fallbacks append after real evidence)
     latest = {}
     for r in rows:
         m = r.get("metric")
         if m and m not in ("llama_bisect", "flash_ab", "flash_ab_summary"):
             prev = latest.get(m)
-            if (prev is not None
-                    and prev.get("device") in ("tpu", "axon")
-                    and r.get("device") not in ("tpu", "axon")):
-                continue
-            latest[m] = r  # file is append-ordered: last wins
+            r_tpu = r.get("device") in ("tpu", "axon")
+            if prev is not None:
+                p_tpu = prev.get("device") in ("tpu", "axon")
+                if p_tpu and not r_tpu:
+                    continue
+                # best-by-value only for throughput metrics (ladders
+                # append every rung); memory/size metrics (GiB — lower
+                # is better, one row per combo) keep last-wins
+                if (p_tpu == r_tpu
+                        and r.get("unit") in ("tokens/s", "imgs/s")
+                        and isinstance(prev.get("value"), (int, float))
+                        and isinstance(r.get("value"), (int, float))
+                        and r["value"] <= prev["value"]):
+                    continue
+            latest[m] = r
     for m in sorted(latest):
         r = latest[m]
         dev = r.get("device", "?")
@@ -57,15 +68,22 @@ def main():
     if bisect:
         # a partial row is only news when no full trajectory row for the
         # same tag landed later (the partial is banked BEFORE the
-        # discriminator evals; the full row supersedes it)
+        # discriminator evals; the full row supersedes it); multiple
+        # bisect passes append duplicate rows — display the LAST per
+        # (probe, tag/D) key so the digest shows one line per probe
         full_tags = {r.get("tag") for r in bisect
                      if r.get("probe") == "trajectory"}
-        print(f"\n  llama_bisect: {len(bisect)} rows")
+        last_by_key = {}
         for r in bisect:
+            last_by_key[(r.get("probe"), r.get("tag"), r.get("D"))] = r
+        display = [r for r in bisect
+                   if id(r) in set(map(id, last_by_key.values()))
+                   and not (r.get("probe") == "trajectory_partial"
+                            and r.get("tag") in full_tags)]
+        print(f"\n  llama_bisect: {len(bisect)} rows "
+              f"({len(display)} distinct probes shown)")
+        for r in display:
             probe = r.get("probe")
-            if (probe == "trajectory_partial"
-                    and r.get("tag") in full_tags):
-                continue
             if probe == "kernel_causality":
                 if r.get("error"):
                     print(f"    kernel: ERROR {r['error']}")
