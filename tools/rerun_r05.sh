@@ -123,7 +123,25 @@ echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
 run_step 11-yoloe 2400 python bench.py --model yoloe
 run_step 11-ocr 1200 python bench.py --model ocr
 
-echo "=== 12. digest ==="
+# --- session-3 additions: long-context evidence + MFU probes ---
+
+gate "12. flash long-S"
+echo "=== 12. flash full S sweep (512..4096, D=64) — long-context kernel evidence ==="
+run_step 12-flash-longs 3600 python tools/bench_flash.py
+
+gate "12b. flash d128 s2048"
+echo "=== 12b. flash D=128 S=2048 (llama/gpt13 geometry, long context) ==="
+run_step 12b-flash-d128-s2048 1200 python tools/bench_flash.py --d 128 --s 2048 --reps 5
+
+gate "13. gpt13 b2"
+echo "=== 13. gpt13 b2-fce probe rung (does the b8->b4 HBM-pressure trend continue?) ==="
+BENCH_BATCH=2 run_step 13-gpt13-b2 2400 python bench.py --model gpt13
+
+gate "14. gpt long-context"
+echo "=== 14. gpt-355m S=2048 training row (long-context training on silicon) ==="
+BENCH_SEQ=2048 BENCH_BATCH=4 run_step 14-gpt-s2048 2400 python bench.py --model gpt
+
+echo "=== 15. digest ==="
 python tools/notes_digest.py
 
 echo "done — see BENCH_NOTES_r05.json"
