@@ -33,6 +33,8 @@ import time
 import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
 
 import numpy as np
 
@@ -60,28 +62,14 @@ def _probe_backend_subprocess(timeout_s: float, require_tpu: bool = False):
     """Probe backend init in a KILLABLE subprocess — the axon plugin can
     hang (not error) inside client init, which no in-process retry loop
     survives. Returns True when `jax.devices()` + a tiny computation work
-    (and, with require_tpu, the platform is an accelerator, not cpu)."""
-    import subprocess
-    code = ("import jax, jax.numpy as jnp;"
-            "d=jax.devices();"
-            "jnp.zeros((8,8)).block_until_ready();"
-            "print('PROBE_OK', d[0].platform, len(d))")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
-                           capture_output=True, text=True)
-        ok = r.returncode == 0 and "PROBE_OK" in r.stdout
-        platform = ""
-        if ok:
-            platform = [ln for ln in r.stdout.splitlines()
-                        if "PROBE_OK" in ln][-1].split()[1]
-        if require_tpu and platform == "cpu":
-            ok = False
-        tail = (r.stdout + r.stderr).strip().splitlines()[-3:]
-        _log(f"probe rc={r.returncode} ok={ok}: {' | '.join(tail)}")
-        return ok
-    except subprocess.TimeoutExpired:
-        _log(f"probe HUNG past {timeout_s:.0f}s (killed)")
+    (and, with require_tpu, the platform is an accelerator, not cpu).
+    Thin wrapper over the shared tools/_bench_timing.probe_backend (one
+    probe implementation, one process-group-kill fix)."""
+    from _bench_timing import probe_backend
+    platform = probe_backend(timeout_s, log=_log)
+    if platform is None:
         return False
+    return not (require_tpu and platform == "cpu")
 
 
 def _acquire_device(max_wait: float):
@@ -259,11 +247,14 @@ def bench_gpt(dev, small):
 
     on_tpu = dev.platform in ("tpu", "axon")
     if small:
+        # scale position table with BENCH_SEQ: ids past a fixed 512-row
+        # embedding would be silently clamped by XLA gather, banking a
+        # numerically bogus long-seq CPU row (battery step 14 sets S=2048)
+        S = int(os.environ.get("BENCH_SEQ", 256))
         cfg = GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
-                        num_heads=8, max_position_embeddings=512,
+                        num_heads=8, max_position_embeddings=max(S, 512),
                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
         B = int(os.environ.get("BENCH_BATCH", 4))
-        S = int(os.environ.get("BENCH_SEQ", 256))
         steps = int(os.environ.get("BENCH_STEPS", 5))
     else:
         # GPT-medium-scale: ~355M params — saturates one v5e chip in bf16
@@ -345,13 +336,13 @@ def bench_gpt13(dev, small):
 
     on_tpu = dev.platform in ("tpu", "axon")
     if small:
+        S = int(os.environ.get("BENCH_SEQ", 256))
         cfg = GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
                         num_heads=2,  # d_head 128 — same head geometry
-                        max_position_embeddings=512,
+                        max_position_embeddings=max(S, 512),
                         hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                         fused_loss=True)
         B = int(os.environ.get("BENCH_BATCH", 2))
-        S = int(os.environ.get("BENCH_SEQ", 256))
         steps = int(os.environ.get("BENCH_STEPS", 3))
     else:
         S = int(os.environ.get("BENCH_SEQ", 1024))

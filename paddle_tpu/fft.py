@@ -5,6 +5,15 @@ Reference parity: python/paddle/fft.py (fft/ifft/rfft/irfft/hfft/ihfft +
 backed there by cuFFT/onemkl phi kernels — here each is one jnp.fft call
 lowered by XLA to its native FFT; gradients come from jax's fft JVP rules
 through the eager tape (differentiable where the reference's are).
+
+Examples:
+    >>> x = paddle.to_tensor(np.array([1.0, 0.0, -1.0, 0.0], "float32"))
+    >>> freq = paddle.fft.fft(x)
+    >>> freq.shape
+    [4]
+    >>> back = paddle.fft.ifft(freq)
+    >>> bool(np.allclose(back.numpy().real, x.numpy(), atol=1e-6))
+    True
 """
 from __future__ import annotations
 

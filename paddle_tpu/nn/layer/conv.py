@@ -70,6 +70,15 @@ class Conv1D(_ConvNd):
 
 
 class Conv2D(_ConvNd):
+    """2-D convolution layer (reference: nn/layer/conv.py Conv2D).
+
+    Examples:
+        >>> conv = paddle.nn.Conv2D(3, 8, kernel_size=3, padding=1)
+        >>> out = conv(paddle.to_tensor(np.ones((2, 3, 16, 16), "float32")))
+        >>> out.shape
+        [2, 8, 16, 16]
+    """
+
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
                  bias_attr=None, data_format="NCHW"):

@@ -66,7 +66,14 @@ _StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
 
 class MultiHeadAttention(Layer):
-    """reference: nn/layer/transformer.py MultiHeadAttention."""
+    """reference: nn/layer/transformer.py MultiHeadAttention.
+
+    Examples:
+        >>> mha = paddle.nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        >>> x = paddle.to_tensor(np.ones((2, 6, 16), "float32"))
+        >>> mha(x, x, x).shape
+        [2, 6, 16]
+    """
 
     Cache = _Cache  # incremental decode kv cache
     StaticCache = _StaticCache  # precomputed encoder kv

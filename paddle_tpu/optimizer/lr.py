@@ -221,6 +221,18 @@ class MultiplicativeDecay(LRScheduler):
 
 
 class CosineAnnealingDecay(LRScheduler):
+    """Cosine-annealed learning rate (reference: optimizer/lr.py
+    CosineAnnealingDecay).
+
+    Examples:
+        >>> sched = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+        >>> sched.get_lr()
+        0.1
+        >>> sched.step()
+        >>> round(sched.get_lr(), 6) < 0.1
+        True
+    """
+
     def __init__(self, learning_rate, T_max: int, eta_min=0.0, last_epoch=-1,
                  verbose=False):
         self.T_max = T_max

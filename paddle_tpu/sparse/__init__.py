@@ -138,7 +138,16 @@ class SparseCsrTensor:
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True) -> SparseCooTensor:
     """reference: sparse/creation.py sparse_coo_tensor — indices [ndim, nnz],
-    values [nnz, ...dense dims]."""
+    values [nnz, ...dense dims].
+
+    Examples:
+        >>> t = paddle.sparse.sparse_coo_tensor(
+        ...     [[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0], shape=[3, 3])
+        >>> t.shape
+        [3, 3]
+        >>> float(t.to_dense()[1][2])
+        2.0
+    """
     idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
                      else indices)
     vals = ensure_tensor(values)._value

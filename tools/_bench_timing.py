@@ -11,9 +11,85 @@ same clock — keep it here, not copy-pasted per tool.
 """
 from __future__ import annotations
 
+import subprocess
+import sys
 import time
 
 _RT_BASELINE = None
+
+
+def probe_backend(timeout_s: float = 240.0, log=None):
+    """Probe backend init in a KILLABLE subprocess before any in-process
+    jax import. The axon plugin can hang (not error) inside client init —
+    r5 session 3 lost 16 min of a 30-min battery slot to exactly that in
+    bench_decode, which touched jax.devices() directly.
+
+    Returns the probed platform string ('tpu'/'axon'/'cpu'/...) on
+    success, or None on hang/error. Callers map None to a TRANSIENT abort
+    (rc=3: the watcher retries) and 'cpu' to their permanent
+    wrong-environment path (rc=2). The probe runs in its own process
+    GROUP and the whole group is killed on timeout — subprocess.run's
+    kill reaches only the direct child, and an orphaned probe grandchild
+    parked in axon client init is exactly the stacked hung chip-claim
+    that wedges the tunnel."""
+    import os
+    import signal
+
+    code = ("import jax, jax.numpy as jnp;"
+            "d=jax.devices();"
+            "jnp.zeros((8,8)).block_until_ready();"
+            "print('PROBE_OK', d[0].platform, len(d))")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        if log:
+            log(f"probe HUNG past {timeout_s:.0f}s (process group killed)")
+        return None
+    ok = proc.returncode == 0 and "PROBE_OK" in out
+    platform = None
+    if ok:
+        platform = [ln for ln in out.splitlines()
+                    if "PROBE_OK" in ln][-1].split()[1]
+    if log:
+        tail = (out + err).strip().splitlines()[-2:]
+        log(f"probe rc={proc.returncode} platform={platform}: "
+            f"{' | '.join(tail)}")
+    return platform
+
+
+def probe_or_exit(timeout_s: float = 240.0, require_tpu: bool = True,
+                  log=None) -> str:
+    """probe_backend + the battery rc contract in one place: exit 3
+    (transient — the watcher retries) on hang/error, exit 2 (permanent
+    wrong-environment) on a CPU-only host when require_tpu. Returns the
+    platform, already validated, so callers never pay a second in-process
+    jax init just to re-discover it.
+
+    Deliberately NOT skipped when a parent (battery gate / bonus battery)
+    probed seconds earlier: each probe is a FRESH chip claim, and a fresh
+    claim is exactly what can wedge — r5 session 3's decode hang happened
+    in the window right after a successful gate probe. The ~20-40 s
+    healthy-path cost buys a 240 s bound on what was a full-step-budget
+    burn."""
+    _log = log or (lambda m: print(m, file=sys.stderr))
+    plat = probe_backend(timeout_s, log=_log)
+    if plat is None:
+        _log("backend probe hung/failed — aborting fast (rc=3) so the "
+             "battery slot survives; the watcher owns the retry cadence")
+        sys.exit(3)
+    if require_tpu and plat == "cpu":
+        _log("not on TPU — aborting (rc=2): permanent wrong-environment, "
+             "not a condition the watcher can retry away")
+        sys.exit(2)
+    return plat
 
 
 def sync_fetch(x):

@@ -43,6 +43,11 @@ def _bench(update, w, m, v, g, lr, t, iters=20, reps=3):
 
 
 def main():
+    from _bench_timing import probe_or_exit
+
+    # require_tpu: the pallas A/B side has no CPU-interpret path — a CPU
+    # "run" only ever produced a mid-sweep crash
+    probe_or_exit(240.0)
     import jax
     import jax.numpy as jnp
 
@@ -51,8 +56,8 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
-    n = int(os.environ.get("BENCH_ADAMW_N", 355_000_000 if on_tpu
-                           else 1_000_000))
+    # (no CPU sizing: probe_or_exit above guarantees an accelerator here)
+    n = int(os.environ.get("BENCH_ADAMW_N", 355_000_000))
     # align to the LARGEST swept blocking (256*1024): the kernel's pad
     # path would otherwise copy all four flat buffers every loop
     # iteration, and a rows count not divisible by block_rows makes

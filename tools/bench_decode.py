@@ -108,11 +108,15 @@ def _bench_one(model_name, rt, B, prompt, new, dev, small):
 
 
 def main():
-    import jax
-
-    from _bench_timing import roundtrip_baseline
+    from _bench_timing import probe_or_exit, roundtrip_baseline
 
     small = os.environ.get("BENCH_DECODE_SMALL") == "1"
+    if not small:
+        # require_tpu: decode numbers are tunnel-specific (the in-tool
+        # check below stays as a backstop for direct non-battery runs)
+        probe_or_exit(240.0, log=lambda m: print(m, file=sys.stderr))
+    import jax
+
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
     if not on_tpu and not small:
