@@ -121,6 +121,22 @@ def roundtrip_baseline(log=None):
     return _RT_BASELINE
 
 
+def iter_notes_rows(path):
+    """Yield parsed rows from a BENCH_NOTES jsonl file, skipping unreadable
+    lines — the one shared parser for every tool's banked-row resume logic
+    (bench_decode._already_banked, bench_flash resume)."""
+    import json
+    try:
+        with open(path) as f:
+            for ln in f:
+                try:
+                    yield json.loads(ln)
+                except ValueError:
+                    continue
+    except OSError:
+        return
+
+
 def bench_chained(step, carry, consts, iters=32, reps=3, log=None,
                   donate=False):
     """Time `step(carry, *consts) -> carry` chained ITERS times in one jit.

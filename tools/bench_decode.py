@@ -51,19 +51,10 @@ def _already_banked(metric):
     """Resume safety: a partial failure exits 1, the battery re-runs the
     whole tool, and append-only notes would duplicate the model that
     succeeded — skip rows already banked on silicon this round."""
-    try:
-        with open(_NOTES) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if (rec.get("metric") == metric
-                        and rec.get("device") in ("tpu", "axon")):
-                    return True
-    except OSError:
-        pass
-    return False
+    from _bench_timing import iter_notes_rows
+    return any(rec.get("metric") == metric
+               and rec.get("device") in ("tpu", "axon")
+               for rec in iter_notes_rows(_NOTES))
 
 
 def _bench_one(model_name, rt, B, prompt, new, dev, small):

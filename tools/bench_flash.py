@@ -45,6 +45,25 @@ def _bench(step, q, k, v, iters=32, reps=3):
     return t
 
 
+def _summarize_s(results, S):
+    """Best-pallas-vs-xla summary entry for one S from the timing dict, or
+    None when either side is missing (e.g. every pallas block failed)."""
+    xla = results.get((S, "xla", None))
+    if xla is None:
+        return None
+    pl_best = None
+    for (s2, impl, blk), (tf, tb) in results.items():
+        if s2 == S and impl == "pallas" and (
+                pl_best is None or tb < pl_best[1][1]):
+            pl_best = (blk, (tf, tb))
+    if pl_best is None:
+        return None
+    win = pl_best[1][1] < xla[1]
+    return {"xla_ms": round(xla[1] * 1e3, 2),
+            "pallas_ms": round(pl_best[1][1] * 1e3, 2),
+            "best_blocks": list(pl_best[0]), "pallas_wins": bool(win)}
+
+
 def main():
     from _bench_timing import probe_or_exit
 
@@ -83,26 +102,30 @@ def main():
     # resume: the full sweep is ~30 min of timed configs appended to an
     # append-only notes file — a re-run after a mid-sweep wedge must not
     # re-measure (and duplicate) the S values a summary row already
-    # banked on silicon this round. --force re-measures everything.
-    banked_rec = {}
+    # banked on silicon this round. Summary rows persist PER S as each
+    # completes (so a mid-sweep wedge checkpoints what it measured), and
+    # the skip honors reps: a reps=9 tie-break must re-measure an S that
+    # only a reps=3 sweep banked (rows without a reps field never skip).
+    # --force re-measures everything.
+    from _bench_timing import iter_notes_rows
+
+    banked_rec, banked_reps = {}, {}
     if "--force" not in argv:
-        try:
-            with open(_NOTES) as f:
-                for ln in f:
-                    try:
-                        row = json.loads(ln)
-                    except ValueError:
-                        continue
-                    if (row.get("metric") == "flash_ab_summary"
-                            and row.get("device") in ("tpu", "axon")
-                            and row.get("D", 64) == D):
-                        banked_rec.update(row.get("per_seq", {}))
-        except OSError:
-            pass
-    skip_s = {int(s) for s in banked_rec}
+        for row in iter_notes_rows(_NOTES):
+            if (row.get("metric") == "flash_ab_summary"
+                    and row.get("device") in ("tpu", "axon")
+                    and row.get("D", 64) == D):
+                # newest row wins per S (rows append chronologically):
+                # the skip decision must gate on the reps of the entry
+                # actually carried — a --force reps=3 re-measure
+                # deliberately supersedes an older reps=9 row
+                for s, entry in row.get("per_seq", {}).items():
+                    banked_rec[s] = entry
+                    banked_reps[int(s)] = row.get("reps", 0)
+    skip_s = {s for s, r in banked_reps.items() if r >= reps}
     if skip_s & set(seqs):
-        _log(f"banked this round (skipping, --force to re-measure): "
-             f"{sorted(skip_s & set(seqs))}")
+        _log(f"banked this round at reps>={reps} (skipping, --force to "
+             f"re-measure): {sorted(skip_s & set(seqs))}")
     blocks = [(256, 512), (512, 512), (1024, 512), (512, 1024),
               (1024, 1024), (256, 1024)]
     causal, scale = True, 1.0 / np.sqrt(D)
@@ -195,7 +218,17 @@ def main():
                           "fwdbwd_ms": round(t_bwd * 1e3, 2),
                           "device": dev.platform})
 
-    # recommendation: per S, best pallas config vs xla on fwd+bwd
+        # checkpoint THIS S the moment it completes: a mid-sweep wedge
+        # must not cost the next window the S values already measured
+        entry = _summarize_s(results, S)
+        if entry is not None and on_tpu:
+            _persist({"metric": "flash_ab_summary", "per_seq": {S: entry},
+                      "D": D, "reps": reps, "device": dev.platform})
+
+    # recommendation: per S, best pallas config vs xla on fwd+bwd.
+    # (The durable record is the per-S checkpoint rows persisted above —
+    # nothing more is persisted here, so carried entries are never
+    # re-dated and a partial run banks exactly what it measured.)
     _log("\n=== summary (fwd+bwd) ===")
     rec = {}
     for S in seqs:
@@ -204,35 +237,16 @@ def main():
             _log(f"S={S}: (banked) xla {rec[S]['xla_ms']}ms vs pallas "
                  f"{rec[S]['pallas_ms']}ms @bq/bk={rec[S]['best_blocks']}")
             continue
-        xla = results.get((S, "xla", None))
-        if xla is None:
+        entry = _summarize_s(results, S)
+        if entry is None:
             continue
-        pl_best = None
-        for (s2, impl, blk), (tf, tb) in results.items():
-            if s2 == S and impl == "pallas" and (
-                    pl_best is None or tb < pl_best[1][1]):
-                pl_best = (blk, (tf, tb))
-        if pl_best is None:
-            continue
-        win = pl_best[1][1] < xla[1]
-        rec[S] = {"xla_ms": round(xla[1] * 1e3, 2),
-                  "pallas_ms": round(pl_best[1][1] * 1e3, 2),
-                  "best_blocks": list(pl_best[0]), "pallas_wins": bool(win)}
-        _log(f"S={S}: xla {xla[1]*1e3:.2f}ms vs pallas "
-             f"{pl_best[1][1]*1e3:.2f}ms @bq/bk={pl_best[0]} "
-             f"-> {'PALLAS' if win else 'XLA'}")
+        rec[S] = entry
+        _log(f"S={S}: xla {entry['xla_ms']}ms vs pallas "
+             f"{entry['pallas_ms']}ms @bq/bk={entry['best_blocks']} "
+             f"-> {'PALLAS' if entry['pallas_wins'] else 'XLA'}")
     wins = sorted(s for s, r in rec.items() if r["pallas_wins"])
     threshold = wins[0] if wins else None
     _log(f"recommended pallas_flash_min_seq = {threshold}")
-    measured_rec = {s: r for s, r in rec.items() if s not in skip_s}
-    if on_tpu and measured_rec:
-        # persist ONLY what this run measured — carried (banked) entries
-        # under a fresh timestamp would re-date session-old data as a new
-        # silicon measurement; the resume loader merges summary rows, so
-        # the union is still recoverable from the notes file
-        _persist({"metric": "flash_ab_summary", "per_seq": measured_rec,
-                  "D": D, "recommended_min_seq": threshold,
-                  "device": dev.platform})
     print(json.dumps({"metric": "flash_ab_summary", "per_seq": rec,
                       "recommended_min_seq": threshold}))
 

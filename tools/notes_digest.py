@@ -110,11 +110,21 @@ def main():
     else:
         print("\n  llama_bisect: NO ROWS (quarantine unresolved)")
 
-    summaries = [r for r in rows if r.get("metric") == "flash_ab_summary"]
-    for r in summaries:
-        print(f"\n  flash_ab_summary (D={r.get('D', 64)}): "
-              f"min_seq={r.get('recommended_min_seq')} "
-              f"per_seq={json.dumps(r.get('per_seq', {}))[:200]}")
+    # merge summary rows per D: bench_flash checkpoints per-S fragments
+    # as each S completes (plus legacy whole-run rows) — display the union
+    merged = {}
+    for r in rows:
+        if r.get("metric") != "flash_ab_summary":
+            continue
+        d = merged.setdefault(r.get("D", 64), {})
+        for s, entry in r.get("per_seq", {}).items():
+            d[int(s)] = entry
+    for D in sorted(merged):
+        per_seq = merged[D]
+        wins = sorted(s for s, e in per_seq.items() if e.get("pallas_wins"))
+        print(f"\n  flash_ab_summary (D={D}): "
+              f"min_seq={wins[0] if wins else None} "
+              f"per_seq={json.dumps({str(s): per_seq[s] for s in sorted(per_seq)})[:300]}")
     return 0
 
 

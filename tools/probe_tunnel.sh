@@ -1,12 +1,16 @@
 #!/usr/bin/env bash
 # THE tunnel health probe — single source of truth for watcher + battery.
 # Killable subprocess probe (never stacked; the wedge discipline): exit 0
-# iff jax sees a real accelerator within the budget.
-timeout 140 python - <<'EOF'
-import subprocess, sys
-r = subprocess.run(
-    [sys.executable, "-c", "import jax; d=jax.devices()[0]; "
-     "assert d.platform in ('tpu','axon'); print('PROBE_OK')"],
-    capture_output=True, text=True, timeout=120)
-sys.exit(0 if (r.returncode == 0 and "PROBE_OK" in r.stdout) else 1)
-EOF
+# iff jax sees a real accelerator within the budget. Delegates to
+# _bench_timing.probe_backend — the ONE probe implementation, which kills
+# the probe's whole process GROUP on timeout (a direct-child-only kill
+# orphans an axon grandchild parked in client init: a stacked hung chip
+# claim, the exact wedge this probe exists to detect).
+here="$(cd "$(dirname "$0")" && pwd)"
+timeout 150 python -c "
+import sys
+sys.path.insert(0, '$here')
+from _bench_timing import probe_backend
+plat = probe_backend(120.0, log=lambda m: print(m, file=sys.stderr))
+sys.exit(0 if plat not in (None, 'cpu') else 1)
+"
