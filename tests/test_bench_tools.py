@@ -1,0 +1,98 @@
+"""Tests for the battery/bench tooling (tools/_bench_timing.py and the
+resume logic in tools/bench_flash.py) — the plumbing that decides what
+gets measured and banked on scarce silicon windows. Pure-logic paths run
+fast; the subprocess probe is marked slow.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+sys.path.insert(0, TOOLS)
+
+
+def _load(name, fname):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, fname))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_iter_notes_rows_skips_bad_lines(tmp_path):
+    from _bench_timing import iter_notes_rows
+
+    p = tmp_path / "notes.json"
+    p.write_text('{"a": 1}\nnot json\n{"b": 2}\n')
+    assert list(iter_notes_rows(str(p))) == [{"a": 1}, {"b": 2}]
+    assert list(iter_notes_rows(str(tmp_path / "missing.json"))) == []
+
+
+def test_summarize_s_best_block_and_missing_sides():
+    bf = _load("bf_test", "bench_flash.py")
+    res = {
+        (1024, "xla", None): (0.006, 0.00637),
+        (1024, "pallas", (1024, 1024)): (0.0005, 0.001),
+        (1024, "pallas", (512, 512)): (0.0009, 0.0017),
+        (2048, "xla", None): (0.01, 0.0111),
+    }
+    e = bf._summarize_s(res, 1024)
+    assert e == {"xla_ms": 6.37, "pallas_ms": 1.0,
+                 "best_blocks": [1024, 1024], "pallas_wins": True}
+    assert bf._summarize_s(res, 2048) is None  # pallas side all failed
+    assert bf._summarize_s(res, 4096) is None  # S never measured
+
+
+def test_flash_resume_reps_gating(tmp_path):
+    """The skip must honor reps with newest-row-wins: a reps=9 tie-break
+    re-measures an S banked only at reps=3, and a --force reps=3
+    re-measure supersedes an older reps=9 row (the r5 session-3 review
+    findings, pinned)."""
+    rows = [
+        {"metric": "flash_ab_summary", "device": "tpu", "D": 64,
+         "reps": 9, "per_seq": {"1024": {"pallas_ms": 1.0}}},
+        {"metric": "flash_ab_summary", "device": "tpu", "D": 64,
+         "reps": 3, "per_seq": {"1024": {"pallas_ms": 1.2},
+                                "2048": {"pallas_ms": 3.7}}},
+        # rows for another D or without a reps field must never skip
+        {"metric": "flash_ab_summary", "device": "tpu", "D": 128,
+         "reps": 9, "per_seq": {"512": {"pallas_ms": 9.9}}},
+        {"metric": "flash_ab_summary", "device": "tpu", "D": 64,
+         "per_seq": {"4096": {"pallas_ms": 6.1}}},
+    ]
+    p = tmp_path / "notes.json"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    bf = _load("bf_resume_test", "bench_flash.py")
+    banked_rec, banked_reps = bf._load_banked(str(p), 64)
+
+    assert banked_rec["1024"] == {"pallas_ms": 1.2}  # newest wins
+    assert "512" not in banked_rec                   # D=128 filtered out
+    skip_at = lambda reps: {s for s, r in banked_reps.items() if r >= reps}
+    assert skip_at(3) == {1024, 2048}
+    assert skip_at(9) == set()          # tie-break re-measures
+    assert 4096 not in skip_at(1)       # legacy row (no reps) never skips
+
+
+@pytest.mark.slow
+def test_probe_backend_reports_cpu_platform():
+    """probe_backend on a scrubbed-CPU env returns 'cpu' (so probe_or_exit
+    can map it to the permanent rc=2 path) — exercised as a subprocess the
+    way the battery runs it."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PJRT_LIBRARY_PATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from _bench_timing import probe_backend\n"
+        "print('PLAT', probe_backend(120.0))\n" % TOOLS)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    assert "PLAT cpu" in r.stdout

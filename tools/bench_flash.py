@@ -45,6 +45,24 @@ def _bench(step, q, k, v, iters=32, reps=3):
     return t
 
 
+def _load_banked(notes_path, D):
+    """Banked flash_ab_summary entries for head dim D from the notes file:
+    (banked_rec {str(S): entry}, banked_reps {int(S): reps}). Newest row
+    wins per S — a --force re-measure deliberately supersedes older rows —
+    and rows without a reps field gate at 0 (never satisfy a skip)."""
+    from _bench_timing import iter_notes_rows
+
+    banked_rec, banked_reps = {}, {}
+    for row in iter_notes_rows(notes_path):
+        if (row.get("metric") == "flash_ab_summary"
+                and row.get("device") in ("tpu", "axon")
+                and row.get("D", 64) == D):
+            for s, entry in row.get("per_seq", {}).items():
+                banked_rec[s] = entry
+                banked_reps[int(s)] = row.get("reps", 0)
+    return banked_rec, banked_reps
+
+
 def _summarize_s(results, S):
     """Best-pallas-vs-xla summary entry for one S from the timing dict, or
     None when either side is missing (e.g. every pallas block failed)."""
@@ -107,21 +125,8 @@ def main():
     # the skip honors reps: a reps=9 tie-break must re-measure an S that
     # only a reps=3 sweep banked (rows without a reps field never skip).
     # --force re-measures everything.
-    from _bench_timing import iter_notes_rows
-
-    banked_rec, banked_reps = {}, {}
-    if "--force" not in argv:
-        for row in iter_notes_rows(_NOTES):
-            if (row.get("metric") == "flash_ab_summary"
-                    and row.get("device") in ("tpu", "axon")
-                    and row.get("D", 64) == D):
-                # newest row wins per S (rows append chronologically):
-                # the skip decision must gate on the reps of the entry
-                # actually carried — a --force reps=3 re-measure
-                # deliberately supersedes an older reps=9 row
-                for s, entry in row.get("per_seq", {}).items():
-                    banked_rec[s] = entry
-                    banked_reps[int(s)] = row.get("reps", 0)
+    banked_rec, banked_reps = (
+        _load_banked(_NOTES, D) if "--force" not in argv else ({}, {}))
     skip_s = {s for s, r in banked_reps.items() if r >= reps}
     if skip_s & set(seqs):
         _log(f"banked this round at reps>={reps} (skipping, --force to "
