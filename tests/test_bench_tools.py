@@ -13,19 +13,25 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(REPO, "tools")
-sys.path.insert(0, TOOLS)
 
 
 def _load(name, fname):
-    spec = importlib.util.spec_from_file_location(
-        name, os.path.join(TOOLS, fname))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    """Load a tools/ module by path with tools/ on sys.path only for the
+    duration of the load (module-level inserts leak into every later test
+    — the scoping precedent is tests/test_api_fingerprint.py)."""
+    sys.path.insert(0, TOOLS)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(TOOLS, fname))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.remove(TOOLS)
 
 
 def test_iter_notes_rows_skips_bad_lines(tmp_path):
-    from _bench_timing import iter_notes_rows
+    iter_notes_rows = _load("bt_test", "_bench_timing.py").iter_notes_rows
 
     p = tmp_path / "notes.json"
     p.write_text('{"a": 1}\nnot json\n{"b": 2}\n')

@@ -117,14 +117,9 @@ def main():
     if only_s is not None:
         seqs = [only_s]
 
-    # resume: the full sweep is ~30 min of timed configs appended to an
-    # append-only notes file — a re-run after a mid-sweep wedge must not
-    # re-measure (and duplicate) the S values a summary row already
-    # banked on silicon this round. Summary rows persist PER S as each
-    # completes (so a mid-sweep wedge checkpoints what it measured), and
-    # the skip honors reps: a reps=9 tie-break must re-measure an S that
-    # only a reps=3 sweep banked (rows without a reps field never skip).
-    # --force re-measures everything.
+    # resume: a re-run after a mid-sweep wedge must not re-measure (and
+    # duplicate) already-banked S values — summary rows checkpoint PER S
+    # as each completes; skip semantics live in _load_banked's docstring
     banked_rec, banked_reps = (
         _load_banked(_NOTES, D) if "--force" not in argv else ({}, {}))
     skip_s = {s for s, r in banked_reps.items() if r >= reps}

@@ -80,15 +80,15 @@ gate "2. gpt ladder"
 echo "=== 2. headline GPT ladder (official artifact evidence) ==="
 # ladder outer timeouts: worst case = rungs x 1800s inner budget + probe
 # slack (the outer kill must never beat the ladder's own per-rung kills)
-BENCH_BONUS=0 run_step 02-gpt-ladder 5700 python bench.py --model gpt
+BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 02-gpt-ladder 5700 python bench.py --model gpt
 
 gate "3. gpt13"
 echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
-BENCH_BONUS=0 run_step 03-gpt13 9500 python bench.py --model gpt13
+BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 03-gpt13 9500 python bench.py --model gpt13
 
 gate "4. resnet50"
 echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
-BENCH_SMALL=0 run_step 04-resnet50 900 python bench.py --model resnet50
+BENCH_SMALL=0 BENCH_NO_CPU_FALLBACK=1 run_step 04-resnet50 900 python bench.py --model resnet50
 
 gate "5. adamw"
 echo "=== 5. fused AdamW re-verdict at designed 256x1024 blocking ==="
@@ -104,7 +104,7 @@ run_step 06b-flash-d128 1200 python tools/bench_flash.py --d 128 --s 1024 --reps
 
 gate "7. bert"
 echo "=== 7. bert re-measure with chained clock ==="
-run_step 07-bert 900 python bench.py --model bert
+BENCH_NO_CPU_FALLBACK=1 run_step 07-bert 900 python bench.py --model bert
 
 gate "8. decode"
 echo "=== 8. decode throughput (device-side while_loop) ==="
@@ -112,16 +112,16 @@ run_step 08-decode 1800 python tools/bench_decode.py
 
 gate "9. bert B64"
 echo "=== 9. bert B64 batch probe ==="
-BENCH_BATCH=64 run_step 09-bert-b64 900 python bench.py --model bert
+BENCH_BATCH=64 BENCH_NO_CPU_FALLBACK=1 run_step 09-bert-b64 900 python bench.py --model bert
 
 gate "10. llama"
 echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
-BENCH_BATCH=8 BENCH_RECOMPUTE=1 run_step 10-llama 2400 python bench.py --model llama
+BENCH_BATCH=8 BENCH_RECOMPUTE=1 BENCH_NO_CPU_FALLBACK=1 run_step 10-llama 2400 python bench.py --model llama
 
 gate "11. vision"
 echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
-run_step 11-yoloe 2400 python bench.py --model yoloe
-run_step 11-ocr 1200 python bench.py --model ocr
+BENCH_NO_CPU_FALLBACK=1 run_step 11-yoloe 2400 python bench.py --model yoloe
+BENCH_NO_CPU_FALLBACK=1 run_step 11-ocr 1200 python bench.py --model ocr
 
 # --- session-3 additions: long-context evidence + MFU probes ---
 
@@ -135,11 +135,11 @@ run_step 12b-flash-d128-s2048 1200 python tools/bench_flash.py --d 128 --s 2048 
 
 gate "13. gpt13 b2"
 echo "=== 13. gpt13 b2-fce probe rung (does the b8->b4 HBM-pressure trend continue?) ==="
-BENCH_BATCH=2 run_step 13-gpt13-b2 2400 python bench.py --model gpt13
+BENCH_BATCH=2 BENCH_NO_CPU_FALLBACK=1 run_step 13-gpt13-b2 2400 python bench.py --model gpt13
 
 gate "14. gpt long-context"
 echo "=== 14. gpt-355m S=2048 training row (long-context training on silicon) ==="
-BENCH_SEQ=2048 BENCH_BATCH=4 run_step 14-gpt-s2048 2400 python bench.py --model gpt
+BENCH_SEQ=2048 BENCH_BATCH=4 BENCH_NO_CPU_FALLBACK=1 run_step 14-gpt-s2048 2400 python bench.py --model gpt
 
 echo "=== 15. digest ==="
 python tools/notes_digest.py
