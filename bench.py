@@ -905,6 +905,10 @@ _LADDERS = {
         # Mosaic, this rung still lands a gpt13 number on smaller blocks
         ("b8-fce-bq512", {"BENCH_BATCH": "8", "PADDLE_TPU_FLASH_BQ": "512",
                           "PADDLE_TPU_FLASH_BK": "512"}),
+        # the GPT-3 paper context for the XL row is S=2048 — same 4096
+        # tokens/step as the b4-s1024 headline, but the paper-faithful
+        # geometry (more uncounted attention FLOPs, so 6N-MFU may dip)
+        ("b2-s2048-fce", {"BENCH_BATCH": "2", "BENCH_SEQ": "2048"}),
     ],
 }
 

@@ -84,7 +84,8 @@ BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 02-gpt-ladder 5700 python bench.p
 
 gate "3. gpt13"
 echo "=== 3. gpt13: 1.3B north-star, 40% MFU target ==="
-BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 03-gpt13 9500 python bench.py --model gpt13
+# 6 rungs x 1800s inner budget + 5 inter-rung probes x 150s + slack
+BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 03-gpt13 12000 python bench.py --model gpt13
 
 gate "4. resnet50"
 echo "=== 4. resnet50 re-measure (old row is suspect-high) ==="
@@ -136,6 +137,18 @@ run_step 12b-flash-d128-s2048 1200 python tools/bench_flash.py --d 128 --s 2048 
 gate "13. gpt13 b2"
 echo "=== 13. gpt13 b2-fce probe rung (does the b8->b4 HBM-pressure trend continue?) ==="
 BENCH_BATCH=2 BENCH_NO_CPU_FALLBACK=1 run_step 13-gpt13-b2 2400 python bench.py --model gpt13
+
+gate "13b. gpt13 s2048"
+echo "=== 13b. gpt13 b2 S=2048 — the GPT-3 paper context for the XL row ==="
+# the gpt13 ladder's last rung measures this same config on a FRESH
+# ladder run (driver path) — skip when a TPU row is already banked
+if grep -q '"config": "gpt13-h2048-l24-b2-s2048.*"device": "tpu"' \
+    BENCH_NOTES_r05.json 2>/dev/null; then
+  echo "[battery] 13b already banked by the ladder — skipping"
+  touch "$DONE_DIR/13b-gpt13-s2048"
+else
+  BENCH_BATCH=2 BENCH_SEQ=2048 BENCH_NO_CPU_FALLBACK=1 run_step 13b-gpt13-s2048 2400 python bench.py --model gpt13
+fi
 
 gate "14. gpt long-context"
 echo "=== 14. gpt-355m S=2048 training row (long-context training on silicon) ==="
