@@ -741,7 +741,11 @@ def bench_llama(dev, small):
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, num_layers=12,
                           num_heads=16, num_key_value_heads=16,
                           max_position_embeddings=max(S, 1024),
-                          recompute=os.environ.get("BENCH_RECOMPUTE") == "1",
+                          # default ON: the fitting, proven config — plain
+                          # b8-norc OOM'd in r4, so a ladder fall-through
+                          # or bare run must not land on it by default
+                          recompute=os.environ.get("BENCH_RECOMPUTE", "1")
+                          == "1",
                           recompute_policy=os.environ.get("BENCH_RC_POLICY")
                           or None,
                           fused_loss=os.environ.get("BENCH_FUSED_CE", "1")
@@ -910,6 +914,16 @@ _LADDERS = {
         # geometry (more uncounted attention FLOPs, so 6N-MFU may dip)
         ("b2-s2048-fce", {"BENCH_BATCH": "2", "BENCH_SEQ": "2048"}),
     ],
+    # llama 0.76B keeps fp32 masters (~10.6 GB state) so no-remat is
+    # tighter than gpt13's nomaster recipe: proven rc config first ({} =
+    # the non-small llama defaults, recompute ON), then the no-remat
+    # probes (the gpt13 lesson: remat pays its recompute FLOPs out of
+    # the 6N MFU number; b8-norc OOM'd in r4 — b4 is the insurance)
+    "llama": [
+        ("b8-rc-fce", {}),
+        ("b8-fce", {"BENCH_BATCH": "8", "BENCH_RECOMPUTE": "0"}),
+        ("b4-fce", {"BENCH_BATCH": "4", "BENCH_RECOMPUTE": "0"}),
+    ],
 }
 
 
@@ -987,11 +1001,11 @@ def _run_bonus_battery():
         ("gpt-355m", [sys.executable, os.path.abspath(__file__),
                       "--model", "gpt"], 6300,
          {"BENCH_LADDER": "1", "BENCH_BONUS": "0"}),
-        # rc=1: plain B8 llama OOMs (10.6G optimizer state + no-remat
-        # activations, measured r4); full remat + fused-CE fits with room
+        # rides the llama ladder (proven b8-rc rung first, then the
+        # no-remat probes); budget sized like gpt-355m's 3-rung ladder
         ("llama-0.76b", [sys.executable, os.path.abspath(__file__),
-                         "--model", "llama"], 2400,
-         {"BENCH_BATCH": "8", "BENCH_RECOMPUTE": "1"}),
+                         "--model", "llama"], 6300,
+         {"BENCH_LADDER": "1", "BENCH_BONUS": "0"}),
         ("flash-sweep", [sys.executable,
                          os.path.join(here, "tools", "bench_flash.py")],
          3600, {}),
@@ -1047,7 +1061,10 @@ def main():
         # TPU is reachable: run the config ladder (each config claims the
         # chip in its own subprocess; this parent never initializes jax)
         if _run_ladder(model):
-            if os.environ.get("BENCH_BONUS", "1") != "0":
+            # bonus battery only after the HEADLINE ladder: a bare
+            # `--model gpt|llama` run (e.g. bench_all.sh) must not fire
+            # a second multi-hour battery of its own
+            if model == "gpt13" and os.environ.get("BENCH_BONUS", "1") != "0":
                 _run_bonus_battery()
             return
         _log("ladder produced nothing; falling through to the single run")

@@ -116,8 +116,9 @@ echo "=== 9. bert B64 batch probe ==="
 BENCH_BATCH=64 BENCH_NO_CPU_FALLBACK=1 run_step 09-bert-b64 900 python bench.py --model bert
 
 gate "10. llama"
-echo "=== 10. llama re-measure (if bisect un-quarantined it) ==="
-BENCH_BATCH=8 BENCH_RECOMPUTE=1 BENCH_NO_CPU_FALLBACK=1 run_step 10-llama 2400 python bench.py --model llama
+echo "=== 10. llama re-measure ladder (proven rc config first, then no-remat probes) ==="
+# 3 rungs x 1800s inner budget + 2 inter-rung probes x 150s + slack
+BENCH_BONUS=0 BENCH_NO_CPU_FALLBACK=1 run_step 10-llama 6300 python bench.py --model llama
 
 gate "11. vision"
 echo "=== 11. dynamic-shape vision: yoloe + ocr (BASELINE config 5) ==="
