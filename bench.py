@@ -418,14 +418,17 @@ def bench_bert(dev, small):
 
     on_tpu = dev.platform in ("tpu", "axon")
     if small:
-        cfg = bert_tiny()
-        B = int(os.environ.get("BENCH_BATCH", 4))
+        # scale the position table with BENCH_SEQ: ids past it are
+        # silently clamped by XLA gather (degenerate embeddings -> NaN
+        # MLM loss, observed at S=512 against the 128-row tiny default)
         S = int(os.environ.get("BENCH_SEQ", 128))
+        cfg = bert_tiny(max_position_embeddings=max(S, 128))
+        B = int(os.environ.get("BENCH_BATCH", 4))
         steps = int(os.environ.get("BENCH_STEPS", 5))
     else:
-        cfg = bert_base()
-        B = int(os.environ.get("BENCH_BATCH", 32))
         S = int(os.environ.get("BENCH_SEQ", 128))
+        cfg = bert_base(max_position_embeddings=max(S, 512))
+        B = int(os.environ.get("BENCH_BATCH", 32))
         steps = int(os.environ.get("BENCH_STEPS", 10))
 
     _log(f"bert config: h{cfg.hidden_size} l{cfg.num_layers} "
@@ -732,9 +735,10 @@ def bench_llama(dev, small):
 
     on_tpu = dev.platform in ("tpu", "axon")
     if small:
-        cfg = llama_tiny(recompute=False, fused_loss=True)
-        B = int(os.environ.get("BENCH_BATCH", 2))
         S = int(os.environ.get("BENCH_SEQ", 128))
+        cfg = llama_tiny(recompute=False, fused_loss=True,
+                         max_position_embeddings=max(S, 128))
+        B = int(os.environ.get("BENCH_BATCH", 2))
         steps = int(os.environ.get("BENCH_STEPS", 3))
     else:
         S = int(os.environ.get("BENCH_SEQ", 1024))

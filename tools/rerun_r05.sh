@@ -115,6 +115,10 @@ gate "9. bert B64"
 echo "=== 9. bert B64 batch probe ==="
 BENCH_BATCH=64 BENCH_NO_CPU_FALLBACK=1 run_step 09-bert-b64 900 python bench.py --model bert
 
+gate "9b. bert S512"
+echo "=== 9b. bert B16 S=512 probe (pretraining phase-2 geometry, better FLOP/byte than S=128) ==="
+BENCH_BATCH=16 BENCH_SEQ=512 BENCH_NO_CPU_FALLBACK=1 run_step 09b-bert-s512 900 python bench.py --model bert
+
 gate "10. llama"
 echo "=== 10. llama re-measure ladder (proven rc config first, then no-remat probes) ==="
 # 3 rungs x 1800s inner budget + 2 inter-rung probes x 150s + slack
