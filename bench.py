@@ -735,10 +735,12 @@ def bench_llama(dev, small):
 
     on_tpu = dev.platform in ("tpu", "axon")
     if small:
-        S = int(os.environ.get("BENCH_SEQ", 128))
-        cfg = llama_tiny(recompute=False, fused_loss=True,
-                         max_position_embeddings=max(S, 128))
+        # no position-table scaling needed here: llama is RoPE-only (the
+        # rotary tables are computed from the actual sequence length;
+        # max_position_embeddings only caps generate()/export)
+        cfg = llama_tiny(recompute=False, fused_loss=True)
         B = int(os.environ.get("BENCH_BATCH", 2))
+        S = int(os.environ.get("BENCH_SEQ", 128))
         steps = int(os.environ.get("BENCH_STEPS", 3))
     else:
         S = int(os.environ.get("BENCH_SEQ", 1024))

@@ -47,23 +47,35 @@ def _build(model_name, prompt, new, small):
     return LlamaForCausalLM(cfg), cfg.vocab_size, "llama-0.76b"
 
 
-def _already_banked(metric):
+def _already_banked(metric, B, prompt, new):
     """Resume safety: a partial failure exits 1, the battery re-runs the
     whole tool, and append-only notes would duplicate the model that
-    succeeded — skip rows already banked on silicon this round."""
+    succeeded — skip rows already banked on silicon this round. Keyed by
+    the (B, prompt, new) geometry too: decode is memory-bound, so batch
+    probes (battery step 8b, B=32) are distinct measurements, not
+    re-runs of the b8 row."""
     from _bench_timing import iter_notes_rows
+    suffix = _geometry(B, prompt, new)
     return any(rec.get("metric") == metric
                and rec.get("device") in ("tpu", "axon")
+               and str(rec.get("config", "")).endswith(suffix)
                for rec in iter_notes_rows(_NOTES))
+
+
+def _geometry(B, prompt, new):
+    """One source of truth for the config-label geometry suffix — the
+    banked-row skip matches on exactly this string, so the two sites
+    cannot drift."""
+    return f"-decode-b{B}-p{prompt}-n{new}-greedy"
 
 
 def _bench_one(model_name, rt, B, prompt, new, dev, small):
     import paddle_tpu as paddle
 
     metric = f"{model_name}_decode_tokens_per_sec_per_chip"
-    if not small and _already_banked(metric):
-        print(f"decode[{model_name}]: already banked this round — skipping",
-              file=sys.stderr)
+    if not small and _already_banked(metric, B, prompt, new):
+        print(f"decode[{model_name}]: b{B}-p{prompt}-n{new} already banked "
+              "this round — skipping", file=sys.stderr)
         return
     model, vocab, label = _build(model_name, prompt, new, small)
     model.eval()
@@ -85,7 +97,7 @@ def _bench_one(model_name, rt, B, prompt, new, dev, small):
     rec = {
         "metric": metric,
         "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
-        "config": f"{label}-decode-b{B}-p{prompt}-n{new}-greedy",
+        "config": label + _geometry(B, prompt, new),
         "total_s": round(best, 3), "compile_s": round(compile_s, 1),
         "per_token_ms": round(1e3 * best / new, 2),
         "device": str(dev.platform),

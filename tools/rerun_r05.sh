@@ -111,6 +111,10 @@ gate "8. decode"
 echo "=== 8. decode throughput (device-side while_loop) ==="
 run_step 08-decode 1800 python tools/bench_decode.py
 
+gate "8b. decode B32"
+echo "=== 8b. decode batch probe (B=32 — decode is memory-bound, batch amortizes the weight streaming) ==="
+BENCH_BATCH=32 run_step 08b-decode-b32 1800 python tools/bench_decode.py
+
 gate "9. bert B64"
 echo "=== 9. bert B64 batch probe ==="
 BENCH_BATCH=64 BENCH_NO_CPU_FALLBACK=1 run_step 09-bert-b64 900 python bench.py --model bert
