@@ -52,15 +52,36 @@ _EXTRA_FLAGS = {
 }
 
 
-def _build(name: str, src_path: str, out_path: str) -> None:
+def _sanitize_mode() -> str:
+    """Sanitizer build mode for the native components — the TPU-native
+    equivalent of the reference's cmake ``SANITIZER_TYPE`` option
+    (reference CMakeLists.txt:270: Address/Thread/Undefined/...).
+    ``PADDLE_TPU_SANITIZE=address|thread|undefined`` builds the .so with
+    the matching -fsanitize instrumentation into a mode-suffixed file
+    (the -O2 production .so is never reused for a sanitizer run, and
+    vice versa). Loading an instrumented .so into a stock CPython needs
+    the sanitizer runtime preloaded — see tests/test_native_sanitize.py
+    for the LD_PRELOAD recipe."""
+    mode = os.environ.get("PADDLE_TPU_SANITIZE", "").strip()
+    allowed = ("", "address", "thread", "undefined")
+    if mode not in allowed:
+        raise ValueError(
+            f"PADDLE_TPU_SANITIZE={mode!r}: expected one of "
+            f"{[m for m in allowed if m]} (lowercase)")
+    return mode
+
+
+def _build(name: str, src_path: str, out_path: str, san: str = "") -> None:
     os.makedirs(_LIB, exist_ok=True)
     # Build into a temp file then atomically rename, so concurrent
     # processes never dlopen a half-written .so.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB)
     os.close(fd)
     extra = _EXTRA_FLAGS.get(name)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           src_path, "-o", tmp] + (extra() if extra else [])
+    san_flags = ([f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer",
+                  "-O1"] if san else ["-O2"])
+    cmd = ["g++", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           *san_flags, src_path, "-o", tmp] + (extra() if extra else [])
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
@@ -74,17 +95,23 @@ def _build(name: str, src_path: str, out_path: str) -> None:
 
 def load_library(name: str) -> ctypes.CDLL:
     """Compile (if needed) and dlopen the native component ``name``."""
+    san = _sanitize_mode()
+    key = (name, san)
     with _lock:
-        if name in _cache:
-            return _cache[name]
+        if key in _cache:
+            return _cache[key]
         src_path = os.path.join(_SRC, f"{name}.cc")
         if not os.path.exists(src_path):
             raise FileNotFoundError(f"no native source for '{name}' "
                                     f"({src_path})")
-        out_path = os.path.join(_LIB, f"lib{name}.so")
+        suffix = f".{san}.so" if san else ".so"
+        out_path = os.path.join(_LIB, f"lib{name}{suffix}")
         if (not os.path.exists(out_path)
                 or os.path.getmtime(out_path) < os.path.getmtime(src_path)):
-            _build(name, src_path, out_path)
+            # pass the resolved mode: flags and filename must come from
+            # the SAME read (a mislabeled cached .so would silently
+            # report "clean" in every future sanitizer run)
+            _build(name, src_path, out_path, san=san)
         lib = ctypes.CDLL(out_path)
-        _cache[name] = lib
+        _cache[key] = lib
         return lib
