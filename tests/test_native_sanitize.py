@@ -56,11 +56,10 @@ def _run_driver(mode, runtime, driver, extra_env=None):
     assert "OK_DONE" in r.stdout, r.stdout[-2000:]
 
 
-@pytest.mark.slow
-def test_shm_ring_under_asan(tmp_path):
-    """shm_ring push/pop/wraparound under AddressSanitizer: any
-    heap/shm overflow or use-after-free in the ring aborts the driver."""
-    driver = """
+# one shm_ring driver template shared by the ASan and UBSan tests so the
+# C ABI bindings can never drift between the two; parameterized by shm
+# name and traffic shape
+_SHM_RING_DRIVER = """
 import ctypes
 lib = native.load_library('shm_ring')
 lib.pd_shm_ring_create.restype = ctypes.c_void_p
@@ -73,25 +72,102 @@ lib.pd_shm_ring_pop.argtypes = [ctypes.c_void_p,
                                 ctypes.c_double]
 lib.pd_shm_ring_close.argtypes = [ctypes.c_void_p]
 lib.pd_shm_ring_free_buf.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-name = b'/pd_san_ring_%d' % os.getpid()
+name = b'/pd_%(tag)s_ring_%%d' %% os.getpid()
 ring = lib.pd_shm_ring_create(name, 1 << 12, 1)
 assert ring
 # enough traffic to wrap the 4 KiB ring several times
-for i in range(64):
-    payload = bytes([i & 0xFF]) * (200 + 13 * (i % 7))
+for i in range(%(iters)d):
+    payload = bytes([i & 0xFF]) * (%(base)d + %(step)d * (i %% %(mod)d))
     buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
     rc = lib.pd_shm_ring_push(ring, buf, len(payload), 5.0)
     assert rc == 0, rc
     out = ctypes.POINTER(ctypes.c_uint8)()
     n = lib.pd_shm_ring_pop(ring, ctypes.byref(out), 5.0)
     assert n == len(payload), (n, len(payload))
-    got = bytes(out[:n])
-    assert got == payload
+    assert bytes(out[:n]) == payload
     lib.pd_shm_ring_free_buf(out)
 lib.pd_shm_ring_close(ring)
 print('OK_DONE')
 """
+
+
+@pytest.mark.slow
+def test_shm_ring_under_asan():
+    """shm_ring push/pop/wraparound under AddressSanitizer: any
+    heap/shm overflow or use-after-free in the ring aborts the driver."""
+    driver = _SHM_RING_DRIVER % dict(tag="san", iters=64, base=200,
+                                     step=13, mod=7)
     _run_driver("address", "libasan.so", driver)
+
+
+@pytest.mark.slow
+def test_ps_table_under_asan(tmp_path):
+    """ps_table (the largest native component: fused-optimizer sparse +
+    dense tables and the file-backed SSD table with its hot-row cache
+    eviction) under AddressSanitizer."""
+    driver = """
+import ctypes
+lib = native.load_library('ps_table')
+u64p = ctypes.POINTER(ctypes.c_uint64)
+f32p = ctypes.POINTER(ctypes.c_float)
+lib.pd_ps_sparse_create.restype = ctypes.c_void_p
+lib.pd_ps_sparse_create.argtypes = [ctypes.c_int, ctypes.c_int,
+    ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+    ctypes.c_float, ctypes.c_uint64]
+lib.pd_ps_sparse_free.argtypes = [ctypes.c_void_p]
+lib.pd_ps_sparse_pull.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p]
+lib.pd_ps_sparse_push.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p]
+lib.pd_ps_sparse_size.restype = ctypes.c_int64
+lib.pd_ps_sparse_size.argtypes = [ctypes.c_void_p]
+lib.pd_ps_file_create.restype = ctypes.c_void_p
+lib.pd_ps_file_create.argtypes = [ctypes.c_int, ctypes.c_int,
+    ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+    ctypes.c_float, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int64]
+lib.pd_ps_file_free.argtypes = [ctypes.c_void_p]
+lib.pd_ps_file_pull.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p]
+lib.pd_ps_file_push.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p]
+lib.pd_ps_file_mem_rows.restype = ctypes.c_int64
+lib.pd_ps_file_mem_rows.argtypes = [ctypes.c_void_p]
+
+DIM, N = 8, 32
+t = lib.pd_ps_sparse_create(DIM, 2, 0.01, 0.9, 0.999, 1e-8, 0.1, 7)  # adam
+assert t
+keys = (ctypes.c_uint64 * N)(*range(100, 100 + N))
+vals = (ctypes.c_float * (N * DIM))()
+lib.pd_ps_sparse_pull(t, keys, N, vals)           # creates rows
+grads = (ctypes.c_float * (N * DIM))(*([0.5] * (N * DIM)))
+for _ in range(4):
+    lib.pd_ps_sparse_push(t, keys, N, grads)      # adam state updates
+lib.pd_ps_sparse_pull(t, keys, N, vals)
+assert lib.pd_ps_sparse_size(t) == N
+lib.pd_ps_sparse_free(t)
+
+path = os.path.join(os.environ['PD_SAN_TMP'], 'ssd_table')
+# max_mem_rows=8 << 32 keys forces hot-row cache eviction to disk
+ft = lib.pd_ps_file_create(DIM, 0, 0.1, 0.9, 0.999, 1e-8, 0.1, 7,
+                           path.encode(), 8)
+assert ft
+lib.pd_ps_file_pull(ft, keys, N, vals)
+lib.pd_ps_file_push(ft, keys, N, grads)
+lib.pd_ps_file_pull(ft, keys, N, vals)            # re-faults evicted rows
+assert lib.pd_ps_file_mem_rows(ft) <= 8
+lib.pd_ps_file_free(ft)
+print('OK_DONE')
+"""
+    _run_driver("address", "libasan.so", driver,
+                extra_env={"PD_SAN_TMP": str(tmp_path)})
+
+
+@pytest.mark.slow
+def test_shm_ring_under_ubsan():
+    """shm_ring under UndefinedBehaviorSanitizer (misaligned access,
+    overflow in the index arithmetic) — completes the documented
+    address|thread|undefined matrix."""
+    driver = _SHM_RING_DRIVER % dict(tag="ubsan", iters=32, base=64,
+                                     step=31, mod=5)
+    _run_driver("undefined", "libubsan.so", driver,
+                extra_env={"UBSAN_OPTIONS":
+                           "halt_on_error=1,print_stacktrace=1"})
 
 
 @pytest.mark.slow
