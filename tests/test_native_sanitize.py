@@ -100,12 +100,7 @@ def test_shm_ring_under_asan():
     _run_driver("address", "libasan.so", driver)
 
 
-@pytest.mark.slow
-def test_ps_table_under_asan(tmp_path):
-    """ps_table (the largest native component: fused-optimizer sparse +
-    dense tables and the file-backed SSD table with its hot-row cache
-    eviction) under AddressSanitizer."""
-    driver = """
+_PS_TABLE_DRIVER = """
 import ctypes
 lib = native.load_library('ps_table')
 u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -154,8 +149,20 @@ assert lib.pd_ps_file_mem_rows(ft) <= 8
 lib.pd_ps_file_free(ft)
 print('OK_DONE')
 """
-    _run_driver("address", "libasan.so", driver,
-                extra_env={"PD_SAN_TMP": str(tmp_path)})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode,runtime,opts", [
+    ("address", "libasan.so", {}),
+    ("undefined", "libubsan.so",
+     {"UBSAN_OPTIONS": "halt_on_error=1,print_stacktrace=1"}),
+])
+def test_ps_table_under_sanitizers(tmp_path, mode, runtime, opts):
+    """ps_table (the largest native component: fused-optimizer sparse +
+    dense tables and the file-backed SSD table with its hot-row cache
+    eviction) under ASan and UBSan."""
+    _run_driver(mode, runtime, _PS_TABLE_DRIVER,
+                extra_env={"PD_SAN_TMP": str(tmp_path), **opts})
 
 
 @pytest.mark.slow
@@ -170,13 +177,7 @@ def test_shm_ring_under_ubsan():
                            "halt_on_error=1,print_stacktrace=1"})
 
 
-@pytest.mark.slow
-def test_tcp_store_under_tsan():
-    """tcp_store server + concurrent clients under ThreadSanitizer: the
-    server's per-connection threads, the condvar wait/notify path and the
-    counter all get raced from two client threads; any data race fails
-    the subprocess."""
-    driver = """
+_TCP_STORE_DRIVER = """
 import ctypes, threading
 lib = native.load_library('tcp_store')
 lib.pd_store_server_start.restype = ctypes.c_void_p
@@ -227,4 +228,16 @@ lib.pd_store_client_free(c)
 lib.pd_store_server_stop(srv)
 print('OK_DONE')
 """
-    _run_driver("thread", "libtsan.so", driver)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode,runtime", [
+    ("thread", "libtsan.so"),
+    ("address", "libasan.so"),
+])
+def test_tcp_store_under_sanitizers(mode, runtime):
+    """tcp_store server + concurrent clients under ThreadSanitizer: the
+    server's per-connection threads, the condvar wait/notify path and the
+    counter all get raced from two client threads; any data race fails
+    the subprocess."""
+    _run_driver(mode, runtime, _TCP_STORE_DRIVER)
