@@ -47,8 +47,16 @@ def _log(msg):
 
 
 def _emit(record: dict):
-    """Print the driver JSON line AND persist it to the round notes file."""
+    """Print the driver JSON line AND persist it to the round notes file.
+    Exception: a plain-CPU row that is NOT a declared fallback (smoke
+    runs — BENCH_SMALL or a box whose jax lands on CPU) prints but never
+    persists, so validation smokes can't pollute the evidence file.
+    Genuine `_reexec_cpu_fallback` rows carry ``cpu_fallback: true`` and
+    DO persist: they are the round's only machine-readable trail when
+    the wedge also eats the driver's stdout (the r2 failure mode)."""
     print(json.dumps(record), flush=True)
+    if record.get("device") == "cpu" and not record.get("cpu_fallback"):
+        return
     try:
         record = dict(record)
         record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")

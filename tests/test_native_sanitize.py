@@ -236,8 +236,9 @@ print('OK_DONE')
     ("address", "libasan.so"),
 ])
 def test_tcp_store_under_sanitizers(mode, runtime):
-    """tcp_store server + concurrent clients under ThreadSanitizer: the
-    server's per-connection threads, the condvar wait/notify path and the
-    counter all get raced from two client threads; any data race fails
-    the subprocess."""
+    """tcp_store server + concurrent clients under TSan and ASan: the
+    server's per-connection threads, the condvar wait/notify path and
+    the counter all get raced from two client threads — TSan fails the
+    subprocess on any data race, ASan on any heap error in the
+    connection handling."""
     _run_driver(mode, runtime, _TCP_STORE_DRIVER)
