@@ -53,7 +53,11 @@ def _emit(record: dict):
     persists, so validation smokes can't pollute the evidence file.
     Genuine `_reexec_cpu_fallback` rows carry ``cpu_fallback: true`` and
     DO persist: they are the round's only machine-readable trail when
-    the wedge also eats the driver's stdout (the r2 failure mode)."""
+    the wedge also eats the driver's stdout (the r2 failure mode). A
+    dev box with no tunnel also appends (honest, labeled) fallback rows
+    through that path — accepted: the wedge-resilience trail is worth
+    more than a perfectly smoke-free file, and the digest/replay both
+    filter on device anyway."""
     print(json.dumps(record), flush=True)
     if record.get("device") == "cpu" and not record.get("cpu_fallback"):
         return

@@ -1,0 +1,127 @@
+"""Tests for bench.py's official-artifact machinery: the escalation
+ladder (headline-first, OOM-rung drop, CPU-fallback stop, best-row
+selection) and the banked-row replay that protects the driver artifact
+when the tunnel is wedged. These paths decide what BENCH_r0N.json says —
+they were previously exercised only on scarce silicon windows.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # never touch a real backend from these tests
+    monkeypatch.setattr(mod, "_probe_backend_subprocess",
+                        lambda *a, **k: True)
+    return mod
+
+
+def _row(config, value, device="tpu", **kw):
+    return dict(metric="gpt13_tokens_per_sec_per_chip", value=value,
+                unit="tokens/s", config=config, device=device, **kw)
+
+
+def test_ladder_picks_best_and_reports_all_rungs(bench, monkeypatch, capsys):
+    results = {
+        "ladder[b4-fce]": _row("b4", 12666.3),
+        "ladder[b2-fce]": _row("b2", 11000.0),
+        "ladder[b8-fce]": _row("b8", 11851.6),
+        "ladder[b8-dots-fce]": _row("b8d", 11633.6),
+        "ladder[b8-fce-bq512]": _row("b8q", 11499.6),
+        "ladder[b2-s2048-fce]": _row("b2s", 9000.0),
+    }
+    monkeypatch.setattr(
+        bench, "_launch_banked",
+        lambda desc, cmd, budget, overrides:
+            (0, json.dumps(results[desc]) + "\n", ""))
+    assert bench._run_ladder("gpt13") is True
+    out = capsys.readouterr().out.strip().splitlines()
+    best = json.loads(out[-1])
+    assert best["value"] == 12666.3          # headline = max tokens/s
+    assert len(best["ladder"]) == 6          # every rung recorded
+
+
+def test_ladder_drops_failed_rung_keeps_going(bench, monkeypatch, capsys):
+    """An OOM (rc!=0) in a lever rung must not cost the round's number —
+    the r2 failure this design exists to prevent."""
+    def launch(desc, cmd, budget, overrides):
+        if desc == "ladder[b2-fce]":
+            return (1, "", "RESOURCE_EXHAUSTED")
+        return (0, json.dumps(_row(desc, 10000.0)) + "\n", "")
+    monkeypatch.setattr(bench, "_launch_banked", launch)
+    assert bench._run_ladder("gpt13") is True
+    best = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(best["ladder"]) == 5          # failed rung dropped
+
+
+def test_ladder_stops_on_cpu_fallback_rung(bench, monkeypatch, capsys):
+    """A rung that fell back to CPU means the tunnel died: stop instead
+    of burning the remaining rungs' budgets."""
+    calls = []
+
+    def launch(desc, cmd, budget, overrides):
+        calls.append(desc)
+        dev = "tpu" if len(calls) == 1 else "cpu"
+        return (0, json.dumps(_row(desc, 5000.0, device=dev)) + "\n", "")
+    monkeypatch.setattr(bench, "_launch_banked", launch)
+    assert bench._run_ladder("gpt13") is True    # first rung banked
+    assert len(calls) == 2                       # stopped at the cpu rung
+
+
+def test_ladder_returns_false_when_nothing_lands(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_launch_banked",
+                        lambda *a: (1, "", "boom"))
+    assert bench._run_ladder("gpt13") is False
+
+
+def test_replay_picks_best_tpu_row_with_provenance(bench, monkeypatch,
+                                                   tmp_path, capsys):
+    notes = tmp_path / "notes.json"
+    rows = [
+        _row("b8", 11851.6, ts="t1"),
+        _row("b4", 12666.3, ts="t2"),
+        _row("cpu-small", 900.0, device="cpu"),          # never replayed
+        _row("fallback", 950.0, cpu_fallback=True),      # never replayed
+        dict(metric="gpt13_decode_tokens_per_sec_per_chip",
+             value=99999.0, device="tpu"),               # decode excluded
+    ]
+    notes.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    monkeypatch.setattr(bench, "_NOTES_PATH", str(notes))
+    for k in ("BENCH_BATCH", "BENCH_FUSED_CE", "BENCH_RECOMPUTE",
+              "BENCH_SEQ", "BENCH_SMALL", "BENCH_STEPS"):
+        monkeypatch.delenv(k, raising=False)
+    assert bench._replay_banked_tpu_row("gpt13") is True
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 12666.3
+    assert rec["replayed_from_notes"] is True
+    assert "t2" in rec["note"]
+
+
+def test_replay_refuses_custom_config_runs(bench, monkeypatch, tmp_path):
+    """A custom-knob run must never be satisfied by a banked row for a
+    different config."""
+    notes = tmp_path / "notes.json"
+    notes.write_text(json.dumps(_row("b4", 12666.3)) + "\n")
+    monkeypatch.setattr(bench, "_NOTES_PATH", str(notes))
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    assert bench._replay_banked_tpu_row("gpt13") is False
+
+
+def test_replay_false_when_no_tpu_row(bench, monkeypatch, tmp_path):
+    notes = tmp_path / "notes.json"
+    notes.write_text(json.dumps(_row("x", 1.0, device="cpu")) + "\n")
+    monkeypatch.setattr(bench, "_NOTES_PATH", str(notes))
+    for k in ("BENCH_BATCH", "BENCH_FUSED_CE", "BENCH_RECOMPUTE",
+              "BENCH_SEQ", "BENCH_SMALL", "BENCH_STEPS"):
+        monkeypatch.delenv(k, raising=False)
+    assert bench._replay_banked_tpu_row("gpt13") is False
