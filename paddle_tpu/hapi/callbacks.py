@@ -6,13 +6,14 @@ from __future__ import annotations
 import numbers
 import os
 import time
+import warnings
 from typing import List, Optional
 
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "CallbackList", "config_callbacks", "VisualDL",
-           "WandbCallback"]
+           "LRScheduler", "ReduceLROnPlateau", "CallbackList",
+           "config_callbacks", "VisualDL", "WandbCallback"]
 
 
 class Callback:
@@ -171,6 +172,79 @@ class EarlyStopping(Callback):
             if self.verbose:
                 print(f"Early stopping at epoch {self.stopped_epoch}",
                       flush=True)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer's learning rate when a monitored eval metric
+    stops improving (reference: hapi/callbacks.py ReduceLROnPlateau —
+    monitor/factor/patience/cooldown/min_lr semantics, 'auto' mode
+    inferring max for 'acc'-like monitors)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = lambda a, b: np.less(a, b - self.min_delta)
+            self._init_best = np.inf
+        else:
+            self.monitor_op = lambda a, b: np.greater(a, b + self.min_delta)
+            self._init_best = -np.inf
+        self.best_value = self._init_best
+        self.wait_epoch = 0
+        self.cooldown_counter = 0
+
+    def on_train_begin(self, logs=None):
+        self.best_value = self._init_best
+        self.wait_epoch = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait_epoch = 0
+        if self.monitor_op(current, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+        elif self.cooldown_counter <= 0:
+            self.wait_epoch += 1
+            if self.wait_epoch >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                if not isinstance(opt._learning_rate, (int, float)):
+                    # reference behavior: warn and skip when the lr is a
+                    # scheduler (set_lr would raise mid-fit otherwise)
+                    warnings.warn(
+                        "ReduceLROnPlateau expects a float learning rate; "
+                        f"got {type(opt._learning_rate).__name__} — "
+                        "skipping the reduction")
+                    return
+                old_lr = opt.get_lr()
+                if old_lr > self.min_lr:
+                    new_lr = max(old_lr * self.factor, self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: reducing learning rate "
+                              f"to {new_lr:.6g}", flush=True)
+                self.cooldown_counter = self.cooldown
+                self.wait_epoch = 0
 
 
 class LRScheduler(Callback):

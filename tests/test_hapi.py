@@ -89,6 +89,41 @@ def test_early_stopping():
     assert model.stop_training  # converged long before 50 epochs
 
 
+def test_reduce_lr_on_plateau():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    model = _model()
+    train, val = ToyClassification(64, 0), ToyClassification(32, 1)
+    lr0 = model._optimizer.get_lr()
+    # min mode + an impossible threshold: every epoch is a "plateau",
+    # so with patience=1 the LR must be reduced during the run
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           min_delta=1e9, verbose=0)
+    model.fit(train, val, batch_size=16, epochs=4, verbose=0, callbacks=[cb])
+    assert model._optimizer.get_lr() < lr0
+    # factor >= 1 is rejected like the reference
+    with pytest.raises(ValueError):
+        ReduceLROnPlateau(factor=1.0)
+    # an LRScheduler-driven optimizer warns and skips instead of crashing
+    sched_model = _model()
+    sched_model._optimizer._learning_rate = \
+        pt.optimizer.lr.StepDecay(0.01, step_size=10)
+    cb2 = ReduceLROnPlateau(monitor="loss", patience=0, min_delta=1e9,
+                            verbose=0)
+    with pytest.warns(UserWarning, match="float learning rate"):
+        sched_model.fit(train, val, batch_size=16, epochs=2, verbose=0,
+                        callbacks=[cb2])
+
+
+def test_paddle_callbacks_namespace_exports():
+    import paddle_tpu as paddle
+
+    for name in ("Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+                 "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+                 "WandbCallback"):
+        assert hasattr(paddle.callbacks, name), name
+
+
 def test_train_batch_and_summary():
     model = _model()
     ds = ToyClassification(16, 0)

@@ -34,6 +34,9 @@ def _ref_all(path):
     (f"{R}/device/__init__.py", "device"),
     (f"{R}/distribution/__init__.py", "distribution"),
     (f"{R}/sparse/__init__.py", "sparse"),
+    # r5 session 3: this namespace was the one facade the gate missed —
+    # VisualDL/WandbCallback/ReduceLROnPlateau were absent until added
+    (f"{R}/callbacks.py", "callbacks"),
 ])
 def test_namespace_parity(ref, mod_path):
     mod = paddle
