@@ -257,3 +257,8 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._prev)
         return False
+
+# submodules matching the reference layout: CPU-build-semantics facades
+# (device_count()==0 / clear not-on-this-build errors) — the TPU device's
+# real streams/events/memory APIs live on this module directly
+from . import cuda, xpu  # noqa: E402,F401

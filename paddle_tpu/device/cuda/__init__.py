@@ -1,0 +1,85 @@
+"""paddle.device.cuda (reference: python/paddle/device/cuda/__init__.py).
+
+This is the TPU-native build: no CUDA runtime exists, so this module
+carries the reference's CPU-build semantics — ``device_count() == 0``,
+memory queries return 0, and operations that require a CUDA device
+raise a clear error naming the build. The TPU equivalents live on
+``paddle.device`` (streams/events/synchronize over the PJRT device).
+"""
+import contextlib
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+]
+
+_ERR = ("Cannot use CUDA on this build: paddle-tpu is compiled without "
+        "CUDA (TPU-native; the device layer is PJRT). Use paddle.device "
+        "APIs for the TPU device.")
+
+
+def device_count() -> int:
+    """Number of CUDA devices — always 0 on the TPU-native build."""
+    return 0
+
+
+def empty_cache() -> None:
+    """No-op (reference CPU-build behavior: nothing to release)."""
+
+
+def memory_allocated(device=None) -> int:
+    return 0
+
+
+def memory_reserved(device=None) -> int:
+    return 0
+
+
+def max_memory_allocated(device=None) -> int:
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    return 0
+
+
+def synchronize(device=None):
+    raise ValueError(_ERR)
+
+
+def current_stream(device=None):
+    raise ValueError(_ERR)
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    raise ValueError(_ERR)
+    yield  # pragma: no cover
+
+
+def get_device_properties(device=None):
+    raise ValueError(_ERR)
+
+
+def get_device_name(device=None):
+    raise ValueError(_ERR)
+
+
+def get_device_capability(device=None):
+    raise ValueError(_ERR)
+
+
+class Stream:
+    """CUDA stream handle — unavailable on the TPU-native build."""
+
+    def __init__(self, *a, **k):
+        raise ValueError(_ERR)
+
+
+class Event:
+    """CUDA event handle — unavailable on the TPU-native build."""
+
+    def __init__(self, *a, **k):
+        raise ValueError(_ERR)

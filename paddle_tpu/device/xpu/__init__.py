@@ -1,0 +1,10 @@
+"""paddle.device.xpu (reference: python/paddle/device/xpu/__init__.py —
+__all__ = ['synchronize']). No XPU on the TPU-native build."""
+__all__ = ["synchronize"]
+
+
+def synchronize(device=None):
+    raise ValueError(
+        "Cannot use XPU on this build: paddle-tpu is compiled without "
+        "XPU (TPU-native; the device layer is PJRT). Use paddle.device "
+        "APIs for the TPU device.")

@@ -13,8 +13,8 @@ import functools as _functools
 from ... import collective as _c
 
 __all__ = ["all_gather", "all_reduce", "alltoall", "all_to_all",
-           "broadcast", "gather", "recv", "reduce", "reduce_scatter",
-           "scatter", "send"]
+           "alltoall_single", "broadcast", "gather", "recv", "reduce",
+           "reduce_scatter", "scatter", "send"]
 
 
 def _stream_variant(fn):
@@ -29,6 +29,19 @@ all_gather = _stream_variant(_c.all_gather)
 all_reduce = _stream_variant(_c.all_reduce)
 alltoall = _stream_variant(_c.alltoall)
 all_to_all = alltoall
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    """reference stream signature is (out_tensor, in_tensor, ...) —
+    the REVERSE of the non-stream paddle.distributed.alltoall_single
+    (in_tensor first); delegate with the order swapped so
+    reference-written calls land the result in out_tensor."""
+    from ...misc import alltoall_single as _fn
+
+    return _fn(in_tensor, out_tensor, in_split_sizes=in_split_sizes,
+               out_split_sizes=out_split_sizes, group=group)
 broadcast = _stream_variant(_c.broadcast)
 gather = _stream_variant(_c.gather)
 recv = _stream_variant(_c.recv)

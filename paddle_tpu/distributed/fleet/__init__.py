@@ -26,9 +26,20 @@ from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pipeline_schedule import StackedPipelineBlocks, pipeline_apply  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import utils  # noqa: F401
+from . import data_generator  # noqa: F401
+from ..topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .utils import DistributedInfer, UtilBase  # noqa: F401
 
 __all__ = [
-    "utils",
+    "utils", "data_generator",
     "init", "fleet", "Fleet", "DistributedStrategy", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
@@ -36,6 +47,10 @@ __all__ = [
     "PipelineParallel", "StackedPipelineBlocks", "pipeline_apply",
     "recompute", "recompute_sequential",
     "worker_index", "worker_num",
+    "CommunicateTopology", "HybridCommunicateGroup", "UtilBase",
+    "Role", "UserDefinedRoleMaker", "PaddleCloudRoleMaker",
+    "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+    "DistributedInfer",
 ]
 
 
@@ -77,11 +92,26 @@ class Fleet:
         self._hcg: Optional[topology.HybridCommunicateGroup] = None
         self._strategy: Optional[DistributedStrategy] = None
         self._is_initialized = False
+        self._role_maker = None
+        self._util = None
+
+    @property
+    def util(self):
+        """reference: fleet.util — ONE cached UtilBase (util_factory
+        caches it in the reference, so state set through it persists);
+        init() rebinds its role maker."""
+        if self._util is None:
+            self._util = UtilBase(self._role_maker)
+        return self._util
 
     def init(self, role_maker=None, is_collective: bool = True, strategy=None,
              log_level="INFO"):
         """reference: fleet.py:168 — env bootstrap + HybridCommunicateGroup.
         Degrees with value -1 absorb remaining devices (dp by default)."""
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        if self._util is not None:
+            self._util._set_role_maker(self._role_maker)
         init_parallel_env(mesh_axes={})  # multi-host rendezvous only; mesh below
         self._strategy = strategy or DistributedStrategy()
         hc = dict(self._strategy.hybrid_configs)

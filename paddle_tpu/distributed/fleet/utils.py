@@ -24,8 +24,96 @@ __all__ = [
     "ExecuteError", "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
     "FS", "LocalFS", "HDFSClient", "get_logger", "logger",
     "broadcast_mp_parameters", "broadcast_dp_parameters",
-    "fused_allreduce_gradients", "recompute",
+    "fused_allreduce_gradients", "recompute", "UtilBase",
+    "DistributedInfer",
 ]
+
+
+class UtilBase:
+    """Fleet utility facade (reference: fleet/base/util_factory.py:49 —
+    all_reduce/barrier/all_gather over the worker world + file sharding).
+    The collective methods delegate to the mesh collectives; comm_world
+    selection ('worker'/'server'/'all') is a PS-era concept — the worker
+    world IS the mesh here, and server-side reduction runs in the PS
+    tables (distributed/ps)."""
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """Host-side reduce over the worker world (the reference runs
+        this over gloo, not the training fabric): gather everyone's
+        value, reduce locally."""
+        import numpy as _np
+
+        from .. import all_gather_object
+
+        vals = []
+        all_gather_object(vals, _np.asarray(input))
+        fn = {"sum": _np.sum, "max": _np.max, "min": _np.min}[mode]
+        return fn(_np.stack(vals), axis=0)
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as _barrier
+
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import all_gather_object
+
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Contiguous near-even split of `files` for this worker
+        (reference: util_factory.py:232)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be"
+                            " read.")
+        if self.role_maker is not None:
+            trainer_id = self.role_maker._worker_index()
+            trainers = self.role_maker._worker_num()
+        else:
+            from .. import get_rank, get_world_size
+
+            trainer_id, trainers = get_rank(), max(get_world_size(), 1)
+        remainder = len(files) % trainers
+        blocksize = len(files) // trainers
+        blocks = [blocksize + (1 if i < remainder else 0)
+                  for i in range(trainers)]
+        start = sum(blocks[:trainer_id])
+        return files[start:start + blocks[trainer_id]]
+
+    def print_on_rank(self, message, rank_id):
+        from .. import get_rank
+
+        if get_rank() == rank_id:
+            print(message, flush=True)
+
+
+class DistributedInfer:
+    """PS-mode distributed inference helper (reference:
+    fleet/utils/ps_util.py DistributedInfer — pulls the sparse
+    parameters from the servers before running inference). Here sparse
+    params live in the native PS tables; ``init_distributed_infer_env``
+    triggers a pull into the local model and ``get_dist_infer_program``
+    returns the (unchanged) program — XLA owns program rewriting."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main_program = main_program
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        # sparse params are pulled lazily by SparseEmbedding's forward
+        # (distributed/ps/layers.py) — nothing to prefetch eagerly
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main_program
 
 
 # ------------------------------------------------------------------ fs

@@ -27,7 +27,8 @@ from ..optimizer.optimizer import Optimizer
 from . import topology
 from .sharding_api import shard_tensor
 
-__all__ = ["group_sharded_parallel", "shard_optimizer_state", "shard_model_params"]
+__all__ = ["group_sharded_parallel", "shard_optimizer_state",
+           "shard_model_params", "save_group_sharded_model"]
 
 
 def _sharding_axis(mesh) -> Optional[str]:
@@ -126,3 +127,26 @@ def group_sharded_parallel(model: Layer, optimizer: Optimizer, level: str = "os_
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model (+ optimizer state) to ``output``
+    (reference: distributed/sharding/group_sharded.py:179 —
+    model.pdmodel + model.pdopt in a directory). Sharded arrays are
+    global jax.Arrays here, so state_dict() already yields full tensors
+    — no gather pass is needed; rank 0 writes."""
+    import os
+
+    from .. import framework
+    from . import env as _env
+
+    assert not os.path.isfile(output), (
+        f"Saving directory ({output}) should be a directory, not a file")
+    os.makedirs(output, exist_ok=True)
+    if getattr(_env, "get_rank", lambda: 0)() != 0:
+        return
+    framework.io.save(model.state_dict(),
+                      os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        framework.io.save(optimizer.state_dict(),
+                          os.path.join(output, "model.pdopt"))

@@ -11,6 +11,7 @@ device arrays so the re-mask fuses into the compiled train step.
 from .asp import (  # noqa: F401
     ASPHelper,
     decorate,
+    add_supported_layer,
     prune_model,
     reset_excluded_layers,
     set_excluded_layers,
@@ -30,5 +31,6 @@ __all__ = [
     "calculate_density", "check_mask_1d", "get_mask_1d", "check_mask_2d",
     "get_mask_2d_greedy", "get_mask_2d_best", "create_mask",
     "check_sparsity", "decorate", "prune_model", "set_excluded_layers",
+    "add_supported_layer",
     "reset_excluded_layers", "ASPHelper",
 ]

@@ -21,7 +21,7 @@ import numpy as np
 from .utils import check_sparsity, create_mask
 
 __all__ = ["decorate", "prune_model", "set_excluded_layers",
-           "reset_excluded_layers", "ASPHelper"]
+           "reset_excluded_layers", "add_supported_layer", "ASPHelper"]
 
 
 class ASPHelper:
@@ -31,6 +31,7 @@ class ASPHelper:
     _excluded_param_names: set = set()
     # param uid -> (param, mask jnp array)
     _masks: Dict[int, tuple] = {}
+    _custom_pruning: Dict[str, object] = {}
 
     MASK_ALGO_MAP = {
         "mask_1d": "mask_1d",
@@ -55,16 +56,40 @@ class ASPHelper:
         masks: Dict[str, np.ndarray] = {}
         for name, p in model.named_parameters():
             v = np.asarray(p._value)
-            if not cls._is_supported_param(name, v):
+            if name in cls._excluded_param_names:  # exclusion always wins
+                continue
+            # an add_supported_layer registration makes the param
+            # prunable REGARDLESS of the default ndim filter (the
+            # reference's registered layers bypass supported_layer_list
+            # checks); match BEFORE the filter so custom shapes reach
+            # their pruning function. False = not registered; None =
+            # registered with the default pruning.
+            registered = next(
+                (fn for key, fn in cls._custom_pruning.items()
+                 if key in name), False)
+            if registered is False and not cls._is_supported_param(name, v):
                 continue
             # Prune along the reduction dim: Linear weights here are
             # [in, out] (y = x @ W), so mask groups run down the input
             # axis — transpose, mask rows, transpose back.
-            if v.ndim == 2:
+            if callable(registered):
+                # user pruning function (add_supported_layer): receives
+                # (weight, m, n, func_name, param_name), returns
+                # (pruned_weight, mask) like the reference's
+                pruned, mask = registered(v, m, n, mask_algo, name)
+                v = np.asarray(pruned)
+                mask = np.asarray(mask)
+            elif v.ndim == 2:
                 mask = create_mask(v.T, cls.MASK_ALGO_MAP[mask_algo],
                                    n, m).T
-            else:
+            elif v.ndim >= 3:
                 mask = create_mask(v, cls.MASK_ALGO_MAP[mask_algo], n, m)
+            else:
+                # registered-with-None 1-D param: default n:m over a
+                # last-dim view (the reference's _default_pruning path)
+                mask = create_mask(v.reshape(1, -1),
+                                   cls.MASK_ALGO_MAP[mask_algo],
+                                   n, m).reshape(v.shape)
             p._set_value(jnp.asarray(v * mask, p._value.dtype))
             masks[name] = mask
             if with_mask:
@@ -96,6 +121,22 @@ def set_excluded_layers(param_names: List[str], main_program=None) -> None:
 def reset_excluded_layers(main_program=None) -> None:
     """Clear the exclusion list (reference: asp.py:127)."""
     ASPHelper._excluded_param_names.clear()
+
+
+def add_supported_layer(layer, pruning_func=None) -> None:
+    """Register a layer (by name or Layer subclass) as prunable, with an
+    optional custom pruning function (reference:
+    incubate/asp/supported_layer_list.py:85). ``pruning_func`` receives
+    (weight, m, n, func_name, param_name) and returns
+    (pruned_weight, mask); with None the default n:m mask applies to
+    parameters whose name contains the registered name."""
+    if isinstance(layer, str):
+        name = layer
+    elif isinstance(layer, type):
+        name = layer.__name__.lower()
+    else:
+        name = type(layer).__name__.lower()
+    ASPHelper._custom_pruning[name] = pruning_func
 
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",

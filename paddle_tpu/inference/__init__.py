@@ -11,7 +11,159 @@ runs in its analysis pipeline), the predictor executes it through
 serving stacks link one shared library, mirroring
 ``libpaddle_inference_c``.
 """
+import enum
+
 from .config import Config
 from .predictor import InferTensor, Predictor, create_predictor
 
-__all__ = ["Config", "Predictor", "InferTensor", "create_predictor"]
+# reference's Tensor alias: paddle.inference.Tensor IS the zero-copy
+# handle class (pybind inference_api.cc ZeroCopyTensor binding)
+Tensor = InferTensor
+
+__all__ = ["Config", "Predictor", "InferTensor", "Tensor",
+           "create_predictor", "DataType", "PlaceType", "PrecisionType",
+           "get_version", "get_trt_compile_version",
+           "get_trt_runtime_version", "get_num_bytes_of_data_type",
+           "PredictorPool", "convert_to_mixed_precision",
+           "_get_phi_kernel_name"]
+
+
+# legacy fluid-op → phi-kernel renames the reference's TransToPhiKernelName
+# special-cases (phi/core/compat/convert_utils.cc); everything else maps
+# through unchanged
+_FLUID_TO_PHI = {
+    "matmul_v2": "matmul", "elementwise_add": "add",
+    "elementwise_sub": "subtract", "elementwise_mul": "multiply",
+    "elementwise_div": "divide", "reduce_sum": "sum", "reduce_mean": "mean",
+    "reduce_max": "max", "reduce_min": "min", "reduce_prod": "prod",
+    "fill_constant": "full", "flatten_contiguous_range": "flatten",
+}
+
+
+def _get_phi_kernel_name(fluid_op_name: str) -> str:
+    """reference: pybind inference_api.cc:502 → phi::TransToPhiKernelName
+    (legacy fluid op name → phi kernel name)."""
+    return _FLUID_TO_PHI.get(fluid_op_name, fluid_op_name)
+
+
+class DataType(enum.Enum):
+    """reference: pybind inference_api.cc:529 PaddleDType."""
+    FLOAT64 = 0
+    FLOAT32 = 1
+    FLOAT16 = 2
+    INT64 = 3
+    INT32 = 4
+    UINT8 = 5
+    INT8 = 6
+    BOOL = 7
+
+
+class PlaceType(enum.Enum):
+    """reference: pybind inference_api.cc:636 PaddlePlace. TPU rides the
+    CUSTOM slot (the reference's plug-in device path)."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    CUSTOM = 4
+
+
+class PrecisionType(enum.Enum):
+    """reference: pybind inference_api.cc:722 AnalysisConfig::Precision."""
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+def get_version() -> str:
+    """reference: inference_api.cc get_version — the inference runtime's
+    version string."""
+    from ..version import full_version
+
+    return f"paddle-tpu inference {full_version}"
+
+
+def get_trt_compile_version():
+    """reference: get_trt_compile_version. No TensorRT in the TPU build
+    (documented descope: XLA is the whole-graph compiler) — returns the
+    all-zero triple the reference returns when built without TRT."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    """reference: get_trt_runtime_version — all-zero without TRT."""
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """reference: inference_api.cc paddle_dtype_size."""
+    return {DataType.FLOAT64: 8, DataType.FLOAT32: 4, DataType.FLOAT16: 2,
+            DataType.INT64: 8, DataType.INT32: 4, DataType.UINT8: 1,
+            DataType.INT8: 1, DataType.BOOL: 1}[dtype]
+
+
+class PredictorPool:
+    """Pool of predictors over one Config for multi-threaded serving
+    (reference: paddle_infer::services::PredictorPool, pybind
+    inference_api.cc). Each slot is an independent Predictor — handles
+    must not be shared across threads; the compiled program cache is
+    shared process-wide by jax."""
+
+    def __init__(self, config, size: int = 1):
+        self._preds = [create_predictor(config) for _ in range(int(size))]
+
+    def retrieve(self, idx: int):
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, keep_io_types=True,
+                               black_list=frozenset()):
+    """Convert an exported fp32 model's STORED WEIGHTS to mixed precision
+    (reference: inference/wrapper.py:73). TPU redesign: the exported
+    artifact is a StableHLO program with a fixed compute signature —
+    XLA already fuses and schedules it — so this pass converts the
+    .pdiparams storage precision (halving artifact size/transfer for
+    Half/Bfloat16); the loader upcasts to the program signature at load.
+    For mixed-precision COMPUTE, export the model under
+    ``amp.auto_cast(dtype='bfloat16')`` — then the program itself is
+    bf16 and this pass can store weights to match. io dtypes are always
+    preserved (keep_io_types is the only supported mode)."""
+    import os
+    import pickle
+
+    import numpy as np
+
+    if not keep_io_types:
+        raise ValueError("keep_io_types=False is not supported: the "
+                         "exported StableHLO signature fixes io dtypes")
+    dt = {PrecisionType.Half: np.float16,
+          PrecisionType.Bfloat16: "bfloat16",
+          PrecisionType.Float32: np.float32}.get(mixed_precision)
+    if dt is None:
+        raise ValueError(f"unsupported mixed_precision {mixed_precision!r}")
+    import jax.numpy as jnp
+
+    target = jnp.bfloat16 if dt == "bfloat16" else dt
+    with open(params_file, "rb") as f:
+        params = pickle.load(f)
+
+    def _cast(v):
+        arr = np.asarray(v)
+        if arr.dtype in (np.float32, np.float64):
+            return np.asarray(arr, dtype=target)
+        return arr
+
+    casted = {k: _cast(v) for k, v in params.items()}
+    for d in (os.path.dirname(mixed_model_file),
+              os.path.dirname(mixed_params_file)):
+        if d:
+            os.makedirs(d, exist_ok=True)
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(casted, f)
+    # the program artifact is dtype-agnostic at the interface; copy it
+    with open(model_file, "rb") as src, open(mixed_model_file, "wb") as dst:
+        dst.write(src.read())

@@ -182,9 +182,18 @@ class TranslatedLayer(Layer):
         self._exported = exported
         self._out_spec = out_spec
         self._param_names = list(params.keys())
+        # storage precision may differ from the program signature (e.g.
+        # inference.convert_to_mixed_precision stores fp16/bf16 weights):
+        # cast each param to its exported aval dtype — dict pytrees
+        # flatten in sorted-key order, so avals[i] pairs with sorted(params)[i]
+        avals = list(exported.in_avals)
+        want = {n: avals[i].dtype for i, n in enumerate(sorted(params))}
         for flat_name, value in params.items():
             safe = flat_name.replace(".", "__")
-            self.add_parameter(safe, Parameter(jnp.asarray(value)))
+            arr = jnp.asarray(value)
+            if arr.dtype != want[flat_name]:
+                arr = arr.astype(want[flat_name])
+            self.add_parameter(safe, Parameter(arr))
 
     def forward(self, *inputs):
         params = {

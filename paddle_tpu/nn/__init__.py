@@ -5,6 +5,7 @@ reference parity: python/paddle/nn/__init__.py (layer classes exported flat,
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from . import utils  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer_base import Layer  # noqa: F401

@@ -20,7 +20,8 @@ from ...generator import default_generator
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+    "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
+    "set_global_initializer",
 ]
 
 _global_weight_init = None
@@ -206,6 +207,29 @@ class Dirac(Initializer):
             for i in range(min_c):
                 idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
                 value[idx] = 1.0
+        return jnp.asarray(value, dtype=dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference:
+    nn/initializer/Bilinear.py — weight[c_out, c_in, k, k] where each
+    [k, k] slice is the separable bilinear interpolation kernel)."""
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("the length of shape must be 4.")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        size = shape[3]
+        f = np.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = np.arange(size)
+        k1d = 1 - np.abs(x / f - c)
+        kernel = np.outer(k1d, k1d).astype(np.float32)   # [k, k]
+        value = np.broadcast_to(kernel, shape)
         return jnp.asarray(value, dtype=dtype)
 
 

@@ -1,0 +1,27 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant/__init__.py —
+__all__ = ['Stub']).
+
+``Stub`` is an identity placeholder marking where a functional API's
+input should be observed/quantized: QAT/PTQ replace it with the
+configured observer/quanter (reference nn/quant/stub.py:19). Here the
+stub holds an optional observer directly — ``quantize`` passes activation
+observers through sublayer replacement, and an un-quantized model runs
+it as identity.
+"""
+from ..layer_base import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """Identity placeholder for quantization insertion points
+    (reference: nn/quant/stub.py Stub)."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, input):
+        if self._observer is not None:
+            return self._observer(input)
+        return input

@@ -20,7 +20,7 @@ __all__ = [
     "logit", "mv", "floor_mod", "multiplex", "real", "imag", "conj",
     "rad2deg", "deg2rad", "gcd", "lcm", "count_nonzero", "increment",
     "scatter_nd", "reverse", "add_n", "angle", "renorm", "nan_to_num",
-    "heaviside", "index_add", "sgn", "take", "frexp", "trapezoid",
+    "heaviside", "index_add", "index_add_", "sgn", "take", "frexp", "trapezoid",
     "cumulative_trapezoid", "polar", "vander", "broadcast_tensors",
     "broadcast_shape", "is_complex", "is_integer", "is_floating_point",
     "rank", "shape", "tolist", "tanh_", "reshape_", "unsqueeze_",
@@ -189,6 +189,14 @@ def index_add(x, index, axis, value, name=None):
         lambda v, ix, val: _index_add_impl(v, ix, axis, val),
         [ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)],
         name="index_add")
+
+
+def index_add_(x, index, axis, value, name=None):
+    """In-place index_add (reference: tensor/manipulation.py:4764)."""
+    x = ensure_tensor(x)
+    out = index_add(x, index, axis, value)
+    inplace_rebind(x, out)
+    return x
 
 
 def _index_add_impl(v, ix, axis, val):

@@ -122,10 +122,6 @@ class AbsmaxObserver(BaseObserver):
         return Tensor(jnp.float32(self._max), stop_gradient=True)
 
 
-class observers:
-    AbsmaxObserver = AbsmaxObserver
-
-
 # ------------------------------------------------------------------- quanters
 class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """reference: quanters/abs_max.py — moving-average absmax + fake-quant
@@ -153,10 +149,6 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     def scales(self) -> Tensor:
         return Tensor(jnp.float32(max(self._scale, 1e-9)),
                       stop_gradient=True)
-
-
-class quanters:
-    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
 
 
 # -------------------------------------------------------------------- config
@@ -345,3 +337,8 @@ def quanter(class_name: str):
 
 
 __all__.append("quanter")
+
+# real submodules (importable as paddle.quantization.observers/quanters,
+# matching the reference package layout) — imported at the END so their
+# `from . import X` re-exports see the fully-defined names above
+from . import observers, quanters  # noqa: E402,F401

@@ -1,0 +1,6 @@
+"""paddle.quantization.observers (reference:
+python/paddle/quantization/observers/__init__.py — __all__ =
+['AbsmaxObserver'])."""
+from . import AbsmaxObserver  # noqa: F401
+
+__all__ = ["AbsmaxObserver"]

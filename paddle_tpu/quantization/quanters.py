@@ -1,0 +1,6 @@
+"""paddle.quantization.quanters (reference:
+python/paddle/quantization/quanters/__init__.py — __all__ =
+['FakeQuanterWithAbsMaxObserver'])."""
+from . import FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

@@ -24,9 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
-from ..nn.layer_base import Layer
-from ..ops._apply import ensure_tensor
-from ..tensor import Parameter, Tensor
+from ...nn.layer_base import Layer
+from ...ops._apply import ensure_tensor
+from ...tensor import Parameter, Tensor
 
 __all__ = [
     "ReLU", "LeakyReLU", "ReLU6", "Softmax", "BatchNorm", "SyncBatchNorm",
@@ -35,7 +35,7 @@ __all__ = [
 
 
 def _bcoo(x):
-    from . import SparseCooTensor, SparseCsrTensor
+    from .. import SparseCooTensor, SparseCsrTensor
 
     if isinstance(x, SparseCsrTensor):
         x = x.to_sparse_coo()
@@ -45,7 +45,7 @@ def _bcoo(x):
 
 
 def _wrap(bcoo):
-    from . import SparseCooTensor
+    from .. import SparseCooTensor
 
     return SparseCooTensor(bcoo)
 
@@ -96,7 +96,7 @@ class functional:
                   attn_mask=None, name=None):
         """reference: sparse/nn/functional/transformer.py attention — scores
         restricted to sparse_mask's support (SDDMM + sparse softmax + spmm)."""
-        from . import masked_matmul, matmul as smatmul
+        from .. import masked_matmul, matmul as smatmul
 
         q = ensure_tensor(query)
         k = ensure_tensor(key)
@@ -133,7 +133,7 @@ class functional:
         """reference: sparse/nn/functional/pooling.py — NDHWC sparse input."""
         s = _bcoo(x)
         dense = s._bcoo.todense()
-        from ..nn import functional as F
+        from ...nn import functional as F
 
         # NDHWC -> NCDHW for the dense pool, then back
         dn = jnp.moveaxis(dense, -1, 1)
@@ -230,13 +230,40 @@ class MaxPool3D(Layer):
                                      self.padding)
 
 
+def _conv3d_impl(x, w, bias, stride, padding, dilation, subm):
+    """Shared core of layer + functional sparse conv3d (NDHWC dense-conv
+    resample — see module docstring for the TPU rationale)."""
+    s = _bcoo(x)
+    dense = s._bcoo.todense()  # [N, D, H, W, C]
+    stride = stride if isinstance(stride, (tuple, list)) else (stride,) * 3
+    pad = padding
+    if isinstance(pad, int):
+        pad = [(pad, pad)] * 3
+    elif pad and isinstance(pad[0], int):
+        pad = [(p, p) for p in pad]
+    out = jax.lax.conv_general_dilated(
+        dense, w, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=(dilation,) * 3
+        if isinstance(dilation, int) else tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if bias is not None:
+        out = out + bias
+    if subm:
+        # submanifold: output support == input support (spatial indices
+        # carry over; channels are a trailing dense dim)
+        spatial = s._bcoo.indices
+        vals = out[tuple(spatial.T)]  # [nnz, Cout]
+        return _wrap(jsparse.BCOO((vals, spatial), shape=tuple(out.shape)))
+    return _wrap(jsparse.BCOO.fromdense(out, n_dense=1))
+
+
 class _SparseConvNd(Layer):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, subm=False,
                  padding_mode="zeros", weight_attr=None, bias_attr=None,
                  data_format="NDHWC"):
         super().__init__()
-        from ..nn import initializer as I
+        from ...nn import initializer as I
 
         ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
             else (kernel_size,) * 3
@@ -248,7 +275,7 @@ class _SparseConvNd(Layer):
         self.subm = subm
         fan_in = in_channels * int(np.prod(ks))
         bound = 1.0 / np.sqrt(fan_in)
-        from .. import ops as O
+        from ... import ops as O
 
         self.weight = Parameter(O.uniform(
             list(self.ks) + [in_channels, out_channels],
@@ -258,29 +285,10 @@ class _SparseConvNd(Layer):
             if bias_attr is not False else None
 
     def forward(self, x):
-        s = _bcoo(x)
-        dense = s._bcoo.todense()  # [N, D, H, W, C]
-        w = self.weight._value  # [kd, kh, kw, Cin, Cout]
-        pad = self.padding
-        if isinstance(pad, int):
-            pad = [(pad, pad)] * 3
-        elif pad and isinstance(pad[0], int):
-            pad = [(p, p) for p in pad]
-        out = jax.lax.conv_general_dilated(
-            dense, w, window_strides=self.stride, padding=pad,
-            rhs_dilation=(self.dilation,) * 3
-            if isinstance(self.dilation, int) else self.dilation,
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
-        if self.bias is not None:
-            out = out + self.bias._value
-        if self.subm:
-            # submanifold: output support == input support (spatial indices
-            # carry over; channels are a trailing dense dim)
-            spatial = s._bcoo.indices
-            vals = out[tuple(spatial.T)]  # [nnz, Cout]
-            return _wrap(jsparse.BCOO((vals, spatial),
-                                      shape=tuple(out.shape)))
-        return _wrap(jsparse.BCOO.fromdense(out, n_dense=1))
+        return _conv3d_impl(
+            x, self.weight._value,
+            self.bias._value if self.bias is not None else None,
+            self.stride, self.padding, self.dilation, self.subm)
 
 
 class Conv3D(_SparseConvNd):
@@ -305,3 +313,10 @@ class SubmConv3D(_SparseConvNd):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, subm=True,
                          bias_attr=bias_attr)
+
+
+# `functional` as a REAL importable submodule (reference layout:
+# sparse/nn/functional/) — imported last so functional.py can read the
+# staticmethod holder and _conv3d_impl defined above; this rebinding
+# replaces the class attribute with the module of the same surface
+from . import functional  # noqa: E402,F401

@@ -1,0 +1,47 @@
+"""paddle.sparse.nn.functional (reference:
+python/paddle/sparse/nn/functional/__init__.py — conv3d, subm_conv3d,
+max_pool3d, relu, relu6, leaky_relu, softmax, attention).
+
+Importable as a real module (``import paddle.sparse.nn.functional``),
+not just an attribute — loaded at the END of the parent package so the
+implementations above it are fully defined.
+"""
+import sys
+
+from ...ops._apply import ensure_tensor
+
+_parent = sys.modules[__package__]
+# the staticmethod holder defined in the parent (before this module
+# rebinds the `functional` name to itself)
+_impl = _parent.functional
+
+relu = _impl.relu
+relu6 = _impl.relu6
+leaky_relu = _impl.leaky_relu
+softmax = _impl.softmax
+attention = _impl.attention
+max_pool3d = _impl.max_pool3d
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """reference: sparse/nn/functional/conv.py conv3d — weight
+    [kd, kh, kw, Cin, Cout], NDHWC sparse input."""
+    w = ensure_tensor(weight)._value
+    b = ensure_tensor(bias)._value if bias is not None else None
+    return _parent._conv3d_impl(x, w, b, stride, padding, dilation,
+                                subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """reference: sparse/nn/functional/conv.py subm_conv3d — output
+    sparsity pattern equals the input's."""
+    w = ensure_tensor(weight)._value
+    b = ensure_tensor(bias)._value if bias is not None else None
+    return _parent._conv3d_impl(x, w, b, stride, padding, dilation,
+                                subm=True)
+
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]

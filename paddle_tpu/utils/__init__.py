@@ -10,4 +10,58 @@ from .layers_utils import flatten, map_structure, pack_sequence_as  # noqa: F401
 
 __all__ = ["dlpack", "unique_name", "deprecated", "flops", "run_check",
            "get_weights_path_from_url", "flatten", "map_structure",
-           "pack_sequence_as"]
+           "pack_sequence_as", "require_version", "try_import"]
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module with an informative install hint on failure
+    (reference: utils/lazy_import.py try_import)."""
+    import importlib
+
+    install_name = module_name.split(".")[0]
+    if module_name == "cv2":
+        install_name = "opencv-python"
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (f"Failed importing {module_name}. This likely means "
+                       f"that some paddle modules require additional "
+                       f"dependencies that have to be manually installed "
+                       f"(usually with `pip install {install_name}`).")
+        raise ImportError(err_msg)
+
+
+def _parse_version(v, what):
+    import re
+
+    m = re.match(r"\d+(\.\d+){0,3}", v)
+    if m is None or m.group() != v:
+        raise ValueError(
+            f"The value of '{what}' in require_version must be in format "
+            f"'\\d+(\\.\\d+){{0,3}}', like '1.5.2.0', but received {v}")
+    parts = [int(p) for p in v.split(".")]
+    return parts + [0] * (4 - len(parts))
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless the installed version is within [min_version,
+    max_version] (reference: fluid/framework.py require_version)."""
+    if not isinstance(min_version, str):
+        raise TypeError("The type of 'min_version' in require_version must "
+                        f"be str, but received {type(min_version)}.")
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError("The type of 'max_version' in require_version must "
+                        f"be str or type(None), but received "
+                        f"{type(max_version)}.")
+    lo = _parse_version(min_version, "min_version")
+    hi = _parse_version(max_version, "max_version") if max_version else None
+    from ..version import major, minor, patch, rc
+
+    cur = [int(major), int(minor), int(patch), int(rc)]
+    if cur < lo or (hi is not None and cur > hi):
+        bound = (f"in [{min_version}, {max_version}]" if max_version
+                 else f">= {min_version}")
+        raise Exception(
+            f"VersionError: paddle-tpu version {'.'.join(map(str, cur))} "
+            f"does not satisfy the requirement {bound}.")

@@ -1,0 +1,99 @@
+"""paddle.utils.cpp_extension (reference:
+python/paddle/utils/cpp_extension/__init__.py — CppExtension,
+CUDAExtension, load, setup, get_build_directory).
+
+TPU-native redesign: the reference JIT-compiles custom C++/CUDA
+*operators* against libpaddle and imports them as python ops. Here the
+operator set is jax/XLA primitives (custom device code is Pallas — see
+ops/pallas/), so the C++ extension story is the C-ABI one the rest of
+the runtime uses (native/__init__.py): ``load`` compiles C++ sources
+with the in-image toolchain into a shared object and returns a
+``ctypes.CDLL``. ``setup``/``CppExtension`` delegate to setuptools for
+wheel-time builds. ``CUDAExtension`` raises — this build has no CUDA.
+"""
+import os
+import subprocess
+import tempfile
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "get_build_directory"]
+
+
+def get_build_directory(verbose=False):
+    """Build dir for JIT-compiled extensions (reference:
+    cpp_extension/extension_utils.py get_build_directory —
+    PADDLE_EXTENSION_DIR wins, else a per-user cache dir)."""
+    root = os.environ.get("PADDLE_EXTENSION_DIR")
+    if not root:
+        root = os.path.join(os.path.expanduser("~"), ".cache",
+                            "paddle_tpu_extensions")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def CppExtension(sources, *args, **kwargs):
+    """setuptools.Extension preconfigured for C++ (reference:
+    cpp_extension.py CppExtension). ``name`` is taken from kwargs or
+    defaults like the reference's setup() contract."""
+    from setuptools import Extension
+
+    name = kwargs.pop("name", "paddle_tpu_ext")
+    kwargs.setdefault("language", "c++")
+    extra = kwargs.setdefault("extra_compile_args", [])
+    if "-std=c++17" not in extra:
+        extra.append("-std=c++17")
+    return Extension(name, sources, *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not available: this is the TPU-native build "
+        "(no CUDA toolchain). Device code belongs in Pallas kernels "
+        "(paddle_tpu/ops/pallas) — use CppExtension/load for host-side "
+        "C++ components.")
+
+
+def setup(**attrs):
+    """setuptools.setup pass-through with the reference's ext_modules
+    contract (reference: cpp_extension.py setup)."""
+    from setuptools import setup as _setup
+
+    return _setup(**attrs)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False,
+         **unused):
+    """JIT-compile C++ ``sources`` into ``lib<name>.so`` and dlopen it
+    (reference: cpp_extension.py load). Returns a ``ctypes.CDLL`` of the
+    C ABI — the TPU build's custom-op surface is jax-level, so there is
+    no generated python-op module to import (see module docstring)."""
+    import ctypes
+
+    out_dir = build_directory or get_build_directory()
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"lib{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    stale = (not os.path.exists(out_path)
+             or any(os.path.getmtime(s) > os.path.getmtime(out_path)
+                    for s in srcs))
+    if stale:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+        os.close(fd)
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + (extra_cxx_cflags or []) + srcs
+               + ["-o", tmp] + (extra_ldflags or []))
+        if verbose:
+            print(" ".join(cmd))
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"cpp_extension build of {name} failed:\n"
+                    f"{proc.stderr[-4000:]}")
+            os.replace(tmp, out_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return ctypes.CDLL(out_path)

@@ -840,6 +840,14 @@ def _check_tanh_inplace():
     np.testing.assert_allclose(np.asarray(t.numpy()), [np.tanh(0.5)])
 
 
+def _check_index_add_inplace():
+    t = pt.to_tensor(np.zeros((3, 2), "float32"))
+    pt.index_add_(t, pt.to_tensor(np.array([0, 2])), 0,
+                  pt.to_tensor(np.ones((2, 2), "float32")))
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               [[1, 1], [0, 0], [1, 1]])
+
+
 CUSTOM["multiplex"] = _check_multiplex
 CUSTOM["index_add"] = _check_index_add
 CUSTOM["polar"] = _check_polar
@@ -850,6 +858,7 @@ CUSTOM["broadcast_tensors"] = _check_broadcast_tensors
 CUSTOM["vsplit"] = _check_vsplit
 CUSTOM["increment"] = _check_increment
 CUSTOM["tanh_"] = _check_tanh_inplace
+CUSTOM["index_add_"] = _check_index_add_inplace
 
 EXCLUDED.update({
     # pure-python helpers over shapes/dtypes (no tensor math to check)
