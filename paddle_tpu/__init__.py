@@ -75,6 +75,7 @@ from . import version  # noqa: E402
 from .utils.flops import flops  # noqa: E402
 from . import text  # noqa: E402
 from . import profiler  # noqa: E402
+from . import serving  # noqa: E402
 from . import reader  # noqa: E402
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402

@@ -48,9 +48,17 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 device_loop: Optional[bool] = None):
+                 device_loop: Optional[bool] = None,
+                 return_stats: bool = False):
         """Returns [B, prompt+generated] token ids (generation stops early
         when every row emitted ``eos_token_id``).
+
+        ``return_stats=True`` returns ``(ids, stats)`` instead, where
+        ``stats`` is ``{"n_gen": tokens generated per row (incl. eos
+        padding), "stop_reason": "eos" | "length"}`` — "eos" when every
+        row finished on ``eos_token_id`` before the token budget ran out.
+        The serving engine and the early-stop tests assert on it; the
+        default keeps the old single-tensor return shape.
 
         EOS semantics (both loops, PaddleNLP/HF style): a row that emits
         ``eos_token_id`` is frozen — every later position in that row is
@@ -147,10 +155,12 @@ class GenerationMixin:
             eos_t = Tensor(jnp.int32(eos_token_id
                                      if eos_token_id is not None else -1),
                            stop_gradient=True)
-            buf, n_gen = loop(nxt, Tensor(k), eos_t, *flat)
+            buf, n_gen, all_done = loop(nxt, Tensor(k), eos_t, *flat)
             # one batched fetch — each host sync costs a tunnel round trip
-            buf_v, n_v = jax.device_get((buf._value, n_gen._value))
+            buf_v, n_v, done_v = jax.device_get(
+                (buf._value, n_gen._value, all_done._value))
             out[-1] = np.asarray(buf_v)[:, :int(n_v)]
+            stopped_on_eos = bool(done_v)
         else:
             done = (tokens[:, 0] == eos_token_id) if eos_token_id is not None \
                 else np.zeros(B, bool)
@@ -168,10 +178,16 @@ class GenerationMixin:
                     tokens = np.where(done[:, None], eos_token_id, tokens)
                     done = done | (tokens[:, 0] == eos_token_id)
                 out.append(tokens)
+            stopped_on_eos = bool(eos_token_id is not None and done.all())
 
         if was_training:
             self.train()
-        return Tensor(jnp.asarray(np.concatenate(out, axis=1)))
+        ids_out = Tensor(jnp.asarray(np.concatenate(out, axis=1)))
+        if not return_stats:
+            return ids_out
+        stats = {"n_gen": int(ids_out.shape[1]) - S0,
+                 "stop_reason": "eos" if stopped_on_eos else "length"}
+        return ids_out, stats
 
     def _make_device_loop(self, trunk, n_layers, B, S0, max_new_tokens,
                           temperature, top_k):
@@ -225,7 +241,8 @@ class GenerationMixin:
                                         == eos_i)
                 init = (buf0, jnp.int32(1), key_v, done0, *cache_vals)
                 fin = jax.lax.while_loop(cond, body, init)
-                return fin[0], fin[1]  # token buffer, count generated
+                # token buffer, count generated, all-rows-hit-eos flag
+                return fin[0], fin[1], jnp.all(fin[3])
 
             return apply_op(run, [ensure_tensor(first_tok),
                                   ensure_tensor(key), ensure_tensor(eos),

@@ -211,6 +211,43 @@ class GPTAttention(nn.Layer):
                           [ensure_tensor(ctx)], name="merge_heads")
         return self.out_proj(merged)
 
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+        """Paged-KV decode step (serving engine): one token per sequence,
+        KV write hook scattering into the page pool at per-row positions,
+        then ragged paged attention over each sequence's block table
+        (ops/pallas/paged_attention.py). Position embeddings were already
+        added at the trunk level (GPTModel.forward_paged)."""
+        from ..ops.pallas.paged_attention import paged_attention
+
+        B = x.shape[0]
+        nh, hd = self.cfg.num_heads, self.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        qkv = self.qkv_proj(x)  # [B, 1, 3H]
+
+        def paged_step(qkv_v, kp, vp, bt, pos):
+            pos = pos.astype(jnp.int32).reshape(B)
+            bt = bt.astype(jnp.int32)
+            page_size = kp.shape[1]
+            qv, kv, vv = jnp.split(qkv_v, 3, axis=-1)
+            nh_l = qv.shape[-1] // hd
+            qh = qv.reshape(B, nh_l, hd)
+            kh = kv.reshape(B, nh_l, hd)
+            vh = vv.reshape(B, nh_l, hd)
+            page_ids = bt[jnp.arange(B), pos // page_size]
+            offs = pos % page_size
+            kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
+            vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
+            ctx = paged_attention(qh, kp, vp, bt, pos + 1, scale=scale)
+            return ctx.reshape(B, 1, nh_l * hd), kp, vp
+
+        merged, new_k, new_v = apply_op(
+            paged_step,
+            [ensure_tensor(qkv), ensure_tensor(k_pool),
+             ensure_tensor(v_pool), ensure_tensor(block_tables),
+             ensure_tensor(positions)],
+            name="gpt_paged_attention")
+        return self.out_proj(merged), (new_k, new_v)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -266,6 +303,12 @@ class GPTDecoderLayer(nn.Layer):
         if self.drop_p and self.training:
             h = F.dropout(h, self.drop_p)
         return x + h
+
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+        h, nc = self.attn.forward_paged(self.ln1(x), positions,
+                                        block_tables, k_pool, v_pool)
+        x = x + h
+        return x + self.mlp(self.ln2(x)), nc
 
 
 class GPTModel(nn.Layer):
@@ -371,6 +414,27 @@ class GPTModel(nn.Layer):
             for layer in self.layers:
                 x = layer(x)
         return self.ln_f(x)
+
+    def forward_paged(self, input_ids, positions, block_tables, caches):
+        """Paged decode trunk (serving engine): ``input_ids`` [B, 1],
+        ``positions`` [B] per-row absolute positions (the learned position
+        embedding is gathered per row — the paged counterpart of the
+        cur_len-offset decode_positions), ``caches`` a per-layer list of
+        (k_pool, v_pool) page pools. Returns (hidden, new_caches)."""
+        if self._pp > 1:
+            raise NotImplementedError(
+                "paged decode requires pp=1 (same single-program scope as "
+                "KV-cache decode)")
+        ids = ensure_tensor(input_ids)
+        pos_ids = apply_op(
+            lambda p: p.astype(jnp.int32).reshape(-1, 1),
+            [ensure_tensor(positions)], name="paged_positions")
+        x = self.embeddings(ids) + self.position_embeddings(pos_ids)
+        new_caches = []
+        for layer, (kp, vp) in zip(self.layers, caches):
+            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp)
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
 
 
 class GPTForCausalLM(nn.Layer, GenerationMixin):

@@ -161,6 +161,68 @@ class LlamaAttention(nn.Layer):
                                     weight_attr=nn.ParamAttr(
                                         initializer=_normal_init(proj_std)))
 
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+        """Paged-KV decode step (serving engine): one token per sequence.
+
+        ``x`` [B, 1, H]; ``positions`` [B] absolute positions; the KV write
+        hook scatters this step's rope'd k/v into the page each sequence's
+        block table names at ``positions``, then ragged paged attention
+        (ops/pallas/paged_attention.py) runs over the page list. Returns
+        (out [B, 1, H], new_k_pool, new_v_pool) — same rope tables and
+        masked-softmax math as the dense cached_attn path, so paged decode
+        is token-compatible with ``generate()``.
+        """
+        from ..ops.pallas.paged_attention import paged_attention
+
+        B = x.shape[0]
+        cfg = self.cfg
+        hd = self.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        max_pos = cfg.max_position_embeddings
+
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        def paged_step(qv, kv, vv, kp, vp, bt, pos):
+            pos = pos.astype(jnp.int32).reshape(B)
+            bt = bt.astype(jnp.int32)
+            page_size = kp.shape[1]
+            nh_l = qv.shape[-1] // hd
+            nkv_l = kv.shape[-1] // hd
+            qh = qv.reshape(B, nh_l, hd)
+            kh = kv.reshape(B, nkv_l, hd)
+            vh = vv.reshape(B, nkv_l, hd)
+            cos_f, sin_f = _rope_tables(max_pos, hd, cfg.rope_theta)
+            cos = cos_f[pos][:, None, :]  # [B, 1, hd/2] per-row positions
+            sin = sin_f[pos][:, None, :]
+
+            def rope_rows(t):
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                return jnp.stack([t1 * cos - t2 * sin,
+                                  t1 * sin + t2 * cos],
+                                 axis=-1).reshape(t.shape)
+
+            qh = rope_rows(qh)
+            kh = rope_rows(kh)
+            # KV write hook: page = block_table[pos // page_size], slot =
+            # pos % page_size. Inactive slots carry all-zero block tables,
+            # landing their writes on the pool's reserved null page 0.
+            page_ids = bt[jnp.arange(B), pos // page_size]
+            offs = pos % page_size
+            kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
+            vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
+            ctx = paged_attention(qh, kp, vp, bt, pos + 1, scale=scale)
+            return ctx.reshape(B, 1, nh_l * hd), kp, vp
+
+        merged, new_k, new_v = apply_op(
+            paged_step,
+            [ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+             ensure_tensor(k_pool), ensure_tensor(v_pool),
+             ensure_tensor(block_tables), ensure_tensor(positions)],
+            name="llama_paged_attention")
+        return self.o_proj(merged), (new_k, new_v)
+
     def forward(self, x, cache=None, cur_len=None):
         B, S, _ = x.shape
         cfg = self.cfg
@@ -320,6 +382,12 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + self.self_attn(self.input_layernorm(x))
         return x + self.mlp(self.post_attention_layernorm(x))
 
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+        h, nc = self.self_attn.forward_paged(
+            self.input_layernorm(x), positions, block_tables, k_pool, v_pool)
+        x = x + h
+        return x + self.mlp(self.post_attention_layernorm(x)), nc
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -380,6 +448,17 @@ class LlamaModel(nn.Layer):
             for layer in self.layers:
                 x = layer(x)
         return self.norm(x)
+
+    def forward_paged(self, input_ids, positions, block_tables, caches):
+        """Paged decode trunk (serving engine): ``input_ids`` [B, 1],
+        ``positions`` [B], ``caches`` a per-layer list of (k_pool, v_pool)
+        page pools. Returns (hidden [B, 1, H], new_caches)."""
+        x = self.embed_tokens(ensure_tensor(input_ids))
+        new_caches = []
+        for layer, (kp, vp) in zip(self.layers, caches):
+            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp)
+            new_caches.append(nc)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer, GenerationMixin):

@@ -1,0 +1,221 @@
+"""Ragged paged-attention decode kernel (Pallas TPU) + pure-jnp fallback.
+
+TPU-native kernel for continuous-batching decode (PAPERS.md: "Ragged
+Paged Attention", arxiv 2604.15464): each live sequence owns a list of
+fixed-size KV pages scattered through a shared pool, described by a
+per-sequence block table. One query token per sequence attends over its
+own ragged page list — no per-sequence dense cache, no re-layout when
+sequences join or retire mid-decode.
+
+Layout (serving/kv_cache.py owns the pool):
+
+- ``q``            [B, num_heads, head_dim]      — one decode token per seq
+- ``k/v pool``     [num_pages, page_size, num_kv_heads, head_dim]
+- ``block_tables`` [B, pages_per_seq] int32      — page ids, 0-padded (page 0
+  is the pool's reserved null page, never allocated to a sequence)
+- ``seq_lens``     [B] int32                     — tokens written so far
+
+Kernel shape (style of ops/pallas/flash_attention.py): grid
+``(B, num_kv_heads, pages_per_seq)`` with the page axis innermost carrying
+the online-softmax state in VMEM scratch; the block table and seq lens ride
+in as SCALAR-PREFETCH operands (``pltpu.PrefetchScalarGridSpec``) so the
+k/v BlockSpec index maps can DMA exactly the pages each sequence names —
+the "ragged" part: no dense [B, max_len] gather ever materializes.
+
+The pure-jnp fallback (``ref_paged_attention``) is the same math as the
+dense decode path (models/llama.py cached_attn): softmax in f32 over the
+gathered pages with masked lanes at -1e30 — tier-1 CPU tests drive the
+engine through this path and assert token-for-token equality with dense
+``generate()``. Set PADDLE_TPU_PALLAS_INTERPRET=1 to run the real kernel
+on CPU (interpret mode), as the flash kernels do.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["paged_attention", "ref_paged_attention"]
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _interpret() -> bool:
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+
+
+# ───────────────────────── pure-jnp fallback ─────────────────────────
+
+
+def ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                        scale: float = None):
+    """Gather-based paged attention, pure jnp — the CPU/equivalence path.
+
+    Math-identical to the dense cached_attn (einsum in f32, -1e30 masked
+    lanes, softmax over the key axis): a masked key contributes exactly 0
+    to every sum, so outputs match the dense decode bit-for-bit on the
+    positions both paths share.
+    """
+    B, nh, hd = q.shape
+    nkv = k_pool.shape[2]
+    page = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    groups = nh // nkv
+    # [B, pages_per_seq, page, nkv, hd] -> [B, K, nkv, hd]
+    k = k_pool[block_tables].reshape(B, -1, nkv, hd)
+    v = v_pool[block_tables].reshape(B, -1, nkv, hd)
+    if groups > 1:  # GQA: repeat kv per query group (same as dense path)
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    qf = q.astype(jnp.float32)
+    s = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(k.shape[1], dtype=jnp.int32)[None, :]  # [1, K]
+    valid = pos < seq_lens.astype(jnp.int32)[:, None]       # [B, K]
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ───────────────────────── pallas kernel ─────────────────────────
+
+
+def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *,
+                       scale: float, page_size: int, groups: int):
+    """One (sequence b, kv head h, page j) step of online-softmax decode.
+
+    bt_ref/len_ref are the scalar-prefetched block table and seq lens —
+    already consumed by the k/v index maps; len_ref masks the tail of the
+    last live page here. q block is the head group [groups, hd]; scratch
+    carries (acc, m, l) across the page axis (innermost, 'arbitrary').
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npages = pl.num_programs(2)
+
+    neg_inf = jnp.float32(NEG_INF)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+        m_ref[...] = jnp.full_like(m_ref[...], neg_inf)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+
+    seq_len = len_ref[b]
+    # ragged early-out: pages past the sequence's length are dead weight
+    # (their block-table entries are the null page) — skip the whole block
+    @pl.when(j * page_size < seq_len)
+    def _body():
+        q = q_ref[0, 0]  # [groups, hd]
+        k = k_ref[0, :, 0, :]  # [page, hd]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        # mask the tail of the last live page
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], page_size), 1)
+        mask = pos < seq_len
+        s = jnp.where(mask, s, neg_inf)
+
+        m_prev = m_ref[...]  # [groups, LANES] replicated
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(mask, p, 0.0)
+        l_ref[...] = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == npages - 1)
+    def _finish():
+        l_fin = jnp.maximum(l_ref[...], jnp.float32(1e-30))
+        o_ref[0, 0] = (acc_ref[...] / l_fin[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, block_tables, seq_lens,
+                            scale: float):
+    B, nh, hd = q.shape
+    num_pages, page_size, nkv, _ = k_pool.shape
+    groups = nh // nkv
+    pages_per_seq = block_tables.shape[1]
+    # q regrouped so each kv head's query group is one contiguous block
+    qg = q.reshape(B, nkv, groups, hd)
+
+    bt = block_tables.astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, seq_lens
+        grid=(B, nkv, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, hd),
+                         lambda b, h, j, bt_ref, len_ref: (b, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, hd),
+                         lambda b, h, j, bt_ref, len_ref:
+                         (bt_ref[b, j], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, hd),
+                         lambda b, h, j, bt_ref, len_ref:
+                         (bt_ref[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, hd),
+                               lambda b, h, j, bt_ref, len_ref: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, hd), jnp.float32),
+            pltpu.VMEM((groups, LANES), jnp.float32),
+            pltpu.VMEM((groups, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale=scale,
+                          page_size=page_size, groups=groups),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, groups, hd), q.dtype),
+        interpret=_interpret(),
+    )(bt, sl, qg, k_pool, v_pool)
+    return out.reshape(B, nh, hd)
+
+
+# ───────────────────────── public op ─────────────────────────
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                    scale: float = None, use_kernel: bool = None):
+    """Ragged paged-attention decode: one query token per sequence over its
+    page list. ``use_kernel=None`` picks the Pallas kernel on TPU backends
+    (or under PADDLE_TPU_PALLAS_INTERPRET=1) and the jnp gather fallback
+    elsewhere — both compute the identical masked-softmax math, so the
+    serving engine's numerics don't depend on the backend."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_kernel is None:
+        use_kernel = _HAS_PLTPU and (
+            _interpret()
+            or jax.default_backend() in ("tpu", "axon"))
+    if use_kernel and _HAS_PLTPU:
+        return _paged_attention_pallas(q, k_pool, v_pool, block_tables,
+                                       seq_lens, scale)
+    return ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                               scale)

@@ -34,7 +34,7 @@ from typing import Callable, Iterable, Optional
 __all__ = [
     "ProfilerState", "ProfilerTarget", "Profiler", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "export_protobuf",
-    "load_profiler_result", "SortedKeys",
+    "load_profiler_result", "SortedKeys", "record_counter",
 ]
 
 
@@ -200,6 +200,17 @@ class RecordEvent:
         self._t0 = None
 
 
+def record_counter(name: str, value) -> None:
+    """Record a numeric gauge sample into the active profiler (no-op when
+    none is recording) — the counter counterpart of RecordEvent. Used by
+    the serving engine for queue depth / running seqs / tokens/s / page
+    utilization; samples show up in ``summary()`` and as chrome-trace
+    counter ("ph": "C") events."""
+    prof = _active_profiler
+    if prof is not None and prof._recording:
+        prof._add_counter(name, time.perf_counter(), float(value))
+
+
 # ------------------------------------------------------------------- profiler
 class Profiler:
     """reference: profiler.py:340.
@@ -234,10 +245,12 @@ class Profiler:
         self.step_num = 0
         self._events: list = []  # (name, t0, dur_s) — current window
         self._step_times: list = []  # (t_start, dur_s) — current window
+        self._counters: list = []  # (name, t, value) — current window
         self._window_step0 = 0
         # run-cumulative copies for summary(); windows clear the live buffers
         self._hist_events: list = []
         self._hist_step_times: list = []
+        self._hist_counters: list = []
         self._step_t0 = None
         self._recording = False
         self._jax_trace_on = False
@@ -336,6 +349,9 @@ class Profiler:
     def _add_event(self, name: str, t0: float, dur: float):
         self._events.append((name, t0, dur))
 
+    def _add_counter(self, name: str, t: float, value: float):
+        self._counters.append((name, t, value))
+
     def _write_chrome_trace(self, path: str):
         pid = os.getpid()
         events = [{
@@ -346,6 +362,10 @@ class Profiler:
             events.append({"name": f"ProfileStep#{self._window_step0 + i}",
                            "ph": "X", "cat": "step", "ts": t0 * 1e6,
                            "dur": dt * 1e6, "pid": pid, "tid": 1})
+        for name, t, value in self._counters:
+            events.append({"name": name, "ph": "C", "cat": "counter",
+                           "ts": t * 1e6, "pid": pid,
+                           "args": {"value": value}})
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
@@ -358,8 +378,10 @@ class Profiler:
             self.on_trace_ready(self)
         self._hist_events.extend(self._events)
         self._hist_step_times.extend(self._step_times)
+        self._hist_counters.extend(self._counters)
         self._events = []
         self._step_times = []
+        self._counters = []
         self._window_step0 = self.step_num
 
     # -- reporting ----------------------------------------------------------
@@ -397,6 +419,18 @@ class Profiler:
             for name, (tot, cnt, mn, mx) in sorted(agg.items(), key=key):
                 lines.append(f"{name[:29]:<30}{cnt:>8}{tot * unit:>10.3f}"
                              f"{tot / cnt * unit:>10.3f}{mx * unit:>10.3f}")
+        cagg = {}
+        for name, _, val in self._hist_counters + self._counters:
+            tot, cnt, mx, last = cagg.get(name, (0.0, 0, float("-inf"), 0.0))
+            cagg[name] = (tot + val, cnt + 1, max(mx, val), val)
+        if cagg:
+            lines.append("-" * 72)
+            lines.append(f"{'Counter (gauge)':<30}{'samples':>8}{'last':>10}"
+                         f"{'avg':>10}{'max':>10}")
+            lines.append("-" * 72)
+            for name, (tot, cnt, mx, last) in sorted(cagg.items()):
+                lines.append(f"{name[:29]:<30}{cnt:>8}{last:>10.3f}"
+                             f"{tot / cnt:>10.3f}{mx:>10.3f}")
         if self._last_export_path:
             lines.append(f"chrome trace: {self._last_export_path}")
         if self._jax_trace_on or (

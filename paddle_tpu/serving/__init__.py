@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — continuous-batching LLM inference engine.
+
+The production decode path the ROADMAP's "millions of users" north star
+needs and ``GenerationMixin.generate`` (one static batch, dense caches)
+cannot provide: paged KV memory (kv_cache.py), FCFS token-budget
+admission (scheduler.py), a single compiled ragged-paged-attention decode
+step over fixed batch slots (engine.py + ops/pallas/paged_attention.py),
+and an OpenAI-ish front door with streaming (api.py).
+
+Quick start (docs/SERVING.md has the sizing math; examples/serve_llama.py
+is runnable):
+
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    engine = ServingEngine(LlamaForCausalLM(llama_tiny()), page_size=16,
+                           max_batch_slots=8)
+    engine.add_request(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    outputs = engine.run()          # continuous batching until drained
+"""
+from .api import CompletionAPI, EnginePool
+from .engine import ServingEngine
+from .kv_cache import PagedKVCachePool, page_bytes, pages_for_hbm_budget
+from .scheduler import FCFSScheduler, Request, RequestOutput
+
+__all__ = [
+    "ServingEngine", "PagedKVCachePool", "FCFSScheduler", "Request",
+    "RequestOutput", "CompletionAPI", "EnginePool", "page_bytes",
+    "pages_for_hbm_budget",
+]

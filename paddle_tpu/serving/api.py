@@ -1,0 +1,145 @@
+"""OpenAI-ish completion front door over the serving engine.
+
+The request/response half of the serving stack: an in-process API whose
+payload shapes mirror the OpenAI completions surface (``id`` /
+``object: "text_completion"`` / ``choices[].finish_reason`` / ``usage``)
+so an HTTP shim is a ~20-line adapter, plus per-request streaming
+callbacks (the SSE chunk analogue). Pooling follows the
+``inference.PredictorPool`` idiom (inference/__init__.py — ``retrieve(i)``
+hands a caller-thread its own slot): one model's weights are shared (jax
+arrays are immutable) while each pool slot owns an independent engine —
+queue, pages, and compiled-step state are per-slot, handles must not be
+shared across threads.
+
+Token ids in, token ids out: tokenization is the caller's concern (pass
+``detokenize=`` to get ``text`` filled in the response).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+
+__all__ = ["CompletionAPI", "EnginePool"]
+
+_cmpl_counter = itertools.count()
+
+
+class CompletionAPI:
+    """OpenAI-completions-shaped facade over one :class:`ServingEngine`."""
+
+    def __init__(self, engine: ServingEngine, model_name: str = "paddle-tpu",
+                 detokenize: Optional[Callable[[Sequence[int]], str]] = None):
+        self.engine = engine
+        self.model_name = model_name
+        self.detokenize = detokenize
+
+    def create_completion(self, prompt, max_tokens: int = 16,
+                          temperature: float = 0.0,
+                          stop_token_id: Optional[int] = None,
+                          seed: int = 0, echo: bool = False,
+                          stream_cb: Optional[Callable] = None) -> dict:
+        """Run one or more prompts to completion and return an OpenAI-ish
+        response dict. ``prompt`` is a token-id list or a batch of them
+        (one ``choices`` entry each, continuous-batched through the
+        engine). ``stream_cb(chunk)`` receives OpenAI-chunk-shaped dicts
+        as tokens land. Each batch-mate's first token samples from its
+        own stream (``seed + index``), so n-best sampling of one prompt
+        diverges instead of returning n identical choices."""
+        prompts = self._as_batch(prompt)
+        # validate the WHOLE batch before queueing anything: a rejected
+        # later prompt must not strand already-queued batch-mates
+        for p in prompts:
+            self.engine.check_request(p.size, max_tokens)
+        cid = f"cmpl-{next(_cmpl_counter)}"
+        req_ids = []
+        for idx, p in enumerate(prompts):
+            cb = None
+            if stream_cb is not None:
+                cb = self._chunk_cb(stream_cb, cid, idx)
+            req_ids.append(self.engine.add_request(
+                p, max_new_tokens=max_tokens, temperature=temperature,
+                eos_token_id=stop_token_id, seed=seed + idx, stream_cb=cb))
+        outputs = self.engine.run()
+        choices = []
+        usage_p = usage_c = 0
+        for idx, rid in enumerate(req_ids):
+            out = outputs[rid]
+            ids = list(out.token_ids)
+            full = (list(map(int, out.prompt_token_ids)) + ids
+                    if echo else ids)
+            choices.append({
+                "index": idx,
+                "token_ids": full,
+                "text": (self.detokenize(full)
+                         if self.detokenize is not None else None),
+                "finish_reason": ("stop" if out.finish_reason == "stop"
+                                  else "length"),
+            })
+            usage_p += int(out.prompt_token_ids.size)
+            usage_c += out.n_gen
+        return {
+            "id": cid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": choices,
+            "usage": {"prompt_tokens": usage_p,
+                      "completion_tokens": usage_c,
+                      "total_tokens": usage_p + usage_c},
+        }
+
+    def _chunk_cb(self, stream_cb, cid, idx):
+        def cb(req_id, token, finished):
+            # the engine's terminal callback passes the finish reason
+            # ("stop"|"length") as `finished`, so streamed chunks agree
+            # with the final response's choices[].finish_reason
+            stream_cb({
+                "id": cid,
+                "object": "text_completion.chunk",
+                "model": self.model_name,
+                "choices": [{
+                    "index": idx,
+                    "token_id": None if token is None else int(token),
+                    "finish_reason": finished or None,
+                }],
+            })
+
+        return cb
+
+    @staticmethod
+    def _as_batch(prompt) -> List[np.ndarray]:
+        if isinstance(prompt, (list, tuple)):
+            if not prompt:
+                raise ValueError("empty prompt batch")
+            if np.ndim(prompt[0]) == 0:  # flat token-id list
+                return [np.asarray(prompt, np.int32)]
+            # ragged batch: one choices entry per prompt
+            return [np.asarray(p, np.int32).reshape(-1) for p in prompt]
+        arr = np.asarray(prompt)
+        if arr.ndim == 1:
+            return [arr.astype(np.int32)]
+        if arr.ndim == 2:
+            return [row.astype(np.int32) for row in arr]
+        raise ValueError(f"prompt rank {arr.ndim} unsupported")
+
+
+class EnginePool:
+    """Pool of engines over ONE model for multi-threaded serving —
+    the ``inference.PredictorPool`` idiom: ``retrieve(i)`` hands thread i
+    its own engine (private queue/pages/compiled-step cache); the model
+    weights are shared process-wide."""
+
+    def __init__(self, model, size: int = 1, **engine_kwargs):
+        self._engines = [ServingEngine(model, **engine_kwargs)
+                         for _ in range(int(size))]
+
+    def retrieve(self, idx: int) -> ServingEngine:
+        return self._engines[idx]
+
+    def __len__(self) -> int:
+        return len(self._engines)

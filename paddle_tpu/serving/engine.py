@@ -1,0 +1,374 @@
+"""Continuous-batching LLM inference engine over the paged KV cache.
+
+The serving counterpart of ``GenerationMixin.generate`` (one static batch,
+dense caches): requests join and retire MID-DECODE. The engine keeps a
+fixed grid of ``max_batch_slots`` decode slots; each engine step
+
+1. **admits** waiting requests FCFS into free slots (scheduler.py) under
+   the prefill token budget and the pool's worst-case page accounting,
+2. **prefills** each admitted prompt through the model's dense-cache path
+   at a power-of-two padded bucket length (bounded prefill program count),
+   scatters the prompt KV into the sequence's pages, and samples the
+   first token,
+3. runs ONE **compiled decode step** for every live slot at once — shapes
+   padded to the slot grid, block tables and positions riding in as data —
+   so XLA compiles the decode program exactly once no matter how the live
+   batch churns (asserted by tests via :meth:`compile_counts`),
+4. **retires** finished sequences (eos or max tokens), freeing their pages
+   immediately for the next admission.
+
+Idle slots carry the null block table (all page 0) and a zero position;
+their masked garbage rides along and is discarded on the host. Per-token
+streaming goes through each request's ``stream_cb``; engine gauges (queue
+depth, running seqs, tokens/s, page utilization) go to ``engine.stats``
+and — when a profiler is recording — to ``profiler.record_counter`` so
+they land in the chrome trace next to the ``engine_step`` spans.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import jit
+from ..autograd.engine import no_grad
+from ..ops._apply import apply_op, ensure_tensor
+from ..tensor import Tensor
+from .kv_cache import PagedKVCachePool
+from .scheduler import FCFSScheduler, Request, RequestOutput
+
+__all__ = ["ServingEngine"]
+
+_MIN_PREFILL_BUCKET = 16
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Power-of-two prefill padding: program count is O(log max_len)."""
+    b = max(_MIN_PREFILL_BUCKET, 1 << (int(n) - 1).bit_length())
+    return min(b, cap)
+
+
+class _SeqState:
+    """One live slot: request + decode cursor."""
+
+    __slots__ = ("req", "pos", "last_token", "gen", "key")
+
+    def __init__(self, req: Request, pos: int, last_token: int, key):
+        self.req = req
+        self.pos = pos              # tokens of KV written so far
+        self.last_token = last_token
+        self.gen = [last_token]     # generated ids (incl. eos when hit)
+        self.key = key
+
+
+class ServingEngine:
+    """Continuous-batching engine for any ``GenerationMixin`` model
+    (LlamaForCausalLM / GPTForCausalLM): paged KV pool + FCFS scheduler +
+    a single compiled ragged-paged-attention decode step.
+
+    ``num_pages=None`` sizes the pool for ``max_batch_slots`` worst-case
+    sequences of ``max_model_len`` tokens (+1 null page); pass an explicit
+    page count (see docs/SERVING.md for the HBM sizing math) to serve more
+    queued requests than fit concurrently — admission simply waits.
+    """
+
+    def __init__(self, model, *, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_batch_slots: int = 8,
+                 max_model_len: Optional[int] = None,
+                 prefill_token_budget: int = 1024,
+                 kv_dtype=jnp.float32, seed: int = 0):
+        self.model = model
+        model.eval()
+        self.trunk = model._decode_trunk()
+        n_layers, n_kv, head_dim = model._cache_spec()
+        self.n_layers = n_layers
+        cfg_max = int(model.config.max_position_embeddings)
+        self.max_model_len = min(int(max_model_len or cfg_max), cfg_max)
+        self.page_size = int(page_size)
+        self.max_batch_slots = int(max_batch_slots)
+        self.pages_per_seq = -(-self.max_model_len // self.page_size)
+        if num_pages is None:
+            num_pages = self.max_batch_slots * self.pages_per_seq + 1
+        self.pool = PagedKVCachePool(n_layers, num_pages, self.page_size,
+                                     n_kv, head_dim, dtype=kv_dtype)
+        self.scheduler = FCFSScheduler(self.max_batch_slots,
+                                       prefill_token_budget)
+        self.slots: List[Optional[_SeqState]] = [None] * self.max_batch_slots
+        self._decode_prog = None
+        self._prefill_progs: Dict[int, jit.StaticFunction] = {}
+        self._rng = jax.random.PRNGKey(seed)
+        self._outputs: Dict[object, RequestOutput] = {}
+        self.stats: Dict[str, float] = {
+            "steps": 0, "generated_tokens": 0, "finished_requests": 0,
+            "queue_depth": 0, "running_seqs": 0, "tokens_per_sec": 0.0,
+            "page_utilization": 0.0, "peak_pages": 0,
+        }
+
+    # ------------------------------------------------------------ frontend
+    def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Raise ValueError if a request of this shape could NEVER be
+        served — batch front doors call this for every prompt before
+        queueing any, so one bad prompt can't strand its batch-mates."""
+        total = int(prompt_len) + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_model_len {self.max_model_len}")
+        need = self.pool.pages_needed(total)
+        if need > self.pool.usable_pages:
+            # even an empty pool could never admit it — rejecting here
+            # (not queueing) keeps run() from spinning forever on a head
+            # request that can never pass can_admit
+            raise ValueError(
+                f"request needs {need} KV pages worst-case but the pool "
+                f"has {self.pool.usable_pages} usable pages — raise "
+                f"num_pages or lower max_new_tokens")
+
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    temperature: float = 0.0,
+                    eos_token_id: Optional[int] = None, seed: int = 0,
+                    stream_cb=None):
+        """Queue a request; returns its ``req_id``. Generation starts at
+        the next :meth:`step` with capacity (continuous batching — no
+        barrier on the current batch)."""
+        req = Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
+                      max_new_tokens=max_new_tokens, temperature=temperature,
+                      eos_token_id=eos_token_id, seed=seed,
+                      stream_cb=stream_cb)
+        self.check_request(req.prompt.size, req.max_new_tokens)
+        self.scheduler.add(req)
+        return req.req_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.scheduler.waiting) or any(
+            s is not None for s in self.slots)
+
+    def run(self) -> Dict[object, RequestOutput]:
+        """Drive :meth:`step` until queue and slots drain; returns every
+        request finished since the last :meth:`run` (including ones that
+        retired in explicit :meth:`step` calls in between), keyed by
+        ``req_id``. Draining — outputs are handed out exactly once, so a
+        long-lived server never accumulates them."""
+        while self.has_work:
+            self.step()
+        out, self._outputs = self._outputs, {}
+        return out
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Compiled-program tally — the recompilation bound the tests
+        assert on: decode stays at 1 signature forever; prefill grows one
+        program per power-of-two bucket."""
+        d = len(self._decode_prog._cache) if self._decode_prog else 0
+        p = sum(len(f._cache) for f in self._prefill_progs.values())
+        return {"decode": d, "prefill": p,
+                "prefill_buckets": len(self._prefill_progs)}
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: admit → prefill → batched decode →
+        retire. Returns requests that finished during this step."""
+        from ..profiler import RecordEvent, record_counter
+
+        t0 = time.perf_counter()
+        tokens_before = self.stats["generated_tokens"]
+        finished: List[RequestOutput] = []
+        with RecordEvent("engine_step"):
+            free = sum(1 for s in self.slots if s is None)
+            for req in self.scheduler.admit(free, self.pool):
+                out = self._prefill(req)
+                if out is not None:
+                    finished.append(out)
+            if any(s is not None for s in self.slots):
+                finished.extend(self._decode_once())
+        dt = max(time.perf_counter() - t0, 1e-9)
+        self.stats["steps"] += 1
+        self.stats["queue_depth"] = self.scheduler.queue_depth
+        self.stats["running_seqs"] = sum(
+            1 for s in self.slots if s is not None)
+        self.stats["tokens_per_sec"] = (
+            self.stats["generated_tokens"] - tokens_before) / dt
+        self.stats["page_utilization"] = self.pool.utilization()
+        self.stats["peak_pages"] = self.pool.peak_used
+        record_counter("serving.queue_depth", self.stats["queue_depth"])
+        record_counter("serving.running_seqs", self.stats["running_seqs"])
+        record_counter("serving.tokens_per_sec",
+                       self.stats["tokens_per_sec"])
+        record_counter("serving.page_utilization",
+                       self.stats["page_utilization"])
+        for out in finished:
+            self._outputs[out.req_id] = out
+        return finished
+
+    # ------------------------------------------------------------- prefill
+    def _make_prefill(self, bucket: int) -> jit.StaticFunction:
+        trunk, model, n_layers = self.trunk, self.model, self.n_layers
+
+        def prefill_fn(ids, last_pos, *flat_caches):
+            caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
+                      for i in range(n_layers)]
+            with no_grad():
+                hidden, ncs = trunk(ids, caches=caches,
+                                    cur_len=Tensor(jnp.zeros((), jnp.int32),
+                                                   stop_gradient=True))
+                # slice the last REAL position before the vocab matmul:
+                # the padded bucket tail never touches the [V] projection
+                last_h = apply_op(
+                    lambda h, lp: jax.lax.dynamic_slice(
+                        h, (jnp.int32(0), lp.astype(jnp.int32).reshape(()),
+                            jnp.int32(0)),
+                        (1, 1, h.shape[-1])),
+                    [ensure_tensor(hidden), ensure_tensor(last_pos)],
+                    name="prefill_last_hidden")
+                logits = model.logits(last_h)
+            last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
+                            [ensure_tensor(logits)], name="last_logits")
+            flat = [t for c in ncs for t in c]
+            return (last, *flat)
+
+        return jit.StaticFunction(prefill_fn, observe=[self.model],
+                                  warmup=False, dy2static=False)
+
+    def _prefill(self, req: Request) -> Optional[RequestOutput]:
+        s = int(req.prompt.size)
+        bucket = _bucket(s, self.max_model_len)
+        prog = self._prefill_progs.get(bucket)
+        if prog is None:
+            prog = self._prefill_progs[bucket] = self._make_prefill(bucket)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s] = req.prompt
+        n_kv, hd = self.pool.n_kv_heads, self.pool.head_dim
+        flat = [Tensor(jnp.zeros((1, bucket, n_kv, hd), self.pool.dtype),
+                       stop_gradient=True)
+                for _ in range(2 * self.n_layers)]
+        res = prog(Tensor(jnp.asarray(ids)),
+                   Tensor(jnp.asarray(s - 1, jnp.int32)), *flat)
+        last, flat_kv = res[0], res[1:]
+
+        self.pool.allocate(req.req_id, s,
+                           max_total_tokens=req.max_total_tokens)
+        self.pool.write_prompt_kv(req.req_id, [
+            (flat_kv[2 * i]._value[0, :s], flat_kv[2 * i + 1]._value[0, :s])
+            for i in range(self.n_layers)])
+
+        key = jax.random.PRNGKey(req.seed)
+        key, sub = jax.random.split(key)
+        tok = int(np.asarray(self._sample_one(last._value, req.temperature,
+                                              sub)))
+        state = _SeqState(req, pos=s, last_token=tok, key=key)
+        self.stats["generated_tokens"] += 1
+        if req.stream_cb is not None:
+            req.stream_cb(req.req_id, tok, False)
+        return self._maybe_retire(state, slot=None)
+
+    def _sample_one(self, last, temperature, key):
+        """First-token sample after prefill — delegates to the model's
+        ``GenerationMixin._sample`` so there is exactly one copy of the
+        greedy/temperature logic to keep token-identical with dense
+        ``generate()``."""
+        return self.model._sample(last, temperature, 0, key)[0]
+
+    # -------------------------------------------------------------- decode
+    def _make_decode(self) -> jit.StaticFunction:
+        trunk, model, n_layers = self.trunk, self.model, self.n_layers
+
+        def step_fn(tok, pos, temps, key, bt, *flat_pools):
+            caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
+                      for i in range(n_layers)]
+            with no_grad():
+                hidden, ncs = trunk.forward_paged(tok, pos, bt, caches)
+                logits = model.logits(hidden)
+            last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
+                            [ensure_tensor(logits)], name="last_logits")
+
+            def batched_sample(lv, tv, kv):
+                greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+                t = jnp.maximum(tv.astype(jnp.float32), 1e-6)
+                sampled = jax.random.categorical(
+                    kv, lv / t[:, None], axis=-1).astype(jnp.int32)
+                return jnp.where(tv > 0, sampled, greedy)
+
+            nxt = apply_op(batched_sample,
+                           [last, ensure_tensor(temps), ensure_tensor(key)],
+                           name="serve_sample")
+            flat = [t for c in ncs for t in c]
+            return (nxt, *flat)
+
+        return jit.StaticFunction(step_fn, observe=[self.model],
+                                  warmup=False, dy2static=False)
+
+    def _decode_once(self) -> List[RequestOutput]:
+        if self._decode_prog is None:
+            self._decode_prog = self._make_decode()
+        B = self.max_batch_slots
+        tok = np.zeros((B, 1), np.int32)
+        pos = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        seq_ids: List[Optional[object]] = [None] * B
+        for i, st in enumerate(self.slots):
+            if st is None:
+                continue
+            # room for this step's KV write at position st.pos
+            self.pool.append_token(st.req.req_id)
+            tok[i, 0] = st.last_token
+            pos[i] = st.pos
+            temps[i] = st.req.temperature
+            seq_ids[i] = st.req.req_id
+        bt = self.pool.block_table_array(seq_ids, self.pages_per_seq)
+        self._rng, sub = jax.random.split(self._rng)
+        res = self._decode_prog(
+            Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(pos)),
+            Tensor(jnp.asarray(temps)), Tensor(sub),
+            Tensor(jnp.asarray(bt)),
+            *[p for i in range(self.n_layers)
+              for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
+        nxt, flat = res[0], res[1:]
+        self.pool.set_arrays([flat[2 * i] for i in range(self.n_layers)],
+                             [flat[2 * i + 1] for i in range(self.n_layers)])
+        nxt_host = np.asarray(nxt.numpy()).reshape(B)
+
+        finished: List[RequestOutput] = []
+        for i, st in enumerate(self.slots):
+            if st is None:
+                continue
+            t = int(nxt_host[i])
+            st.pos += 1
+            st.last_token = t
+            st.gen.append(t)
+            self.stats["generated_tokens"] += 1
+            if st.req.stream_cb is not None:
+                st.req.stream_cb(st.req.req_id, t, False)
+            out = self._maybe_retire(st, slot=i)
+            if out is not None:
+                finished.append(out)
+        return finished
+
+    # -------------------------------------------------------------- retire
+    def _maybe_retire(self, st: _SeqState,
+                      slot: Optional[int]) -> Optional[RequestOutput]:
+        req = st.req
+        hit_eos = (req.eos_token_id is not None
+                   and st.last_token == req.eos_token_id)
+        if not hit_eos and len(st.gen) < req.max_new_tokens:
+            if slot is None:  # fresh prefill: park in a free slot
+                i = self.slots.index(None)
+                self.slots[i] = st
+            return None
+        # retire NOW: pages go back to the pool this very step
+        self.pool.free(req.req_id)
+        if slot is not None:
+            self.slots[slot] = None
+        self.stats["finished_requests"] += 1
+        out = RequestOutput(req_id=req.req_id,
+                            prompt_token_ids=req.prompt,
+                            token_ids=list(st.gen),
+                            finish_reason="stop" if hit_eos else "length")
+        if req.stream_cb is not None:
+            # terminal call: `finished` is the reason string (truthy, so
+            # bool-style `if finished:` consumers keep working)
+            req.stream_cb(req.req_id, None, out.finish_reason)
+        return out

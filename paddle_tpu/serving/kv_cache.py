@@ -1,0 +1,266 @@
+"""Paged KV-cache pool: fixed page pool + per-sequence block tables.
+
+The serving engine's memory substrate (PAPERS.md: Ragged Paged Attention,
+arxiv 2604.15464 — vLLM-style paging on TPU): instead of one dense
+``[B, max_len, nkv, hd]`` cache per request, every layer owns a fixed pool
+of ``[num_pages, page_size, n_kv_heads, head_dim]`` K and V blocks, and a
+sequence is a *list of page ids* (its block table). Admission, retirement,
+and fork never move KV bytes — only page ids change hands — so the decode
+step's shapes stay fixed while the live batch churns.
+
+Page 0 is the reserved NULL page: block tables are 0-padded and idle batch
+slots carry all-zero tables, so their (masked) KV writes land harmlessly
+there instead of corrupting a live sequence. The allocator hands out pages
+1..num_pages-1.
+
+Allocation is LAZY (a page is taken from the free list only when a token
+actually lands in it) but admission is accounted against each sequence's
+worst case via ``reserve`` — the scheduler admits a request only if the
+pool can cover every live sequence's ``prompt + max_new_tokens`` tail, so
+a mid-decode out-of-pages abort is impossible without preemption.
+
+Sharding note (GSPMD, arxiv 2105.04663): the pool keeps the kv-head axis
+third, matching the dense cache layout the mp mesh shards today — a later
+multi-chip serving PR can shard ``n_kv_heads`` over 'mp' without touching
+the allocator or block tables (page ids are replicated host metadata).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["PagedKVCachePool", "page_bytes", "pages_for_hbm_budget"]
+
+
+def page_bytes(page_size: int, n_kv_heads: int, head_dim: int,
+               num_layers: int, dtype_bytes: int = 4) -> int:
+    """Bytes one page costs across ALL layers (K and V)."""
+    return 2 * num_layers * page_size * n_kv_heads * head_dim * dtype_bytes
+
+
+def pages_for_hbm_budget(hbm_bytes: int, page_size: int, n_kv_heads: int,
+                         head_dim: int, num_layers: int,
+                         dtype_bytes: int = 4) -> int:
+    """Pool sizing math (docs/SERVING.md): pages = HBM budget / page bytes,
+    minus nothing — the caller budgets weights/activations separately."""
+    per = page_bytes(page_size, n_kv_heads, head_dim, num_layers, dtype_bytes)
+    return max(int(hbm_bytes) // per, 0)
+
+
+class PagedKVCachePool:
+    """Fixed K/V page pool per layer + block-table allocator.
+
+    Device state: ``k_pools``/``v_pools`` — one framework Tensor per layer,
+    shape ``[num_pages, page_size, n_kv_heads, head_dim]``. The compiled
+    decode step consumes and returns them functionally; the engine swaps
+    the fresh arrays back in via :meth:`set_arrays`.
+
+    Host state: free list, per-page refcounts (fork shares full pages
+    copy-on-nothing — pages are append-only once full), per-sequence block
+    tables and lengths, worst-case reservations, and the high-water mark
+    (``peak_used``) the page-reuse tests assert on.
+    """
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 n_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_pages, self.page_size, self.n_kv_heads,
+                 self.head_dim)
+        self.k_pools: List[Tensor] = [
+            Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+            for _ in range(self.num_layers)]
+        self.v_pools: List[Tensor] = [
+            Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+            for _ in range(self.num_layers)]
+        # page 0 reserved: free list covers 1..num_pages-1 (LIFO for reuse
+        # locality — a just-freed page is the next handed out)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._ref = np.zeros(self.num_pages, np.int32)
+        self._tables: Dict[object, List[int]] = {}
+        self._lens: Dict[object, int] = {}
+        self._resv: Dict[object, int] = {}
+        self.peak_used = 0
+
+    # ---------------------------------------------------------- accounting
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def used_pages(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_pages / max(self.usable_pages, 1)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return max(math.ceil(int(n_tokens) / self.page_size), 1)
+
+    def _unallocated_reserved(self) -> int:
+        """Pages promised to live sequences but not yet drawn from the
+        free list (their lazy tails)."""
+        return sum(max(r - len(self._tables[s]), 0)
+                   for s, r in self._resv.items())
+
+    def can_admit(self, max_total_tokens: int,
+                  pending_pages: int = 0) -> bool:
+        """True when the pool can cover a new sequence's WORST CASE
+        (``max_total_tokens`` = prompt + max_new_tokens) on top of every
+        live sequence's outstanding reservation — the no-preemption
+        admission guarantee. ``pending_pages`` charges pages promised to
+        requests admitted earlier in the same scheduler step, whose
+        reservations are not recorded here until their prefill runs."""
+        need = self.pages_needed(max_total_tokens)
+        return (need + int(pending_pages)
+                <= len(self._free) - self._unallocated_reserved())
+
+    # ---------------------------------------------------------- allocation
+    def _take_page(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                "KV page pool exhausted — admission accounting should have "
+                "prevented this (reserve() not called?)")
+        p = self._free.pop()
+        self._ref[p] = 1
+        self.peak_used = max(self.peak_used, self.used_pages)
+        return p
+
+    def allocate(self, seq_id, n_tokens: int,
+                 max_total_tokens: Optional[int] = None) -> List[int]:
+        """Create a sequence holding ``n_tokens`` of KV (the prompt), with
+        a worst-case reservation of ``max_total_tokens`` (defaults to
+        ``n_tokens``). Returns the block table."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        resv = self.pages_needed(max_total_tokens
+                                 if max_total_tokens is not None
+                                 else n_tokens)
+        self._tables[seq_id] = []
+        self._lens[seq_id] = 0
+        self._resv[seq_id] = resv
+        self.extend(seq_id, n_tokens)
+        return list(self._tables[seq_id])
+
+    def extend(self, seq_id, total_tokens: int) -> None:
+        """Grow ``seq_id``'s table to cover ``total_tokens`` of KV."""
+        table = self._tables[seq_id]
+        need = self.pages_needed(total_tokens)
+        while len(table) < need:
+            table.append(self._take_page())
+        self._lens[seq_id] = max(self._lens[seq_id], int(total_tokens))
+
+    def append_token(self, seq_id) -> None:
+        """Make room for one more token (the engine calls this right before
+        the decode step writes position ``seq_len``)."""
+        self.extend(seq_id, self._lens[seq_id] + 1)
+
+    def free(self, seq_id) -> None:
+        """Retire a sequence NOW: drop refcounts, return exclusive pages to
+        the free list (immediate reuse — the continuous-batching payoff)."""
+        table = self._tables.pop(seq_id)
+        self._lens.pop(seq_id)
+        self._resv.pop(seq_id, None)
+        for p in table:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+    def fork(self, src_id, dst_id, max_total_tokens: Optional[int] = None
+             ) -> List[int]:
+        """Fork ``src_id`` into ``dst_id`` sharing all FULL pages by
+        refcount (they are append-only once full, so sharing is free); the
+        partial tail page is copied into a fresh page so the two branches
+        can diverge. The substrate for prefix caching / parallel sampling."""
+        if dst_id in self._tables:
+            raise ValueError(f"sequence {dst_id!r} already allocated")
+        src = self._tables[src_id]
+        n = self._lens[src_id]
+        full = n // self.page_size  # pages completely written
+        table: List[int] = []
+        for p in src[:full]:
+            self._ref[p] += 1
+            table.append(p)
+        if full < len(src):  # copy the partial tail
+            tail = self._take_page()
+            for i in range(self.num_layers):
+                kv = self.k_pools[i]._value
+                vv = self.v_pools[i]._value
+                self.k_pools[i] = Tensor(
+                    kv.at[tail].set(kv[src[full]]), stop_gradient=True)
+                self.v_pools[i] = Tensor(
+                    vv.at[tail].set(vv[src[full]]), stop_gradient=True)
+            table.append(tail)
+        self._tables[dst_id] = table
+        self._lens[dst_id] = n
+        self._resv[dst_id] = self.pages_needed(
+            max_total_tokens if max_total_tokens is not None else n)
+        self.peak_used = max(self.peak_used, self.used_pages)
+        return list(table)
+
+    # ------------------------------------------------------------- queries
+    def has_seq(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def block_table(self, seq_id) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def block_table_array(self, seq_ids: Sequence, width: int) -> np.ndarray:
+        """Padded [len(seq_ids), width] int32 block-table batch; ``None``
+        entries (idle slots) and table tails pad with the null page 0."""
+        out = np.zeros((len(seq_ids), width), np.int32)
+        for i, s in enumerate(seq_ids):
+            if s is None:
+                continue
+            t = self._tables[s]
+            if len(t) > width:
+                raise ValueError(
+                    f"sequence {s!r} spans {len(t)} pages > table width "
+                    f"{width}")
+            out[i, :len(t)] = t
+        return out
+
+    # ------------------------------------------------------- device arrays
+    def set_arrays(self, k_arrays, v_arrays) -> None:
+        """Swap in the pools a compiled decode step returned (functional
+        update — the engine's step owns the only in-flight copy)."""
+        self.k_pools = [t if isinstance(t, Tensor)
+                        else Tensor(t, stop_gradient=True)
+                        for t in k_arrays]
+        self.v_pools = [t if isinstance(t, Tensor)
+                        else Tensor(t, stop_gradient=True)
+                        for t in v_arrays]
+
+    def write_prompt_kv(self, seq_id, layer_kv) -> None:
+        """Prefill's KV write hook: scatter a dense prompt cache into this
+        sequence's pages. ``layer_kv`` is a per-layer list of (k, v) arrays
+        ``[S, n_kv_heads, head_dim]`` (S = true prompt length; any padded
+        prefill tail must already be sliced off)."""
+        table = np.asarray(self._tables[seq_id], np.int32)
+        s = int(layer_kv[0][0].shape[0])
+        idx = np.arange(s)
+        page_ids = jnp.asarray(table[idx // self.page_size])
+        offs = jnp.asarray(idx % self.page_size)
+        for li, (k, v) in enumerate(layer_kv):
+            kp = self.k_pools[li]._value
+            vp = self.v_pools[li]._value
+            self.k_pools[li] = Tensor(
+                kp.at[page_ids, offs].set(
+                    jnp.asarray(k).astype(kp.dtype)), stop_gradient=True)
+            self.v_pools[li] = Tensor(
+                vp.at[page_ids, offs].set(
+                    jnp.asarray(v).astype(vp.dtype)), stop_gradient=True)

@@ -1,0 +1,120 @@
+"""Continuous-batching scheduler: FCFS admission under a token budget.
+
+The policy half of the serving engine (the mechanism — pages, compiled
+steps — lives in engine.py/kv_cache.py). Requests queue FCFS; each engine
+step admits waiting requests into free batch slots as long as
+
+1. a fixed decode slot is free (the compiled step's batch is padded to
+   ``max_batch_slots``, so slots — not requests — bound concurrency),
+2. the KV pool can cover the request's WORST CASE (prompt + max_new
+   tokens) on top of every live reservation (kv_cache.can_admit) — with
+   no preemption, admitting on hope would strand a sequence mid-decode,
+3. this step's prefill token budget is not exhausted — prefill compute is
+   O(prompt²) while decode is O(1) per live sequence, so unbounded
+   admission would stall every running stream for one giant prompt
+   (the continuous-batching latency win this budget protects).
+
+Head-of-line semantics: strict FCFS — if the head request doesn't fit,
+nothing behind it is admitted (no starvation of big prompts).
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "RequestOutput", "FCFSScheduler"]
+
+_req_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request (the engine's admission unit)."""
+
+    prompt: np.ndarray  # [S] int32 token ids
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+    # called with (req_id, token_id, finished) as each token lands —
+    # the streaming front door (serving/api.py) hangs SSE-ish chunks off
+    # it. finished is False per token; the terminal call passes token=None
+    # and the finish-reason string ("stop"|"length") as finished (truthy)
+    stream_cb: Optional[Callable] = None
+    req_id: object = field(default_factory=lambda: next(_req_counter))
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    @property
+    def max_total_tokens(self) -> int:
+        return int(self.prompt.size) + int(self.max_new_tokens)
+
+
+@dataclass
+class RequestOutput:
+    """Terminal state of a request (engine.step() returns these)."""
+
+    req_id: object
+    prompt_token_ids: np.ndarray
+    token_ids: List[int]            # generated tokens (incl. eos if hit)
+    finish_reason: str              # "stop" (eos) | "length"
+    n_gen: int = 0
+
+    def __post_init__(self):
+        self.n_gen = len(self.token_ids)
+
+
+class FCFSScheduler:
+    """FCFS waiting queue + per-step admission (policy only: slot/page
+    bookkeeping stays in the engine/pool)."""
+
+    def __init__(self, max_batch_slots: int,
+                 prefill_token_budget: int = 1024):
+        if max_batch_slots < 1:
+            raise ValueError("max_batch_slots must be >= 1")
+        self.max_batch_slots = int(max_batch_slots)
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.waiting: deque = deque()
+
+    def add(self, request: Request) -> None:
+        self.waiting.append(request)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def admit(self, free_slots: int, pool) -> List[Request]:
+        """Pop the FCFS prefix that fits this step: free decode slots,
+        worst-case page reservations, and the prefill token budget."""
+        admitted: List[Request] = []
+        budget = self.prefill_token_budget
+        # pages promised to THIS step's earlier admissions: the pool only
+        # records a reservation at prefill (after admit returns), so
+        # can_admit must be charged for batch-mates or two big requests
+        # admitted together could jointly over-commit the pool
+        pending_pages = 0
+        while self.waiting and free_slots > 0:
+            req = self.waiting[0]
+            if req.prompt.size > budget and admitted:
+                break  # budget spent this step; FCFS head keeps its turn
+            # (an over-budget prompt with no batch-mates still runs, alone
+            # this step, or it would starve forever)
+            if not pool.can_admit(req.max_total_tokens, pending_pages):
+                break  # head-of-line blocks: no overtaking, no starvation
+            self.waiting.popleft()
+            admitted.append(req)
+            pending_pages += pool.pages_needed(req.max_total_tokens)
+            free_slots -= 1
+            budget -= int(req.prompt.size)
+            if budget <= 0:
+                break
+        return admitted

@@ -23,6 +23,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # belt-and-braces with pyproject.toml [tool.pytest.ini_options]: the
+    # marker stays registered when tests run from a checkout that pytest
+    # didn't root at the repo (e.g. pytest tests/ from another cwd)
+    config.addinivalue_line(
+        "markers",
+        "serving: paddle_tpu.serving continuous-batching engine tests")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import paddle_tpu

@@ -147,6 +147,46 @@ class TestProfiler:
                 p.step()
         assert exports == [["work1"], ["work3"]]
 
+    def test_engine_step_spans_and_counters_in_trace(self, tmp_path):
+        """Serving steps appear in chrome traces: engine.step() wraps in a
+        RecordEvent('engine_step') span and pushes the engine gauges
+        through record_counter (ph 'C' events + summary table)."""
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.serving import ServingEngine
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            num_key_value_heads=2, max_position_embeddings=32))
+        engine = ServingEngine(model, page_size=4, max_batch_slots=1)
+        engine.add_request(np.arange(4), max_new_tokens=2)
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=export_chrome_tracing(str(tmp_path)),
+                     trace_dir=str(tmp_path))
+        p.start()
+        while engine.has_work:
+            engine.step()
+            p.step()
+        p.stop()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.endswith(".paddle_trace.json")]
+        assert files
+        trace = load_profiler_result(os.path.join(tmp_path, files[0]))
+        spans = [e for e in trace["traceEvents"]
+                 if e["name"] == "engine_step" and e["ph"] == "X"]
+        assert spans, "no engine_step spans in the chrome trace"
+        counters = {e["name"] for e in trace["traceEvents"]
+                    if e["ph"] == "C"}
+        assert "serving.queue_depth" in counters
+        assert "serving.tokens_per_sec" in counters
+        out = p.summary()
+        assert "engine_step" in out and "serving.queue_depth" in out
+
+    def test_record_counter_noop_without_profiler(self):
+        from paddle_tpu.profiler import record_counter
+
+        record_counter("orphan.gauge", 1.0)  # must not raise
+
     def test_step_events_exported_with_timestamps(self, tmp_path):
         p = Profiler(targets=[ProfilerTarget.CPU],
                      on_trace_ready=export_chrome_tracing(str(tmp_path)),

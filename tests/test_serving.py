@@ -1,0 +1,432 @@
+"""paddle_tpu.serving: continuous-batching engine over the paged KV cache.
+
+Acceptance gates (ISSUE 1): paged-fallback decode is TOKEN-IDENTICAL to
+dense ``generate()`` on mixed-length prompts, with eos mid-batch and a
+request admitted after step 0; retired sequences' pages are reused (pool
+high-water mark < the sum of per-request dense caches on a staggered
+workload); and the decode step compiles a BOUNDED number of times while
+the live batch churns. The pallas kernel itself runs in interpret mode
+(tests/test_flash_attention.py pattern); everything else drives the
+pure-jnp fallback — the same code path a CPU build serves with.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (GPTForCausalLM, LlamaForCausalLM, gpt_tiny,
+                               llama_tiny)
+from paddle_tpu.serving import (CompletionAPI, EnginePool, FCFSScheduler,
+                                PagedKVCachePool, Request, ServingEngine,
+                                page_bytes, pages_for_hbm_budget)
+
+pytestmark = pytest.mark.serving
+
+
+def _llama():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+
+
+def _gpt():
+    paddle.seed(0)
+    return GPTForCausalLM(gpt_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+
+
+def _dense_gen(model, prompt, n, eos=None):
+    """Per-request dense reference: generated ids only."""
+    out = model.generate(paddle.to_tensor(np.asarray(prompt)[None, :]),
+                         max_new_tokens=n, temperature=0.0,
+                         eos_token_id=eos)
+    return np.asarray(out.numpy())[0, len(prompt):]
+
+
+_PROMPTS = [np.random.RandomState(7).randint(0, 128, (n,))
+            for n in (5, 9, 3)]
+
+
+# ───────────────────────── kernel (interpret mode) ─────────────────────────
+
+
+class TestPagedAttentionKernel:
+    def test_kernel_matches_fallback(self, monkeypatch):
+        """The real pallas kernel (scalar-prefetched block tables, online
+        softmax over the ragged page list) against the jnp gather
+        fallback, on CPU via interpret mode."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas import paged_attention as pa
+
+        rng = np.random.default_rng(0)
+        B, nh, nkv, hd, page, pages, width = 3, 4, 2, 64, 8, 12, 4
+        q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((pages, page, nkv, hd)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((pages, page, nkv, hd)),
+                         jnp.float32)
+        bt = jnp.asarray(rng.integers(1, pages, (B, width)), jnp.int32)
+        sl = jnp.asarray([1, 17, 32], jnp.int32)  # ragged, incl. 1 token
+        ref = pa.ref_paged_attention(q, kp, vp, bt, sl)
+        out = pa.paged_attention(q, kp, vp, bt, sl, use_kernel=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+# ───────────────────────────── kv-cache pool ─────────────────────────────
+
+
+class TestPagedKVCachePool:
+    def _pool(self, pages=9):
+        return PagedKVCachePool(num_layers=1, num_pages=pages, page_size=4,
+                                n_kv_heads=2, head_dim=8)
+
+    def test_alloc_free_reuse_and_null_page(self):
+        pool = self._pool()
+        t = pool.allocate("a", 6)  # 2 pages
+        assert 0 not in t and len(t) == 2 and pool.used_pages == 2
+        pool.allocate("b", 4)
+        assert pool.used_pages == 3
+        pool.free("a")
+        assert pool.used_pages == 1
+        t2 = pool.allocate("c", 8)
+        assert set(t2) <= set(range(1, 9))  # freed pages recycled
+        assert pool.peak_used == 3
+
+    def test_lazy_extend_and_reservation_accounting(self):
+        pool = self._pool(pages=5)  # 4 usable
+        pool.allocate("a", 2, max_total_tokens=12)  # 1 page now, 3 reserved
+        assert pool.used_pages == 1
+        assert not pool.can_admit(8)  # 2 pages wanted, only 1 unreserved
+        assert pool.can_admit(4)
+        for _ in range(3):  # tokens 3, 4, 5 — position 4 opens page 2
+            pool.append_token("a")
+        assert pool.used_pages == 2
+
+    def test_can_admit_charges_same_step_pending_pages(self):
+        """Batch-mates admitted in one scheduler step reserve nothing in
+        the pool until their prefill runs — can_admit must charge their
+        pending pages or two big requests would jointly over-commit."""
+        pool = self._pool(pages=6)  # 5 usable
+        assert pool.can_admit(12)                     # 3 pages alone: fits
+        assert not pool.can_admit(12, pending_pages=3)  # with a batch-mate
+
+    def test_pool_exhaustion_raises(self):
+        pool = self._pool(pages=3)
+        pool.allocate("a", 8)
+        with pytest.raises(RuntimeError):
+            pool.allocate("b", 4)
+
+    def test_fork_shares_full_pages_and_copies_tail(self):
+        import jax.numpy as jnp
+
+        pool = self._pool()
+        pool.allocate("src", 6)  # page0 full (4 tokens), page1 partial (2)
+        k = jnp.arange(9 * 4 * 2 * 8, dtype=jnp.float32).reshape(9, 4, 2, 8)
+        pool.set_arrays([k], [k + 1000.0])
+        src_table = pool.block_table("src")
+        dst_table = pool.fork("src", "dst")
+        assert dst_table[0] == src_table[0]       # full page shared
+        assert dst_table[1] != src_table[1]       # tail copied
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pools[0]._value[dst_table[1]]),
+            np.asarray(pool.k_pools[0]._value[src_table[1]]))
+        pool.free("src")  # shared page must survive the src retirement
+        assert pool.has_seq("dst")
+        used_after = pool.used_pages
+        assert used_after == 2  # shared full page + dst tail
+        pool.free("dst")
+        assert pool.used_pages == 0
+
+    def test_sizing_math(self):
+        # docs/SERVING.md worked example: 8 MiB/page, 10 GiB -> 1280 pages
+        pb = page_bytes(page_size=16, n_kv_heads=32, head_dim=128,
+                        num_layers=32, dtype_bytes=2)
+        assert pb == 8 * 2 ** 20
+        assert pages_for_hbm_budget(10 * 2 ** 30, 16, 32, 128, 32, 2) == 1280
+
+
+# ───────────────────────────── scheduler ─────────────────────────────
+
+
+class TestFCFSScheduler:
+    def test_fcfs_order_token_budget_and_head_of_line(self):
+        pool = PagedKVCachePool(1, 64, 4, 2, 8)
+        sched = FCFSScheduler(max_batch_slots=4, prefill_token_budget=8)
+        reqs = [Request(prompt=np.arange(1, 6), max_new_tokens=2),
+                Request(prompt=np.arange(1, 5), max_new_tokens=2),
+                Request(prompt=np.arange(1, 3), max_new_tokens=2)]
+        for r in reqs:
+            sched.add(r)
+        first = sched.admit(free_slots=4, pool=pool)
+        # budget 8: req0 (5 tok) fits; req1 (4 tok) would overflow -> waits
+        assert [r.req_id for r in first] == [reqs[0].req_id]
+        assert sched.queue_depth == 2
+        # next step: req1 (4) + req2 (2) fit the fresh budget together
+        assert [r.req_id for r in sched.admit(4, pool)] == [
+            reqs[1].req_id, reqs[2].req_id]
+
+    def test_no_overtaking_when_pool_full(self):
+        pool = PagedKVCachePool(1, 3, 4, 2, 8)  # 2 usable pages
+        pool.allocate("live", 8)  # pool full
+        sched = FCFSScheduler(max_batch_slots=4)
+        big = Request(prompt=np.arange(1, 9), max_new_tokens=1)
+        small = Request(prompt=np.arange(1, 3), max_new_tokens=1)
+        sched.add(big)
+        sched.add(small)
+        assert sched.admit(4, pool) == []  # head blocks; no starvation
+        assert sched.queue_depth == 2
+
+
+# ─────────────────────────── engine acceptance ───────────────────────────
+
+
+def test_engine_smoke_fast():
+    """<5s tier-1 smoke: smallest viable engine pass (1-layer llama, one
+    prefill-only request) — admission, page alloc, prefill program,
+    retire+free. The compiled decode step is covered by the (also tier-1)
+    equivalence tests; keeping it out of the smoke keeps this under 5s."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=32, hidden_size=16, num_layers=1, num_heads=1,
+        num_key_value_heads=1, max_position_embeddings=16))
+    engine = ServingEngine(model, page_size=4, max_batch_slots=1)
+    rid = engine.add_request(np.arange(1, 5), max_new_tokens=1)
+    outs = engine.run()
+    assert outs[rid].n_gen == 1
+    assert all(0 <= t < 32 for t in outs[rid].token_ids)
+    assert engine.pool.used_pages == 0
+    assert engine.stats["finished_requests"] == 1
+
+
+class TestEngineEquivalence:
+    def test_paged_matches_dense_mixed_lengths_eos_and_late_admission(self):
+        """The ISSUE acceptance test in one workload: mixed-length
+        prompts, one row stopping on eos mid-batch, and a request
+        admitted after step 0 — every request token-identical to its
+        dense ``generate()`` run."""
+        model = _llama()
+        eos_probe = int(_dense_gen(model, _PROMPTS[0], 3)[2])  # hits at t3
+        dense = [
+            _dense_gen(model, _PROMPTS[0], 8, eos=eos_probe),
+            _dense_gen(model, _PROMPTS[1], 6),
+            _dense_gen(model, _PROMPTS[2], 5),
+        ]
+        engine = ServingEngine(model, page_size=4, max_batch_slots=2)
+        r0 = engine.add_request(_PROMPTS[0], max_new_tokens=8,
+                                eos_token_id=eos_probe)
+        r1 = engine.add_request(_PROMPTS[1], max_new_tokens=6)
+        engine.step()  # admit + prefill r0/r1, decode step 0
+        r2 = engine.add_request(_PROMPTS[2], max_new_tokens=5)  # mid-decode
+        outs = engine.run()
+        # dense freezes finished rows with eos padding; the engine stops
+        # the row at eos — compare up to the engine's (shorter) output
+        got0 = np.asarray(outs[r0].token_ids)
+        np.testing.assert_array_equal(got0, dense[0][:got0.size])
+        assert outs[r0].finish_reason == "stop"
+        assert got0[-1] == eos_probe
+        np.testing.assert_array_equal(np.asarray(outs[r1].token_ids),
+                                      dense[1])
+        np.testing.assert_array_equal(np.asarray(outs[r2].token_ids),
+                                      dense[2])
+        assert outs[r2].finish_reason == "length"
+        # everything retired -> every page back on the free list
+        assert engine.pool.used_pages == 0
+
+    def test_decode_compiles_bounded_across_live_batch_churn(self):
+        """The compiled decode step is padded to fixed slots: admission,
+        retirement, and ragged lengths must never retrace it."""
+        model = _llama()
+        engine = ServingEngine(model, page_size=4, max_batch_slots=3)
+        rng = np.random.RandomState(3)
+        for n, new in ((4, 2), (6, 5), (3, 3), (5, 7), (4, 1), (7, 4)):
+            engine.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new)
+            engine.step()  # live batch size churns every step
+        engine.run()
+        counts = engine.compile_counts()
+        assert counts["decode"] == 1, counts
+        # prefill buckets are powers of two: lengths 3..7 -> ONE bucket (16)
+        assert counts["prefill"] == 1, counts
+
+    def test_page_reuse_staggered_high_water_mark(self):
+        """Retired sequences' pages serve later requests: on a staggered
+        workload the pool's high-water mark stays strictly under the sum
+        of per-request dense caches (what generate() would pin)."""
+        model = _llama()
+        engine = ServingEngine(model, page_size=4, max_batch_slots=2)
+        rng = np.random.RandomState(5)
+        reqs = [(rng.randint(0, 128, (6,)), 6) for _ in range(6)]
+        for p, n in reqs:
+            engine.add_request(p, max_new_tokens=n)
+        outs = engine.run()
+        assert len(outs) == 6
+        dense_pages_equiv = sum(
+            -(-(len(p) + n) // engine.page_size) for p, n in reqs)
+        assert engine.pool.peak_used < dense_pages_equiv
+        # 2 slots * 3 pages worst case -> the mark is the concurrency cap
+        assert engine.pool.peak_used <= 2 * 3
+        assert engine.pool.used_pages == 0
+
+    def test_gpt_engine_smoke(self):
+        """Fast CPU smoke (tier-1): the GPT adapter end-to-end — learned
+        position embeddings gathered per row, fused qkv write hook."""
+        model = _gpt()
+        dense = _dense_gen(model, _PROMPTS[2], 4)
+        engine = ServingEngine(model, page_size=4, max_batch_slots=2)
+        rid = engine.add_request(_PROMPTS[2], max_new_tokens=4)
+        outs = engine.run()
+        np.testing.assert_array_equal(np.asarray(outs[rid].token_ids), dense)
+
+    def test_add_request_validates_length(self):
+        engine = ServingEngine(_llama(), page_size=4, max_batch_slots=1)
+        with pytest.raises(ValueError):
+            engine.add_request(np.arange(60), max_new_tokens=10)  # > 64
+
+    def test_add_request_rejects_pool_impossible(self):
+        """A request whose worst case exceeds the whole pool must be
+        rejected at add_request — queueing it would leave run() spinning
+        forever on a head request that can never pass can_admit."""
+        engine = ServingEngine(_llama(), page_size=4, num_pages=3,
+                               max_batch_slots=1)
+        with pytest.raises(ValueError, match="usable pages"):
+            engine.add_request(np.arange(8), max_new_tokens=4)  # 3 > 2
+
+    def test_undersized_pool_serializes_not_overcommits(self):
+        """Two requests that each fit alone but not together: one
+        scheduler step must admit only the first (pending-page
+        accounting), the second runs after its pages free — no mid-decode
+        pool exhaustion."""
+        model = _llama()
+        # 5 usable pages; each request's worst case is 3 pages
+        engine = ServingEngine(model, page_size=4, num_pages=6,
+                               max_batch_slots=2)
+        dense = [_dense_gen(model, _PROMPTS[1], 6),
+                 _dense_gen(model, _PROMPTS[2], 6)]
+        r0 = engine.add_request(_PROMPTS[1], max_new_tokens=6)
+        r1 = engine.add_request(_PROMPTS[2], max_new_tokens=6)
+        engine.step()
+        assert engine.stats["running_seqs"] == 1  # r1 waits, not admitted
+        outs = engine.run()
+        np.testing.assert_array_equal(np.asarray(outs[r0].token_ids),
+                                      dense[0])
+        np.testing.assert_array_equal(np.asarray(outs[r1].token_ids),
+                                      dense[1])
+        assert engine.pool.peak_used <= 5
+        assert engine.pool.used_pages == 0
+        assert engine.run() == {}  # outputs drain: handed out exactly once
+
+
+# ──────────────────────────── front door (api) ────────────────────────────
+
+
+class TestCompletionAPI:
+    def test_openai_shape_streaming_and_usage(self):
+        model = _llama()
+        engine = ServingEngine(model, page_size=4, max_batch_slots=2)
+        api = CompletionAPI(engine, model_name="llama-tiny")
+        chunks = []
+        resp = api.create_completion(
+            [_PROMPTS[0], _PROMPTS[2]], max_tokens=3,
+            stream_cb=chunks.append)
+        assert resp["object"] == "text_completion"
+        assert resp["model"] == "llama-tiny"
+        assert len(resp["choices"]) == 2
+        for i, ch in enumerate(resp["choices"]):
+            assert ch["index"] == i
+            assert len(ch["token_ids"]) == 3
+            assert ch["finish_reason"] == "length"
+        assert resp["usage"]["prompt_tokens"] == (
+            _PROMPTS[0].size + _PROMPTS[2].size)
+        assert resp["usage"]["completion_tokens"] == 6
+        # streamed chunks: 3 tokens + 1 finish per choice, and the
+        # terminal chunk's reason agrees with the final response's
+        tok_chunks = [c for c in chunks
+                      if c["choices"][0]["token_id"] is not None]
+        fin_chunks = [c for c in chunks
+                      if c["choices"][0]["finish_reason"] is not None]
+        assert len(tok_chunks) == 6 and len(fin_chunks) == 2
+        assert all(c["choices"][0]["finish_reason"] == "length"
+                   for c in fin_chunks)
+        assert all(c["object"] == "text_completion.chunk" for c in chunks)
+        # streamed ids replay the final choice ids, in order
+        ids0 = [c["choices"][0]["token_id"] for c in tok_chunks
+                if c["choices"][0]["index"] == 0]
+        assert ids0 == resp["choices"][0]["token_ids"]
+
+    def test_batch_prevalidation_leaves_no_orphans(self):
+        """One bad prompt in a batch must reject the WHOLE call before
+        anything queues — otherwise its batch-mates would run as orphans
+        on the next create_completion and their outputs be discarded."""
+        engine = ServingEngine(_llama(), page_size=4, max_batch_slots=2)
+        api = CompletionAPI(engine)
+        with pytest.raises(ValueError):
+            api.create_completion([_PROMPTS[0], np.arange(60)],
+                                  max_tokens=10)  # 70 > max_model_len 64
+        assert engine.scheduler.queue_depth == 0 and not engine.has_work
+
+    def test_batch_mates_get_distinct_seeds(self):
+        """n-best sampling of one prompt: each choice must draw its first
+        token from its own stream (seed + index), not n copies of one."""
+        engine = ServingEngine(_llama(), page_size=4, max_batch_slots=2)
+        api = CompletionAPI(engine)
+        seeds = []
+        orig = engine.add_request
+        engine.add_request = (
+            lambda p, **kw: (seeds.append(kw["seed"]), orig(p, **kw))[1])
+        api.create_completion([_PROMPTS[2], _PROMPTS[2]], max_tokens=2,
+                              seed=7)
+        assert seeds == [7, 8]
+
+    def test_engine_pool_retrieve(self):
+        pool = EnginePool(_llama(), size=2, page_size=4, max_batch_slots=1)
+        assert len(pool) == 2
+        assert pool.retrieve(0) is not pool.retrieve(1)
+        rid = pool.retrieve(1).add_request(_PROMPTS[2], max_new_tokens=2)
+        outs = pool.retrieve(1).run()
+        assert outs[rid].n_gen == 2
+
+
+# ─────────────────────── generation stats satellite ───────────────────────
+
+
+class TestGenerateStats:
+    def test_return_stats_length_and_eos(self):
+        model = _llama()
+        ids, st = model.generate(paddle.to_tensor(_PROMPTS[1][None, :]),
+                                 max_new_tokens=4, temperature=0.0,
+                                 return_stats=True)
+        assert st == {"n_gen": 4, "stop_reason": "length"}
+        assert ids.shape[1] == _PROMPTS[1].size + 4
+        eos = int(_dense_gen(model, _PROMPTS[1], 1)[0])
+        _, st2 = model.generate(paddle.to_tensor(_PROMPTS[1][None, :]),
+                                max_new_tokens=6, temperature=0.0,
+                                eos_token_id=eos, return_stats=True)
+        assert st2["stop_reason"] == "eos" and st2["n_gen"] < 6
+
+
+# ─────────────────────────── slow batch sweeps ───────────────────────────
+
+
+@pytest.mark.slow
+class TestBatchSweeps:
+    @pytest.mark.parametrize("slots", [1, 4, 8])
+    def test_oversubscribed_sweep_all_complete_and_match(self, slots):
+        """2x-oversubscribed mixed workload at each slot count: every
+        request completes and matches dense generate token-for-token."""
+        model = _llama()
+        rng = np.random.RandomState(11 + slots)
+        work = [(rng.randint(0, 128, (int(rng.randint(2, 12)),)),
+                 int(rng.randint(1, 8))) for _ in range(2 * slots)]
+        dense = [_dense_gen(model, p, n) for p, n in work]
+        engine = ServingEngine(model, page_size=4, max_batch_slots=slots)
+        rids = [engine.add_request(p, max_new_tokens=n) for p, n in work]
+        outs = engine.run()
+        for rid, want in zip(rids, dense):
+            np.testing.assert_array_equal(
+                np.asarray(outs[rid].token_ids), want)
+        assert engine.compile_counts()["decode"] == 1
+        assert engine.pool.used_pages == 0

@@ -6,6 +6,12 @@ decode is ONE compiled program (models/generation.py device loop), so
 the measurement is real device time, not 63ms-per-token tunnel round
 trips. Covers GPT-355M and Llama-0.76B (set BENCH_DECODE_MODELS to a
 comma list to narrow). Appends each row to BENCH_NOTES_r05.json.
+
+``--paged``: continuous-batching engine sweep (paddle_tpu.serving) —
+engine tokens/s vs this dense loop at batch {1, 8, 32}, one JSON row per
+(mode, batch) in the same record shape as the dense rows
+(``*_paged_decode_tokens_per_sec_per_chip`` vs
+``*_decode_tokens_per_sec_per_chip``).
 """
 import json
 import os
@@ -110,6 +116,56 @@ def _bench_one(model_name, rt, B, prompt, new, dev, small):
         f.write(json.dumps(rec) + "\n")
 
 
+def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
+    """Engine (paged, continuous-batching) throughput at batch B — same
+    record shape as _bench_one so BENCH digests treat both alike."""
+    import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu.serving import ServingEngine
+
+    metric = f"{model_name}_paged_decode_tokens_per_sec_per_chip"
+    if not small and _already_banked(metric, B, prompt, new):
+        print(f"paged[{model_name}]: b{B}-p{prompt}-n{new} already banked "
+              "this round — skipping", file=sys.stderr)
+        return
+    model, vocab, label = _build(model_name, prompt, new, small)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, (prompt,)) for _ in range(B)]
+    engine = ServingEngine(
+        model, page_size=16, max_batch_slots=B,
+        prefill_token_budget=max(B * prompt, 1024))
+
+    def run_once():
+        for p in prompts:
+            engine.add_request(p, max_new_tokens=new, temperature=0.0)
+        engine.run()
+
+    t0 = time.time()
+    run_once()  # compile prefill bucket + the single decode program
+    compile_s = time.time() - t0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_once()
+        best = min(best, time.perf_counter() - t0 - rt)
+    tok_s = B * new / best
+    rec = {
+        "metric": metric,
+        "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": 1.0,
+        "config": label + "-paged" + _geometry(B, prompt, new),
+        "total_s": round(best, 3), "compile_s": round(compile_s, 1),
+        "per_token_ms": round(1e3 * best / new, 2),
+        "decode_compiles": engine.compile_counts()["decode"],
+        "peak_pages": engine.pool.peak_used,
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def main():
     from _bench_timing import probe_or_exit, roundtrip_baseline
 
@@ -140,6 +196,21 @@ def main():
         sys.exit(2)
     rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
     failures = 0
+    if "--paged" in sys.argv:
+        # engine-vs-dense sweep: one dense and one paged row per batch
+        batches = [int(b) for b in os.environ.get(
+            "BENCH_PAGED_BATCHES", "1,8,32").split(",") if b.strip()]
+        for name in models:
+            for b in batches:
+                for fn, tag in ((_bench_one, "decode"),
+                                (_bench_paged_one, "paged")):
+                    try:
+                        fn(name, rt, b, prompt, new, dev, small)
+                    except Exception as e:
+                        failures += 1
+                        print(f"{tag}[{name}] b{b}: {type(e).__name__}: "
+                              f"{str(e)[:160]}", file=sys.stderr)
+        sys.exit(1 if failures else 0)
     for name in models:
         try:
             _bench_one(name, rt, B, prompt, new, dev, small)

@@ -51,6 +51,7 @@ NAMESPACES = [
     "paddle_tpu.signal",
     "paddle_tpu.onnx",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.quantization",
     "paddle_tpu.profiler",
     "paddle_tpu.incubate.nn",
