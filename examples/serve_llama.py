@@ -42,3 +42,19 @@ api = CompletionAPI(engine, model_name="llama-tiny")
 resp = api.create_completion(prompts[0], max_tokens=8)
 print(f"{resp['object']}: {resp['choices'][0]['token_ids']} "
       f"({resp['usage']['completion_tokens']} completion tokens)")
+
+# telemetry rode along the whole time (docs/OBSERVABILITY.md): TTFT /
+# inter-token percentiles from the always-on registry, and a one-liner
+# scrape endpoint any Prometheus can poll
+from paddle_tpu import metrics  # noqa: E402
+
+reg = metrics.get_registry()
+ttft = reg.get("paddle_tpu_serving_ttft_seconds")
+itl = reg.get("paddle_tpu_serving_inter_token_seconds")
+print(f"ttft p50={ttft.quantile(0.5)*1e3:.1f}ms "
+      f"p99={ttft.quantile(0.99)*1e3:.1f}ms | "
+      f"itl p50={itl.quantile(0.5)*1e3:.1f}ms "
+      f"({itl.count} gaps observed)")
+with metrics.MetricsServer(port=0) as srv:   # port=0: pick a free port
+    print(f"scrape endpoint (for real deployments keep it running): "
+          f"{srv.url}/metrics")

@@ -74,6 +74,7 @@ from . import utils  # noqa: E402
 from . import version  # noqa: E402
 from .utils.flops import flops  # noqa: E402
 from . import text  # noqa: E402
+from . import metrics  # noqa: E402
 from . import profiler  # noqa: E402
 from . import serving  # noqa: E402
 from . import reader  # noqa: E402

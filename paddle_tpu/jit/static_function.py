@@ -504,6 +504,18 @@ class StaticFunction:
 
     # -- compile -------------------------------------------------------------
     def _build(self, spec, meta):
+        # every cache miss IS a compile event: counting here makes the
+        # "decode compiles exactly once" invariant a monitorable metric
+        # (paddle_tpu_jit_compiles_total{fn=...}), not just a test
+        # assertion — a recompile storm shows up on /metrics before it
+        # shows up as a latency cliff
+        from ..metrics import get_registry
+
+        get_registry().counter(
+            "paddle_tpu_jit_compiles_total",
+            "XLA program compiles (one per new StaticFunction input "
+            "signature)", labels=("fn",),
+        ).labels(fn=self.__name__).inc()
         slots, opts, fn = self._slots, self._opts, self._fn
         holder = _Compiled(None)
 

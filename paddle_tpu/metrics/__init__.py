@@ -1,0 +1,41 @@
+"""paddle_tpu.metrics — always-on, low-overhead telemetry.
+
+The operational counterpart of ``paddle_tpu.profiler`` (docs/
+OBSERVABILITY.md): the profiler answers "why was step 4182 slow" with
+sampled chrome/xplane traces; this registry answers "what are the TTFT
+p99 and queue depth *right now*" with typed instruments that are always
+recording and cost nanoseconds per sample.
+
+    from paddle_tpu import metrics
+
+    reg = metrics.get_registry()
+    reqs = reg.counter("paddle_tpu_serving_requests_total",
+                       "Requests by lifecycle event", labels=("event",))
+    reqs.labels(event="admitted").inc()
+
+    lat = reg.histogram("paddle_tpu_serving_ttft_seconds",
+                        "Time to first token")
+    with lat.time():
+        serve_one()
+
+    print(reg.expose_prometheus())        # Prometheus text format
+    snap = reg.snapshot()                 # JSON-able dict, p50/p95/p99
+
+    metrics.MetricsServer(port=9100).start()   # GET /metrics, /healthz
+
+Naming convention: ``paddle_tpu_<subsystem>_<name>_<unit>`` (seconds,
+total, ...). Built-in instrumentation (serving engine, jit compiles,
+optimizer steps, ``profiler.record_counter`` bridge) registers in the
+default registry; ``get_registry().disable()`` reduces every sample to a
+flag check.
+"""
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       exponential_buckets, get_registry,
+                       sanitize_metric_name, time_histogram)
+from .server import MetricsServer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
+    "exponential_buckets", "get_registry", "sanitize_metric_name",
+    "time_histogram",
+]

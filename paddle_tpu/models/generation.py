@@ -74,11 +74,14 @@ class GenerationMixin:
         off elsewhere (the host loop is easier to debug and can stop the
         moment EOS lands instead of at the compiled cond check).
         """
+        import time
+
         import numpy as np
 
-        from .. import jit
+        from .. import jit, metrics
         from ..autograd.engine import no_grad
 
+        _gen_t0 = time.perf_counter()
         cfg = self.config
         trunk = self._decode_trunk()
         n_layers, nh_c, hd = self._cache_spec()
@@ -108,6 +111,8 @@ class GenerationMixin:
                 [last, ensure_tensor(key)], name="sample")
             flat = [t for c in ncs for t in c]
             return (nxt, *flat)
+
+        step_fn.__name__ = "generate_step"  # jit_compiles_total{fn=...}
 
         # compiled prefill/decode are cached on the model per signature:
         # repeated generate() calls pay tracing+compilation once
@@ -183,6 +188,16 @@ class GenerationMixin:
         if was_training:
             self.train()
         ids_out = Tensor(jnp.asarray(np.concatenate(out, axis=1)))
+        reg = metrics.get_registry()
+        reg.histogram(
+            "paddle_tpu_generate_seconds",
+            "Whole dense generate() call (prefill + all decode steps, "
+            "compile included on the first signature)",
+        ).observe(time.perf_counter() - _gen_t0)
+        reg.counter(
+            "paddle_tpu_generate_tokens_total",
+            "Tokens emitted by dense generate() across all rows",
+        ).inc(B * (int(ids_out.shape[1]) - S0))
         if not return_stats:
             return ids_out
         stats = {"n_gen": int(ids_out.shape[1]) - S0,
@@ -249,4 +264,5 @@ class GenerationMixin:
                                   *[ensure_tensor(c) for c in flat_caches]],
                             name="generate_device_loop")
 
+        loop_fn.__name__ = "generate_device_loop"
         return loop_fn

@@ -15,6 +15,7 @@ machinery ``_add_accumulator``), reshaped TPU-first:
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterable, Optional, Sequence, Union
 
 import jax.numpy as jnp
@@ -201,7 +202,18 @@ class Optimizer:
           check_finite_and_unscale + update_loss_scaling ops): when the traced
           flag is true the whole update is a jnp.where no-op — the traceable
           equivalent of the reference's skip-step.
+
+        Telemetry: each call lands in
+        ``paddle_tpu_train_optimizer_step_seconds`` /
+        ``..._steps_total``. Inside a jit-compiled train step this python
+        body runs only at trace time, so the metrics then count *traces*
+        (and time tracing), not executed steps — eager training gets
+        per-step numbers (docs/OBSERVABILITY.md).
         """
+        from .. import metrics
+
+        _reg = metrics.get_registry()
+        _t0 = time.perf_counter() if _reg.enabled else 0.0
         params_grads = self._collect_params_grads()
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
@@ -245,6 +257,17 @@ class Optimizer:
             self._accumulators[p._uid] = new_accs
         self._found_inf = None  # consume-once: a stale flag must not freeze future steps
         self._global_step += 1
+        # _t0 > 0 guard: if the registry was enabled mid-step, _t0 is the
+        # 0.0 sentinel and observing perf_counter()-0 would poison the
+        # histogram with an absolute-clock outlier
+        if _reg.enabled and _t0 > 0.0:
+            _reg.histogram(
+                "paddle_tpu_train_optimizer_step_seconds",
+                "One Optimizer.step(): clip + per-param updates"
+            ).observe(time.perf_counter() - _t0)
+            _reg.counter(
+                "paddle_tpu_train_optimizer_steps_total",
+                "Optimizer.step() calls (trace-time only under jit)").inc()
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         """reference: optimizer.py:1391 — in dygraph the reference's

@@ -139,13 +139,46 @@ def load_profiler_result(filename: str):
 # --------------------------------------------------------------- record event
 _active_profiler: Optional["Profiler"] = None
 
+# registry bridge: the chrome trace (sampled, RECORD windows only) and
+# /metrics (always on) are two views over the same record_counter /
+# RecordEvent call sites — see docs/OBSERVABILITY.md
+_counter_gauges: dict = {}   # raw name -> metrics Gauge (child) cache
+_event_hist = None           # paddle_tpu_profiler_event_seconds family
+
+
+def _registry_gauge(name: str):
+    g = _counter_gauges.get(name)
+    if g is None:
+        from ..metrics import get_registry, sanitize_metric_name
+
+        g = get_registry().gauge(
+            sanitize_metric_name(name),
+            f"record_counter({name!r}) gauge (profiler bridge)")
+        _counter_gauges[name] = g
+    return g
+
+
+def _registry_event_hist():
+    global _event_hist
+    if _event_hist is None:
+        from ..metrics import get_registry
+
+        _event_hist = get_registry().histogram(
+            "paddle_tpu_profiler_event_seconds",
+            "RecordEvent span durations (profiler bridge)",
+            labels=("event",))
+    return _event_hist
+
 
 class RecordEvent:
     """reference: utils.py:37 / event_tracing.h:36 — user-scoped span.
 
-    Records host wall-time into the active Profiler (when RECORDing) and
-    enters a jax TraceAnnotation so the span also appears on the device
-    timeline inside xplane traces.
+    Every span's wall-time lands in the metrics registry histogram
+    ``paddle_tpu_profiler_event_seconds{event=<name>}`` (always on,
+    unless the registry is disabled). When a Profiler is RECORDing, the
+    span is additionally recorded into the chrome-trace buffer and enters
+    a jax TraceAnnotation so it appears on the device timeline inside
+    xplane traces.
     """
 
     def __init__(self, name: str, event_type=None):
@@ -153,6 +186,7 @@ class RecordEvent:
         self.event_type = event_type
         self._t0 = None
         self._ann = None
+        self._to_prof = False
 
     def __enter__(self):
         self.begin()
@@ -173,15 +207,17 @@ class RecordEvent:
 
     def begin(self):
         prof = _active_profiler
-        if prof is None or not prof._recording:
-            return
-        try:
-            import jax.profiler as jprof
+        self._to_prof = prof is not None and prof._recording
+        if self._to_prof:
+            try:
+                import jax.profiler as jprof
 
-            self._ann = jprof.TraceAnnotation(self.name)
-            self._ann.__enter__()
-        except Exception:
-            self._ann = None
+                self._ann = jprof.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        elif not _registry_event_hist()._registry.enabled:
+            return  # nothing to feed: skip the clock read entirely
         self._t0 = time.perf_counter()
 
     def end(self):
@@ -194,21 +230,30 @@ class RecordEvent:
             except Exception:
                 pass
             self._ann = None
-        prof = _active_profiler
-        if prof is not None and prof._recording:
-            prof._add_event(self.name, self._t0, dt)
+        hist = _registry_event_hist()
+        if hist._registry.enabled:
+            hist.labels(event=self.name).observe(dt)
+        if self._to_prof:
+            prof = _active_profiler
+            if prof is not None and prof._recording:
+                prof._add_event(self.name, self._t0, dt)
         self._t0 = None
+        self._to_prof = False
 
 
 def record_counter(name: str, value) -> None:
-    """Record a numeric gauge sample into the active profiler (no-op when
-    none is recording) — the counter counterpart of RecordEvent. Used by
-    the serving engine for queue depth / running seqs / tokens/s / page
-    utilization; samples show up in ``summary()`` and as chrome-trace
-    counter ("ph": "C") events."""
+    """Record a numeric gauge sample — the counter counterpart of
+    RecordEvent. EVERY sample lands in the metrics registry gauge
+    ``paddle_tpu_<sanitized name>`` unconditionally (always-on /metrics);
+    during profiler RECORD windows the sample is *additionally* buffered
+    into the chrome trace as a counter ("ph": "C") track and shows up in
+    ``summary()``. Used by the serving engine for queue depth / running
+    seqs / tokens/s / page utilization."""
+    v = float(value)
+    _registry_gauge(name).set(v)
     prof = _active_profiler
     if prof is not None and prof._recording:
-        prof._add_counter(name, time.perf_counter(), float(value))
+        prof._add_counter(name, time.perf_counter(), v)
 
 
 # ------------------------------------------------------------------- profiler

@@ -5,7 +5,10 @@ needs and ``GenerationMixin.generate`` (one static batch, dense caches)
 cannot provide: paged KV memory (kv_cache.py), FCFS token-budget
 admission (scheduler.py), a single compiled ragged-paged-attention decode
 step over fixed batch slots (engine.py + ops/pallas/paged_attention.py),
-and an OpenAI-ish front door with streaming (api.py).
+and an OpenAI-ish front door with streaming (api.py). Always-on
+telemetry — TTFT / inter-token-latency / queue-wait histograms,
+lifecycle counters, page-pool gauges — lands in ``paddle_tpu.metrics``
+(docs/OBSERVABILITY.md).
 
 Quick start (docs/SERVING.md has the sizing math; examples/serve_llama.py
 is runnable):

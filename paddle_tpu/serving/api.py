@@ -22,6 +22,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import metrics
 from .engine import ServingEngine
 
 __all__ = ["CompletionAPI", "EnginePool"]
@@ -37,6 +38,14 @@ class CompletionAPI:
         self.engine = engine
         self.model_name = model_name
         self.detokenize = detokenize
+        reg = metrics.get_registry()
+        self._m_completions = reg.counter(
+            "paddle_tpu_serving_completions_total",
+            "create_completion calls by outcome", labels=("status",))
+        self._m_latency = reg.histogram(
+            "paddle_tpu_serving_completion_seconds",
+            "Whole create_completion latency: queue + prefill + decode "
+            "to the last choice finishing")
 
     def create_completion(self, prompt, max_tokens: int = 16,
                           temperature: float = 0.0,
@@ -50,11 +59,16 @@ class CompletionAPI:
         as tokens land. Each batch-mate's first token samples from its
         own stream (``seed + index``), so n-best sampling of one prompt
         diverges instead of returning n identical choices."""
+        t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         # validate the WHOLE batch before queueing anything: a rejected
         # later prompt must not strand already-queued batch-mates
-        for p in prompts:
-            self.engine.check_request(p.size, max_tokens)
+        try:
+            for p in prompts:
+                self.engine.check_request(p.size, max_tokens)
+        except ValueError:
+            self._m_completions.labels(status="rejected").inc()
+            raise
         cid = f"cmpl-{next(_cmpl_counter)}"
         req_ids = []
         for idx, p in enumerate(prompts):
@@ -82,6 +96,8 @@ class CompletionAPI:
             })
             usage_p += int(out.prompt_token_ids.size)
             usage_c += out.n_gen
+        self._m_completions.labels(status="ok").inc()
+        self._m_latency.observe(time.perf_counter() - t0)
         return {
             "id": cid,
             "object": "text_completion",

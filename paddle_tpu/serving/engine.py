@@ -19,10 +19,15 @@ fixed grid of ``max_batch_slots`` decode slots; each engine step
 
 Idle slots carry the null block table (all page 0) and a zero position;
 their masked garbage rides along and is discarded on the host. Per-token
-streaming goes through each request's ``stream_cb``; engine gauges (queue
-depth, running seqs, tokens/s, page utilization) go to ``engine.stats``
-and — when a profiler is recording — to ``profiler.record_counter`` so
-they land in the chrome trace next to the ``engine_step`` spans.
+streaming goes through each request's ``stream_cb``.
+
+Telemetry (docs/OBSERVABILITY.md): every step feeds the always-on
+``paddle_tpu.metrics`` registry — TTFT / inter-token-latency / queue-wait
+/ step-time histograms, request lifecycle counters, and page/queue gauges
+(the latter via ``profiler.record_counter``, which ALSO lands them in the
+chrome trace next to the ``engine_step`` spans whenever a profiler is
+recording). ``engine.stats`` stays a thin per-step dict view over the
+same numbers.
 """
 from __future__ import annotations
 
@@ -33,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import jit
+from .. import jit, metrics
 from ..autograd.engine import no_grad
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
@@ -54,7 +59,7 @@ def _bucket(n: int, cap: int) -> int:
 class _SeqState:
     """One live slot: request + decode cursor."""
 
-    __slots__ = ("req", "pos", "last_token", "gen", "key")
+    __slots__ = ("req", "pos", "last_token", "gen", "key", "t_last")
 
     def __init__(self, req: Request, pos: int, last_token: int, key):
         self.req = req
@@ -62,6 +67,7 @@ class _SeqState:
         self.last_token = last_token
         self.gen = [last_token]     # generated ids (incl. eos when hit)
         self.key = key
+        self.t_last = time.perf_counter()  # last token's landing time (ITL)
 
 
 class ServingEngine:
@@ -107,6 +113,34 @@ class ServingEngine:
             "queue_depth": 0, "running_seqs": 0, "tokens_per_sec": 0.0,
             "page_utilization": 0.0, "peak_pages": 0,
         }
+        # typed instruments (docs/OBSERVABILITY.md catalog) — the stats
+        # dict above stays a thin per-step view over these
+        reg = metrics.get_registry()
+        self._m_ttft = reg.histogram(
+            "paddle_tpu_serving_ttft_seconds",
+            "Time to first token: request enqueue -> first sampled token")
+        self._m_itl = reg.histogram(
+            "paddle_tpu_serving_inter_token_seconds",
+            "Inter-token latency: gap between consecutive tokens of one "
+            "sequence during decode")
+        self._m_step = reg.histogram(
+            "paddle_tpu_serving_step_seconds",
+            "Full engine step: admit + prefill + batched decode + retire")
+        self._m_prefill = reg.histogram(
+            "paddle_tpu_serving_prefill_seconds",
+            "One request's prefill: bucketed forward + KV scatter + "
+            "first-token sample")
+        self._m_decode = reg.histogram(
+            "paddle_tpu_serving_decode_step_seconds",
+            "One batched decode step over all live slots")
+        self._m_requests = reg.counter(
+            "paddle_tpu_serving_requests_total",
+            "Requests by lifecycle event", labels=("event",))
+        self._m_tokens = reg.counter(
+            "paddle_tpu_serving_generated_tokens_total",
+            "Tokens sampled by the engine (prefill first tokens included)")
+        for ev in ("admitted", "rejected", "retired", "preempted"):
+            self._m_requests.labels(event=ev)  # pre-create: scrapes show 0
 
     # ------------------------------------------------------------ frontend
     def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -115,6 +149,7 @@ class ServingEngine:
         queueing any, so one bad prompt can't strand its batch-mates."""
         total = int(prompt_len) + int(max_new_tokens)
         if total > self.max_model_len:
+            self._m_requests.labels(event="rejected").inc()
             raise ValueError(
                 f"prompt {prompt_len} + max_new_tokens {max_new_tokens} "
                 f"exceeds max_model_len {self.max_model_len}")
@@ -123,6 +158,7 @@ class ServingEngine:
             # even an empty pool could never admit it — rejecting here
             # (not queueing) keeps run() from spinning forever on a head
             # request that can never pass can_admit
+            self._m_requests.labels(event="rejected").inc()
             raise ValueError(
                 f"request needs {need} KV pages worst-case but the pool "
                 f"has {self.pool.usable_pages} usable pages — raise "
@@ -180,18 +216,24 @@ class ServingEngine:
         with RecordEvent("engine_step"):
             free = sum(1 for s in self.slots if s is None)
             for req in self.scheduler.admit(free, self.pool):
+                self._m_requests.labels(event="admitted").inc()
                 out = self._prefill(req)
                 if out is not None:
                     finished.append(out)
             if any(s is not None for s in self.slots):
                 finished.extend(self._decode_once())
-        dt = max(time.perf_counter() - t0, 1e-9)
+        dt = time.perf_counter() - t0
+        self._m_step.observe(dt)
         self.stats["steps"] += 1
         self.stats["queue_depth"] = self.scheduler.queue_depth
         self.stats["running_seqs"] = sum(
             1 for s in self.slots if s is not None)
+        # zero-duration guard: a clock with coarse resolution can report
+        # dt == 0 for an idle step — a rate of 0 beats a ZeroDivisionError
+        # (or the absurd spike 1e-9 used to produce)
+        tokens_this_step = self.stats["generated_tokens"] - tokens_before
         self.stats["tokens_per_sec"] = (
-            self.stats["generated_tokens"] - tokens_before) / dt
+            tokens_this_step / dt if dt > 0.0 else 0.0)
         self.stats["page_utilization"] = self.pool.utilization()
         self.stats["peak_pages"] = self.pool.peak_used
         record_counter("serving.queue_depth", self.stats["queue_depth"])
@@ -230,10 +272,14 @@ class ServingEngine:
             flat = [t for c in ncs for t in c]
             return (last, *flat)
 
+        # the compile counter labels by function name — make recompiles
+        # attributable on /metrics (jit_compiles_total{fn="serving_prefill"})
+        prefill_fn.__name__ = "serving_prefill"
         return jit.StaticFunction(prefill_fn, observe=[self.model],
                                   warmup=False, dy2static=False)
 
     def _prefill(self, req: Request) -> Optional[RequestOutput]:
+        t0 = time.perf_counter()
         s = int(req.prompt.size)
         bucket = _bucket(s, self.max_model_len)
         prog = self._prefill_progs.get(bucket)
@@ -260,6 +306,10 @@ class ServingEngine:
         tok = int(np.asarray(self._sample_one(last._value, req.temperature,
                                               sub)))
         state = _SeqState(req, pos=s, last_token=tok, key=key)
+        now = time.perf_counter()
+        self._m_prefill.observe(now - t0)
+        self._m_ttft.observe(now - req.arrival_t)  # first token is OUT
+        self._m_tokens.inc()
         self.stats["generated_tokens"] += 1
         if req.stream_cb is not None:
             req.stream_cb(req.req_id, tok, False)
@@ -298,10 +348,14 @@ class ServingEngine:
             flat = [t for c in ncs for t in c]
             return (nxt, *flat)
 
+        # "decode compiles exactly once" becomes monitorable:
+        # jit_compiles_total{fn="serving_decode"} must pin at 1
+        step_fn.__name__ = "serving_decode"
         return jit.StaticFunction(step_fn, observe=[self.model],
                                   warmup=False, dy2static=False)
 
     def _decode_once(self) -> List[RequestOutput]:
+        t0 = time.perf_counter()
         if self._decode_prog is None:
             self._decode_prog = self._make_decode()
         B = self.max_batch_slots
@@ -330,6 +384,8 @@ class ServingEngine:
         self.pool.set_arrays([flat[2 * i] for i in range(self.n_layers)],
                              [flat[2 * i + 1] for i in range(self.n_layers)])
         nxt_host = np.asarray(nxt.numpy()).reshape(B)
+        now = time.perf_counter()
+        self._m_decode.observe(now - t0)
 
         finished: List[RequestOutput] = []
         for i, st in enumerate(self.slots):
@@ -339,6 +395,11 @@ class ServingEngine:
             st.pos += 1
             st.last_token = t
             st.gen.append(t)
+            # per-sequence inter-token latency: the streaming SLO — decode
+            # step time plus any step this sequence sat through
+            self._m_itl.observe(now - st.t_last)
+            st.t_last = now
+            self._m_tokens.inc()
             self.stats["generated_tokens"] += 1
             if st.req.stream_cb is not None:
                 st.req.stream_cb(st.req.req_id, t, False)
@@ -362,6 +423,7 @@ class ServingEngine:
         self.pool.free(req.req_id)
         if slot is not None:
             self.slots[slot] = None
+        self._m_requests.labels(event="retired").inc()
         self.stats["finished_requests"] += 1
         out = RequestOutput(req_id=req.req_id,
                             prompt_token_ids=req.prompt,

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .. import metrics
 from ..tensor import Tensor
 
 __all__ = ["PagedKVCachePool", "page_bytes", "pages_for_hbm_budget"]
@@ -92,6 +93,26 @@ class PagedKVCachePool:
         self._lens: Dict[object, int] = {}
         self._resv: Dict[object, int] = {}
         self.peak_used = 0
+        reg = metrics.get_registry()
+        self._m_pages_used = reg.gauge(
+            "paddle_tpu_serving_kv_pages_used",
+            "KV pages currently allocated out of the pool")
+        self._m_pages_total = reg.gauge(
+            "paddle_tpu_serving_kv_pages_total",
+            "Usable KV pages in the pool (page 0 reserved excluded)")
+        self._m_page_events = reg.counter(
+            "paddle_tpu_serving_kv_page_events_total",
+            "Page allocator traffic", labels=("event",))
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        """Re-set BOTH pool gauges on every allocator event: the total is
+        re-published (not just set once at construction) so a registry
+        ``reset()`` mid-life self-heals instead of reporting 0 capacity
+        forever. Process-wide caveat: with several pools (EnginePool)
+        these are last-writer-wins — see docs/OBSERVABILITY.md."""
+        self._m_pages_used.set(self.used_pages)
+        self._m_pages_total.set(self.usable_pages)
 
     # ---------------------------------------------------------- accounting
     @property
@@ -135,6 +156,8 @@ class PagedKVCachePool:
         p = self._free.pop()
         self._ref[p] = 1
         self.peak_used = max(self.peak_used, self.used_pages)
+        self._m_page_events.labels(event="alloc").inc()
+        self._refresh_gauges()
         return p
 
     def allocate(self, seq_id, n_tokens: int,
@@ -176,6 +199,8 @@ class PagedKVCachePool:
             self._ref[p] -= 1
             if self._ref[p] == 0:
                 self._free.append(p)
+                self._m_page_events.labels(event="free").inc()
+        self._refresh_gauges()
 
     def fork(self, src_id, dst_id, max_total_tokens: Optional[int] = None
              ) -> List[int]:

@@ -20,11 +20,14 @@ nothing behind it is admitted (no starvation of big prompts).
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 import numpy as np
+
+from .. import metrics
 
 __all__ = ["Request", "RequestOutput", "FCFSScheduler"]
 
@@ -46,6 +49,10 @@ class Request:
     # and the finish-reason string ("stop"|"length") as finished (truthy)
     stream_cb: Optional[Callable] = None
     req_id: object = field(default_factory=lambda: next(_req_counter))
+    # enqueue wall-clock (perf_counter domain): queue-wait and TTFT are
+    # measured from here, so they include scheduling delay, not just
+    # model time — the serving-SLO definition
+    arrival_t: float = field(default_factory=time.perf_counter)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -84,6 +91,9 @@ class FCFSScheduler:
         self.max_batch_slots = int(max_batch_slots)
         self.prefill_token_budget = int(prefill_token_budget)
         self.waiting: deque = deque()
+        self._m_queue_wait = metrics.get_registry().histogram(
+            "paddle_tpu_serving_queue_wait_seconds",
+            "Time a request waits in the FCFS queue before admission")
 
     def add(self, request: Request) -> None:
         self.waiting.append(request)
@@ -112,6 +122,7 @@ class FCFSScheduler:
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
             admitted.append(req)
+            self._m_queue_wait.observe(time.perf_counter() - req.arrival_t)
             pending_pages += pool.pages_needed(req.max_total_tokens)
             free_slots -= 1
             budget -= int(req.prompt.size)

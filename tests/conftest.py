@@ -30,6 +30,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "serving: paddle_tpu.serving continuous-batching engine tests")
+    config.addinivalue_line(
+        "markers",
+        "metrics: paddle_tpu.metrics telemetry tests (tier-1 fast lane)")
 
 
 @pytest.fixture(autouse=True)

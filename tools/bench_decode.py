@@ -116,10 +116,30 @@ def _bench_one(model_name, rt, B, prompt, new, dev, small):
         f.write(json.dumps(rec) + "\n")
 
 
+def _latency_percentiles():
+    """TTFT / inter-token-latency p50/p95/p99 (ms) from the serving
+    histograms — the latency half of the paged row (ISSUE 2): BENCH
+    rows carry SLO percentiles next to the throughput number."""
+    from paddle_tpu import metrics
+
+    reg = metrics.get_registry()
+    out = {}
+    for key, name in (("ttft_ms", "paddle_tpu_serving_ttft_seconds"),
+                      ("itl_ms",
+                       "paddle_tpu_serving_inter_token_seconds")):
+        h = reg.get(name)
+        if h is None or h.count == 0:
+            continue
+        out[key] = {f"p{int(q * 100)}": round(h.quantile(q) * 1e3, 3)
+                    for q in (0.5, 0.95, 0.99)}
+    return out
+
+
 def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
     """Engine (paged, continuous-batching) throughput at batch B — same
     record shape as _bench_one so BENCH digests treat both alike."""
     import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu import metrics
     from paddle_tpu.serving import ServingEngine
 
     metric = f"{model_name}_paged_decode_tokens_per_sec_per_chip"
@@ -142,6 +162,9 @@ def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
     t0 = time.time()
     run_once()  # compile prefill bucket + the single decode program
     compile_s = time.time() - t0
+    # isolate the measured runs' latency histograms from the compile
+    # pass: a compile-inflated TTFT p99 would be nonsense
+    metrics.get_registry().reset()
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
@@ -158,6 +181,7 @@ def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
         "peak_pages": engine.pool.peak_used,
         "device": str(dev.platform),
     }
+    rec.update(_latency_percentiles())
     print(json.dumps(rec))
     if small:
         return  # CPU smoke: never pollute the round's evidence file

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Dump a paddle_tpu metrics snapshot as JSON (or Prometheus text).
+
+Three sources, in order of usefulness:
+
+  --url http://host:port   scrape a running MetricsServer (fetches
+                           /metrics.json; with --prometheus, /metrics)
+  --demo                   run a tiny CPU serving workload in-process
+                           and dump the registry it populated (smoke /
+                           docs walkthrough; also what the tests drive)
+  (neither)                dump THIS process's default registry — only
+                           meaningful when imported and called after a
+                           workload, so the CLI warns on an empty one
+
+Output goes to stdout, or --out FILE. Examples:
+
+  python tools/metrics_dump.py --demo | jq '.paddle_tpu_serving_ttft_seconds'
+  python tools/metrics_dump.py --url http://127.0.0.1:9100 --out snap.json
+  python tools/metrics_dump.py --demo --prometheus
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _demo_registry():
+    """Tiny CPU-fallback engine run (tests/test_serving.py scale): a few
+    requests through prefill+decode so every serving instrument is live."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import metrics
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32))
+    engine = ServingEngine(model, page_size=4, max_batch_slots=2)
+    rng = np.random.default_rng(0)
+    for n, new in ((5, 4), (3, 6), (7, 3)):
+        engine.add_request(rng.integers(1, 64, (n,)), max_new_tokens=new)
+    engine.run()
+    return metrics.get_registry()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="scrape a running MetricsServer "
+                                  "(e.g. http://127.0.0.1:9100)")
+    ap.add_argument("--demo", action="store_true",
+                    help="populate via a tiny in-process serving run")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="text exposition instead of JSON")
+    ap.add_argument("--out", help="write here instead of stdout")
+    args = ap.parse_args(argv)
+    if args.url and args.demo:
+        ap.error("--url and --demo are mutually exclusive")
+
+    if args.url:
+        path = "/metrics" if args.prometheus else "/metrics.json"
+        with urllib.request.urlopen(args.url.rstrip("/") + path,
+                                    timeout=10) as r:
+            body = r.read().decode()
+        text = body if args.prometheus else json.dumps(json.loads(body),
+                                                       indent=2)
+    else:
+        if args.demo:
+            reg = _demo_registry()
+        else:
+            from paddle_tpu import metrics
+
+            reg = metrics.get_registry()
+            if not reg.snapshot():
+                print("warning: default registry is empty (no workload "
+                      "ran in this process) — did you want --demo or "
+                      "--url?", file=sys.stderr)
+        text = (reg.expose_prometheus() if args.prometheus
+                else json.dumps(reg.snapshot(), indent=2))
+
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,7 @@ NAMESPACES = [
     "paddle_tpu.vision.ops",
     "paddle_tpu.models",
     "paddle_tpu.metric",
+    "paddle_tpu.metrics",
     "paddle_tpu.distribution",
     "paddle_tpu.sparse",
     "paddle_tpu.fft",
