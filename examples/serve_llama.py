@@ -18,11 +18,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny
-from paddle_tpu.serving import CompletionAPI, ServingEngine
+from paddle_tpu.serving import CompletionAPI, EnginePool
 
 paddle.seed(0)
 model = LlamaForCausalLM(llama_tiny())
-engine = ServingEngine(model, page_size=16, max_batch_slots=2)
+# EnginePool shares ONE model's weights across independent engines;
+# next() hands each worker the next engine round-robin (thread-safe) —
+# here a single-threaded demo just takes the first
+pool = EnginePool(model, size=2, page_size=16, max_batch_slots=2)
+engine = pool.next()
 
 rng = np.random.default_rng(0)
 prompts = [rng.integers(0, 512, (n,)) for n in (12, 5, 21)]
@@ -55,6 +59,10 @@ print(f"ttft p50={ttft.quantile(0.5)*1e3:.1f}ms "
       f"p99={ttft.quantile(0.99)*1e3:.1f}ms | "
       f"itl p50={itl.quantile(0.5)*1e3:.1f}ms "
       f"({itl.count} gaps observed)")
-with metrics.MetricsServer(port=0) as srv:   # port=0: pick a free port
+# health_cb wires the engine's watchdog state into /healthz: a load
+# balancer drains this replica while it reports degraded
+# (docs/RESILIENCE.md; tools/chaos_serve.py drills the failure paths)
+with metrics.MetricsServer(port=0, health_cb=engine.health) as srv:
     print(f"scrape endpoint (for real deployments keep it running): "
-          f"{srv.url}/metrics")
+          f"{srv.url}/metrics  health: {srv.url}/healthz "
+          f"-> {engine.health()['status']}")

@@ -1,0 +1,43 @@
+"""paddle_tpu.faults — fault injection + the resilience primitives.
+
+A serving system is only as good as its worst step: this package turns
+failure modes into *tested contracts* (docs/RESILIENCE.md). Four pieces:
+
+- **Fault points** (injection.py): production code marks failure-prone
+  sites with ``faults.point("serving.kv_alloc")`` — free until a test
+  arms a fault there with the ``faults.inject(...)`` context manager
+  (raise-once / raise-every-N / latency / resource-exhaustion / host
+  callback, on deterministic seeded schedules). Every firing counts in
+  ``paddle_tpu_faults_injected_total{point}``.
+- **retry** (retry.py): exponential backoff + seeded jitter, injectable
+  sleep, optional deadline bound. The final failure re-raises unchanged.
+- **Deadline** (deadline.py): an absolute time budget on an injectable
+  clock — the currency of request timeouts and retry bounds.
+- **StepWatchdog** (watchdog.py): trips on an over-threshold engine
+  step, detects live hangs from any thread (``stalled_now``), recovers
+  after N healthy steps — the state behind ``/healthz`` degraded mode.
+
+Chaos drill in one breath:
+
+    from paddle_tpu import faults
+
+    with faults.inject("serving.decode_step", delay_s=0.05):
+        engine.step()               # watchdog trips; /healthz -> 503
+    engine.run()                    # recovers after healthy steps
+
+Stdlib + paddle_tpu.metrics only — importable from every layer without
+jax or import cycles, so tier-1 tests stay hermetic and fast.
+"""
+from .deadline import Deadline, DeadlineExceeded
+from .injection import (CallbackError, FaultInjected, FaultSpec,
+                        ResourceExhausted, active_faults, declare_point,
+                        inject, known_points, point, reset)
+from .retry import backoff_delays, retry
+from .watchdog import StepWatchdog
+
+__all__ = [
+    "CallbackError", "Deadline", "DeadlineExceeded", "FaultInjected",
+    "FaultSpec", "ResourceExhausted", "StepWatchdog", "active_faults",
+    "backoff_delays", "declare_point", "inject", "known_points", "point",
+    "reset", "retry",
+]

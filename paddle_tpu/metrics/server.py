@@ -4,7 +4,10 @@ The scrape-able half of the registry — a ``ThreadingHTTPServer`` serving
 
 - ``/metrics``       Prometheus text exposition (0.0.4)
 - ``/metrics.json``  ``registry.snapshot()`` as JSON
-- ``/healthz``       liveness probe (``ok``)
+- ``/healthz``       liveness probe: 200 ``ok`` — or, with a
+  ``health_cb`` wired (e.g. ``ServingEngine.health``), 503 while the
+  callback reports degraded (the watchdog's state machine,
+  docs/RESILIENCE.md), so a load balancer drains a wedged engine
 
 No framework dependency: the serving stack must stay importable and
 operable on a bare jax+numpy container, so this is ``http.server``, not
@@ -33,22 +36,45 @@ class MetricsServer:
     the thread. Also usable as a context manager."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 health_cb=None):
         self.registry = registry if registry is not None else get_registry()
         self.host = host
         self._requested_port = int(port)
+        # health_cb() drives /healthz: return truthy/falsy, or a dict
+        # whose "status" key must equal "ok" (a dict is echoed as the
+        # JSON body — ServingEngine.health fits directly). None keeps
+        # the bare liveness behavior (always 200 ok).
+        self.health_cb = health_cb
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _health(self):
+        """(http_status, content_type, body) for /healthz."""
+        if self.health_cb is None:
+            return 200, "text/plain", b"ok\n"
+        try:
+            h = self.health_cb()
+        except Exception as e:  # a broken probe reads as unhealthy
+            return 503, "text/plain", f"health_cb error: {e!r}\n".encode()
+        if isinstance(h, dict):
+            ok = h.get("status", "ok") == "ok"
+            return (200 if ok else 503, "application/json",
+                    (json.dumps(h) + "\n").encode())
+        return ((200, "text/plain", b"ok\n") if h
+                else (503, "text/plain", b"degraded\n"))
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
             return self
         registry = self.registry
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0]
+                code = 200
                 if path == "/metrics":
                     body = registry.expose_prometheus().encode()
                     ctype = _PROM_CONTENT_TYPE
@@ -56,12 +82,11 @@ class MetricsServer:
                     body = json.dumps(registry.snapshot()).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
-                    body = b"ok\n"
-                    ctype = "text/plain"
+                    code, ctype, body = server._health()
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

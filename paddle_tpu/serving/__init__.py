@@ -8,7 +8,11 @@ step over fixed batch slots (engine.py + ops/pallas/paged_attention.py),
 and an OpenAI-ish front door with streaming (api.py). Always-on
 telemetry — TTFT / inter-token-latency / queue-wait histograms,
 lifecycle counters, page-pool gauges — lands in ``paddle_tpu.metrics``
-(docs/OBSERVABILITY.md).
+(docs/OBSERVABILITY.md). The resilience layer (docs/RESILIENCE.md) rides
+``paddle_tpu.faults``: per-request deadlines and ``cancel()``, a bounded
+queue that rejects with a ``retry_after_s`` hint (BackpressureError),
+NaN-logit quarantine that never poisons batch-mates, isolated stream
+callbacks, and a step watchdog surfaced through ``/healthz``.
 
 Quick start (docs/SERVING.md has the sizing math; examples/serve_llama.py
 is runnable):
@@ -24,10 +28,11 @@ is runnable):
 from .api import CompletionAPI, EnginePool
 from .engine import ServingEngine
 from .kv_cache import PagedKVCachePool, page_bytes, pages_for_hbm_budget
-from .scheduler import FCFSScheduler, Request, RequestOutput
+from .scheduler import (BackpressureError, FCFSScheduler, Request,
+                        RequestOutput)
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "FCFSScheduler", "Request",
-    "RequestOutput", "CompletionAPI", "EnginePool", "page_bytes",
-    "pages_for_hbm_budget",
+    "RequestOutput", "CompletionAPI", "EnginePool", "BackpressureError",
+    "page_bytes", "pages_for_hbm_budget",
 ]

@@ -17,13 +17,15 @@ Token ids in, token ids out: tokenization is the caller's concern (pass
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from .. import metrics
+from .. import faults, metrics
 from .engine import ServingEngine
+from .scheduler import BackpressureError
 
 __all__ = ["CompletionAPI", "EnginePool"]
 
@@ -51,14 +53,17 @@ class CompletionAPI:
                           temperature: float = 0.0,
                           stop_token_id: Optional[int] = None,
                           seed: int = 0, echo: bool = False,
-                          stream_cb: Optional[Callable] = None) -> dict:
+                          stream_cb: Optional[Callable] = None,
+                          deadline_s: Optional[float] = None) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
         engine). ``stream_cb(chunk)`` receives OpenAI-chunk-shaped dicts
         as tokens land. Each batch-mate's first token samples from its
         own stream (``seed + index``), so n-best sampling of one prompt
-        diverges instead of returning n identical choices."""
+        diverges instead of returning n identical choices. ``deadline_s``
+        bounds each choice from enqueue; an expired one comes back with
+        ``finish_reason="timeout"`` and whatever tokens it produced."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         # validate the WHOLE batch before queueing anything: a rejected
@@ -71,13 +76,26 @@ class CompletionAPI:
             raise
         cid = f"cmpl-{next(_cmpl_counter)}"
         req_ids = []
-        for idx, p in enumerate(prompts):
-            cb = None
-            if stream_cb is not None:
-                cb = self._chunk_cb(stream_cb, cid, idx)
-            req_ids.append(self.engine.add_request(
-                p, max_new_tokens=max_tokens, temperature=temperature,
-                eos_token_id=stop_token_id, seed=seed + idx, stream_cb=cb))
+        try:
+            for idx, p in enumerate(prompts):
+                cb = None
+                if stream_cb is not None:
+                    cb = self._chunk_cb(stream_cb, cid, idx)
+                req_ids.append(self.engine.add_request(
+                    p, max_new_tokens=max_tokens, temperature=temperature,
+                    eos_token_id=stop_token_id, seed=seed + idx,
+                    stream_cb=cb, deadline_s=deadline_s))
+        except Exception:
+            # enqueue failed mid-batch (bounded queue filled, or a
+            # Request invariant check_request can't see, e.g. an empty
+            # prompt): silently un-queue the mates already added — from
+            # the caller's perspective this call was never accepted, so
+            # no cancelled counters, no terminal stream chunks, no
+            # orphans running under the next create_completion
+            for rid in req_ids:
+                self.engine.scheduler.remove(rid)
+            self._m_completions.labels(status="rejected").inc()
+            raise
         outputs = self.engine.run()
         choices = []
         usage_p = usage_c = 0
@@ -91,8 +109,11 @@ class CompletionAPI:
                 "token_ids": full,
                 "text": (self.detokenize(full)
                          if self.detokenize is not None else None),
-                "finish_reason": ("stop" if out.finish_reason == "stop"
-                                  else "length"),
+                # pass the engine's reason straight through — the
+                # resilience reasons ("timeout"/"cancelled"/"nan"/
+                # "error", docs/SERVING.md table) must not be masked
+                # as a normal "length" stop
+                "finish_reason": out.finish_reason,
             })
             usage_p += int(out.prompt_token_ids.size)
             usage_c += out.n_gen
@@ -112,18 +133,27 @@ class CompletionAPI:
     def _chunk_cb(self, stream_cb, cid, idx):
         def cb(req_id, token, finished):
             # the engine's terminal callback passes the finish reason
-            # ("stop"|"length") as `finished`, so streamed chunks agree
-            # with the final response's choices[].finish_reason
-            stream_cb({
-                "id": cid,
-                "object": "text_completion.chunk",
-                "model": self.model_name,
-                "choices": [{
-                    "index": idx,
-                    "token_id": None if token is None else int(token),
-                    "finish_reason": finished or None,
-                }],
-            })
+            # (docs/SERVING.md table) as `finished`, so streamed chunks
+            # agree with the final response's choices[].finish_reason
+            try:
+                stream_cb({
+                    "id": cid,
+                    "object": "text_completion.chunk",
+                    "model": self.model_name,
+                    "choices": [{
+                        "index": idx,
+                        "token_id": None if token is None else int(token),
+                        "finish_reason": finished or None,
+                    }],
+                })
+            except Exception as e:
+                # a raising USER callback must never abort the engine
+                # step its batch-mates are riding: normalize to
+                # CallbackError (original chained) — the engine's
+                # callback isolation records it and retires THIS request
+                # with finish_reason="error"
+                raise faults.CallbackError(
+                    f"stream_cb raised for {cid} choice {idx}") from e
 
         return cb
 
@@ -153,9 +183,25 @@ class EnginePool:
     def __init__(self, model, size: int = 1, **engine_kwargs):
         self._engines = [ServingEngine(model, **engine_kwargs)
                          for _ in range(int(size))]
+        self._rr = itertools.count()
+        self._rr_lock = threading.Lock()
 
     def retrieve(self, idx: int) -> ServingEngine:
-        return self._engines[idx]
+        if not 0 <= int(idx) < len(self._engines):
+            raise IndexError(
+                f"engine index {idx} out of range for EnginePool of size "
+                f"{len(self._engines)} (valid: 0..{len(self._engines) - 1})")
+        return self._engines[int(idx)]
+
+    def next(self) -> ServingEngine:
+        """Round-robin handout: the ROTATION is thread-safe, the engines
+        are not — size the pool to at least the worker count so no two
+        concurrent callers drive one engine (same contract as
+        ``retrieve``: one engine per thread at a time). Used by
+        examples/serve_llama.py."""
+        with self._rr_lock:
+            i = next(self._rr) % len(self._engines)
+        return self._engines[i]
 
     def __len__(self) -> int:
         return len(self._engines)

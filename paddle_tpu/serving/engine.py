@@ -31,6 +31,7 @@ same numbers.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Dict, List, Optional
 
@@ -38,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import jit, metrics
+from .. import faults, jit, metrics
 from ..autograd.engine import no_grad
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
@@ -48,6 +49,24 @@ from .scheduler import FCFSScheduler, Request, RequestOutput
 __all__ = ["ServingEngine"]
 
 _MIN_PREFILL_BUCKET = 16
+_engine_counter = itertools.count()
+
+faults.declare_point(
+    "serving.step", "top of ServingEngine.step(), before the deadline "
+    "sweep — arm latency here to stall whole iterations")
+faults.declare_point(
+    "serving.prefill", "start of one request's prefill — a raise retires "
+    "that request with finish_reason=\"error\"; batch-mates proceed")
+faults.declare_point(
+    "serving.decode_step", "in _decode_once, after the KV-room loop and "
+    "before the compiled step consumes the pools — arm call= here to "
+    "corrupt state (e.g. pool.poison_seq), delay_s to trip the watchdog")
+faults.declare_point(
+    "serving.compile_decode", "building the decode program — a transient "
+    "raise exercises the faults.retry backoff path")
+faults.declare_point(
+    "serving.compile_prefill", "building one prefill-bucket program — "
+    "retried like serving.compile_decode")
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -86,7 +105,10 @@ class ServingEngine:
                  max_batch_slots: int = 8,
                  max_model_len: Optional[int] = None,
                  prefill_token_budget: int = 1024,
-                 kv_dtype=jnp.float32, seed: int = 0):
+                 kv_dtype=jnp.float32, seed: int = 0,
+                 max_queue: Optional[int] = None,
+                 watchdog_stall_s: Optional[float] = 30.0,
+                 watchdog_recovery_steps: int = 3):
         self.model = model
         model.eval()
         self.trunk = model._decode_trunk()
@@ -102,8 +124,26 @@ class ServingEngine:
         self.pool = PagedKVCachePool(n_layers, num_pages, self.page_size,
                                      n_kv, head_dim, dtype=kv_dtype)
         self.scheduler = FCFSScheduler(self.max_batch_slots,
-                                       prefill_token_budget)
+                                       prefill_token_budget,
+                                       max_queue=max_queue,
+                                       retry_after_cb=self
+                                       ._estimate_retry_after)
+        # step watchdog (faults.StepWatchdog): trips past the stall
+        # threshold, recovers after N healthy steps, drives /healthz via
+        # health(). None disables it.
+        self.watchdog = (faults.StepWatchdog(
+            stall_threshold_s=watchdog_stall_s,
+            recovery_steps=watchdog_recovery_steps)
+            if watchdog_stall_s is not None else None)
+        # EWMA of step wall-time: the drain-rate estimate behind
+        # BackpressureError.retry_after_s (seeded at a plausible 50 ms)
+        self._avg_step_s = 0.05
         self.slots: List[Optional[_SeqState]] = [None] * self.max_batch_slots
+        # the request currently mid-prefill (popped from the queue, not
+        # yet parked in a slot): cancel() must be able to see it, or a
+        # cancel issued from its own first-token callback would be
+        # silently ignored
+        self._active_prefill: Optional[_SeqState] = None
         self._decode_prog = None
         self._prefill_progs: Dict[int, jit.StaticFunction] = {}
         self._rng = jax.random.PRNGKey(seed)
@@ -141,18 +181,70 @@ class ServingEngine:
             "Tokens sampled by the engine (prefill first tokens included)")
         for ev in ("admitted", "rejected", "retired", "preempted"):
             self._m_requests.labels(event=ev)  # pre-create: scrapes show 0
+        # resilience instruments (docs/RESILIENCE.md): every failure path
+        # increments exactly one of these per event, so chaos tests pin
+        # telemetry alongside behavior
+        self._m_timeouts = reg.counter(
+            "paddle_tpu_serving_request_timeouts_total",
+            "Requests retired on deadline expiry "
+            "(finish_reason=\"timeout\"), queued or mid-decode")
+        self._m_cancels = reg.counter(
+            "paddle_tpu_serving_cancellations_total",
+            "Requests retired by cancel() (finish_reason=\"cancelled\")")
+        self._m_nan_quarantines = reg.counter(
+            "paddle_tpu_serving_nan_quarantines_total",
+            "Sequences quarantined for non-finite decode logits "
+            "(finish_reason=\"nan\"); batch-mates are unaffected")
+        self._m_req_errors = reg.counter(
+            "paddle_tpu_serving_request_errors_total",
+            "Requests retired on an internal failure "
+            "(finish_reason=\"error\": prefill/alloc/callback faults)")
+        self._m_cb_errors = reg.counter(
+            "paddle_tpu_serving_callback_errors_total",
+            "Exceptions raised by user stream callbacks (isolated: the "
+            "engine step survives; the request retires \"error\")")
+        self._m_wd_trips = reg.counter(
+            "paddle_tpu_serving_watchdog_trips_total",
+            "Watchdog trip episodes (healthy->tripped transitions, not "
+            "slow-step count)")
+        # labeled per engine: in an EnginePool a healthy sibling's step
+        # must not overwrite a tripped engine's 1.0 (the other serving
+        # gauges stay process-wide last-writer-wins, documented in
+        # docs/OBSERVABILITY.md — degraded is the one alerts key on)
+        self.engine_id = str(next(_engine_counter))
+        self._m_degraded = reg.gauge(
+            "paddle_tpu_serving_degraded",
+            "1 while the step watchdog holds this engine degraded "
+            "(/healthz returns 503), else 0; refreshed at step end and "
+            "on every health() probe", labels=("engine",)).labels(
+            engine=self.engine_id)
+        self._reason_counters = {
+            "timeout": self._m_timeouts, "cancelled": self._m_cancels,
+            "nan": self._m_nan_quarantines, "error": self._m_req_errors,
+        }
 
     # ------------------------------------------------------------ frontend
     def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
         """Raise ValueError if a request of this shape could NEVER be
         served — batch front doors call this for every prompt before
         queueing any, so one bad prompt can't strand its batch-mates."""
-        total = int(prompt_len) + int(max_new_tokens)
+        p, m = int(prompt_len), int(max_new_tokens)
+        if p > self.max_model_len:
+            # 4xx responses must be actionable: name the violated limit
+            # AND its configured value in every rejection message
+            self._m_requests.labels(event="rejected").inc()
+            raise ValueError(
+                f"prompt_len {p} exceeds the prefill bucket cap (limit: "
+                f"max_model_len={self.max_model_len}); truncate the prompt "
+                f"or construct the engine with a larger max_model_len")
+        total = p + m
         if total > self.max_model_len:
             self._m_requests.labels(event="rejected").inc()
             raise ValueError(
-                f"prompt {prompt_len} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_model_len {self.max_model_len}")
+                f"prompt_len {p} + max_new_tokens {m} = {total} exceeds "
+                f"the per-request token cap (limit: max_model_len="
+                f"{self.max_model_len}); lower max_new_tokens to at most "
+                f"{self.max_model_len - p}")
         need = self.pool.pages_needed(total)
         if need > self.pool.usable_pages:
             # even an empty pool could never admit it — rejecting here
@@ -160,24 +252,85 @@ class ServingEngine:
             # request that can never pass can_admit
             self._m_requests.labels(event="rejected").inc()
             raise ValueError(
-                f"request needs {need} KV pages worst-case but the pool "
-                f"has {self.pool.usable_pages} usable pages — raise "
-                f"num_pages or lower max_new_tokens")
+                f"max_total_tokens {total} needs {need} KV pages "
+                f"worst-case but the pool has only {self.pool.usable_pages}"
+                f" usable pages (limit: num_pages={self.pool.num_pages}, "
+                f"page_size={self.pool.page_size}); raise num_pages or "
+                f"lower max_new_tokens")
 
     def add_request(self, prompt, max_new_tokens: int = 32,
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None, seed: int = 0,
-                    stream_cb=None):
+                    stream_cb=None, deadline_s: Optional[float] = None):
         """Queue a request; returns its ``req_id``. Generation starts at
         the next :meth:`step` with capacity (continuous batching — no
-        barrier on the current batch)."""
+        barrier on the current batch). ``deadline_s`` bounds the whole
+        request from ENQUEUE (queue wait included): past it, the engine
+        retires it with ``finish_reason="timeout"``. Raises
+        :class:`~.scheduler.BackpressureError` (with a ``retry_after_s``
+        hint) when a bounded queue (``max_queue=``) is full."""
         req = Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       eos_token_id=eos_token_id, seed=seed,
-                      stream_cb=stream_cb)
+                      stream_cb=stream_cb, deadline_s=deadline_s)
         self.check_request(req.prompt.size, req.max_new_tokens)
-        self.scheduler.add(req)
+        try:
+            self.scheduler.add(req)
+        except Exception:
+            self._m_requests.labels(event="rejected").inc()
+            raise
         return req.req_id
+
+    def cancel(self, req_id) -> bool:
+        """Cancel a request wherever it is: pulled from the queue, or
+        retired mid-decode with its KV pages freed THIS call. The output
+        (tokens generated so far, ``finish_reason="cancelled"``) is
+        delivered through the usual :meth:`run` path and the terminal
+        stream callback fires. False if the request is unknown or
+        already finished — cancel is idempotent, never raises."""
+        req = self.scheduler.remove(req_id)
+        if req is not None:
+            self._finish_queued(req, "cancelled")
+            return True
+        ap = self._active_prefill
+        if ap is not None and ap.req.req_id == req_id:
+            # mid-prefill (reentrant: we are inside its own stream
+            # callback) — retire now; _prefill notices the freed pages
+            # and skips parking it in a slot
+            self._active_prefill = None
+            self._retire_abnormal(ap, slot=None, reason="cancelled")
+            return True
+        for i, st in enumerate(self.slots):
+            if st is not None and st.req.req_id == req_id:
+                self._retire_abnormal(st, slot=i, reason="cancelled")
+                return True
+        return False
+
+    def health(self) -> Dict[str, object]:
+        """Liveness view for ``MetricsServer(health_cb=engine.health)``:
+        ``status`` flips to ``"degraded"`` while the watchdog is tripped
+        OR a step is live-hung past the stall threshold (stalled_now is
+        answerable from the scrape thread mid-step)."""
+        degraded = (self.watchdog is not None
+                    and self.watchdog.status() != "ok")
+        # keep the gauge agreeing with /healthz even MID-step: a live
+        # hang is only observable from this (scrape) thread, and the
+        # step's own finally can't run until the hang ends
+        self._m_degraded.set(1.0 if degraded else 0.0)
+        return {
+            "status": "degraded" if degraded else "ok",
+            "watchdog_trips": (0 if self.watchdog is None
+                               else self.watchdog.trips),
+            "queue_depth": self.scheduler.queue_depth,
+            "running_seqs": sum(1 for s in self.slots if s is not None),
+        }
+
+    def _estimate_retry_after(self) -> float:
+        """Backpressure hint: FCFS drains roughly one admission per step
+        per free slot, so a full queue clears in about
+        ``queue_depth x avg_step_time`` — rounded up to a 50 ms floor so
+        clients never busy-spin on a hot engine."""
+        return max(0.05, self.scheduler.queue_depth * self._avg_step_s)
 
     @property
     def has_work(self) -> bool:
@@ -211,18 +364,41 @@ class ServingEngine:
         from ..profiler import RecordEvent, record_counter
 
         t0 = time.perf_counter()
+        if self.watchdog is not None:
+            self.watchdog.begin_step()
         tokens_before = self.stats["generated_tokens"]
         finished: List[RequestOutput] = []
-        with RecordEvent("engine_step"):
-            free = sum(1 for s in self.slots if s is None)
-            for req in self.scheduler.admit(free, self.pool):
-                self._m_requests.labels(event="admitted").inc()
-                out = self._prefill(req)
-                if out is not None:
-                    finished.append(out)
-            if any(s is not None for s in self.slots):
-                finished.extend(self._decode_once())
-        dt = time.perf_counter() - t0
+        try:
+            faults.point("serving.step")
+            with RecordEvent("engine_step"):
+                finished.extend(self._sweep_deadlines())
+                free = sum(1 for s in self.slots if s is None)
+                for req in self.scheduler.admit(free, self.pool):
+                    self._m_requests.labels(event="admitted").inc()
+                    try:
+                        out = self._prefill(req)
+                    except Exception as e:
+                        # a prefill failure (compile fault, pool
+                        # exhaustion, injected drill) fails THIS request,
+                        # not the engine: batch-mates keep decoding, the
+                        # queue keeps draining
+                        out = self._fail_prefilled_request(req, e)
+                    if out is not None:
+                        finished.append(out)
+                if any(s is not None for s in self.slots):
+                    finished.extend(self._decode_once())
+        finally:
+            # the watchdog bracket must close even when the step body
+            # raises (an armed fault, an unhandled bug) — otherwise
+            # _in_step_since stays set and an IDLE engine reads as
+            # live-hung on /healthz forever
+            dt = time.perf_counter() - t0
+            self._avg_step_s = 0.8 * self._avg_step_s + 0.2 * dt
+            if self.watchdog is not None:
+                if self.watchdog.end_step(dt):
+                    self._m_wd_trips.inc()
+                self._m_degraded.set(
+                    0.0 if self.watchdog.status() == "ok" else 1.0)
         self._m_step.observe(dt)
         self.stats["steps"] += 1
         self.stats["queue_depth"] = self.scheduler.queue_depth
@@ -242,8 +418,99 @@ class ServingEngine:
                        self.stats["tokens_per_sec"])
         record_counter("serving.page_utilization",
                        self.stats["page_utilization"])
-        for out in finished:
-            self._outputs[out.req_id] = out
+        # outputs were registered in self._outputs eagerly at retirement
+        return finished
+
+    # -------------------------------------------------- resilience helpers
+    def _compile_with_retry(self, point_name: str, make_fn):
+        """Build a compiled program under a fault point with a short
+        seeded backoff (ONE retry policy for every build site): a
+        transient failure costs milliseconds, a persistent one surfaces
+        to step()'s per-request isolation. The program still compiles
+        exactly once — only the successful build reaches XLA."""
+        def build():
+            faults.point(point_name)
+            return make_fn()
+
+        return faults.retry(build, attempts=3, base_delay_s=0.01,
+                            max_delay_s=0.1)
+
+    def _safe_cb(self, req: Request, token, finished):
+        """Invoke ``req.stream_cb`` isolated: a raising user callback
+        cannot abort :meth:`step`. Records the error, disables the
+        callback (no further calls for this request), and returns the
+        exception (None on success) so the caller can retire the
+        request with ``"error"`` carrying the diagnostic."""
+        try:
+            req.stream_cb(req.req_id, token, finished)
+            return None
+        except Exception as e:
+            self._m_cb_errors.inc()
+            req.stream_cb = None
+            return e
+
+    def _emit_terminal(self, req: Request, gen, reason: str,
+                       error=None) -> RequestOutput:
+        """Common tail of every abnormal retirement: per-reason counter
+        (exactly once per event), lifecycle counter, terminal stream
+        callback (isolated), RequestOutput."""
+        self._reason_counters[reason].inc()
+        self._m_requests.labels(event="retired").inc()
+        self.stats["finished_requests"] += 1
+        out = RequestOutput(req_id=req.req_id, prompt_token_ids=req.prompt,
+                            token_ids=list(gen), finish_reason=reason,
+                            error=None if error is None else repr(error))
+        # register EAGERLY: if the rest of this step raises (an armed
+        # fault, a bug), run() must still deliver every output whose
+        # retirement side effects (pages freed, counters, terminal
+        # callback) already happened
+        self._outputs[out.req_id] = out
+        if req.stream_cb is not None:
+            self._safe_cb(req, None, reason)
+        return out
+
+    def _finish_queued(self, req: Request, reason: str) -> RequestOutput:
+        """Retire a request that never ran (timeout/cancel in queue)."""
+        return self._emit_terminal(req, [], reason)
+
+    def _fail_prefilled_request(self, req: Request,
+                                error: Exception) -> RequestOutput:
+        """Retire a request whose prefill failed partway; any pages its
+        allocation grabbed go back to the pool now."""
+        if self.pool.has_seq(req.req_id):
+            self.pool.free(req.req_id)
+        return self._emit_terminal(req, [], "error", error)
+
+    def _retire_abnormal(self, st: _SeqState, slot: Optional[int],
+                         reason: str, error=None) -> RequestOutput:
+        """Retire a LIVE sequence off the normal eos/length path
+        (timeout / cancelled / nan / error): pages freed this call, slot
+        cleared, tokens generated so far delivered."""
+        req = st.req
+        if self.pool.has_seq(req.req_id):
+            # scrub=True for NaN: the pool zeroes each freed page lazily
+            # on reuse — attention masks give padding lanes weight 0,
+            # but IEEE 0 * NaN = NaN, so a poisoned page handed to the
+            # next sequence would re-poison it through its masked tail.
+            # Normal retires skip it: finite garbage IS annihilated by
+            # the 0 weights.
+            self.pool.free(req.req_id, scrub=(reason == "nan"))
+        if slot is not None:
+            self.slots[slot] = None
+        return self._emit_terminal(req, st.gen, reason, error)
+
+    def _sweep_deadlines(self) -> List[RequestOutput]:
+        """Retire every over-deadline request — queued or mid-decode —
+        with ``finish_reason="timeout"``; runs at the top of each step so
+        an overloaded queue sheds load instead of serving stale work."""
+        finished: List[RequestOutput] = []
+        for req in self.scheduler.pop_expired():
+            finished.append(self._finish_queued(req, "timeout"))
+        for i, st in enumerate(self.slots):
+            if (st is not None and st.req.deadline is not None
+                    and st.req.deadline.expired()):
+                finished.append(
+                    self._retire_abnormal(st, slot=i, reason="timeout"))
         return finished
 
     # ------------------------------------------------------------- prefill
@@ -269,8 +536,13 @@ class ServingEngine:
                 logits = model.logits(last_h)
             last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
                             [ensure_tensor(logits)], name="last_logits")
+            # same no-trust rule as decode: the host quarantines a
+            # non-finite prefill instead of streaming its garbage first
+            # token (argmax over all-NaN logits returns index 0)
+            fin = apply_op(lambda lv: jnp.isfinite(lv).all(),
+                           [last], name="prefill_logits_finite")
             flat = [t for c in ncs for t in c]
-            return (last, *flat)
+            return (last, fin, *flat)
 
         # the compile counter labels by function name — make recompiles
         # attributable on /metrics (jit_compiles_total{fn="serving_prefill"})
@@ -280,11 +552,14 @@ class ServingEngine:
 
     def _prefill(self, req: Request) -> Optional[RequestOutput]:
         t0 = time.perf_counter()
+        faults.point("serving.prefill")
         s = int(req.prompt.size)
         bucket = _bucket(s, self.max_model_len)
         prog = self._prefill_progs.get(bucket)
         if prog is None:
-            prog = self._prefill_progs[bucket] = self._make_prefill(bucket)
+            prog = self._prefill_progs[bucket] = self._compile_with_retry(
+                "serving.compile_prefill",
+                lambda: self._make_prefill(bucket))
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :s] = req.prompt
         n_kv, hd = self.pool.n_kv_heads, self.pool.head_dim
@@ -293,7 +568,12 @@ class ServingEngine:
                 for _ in range(2 * self.n_layers)]
         res = prog(Tensor(jnp.asarray(ids)),
                    Tensor(jnp.asarray(s - 1, jnp.int32)), *flat)
-        last, flat_kv = res[0], res[1:]
+        last, fin, flat_kv = res[0], res[1], res[2:]
+        if not bool(np.asarray(fin._value).reshape(())):
+            # NaN/inf logits straight out of prefill: quarantine before
+            # any page is allocated or any token streamed — the prompt
+            # KV is as untrustworthy as the sample
+            return self._emit_terminal(req, [], "nan")
 
         self.pool.allocate(req.req_id, s,
                            max_total_tokens=req.max_total_tokens)
@@ -312,7 +592,17 @@ class ServingEngine:
         self._m_tokens.inc()
         self.stats["generated_tokens"] += 1
         if req.stream_cb is not None:
-            req.stream_cb(req.req_id, tok, False)
+            # visible to cancel() for the duration of the callback (the
+            # request is in neither the queue nor a slot right now)
+            self._active_prefill = state
+            cb_err = self._safe_cb(req, tok, False)
+            cancelled = self._active_prefill is None
+            self._active_prefill = None
+            if cancelled:  # cancel() ran inside the callback
+                return None
+            if cb_err is not None:
+                return self._retire_abnormal(state, slot=None,
+                                             reason="error", error=cb_err)
         return self._maybe_retire(state, slot=None)
 
     def _sample_one(self, last, temperature, key):
@@ -334,6 +624,14 @@ class ServingEngine:
                 logits = model.logits(hidden)
             last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
                             [ensure_tensor(logits)], name="last_logits")
+            # per-slot finite flag BEFORE sampling: the host quarantines
+            # any row whose logits went NaN/inf (poisoned KV, numeric
+            # blowup) without ever trusting its sampled token — and
+            # because it rides in the same program, the check costs one
+            # fused reduction, not a second compile
+            fin = apply_op(
+                lambda lv: jnp.isfinite(lv).all(axis=-1),
+                [last], name="logits_finite")
 
             def batched_sample(lv, tv, kv):
                 greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
@@ -346,7 +644,7 @@ class ServingEngine:
                            [last, ensure_tensor(temps), ensure_tensor(key)],
                            name="serve_sample")
             flat = [t for c in ncs for t in c]
-            return (nxt, *flat)
+            return (nxt, fin, *flat)
 
         # "decode compiles exactly once" becomes monitorable:
         # jit_compiles_total{fn="serving_decode"} must pin at 1
@@ -357,21 +655,40 @@ class ServingEngine:
     def _decode_once(self) -> List[RequestOutput]:
         t0 = time.perf_counter()
         if self._decode_prog is None:
-            self._decode_prog = self._make_decode()
+            self._decode_prog = self._compile_with_retry(
+                "serving.compile_decode", self._make_decode)
         B = self.max_batch_slots
         tok = np.zeros((B, 1), np.int32)
         pos = np.zeros(B, np.int32)
         temps = np.zeros(B, np.float32)
         seq_ids: List[Optional[object]] = [None] * B
+        finished: List[RequestOutput] = []
         for i, st in enumerate(self.slots):
             if st is None:
                 continue
-            # room for this step's KV write at position st.pos
-            self.pool.append_token(st.req.req_id)
+            try:
+                # room for this step's KV write at position st.pos —
+                # extend() (not append_token) so a step aborted after
+                # this loop re-reserves the SAME position on retry
+                # instead of drifting _lens past the admission
+                # accounting one phantom token per aborted step
+                self.pool.extend(st.req.req_id, st.pos + 1)
+            except Exception as e:
+                # out of pages mid-decode (admission accounting makes
+                # this impossible unless injected/buggy): quarantine the
+                # victim, keep the rest of the batch decoding — its slot
+                # reads as idle (null block table) this step
+                finished.append(
+                    self._retire_abnormal(st, slot=i, reason="error",
+                                          error=e))
+                continue
             tok[i, 0] = st.last_token
             pos[i] = st.pos
             temps[i] = st.req.temperature
             seq_ids[i] = st.req.req_id
+        faults.point("serving.decode_step")
+        if not any(s is not None for s in self.slots):
+            return finished  # every slot aborted before the compiled step
         bt = self.pool.block_table_array(seq_ids, self.pages_per_seq)
         self._rng, sub = jax.random.split(self._rng)
         res = self._decode_prog(
@@ -380,16 +697,24 @@ class ServingEngine:
             Tensor(jnp.asarray(bt)),
             *[p for i in range(self.n_layers)
               for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
-        nxt, flat = res[0], res[1:]
+        nxt, fin, flat = res[0], res[1], res[2:]
         self.pool.set_arrays([flat[2 * i] for i in range(self.n_layers)],
                              [flat[2 * i + 1] for i in range(self.n_layers)])
         nxt_host = np.asarray(nxt.numpy()).reshape(B)
+        fin_host = np.asarray(fin.numpy()).reshape(B).astype(bool)
         now = time.perf_counter()
         self._m_decode.observe(now - t0)
 
-        finished: List[RequestOutput] = []
         for i, st in enumerate(self.slots):
             if st is None:
+                continue
+            if not fin_host[i]:
+                # NaN/inf logits: quarantine ONLY this sequence — its
+                # sampled token is garbage and is never appended; pages
+                # return to the pool now; batch-mates are untouched
+                # because attention gathers strictly via block tables
+                finished.append(
+                    self._retire_abnormal(st, slot=i, reason="nan"))
                 continue
             t = int(nxt_host[i])
             st.pos += 1
@@ -402,7 +727,17 @@ class ServingEngine:
             self._m_tokens.inc()
             self.stats["generated_tokens"] += 1
             if st.req.stream_cb is not None:
-                st.req.stream_cb(st.req.req_id, t, False)
+                cb_err = self._safe_cb(st.req, t, False)
+                if self.slots[i] is not st:
+                    # cancel() ran inside the callback and already
+                    # retired this sequence — touching it again would
+                    # double-free its pages
+                    continue
+                if cb_err is not None:
+                    finished.append(
+                        self._retire_abnormal(st, slot=i, reason="error",
+                                              error=cb_err))
+                    continue
             out = self._maybe_retire(st, slot=i)
             if out is not None:
                 finished.append(out)
@@ -419,8 +754,11 @@ class ServingEngine:
                 i = self.slots.index(None)
                 self.slots[i] = st
             return None
-        # retire NOW: pages go back to the pool this very step
-        self.pool.free(req.req_id)
+        # retire NOW: pages go back to the pool this very step (has_seq
+        # guard: a reentrant cancel from the terminal-token's stream
+        # callback may have freed them already)
+        if self.pool.has_seq(req.req_id):
+            self.pool.free(req.req_id)
         if slot is not None:
             self.slots[slot] = None
         self._m_requests.labels(event="retired").inc()
@@ -429,8 +767,10 @@ class ServingEngine:
                             prompt_token_ids=req.prompt,
                             token_ids=list(st.gen),
                             finish_reason="stop" if hit_eos else "length")
+        self._outputs[out.req_id] = out  # eager: survives a later raise
         if req.stream_cb is not None:
             # terminal call: `finished` is the reason string (truthy, so
-            # bool-style `if finished:` consumers keep working)
-            req.stream_cb(req.req_id, None, out.finish_reason)
+            # bool-style `if finished:` consumers keep working); isolated
+            # like every callback — a raise here only records
+            self._safe_cb(req, None, out.finish_reason)
         return out

@@ -32,8 +32,13 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from .. import metrics
+from .. import faults, metrics
 from ..tensor import Tensor
+
+faults.declare_point(
+    "serving.kv_alloc",
+    "PagedKVCachePool._take_page, before a page leaves the free list — "
+    "arm ResourceExhausted here to drill pool-exhaustion handling")
 
 __all__ = ["PagedKVCachePool", "page_bytes", "pages_for_hbm_budget"]
 
@@ -89,6 +94,12 @@ class PagedKVCachePool:
         # locality — a just-freed page is the next handed out)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._ref = np.zeros(self.num_pages, np.int32)
+        # pages freed by a NaN quarantine: zeroed lazily the moment they
+        # are re-taken (free() with scrub=True) — masked attention gives
+        # padding lanes weight 0, but 0 x NaN = NaN, so a poisoned page
+        # must never enter a new block table un-scrubbed. Lazy keeps the
+        # quarantine itself O(1): no full-pool rewrite per retirement.
+        self._dirty: set = set()
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
         self._resv: Dict[object, int] = {}
@@ -149,11 +160,29 @@ class PagedKVCachePool:
 
     # ---------------------------------------------------------- allocation
     def _take_page(self) -> int:
+        faults.point("serving.kv_alloc")
         if not self._free:
             raise RuntimeError(
                 "KV page pool exhausted — admission accounting should have "
                 "prevented this (reserve() not called?)")
         p = self._free.pop()
+        if p in self._dirty:
+            # a quarantined page is about to re-enter a block table:
+            # scrub ALL dirty pages in one batched update per layer
+            # (each .at[].set copies the whole pool, so amortize the
+            # copies over every pending page instead of paying them
+            # per page)
+            pages = jnp.asarray(sorted(self._dirty), jnp.int32)
+            for li in range(self.num_layers):
+                kp = self.k_pools[li]._value
+                vp = self.v_pools[li]._value
+                self.k_pools[li] = Tensor(
+                    kp.at[pages].set(jnp.zeros((), kp.dtype)),
+                    stop_gradient=True)
+                self.v_pools[li] = Tensor(
+                    vp.at[pages].set(jnp.zeros((), vp.dtype)),
+                    stop_gradient=True)
+            self._dirty.clear()
         self._ref[p] = 1
         self.peak_used = max(self.peak_used, self.used_pages)
         self._m_page_events.labels(event="alloc").inc()
@@ -173,7 +202,14 @@ class PagedKVCachePool:
         self._tables[seq_id] = []
         self._lens[seq_id] = 0
         self._resv[seq_id] = resv
-        self.extend(seq_id, n_tokens)
+        try:
+            self.extend(seq_id, n_tokens)
+        except Exception:
+            # atomic: a mid-allocate failure (real exhaustion or an armed
+            # serving.kv_alloc fault) must not leak a half-built sequence —
+            # roll back pages already taken and the bookkeeping entries
+            self.free(seq_id)
+            raise
         return list(self._tables[seq_id])
 
     def extend(self, seq_id, total_tokens: int) -> None:
@@ -189,9 +225,11 @@ class PagedKVCachePool:
         the decode step writes position ``seq_len``)."""
         self.extend(seq_id, self._lens[seq_id] + 1)
 
-    def free(self, seq_id) -> None:
+    def free(self, seq_id, scrub: bool = False) -> None:
         """Retire a sequence NOW: drop refcounts, return exclusive pages to
-        the free list (immediate reuse — the continuous-batching payoff)."""
+        the free list (immediate reuse — the continuous-batching payoff).
+        ``scrub=True`` (NaN quarantine) marks the freed pages dirty so
+        :meth:`_take_page` zeroes each one lazily on reuse."""
         table = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
         self._resv.pop(seq_id, None)
@@ -199,6 +237,8 @@ class PagedKVCachePool:
             self._ref[p] -= 1
             if self._ref[p] == 0:
                 self._free.append(p)
+                if scrub:
+                    self._dirty.add(p)
                 self._m_page_events.labels(event="free").inc()
         self._refresh_gauges()
 
@@ -233,6 +273,35 @@ class PagedKVCachePool:
             max_total_tokens if max_total_tokens is not None else n)
         self.peak_used = max(self.peak_used, self.used_pages)
         return list(table)
+
+    def _slot_coords(self, seq_id, n_tokens: int):
+        """(page_ids, offs) device coords of a sequence's first
+        ``n_tokens`` KV slots — THE block-table indexing math, shared by
+        every pool-rewrite path so it cannot drift between them."""
+        table = np.asarray(self._tables[seq_id], np.int32)
+        idx = np.arange(int(n_tokens))
+        return (jnp.asarray(table[idx // self.page_size]),
+                jnp.asarray(idx % self.page_size))
+
+    def poison_seq(self, seq_id, value: float = float("nan")) -> int:
+        """Chaos helper (tests/test_faults.py, tools/chaos_serve.py):
+        overwrite every WRITTEN KV slot of one sequence with ``value``
+        (default NaN), all layers, K and V. Because attention gathers
+        strictly through block tables, the poison stays confined to this
+        sequence — the engine's NaN quarantine must retire it while its
+        batch-mates decode on untouched. Returns slots poisoned."""
+        n = int(self._lens[seq_id])
+        page_ids, offs = self._slot_coords(seq_id, n)
+        for li in range(self.num_layers):
+            kp = self.k_pools[li]._value
+            vp = self.v_pools[li]._value
+            self.k_pools[li] = Tensor(
+                kp.at[page_ids, offs].set(jnp.asarray(value, kp.dtype)),
+                stop_gradient=True)
+            self.v_pools[li] = Tensor(
+                vp.at[page_ids, offs].set(jnp.asarray(value, vp.dtype)),
+                stop_gradient=True)
+        return n
 
     # ------------------------------------------------------------- queries
     def has_seq(self, seq_id) -> bool:
@@ -275,11 +344,8 @@ class PagedKVCachePool:
         sequence's pages. ``layer_kv`` is a per-layer list of (k, v) arrays
         ``[S, n_kv_heads, head_dim]`` (S = true prompt length; any padded
         prefill tail must already be sliced off)."""
-        table = np.asarray(self._tables[seq_id], np.int32)
         s = int(layer_kv[0][0].shape[0])
-        idx = np.arange(s)
-        page_ids = jnp.asarray(table[idx // self.page_size])
-        offs = jnp.asarray(idx % self.page_size)
+        page_ids, offs = self._slot_coords(seq_id, s)
         for li, (k, v) in enumerate(layer_kv):
             kp = self.k_pools[li]._value
             vp = self.v_pools[li]._value

@@ -27,9 +27,26 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from .. import metrics
+from .. import faults, metrics
 
-__all__ = ["Request", "RequestOutput", "FCFSScheduler"]
+__all__ = ["BackpressureError", "Request", "RequestOutput", "FCFSScheduler"]
+
+
+class BackpressureError(RuntimeError):
+    """The scheduler queue is full: the request was REJECTED, not queued.
+
+    Carries ``retry_after_s`` — the engine's drain-rate estimate of when
+    a slot is likely to open — so an HTTP front door can map this
+    straight onto ``429 Too Many Requests`` + ``Retry-After``. Rejecting
+    at enqueue bounds memory AND tail latency: a request that would wait
+    forever is better told so immediately (docs/RESILIENCE.md).
+    """
+
+    def __init__(self, message: str, retry_after_s: float,
+                 queue_depth: int):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.queue_depth = int(queue_depth)
 
 _req_counter = itertools.count()
 
@@ -48,6 +65,9 @@ class Request:
     # it. finished is False per token; the terminal call passes token=None
     # and the finish-reason string ("stop"|"length") as finished (truthy)
     stream_cb: Optional[Callable] = None
+    # seconds from enqueue until the engine retires the request with
+    # finish_reason="timeout" (queued or mid-decode); None = no deadline
+    deadline_s: Optional[float] = None
     req_id: object = field(default_factory=lambda: next(_req_counter))
     # enqueue wall-clock (perf_counter domain): queue-wait and TTFT are
     # measured from here, so they include scheduling delay, not just
@@ -60,6 +80,13 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0")
+        # the deadline clock starts at ENQUEUE (same SLO domain as TTFT):
+        # queue wait burns budget, so an overloaded engine times requests
+        # out instead of serving them arbitrarily late
+        self.deadline = (faults.Deadline(self.deadline_s)
+                         if self.deadline_s is not None else None)
 
     @property
     def max_total_tokens(self) -> int:
@@ -73,8 +100,11 @@ class RequestOutput:
     req_id: object
     prompt_token_ids: np.ndarray
     token_ids: List[int]            # generated tokens (incl. eos if hit)
-    finish_reason: str              # "stop" (eos) | "length"
+    # "stop" (eos) | "length" | "timeout" | "cancelled" | "nan"
+    # (quarantined) | "error" — docs/SERVING.md has the full table
+    finish_reason: str
     n_gen: int = 0
+    error: Optional[str] = None     # diagnostic for finish_reason="error"
 
     def __post_init__(self):
         self.n_gen = len(self.token_ids)
@@ -85,18 +115,85 @@ class FCFSScheduler:
     bookkeeping stays in the engine/pool)."""
 
     def __init__(self, max_batch_slots: int,
-                 prefill_token_budget: int = 1024):
+                 prefill_token_budget: int = 1024,
+                 max_queue: Optional[int] = None,
+                 retry_after_cb: Optional[Callable[[], float]] = None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None: unbounded)")
         self.max_batch_slots = int(max_batch_slots)
         self.prefill_token_budget = int(prefill_token_budget)
+        # backpressure bound: add() rejects with BackpressureError past
+        # this depth. retry_after_cb computes the hint from live drain
+        # rate (the engine installs its step-time EWMA); the fallback
+        # heuristic assumes ~10 admissions/s per slot.
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._retry_after_cb = retry_after_cb
         self.waiting: deque = deque()
-        self._m_queue_wait = metrics.get_registry().histogram(
+        # deadline-bearing requests currently queued: keeps the per-step
+        # expiry sweep free (early return) for the common all-None case
+        self._n_deadlined = 0
+        reg = metrics.get_registry()
+        self._m_queue_wait = reg.histogram(
             "paddle_tpu_serving_queue_wait_seconds",
             "Time a request waits in the FCFS queue before admission")
+        self._m_rejections = reg.counter(
+            "paddle_tpu_serving_queue_rejections_total",
+            "Requests rejected at enqueue because the bounded queue was "
+            "full (BackpressureError)")
+
+    def _retry_after(self) -> float:
+        if self._retry_after_cb is not None:
+            return max(float(self._retry_after_cb()), 0.0)
+        return max(0.05, 0.1 * len(self.waiting) / self.max_batch_slots)
 
     def add(self, request: Request) -> None:
+        """Queue a request FCFS, or raise :class:`BackpressureError` when
+        the bounded queue is full (never silently drops, never grows
+        unboundedly)."""
+        if self.max_queue is not None and len(self.waiting) >= self.max_queue:
+            self._m_rejections.inc()
+            hint = self._retry_after()
+            raise BackpressureError(
+                f"scheduler queue full ({len(self.waiting)}/{self.max_queue}"
+                f" waiting, limit: max_queue={self.max_queue}); retry in "
+                f"~{hint:.3f}s", retry_after_s=hint,
+                queue_depth=len(self.waiting))
         self.waiting.append(request)
+        if request.deadline is not None:
+            self._n_deadlined += 1
+
+    def pop_expired(self) -> List[Request]:
+        """Pull every deadline-expired request out of the queue in ONE
+        pass (a mass-expiry sweep must stay O(n), not O(k*n) — a large
+        idle backlog could otherwise trip the step watchdog on its own
+        bookkeeping). Free when nothing queued carries a deadline."""
+        if self._n_deadlined == 0:
+            return []
+        expired: List[Request] = []
+        alive: deque = deque()
+        for r in self.waiting:
+            if r.deadline is not None and r.deadline.expired():
+                expired.append(r)
+            else:
+                alive.append(r)
+        self.waiting = alive
+        self._n_deadlined -= len(expired)
+        return expired
+
+    def remove(self, req_id) -> Optional[Request]:
+        """Pull a WAITING request out of the queue (cancellation path);
+        None if it is not queued (already admitted or unknown)."""
+        # by index, not deque.remove: dataclass equality would compare
+        # prompt arrays elementwise (and raise on mixed lengths)
+        for i, r in enumerate(self.waiting):
+            if r.req_id == req_id:
+                del self.waiting[i]
+                if r.deadline is not None:
+                    self._n_deadlined -= 1
+                return r
+        return None
 
     @property
     def queue_depth(self) -> int:
@@ -121,6 +218,8 @@ class FCFSScheduler:
             if not pool.can_admit(req.max_total_tokens, pending_pages):
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
+            if req.deadline is not None:
+                self._n_deadlined -= 1
             admitted.append(req)
             self._m_queue_wait.observe(time.perf_counter() - req.arrival_t)
             pending_pages += pool.pages_needed(req.max_total_tokens)

@@ -33,6 +33,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "metrics: paddle_tpu.metrics telemetry tests (tier-1 fast lane)")
+    config.addinivalue_line(
+        "markers",
+        "faults: paddle_tpu.faults chaos suite — injection framework + "
+        "serving resilience drills (tier-1 fast lane)")
 
 
 @pytest.fixture(autouse=True)

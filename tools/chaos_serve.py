@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Chaos drill for the serving stack: run the demo engine under a seeded
+fault schedule and print a pass/fail resilience report.
+
+The operational twin of tests/test_faults.py (docs/RESILIENCE.md): six
+scenarios arm ``paddle_tpu.faults`` injections against a tiny llama
+engine — NaN quarantine, page-pool exhaustion, compile-failure retry,
+deadline expiry + cancellation, queue backpressure, watchdog trip +
+``/healthz`` — and each asserts both the behavior AND the telemetry
+(every failure path must move its counter). Exit code 0 iff every
+scenario passes.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_serve.py
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import faults, metrics  # noqa: E402
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
+from paddle_tpu.serving import BackpressureError, ServingEngine  # noqa: E402
+
+SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+def _model():
+    paddle.seed(SEED)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+
+
+def _counter(name, **labels):
+    fam = metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+def _check(cond, what):
+    if not cond:
+        raise AssertionError(what)
+
+
+_RNG = np.random.RandomState(7)
+P5, P9, P3, P4 = (_RNG.randint(0, 128, (n,)) for n in (5, 9, 3, 4))
+
+
+def scenario_nan_quarantine(model):
+    """NaN in one sequence's KV: victim quarantined, mate token-identical
+    to a fault-free run, pages recover, decode compiles once."""
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    rm = ref_eng.add_request(P5, max_new_tokens=8)
+    ref_eng.add_request(P9, max_new_tokens=8)
+    ref = ref_eng.run()
+
+    before = _counter("paddle_tpu_serving_nan_quarantines_total")
+    eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    mate = eng.add_request(P5, max_new_tokens=8)
+    victim = eng.add_request(P9, max_new_tokens=8)
+    eng.step()
+    with faults.inject("serving.decode_step",
+                       call=lambda: eng.pool.poison_seq(victim),
+                       times=1, seed=SEED):
+        outs = eng.run()
+    _check(outs[victim].finish_reason == "nan", "victim not quarantined")
+    _check(list(outs[mate].token_ids) == list(ref[rm].token_ids),
+           "batch-mate tokens diverged from fault-free run")
+    _check(eng.pool.used_pages == 0, "pages leaked")
+    _check(_counter("paddle_tpu_serving_nan_quarantines_total")
+           == before + 1, "quarantine counter")
+    _check(eng.compile_counts()["decode"] == 1, "decode recompiled")
+    return (f"victim n_gen={outs[victim].n_gen} reason=nan; mate "
+            f"token-identical ({outs[mate].n_gen} tokens)")
+
+
+def scenario_pool_exhaustion(model):
+    """One injected allocation failure mid-decode: victim errors out,
+    everything else (including queued work) drains."""
+    eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    victim = eng.add_request(P3, max_new_tokens=6)
+    mate = eng.add_request(P4, max_new_tokens=6)
+    queued = eng.add_request(P3, max_new_tokens=4)
+    eng.step()
+    with faults.inject("serving.kv_alloc",
+                       raise_=faults.ResourceExhausted, times=1, seed=SEED):
+        outs = eng.run()
+    _check(outs[victim].finish_reason == "error", "victim not quarantined")
+    _check(outs[mate].finish_reason == "length", "mate was disturbed")
+    _check(outs[queued].finish_reason == "length", "queued work stranded")
+    _check(eng.pool.used_pages == 0, "pages leaked")
+    return "victim=error, mate+queued drained, 0 pages leaked"
+
+
+def scenario_compile_retry(model):
+    """A transient decode-build failure is retried; still one compile."""
+    eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+    rid = eng.add_request(P4, max_new_tokens=3)
+    before = _counter("paddle_tpu_faults_retries_total")
+    with faults.inject("serving.compile_decode",
+                       raise_=RuntimeError("flaky build"), times=1,
+                       seed=SEED):
+        outs = eng.run()
+    _check(outs[rid].finish_reason == "length", "request failed")
+    _check(_counter("paddle_tpu_faults_retries_total") > before,
+           "no retry recorded")
+    _check(eng.compile_counts()["decode"] == 1, "decode recompiled")
+    return "1 injected build failure, 1 retry, decode compiled once"
+
+
+def scenario_deadline_and_cancel(model):
+    """Deadline expiry and cancel() retire with their own reasons and
+    counters; pages free immediately."""
+    eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+    t_before = _counter("paddle_tpu_serving_request_timeouts_total")
+    c_before = _counter("paddle_tpu_serving_cancellations_total")
+    running = eng.add_request(P4, max_new_tokens=6)
+    late = eng.add_request(P3, max_new_tokens=6, deadline_s=0.0)
+    eng.step()
+    cancelled = eng.add_request(P3, max_new_tokens=6)
+    eng.cancel(cancelled)
+    eng.slots[0].req.deadline = faults.Deadline(-1.0)  # force mid-decode
+    outs = eng.run()
+    _check(outs[late].finish_reason == "timeout", "queued timeout")
+    _check(outs[running].finish_reason == "timeout", "mid-decode timeout")
+    _check(outs[cancelled].finish_reason == "cancelled", "cancel")
+    _check(_counter("paddle_tpu_serving_request_timeouts_total")
+           == t_before + 2, "timeout counter != exactly 2")
+    _check(_counter("paddle_tpu_serving_cancellations_total")
+           == c_before + 1, "cancel counter != exactly 1")
+    _check(eng.pool.used_pages == 0, "pages leaked")
+    return "2 timeouts + 1 cancel, each counted exactly once"
+
+
+def scenario_backpressure(model):
+    """A bounded queue rejects with a retry_after_s hint, not OOM."""
+    eng = ServingEngine(model, page_size=4, max_batch_slots=1, max_queue=1)
+    eng.add_request(P3, max_new_tokens=2)
+    try:
+        eng.add_request(P3, max_new_tokens=2)
+        raise AssertionError("full queue accepted a request")
+    except BackpressureError as e:
+        hint = e.retry_after_s
+    _check(hint > 0, "no retry_after_s hint")
+    eng.run()
+    eng.add_request(P3, max_new_tokens=1)  # drained queue admits again
+    eng.run()
+    return f"rejected with retry_after_s={hint:.3f}s, recovered after drain"
+
+
+def scenario_watchdog_healthz(model):
+    """Latency injection trips the watchdog; /healthz goes 503 and
+    recovers after healthy steps."""
+    eng = ServingEngine(model, page_size=4, max_batch_slots=1,
+                        watchdog_stall_s=0.005, watchdog_recovery_steps=2)
+    with metrics.MetricsServer(health_cb=eng.health, port=0) as srv:
+        with faults.inject("serving.step", delay_s=0.02, times=1,
+                           seed=SEED):
+            eng.step()
+        try:
+            urllib.request.urlopen(f"{srv.url}/healthz")
+            raise AssertionError("/healthz stayed 200 while degraded")
+        except urllib.error.HTTPError as e:
+            _check(e.code == 503, f"expected 503, got {e.code}")
+            _check(json.loads(e.read())["status"] == "degraded",
+                   "degraded body")
+        eng.step()
+        eng.step()
+        with urllib.request.urlopen(f"{srv.url}/healthz") as r:
+            _check(r.status == 200, "no recovery")
+    trips = eng.watchdog.trips
+    _check(trips == 1, f"expected exactly 1 trip episode, got {trips}")
+    return "tripped -> /healthz 503 -> recovered -> 200 (1 episode)"
+
+
+SCENARIOS = [
+    ("nan-quarantine-no-poison", scenario_nan_quarantine),
+    ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
+    ("compile-failure-retry", scenario_compile_retry),
+    ("deadline-and-cancel", scenario_deadline_and_cancel),
+    ("queue-backpressure", scenario_backpressure),
+    ("watchdog-healthz", scenario_watchdog_healthz),
+]
+
+
+def main() -> int:
+    model = _model()
+    print(f"chaos_serve: seed={SEED}, {len(SCENARIOS)} scenarios\n")
+    failures = 0
+    for name, fn in SCENARIOS:
+        faults.reset()
+        try:
+            detail = fn(model)
+            print(f"  PASS  {name:<28} {detail}")
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            failures += 1
+            print(f"  FAIL  {name:<28} {e!r}")
+    faults.reset()
+    injected = _counter("paddle_tpu_faults_injected_total",
+                        point="serving.decode_step")
+    print(f"\nfault points armed this run: "
+          f"{sorted(faults.known_points())}")
+    print(f"injected (decode_step alone): {int(injected)}; full telemetry: "
+          f"python tools/metrics_dump.py --demo")
+    verdict = "RESILIENT" if failures == 0 else f"{failures} FAILURE(S)"
+    print(f"verdict: {verdict}")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ NAMESPACES = [
     "paddle_tpu.models",
     "paddle_tpu.metric",
     "paddle_tpu.metrics",
+    "paddle_tpu.faults",
     "paddle_tpu.distribution",
     "paddle_tpu.sparse",
     "paddle_tpu.fft",
