@@ -79,6 +79,7 @@ from . import profiler  # noqa: E402
 from . import serving  # noqa: E402
 from . import reader  # noqa: E402
 from . import framework  # noqa: E402
+from . import checkpoint  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .framework.flags import get_flags, set_flags  # noqa: E402
 

@@ -1,0 +1,54 @@
+"""paddle_tpu.checkpoint — crash-consistent checkpointing + auto-resume.
+
+The training-side half of the resilience story (docs/RESILIENCE.md): on
+preemptible TPU fleets a job must survive SIGTERM without losing work,
+and a crash mid-save must never cost the *previous* checkpoint either.
+
+- :class:`CheckpointManager` (manager.py): step-versioned directory with
+  an atomic commit protocol — scratch dir, fsynced shard files, a COMMIT
+  marker carrying per-file CRC32s written last, then one atomic rename.
+  ``latest_step()`` only ever sees committed steps; ``restore()``
+  verifies checksums and quarantines corrupt steps, falling back to the
+  newest valid one; retention GC keeps the last K.
+- ``save_on_signal()`` / ``restore_or_init()``: preemption-aware resume —
+  checkpoint-and-exit on SIGTERM, one call to pick the run back up.
+- ``capture_train_state`` / ``restore_train_state`` (state.py): params +
+  optimizer moments + RNG key + dataloader position (epoch + offset), so
+  resumed training is sample-exact and token-for-token identical to an
+  uninterrupted run (proved by tools/chaos_train.py).
+
+Ten-second tour::
+
+    from paddle_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager("/ckpts/run7", max_to_keep=3)
+    res = mgr.restore_or_init()
+    start = 0
+    if res.restored:
+        start = checkpoint.restore_train_state(
+            res.state, model=net, optimizer=opt, dataloader=loader) + 1
+    step = start - 1   # bound BEFORE the handler can fire
+    scope = mgr.save_on_signal(
+        lambda: (step, checkpoint.capture_train_state(
+            model=net, optimizer=opt, dataloader=loader, step=step)))
+    for step in range(start, total_steps):
+        train_step(...)
+        mgr.save(step, checkpoint.capture_train_state(..., step=step),
+                 async_save=True)
+    checkpoint.wait()   # async saves are durable only after this returns
+
+The sharded file format underneath is ``distributed.checkpoint`` —
+cross-topology resume (save under one mesh, load under another) works
+through the same ``shardings=``/``target=`` arguments.
+"""
+from ..distributed.checkpoint import AsyncHandle, CheckpointError, wait
+from .manager import (CheckpointManager, CheckpointNotFoundError,
+                      RestoreResult)
+from .state import (capture_train_state, restore_train_state,
+                    rng_state_dict, set_rng_state_dict)
+
+__all__ = [
+    "AsyncHandle", "CheckpointError", "CheckpointManager",
+    "CheckpointNotFoundError", "RestoreResult", "capture_train_state",
+    "restore_train_state", "rng_state_dict", "set_rng_state_dict", "wait",
+]

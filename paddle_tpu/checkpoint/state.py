@@ -1,0 +1,95 @@
+"""Train-state capture/restore: params + optimizer + RNG + data position.
+
+Sample-exact resume needs more than parameters: the optimizer moments, the
+global RNG key, and where the dataloader was (epoch + batch/sample offset).
+``capture_train_state`` gathers all of it into one nested dict the
+:class:`~paddle_tpu.checkpoint.CheckpointManager` can commit atomically;
+``restore_train_state`` pushes it back. Any object with
+``state_dict``/``set_state_dict`` works for ``model`` (an ``nn.Layer``;
+for a ``hapi.Model`` pass ``model.network`` or use
+``Model.save_checkpoint``/``Model.restore_checkpoint``).
+
+RNG keys are typed jax PRNG arrays — not numpy-serializable directly —
+so they travel as their ``jax.random.key_data`` uint32 payload and are
+rebuilt with ``wrap_key_data`` on restore.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generator import default_generator, get_rng_state, set_rng_state
+from ..tensor import Tensor
+
+__all__ = [
+    "capture_train_state", "restore_train_state", "rng_state_dict",
+    "set_rng_state_dict",
+]
+
+
+def rng_state_dict() -> Dict[str, Any]:
+    """Serialize the global generator: the device key as its uint32
+    key-data, plus the base seed — host-side epoch-seeded shuffling
+    (io/sampler.py) derives from ``(seed, epoch)``, so resume must restore
+    the seed or the replayed epochs would shuffle differently."""
+    key = get_rng_state()
+    try:
+        data = jax.random.key_data(key)
+    except (TypeError, AttributeError):  # already a raw uint32 key array
+        data = key
+    return {"key_data": Tensor(np.asarray(jax.device_get(data),
+                                          dtype=np.uint32)),
+            "seed": int(default_generator.seed())}
+
+
+def set_rng_state_dict(state: Dict[str, Any]) -> None:
+    """Rebuild and install the global RNG key (and base seed) from
+    :func:`rng_state_dict` output (values may be Tensors fresh off a
+    checkpoint load)."""
+    if "seed" in state:
+        # restore the base seed WITHOUT resetting the key (manual_seed
+        # would): the key is restored explicitly below
+        default_generator._seed = int(state["seed"])
+    data = state["key_data"]
+    if isinstance(data, Tensor):
+        data = data.numpy()
+    arr = jnp.asarray(np.asarray(data, dtype=np.uint32))
+    set_rng_state(jax.random.wrap_key_data(arr))
+
+
+def capture_train_state(model=None, optimizer=None, dataloader=None,
+                        step: Optional[int] = None,
+                        extra: Optional[Dict] = None) -> Dict[str, Any]:
+    """One nested dict holding everything resume needs. Omitted pieces are
+    simply absent; ``step`` rides along as an exact python int."""
+    state: Dict[str, Any] = {"rng": rng_state_dict()}
+    if model is not None:
+        state["model"] = model.state_dict()
+    if optimizer is not None:
+        state["optimizer"] = optimizer.state_dict()
+    if dataloader is not None:
+        state["dataloader"] = dataloader.state_dict()
+    if step is not None:
+        state["step"] = int(step)
+    if extra:
+        state["extra"] = dict(extra)
+    return state
+
+
+def restore_train_state(state: Dict[str, Any], model=None, optimizer=None,
+                        dataloader=None) -> Optional[int]:
+    """Push a :func:`capture_train_state` dict back into live objects and
+    return the saved ``step`` (None if it wasn't captured)."""
+    if "rng" in state:
+        set_rng_state_dict(state["rng"])
+    if model is not None and "model" in state:
+        model.set_state_dict(state["model"])
+    if optimizer is not None and "optimizer" in state:
+        optimizer.set_state_dict(state["optimizer"])
+    if dataloader is not None and "dataloader" in state:
+        dataloader.set_state_dict(state["dataloader"])
+    step = state.get("step")
+    return None if step is None else int(step)

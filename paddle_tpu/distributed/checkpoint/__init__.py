@@ -18,30 +18,131 @@ conversion (the reference's Converter machinery: merge per-rank slices,
 re-slice for the new layout) degenerates to "read global, device_put with
 the new NamedSharding", because GSPMD owns physical layout.
 
+Crash-consistent write ordering: shard files land first (each fsynced),
+the manifest is written LAST via tmp-file + fsync + atomic ``os.replace``.
+A crash mid-save therefore leaves either (a) partial shards with no
+manifest — the load fails cleanly with "no manifest", never with silently
+missing data — or (b) a complete checkpoint. The manifest is the commit
+record of this layer; ``paddle_tpu.checkpoint.CheckpointManager`` adds a
+directory-level COMMIT marker (checksums + atomic rename) on top.
+
 Async save snapshots device arrays to host, then writes files on a
-background thread; ``AsyncHandle.wait()`` (or module ``wait()``) joins.
+background thread; ``AsyncHandle.wait()`` (or module ``wait()``) joins and
+RE-RAISES any exception the writer thread hit (disk full, injected fault):
+an async save is not durable until ``wait()`` returned without raising.
+
+Fault points (armed via ``paddle_tpu.faults.inject`` in chaos tests):
+``ckpt.write`` before each shard-file write, ``ckpt.fsync`` before each
+fsync, ``ckpt.manifest`` before the manifest write.
 """
 from __future__ import annotations
 
 import json
 import os
 import threading
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ... import faults
+from ...framework.io import _fsync_dir, _fsync_file
 from ...tensor import Tensor
 
 __all__ = [
-    "save_state_dict", "load_state_dict", "Converter", "AsyncHandle", "wait",
+    "save_state_dict", "load_state_dict", "Converter", "AsyncHandle",
+    "CheckpointError", "wait",
 ]
 
 _META = "checkpoint.metadata.json"
 _SEP = "//"  # flat-key separator for nested dicts
 
 _pending: list = []
-_pending_lock = threading.Lock()
+# REENTRANT: the save_on_signal preemption handler runs on the main thread
+# and may interrupt a frame that is inside this lock — a plain Lock would
+# self-deadlock the handler
+_pending_lock = threading.RLock()
+
+faults.declare_point("ckpt.write", "before each checkpoint file write")
+faults.declare_point("ckpt.fsync", "before each checkpoint fsync")
+faults.declare_point("ckpt.manifest", "before the shard-manifest write")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint save failed. Raised by ``AsyncHandle.wait()`` when the
+    background writer crashed, and by module ``wait()`` aggregating several
+    failed saves (individual exceptions ride in ``errors``)."""
+
+    def __init__(self, msg: str, errors: Optional[list] = None):
+        super().__init__(msg)
+        self.errors = list(errors or [])
+
+
+class _DigestWriter:
+    """File-object proxy accumulating size + CRC32 as bytes stream through
+    — checkpoint digests come for free at write time instead of a second
+    full read pass at commit."""
+
+    __slots__ = ("_fh", "size", "crc")
+
+    def __init__(self, fh):
+        self._fh = fh
+        self.size = 0
+        self.crc = 0
+
+    def write(self, data) -> int:
+        n = self._fh.write(data)
+        b = memoryview(data)  # no copy: crc32 takes any buffer object
+        self.size += b.nbytes
+        self.crc = zlib.crc32(b, self.crc)
+        return n
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def digest(self) -> Dict[str, int]:
+        return {"size": self.size, "crc32": self.crc}
+
+
+def _write_shard_file(fname: str, arr: np.ndarray) -> Dict[str, int]:
+    faults.point("ckpt.write")
+    with open(fname, "wb") as fh:
+        w = _DigestWriter(fh)
+        np.save(w, arr, allow_pickle=False)
+        _fsync_file(fh)
+    return w.digest()
+
+
+def _atomic_json_write(path: str, payload: Dict[str, Any]) -> Dict[str, int]:
+    """tmp file + fsync + atomic ``os.replace`` + parent-dir fsync — the one
+    durable-small-file primitive (manifest, scalars, COMMIT marker all ride
+    it). Callers fire their own phase fault point first; the fsyncs inside
+    pass ``ckpt.fsync``. Returns the written bytes' digest."""
+    data = json.dumps(payload).encode()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            _fsync_file(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path) or ".")
+    return {"size": len(data), "crc32": zlib.crc32(data)}
+
+
+def _write_manifest(manifest: str, meta: Dict[str, Any]) -> Dict[str, int]:
+    """Manifest lands atomically and LAST — it is the record of commitment
+    for this layer: its presence implies every shard it references is
+    already durable."""
+    faults.point("ckpt.manifest")
+    return _atomic_json_write(manifest, meta)
 
 
 def _flatten(d: Any, prefix: str = "") -> Dict[str, Any]:
@@ -120,37 +221,54 @@ def save_state_dict(state_dict: Dict, path: str, async_save: bool = False,
         meta["leaves"][key] = entry
 
     # process 0 owns the manifest; per-process shard lists are merged by
-    # suffixing (multi-host: every process writes its own manifest part)
+    # suffixing (multi-host: every process writes its own manifest part).
+    # Written AFTER the shard files: a crash mid-save must never leave a
+    # manifest referencing missing or partially-written shards.
     manifest = os.path.join(
         path, _META if pidx == 0 else f"{_META}.p{pidx}")
-    with open(manifest, "w") as f:
-        json.dump(meta, f)
-
-    def do_writes():
-        for fname, data in writes:
-            arr = _encode(np.asarray(jax.device_get(data)))
-            with open(fname, "wb") as fh:
-                np.save(fh, arr, allow_pickle=False)
 
     if async_save:
         # snapshot to host first so training can mutate params immediately
         snapped = [(f, _encode(np.asarray(jax.device_get(d))))
                    for f, d in writes]
 
-        def bg():
+        def bg(handle):
             for fname, arr in snapped:
-                with open(fname, "wb") as fh:
-                    np.save(fh, arr, allow_pickle=False)
+                handle.digests[os.path.basename(fname)] = \
+                    _write_shard_file(fname, arr)
+            handle.digests[os.path.basename(manifest)] = \
+                _write_manifest(manifest, meta)
 
-        t = threading.Thread(target=bg, daemon=True)
-        handle = AsyncHandle(t)
-        with _pending_lock:
-            _pending.append(handle)
-        t.start()
-        return handle
+        return _spawn_async(bg, pass_handle=True)
 
-    do_writes()
-    return AsyncHandle(None)
+    out = AsyncHandle(None)
+    for fname, data in writes:
+        out.digests[os.path.basename(fname)] = _write_shard_file(
+            fname, _encode(np.asarray(jax.device_get(data))))
+    out.digests[os.path.basename(manifest)] = _write_manifest(manifest, meta)
+    return out
+
+
+def _spawn_async(fn, pass_handle: bool = False) -> "AsyncHandle":
+    """Run ``fn`` on a daemon thread behind an :class:`AsyncHandle` that
+    captures any exception for re-raise at ``wait()`` (a swallowed writer
+    error would report a durable checkpoint that does not exist).
+    ``pass_handle`` hands the handle to ``fn`` so the writer can publish
+    per-file digests on it (visible after ``wait()``'s join)."""
+    handle = AsyncHandle(None)
+
+    def guarded():
+        try:
+            fn(handle) if pass_handle else fn()
+        except BaseException as exc:  # noqa: BLE001 - surfaced at wait()
+            handle._error = exc
+
+    t = threading.Thread(target=guarded, daemon=True)
+    handle._thread = t
+    with _pending_lock:
+        _pending.append(handle)
+    t.start()
+    return handle
 
 
 def _safe(key: str) -> str:
@@ -247,10 +365,25 @@ def load_state_dict(path: str, shardings: Optional[Dict] = None,
 
 class AsyncHandle:
     """Join handle for an async save (reference: async checkpoint semantics
-    of SURVEY §5 — Orbax-style wait)."""
+    of SURVEY §5 — Orbax-style wait).
 
-    def __init__(self, thread: Optional[threading.Thread]):
+    The writer thread's exception (disk full, injected fault) is captured
+    and re-raised from :meth:`wait` — an async save is only durable once
+    ``wait()`` returns without raising. :meth:`done` is True only for a
+    *successful* finish; a crashed save reports :meth:`failed` instead."""
+
+    def __init__(self, thread: Optional[threading.Thread] = None):
         self._thread = thread
+        self._error: Optional[BaseException] = None
+        # {basename: {"size", "crc32"}} accumulated by the writer as bytes
+        # stream out — consumed by CheckpointManager's COMMIT marker
+        self.digests: Dict[str, Dict[str, int]] = {}
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The writer thread's exception, if it crashed (None while running
+        or after success)."""
+        return self._error
 
     def wait(self):
         if self._thread is not None:
@@ -258,17 +391,45 @@ class AsyncHandle:
         with _pending_lock:
             if self in _pending:
                 _pending.remove(self)
+        if self._error is not None:
+            raise self._error
 
     def done(self) -> bool:
-        return self._thread is None or not self._thread.is_alive()
+        """Finished successfully (False while running OR after a crash)."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        return self._error is None
+
+    def failed(self) -> bool:
+        """Finished by crashing — ``wait()`` will re-raise the error."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        return self._error is not None
 
 
 def wait():
-    """Join ALL outstanding async saves."""
+    """Join ALL outstanding async saves. Aggregates failures: a single
+    crashed save re-raises its original exception; several raise one
+    :class:`CheckpointError` carrying them all in ``.errors``."""
     with _pending_lock:
         pending = list(_pending)
+    errors = []
     for h in pending:
-        h.wait()
+        try:
+            h.wait()
+        except BaseException as exc:  # noqa: BLE001 - aggregated below
+            # chained handles (CheckpointManager's writer + commit pair)
+            # re-raise the SAME exception object — one failed save must
+            # count once
+            if not any(exc is e for e in errors):
+                errors.append(exc)
+    if len(errors) == 1:
+        raise errors[0]
+    if errors:
+        raise CheckpointError(
+            f"{len(errors)} async checkpoint saves failed: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in errors),
+            errors=errors)
 
 
 class Converter:

@@ -15,7 +15,34 @@ from typing import Any
 import jax
 import numpy as np
 
+from .. import faults
 from ..tensor import Tensor
+
+
+def _fsync_file(fh) -> None:
+    """flush + fsync behind the ``ckpt.fsync`` fault point — the one
+    durability barrier all checkpoint writers share (this module,
+    distributed.checkpoint, checkpoint.CheckpointManager)."""
+    fh.flush()
+    faults.point("ckpt.fsync")
+    os.fsync(fh.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a directory entry durable (POSIX: rename/create is only on
+    disk once the parent directory is fsynced). Best-effort on platforms
+    without O_DIRECTORY semantics."""
+    faults.point("ckpt.fsync")
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class _TensorPayload:
@@ -60,12 +87,31 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
         ...     path = os.path.join(d, "linear.pdparams")
         ...     paddle.save(layer.state_dict(), path)
         ...     layer.set_state_dict(paddle.load(path))
+
+    Crash-consistent: bytes go to ``<path>.tmp-<pid>``, are fsynced, and the
+    tmp file is atomically ``os.replace``d over ``path`` — a crash mid-save
+    can never truncate an existing checkpoint in place; readers see either
+    the old complete file or the new complete file.
     """
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        faults.point("ckpt.write")
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
+            _fsync_file(f)
+        faults.point("ckpt.commit")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d or ".")
 
 
 def load(path: str, **configs) -> Any:

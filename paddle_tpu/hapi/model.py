@@ -146,10 +146,19 @@ class Model:
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None,
-            accumulate_grad_batches: int = 1, num_iters: Optional[int] = None):
+            accumulate_grad_batches: int = 1, num_iters: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None, resume: bool = True):
         """reference: model.py fit — epoch/step loop + callbacks + periodic
         eval + checkpointing. ``accumulate_grad_batches`` applies the
-        optimizer every N micro-batches (reference gradient merge)."""
+        optimizer every N micro-batches (reference gradient merge).
+
+        ``checkpoint_dir`` switches on crash-consistent, preemption-aware
+        checkpointing via ``paddle_tpu.checkpoint.CheckpointManager``: a
+        committed step (params + optimizer + RNG) lands every ``save_freq``
+        epochs, and with ``resume=True`` (default) fit() first restores the
+        newest valid step and continues from the following epoch — rerunning
+        the same command after a crash or preemption picks the run back up.
+        (``save_dir`` remains the reference's plain .pdparams path.)"""
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers)
@@ -162,15 +171,52 @@ class Model:
             save_freq=save_freq, save_dir=save_dir,
             metrics=[m.name() for m in self._metrics])
 
+        ckpt_mgr = None
+        start_epoch = 0
+        if checkpoint_dir is not None:
+            from .. import checkpoint as _ckpt
+
+            ckpt_mgr = _ckpt.CheckpointManager(checkpoint_dir)
+            if resume:
+                res = ckpt_mgr.restore_or_init()
+                if res.restored:
+                    if "epoch" not in res.state:
+                        # e.g. written by save_checkpoint(step=...): a
+                        # global step is NOT an epoch count — resuming
+                        # "epoch 5001 of 10" would silently train nothing
+                        raise ValueError(
+                            f"checkpoint step {res.step} in "
+                            f"{checkpoint_dir!r} has no epoch marker "
+                            f"(written by save_checkpoint?); fit can only "
+                            f"resume epoch-granular checkpoints it wrote")
+                    self._restore_training_state(res.state)
+                    start_epoch = int(res.state["epoch"]) + 1
+                    if hasattr(loader, "set_epoch"):
+                        # align the shuffle stream: epoch-seeded sampling
+                        # must replay the orders the uninterrupted run
+                        # would have used from this epoch on
+                        loader.set_epoch(start_epoch)
+            elif ckpt_mgr.all_steps():
+                # a fresh run would collide with (and silently never
+                # overwrite) the committed steps already here — refuse
+                # loudly rather than lose every new checkpoint
+                raise ValueError(
+                    f"checkpoint_dir {checkpoint_dir!r} already holds "
+                    f"committed steps {ckpt_mgr.all_steps()}; pass "
+                    f"resume=True to continue that run, or point "
+                    f"checkpoint_dir at a fresh directory")
+
         cbks.on_train_begin()
         iters_done = 0
-        for epoch in range(epochs):
+        logs = {}  # resume may satisfy every epoch: loop body never runs
+        for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
+            epoch_completed = True  # False only on the mid-epoch break
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 x, y = (batch[0], batch[1]) if isinstance(
@@ -182,8 +228,18 @@ class Model:
                 iters_done += 1
                 if num_iters is not None and iters_done >= num_iters:
                     self.stop_training = True
+                    epoch_completed = False
                     break
             cbks.on_epoch_end(epoch, logs)
+            # only a COMPLETED epoch commits: a num_iters break mid-epoch
+            # must not record epoch N as done, or resume would skip the
+            # batches it never saw. A callback stopping training AFTER the
+            # batch loop finished (early stopping) still checkpoints its
+            # final epoch. (A duplicate step is a loud ValueError from the
+            # manager, never a silent skip.)
+            if ckpt_mgr is not None and epoch_completed \
+                    and (epoch + 1) % save_freq == 0:
+                ckpt_mgr.save(epoch, self._training_state(epoch))
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 # reference fit loop brackets evaluation with
@@ -295,6 +351,51 @@ class Model:
         if (not reset_optimizer and self._optimizer is not None
                 and os.path.exists(opt_path)):
             self._optimizer.set_state_dict(_fio.load(opt_path))
+
+    # -------------------------------------------- crash-consistent ckpt
+    def _training_state(self, epoch: Optional[int] = None) -> dict:
+        from .. import checkpoint as _ckpt
+
+        state = _ckpt.capture_train_state(
+            model=self.network, optimizer=self._optimizer)
+        if epoch is not None:
+            state["epoch"] = int(epoch)
+        return state
+
+    def _restore_training_state(self, state: dict):
+        from .. import checkpoint as _ckpt
+
+        _ckpt.restore_train_state(state, model=self.network,
+                                  optimizer=self._optimizer)
+
+    def save_checkpoint(self, directory: str, step: int,
+                        max_to_keep: Optional[int] = 5,
+                        async_save: bool = False):
+        """Commit a crash-consistent checkpoint (params + optimizer + RNG)
+        as step ``step`` under ``directory`` — the CheckpointManager commit
+        protocol, unlike :meth:`save`'s plain (but atomic) pickle files.
+        The step is a GLOBAL step, stored as ``step`` (not ``epoch`` —
+        fit's epoch-granular resume refuses step-only checkpoints rather
+        than misreading a step count as an epoch count). Returns the
+        manager's async handle (``wait()`` it for async)."""
+        from .. import checkpoint as _ckpt
+
+        mgr = _ckpt.CheckpointManager(directory, max_to_keep=max_to_keep)
+        state = _ckpt.capture_train_state(
+            model=self.network, optimizer=self._optimizer, step=int(step))
+        return mgr.save(int(step), state, async_save=async_save)
+
+    def restore_checkpoint(self, directory: str) -> Optional[int]:
+        """Auto-resume: restore the newest valid committed step (verifying
+        checksums, quarantining corruption). Returns the restored step, or
+        None when the directory holds nothing restorable."""
+        from .. import checkpoint as _ckpt
+
+        res = _ckpt.CheckpointManager(directory).restore_or_init()
+        if not res.restored:
+            return None
+        self._restore_training_state(res.state)
+        return res.step
 
     # ------------------------------------------------------------- intro
     def parameters(self, *args, **kwargs):

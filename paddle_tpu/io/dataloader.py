@@ -30,6 +30,14 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "default_collate_fn"]
 
 
+def _end_epoch_once(it):
+    """Advance the loader's epoch exactly once per exhausted iterator, no
+    matter how many times next() is re-called on it."""
+    if not getattr(it, "_epoch_noted", False):
+        it._epoch_noted = True
+        it._loader._note_epoch_end()
+
+
 def default_collate_fn(batch):
     """Stack samples into batch arrays (reference:
     fluid/dataloader/collate.py default_collate_fn)."""
@@ -53,14 +61,23 @@ def default_collate_fn(batch):
 class _SingleProcessIter:
     def __init__(self, loader: "DataLoader"):
         self._loader = loader
-        self._index_iter = iter(loader.batch_sampler)
+        # lazy: the sampler streams batch-by-batch (an epoch of a 100M
+        # sample dataset must not materialize millions of index lists);
+        # only the prefetch iterators need the whole list up front
+        self._it = loader._epoch_index_iter()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        indices = next(self._index_iter)
-        return self._loader._fetch(indices)
+        try:
+            indices = next(self._it)
+        except StopIteration:
+            _end_epoch_once(self)
+            raise
+        batch = self._loader._fetch(indices)
+        self._loader._note_batch(len(indices))
+        return batch
 
 
 class _ThreadedPrefetchIter:
@@ -68,7 +85,7 @@ class _ThreadedPrefetchIter:
 
     def __init__(self, loader: "DataLoader"):
         self._loader = loader
-        self._indices = list(iter(loader.batch_sampler))
+        self._indices = loader._epoch_indices()
         capacity = max(2, loader.prefetch_factor * loader.num_workers)
         self._results: dict = {}
         self._results_lock = threading.Condition()
@@ -122,6 +139,7 @@ class _ThreadedPrefetchIter:
     def __next__(self):
         if self._next_out >= len(self._indices):
             self.close()
+            _end_epoch_once(self)
             raise StopIteration
         with self._results_lock:
             while self._next_out not in self._results:
@@ -132,6 +150,7 @@ class _ThreadedPrefetchIter:
         if err is not None:
             self.close()
             raise RuntimeError(f"DataLoader worker failed:\n{err}")
+        self._loader._note_batch(len(self._indices[i]))
         return batch
 
     def close(self):
@@ -188,7 +207,7 @@ class _ProcessPoolIter:
         from collections import deque
 
         self._loader = loader
-        self._indices = list(iter(loader.batch_sampler))
+        self._indices = loader._epoch_indices()
         ctx = mp.get_context("spawn")
         self._pool = ctx.Pool(
             loader.num_workers, initializer=_process_worker_init,
@@ -216,6 +235,7 @@ class _ProcessPoolIter:
     def __next__(self):
         if not self._pending:
             self.close()
+            _end_epoch_once(self)
             raise StopIteration
         res = self._pending.popleft()
         try:
@@ -225,7 +245,9 @@ class _ProcessPoolIter:
             raise
         self._fill()
         collate = self._loader.collate_fn or default_collate_fn
-        return collate(samples)
+        batch = collate(samples)
+        self._loader._note_batch(len(samples))
+        return batch
 
     def close(self):
         pool, self._pool = self._pool, None
@@ -272,7 +294,7 @@ class _ShmProcessPoolIter:
         self._loader = loader
         self._pool = None
         self._channel = None
-        self._indices = list(iter(loader.batch_sampler))
+        self._indices = loader._epoch_indices()
         self._capacity = max(2, loader.prefetch_factor * loader.num_workers)
         self._pending = deque()
         self._next_submit = 0
@@ -318,6 +340,7 @@ class _ShmProcessPoolIter:
     def __next__(self):
         if self._next_seq >= len(self._indices):
             self.close()
+            _end_epoch_once(self)
             raise StopIteration
         want = self._next_seq
         while want not in self._stash:
@@ -336,7 +359,9 @@ class _ShmProcessPoolIter:
         samples = self._stash.pop(want)
         self._next_seq += 1
         collate = self._loader.collate_fn or default_collate_fn
-        return collate(samples)
+        batch = collate(samples)
+        self._loader._note_batch(len(samples))
+        return batch
 
     def close(self):
         pool, self._pool = getattr(self, "_pool", None), None
@@ -358,6 +383,16 @@ class _IterableDatasetIter:
     def __init__(self, loader: "DataLoader"):
         self._loader = loader
         self._it = iter(loader.dataset)
+        # resume for iterable datasets = skip-by-consume: the stream is
+        # re-iterated from the top and the already-served prefix discarded
+        # (sample-exact iff the iterable is deterministic); counters reset
+        # to what this iterator actually skipped
+        skip = loader._consume_resume_batches()
+        loader._batches_served = loader._samples_served = 0
+        for b in _chunks_consumed(self._it, skip, loader.batch_size,
+                                  loader.drop_last):
+            loader._batches_served += 1
+            loader._samples_served += len(b)
 
     def __iter__(self):
         return self
@@ -365,11 +400,25 @@ class _IterableDatasetIter:
     def __next__(self):
         batch = list(itertools.islice(self._it, self._loader.batch_size))
         if not batch:
+            _end_epoch_once(self)
             raise StopIteration
         if self._loader.drop_last and len(batch) < self._loader.batch_size:
+            _end_epoch_once(self)
             raise StopIteration
         collate = self._loader.collate_fn or default_collate_fn
-        return collate(batch)
+        out = collate(batch)
+        self._loader._note_batch(len(batch))
+        return out
+
+
+def _chunks_consumed(it, n_batches, batch_size, drop_last):
+    """Pull (and discard) the first ``n_batches`` batches of an iterable
+    stream, yielding them so the caller can count skipped samples."""
+    for _ in range(n_batches):
+        batch = list(itertools.islice(it, batch_size))
+        if not batch or (drop_last and len(batch) < batch_size):
+            return
+        yield batch
 
 
 class DataLoader:
@@ -425,6 +474,19 @@ class DataLoader:
         self.worker_mode = worker_mode
         self._is_iterable = isinstance(dataset, IterableDataset)
         self.drop_last = drop_last
+        # checkpoint-resume position: epoch + batches/samples consumed this
+        # epoch (docs/RESILIENCE.md). _resume_batches is the pending skip
+        # the NEXT __iter__ applies; counters advance as batches are
+        # *consumed* (not prefetched), so state_dict() mid-epoch is exact.
+        self._epoch = 0
+        self._batches_served = 0
+        self._samples_served = 0
+        self._resume_batches = 0
+        # epoch-driving (set_epoch per __iter__) applies only to a sampler
+        # the loader built itself: a user-provided batch_sampler keeps full
+        # control of its own epoch/shuffle stream (the reference pattern of
+        # calling DistributedBatchSampler.set_epoch by hand every epoch)
+        self._owns_batch_sampler = False
         if self._is_iterable:
             assert batch_sampler is None, (
                 "batch_sampler is not supported for IterableDataset"
@@ -440,11 +502,78 @@ class DataLoader:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size,
                 drop_last=drop_last)
+            self._owns_batch_sampler = True
 
     def _fetch(self, indices):
         samples = [self.dataset[i] for i in indices]
         collate = self.collate_fn or default_collate_fn
         return collate(samples)
+
+    # ------------------------------------------------- checkpoint resume
+    def set_epoch(self, epoch: int):
+        """Pin the epoch (shuffle stream + resume bookkeeping). Called
+        automatically at each __iter__; call manually to replay or skip
+        epochs. Epoch-seeded shuffling means the same (global seed, epoch)
+        always yields the same batch order — the property checkpoint
+        resume needs."""
+        self._epoch = int(epoch)
+
+    def state_dict(self) -> dict:
+        """Exact stream position: epoch + batches/samples consumed within
+        it. Goes inside a checkpoint (see paddle_tpu.checkpoint
+        capture_train_state) so resume continues from the next sample."""
+        return {"epoch": self._epoch, "batch": self._batches_served,
+                "sample": self._samples_served}
+
+    def set_state_dict(self, state: dict):
+        """Resume from a :meth:`state_dict` position: the next __iter__
+        replays the saved epoch's order and skips the already-consumed
+        prefix, so the first batch served is exactly the one the
+        interrupted run would have seen next."""
+        self._epoch = int(state.get("epoch", 0))
+        self._batches_served = int(state.get("batch", 0))
+        self._samples_served = int(state.get("sample", 0))
+        self._resume_batches = self._batches_served
+
+    load_state_dict = set_state_dict
+
+    def _epoch_index_iter(self):
+        """Lazy batch-index stream for the current epoch, the resume skip
+        already consumed. The newest iterator owns the position: counters
+        reset to its start offset, so an abandoned mid-epoch iterator
+        can't leave stale batch/sample counts behind in state_dict()."""
+        if self._owns_batch_sampler and hasattr(
+                self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(self._epoch)
+        it = iter(self.batch_sampler)
+        skip = self._consume_resume_batches()
+        served = samples = 0
+        for b in itertools.islice(it, skip):
+            served += 1
+            samples += len(b)
+        self._batches_served = served
+        self._samples_served = samples
+        return it
+
+    def _epoch_indices(self):
+        """Materialized form of :meth:`_epoch_index_iter` for the prefetch
+        iterators, which need random access for ordered multi-worker
+        scheduling."""
+        return list(self._epoch_index_iter())
+
+    def _consume_resume_batches(self) -> int:
+        skip, self._resume_batches = self._resume_batches, 0
+        return skip
+
+    def _note_batch(self, n_samples: int):
+        self._batches_served += 1
+        self._samples_served += int(n_samples)
+
+    def _note_epoch_end(self):
+        self._epoch += 1
+        self._batches_served = 0
+        self._samples_served = 0
+        self._resume_batches = 0
 
     def __iter__(self):
         if self._is_iterable:
@@ -452,10 +581,13 @@ class DataLoader:
         if self.num_workers > 0:
             if self.worker_mode == "process":
                 if self.use_shared_memory:
+                    saved_resume = self._resume_batches
                     try:
                         return _ShmProcessPoolIter(self)
                     except Exception:  # shm unavailable: fall back to pipes
-                        pass
+                        # the failed iterator may already have consumed the
+                        # resume skip — restore it for the fallback
+                        self._resume_batches = saved_resume
                 return _ProcessPoolIter(self)
             return _ThreadedPrefetchIter(self)
         return _SingleProcessIter(self)

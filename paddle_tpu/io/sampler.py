@@ -11,7 +11,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from ..generator import host_rng
+from ..generator import default_generator, host_rng
 
 __all__ = [
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
@@ -19,9 +19,29 @@ __all__ = [
 ]
 
 
+def _epoch_rng(epoch, tag: int) -> np.random.Generator:
+    """Epoch-deterministic RNG for randomized samplers whose epoch is
+    pinned (checkpoint resume must replay the exact order). The per-class
+    ``tag`` and the tuple shape give domain separation from host_rng()'s
+    (seed, counter) space and from each other — two samplers sharing a
+    seed and epoch must not draw in lockstep. epoch=None keeps the legacy
+    free-running stream."""
+    if epoch is None:
+        return host_rng()
+    return np.random.default_rng((default_generator.seed(), tag, epoch))
+
+
 class Sampler:
     def __init__(self, data_source=None):
         self.data_source = data_source
+        # set_epoch pins randomized samplers to an epoch-deterministic
+        # stream — the contract checkpoint resume relies on: the same
+        # (global seed, epoch) must yield the same order in both the
+        # interrupted and the resumed run. None = legacy free-running RNG.
+        self.epoch: Optional[int] = None
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
 
     def __iter__(self) -> Iterator[int]:
         raise NotImplementedError
@@ -63,7 +83,7 @@ class RandomSampler(Sampler):
             yield from (int(i) for i in
                         itertools.islice(self.generator, self.num_samples))
             return
-        rng = host_rng()
+        rng = _epoch_rng(self.epoch, 0x5EED)
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:
@@ -79,7 +99,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        rng = host_rng()
+        rng = _epoch_rng(self.epoch, 0x5EEE)
         yield from (self.indices[i] for i in rng.permutation(len(self.indices)))
 
     def __len__(self):
@@ -103,7 +123,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        rng = host_rng()
+        rng = _epoch_rng(self.epoch, 0x5EEF)
         idx = rng.choice(len(p), size=self.num_samples, replace=self.replacement, p=p)
         yield from idx.tolist()
 
@@ -130,6 +150,14 @@ class BatchSampler(Sampler):
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.shuffle = shuffle
+
+    def set_epoch(self, epoch: int) -> None:
+        """Forwarded to the wrapped sampler: makes a shuffled epoch
+        deterministic given (global seed, epoch) — checkpoint resume
+        replays the exact same batch order."""
+        super().set_epoch(epoch)
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self) -> Iterator[List[int]]:
         batch = []

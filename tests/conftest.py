@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers",
         "faults: paddle_tpu.faults chaos suite — injection framework + "
         "serving resilience drills (tier-1 fast lane)")
+    config.addinivalue_line(
+        "markers",
+        "checkpoint: paddle_tpu.checkpoint crash-consistency suite — "
+        "commit-protocol crash matrix + auto-resume (tier-1 fast lane)")
 
 
 @pytest.fixture(autouse=True)

@@ -139,3 +139,84 @@ def test_converter_class(tmp_path):
     out = conv.convert(path=str(tmp_path / "ck"))
     np.testing.assert_array_equal(
         np.asarray(out["linear2"]["weight"].numpy()), raw["linear2//weight"])
+
+
+# ------------------------------------------- crash-consistency satellites
+def test_shards_land_before_manifest(tmp_path):
+    """ISSUE 5 satellite: manifest must be written LAST. A fault killing
+    the manifest write leaves shard files but NO manifest — load fails
+    cleanly instead of referencing missing shards."""
+    from paddle_tpu import faults
+
+    mesh = create_mesh({"dp": 8})
+    state, _ = _sharded_state(mesh)
+    with faults.inject("ckpt.manifest", raise_=faults.FaultInjected,
+                       times=1):
+        with pytest.raises(faults.FaultInjected):
+            ckpt.save_state_dict(state, str(tmp_path / "ck"))
+    assert not os.path.exists(tmp_path / "ck" / "checkpoint.metadata.json")
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_state_dict(str(tmp_path / "ck"))
+    # and a fault killing the FIRST shard write leaves no manifest either
+    with faults.inject("ckpt.write", raise_=faults.FaultInjected, times=1):
+        with pytest.raises(faults.FaultInjected):
+            ckpt.save_state_dict(state, str(tmp_path / "ck2"))
+    assert not os.path.exists(tmp_path / "ck2" / "checkpoint.metadata.json")
+
+
+def test_async_save_error_reraised_at_wait(tmp_path):
+    """ISSUE 5 satellite: the background writer must not swallow
+    exceptions — wait() re-raises, done() stays False, failed() is True."""
+    from paddle_tpu import faults
+
+    mesh = create_mesh({"dp": 8})
+    state, _ = _sharded_state(mesh)
+    with faults.inject("ckpt.write", raise_=faults.FaultInjected, times=1):
+        h = ckpt.save_state_dict(state, str(tmp_path / "ck"),
+                                 async_save=True)
+        with pytest.raises(faults.FaultInjected):
+            h.wait()
+    assert h.failed() and not h.done()
+    assert isinstance(h.error, faults.FaultInjected)
+    # wait() keeps raising on repeat calls (idempotent error)
+    with pytest.raises(faults.FaultInjected):
+        h.wait()
+
+
+def test_module_wait_aggregates_errors(tmp_path):
+    """Module-level wait() joins all pending saves and aggregates their
+    failures into one CheckpointError."""
+    from paddle_tpu import faults
+
+    mesh = create_mesh({"dp": 8})
+    state, _ = _sharded_state(mesh)
+    with faults.inject("ckpt.write", raise_=faults.FaultInjected, times=2):
+        h1 = ckpt.save_state_dict(state, str(tmp_path / "a"),
+                                  async_save=True)
+        h2 = ckpt.save_state_dict(state, str(tmp_path / "b"),
+                                  async_save=True)
+        for h in (h1, h2):  # join without consuming the error
+            if h._thread is not None:
+                h._thread.join()
+        n_failed = sum(1 for h in (h1, h2) if h.failed())
+        if n_failed == 2:
+            with pytest.raises(ckpt.CheckpointError) as ei:
+                ckpt.wait()
+            assert len(ei.value.errors) == 2
+        else:  # scheduling raced: exactly one save lost the injection
+            with pytest.raises(faults.FaultInjected):
+                ckpt.wait()
+    ckpt.wait()  # queue drained: further waits are clean
+
+
+def test_shard_files_are_fsynced_via_fault_point(tmp_path):
+    """Every shard write passes the ckpt.fsync point (durability hook the
+    chaos drill arms)."""
+    from paddle_tpu import faults
+
+    mesh = create_mesh({"dp": 8})
+    state, _ = _sharded_state(mesh)
+    with faults.inject("ckpt.fsync", delay_s=0.0,
+                       call=lambda: None) as spec:
+        ckpt.save_state_dict(state, str(tmp_path / "ck")).wait()
+    assert spec.fired >= 4  # >= one per shard file + manifest

