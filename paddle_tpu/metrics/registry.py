@@ -179,27 +179,32 @@ class _HistogramChild:
         the cumulative count crosses ``q * count`` and interpolate linearly
         inside it (first bucket interpolates from 0; the +Inf bucket clamps
         to the last finite bound). None before any observation."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError("quantile q must be in [0, 1]")
         fam = self._family
         with fam._lock:
             total = self._count
             counts = list(self._counts)
-        if total == 0:
-            return None
-        target = q * total
-        cum = 0.0
-        for i, c in enumerate(counts):
-            prev = cum
-            cum += c
-            if cum >= target and c > 0:
-                bounds = fam.buckets
-                if i >= len(bounds):       # +Inf bucket
-                    return bounds[-1]
-                lo = 0.0 if i == 0 else bounds[i - 1]
-                hi = bounds[i]
-                return lo + (hi - lo) * (target - prev) / c
-        return fam.buckets[-1]
+        return _quantile_from_counts(counts, total, fam.buckets, q)
+
+
+def _quantile_from_counts(counts, total, bounds, q: float) -> Optional[float]:
+    """The one copy of the bucket-interpolation math, shared by per-series
+    and family-aggregated (label-merged) quantiles."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("quantile q must be in [0, 1]")
+    if total == 0:
+        return None
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev = cum
+        cum += c
+        if cum >= target and c > 0:
+            if i >= len(bounds):       # +Inf bucket
+                return bounds[-1]
+            lo = 0.0 if i == 0 else bounds[i - 1]
+            hi = bounds[i]
+            return lo + (hi - lo) * (target - prev) / c
+    return bounds[-1]
 
 
 class _Timer:
@@ -294,6 +299,10 @@ class _MetricFamily:
         with self._lock:
             return list(self._children.items())
 
+    def _children_snapshot(self):
+        with self._lock:
+            return list(self._children.values())
+
     def _reset(self):
         with self._lock:
             for child in self._children.values():
@@ -307,7 +316,13 @@ class _MetricFamily:
 
 class Counter(_MetricFamily):
     """Monotonically increasing count (requests served, tokens emitted,
-    programs compiled). Convention: name ends in ``_total``."""
+    programs compiled). Convention: name ends in ``_total``.
+
+    Family-level reads AGGREGATE: on a labeled family ``value`` sums every
+    child series (the fleet total a ``router`` deployment wants when the
+    same counter carries per-engine ``engine_id`` labels). Writes stay
+    per-series — ``inc()`` on a labeled family raises, because an
+    unattributed increment has no series to land in."""
 
     kind = "counter"
     _child_cls = _CounterChild
@@ -317,12 +332,16 @@ class Counter(_MetricFamily):
 
     @property
     def value(self) -> float:
+        if self.label_names:
+            return sum(c.value for c in self._children_snapshot())
         return self._default_child().value
 
 
 class Gauge(_MetricFamily):
     """Point-in-time value that can go both ways (queue depth, page
-    utilization, tokens/s)."""
+    utilization, tokens/s). Like :class:`Counter`, family-level ``value``
+    on a labeled family sums the children (pages used across a fleet of
+    engines); ``set``/``inc``/``dec`` need ``.labels(...)`` first."""
 
     kind = "gauge"
     _child_cls = _GaugeChild
@@ -338,6 +357,8 @@ class Gauge(_MetricFamily):
 
     @property
     def value(self) -> float:
+        if self.label_names:
+            return sum(c.value for c in self._children_snapshot())
         return self._default_child().value
 
 
@@ -397,15 +418,37 @@ class Histogram(_MetricFamily):
     def time(self) -> _Timer:
         return _Timer(self._default_child())
 
+    def _merged_counts(self):
+        """Element-wise bucket merge across every child series (shared
+        bounds, so the merge is exact) — family-level reads on a labeled
+        histogram aggregate the fleet, same contract as Counter.value."""
+        counts = [0] * (len(self.buckets) + 1)
+        total = 0
+        with self._lock:
+            children = list(self._children.values())
+            for c in children:
+                for i, n in enumerate(c._counts):
+                    counts[i] += n
+                total += c._count
+        return counts, total
+
     def quantile(self, q: float) -> Optional[float]:
+        if self.label_names:
+            counts, total = self._merged_counts()
+            return _quantile_from_counts(counts, total, self.buckets, q)
         return self._default_child().quantile(q)
 
     @property
     def count(self) -> int:
+        if self.label_names:
+            return self._merged_counts()[1]
         return self._default_child().count
 
     @property
     def sum(self) -> float:
+        if self.label_names:
+            with self._lock:
+                return sum(c._sum for c in self._children.values())
         return self._default_child().sum
 
 

@@ -5,9 +5,13 @@ The scrape-able half of the registry — a ``ThreadingHTTPServer`` serving
 - ``/metrics``       Prometheus text exposition (0.0.4)
 - ``/metrics.json``  ``registry.snapshot()`` as JSON
 - ``/healthz``       liveness probe: 200 ``ok`` — or, with a
-  ``health_cb`` wired (e.g. ``ServingEngine.health``), 503 while the
-  callback reports degraded (the watchdog's state machine,
-  docs/RESILIENCE.md), so a load balancer drains a wedged engine
+  ``health_cb`` wired (e.g. ``ServingEngine.health`` or
+  ``Router.health``), 503 while the callback reports degraded (the
+  watchdog's state machine, docs/RESILIENCE.md), so a load balancer
+  drains a wedged engine. ``/healthz?engine=<id>`` forwards the engine
+  id to a callback that accepts an ``engine=`` keyword (``Router.health``
+  does: per-engine probing behind one fleet endpoint); callbacks without
+  the keyword ignore the query.
 
 No framework dependency: the serving stack must stay importable and
 operable on a bare jax+numpy container, so this is ``http.server``, not
@@ -17,10 +21,12 @@ it), which is what the tests use.
 """
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from .registry import MetricsRegistry, get_registry
 
@@ -46,15 +52,44 @@ class MetricsServer:
         # JSON body — ServingEngine.health fits directly). None keeps
         # the bare liveness behavior (always 200 ok).
         self.health_cb = health_cb
+        self._cb_engine_probe = None  # (callback, takes_engine) cache
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    def _health(self):
-        """(http_status, content_type, body) for /healthz."""
+    def _cb_takes_engine(self) -> bool:
+        """True when health_cb can accept an ``engine=`` keyword (an
+        explicit parameter or **kwargs) — probed once per CALLBACK, so
+        reassigning the public ``health_cb`` attribute (engine.health ->
+        router.health on a fleet upgrade) re-probes instead of serving a
+        stale capability decision."""
+        cached = self._cb_engine_probe
+        if cached is not None and cached[0] is self.health_cb:
+            return cached[1]
+        ok = False
+        try:
+            for p in inspect.signature(self.health_cb).parameters.values():
+                if (p.name == "engine"
+                        or p.kind is inspect.Parameter.VAR_KEYWORD):
+                    ok = True
+                    break
+        except (TypeError, ValueError):  # builtins/partials: be safe
+            ok = False
+        self._cb_engine_probe = (self.health_cb, ok)
+        return ok
+
+    def _health(self, query: str = ""):
+        """(http_status, content_type, body) for /healthz. ``query`` is the
+        raw query string; an ``engine=<id>`` param is forwarded to a
+        callback that declares the keyword (Router.health) and ignored
+        otherwise (ServingEngine.health)."""
         if self.health_cb is None:
             return 200, "text/plain", b"ok\n"
+        engine = parse_qs(query).get("engine", [None])[0] if query else None
         try:
-            h = self.health_cb()
+            if engine is not None and self._cb_takes_engine():
+                h = self.health_cb(engine=engine)
+            else:
+                h = self.health_cb()
         except Exception as e:  # a broken probe reads as unhealthy
             return 503, "text/plain", f"health_cb error: {e!r}\n".encode()
         if isinstance(h, dict):
@@ -73,7 +108,7 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 code = 200
                 if path == "/metrics":
                     body = registry.expose_prometheus().encode()
@@ -82,7 +117,7 @@ class MetricsServer:
                     body = json.dumps(registry.snapshot()).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
-                    code, ctype, body = server._health()
+                    code, ctype, body = server._health(query)
                 else:
                     self.send_error(404)
                     return

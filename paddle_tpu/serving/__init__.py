@@ -5,7 +5,10 @@ needs and ``GenerationMixin.generate`` (one static batch, dense caches)
 cannot provide: paged KV memory (kv_cache.py), FCFS token-budget
 admission (scheduler.py), a single compiled ragged-paged-attention decode
 step over fixed batch slots (engine.py + ops/pallas/paged_attention.py),
-and an OpenAI-ish front door with streaming (api.py). Always-on
+an OpenAI-ish front door with streaming (api.py), and a fleet-scale
+control plane (router.py): least-loaded dispatch, health-gated
+auto-drain/failover with exactly-once requeue, rolling weight reload
+from committed checkpoints, and multi-model tenancy. Always-on
 telemetry — TTFT / inter-token-latency / queue-wait histograms,
 lifecycle counters, page-pool gauges — lands in ``paddle_tpu.metrics``
 (docs/OBSERVABILITY.md). The resilience layer (docs/RESILIENCE.md) rides
@@ -28,11 +31,13 @@ is runnable):
 from .api import CompletionAPI, EnginePool
 from .engine import ServingEngine
 from .kv_cache import PagedKVCachePool, page_bytes, pages_for_hbm_budget
+from .router import EngineHandle, NoHealthyEngineError, Router
 from .scheduler import (BackpressureError, FCFSScheduler, Request,
                         RequestOutput)
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "FCFSScheduler", "Request",
     "RequestOutput", "CompletionAPI", "EnginePool", "BackpressureError",
+    "Router", "EngineHandle", "NoHealthyEngineError",
     "page_bytes", "pages_for_hbm_budget",
 ]

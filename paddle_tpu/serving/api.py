@@ -1,18 +1,22 @@
-"""OpenAI-ish completion front door over the serving engine.
+"""OpenAI-ish completion front door over the serving engine / router.
 
 The request/response half of the serving stack: an in-process API whose
 payload shapes mirror the OpenAI completions surface (``id`` /
 ``object: "text_completion"`` / ``choices[].finish_reason`` / ``usage``)
 so an HTTP shim is a ~20-line adapter, plus per-request streaming
-callbacks (the SSE chunk analogue). Pooling follows the
-``inference.PredictorPool`` idiom (inference/__init__.py — ``retrieve(i)``
-hands a caller-thread its own slot): one model's weights are shared (jax
-arrays are immutable) while each pool slot owns an independent engine —
-queue, pages, and compiled-step state are per-slot, handles must not be
-shared across threads.
+callbacks (the SSE chunk analogue).
 
-Token ids in, token ids out: tokenization is the caller's concern (pass
-``detokenize=`` to get ``text`` filled in the response).
+``CompletionAPI`` fronts either ONE :class:`~.engine.ServingEngine`
+(single-replica, as in PRs 1–3) or a :class:`~.router.Router` fleet: with
+a router, ``create_completion(model=...)`` routes through least-loaded
+dispatch and health gating, and the whole fleet is driven so a request
+requeued off a draining engine still delivers here. Token ids in, token
+ids out: tokenization is the caller's concern (pass ``detokenize=`` to
+get ``text`` filled in the response).
+
+``EnginePool`` — the PR 1 round-robin pool — survives as a thin
+DEPRECATED shim over ``Router`` (one model id, ``retrieve``/``next``
+kept) so existing callers keep working; new code should hold a Router.
 """
 from __future__ import annotations
 
@@ -25,7 +29,7 @@ import numpy as np
 
 from .. import faults, metrics
 from .engine import ServingEngine
-from .scheduler import BackpressureError
+from .router import NoHealthyEngineError, Router
 
 __all__ = ["CompletionAPI", "EnginePool"]
 
@@ -33,11 +37,17 @@ _cmpl_counter = itertools.count()
 
 
 class CompletionAPI:
-    """OpenAI-completions-shaped facade over one :class:`ServingEngine`."""
+    """OpenAI-completions-shaped facade over one :class:`ServingEngine`
+    or a :class:`Router` fleet (pass either as ``backend``)."""
 
-    def __init__(self, engine: ServingEngine, model_name: str = "paddle-tpu",
+    def __init__(self, backend, model_name: str = "paddle-tpu",
                  detokenize: Optional[Callable[[Sequence[int]], str]] = None):
-        self.engine = engine
+        if isinstance(backend, Router):
+            self.router: Optional[Router] = backend
+            self.engine: Optional[ServingEngine] = None
+        else:
+            self.router = None
+            self.engine = backend
         self.model_name = model_name
         self.detokenize = detokenize
         reg = metrics.get_registry()
@@ -49,12 +59,28 @@ class CompletionAPI:
             "Whole create_completion latency: queue + prefill + decode "
             "to the last choice finishing")
 
+    def _route(self, model: Optional[str]):
+        """(engine, handle, response_model_name) for this completion."""
+        if self.router is not None:
+            handle = self.router.select(model)  # ValueError on unknown id
+            # echo the tenant the caller named; the display name covers
+            # the single-model default (same as the engine-backed path)
+            return handle.engine, handle, (model if model is not None
+                                           else self.model_name)
+        if model is not None and model != self.model_name:
+            raise ValueError(
+                f"unknown model id {model!r} (this CompletionAPI serves "
+                f"only {self.model_name!r}); front a Router to serve "
+                f"several models")
+        return self.engine, None, self.model_name
+
     def create_completion(self, prompt, max_tokens: int = 16,
                           temperature: float = 0.0,
                           stop_token_id: Optional[int] = None,
                           seed: int = 0, echo: bool = False,
                           stream_cb: Optional[Callable] = None,
-                          deadline_s: Optional[float] = None) -> dict:
+                          deadline_s: Optional[float] = None,
+                          model: Optional[str] = None) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
@@ -63,14 +89,23 @@ class CompletionAPI:
         own stream (``seed + index``), so n-best sampling of one prompt
         diverges instead of returning n identical choices. ``deadline_s``
         bounds each choice from enqueue; an expired one comes back with
-        ``finish_reason="timeout"`` and whatever tokens it produced."""
+        ``finish_reason="timeout"`` and whatever tokens it produced.
+        ``model=`` selects the tenant on a Router backend (batch-mates
+        stay on one engine so they continuous-batch together); unknown
+        ids raise an actionable ValueError, a fully gated-out model
+        raises :class:`NoHealthyEngineError`."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
+        try:
+            engine, handle, resp_model = self._route(model)
+        except (ValueError, NoHealthyEngineError):
+            self._m_completions.labels(status="rejected").inc()
+            raise
         # validate the WHOLE batch before queueing anything: a rejected
         # later prompt must not strand already-queued batch-mates
         try:
             for p in prompts:
-                self.engine.check_request(p.size, max_tokens)
+                engine.check_request(p.size, max_tokens)
         except ValueError:
             self._m_completions.labels(status="rejected").inc()
             raise
@@ -80,11 +115,13 @@ class CompletionAPI:
             for idx, p in enumerate(prompts):
                 cb = None
                 if stream_cb is not None:
-                    cb = self._chunk_cb(stream_cb, cid, idx)
-                req_ids.append(self.engine.add_request(
+                    cb = self._chunk_cb(stream_cb, cid, idx, resp_model)
+                req_ids.append(engine.add_request(
                     p, max_new_tokens=max_tokens, temperature=temperature,
                     eos_token_id=stop_token_id, seed=seed + idx,
                     stream_cb=cb, deadline_s=deadline_s))
+                if handle is not None:
+                    self.router._count_dispatch(handle)
         except Exception:
             # enqueue failed mid-batch (bounded queue filled, or a
             # Request invariant check_request can't see, e.g. an empty
@@ -93,10 +130,22 @@ class CompletionAPI:
             # no cancelled counters, no terminal stream chunks, no
             # orphans running under the next create_completion
             for rid in req_ids:
-                self.engine.scheduler.remove(rid)
+                engine.scheduler.remove(rid)
             self._m_completions.labels(status="rejected").inc()
             raise
-        outputs = self.engine.run()
+        if self.router is not None:
+            # drive the FLEET: a health-gated drain may move our queued
+            # requests to a sibling mid-flight, and their outputs then
+            # come from that engine; outputs we don't own go back
+            all_outputs = self.router.run()
+            ours = set(req_ids)
+            outputs = {k: v for k, v in all_outputs.items() if k in ours}
+            unclaimed = {k: v for k, v in all_outputs.items()
+                         if k not in ours}
+            if unclaimed:
+                self.router.stash_unclaimed(unclaimed)
+        else:
+            outputs = engine.run()
         choices = []
         usage_p = usage_c = 0
         for idx, rid in enumerate(req_ids):
@@ -111,8 +160,8 @@ class CompletionAPI:
                          if self.detokenize is not None else None),
                 # pass the engine's reason straight through — the
                 # resilience reasons ("timeout"/"cancelled"/"nan"/
-                # "error", docs/SERVING.md table) must not be masked
-                # as a normal "length" stop
+                # "error"/"unavailable", docs/SERVING.md table) must not
+                # be masked as a normal "length" stop
                 "finish_reason": out.finish_reason,
             })
             usage_p += int(out.prompt_token_ids.size)
@@ -123,23 +172,24 @@ class CompletionAPI:
             "id": cid,
             "object": "text_completion",
             "created": int(time.time()),
-            "model": self.model_name,
+            "model": resp_model,
             "choices": choices,
             "usage": {"prompt_tokens": usage_p,
                       "completion_tokens": usage_c,
                       "total_tokens": usage_p + usage_c},
         }
 
-    def _chunk_cb(self, stream_cb, cid, idx):
+    def _chunk_cb(self, stream_cb, cid, idx, model_name):
         def cb(req_id, token, finished):
             # the engine's terminal callback passes the finish reason
             # (docs/SERVING.md table) as `finished`, so streamed chunks
-            # agree with the final response's choices[].finish_reason
+            # agree with the final response's choices[].finish_reason —
+            # and carry the same routed model name as the final response
             try:
                 stream_cb({
                     "id": cid,
                     "object": "text_completion.chunk",
-                    "model": self.model_name,
+                    "model": model_name,
                     "choices": [{
                         "index": idx,
                         "token_id": None if token is None else int(token),
@@ -174,34 +224,48 @@ class CompletionAPI:
         raise ValueError(f"prompt rank {arr.ndim} unsupported")
 
 
-class EnginePool:
-    """Pool of engines over ONE model for multi-threaded serving —
-    the ``inference.PredictorPool`` idiom: ``retrieve(i)`` hands thread i
-    its own engine (private queue/pages/compiled-step cache); the model
-    weights are shared process-wide."""
+class EnginePool(Router):
+    """DEPRECATED thin shim over :class:`Router` — the PR 1 pool surface
+    (``retrieve(i)`` / thread-safe ``next()`` round-robin / ``len``) on
+    top of a single-model router, kept so existing callers and examples
+    keep working. New code should construct a ``Router`` and use
+    ``select``/``submit`` (least-loaded, health-gated) instead of blind
+    rotation; the full control plane (drain/reload/health) is inherited
+    and fully functional here."""
+
+    _MODEL_ID = "default"
 
     def __init__(self, model, size: int = 1, **engine_kwargs):
-        self._engines = [ServingEngine(model, **engine_kwargs)
-                         for _ in range(int(size))]
-        self._rr = itertools.count()
+        super().__init__()
+        self.add_model(self._MODEL_ID, model, replicas=int(size),
+                       **engine_kwargs)
+        # modular index, not itertools.count: the old unbounded counter
+        # grew without limit on a long-lived server (harmless for int
+        # math in CPython, but a slow drift toward bignum arithmetic on
+        # the hot path — and a pointless one)
+        self._rr_idx = 0
         self._rr_lock = threading.Lock()
 
+    @property
+    def _engines(self) -> List[ServingEngine]:
+        return self.engines(self._MODEL_ID)
+
     def retrieve(self, idx: int) -> ServingEngine:
-        if not 0 <= int(idx) < len(self._engines):
+        engines = self._engines
+        if not 0 <= int(idx) < len(engines):
             raise IndexError(
                 f"engine index {idx} out of range for EnginePool of size "
-                f"{len(self._engines)} (valid: 0..{len(self._engines) - 1})")
-        return self._engines[int(idx)]
+                f"{len(engines)} (valid: 0..{len(engines) - 1})")
+        return engines[int(idx)]
 
     def next(self) -> ServingEngine:
         """Round-robin handout: the ROTATION is thread-safe, the engines
         are not — size the pool to at least the worker count so no two
         concurrent callers drive one engine (same contract as
-        ``retrieve``: one engine per thread at a time). Used by
-        examples/serve_llama.py."""
+        ``retrieve``: one engine per thread at a time). Blind rotation —
+        ``select()`` is the load- and health-aware replacement."""
+        engines = self._engines
         with self._rr_lock:
-            i = next(self._rr) % len(self._engines)
-        return self._engines[i]
-
-    def __len__(self) -> int:
-        return len(self._engines)
+            i = self._rr_idx
+            self._rr_idx = (self._rr_idx + 1) % len(engines)
+        return engines[i]

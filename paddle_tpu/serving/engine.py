@@ -108,9 +108,20 @@ class ServingEngine:
                  kv_dtype=jnp.float32, seed: int = 0,
                  max_queue: Optional[int] = None,
                  watchdog_stall_s: Optional[float] = 30.0,
-                 watchdog_recovery_steps: int = 3):
+                 watchdog_recovery_steps: int = 3,
+                 engine_id: Optional[str] = None,
+                 model_id: str = "default"):
         self.model = model
         model.eval()
+        # identity labels: every per-engine serving series carries
+        # {engine_id, model_id} so a Router fronting N engines yields N
+        # distinguishable series (docs/OBSERVABILITY.md). The default id is
+        # a process-wide counter; a Router assigns stable "model/replica"
+        # ids instead.
+        self.engine_id = (str(engine_id) if engine_id is not None
+                          else str(next(_engine_counter)))
+        self.model_id = str(model_id)
+        self._lbl = {"engine_id": self.engine_id, "model_id": self.model_id}
         self.trunk = model._decode_trunk()
         n_layers, n_kv, head_dim = model._cache_spec()
         self.n_layers = n_layers
@@ -122,7 +133,9 @@ class ServingEngine:
         if num_pages is None:
             num_pages = self.max_batch_slots * self.pages_per_seq + 1
         self.pool = PagedKVCachePool(n_layers, num_pages, self.page_size,
-                                     n_kv, head_dim, dtype=kv_dtype)
+                                     n_kv, head_dim, dtype=kv_dtype,
+                                     engine_id=self.engine_id,
+                                     model_id=self.model_id)
         self.scheduler = FCFSScheduler(self.max_batch_slots,
                                        prefill_token_budget,
                                        max_queue=max_queue,
@@ -154,73 +167,87 @@ class ServingEngine:
             "page_utilization": 0.0, "peak_pages": 0,
         }
         # typed instruments (docs/OBSERVABILITY.md catalog) — the stats
-        # dict above stays a thin per-step view over these
+        # dict above stays a thin per-step view over these. Every series
+        # carries {engine_id, model_id}: family-level reads on the
+        # registry aggregate across engines, per-engine dashboards filter
+        # on the labels.
         reg = metrics.get_registry()
+        _eng = ("engine_id", "model_id")
         self._m_ttft = reg.histogram(
             "paddle_tpu_serving_ttft_seconds",
-            "Time to first token: request enqueue -> first sampled token")
+            "Time to first token: request enqueue -> first sampled token",
+            labels=_eng).labels(**self._lbl)
         self._m_itl = reg.histogram(
             "paddle_tpu_serving_inter_token_seconds",
             "Inter-token latency: gap between consecutive tokens of one "
-            "sequence during decode")
+            "sequence during decode", labels=_eng).labels(**self._lbl)
         self._m_step = reg.histogram(
             "paddle_tpu_serving_step_seconds",
-            "Full engine step: admit + prefill + batched decode + retire")
+            "Full engine step: admit + prefill + batched decode + retire",
+            labels=_eng).labels(**self._lbl)
         self._m_prefill = reg.histogram(
             "paddle_tpu_serving_prefill_seconds",
             "One request's prefill: bucketed forward + KV scatter + "
-            "first-token sample")
+            "first-token sample", labels=_eng).labels(**self._lbl)
         self._m_decode = reg.histogram(
             "paddle_tpu_serving_decode_step_seconds",
-            "One batched decode step over all live slots")
+            "One batched decode step over all live slots",
+            labels=_eng).labels(**self._lbl)
         self._m_requests = reg.counter(
             "paddle_tpu_serving_requests_total",
-            "Requests by lifecycle event", labels=("event",))
+            "Requests by lifecycle event",
+            labels=("event",) + _eng)
         self._m_tokens = reg.counter(
             "paddle_tpu_serving_generated_tokens_total",
-            "Tokens sampled by the engine (prefill first tokens included)")
+            "Tokens sampled by the engine (prefill first tokens included)",
+            labels=_eng).labels(**self._lbl)
         for ev in ("admitted", "rejected", "retired", "preempted"):
-            self._m_requests.labels(event=ev)  # pre-create: scrapes show 0
+            self._m_requests.labels(event=ev, **self._lbl)  # scrapes show 0
         # resilience instruments (docs/RESILIENCE.md): every failure path
         # increments exactly one of these per event, so chaos tests pin
         # telemetry alongside behavior
         self._m_timeouts = reg.counter(
             "paddle_tpu_serving_request_timeouts_total",
             "Requests retired on deadline expiry "
-            "(finish_reason=\"timeout\"), queued or mid-decode")
+            "(finish_reason=\"timeout\"), queued or mid-decode",
+            labels=_eng).labels(**self._lbl)
         self._m_cancels = reg.counter(
             "paddle_tpu_serving_cancellations_total",
-            "Requests retired by cancel() (finish_reason=\"cancelled\")")
+            "Requests retired by cancel() (finish_reason=\"cancelled\")",
+            labels=_eng).labels(**self._lbl)
         self._m_nan_quarantines = reg.counter(
             "paddle_tpu_serving_nan_quarantines_total",
             "Sequences quarantined for non-finite decode logits "
-            "(finish_reason=\"nan\"); batch-mates are unaffected")
+            "(finish_reason=\"nan\"); batch-mates are unaffected",
+            labels=_eng).labels(**self._lbl)
         self._m_req_errors = reg.counter(
             "paddle_tpu_serving_request_errors_total",
             "Requests retired on an internal failure "
-            "(finish_reason=\"error\": prefill/alloc/callback faults)")
+            "(finish_reason=\"error\": prefill/alloc/callback faults)",
+            labels=_eng).labels(**self._lbl)
+        self._m_unavailable = reg.counter(
+            "paddle_tpu_serving_unavailable_total",
+            "Queued requests retired because no healthy engine could adopt "
+            "them (finish_reason=\"unavailable\": the router's "
+            "requeue-impossible path)", labels=_eng).labels(**self._lbl)
         self._m_cb_errors = reg.counter(
             "paddle_tpu_serving_callback_errors_total",
             "Exceptions raised by user stream callbacks (isolated: the "
-            "engine step survives; the request retires \"error\")")
+            "engine step survives; the request retires \"error\")",
+            labels=_eng).labels(**self._lbl)
         self._m_wd_trips = reg.counter(
             "paddle_tpu_serving_watchdog_trips_total",
             "Watchdog trip episodes (healthy->tripped transitions, not "
-            "slow-step count)")
-        # labeled per engine: in an EnginePool a healthy sibling's step
-        # must not overwrite a tripped engine's 1.0 (the other serving
-        # gauges stay process-wide last-writer-wins, documented in
-        # docs/OBSERVABILITY.md — degraded is the one alerts key on)
-        self.engine_id = str(next(_engine_counter))
+            "slow-step count)", labels=_eng).labels(**self._lbl)
         self._m_degraded = reg.gauge(
             "paddle_tpu_serving_degraded",
             "1 while the step watchdog holds this engine degraded "
             "(/healthz returns 503), else 0; refreshed at step end and "
-            "on every health() probe", labels=("engine",)).labels(
-            engine=self.engine_id)
+            "on every health() probe", labels=_eng).labels(**self._lbl)
         self._reason_counters = {
             "timeout": self._m_timeouts, "cancelled": self._m_cancels,
             "nan": self._m_nan_quarantines, "error": self._m_req_errors,
+            "unavailable": self._m_unavailable,
         }
 
     # ------------------------------------------------------------ frontend
@@ -232,14 +259,14 @@ class ServingEngine:
         if p > self.max_model_len:
             # 4xx responses must be actionable: name the violated limit
             # AND its configured value in every rejection message
-            self._m_requests.labels(event="rejected").inc()
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise ValueError(
                 f"prompt_len {p} exceeds the prefill bucket cap (limit: "
                 f"max_model_len={self.max_model_len}); truncate the prompt "
                 f"or construct the engine with a larger max_model_len")
         total = p + m
         if total > self.max_model_len:
-            self._m_requests.labels(event="rejected").inc()
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise ValueError(
                 f"prompt_len {p} + max_new_tokens {m} = {total} exceeds "
                 f"the per-request token cap (limit: max_model_len="
@@ -250,7 +277,7 @@ class ServingEngine:
             # even an empty pool could never admit it — rejecting here
             # (not queueing) keeps run() from spinning forever on a head
             # request that can never pass can_admit
-            self._m_requests.labels(event="rejected").inc()
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise ValueError(
                 f"max_total_tokens {total} needs {need} KV pages "
                 f"worst-case but the pool has only {self.pool.usable_pages}"
@@ -277,7 +304,7 @@ class ServingEngine:
         try:
             self.scheduler.add(req)
         except Exception:
-            self._m_requests.labels(event="rejected").inc()
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise
         return req.req_id
 
@@ -345,8 +372,75 @@ class ServingEngine:
         long-lived server never accumulates them."""
         while self.has_work:
             self.step()
+        return self.take_outputs()
+
+    def take_outputs(self) -> Dict[object, RequestOutput]:
+        """Drain accumulated terminal outputs WITHOUT stepping (exactly-once
+        handout, same contract as :meth:`run`). The router's collection
+        path: it steps many engines itself and merges their outputs."""
         out, self._outputs = self._outputs, {}
         return out
+
+    # ------------------------------------------------- router control plane
+    def steal_queued(self) -> List[Request]:
+        """Pull EVERY waiting (never-admitted) request out of the queue and
+        return the live Request objects — the router's drain/failover path.
+        No lifecycle counters move: the requests were never admitted here
+        and are about to be adopted elsewhere (or retired explicitly via
+        :meth:`retire_queued`). In-flight slots are untouched; they finish
+        or fall to the cancel/deadline machinery."""
+        return self.scheduler.pop_all()
+
+    def adopt_request(self, req: Request) -> None:
+        """Enqueue a Request object stolen from ANOTHER engine: req_id,
+        arrival time, running deadline, seed, and stream_cb all ride along,
+        so queue-wait/TTFT keep measuring from the original enqueue and the
+        caller's streaming keeps working. Raises exactly like
+        :meth:`add_request` (ValueError from :meth:`check_request`,
+        BackpressureError from a full bounded queue) — the router treats a
+        raise as requeue-impossible."""
+        self.check_request(req.prompt.size, req.max_new_tokens)
+        try:
+            self.scheduler.add(req)
+        except Exception:
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
+            raise
+
+    def retire_queued(self, req: Request,
+                      reason: str = "unavailable") -> RequestOutput:
+        """Terminally retire a request that is NOT queued here anymore
+        (stolen via :meth:`steal_queued`) and could not be placed on any
+        healthy engine: emits the terminal stream callback and the
+        per-reason counter, and delivers the output through this engine's
+        normal :meth:`run`/:meth:`take_outputs` path — exactly once, like
+        every other retirement."""
+        return self._finish_queued(req, reason)
+
+    @property
+    def avg_step_s(self) -> float:
+        """Step wall-time EWMA — the same drain-rate estimate behind
+        ``BackpressureError.retry_after_s``, exposed for the router's
+        least-loaded scoring."""
+        return self._avg_step_s
+
+    def load_score(self) -> float:
+        """Estimated seconds to drain this engine's current commitment:
+        outstanding work in STEPS (one prefill step + one decode step per
+        remaining token, per request — a 2-token short and a 128-token
+        hog must not weigh the same) x the step-time EWMA. The queue half
+        rides the scheduler's incremental tally (O(1)); the slot scan is
+        bounded by ``max_batch_slots``. The router's least-loaded
+        dispatch admits onto the minimum-score healthy engine; exact ties
+        (idle fleets) round-robin."""
+        steps = self.scheduler.pending_steps
+        for st in self.slots:
+            if st is not None:
+                steps += 1 + max(int(st.req.max_new_tokens)
+                                 - len(st.gen), 0)
+        if self._active_prefill is not None:
+            ap = self._active_prefill
+            steps += 1 + max(int(ap.req.max_new_tokens) - len(ap.gen), 0)
+        return steps * self._avg_step_s
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-program tally — the recompilation bound the tests
@@ -374,7 +468,7 @@ class ServingEngine:
                 finished.extend(self._sweep_deadlines())
                 free = sum(1 for s in self.slots if s is None)
                 for req in self.scheduler.admit(free, self.pool):
-                    self._m_requests.labels(event="admitted").inc()
+                    self._m_requests.labels(event="admitted", **self._lbl).inc()
                     try:
                         out = self._prefill(req)
                     except Exception as e:
@@ -455,7 +549,7 @@ class ServingEngine:
         (exactly once per event), lifecycle counter, terminal stream
         callback (isolated), RequestOutput."""
         self._reason_counters[reason].inc()
-        self._m_requests.labels(event="retired").inc()
+        self._m_requests.labels(event="retired", **self._lbl).inc()
         self.stats["finished_requests"] += 1
         out = RequestOutput(req_id=req.req_id, prompt_token_ids=req.prompt,
                             token_ids=list(gen), finish_reason=reason,
@@ -761,7 +855,7 @@ class ServingEngine:
             self.pool.free(req.req_id)
         if slot is not None:
             self.slots[slot] = None
-        self._m_requests.labels(event="retired").inc()
+        self._m_requests.labels(event="retired", **self._lbl).inc()
         self.stats["finished_requests"] += 1
         out = RequestOutput(req_id=req.req_id,
                             prompt_token_ids=req.prompt,

@@ -73,9 +73,15 @@ class PagedKVCachePool:
     """
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
-                 n_kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 n_kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 engine_id: str = "", model_id: str = ""):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        # identity labels for the pool gauges: an engine passes its own
+        # {engine_id, model_id} so N pools behind a Router stay N series
+        # instead of last-writer-wins; a standalone pool reports under the
+        # empty-string labels
+        self._lbl = {"engine_id": str(engine_id), "model_id": str(model_id)}
         self.num_layers = int(num_layers)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -105,23 +111,26 @@ class PagedKVCachePool:
         self._resv: Dict[object, int] = {}
         self.peak_used = 0
         reg = metrics.get_registry()
+        _eng = ("engine_id", "model_id")
         self._m_pages_used = reg.gauge(
             "paddle_tpu_serving_kv_pages_used",
-            "KV pages currently allocated out of the pool")
+            "KV pages currently allocated out of the pool",
+            labels=_eng).labels(**self._lbl)
         self._m_pages_total = reg.gauge(
             "paddle_tpu_serving_kv_pages_total",
-            "Usable KV pages in the pool (page 0 reserved excluded)")
+            "Usable KV pages in the pool (page 0 reserved excluded)",
+            labels=_eng).labels(**self._lbl)
         self._m_page_events = reg.counter(
             "paddle_tpu_serving_kv_page_events_total",
-            "Page allocator traffic", labels=("event",))
+            "Page allocator traffic", labels=("event",) + _eng)
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
         """Re-set BOTH pool gauges on every allocator event: the total is
         re-published (not just set once at construction) so a registry
         ``reset()`` mid-life self-heals instead of reporting 0 capacity
-        forever. Process-wide caveat: with several pools (EnginePool)
-        these are last-writer-wins — see docs/OBSERVABILITY.md."""
+        forever. Each pool owns its {engine_id, model_id} series; the
+        family-level read aggregates the fleet (docs/OBSERVABILITY.md)."""
         self._m_pages_used.set(self.used_pages)
         self._m_pages_total.set(self.usable_pages)
 
@@ -185,7 +194,7 @@ class PagedKVCachePool:
             self._dirty.clear()
         self._ref[p] = 1
         self.peak_used = max(self.peak_used, self.used_pages)
-        self._m_page_events.labels(event="alloc").inc()
+        self._m_page_events.labels(event="alloc", **self._lbl).inc()
         self._refresh_gauges()
         return p
 
@@ -239,7 +248,7 @@ class PagedKVCachePool:
                 self._free.append(p)
                 if scrub:
                     self._dirty.add(p)
-                self._m_page_events.labels(event="free").inc()
+                self._m_page_events.labels(event="free", **self._lbl).inc()
         self._refresh_gauges()
 
     def fork(self, src_id, dst_id, max_total_tokens: Optional[int] = None

@@ -1,0 +1,565 @@
+"""Fleet-scale serving control plane: the layer that turns N engines into
+one serving product (ROADMAP item 4).
+
+``Router`` grows the old round-robin ``EnginePool`` into a real front
+door over a fleet of :class:`~.engine.ServingEngine` replicas:
+
+- **Least-loaded dispatch** — admission picks the healthy engine with the
+  minimum ``load_score()`` ((queued + running) x step-time EWMA, the same
+  EWMA behind ``BackpressureError.retry_after_s``); exact ties break
+  round-robin so idle fleets still rotate. Every placement lands in
+  ``paddle_tpu_router_dispatch_total{engine_id,model_id}``.
+
+- **Health gating + auto-drain** — each engine carries a state
+  (``healthy`` / ``degraded`` / ``draining`` / ``down``). The router
+  derives ``degraded`` from the engine's PR 3 watchdog (``health()``)
+  at every :meth:`step`; a non-healthy engine stops receiving admissions,
+  keeps stepping so its in-flight work finishes (or falls to the existing
+  ``cancel``/deadline machinery — :meth:`mark_down` cancels it
+  immediately), and its WAITING requests are requeued onto healthy
+  siblings **exactly once**: a request is moved at most one time, and if
+  no healthy engine can adopt it (none exists, bounded queue full, or it
+  was already moved once) it retires deterministically with
+  ``finish_reason="unavailable"`` — no duplicates, no silent drops.
+
+- **Rolling weight reload** — :meth:`reload` drains one engine at a time
+  (admissions gate out; its in-flight and queued work finishes locally
+  while siblings keep serving), restores the newest committed PR 4
+  checkpoint
+  into it (checksum-verified via ``CheckpointManager.restore``; weights
+  land IN-PLACE via ``set_state_dict`` so the compiled decode step picks
+  them up without recompiling — ``paddle_tpu_jit_compiles_total`` stays
+  at one decode compile per engine across a weight push), re-warms it
+  with a canary request, and returns it to rotation. A canary that comes
+  back ``nan``/``error`` marks the engine ``down`` instead of serving a
+  bad checkpoint.
+
+- **Multi-model tenancy** — the router owns a ``{model_id: [engines]}``
+  table; :meth:`select`/:meth:`submit` route by model id and unknown ids
+  raise an actionable ValueError naming the served models
+  (``CompletionAPI(router)`` forwards its ``model=`` field here).
+
+Threading contract: dispatch/step/run/reload are single-threaded like the
+engines they drive (one driver thread owns the control plane);
+:meth:`health` is safe to call from a scrape thread, which is how
+``MetricsServer(health_cb=router.health)`` serves ``/healthz`` (503 only
+when some served model has NO healthy engine) and
+``/healthz?engine=<id>`` (one engine's view).
+
+State machine (docs/SERVING.md "Control plane" has the diagram)::
+
+    healthy --watchdog trip--> degraded --recovery steps--> healthy
+    healthy --drain()/reload--> draining --reload ok/undrain--> healthy
+    any --mark_down()/failed canary--> down --undrain()--> healthy
+
+Degraded/draining/down engines never receive admissions; degraded and
+draining engines still step (they recover or finish); down engines are
+cancelled and skipped.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import metrics
+from .engine import ServingEngine
+from .scheduler import Request, RequestOutput
+
+__all__ = ["Router", "EngineHandle", "NoHealthyEngineError",
+           "HEALTHY", "DEGRADED", "DRAINING", "DOWN"]
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DOWN = "down"
+
+# numeric encoding for the per-engine state gauge (docs/OBSERVABILITY.md):
+# alerts key on  > 0  (any engine out of rotation)
+_STATE_CODE = {HEALTHY: 0.0, DEGRADED: 1.0, DRAINING: 2.0, DOWN: 3.0}
+
+
+class NoHealthyEngineError(RuntimeError):
+    """Every engine serving the requested model is out of rotation
+    (degraded/draining/down) — the 503 analogue of BackpressureError's
+    429. The fleet is known-unable to admit right now; retry after the
+    watchdog recovers or the drain/reload finishes."""
+
+
+class EngineHandle:
+    """One engine's seat in the router: identity, gate state, and the
+    weight version it serves."""
+
+    __slots__ = ("engine", "engine_id", "model_id", "state", "weights_step")
+
+    def __init__(self, engine: ServingEngine, engine_id: str,
+                 model_id: str):
+        self.engine = engine
+        self.engine_id = engine_id
+        self.model_id = model_id
+        self.state = HEALTHY
+        self.weights_step: Optional[int] = None  # last reload's ckpt step
+
+
+class Router:
+    """Control plane over a fleet of engines (see module docstring).
+
+    ::
+
+        router = Router()
+        router.add_model("llama", model, replicas=2, page_size=16)
+        rid = router.submit(prompt_ids, model="llama", max_new_tokens=32)
+        outputs = router.run()               # least-loaded, health-gated
+        router.reload(ckpt_dir)              # rolling weight push
+
+    ``add_model`` accepts one model (weights shared by every replica —
+    jax arrays are immutable, so sharing is free) or a sequence of model
+    instances (one per replica — what :meth:`reload` needs for true
+    rolling version isolation: with a shared model every replica flips to
+    the new weights at the first restore)."""
+
+    def __init__(self):
+        self._models: Dict[str, List[EngineHandle]] = {}
+        self._handles: Dict[str, EngineHandle] = {}
+        self._rr: Dict[str, int] = {}          # per-model tie-break cursor
+        self._lock = threading.Lock()          # rr cursors + state flips
+        self._requeued: set = set()            # req_ids moved once already
+        self._stash: Dict[object, RequestOutput] = {}
+        reg = metrics.get_registry()
+        self._m_dispatch = reg.counter(
+            "paddle_tpu_router_dispatch_total",
+            "Requests placed on an engine by the router's least-loaded "
+            "dispatch", labels=("engine_id", "model_id"))
+        self._m_requeued = reg.counter(
+            "paddle_tpu_router_requeued_total",
+            "Waiting requests moved off a non-healthy engine onto a "
+            "healthy sibling (each request moves at most once)")
+        self._m_unplaceable = reg.counter(
+            "paddle_tpu_router_unplaceable_total",
+            "Waiting requests the router could not requeue (no healthy "
+            "engine / bounded queue full / already moved once) — retired "
+            "with finish_reason=\"unavailable\"")
+        self._m_reloads = reg.counter(
+            "paddle_tpu_router_reloads_total",
+            "Per-engine rolling weight reloads by result",
+            labels=("result",))
+        for r in ("ok", "error"):
+            self._m_reloads.labels(result=r)   # pre-create: scrapes show 0
+        self._m_state = reg.gauge(
+            "paddle_tpu_router_engine_state",
+            "Router gate state per engine: 0 healthy, 1 degraded, "
+            "2 draining, 3 down", labels=("engine_id", "model_id"))
+
+    # ------------------------------------------------------------- topology
+    def add_model(self, model_id: str, model, replicas: int = 1,
+                  **engine_kwargs) -> List[str]:
+        """Register ``replicas`` engines serving ``model`` under
+        ``model_id``; returns the assigned engine ids
+        (``"<model_id>/<n>"`` — stable, unlike the process-wide default).
+        ``model`` may be a sequence of model instances (one per replica,
+        ``replicas`` then defaults to its length) for per-replica weight
+        isolation under :meth:`reload`."""
+        model_id = str(model_id)
+        if model_id in self._models:
+            raise ValueError(
+                f"model id {model_id!r} already registered "
+                f"({len(self._models[model_id])} engines); model ids are "
+                f"immutable — pick a new id for a new fleet")
+        if isinstance(model, (list, tuple)):
+            models = list(model)
+            if not models:
+                raise ValueError("empty model sequence")
+            replicas = len(models)
+        else:
+            models = [model] * int(replicas)
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        handles = []
+        for i, m in enumerate(models):
+            eid = f"{model_id}/{i}"
+            eng = ServingEngine(m, engine_id=eid, model_id=model_id,
+                                **engine_kwargs)
+            handles.append(EngineHandle(eng, eid, model_id))
+        with self._lock:
+            self._models[model_id] = handles
+            for h in handles:
+                self._handles[h.engine_id] = h
+                self._set_state_gauge(h)
+            self._rr.setdefault(model_id, 0)
+        return [h.engine_id for h in handles]
+
+    @property
+    def models(self) -> List[str]:
+        return sorted(self._models)
+
+    def engines(self, model: Optional[str] = None) -> List[ServingEngine]:
+        """Engines of one model (router order) or the whole fleet."""
+        if model is not None:
+            return [h.engine for h in self._model_handles(model)]
+        return [h.engine for h in self._handles.values()]
+
+    def engine(self, engine_id: str) -> ServingEngine:
+        return self._require(engine_id).engine
+
+    def states(self) -> Dict[str, str]:
+        """{engine_id: state} snapshot of the whole fleet (safe from any
+        thread: iterates a copy taken under the topology lock)."""
+        with self._lock:
+            handles = list(self._handles.values())
+        return {h.engine_id: h.state for h in handles}
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+    def _model_handles(self, model) -> List[EngineHandle]:
+        mid = self._resolve_model(model)
+        return self._models[mid]
+
+    def _resolve_model(self, model) -> str:
+        if model is None:
+            if len(self._models) == 1:
+                return next(iter(self._models))
+            raise ValueError(
+                f"model= is required when the router serves "
+                f"{len(self._models)} models (serving: {self.models}); "
+                f"pass one of them")
+        mid = str(model)
+        if mid not in self._models:
+            # 4xx-style actionable rejection, same contract as
+            # engine.check_request: name what was asked AND what exists
+            raise ValueError(
+                f"unknown model id {mid!r} (serving: {self.models}); "
+                f"register it with router.add_model({mid!r}, model) or "
+                f"request a served model")
+        return mid
+
+    def _set_state_gauge(self, h: EngineHandle) -> None:
+        self._m_state.labels(engine_id=h.engine_id,
+                             model_id=h.model_id).set(_STATE_CODE[h.state])
+
+    # ------------------------------------------------------------- dispatch
+    def select(self, model: Optional[str] = None) -> EngineHandle:
+        """Least-loaded healthy engine for ``model`` (the single served
+        model when omitted): minimum ``engine.load_score()``; exact ties
+        rotate round-robin. Raises ValueError for an unknown model and
+        :class:`NoHealthyEngineError` when every engine of the model is
+        gated out."""
+        mid = self._resolve_model(model)
+        cands = [h for h in self._models[mid] if h.state == HEALTHY]
+        if not cands:
+            states = {h.engine_id: h.state for h in self._models[mid]}
+            raise NoHealthyEngineError(
+                f"no healthy engine for model {mid!r} (states: {states}); "
+                f"retry after recovery, or undrain()/reload a replica")
+        scores = [h.engine.load_score() for h in cands]
+        best = min(scores)
+        tied = [h for h, s in zip(cands, scores) if s == best]
+        with self._lock:
+            pick = tied[self._rr[mid] % len(tied)]
+            # modular, not unbounded (the same fix EnginePool.next got):
+            # the cursor only breaks ties, so any stable modulus works
+            self._rr[mid] = (self._rr[mid] + 1) % len(self._models[mid])
+        return pick
+
+    def submit(self, prompt, model: Optional[str] = None,
+               **request_kwargs):
+        """Route one request: least-loaded placement + dispatch counter.
+        Returns the engine's ``req_id``; raises like
+        ``ServingEngine.add_request`` (plus the routing errors of
+        :meth:`select`). Drive the fleet with :meth:`run`."""
+        h = self.select(model)
+        rid = h.engine.add_request(prompt, **request_kwargs)
+        self._m_dispatch.labels(engine_id=h.engine_id,
+                                model_id=h.model_id).inc()
+        return rid
+
+    def _count_dispatch(self, h: EngineHandle) -> None:
+        """Dispatch-accounting hook for front doors (CompletionAPI) that
+        enqueue on a selected handle themselves."""
+        self._m_dispatch.labels(engine_id=h.engine_id,
+                                model_id=h.model_id).inc()
+
+    # ----------------------------------------------------------- health gate
+    def _refresh_health(self) -> None:
+        """Derive degraded/healthy from each engine's watchdog and
+        auto-drain the queue of anything that just left rotation. Manual
+        states (draining/down) are sticky — only undrain()/reload flip
+        them back."""
+        for h in self._handles.values():
+            if h.state in (DRAINING, DOWN):
+                continue
+            ok = h.engine.health()["status"] == "ok"
+            if h.state == HEALTHY and not ok:
+                with self._lock:
+                    h.state = DEGRADED
+                self._set_state_gauge(h)
+                self._requeue_waiting(h)
+            elif h.state == DEGRADED and ok:
+                with self._lock:
+                    h.state = HEALTHY
+                self._set_state_gauge(h)
+
+    def _requeue_waiting(self, h: EngineHandle) -> None:
+        """Move ``h``'s WAITING requests onto healthy siblings, each
+        exactly once; whatever cannot move retires
+        ``finish_reason="unavailable"`` on ``h`` (delivered through the
+        normal output path). In-flight slots stay: they finish on ``h`` or
+        fall to cancel/deadline/NaN handling."""
+        for req in h.engine.steal_queued():
+            target: Optional[EngineHandle] = None
+            if req.req_id not in self._requeued:
+                try:
+                    target = self.select(h.model_id)
+                except (ValueError, NoHealthyEngineError):
+                    target = None
+            if target is None:
+                self._m_unplaceable.inc()
+                h.engine.retire_queued(req, "unavailable")
+                continue
+            self._requeued.add(req.req_id)
+            try:
+                target.engine.adopt_request(req)
+            except Exception:
+                # the one chosen target refused (bounded queue, shape cap
+                # mismatch between heterogeneous replicas): requeue is
+                # impossible NOW — retire deterministically rather than
+                # shopping the request around the fleet
+                self._m_unplaceable.inc()
+                h.engine.retire_queued(req, "unavailable")
+                continue
+            self._m_requeued.inc()
+
+    # ---------------------------------------------------------------- drive
+    @property
+    def has_work(self) -> bool:
+        return any(h.state != DOWN and h.engine.has_work
+                   for h in self._handles.values())
+
+    def step(self) -> None:
+        """One fleet sweep: refresh health gates (auto-draining anything
+        that tripped), then step every non-down engine that has work."""
+        self._refresh_health()
+        for h in list(self._handles.values()):
+            if h.state == DOWN:
+                continue
+            if h.engine.has_work:
+                h.engine.step()
+
+    def run(self) -> Dict[object, RequestOutput]:
+        """Drive :meth:`step` until the whole fleet drains; returns every
+        output finished since the last :meth:`run`, merged across engines
+        (a requeued request's output comes from its adoptive engine) —
+        exactly-once handout, same contract as ``ServingEngine.run``."""
+        while self.has_work:
+            self.step()
+        out = self._stash
+        self._stash = {}
+        for h in self._handles.values():
+            out.update(h.engine.take_outputs())
+        self._requeued -= set(out)  # delivered: drop the move-once marks
+        return out
+
+    def stash_unclaimed(self, outputs: Dict[object, RequestOutput]) -> None:
+        """Hand back outputs a caller collected but does not own (a front
+        door draining the fleet for its own req_ids); they merge into the
+        next :meth:`run`'s return."""
+        self._stash.update(outputs)
+
+    # ------------------------------------------------------- manual gating
+    def drain(self, engine_id: str) -> None:
+        """Gate an engine out of admission (state ``draining``): waiting
+        requests move to healthy siblings (exactly once), in-flight work
+        keeps stepping to completion. ``undrain`` returns it."""
+        h = self._require(engine_id)
+        with self._lock:
+            h.state = DRAINING
+        self._set_state_gauge(h)
+        self._requeue_waiting(h)
+
+    def mark_down(self, engine_id: str) -> None:
+        """Take an engine out NOW (state ``down``): waiting requests are
+        requeued (exactly once), in-flight requests are cancelled through
+        the existing ``engine.cancel`` machinery
+        (``finish_reason="cancelled"``), and the engine is no longer
+        stepped until :meth:`undrain`."""
+        h = self._require(engine_id)
+        with self._lock:
+            h.state = DOWN
+        self._set_state_gauge(h)
+        self._requeue_waiting(h)
+        eng = h.engine
+        live = [st.req.req_id for st in eng.slots if st is not None]
+        if eng._active_prefill is not None:
+            live.append(eng._active_prefill.req.req_id)
+        for rid in live:
+            eng.cancel(rid)
+
+    def undrain(self, engine_id: str) -> None:
+        """Return a drained/down engine to rotation (state ``healthy``;
+        the next health refresh re-derives ``degraded`` if its watchdog
+        is still tripped)."""
+        h = self._require(engine_id)
+        with self._lock:
+            h.state = HEALTHY
+        self._set_state_gauge(h)
+
+    def _require(self, engine_id: str) -> EngineHandle:
+        h = self._handles.get(str(engine_id))
+        if h is None:
+            raise KeyError(
+                f"unknown engine id {engine_id!r} (known: "
+                f"{sorted(self._handles)})")
+        return h
+
+    # -------------------------------------------------------------- reload
+    def reload(self, checkpoint_dir: str, model: Optional[str] = None,
+               step: Optional[int] = None,
+               warm_prompt: Sequence[int] = (1,)) -> Dict[str, object]:
+        """Rolling weight push for ONE model's engines (``model`` may be
+        omitted only when the router serves a single model — a checkpoint
+        belongs to one architecture, and pushing it fleet-wide by default
+        would drain and corrupt unrelated tenants): engine by engine —
+        gate it ``draining`` (no new admissions), finish its in-flight
+        and queued work while the rest of the fleet keeps serving,
+        restore the newest committed checkpoint (checksum-verified;
+        ``step=`` pins one), and re-warm with a canary request before
+        returning it to rotation.
+
+        The restore is IN-PLACE (``set_state_dict``), so the compiled
+        decode step sees the new weights as data: no recompile, and
+        ``paddle_tpu_jit_compiles_total{fn="serving_decode"}`` stays at
+        one compile per engine across the push. A canary that retires
+        ``nan``/``error`` marks that engine ``down`` (bad checkpoint never
+        re-enters rotation) and the push continues; the summary reports
+        per-engine results. Accepts a ``capture_train_state``-shaped state
+        (uses its ``"model"`` subtree) or a bare ``state_dict``."""
+        from ..checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(checkpoint_dir, max_to_keep=None)
+        state, ck_step = mgr.restore(step=step)
+        sd = state["model"] if isinstance(state, dict) and "model" in state \
+            else state
+        # host-side copy of every leaf: set_state_dict would otherwise
+        # alias ONE device array into every replica's params, and the
+        # compiled step DONATES its state buffers — the first engine's
+        # post-reload step would invalidate the weights under every
+        # sibling ("buffer has been deleted or donated"). From numpy,
+        # each set_state_dict materializes a private device buffer.
+        sd = {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+              for k, v in sd.items()}
+        # resolve like every other routing entry point: None means "the
+        # single served model" and is an actionable error otherwise
+        mid = self._resolve_model(model)
+        results: List[Dict[str, object]] = []
+        for h in self._models[mid]:
+            if h.state == DOWN:
+                results.append({"engine_id": h.engine_id,
+                                "result": "skipped-down"})
+                continue
+            results.append(self._reload_one(h, sd, ck_step, warm_prompt))
+        return {"step": ck_step, "engines": results}
+
+    def _reload_one(self, h: EngineHandle, sd, ck_step: int,
+                    warm_prompt: Sequence[int]) -> Dict[str, object]:
+        with self._lock:
+            h.state = DRAINING
+        self._set_state_gauge(h)
+        # drain: the WHOLE fleet keeps stepping (live traffic continues on
+        # siblings; draining gates h out of NEW admissions) until h
+        # finishes its in-flight AND already-queued work locally. Queued
+        # work deliberately does NOT requeue here: a rolling push visits
+        # every sibling next, so moving requests ahead of the wave would
+        # double-move them — and the exactly-once failover budget belongs
+        # to real failures, not planned maintenance.
+        while h.engine.has_work:
+            self.step()
+        try:
+            missing, _unexpected = h.engine.model.set_state_dict(sd)
+            if missing:
+                raise ValueError(
+                    f"checkpoint is missing {len(missing)} model keys "
+                    f"(first: {missing[:3]}); refusing a partial weight "
+                    f"load on engine {h.engine_id}")
+            canary_ok, reason = self._warm(h, warm_prompt)
+        except Exception:
+            # restore itself failed (shape mismatch, corrupt leaf): the
+            # engine's weights are suspect — gate it down, surface the
+            # error; siblings keep serving the old version
+            with self._lock:
+                h.state = DOWN
+            self._set_state_gauge(h)
+            self._m_reloads.labels(result="error").inc()
+            raise
+        if not canary_ok:
+            with self._lock:
+                h.state = DOWN
+            self._set_state_gauge(h)
+            self._m_reloads.labels(result="error").inc()
+            return {"engine_id": h.engine_id, "result": "error",
+                    "canary_finish_reason": reason}
+        h.weights_step = ck_step
+        with self._lock:
+            h.state = HEALTHY
+        self._set_state_gauge(h)
+        self._m_reloads.labels(result="ok").inc()
+        return {"engine_id": h.engine_id, "result": "ok",
+                "weights_step": ck_step}
+
+    def _warm(self, h: EngineHandle, warm_prompt: Sequence[int]):
+        """Canary decode on the freshly loaded weights: one tiny request
+        end-to-end (prefill + one decode token) re-warms the compiled
+        programs and proves the checkpoint produces finite logits before
+        the engine rejoins rotation. Returns (ok, finish_reason)."""
+        eng = h.engine
+        wid = eng.add_request(np.asarray(warm_prompt, np.int32),
+                              max_new_tokens=1)
+        while eng.has_work:
+            eng.step()
+        outs = eng.take_outputs()
+        warm = outs.pop(wid)
+        if outs:  # real outputs scooped alongside the canary: hand back
+            self._stash.update(outs)
+        return warm.finish_reason in ("stop", "length"), warm.finish_reason
+
+    # -------------------------------------------------------------- health
+    def health(self, engine: Optional[str] = None) -> Dict[str, object]:
+        """Aggregate (or per-engine, via ``engine=``) health view for
+        ``MetricsServer(health_cb=router.health)``.
+
+        Aggregate ``status`` is ``"ok"`` unless some served model has NO
+        engine that is both router-healthy and watchdog-ok — one degraded
+        replica keeps /healthz 200 (its siblings cover), a fully dark
+        model flips 503. ``/healthz?engine=<id>`` routes here with
+        ``engine=`` set; an unknown id reports non-ok and names the known
+        ids."""
+        # snapshot the topology under the lock: the scrape thread must
+        # not iterate dicts the driver thread's add_model() is growing
+        with self._lock:
+            handles = list(self._handles.values())
+            model_map = {mid: list(hs) for mid, hs in self._models.items()}
+        if engine is not None:
+            h = next((x for x in handles if x.engine_id == str(engine)),
+                     None)
+            if h is None:
+                return {"status": "unknown-engine",
+                        "engine": str(engine),
+                        "known": sorted(x.engine_id for x in handles)}
+            eh = h.engine.health()
+            ok = h.state == HEALTHY and eh["status"] == "ok"
+            return {"status": "ok" if ok else
+                    (h.state if h.state != HEALTHY else "degraded"),
+                    "state": h.state, "model": h.model_id,
+                    "weights_step": h.weights_step, **{
+                        k: v for k, v in eh.items() if k != "status"}}
+        models: Dict[str, Dict[str, int]] = {}
+        all_ok = True
+        for mid, hs in model_map.items():
+            healthy = sum(1 for h in hs if h.state == HEALTHY
+                          and h.engine.health()["status"] == "ok")
+            models[mid] = {"healthy": healthy, "total": len(hs)}
+            if healthy == 0:
+                all_ok = False
+        return {"status": "ok" if all_ok else "degraded",
+                "models": models,
+                "engines": {h.engine_id: h.state for h in handles}}

@@ -101,7 +101,8 @@ class RequestOutput:
     prompt_token_ids: np.ndarray
     token_ids: List[int]            # generated tokens (incl. eos if hit)
     # "stop" (eos) | "length" | "timeout" | "cancelled" | "nan"
-    # (quarantined) | "error" — docs/SERVING.md has the full table
+    # (quarantined) | "error" | "unavailable" (router requeue impossible)
+    # — docs/SERVING.md has the full table
     finish_reason: str
     n_gen: int = 0
     error: Optional[str] = None     # diagnostic for finish_reason="error"
@@ -134,6 +135,12 @@ class FCFSScheduler:
         # deadline-bearing requests currently queued: keeps the per-step
         # expiry sweep free (early return) for the common all-None case
         self._n_deadlined = 0
+        # outstanding work queued here, in engine STEPS (1 prefill +
+        # max_new_tokens decode steps per request) — maintained
+        # incrementally at every queue mutation so the router's
+        # least-loaded scoring (engine.load_score) stays O(1) per probe
+        # instead of rescanning the deque on the dispatch hot path
+        self._pending_steps = 0
         reg = metrics.get_registry()
         self._m_queue_wait = reg.histogram(
             "paddle_tpu_serving_queue_wait_seconds",
@@ -161,6 +168,7 @@ class FCFSScheduler:
                 f"~{hint:.3f}s", retry_after_s=hint,
                 queue_depth=len(self.waiting))
         self.waiting.append(request)
+        self._pending_steps += 1 + int(request.max_new_tokens)
         if request.deadline is not None:
             self._n_deadlined += 1
 
@@ -180,7 +188,19 @@ class FCFSScheduler:
                 alive.append(r)
         self.waiting = alive
         self._n_deadlined -= len(expired)
+        for r in expired:
+            self._pending_steps -= 1 + int(r.max_new_tokens)
         return expired
+
+    def pop_all(self) -> List[Request]:
+        """Empty the waiting queue in FCFS order and return the requests —
+        the router's drain path (requeue onto a healthy engine). O(1)
+        bookkeeping: the deque is handed over wholesale."""
+        out = list(self.waiting)
+        self.waiting = deque()
+        self._n_deadlined = 0
+        self._pending_steps = 0
+        return out
 
     def remove(self, req_id) -> Optional[Request]:
         """Pull a WAITING request out of the queue (cancellation path);
@@ -190,6 +210,7 @@ class FCFSScheduler:
         for i, r in enumerate(self.waiting):
             if r.req_id == req_id:
                 del self.waiting[i]
+                self._pending_steps -= 1 + int(r.max_new_tokens)
                 if r.deadline is not None:
                     self._n_deadlined -= 1
                 return r
@@ -198,6 +219,12 @@ class FCFSScheduler:
     @property
     def queue_depth(self) -> int:
         return len(self.waiting)
+
+    @property
+    def pending_steps(self) -> int:
+        """Estimated engine steps queued here (prefill + decode tokens);
+        the queue half of ``ServingEngine.load_score``."""
+        return self._pending_steps
 
     def admit(self, free_slots: int, pool) -> List[Request]:
         """Pop the FCFS prefix that fits this step: free decode slots,
@@ -218,6 +245,7 @@ class FCFSScheduler:
             if not pool.can_admit(req.max_total_tokens, pending_pages):
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
+            self._pending_steps -= 1 + int(req.max_new_tokens)
             if req.deadline is not None:
                 self._n_deadlined -= 1
             admitted.append(req)

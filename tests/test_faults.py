@@ -495,7 +495,8 @@ class TestServingChaos:
             assert (_counter("paddle_tpu_serving_watchdog_trips_total")
                     == trips_before + 1)
             assert _counter("paddle_tpu_serving_degraded",
-                            engine=engine.engine_id) == 1.0
+                            engine_id=engine.engine_id,
+                            model_id=engine.model_id) == 1.0
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(url)
             assert ei.value.code == 503
@@ -503,7 +504,8 @@ class TestServingChaos:
             engine.step()  # two healthy (empty) steps -> recovery
             engine.step()
             assert _counter("paddle_tpu_serving_degraded",
-                            engine=engine.engine_id) == 0.0
+                            engine_id=engine.engine_id,
+                            model_id=engine.model_id) == 0.0
             with urllib.request.urlopen(url) as r:
                 assert r.status == 200
         # one trip episode, counted exactly once

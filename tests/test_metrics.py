@@ -479,21 +479,32 @@ class TestEngineInstrumentation:
             assert name in snap, f"{name} missing from snapshot"
             return snap[name]["series"][0]
 
-        assert one("paddle_tpu_serving_ttft_seconds")["count"] == 2
-        assert one("paddle_tpu_serving_ttft_seconds")["p50"] > 0
+        # per-engine serving series carry {engine_id, model_id} since the
+        # router PR; family-level reads aggregate across engines (stale
+        # series from earlier tests were zeroed by the reset above)
+        assert reg.get("paddle_tpu_serving_ttft_seconds").count == 2
+        assert reg.get("paddle_tpu_serving_ttft_seconds").quantile(0.5) > 0
         # 7 tokens total, 2 are prefill first-tokens -> 5 decode gaps
-        assert one("paddle_tpu_serving_inter_token_seconds")["count"] == 5
+        assert reg.get("paddle_tpu_serving_inter_token_seconds").count == 5
         assert one("paddle_tpu_serving_queue_wait_seconds")["count"] == 2
-        assert one("paddle_tpu_serving_generated_tokens_total")["value"] == 7
-        ev = {s["labels"]["event"]: s["value"]
-              for s in snap["paddle_tpu_serving_requests_total"]["series"]}
+        assert (reg.get("paddle_tpu_serving_generated_tokens_total").value
+                == 7)
+        lbl = {"engine_id": engine.engine_id, "model_id": engine.model_id}
+        ttft_series = [
+            s for s in snap["paddle_tpu_serving_ttft_seconds"]["series"]
+            if s["labels"] == lbl]
+        assert len(ttft_series) == 1 and ttft_series[0]["count"] == 2
+        ev: dict = {}
+        for s in snap["paddle_tpu_serving_requests_total"]["series"]:
+            k = s["labels"]["event"]
+            ev[k] = ev.get(k, 0) + s["value"]
         assert ev == {"admitted": 2, "retired": 2, "rejected": 0,
                       "preempted": 0}
         # record_counter bridge gauges (always-on, no profiler attached)
         assert one("paddle_tpu_serving_queue_depth")["value"] == 0
         assert "paddle_tpu_serving_page_utilization" in snap
-        assert one("paddle_tpu_serving_kv_pages_used")["value"] == 0
-        assert one("paddle_tpu_serving_kv_pages_total")["value"] > 0
+        assert reg.get("paddle_tpu_serving_kv_pages_used").value == 0
+        assert reg.get("paddle_tpu_serving_kv_pages_total").value > 0
         # THE invariant, now a metric: decode compiled exactly once
         compiles = {s["labels"]["fn"]: s["value"]
                     for s in snap["paddle_tpu_jit_compiles_total"]["series"]}
@@ -503,7 +514,7 @@ class TestEngineInstrumentation:
         fams = parse_prometheus(reg.expose_prometheus())
         ttft = fams["paddle_tpu_serving_ttft_seconds"]
         assert ttft["type"] == "histogram"
-        assert ("paddle_tpu_serving_ttft_seconds_count", {}, 2.0) \
+        assert ("paddle_tpu_serving_ttft_seconds_count", lbl, 2.0) \
             in ttft["samples"]
         decode_c = [v for _, lab, v
                     in fams["paddle_tpu_jit_compiles_total"]["samples"]
@@ -513,12 +524,13 @@ class TestEngineInstrumentation:
     def test_rejected_request_counts(self):
         reg = get_registry()
         engine = _tiny_engine()
+        lbl = {"engine_id": engine.engine_id, "model_id": engine.model_id}
         before = reg.get("paddle_tpu_serving_requests_total") \
-            .labels(event="rejected").value
+            .labels(event="rejected", **lbl).value
         with pytest.raises(ValueError):
             engine.add_request(np.arange(40), max_new_tokens=10)
         after = reg.get("paddle_tpu_serving_requests_total") \
-            .labels(event="rejected").value
+            .labels(event="rejected", **lbl).value
         assert after == before + 1
 
     def test_pool_capacity_gauge_self_heals_after_reset(self):
@@ -527,13 +539,17 @@ class TestEngineInstrumentation:
         post-reset scrape reports 0 capacity forever."""
         reg = get_registry()
         engine = _tiny_engine()
-        total = reg.get("paddle_tpu_serving_kv_pages_total").value
+        # this engine's own series (other engines from earlier tests keep
+        # their series alive in the same process-wide family)
+        child = reg.get("paddle_tpu_serving_kv_pages_total").labels(
+            engine_id=engine.engine_id, model_id=engine.model_id)
+        total = child.value
         assert total == engine.pool.usable_pages
         reg.reset()
-        assert reg.get("paddle_tpu_serving_kv_pages_total").value == 0
+        assert child.value == 0
         engine.add_request(np.arange(1, 5), max_new_tokens=2)
         engine.run()
-        assert reg.get("paddle_tpu_serving_kv_pages_total").value == total
+        assert child.value == total
 
     def test_engine_stats_is_thin_view_and_rate_guarded(self):
         """engine.stats mirrors the registry and tokens_per_sec survives

@@ -2,19 +2,27 @@
 """Chaos drill for the serving stack: run the demo engine under a seeded
 fault schedule and print a pass/fail resilience report.
 
-The operational twin of tests/test_faults.py (docs/RESILIENCE.md): six
-scenarios arm ``paddle_tpu.faults`` injections against a tiny llama
-engine — NaN quarantine, page-pool exhaustion, compile-failure retry,
-deadline expiry + cancellation, queue backpressure, watchdog trip +
-``/healthz`` — and each asserts both the behavior AND the telemetry
-(every failure path must move its counter). Exit code 0 iff every
-scenario passes.
+The operational twin of tests/test_faults.py + tests/test_router.py
+(docs/RESILIENCE.md): scenarios 1-6 arm ``paddle_tpu.faults`` injections
+against a tiny llama engine — NaN quarantine, page-pool exhaustion,
+compile-failure retry, deadline expiry + cancellation, queue
+backpressure, watchdog trip + ``/healthz`` — and scenarios 7-9 drill the
+ROUTER control plane: a NaN-poisoned + degraded engine fails its waiting
+work over to a sibling exactly once (no duplicates, no drops), a rolling
+``reload()`` across live traffic completes every request and lands every
+engine on the new checkpoint's weights with the decode program still
+compiled exactly once per engine, and least-loaded dispatch beats blind
+round-robin on p95 queue wait under skewed load. Each scenario asserts
+both the behavior AND the telemetry (every failure path must move its
+counter). Exit code 0 iff every scenario passes.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_serve.py
 """
 import json
 import os
+import shutil
 import sys
+import tempfile
 import urllib.error
 import urllib.request
 
@@ -27,8 +35,10 @@ import numpy as np  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
 from paddle_tpu import faults, metrics  # noqa: E402
+from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
-from paddle_tpu.serving import BackpressureError, ServingEngine  # noqa: E402
+from paddle_tpu.serving import (BackpressureError, Router,  # noqa: E402
+                                ServingEngine)
 
 SEED = int(os.environ.get("CHAOS_SEED", "0"))
 
@@ -183,6 +193,158 @@ def scenario_watchdog_healthz(model):
     return "tripped -> /healthz 503 -> recovered -> 200 (1 episode)"
 
 
+def _trip_watchdog(engine):
+    """Report one over-threshold step straight to the watchdog state
+    machine — the deterministic stand-in for a stalled step (scenario 6
+    drills the real latency-injection route; here the stall must hit ONE
+    chosen engine of a fleet, and a sleep long enough to beat the 30 s
+    default threshold has no place in a CI drill)."""
+    engine.watchdog.end_step(engine.watchdog.stall_threshold_s + 1.0)
+
+
+def scenario_router_failover(model):
+    """Scenario 7: an engine is NaN-poisoned mid-stream AND degraded —
+    the victim quarantines, every WAITING request completes on the
+    sibling exactly once; with the whole fleet dark, waiting work retires
+    "unavailable" instead of bouncing (no duplicates, no drops)."""
+    r = Router()
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=1,
+                watchdog_recovery_steps=999)
+    e0, e1 = r.engine("m/0"), r.engine("m/1")
+    victim = e0.add_request(P9, max_new_tokens=8)
+    e0.step()  # victim decoding in m/0's only slot
+    queued = [e0.add_request(P3, max_new_tokens=3),
+              e0.add_request(P4, max_new_tokens=3)]
+    moved0 = _counter("paddle_tpu_router_requeued_total")
+    un0 = _counter("paddle_tpu_router_unplaceable_total")
+    e0.pool.poison_seq(victim)
+    _trip_watchdog(e0)
+    outs = r.run()
+    _check(outs[victim].finish_reason == "nan", "victim not quarantined")
+    _check([outs[q].finish_reason for q in queued] == ["length"] * 2,
+           "requeued work did not complete on the sibling")
+    _check(len(outs) == 3, "duplicate or dropped outputs")
+    _check(_counter("paddle_tpu_router_requeued_total") == moved0 + 2,
+           "requeue counter != exactly 2")
+    _check(e0.pool.used_pages == 0 and e1.pool.used_pages == 0,
+           "pages leaked")
+    _check(r.states() == {"m/0": "degraded", "m/1": "healthy"},
+           "gate states wrong")
+    # both engines dark: a fresh waiting request has nowhere to go and
+    # retires with the deterministic reason, exactly once
+    b1 = e1.add_request(P9, max_new_tokens=12)
+    e1.step()
+    q2 = e1.add_request(P3, max_new_tokens=2)
+    _trip_watchdog(e1)
+    outs2 = r.run()
+    _check(outs2[q2].finish_reason == "unavailable",
+           "expected finish_reason=unavailable with no healthy engine")
+    _check(outs2[b1].finish_reason == "length", "in-flight request lost")
+    _check(_counter("paddle_tpu_router_unplaceable_total") == un0 + 1,
+           "unplaceable counter != exactly 1")
+    return ("victim=nan, 2 requeued once -> length on sibling; fleet dark "
+            "-> unavailable exactly once")
+
+
+def scenario_router_reload(model):
+    """Scenario 8: rolling reload() across a live request stream — every
+    request completes, every engine ends on the new checkpoint's weights,
+    and decode stays compiled exactly once per engine per weight push."""
+    tmp = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    try:
+        paddle.seed(SEED + 1)
+        donor = LlamaForCausalLM(llama_tiny(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64))
+        sd = donor.state_dict()
+        CheckpointManager(tmp, max_to_keep=None).save(1, {"model": sd})
+        # one model INSTANCE per replica (same seed, identical weights):
+        # a shared instance would flip every replica at the first restore
+        r = Router()
+        r.add_model("m", [_model(), _model()], page_size=4,
+                    max_batch_slots=1)
+        live = [r.submit(p, model="m", max_new_tokens=6)
+                for p in (P5, P9, P3, P4)]
+        jit0 = _counter("paddle_tpu_jit_compiles_total",
+                        fn="serving_decode")
+        ok0 = _counter("paddle_tpu_router_reloads_total", result="ok")
+        summary = r.reload(tmp)
+        outs = r.run()
+        _check([e["result"] for e in summary["engines"]] == ["ok", "ok"],
+               f"reload results: {summary}")
+        _check(sorted(outs) == sorted(live),
+               "live requests dropped or duplicated across reload")
+        _check(all(outs[k].finish_reason == "length" for k in live),
+               "a live request did not complete normally")
+        k0 = next(iter(sd))
+        for eng in r.engines("m"):
+            _check(np.allclose(np.asarray(eng.model.state_dict()[k0]
+                                          .numpy()),
+                               np.asarray(sd[k0].numpy())),
+                   f"engine {eng.engine_id} not on the new weights")
+            _check(eng.compile_counts()["decode"] == 1,
+                   "decode recompiled across the weight push")
+        _check(_counter("paddle_tpu_jit_compiles_total",
+                        fn="serving_decode") == jit0 + 2,
+               "decode compiles != one per engine")
+        _check(_counter("paddle_tpu_router_reloads_total", result="ok")
+               == ok0 + 2, "reload counter")
+        _check(all(h.weights_step == 1 for h in r._model_handles("m")),
+               "weights_step not recorded")
+        return ("4 live requests completed across a 2-engine rolling "
+                "push; weights=ckpt step 1 everywhere; decode still "
+                "1 compile/engine")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def scenario_router_least_loaded(model):
+    """Scenario 9: skewed load — two long hogs pinned on engine 0. Blind
+    round-robin parks half the short requests behind them; least-loaded
+    dispatch steers every short to the idle sibling. Asserted on the
+    queue-wait histogram (p95 AND mean) from the registry."""
+    reg = metrics.get_registry()
+
+    def drive(policy):
+        r = Router()
+        r.add_model("m", model, replicas=2, page_size=4,
+                    max_batch_slots=1)
+        # pre-warm both engines (compile prefill+decode) so the measured
+        # waits are pure scheduling, not one-off XLA compile time
+        for eid in ("m/0", "m/1"):
+            r.engine(eid).add_request(P3, max_new_tokens=2)
+            r.engine(eid).run()
+        reg.reset()
+        e0 = r.engine("m/0")
+        for _ in range(2):  # the skew: 2 x 28-token hogs on engine 0
+            e0.add_request(P3, max_new_tokens=28)
+        for i in range(8):  # 8 short requests placed by `policy`
+            if policy == "round-robin":
+                r.engine(f"m/{i % 2}").add_request(P4, max_new_tokens=2)
+            else:
+                r.submit(P4, model="m", max_new_tokens=2)
+        outs = r.run()
+        _check(len(outs) == 10 and all(
+            o.finish_reason == "length" for o in outs.values()),
+            f"{policy}: workload did not drain cleanly")
+        wait = reg.get("paddle_tpu_serving_queue_wait_seconds")
+        return wait.quantile(0.95), wait.sum / wait.count
+
+    p95_rr, mean_rr = drive("round-robin")
+    p95_ll, mean_ll = drive("least-loaded")
+    _check(p95_ll < p95_rr,
+           f"least-loaded p95 {p95_ll:.4f}s !< round-robin {p95_rr:.4f}s")
+    # the mean separates by ~40% structurally (half the shorts escape the
+    # hogs); 0.9 keeps teeth against a regression to blind rotation while
+    # tolerating CI wall-clock noise
+    _check(mean_ll < 0.9 * mean_rr,
+           f"least-loaded mean {mean_ll:.4f}s !< 0.9 x round-robin "
+           f"{mean_rr:.4f}s")
+    return (f"p95 queue-wait {p95_rr*1e3:.1f}ms (rr) -> "
+            f"{p95_ll*1e3:.1f}ms (least-loaded), mean "
+            f"{mean_rr*1e3:.1f}ms -> {mean_ll*1e3:.1f}ms")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -190,6 +352,9 @@ SCENARIOS = [
     ("deadline-and-cancel", scenario_deadline_and_cancel),
     ("queue-backpressure", scenario_backpressure),
     ("watchdog-healthz", scenario_watchdog_healthz),
+    ("router-failover-requeue-once", scenario_router_failover),
+    ("router-rolling-reload", scenario_router_reload),
+    ("router-least-loaded-dispatch", scenario_router_least_loaded),
 ]
 
 

@@ -8,6 +8,13 @@ Three sources, in order of usefulness:
   --demo                   run a tiny CPU serving workload in-process
                            and dump the registry it populated (smoke /
                            docs walkthrough; also what the tests drive)
+  --demo --router          same, but through a 2-replica Router fleet:
+                           least-loaded dispatch, a degrade + failover,
+                           and a rolling weight reload, so the router
+                           series (paddle_tpu_router_dispatch_total
+                           {engine_id,model_id}, _requeued_total,
+                           _reloads_total{result}, _engine_state and the
+                           per-engine serving labels) are all live
   (neither)                dump THIS process's default registry — only
                            meaningful when imported and called after a
                            workload, so the CLI warns on an empty one
@@ -15,8 +22,8 @@ Three sources, in order of usefulness:
 Output goes to stdout, or --out FILE. Examples:
 
   python tools/metrics_dump.py --demo | jq '.paddle_tpu_serving_ttft_seconds'
+  python tools/metrics_dump.py --demo --router --prometheus | grep router_
   python tools/metrics_dump.py --url http://127.0.0.1:9100 --out snap.json
-  python tools/metrics_dump.py --demo --prometheus
 """
 from __future__ import annotations
 
@@ -52,18 +59,72 @@ def _demo_registry():
     return metrics.get_registry()
 
 
+def _demo_router_registry():
+    """Router-fleet demo: least-loaded dispatch over 2 replicas, one
+    watchdog degrade with exactly-once failover, and a rolling reload
+    from a committed checkpoint — every router series ends up live."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import metrics
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Router
+
+    def model(seed):
+        paddle.seed(seed)
+        return LlamaForCausalLM(llama_tiny(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            num_key_value_heads=2, max_position_embeddings=32))
+
+    router = Router()
+    router.add_model("llama-tiny", [model(0), model(0)], page_size=4,
+                     max_batch_slots=2, watchdog_recovery_steps=2)
+    rng = np.random.default_rng(0)
+    for n, new in ((5, 4), (3, 6), (7, 3), (4, 5)):
+        router.submit(rng.integers(1, 64, (n,)), model="llama-tiny",
+                      max_new_tokens=new)
+    router.run()
+    # degrade replica 0 mid-workload: its waiting request fails over
+    e0 = router.engine("llama-tiny/0")
+    e0.add_request(rng.integers(1, 64, (6,)), max_new_tokens=8)
+    e0.step()
+    e0.add_request(rng.integers(1, 64, (4,)), max_new_tokens=2)
+    e0.watchdog.end_step(e0.watchdog.stall_threshold_s + 1)  # stall
+    router.run()  # failover happens here; e0 recovers after 2 steps
+    # rolling weight push from a committed checkpoint
+    tmp = tempfile.mkdtemp(prefix="metrics_demo_ckpt_")
+    try:
+        CheckpointManager(tmp, max_to_keep=None).save(
+            1, {"model": model(1).state_dict()})
+        router.reload(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return metrics.get_registry()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", help="scrape a running MetricsServer "
                                   "(e.g. http://127.0.0.1:9100)")
     ap.add_argument("--demo", action="store_true",
                     help="populate via a tiny in-process serving run")
+    ap.add_argument("--router", action="store_true",
+                    help="with --demo: drive a 2-replica Router fleet "
+                         "(dispatch/failover/reload) instead of one "
+                         "engine, lighting up the router metrics")
     ap.add_argument("--prometheus", action="store_true",
                     help="text exposition instead of JSON")
     ap.add_argument("--out", help="write here instead of stdout")
     args = ap.parse_args(argv)
     if args.url and args.demo:
         ap.error("--url and --demo are mutually exclusive")
+    if args.router and not args.demo:
+        ap.error("--router is a --demo mode (a live fleet is scraped "
+                 "with --url)")
 
     if args.url:
         path = "/metrics" if args.prometheus else "/metrics.json"
@@ -74,7 +135,8 @@ def main(argv=None):
                                                        indent=2)
     else:
         if args.demo:
-            reg = _demo_registry()
+            reg = (_demo_router_registry() if args.router
+                   else _demo_registry())
         else:
             from paddle_tpu import metrics
 
