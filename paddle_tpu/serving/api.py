@@ -23,6 +23,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -180,11 +181,16 @@ class CompletionAPI:
         }
 
     def _chunk_cb(self, stream_cb, cid, idx, model_name):
-        def cb(req_id, token, finished):
+        def cb(req_id, token, finished, seq):
             # the engine's terminal callback passes the finish reason
             # (docs/SERVING.md table) as `finished`, so streamed chunks
             # agree with the final response's choices[].finish_reason —
-            # and carry the same routed model name as the final response
+            # and carry the same routed model name as the final response.
+            # `seq` is the engine's per-request monotone token sequence
+            # number (token chunks: 0-based generated index; terminal
+            # chunk: total tokens emitted): after an in-flight migration
+            # the adoptive engine resumes at the journaled seq, so a
+            # client can VERIFY it saw every token exactly once.
             try:
                 stream_cb({
                     "id": cid,
@@ -193,6 +199,7 @@ class CompletionAPI:
                     "choices": [{
                         "index": idx,
                         "token_id": None if token is None else int(token),
+                        "seq": int(seq),
                         "finish_reason": finished or None,
                     }],
                 })
@@ -236,6 +243,11 @@ class EnginePool(Router):
     _MODEL_ID = "default"
 
     def __init__(self, model, size: int = 1, **engine_kwargs):
+        warnings.warn(
+            "EnginePool is deprecated: construct a serving.Router and "
+            "use select()/submit() (least-loaded, health-gated) instead "
+            "of blind round-robin rotation", DeprecationWarning,
+            stacklevel=2)
         super().__init__()
         self.add_model(self._MODEL_ID, model, replicas=int(size),
                        **engine_kwargs)

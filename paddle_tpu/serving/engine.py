@@ -19,7 +19,18 @@ fixed grid of ``max_batch_slots`` decode slots; each engine step
 
 Idle slots carry the null block table (all page 0) and a zero position;
 their masked garbage rides along and is discarded on the host. Per-token
-streaming goes through each request's ``stream_cb``.
+streaming goes through each request's ``stream_cb`` with a monotone
+per-request sequence number.
+
+Determinism contract (docs/SERVING.md "Seeds and determinism"): every
+sampled token is keyed ``fold_in(PRNGKey(req.seed), position)`` — prefill
+and the compiled decode step derive from the SAME per-request stream, so
+a request's tokens are a pure function of (prompt, seed, temperature),
+independent of batch composition and engine history. That purity is what
+makes in-flight migration exact: :meth:`export_inflight` journals each
+live request's generated tokens, and an adopting engine re-prefills
+prompt + journal (one ragged prefill) and continues decoding
+token-identically from the journaled position.
 
 Telemetry (docs/OBSERVABILITY.md): every step feeds the always-on
 ``paddle_tpu.metrics`` registry — TTFT / inter-token-latency / queue-wait
@@ -31,6 +42,7 @@ same numbers.
 """
 from __future__ import annotations
 
+import inspect
 import itertools
 import time
 from typing import Dict, List, Optional
@@ -75,17 +87,52 @@ def _bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _cb_accepts_seq(cb) -> bool:
+    """True if a stream callback WANTS the 4th positional arg — the
+    per-request monotone token sequence number. Signature-probed (the
+    MetricsServer health_cb idiom) so the legacy 3-arg
+    ``cb(req_id, token, finished)`` contract keeps working unchanged.
+
+    Opting in requires ``*args``, a REQUIRED 4th positional parameter,
+    or a parameter named ``seq`` — a legacy callback that merely happens
+    to carry a defaulted 4th parameter (``def cb(r, t, f, logger=X)``)
+    must NOT suddenly receive an int in it on upgrade."""
+    try:
+        sig = inspect.signature(cb)
+    except (TypeError, ValueError):
+        return False
+    positional = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            return True
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            positional.append(p)
+    if len(positional) < 4:
+        return False
+    fourth = positional[3]
+    return fourth.default is fourth.empty or fourth.name == "seq"
+
+
 class _SeqState:
-    """One live slot: request + decode cursor."""
+    """One live slot: request + decode cursor.
 
-    __slots__ = ("req", "pos", "last_token", "gen", "key", "t_last")
+    No PRNG state lives here: sampling keys are derived per token as
+    ``fold_in(PRNGKey(req.seed), position)`` inside the compiled step, so
+    the cursor (``pos``) and the journal (``gen``) are the WHOLE resume
+    state — exactly what :meth:`ServingEngine.export_inflight` ships to a
+    sibling engine on migration.
+    """
 
-    def __init__(self, req: Request, pos: int, last_token: int, key):
+    __slots__ = ("req", "pos", "last_token", "gen", "t_last")
+
+    def __init__(self, req: Request, pos: int, last_token: int):
         self.req = req
         self.pos = pos              # tokens of KV written so far
         self.last_token = last_token
-        self.gen = [last_token]     # generated ids (incl. eos when hit)
-        self.key = key
+        # generated ids (incl. eos when hit); for a migrated request this
+        # is pre-seeded with the journaled tokens so stream sequence
+        # numbers and max_new_tokens accounting continue, not restart
+        self.gen = [last_token]
         self.t_last = time.perf_counter()  # last token's landing time (ITL)
 
 
@@ -159,7 +206,11 @@ class ServingEngine:
         self._active_prefill: Optional[_SeqState] = None
         self._decode_prog = None
         self._prefill_progs: Dict[int, jit.StaticFunction] = {}
-        self._rng = jax.random.PRNGKey(seed)
+        # NO engine-global RNG: decode sampling keys derive per slot from
+        # fold_in(PRNGKey(req.seed), position) INSIDE the compiled step,
+        # so a request's token stream never depends on batch composition
+        # or engine history (the `seed` ctor arg survives for API compat
+        # but seeds nothing anymore — docs/SERVING.md).
         self._outputs: Dict[object, RequestOutput] = {}
         self.stats: Dict[str, float] = {
             "steps": 0, "generated_tokens": 0, "finished_requests": 0,
@@ -391,11 +442,47 @@ class ServingEngine:
         or fall to the cancel/deadline machinery."""
         return self.scheduler.pop_all()
 
+    def export_inflight(self) -> List[Request]:
+        """Pop every IN-FLIGHT request (decode slots + a mid-prefill one)
+        off this engine and return resume journals: each Request comes
+        back with ``resume_tokens`` set to the tokens it generated here —
+        together with (prompt, seed, temperature, deadline) already on
+        the Request, the complete state a sibling needs to continue the
+        stream token-identically (ragged re-prefill of prompt + journal,
+        then decode from the journaled position; emission resumes at
+        stream seq ``len(resume_tokens)``). The router's migration path
+        for ``mark_down``/step-crash.
+
+        No lifecycle counters move (the requests retire elsewhere), and
+        pages are freed best-effort per sequence — a crashed engine's
+        pool may refuse, and its memory is being abandoned anyway."""
+        states: List[_SeqState] = []
+        for i, st in enumerate(self.slots):
+            if st is not None:
+                states.append(st)
+                self.slots[i] = None
+        if self._active_prefill is not None:
+            states.append(self._active_prefill)
+            self._active_prefill = None
+        out: List[Request] = []
+        for st in states:
+            try:
+                if self.pool.has_seq(st.req.req_id):
+                    self.pool.free(st.req.req_id)
+            except Exception:
+                pass  # dead pool: journaling must still succeed
+            st.req.resume_tokens = list(st.gen)
+            out.append(st.req)
+        return out
+
     def adopt_request(self, req: Request) -> None:
         """Enqueue a Request object stolen from ANOTHER engine: req_id,
         arrival time, running deadline, seed, and stream_cb all ride along,
         so queue-wait/TTFT keep measuring from the original enqueue and the
-        caller's streaming keeps working. Raises exactly like
+        caller's streaming keeps working. A request journaled by
+        :meth:`export_inflight` (``resume_tokens`` set) re-prefills
+        prompt + journal at admission and continues its stream
+        token-identically. Raises exactly like
         :meth:`add_request` (ValueError from :meth:`check_request`,
         BackpressureError from a full bounded queue) — the router treats a
         raise as requeue-impossible."""
@@ -529,14 +616,30 @@ class ServingEngine:
         return faults.retry(build, attempts=3, base_delay_s=0.01,
                             max_delay_s=0.1)
 
-    def _safe_cb(self, req: Request, token, finished):
+    def _safe_cb(self, req: Request, token, finished, seq: int):
         """Invoke ``req.stream_cb`` isolated: a raising user callback
         cannot abort :meth:`step`. Records the error, disables the
         callback (no further calls for this request), and returns the
         exception (None on success) so the caller can retire the
-        request with ``"error"`` carrying the diagnostic."""
+        request with ``"error"`` carrying the diagnostic.
+
+        ``seq`` is the request's monotone token sequence number (0-based
+        generated index; the terminal call passes the total emitted
+        count). A callback whose signature takes a 4th positional arg
+        receives it — the exactly-once streaming cursor: a migrated
+        request's adoptive engine resumes emission at the journaled seq,
+        so a client never sees a duplicated or missing chunk. Legacy
+        3-arg callbacks are called exactly as before."""
+        cb = req.stream_cb
+        wants_seq = getattr(req, "_cb_wants_seq", None)
+        if wants_seq is None:
+            wants_seq = _cb_accepts_seq(cb)
+            req._cb_wants_seq = wants_seq  # probe once, rides with req
         try:
-            req.stream_cb(req.req_id, token, finished)
+            if wants_seq:
+                cb(req.req_id, token, finished, seq)
+            else:
+                cb(req.req_id, token, finished)
             return None
         except Exception as e:
             self._m_cb_errors.inc()
@@ -560,20 +663,26 @@ class ServingEngine:
         # callback) already happened
         self._outputs[out.req_id] = out
         if req.stream_cb is not None:
-            self._safe_cb(req, None, reason)
+            self._safe_cb(req, None, reason, len(out.token_ids))
         return out
 
     def _finish_queued(self, req: Request, reason: str) -> RequestOutput:
-        """Retire a request that never ran (timeout/cancel in queue)."""
-        return self._emit_terminal(req, [], reason)
+        """Retire a request that never ran HERE (timeout/cancel/
+        unavailable in queue). A migrated request carries its journal:
+        the tokens it generated before its engine died are delivered —
+        they were already streamed, so the output must own them too."""
+        return self._emit_terminal(req, list(req.resume_tokens or ()),
+                                   reason)
 
     def _fail_prefilled_request(self, req: Request,
                                 error: Exception) -> RequestOutput:
         """Retire a request whose prefill failed partway; any pages its
-        allocation grabbed go back to the pool now."""
+        allocation grabbed go back to the pool now. A migrated request's
+        journaled tokens still deliver — they were already streamed."""
         if self.pool.has_seq(req.req_id):
             self.pool.free(req.req_id)
-        return self._emit_terminal(req, [], "error", error)
+        return self._emit_terminal(req, list(req.resume_tokens or ()),
+                                   "error", error)
 
     def _retire_abnormal(self, st: _SeqState, slot: Optional[int],
                          reason: str, error=None) -> RequestOutput:
@@ -647,7 +756,20 @@ class ServingEngine:
     def _prefill(self, req: Request) -> Optional[RequestOutput]:
         t0 = time.perf_counter()
         faults.point("serving.prefill")
-        s = int(req.prompt.size)
+        journal = list(req.resume_tokens or ())
+        if journal:
+            # migration resume (docs/RESILIENCE.md "In-flight
+            # migration"): ONE ragged prefill over prompt + journaled
+            # tokens rebuilds the KV the dead engine held, and the
+            # sample below IS the stream's next token — position
+            # len(ids)-1 keys identically to the decode step the old
+            # engine would have run, so the continued stream is
+            # token-identical to an uninterrupted run
+            ids_full = np.concatenate(
+                [req.prompt, np.asarray(journal, np.int32)])
+        else:
+            ids_full = req.prompt
+        s = int(ids_full.size)
         bucket = _bucket(s, self.max_model_len)
         prog = self._prefill_progs.get(bucket)
         if prog is None:
@@ -655,7 +777,7 @@ class ServingEngine:
                 "serving.compile_prefill",
                 lambda: self._make_prefill(bucket))
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :s] = req.prompt
+        ids[0, :s] = ids_full
         n_kv, hd = self.pool.n_kv_heads, self.pool.head_dim
         flat = [Tensor(jnp.zeros((1, bucket, n_kv, hd), self.pool.dtype),
                        stop_gradient=True)
@@ -666,8 +788,9 @@ class ServingEngine:
         if not bool(np.asarray(fin._value).reshape(())):
             # NaN/inf logits straight out of prefill: quarantine before
             # any page is allocated or any token streamed — the prompt
-            # KV is as untrustworthy as the sample
-            return self._emit_terminal(req, [], "nan")
+            # KV is as untrustworthy as the sample (a migrated request's
+            # already-streamed journal still delivers)
+            return self._emit_terminal(req, journal, "nan")
 
         self.pool.allocate(req.req_id, s,
                            max_total_tokens=req.max_total_tokens)
@@ -675,21 +798,23 @@ class ServingEngine:
             (flat_kv[2 * i]._value[0, :s], flat_kv[2 * i + 1]._value[0, :s])
             for i in range(self.n_layers)])
 
-        key = jax.random.PRNGKey(req.seed)
-        key, sub = jax.random.split(key)
-        tok = int(np.asarray(self._sample_one(last._value, req.temperature,
-                                              sub)))
-        state = _SeqState(req, pos=s, last_token=tok, key=key)
+        tok = int(np.asarray(self._sample_one(
+            last._value, req.temperature, self._sample_key(req.seed,
+                                                           s - 1))))
+        state = _SeqState(req, pos=s, last_token=tok)
+        if journal:
+            state.gen = journal + [tok]  # seq numbers/limits continue
         now = time.perf_counter()
         self._m_prefill.observe(now - t0)
-        self._m_ttft.observe(now - req.arrival_t)  # first token is OUT
+        if not journal:  # a resumed request's first token landed long ago
+            self._m_ttft.observe(now - req.arrival_t)  # first token is OUT
         self._m_tokens.inc()
         self.stats["generated_tokens"] += 1
         if req.stream_cb is not None:
             # visible to cancel() for the duration of the callback (the
             # request is in neither the queue nor a slot right now)
             self._active_prefill = state
-            cb_err = self._safe_cb(req, tok, False)
+            cb_err = self._safe_cb(req, tok, False, len(state.gen) - 1)
             cancelled = self._active_prefill is None
             self._active_prefill = None
             if cancelled:  # cancel() ran inside the callback
@@ -698,6 +823,19 @@ class ServingEngine:
                 return self._retire_abnormal(state, slot=None,
                                              reason="error", error=cb_err)
         return self._maybe_retire(state, slot=None)
+
+    @staticmethod
+    def _sample_key(seed, position):
+        """THE determinism contract, in one line: the key that samples
+        the token following ``position`` (0-based index of the last
+        consumed token) is ``fold_in(PRNGKey(seed), position)`` — a pure
+        function of (request seed, stream position). Prefill calls this
+        on the host; the compiled decode step computes the identical
+        expression per slot (traced, vmapped) — threefry is
+        deterministic, so both derive bit-equal keys and a request's
+        sampled stream is independent of batch composition, engine
+        history, and any migration."""
+        return jax.random.fold_in(jax.random.PRNGKey(seed), position)
 
     def _sample_one(self, last, temperature, key):
         """First-token sample after prefill — delegates to the model's
@@ -710,7 +848,7 @@ class ServingEngine:
     def _make_decode(self) -> jit.StaticFunction:
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
 
-        def step_fn(tok, pos, temps, key, bt, *flat_pools):
+        def step_fn(tok, pos, temps, seeds, bt, *flat_pools):
             caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
                       for i in range(n_layers)]
             with no_grad():
@@ -727,15 +865,30 @@ class ServingEngine:
                 lambda lv: jnp.isfinite(lv).all(axis=-1),
                 [last], name="logits_finite")
 
-            def batched_sample(lv, tv, kv):
+            def batched_sample(lv, tv, sv, pv):
+                # per-slot key = fold_in(PRNGKey(seed), position) — the
+                # _sample_key contract, traced: each request samples
+                # from ITS OWN stream, so its tokens are a pure function
+                # of (prompt, seed, temperature) no matter which
+                # batch-mates ride the grid or which engine runs it.
+                # seeds and positions are DATA: no recompile, and an
+                # idle slot's (0, 0) key samples masked garbage that the
+                # host discards as before.
                 greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
                 t = jnp.maximum(tv.astype(jnp.float32), 1e-6)
-                sampled = jax.random.categorical(
-                    kv, lv / t[:, None], axis=-1).astype(jnp.int32)
+
+                def one_row(seed_i, pos_i, row):
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed_i),
+                                             pos_i)
+                    return jax.random.categorical(key, row)
+
+                sampled = jax.vmap(one_row)(
+                    sv, pv, lv / t[:, None]).astype(jnp.int32)
                 return jnp.where(tv > 0, sampled, greedy)
 
             nxt = apply_op(batched_sample,
-                           [last, ensure_tensor(temps), ensure_tensor(key)],
+                           [last, ensure_tensor(temps),
+                            ensure_tensor(seeds), ensure_tensor(pos)],
                            name="serve_sample")
             flat = [t for c in ncs for t in c]
             return (nxt, fin, *flat)
@@ -755,6 +908,7 @@ class ServingEngine:
         tok = np.zeros((B, 1), np.int32)
         pos = np.zeros(B, np.int32)
         temps = np.zeros(B, np.float32)
+        seeds = np.zeros(B, np.int32)
         seq_ids: List[Optional[object]] = [None] * B
         finished: List[RequestOutput] = []
         for i, st in enumerate(self.slots):
@@ -779,15 +933,15 @@ class ServingEngine:
             tok[i, 0] = st.last_token
             pos[i] = st.pos
             temps[i] = st.req.temperature
+            seeds[i] = st.req.seed
             seq_ids[i] = st.req.req_id
         faults.point("serving.decode_step")
         if not any(s is not None for s in self.slots):
             return finished  # every slot aborted before the compiled step
         bt = self.pool.block_table_array(seq_ids, self.pages_per_seq)
-        self._rng, sub = jax.random.split(self._rng)
         res = self._decode_prog(
             Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(pos)),
-            Tensor(jnp.asarray(temps)), Tensor(sub),
+            Tensor(jnp.asarray(temps)), Tensor(jnp.asarray(seeds)),
             Tensor(jnp.asarray(bt)),
             *[p for i in range(self.n_layers)
               for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
@@ -821,7 +975,7 @@ class ServingEngine:
             self._m_tokens.inc()
             self.stats["generated_tokens"] += 1
             if st.req.stream_cb is not None:
-                cb_err = self._safe_cb(st.req, t, False)
+                cb_err = self._safe_cb(st.req, t, False, len(st.gen) - 1)
                 if self.slots[i] is not st:
                     # cancel() ran inside the callback and already
                     # retired this sequence — touching it again would
@@ -866,5 +1020,5 @@ class ServingEngine:
             # terminal call: `finished` is the reason string (truthy, so
             # bool-style `if finished:` consumers keep working); isolated
             # like every callback — a raise here only records
-            self._safe_cb(req, None, out.finish_reason)
+            self._safe_cb(req, None, out.finish_reason, len(st.gen))
         return out

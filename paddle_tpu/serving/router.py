@@ -15,12 +15,27 @@ door over a fleet of :class:`~.engine.ServingEngine` replicas:
   derives ``degraded`` from the engine's PR 3 watchdog (``health()``)
   at every :meth:`step`; a non-healthy engine stops receiving admissions,
   keeps stepping so its in-flight work finishes (or falls to the existing
-  ``cancel``/deadline machinery — :meth:`mark_down` cancels it
-  immediately), and its WAITING requests are requeued onto healthy
-  siblings **exactly once**: a request is moved at most one time, and if
-  no healthy engine can adopt it (none exists, bounded queue full, or it
-  was already moved once) it retires deterministically with
-  ``finish_reason="unavailable"`` — no duplicates, no silent drops.
+  ``cancel``/deadline machinery), and its WAITING requests are requeued
+  onto healthy siblings **exactly once**: a request is moved at most one
+  time, and if no healthy engine can adopt it (none exists, bounded
+  queue full, or it was already moved once) it retires deterministically
+  with ``finish_reason="unavailable"`` — no duplicates, no silent drops.
+
+- **Crash containment + in-flight migration** — an exception escaping
+  one engine's ``step()`` marks THAT engine ``down``
+  (``paddle_tpu_router_engine_crash_total{engine_id,model_id}``) instead
+  of killing the serving loop, and everything it held moves: waiting
+  requests requeue as above, and IN-FLIGHT requests migrate
+  (``paddle_tpu_router_migrated_total``) under the same move-once
+  discipline — the engine's per-request token journals
+  (``export_inflight``) carry (prompt, generated tokens, sampling
+  params, deadline, stream position) to a healthy sibling, which
+  re-prefills prompt + journal and continues decoding
+  **token-identically** (sampling is a pure function of request seed and
+  stream position — engine.py's determinism contract), resuming stream
+  emission at the journaled seq so clients see no duplicated or missing
+  chunk. :meth:`mark_down` takes the same path. Unplaceable in-flight
+  work retires ``"unavailable"`` delivering the tokens generated so far.
 
 - **Rolling weight reload** — :meth:`reload` drains one engine at a time
   (admissions gate out; its in-flight and queued work finishes locally
@@ -50,11 +65,12 @@ State machine (docs/SERVING.md "Control plane" has the diagram)::
 
     healthy --watchdog trip--> degraded --recovery steps--> healthy
     healthy --drain()/reload--> draining --reload ok/undrain--> healthy
-    any --mark_down()/failed canary--> down --undrain()--> healthy
+    any --mark_down()/step crash/failed canary--> down --undrain()--> healthy
 
 Degraded/draining/down engines never receive admissions; degraded and
 draining engines still step (they recover or finish); down engines are
-cancelled and skipped.
+emptied (waiting requeued, in-flight migrated, each exactly once) and
+skipped.
 """
 from __future__ import annotations
 
@@ -63,7 +79,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import metrics
+from .. import faults, metrics
 from .engine import ServingEngine
 from .scheduler import Request, RequestOutput
 
@@ -79,6 +95,12 @@ DOWN = "down"
 # alerts key on  > 0  (any engine out of rotation)
 _STATE_CODE = {HEALTHY: 0.0, DEGRADED: 1.0, DRAINING: 2.0, DOWN: 3.0}
 
+faults.declare_point(
+    "router.engine_step", "wrapping ONE engine's step() inside "
+    "router.step() — a raise here simulates that engine dying mid-decode; "
+    "the router must contain it (mark down, migrate its in-flight work) "
+    "and never let it escape the fleet loop")
+
 
 class NoHealthyEngineError(RuntimeError):
     """Every engine serving the requested model is out of rotation
@@ -91,7 +113,8 @@ class EngineHandle:
     """One engine's seat in the router: identity, gate state, and the
     weight version it serves."""
 
-    __slots__ = ("engine", "engine_id", "model_id", "state", "weights_step")
+    __slots__ = ("engine", "engine_id", "model_id", "state", "weights_step",
+                 "last_error")
 
     def __init__(self, engine: ServingEngine, engine_id: str,
                  model_id: str):
@@ -100,6 +123,7 @@ class EngineHandle:
         self.model_id = model_id
         self.state = HEALTHY
         self.weights_step: Optional[int] = None  # last reload's ckpt step
+        self.last_error: Optional[str] = None    # repr of a step() crash
 
 
 class Router:
@@ -137,9 +161,20 @@ class Router:
             "healthy sibling (each request moves at most once)")
         self._m_unplaceable = reg.counter(
             "paddle_tpu_router_unplaceable_total",
-            "Waiting requests the router could not requeue (no healthy "
-            "engine / bounded queue full / already moved once) — retired "
-            "with finish_reason=\"unavailable\"")
+            "Requests (waiting or in-flight) the router could not place "
+            "on a sibling (no healthy engine / bounded queue full / "
+            "already moved once) — retired with "
+            "finish_reason=\"unavailable\"")
+        self._m_migrated = reg.counter(
+            "paddle_tpu_router_migrated_total",
+            "IN-FLIGHT requests moved off a dead engine onto a healthy "
+            "sibling via their token journals (each request moves at "
+            "most once; the continued stream is token-identical)")
+        self._m_crash = reg.counter(
+            "paddle_tpu_router_engine_crash_total",
+            "Exceptions escaping one engine's step() that the router "
+            "contained by marking the engine down and migrating its work",
+            labels=("engine_id", "model_id"))
         self._m_reloads = reg.counter(
             "paddle_tpu_router_reloads_total",
             "Per-engine rolling weight reloads by result",
@@ -285,11 +320,17 @@ class Router:
         """Derive degraded/healthy from each engine's watchdog and
         auto-drain the queue of anything that just left rotation. Manual
         states (draining/down) are sticky — only undrain()/reload flip
-        them back."""
-        for h in self._handles.values():
+        them back. A health probe that RAISES (or returns garbage) is
+        worse than degraded: contained like a step crash, so a broken
+        engine can never kill the fleet loop through its own probe."""
+        for h in list(self._handles.values()):
             if h.state in (DRAINING, DOWN):
                 continue
-            ok = h.engine.health()["status"] == "ok"
+            try:
+                ok = h.engine.health()["status"] == "ok"
+            except Exception as e:
+                self._contain(h, e)
+                continue
             if h.state == HEALTHY and not ok:
                 with self._lock:
                     h.state = DEGRADED
@@ -304,9 +345,62 @@ class Router:
         """Move ``h``'s WAITING requests onto healthy siblings, each
         exactly once; whatever cannot move retires
         ``finish_reason="unavailable"`` on ``h`` (delivered through the
-        normal output path). In-flight slots stay: they finish on ``h`` or
-        fall to cancel/deadline/NaN handling."""
-        for req in h.engine.steal_queued():
+        normal output path). In-flight slots stay: they finish on ``h``
+        (still stepping while degraded/draining) or migrate when ``h``
+        goes down (:meth:`_migrate_inflight`). If ``steal_queued``
+        itself raises, the queue is scraped by hand — a broken METHOD
+        must not silently drop requests whose state is readable."""
+        try:
+            stolen = h.engine.steal_queued()
+        except Exception:
+            stolen = self._scrape_queued(h)
+        self._place_elsewhere(h, stolen, self._m_requeued)
+
+    def _migrate_inflight(self, h: EngineHandle) -> None:
+        """Move ``h``'s IN-FLIGHT requests onto healthy siblings via
+        their token journals (``engine.export_inflight``), each exactly
+        once under the same ``_requeued`` move-once discipline as
+        waiting-requeue: the adoptive engine re-prefills prompt +
+        journal and continues the stream token-identically, resuming
+        emission at the journaled seq. Unplaceable requests retire
+        ``"unavailable"`` delivering the tokens generated so far. If
+        ``export_inflight`` itself raises, the journals are scraped by
+        hand (they are plain host state)."""
+        try:
+            journals = h.engine.export_inflight()
+        except Exception:
+            journals = self._scrape_inflight(h)
+        self._place_elsewhere(h, journals, self._m_migrated)
+
+    def _scrape_inflight(self, h: EngineHandle) -> List[Request]:
+        """Fallback when the INSTANCE's ``export_inflight`` attribute is
+        broken (shadowed, wrapped, corrupted): invoke the CLASS
+        implementation directly on the engine's host state — the
+        journals are plain python lists, and losing a mid-stream request
+        because a method binding is broken would violate
+        never-silently-dropped. One copy of the journaling logic either
+        way. Anything truly unreadable stays lost (nothing more exists
+        to read)."""
+        try:
+            return ServingEngine.export_inflight(h.engine)
+        except Exception:
+            return []
+
+    def _scrape_queued(self, h: EngineHandle) -> List[Request]:
+        """``steal_queued`` fallback via the class implementation, same
+        rationale as :meth:`_scrape_inflight`."""
+        try:
+            return ServingEngine.steal_queued(h.engine)
+        except Exception:
+            return []
+
+    def _place_elsewhere(self, h: EngineHandle, reqs: Sequence[Request],
+                         moved_counter) -> None:
+        """The one placement loop behind requeue AND migration: move each
+        request to a healthy sibling at most once; a request that cannot
+        move (no healthy engine, target refused, already moved) retires
+        ``"unavailable"`` — never dropped, never duplicated."""
+        for req in reqs:
             target: Optional[EngineHandle] = None
             if req.req_id not in self._requeued:
                 try:
@@ -314,50 +408,165 @@ class Router:
                 except (ValueError, NoHealthyEngineError):
                     target = None
             if target is None:
-                self._m_unplaceable.inc()
-                h.engine.retire_queued(req, "unavailable")
+                self._retire_unavailable(h, req)
                 continue
             self._requeued.add(req.req_id)
             try:
                 target.engine.adopt_request(req)
             except Exception:
                 # the one chosen target refused (bounded queue, shape cap
-                # mismatch between heterogeneous replicas): requeue is
+                # mismatch between heterogeneous replicas): placement is
                 # impossible NOW — retire deterministically rather than
                 # shopping the request around the fleet
-                self._m_unplaceable.inc()
-                h.engine.retire_queued(req, "unavailable")
+                self._retire_unavailable(h, req)
                 continue
-            self._m_requeued.inc()
+            moved_counter.inc()
+
+    def _retire_unavailable(self, h: EngineHandle, req: Request) -> None:
+        """Deterministic dead end: retire ``req`` with
+        ``finish_reason="unavailable"`` (journaled tokens, if any,
+        deliver — they were already streamed) and drop its move-once
+        mark NOW: the id will never be seen again, so keeping the mark
+        would leak it forever (the ``_requeued`` growth bug)."""
+        self._m_unplaceable.inc()
+        self._requeued.discard(req.req_id)
+        try:
+            h.engine.retire_queued(req, "unavailable")
+        except Exception:
+            # even the source engine's emit path is dead: the router
+            # still owes the caller an output exactly once — synthesize
+            # it into the stash run() merges from — AND the terminal
+            # stream chunk a streaming client is blocked on (via the
+            # engine's _safe_cb so the 3-arg/4-arg protocol and
+            # isolation stay in one place; pure host code, guarded)
+            self._stash[req.req_id] = RequestOutput(
+                req_id=req.req_id, prompt_token_ids=req.prompt,
+                token_ids=list(req.resume_tokens or ()),
+                finish_reason="unavailable")
+            if req.stream_cb is not None:
+                try:
+                    h.engine._safe_cb(req, None, "unavailable",
+                                      len(req.resume_tokens or ()))
+                except Exception:
+                    pass
 
     # ---------------------------------------------------------------- drive
     @property
     def has_work(self) -> bool:
-        return any(h.state != DOWN and h.engine.has_work
-                   for h in self._handles.values())
+        return any(self._safe_has_work(h)
+                   for h in list(self._handles.values()))
+
+    def _safe_has_work(self, h: EngineHandle) -> bool:
+        """``engine.has_work`` with crash containment: a probe that
+        raises gates the engine down (its readable requests evacuate via
+        :meth:`_contain`, after which it genuinely has no work here)."""
+        if h.state == DOWN:
+            return False
+        try:
+            return bool(h.engine.has_work)
+        except Exception as e:
+            self._contain(h, e)
+            return False
 
     def step(self) -> None:
         """One fleet sweep: refresh health gates (auto-draining anything
-        that tripped), then step every non-down engine that has work."""
+        that tripped), then step every non-down engine that has work.
+
+        CRASH CONTAINMENT: an exception escaping one engine's ``step()``
+        — or its ``has_work``/``health()`` probes (hardware fault, bug,
+        armed ``router.engine_step`` injection) — never propagates: that
+        engine is marked ``down``
+        (``paddle_tpu_router_engine_crash_total``), its waiting requests
+        requeue and its in-flight requests migrate by token journal,
+        and the sweep continues with the next engine. A single engine
+        death is invisible to every other tenant of the fleet."""
         self._refresh_health()
         for h in list(self._handles.values()):
             if h.state == DOWN:
                 continue
-            if h.engine.has_work:
+            try:
+                if not h.engine.has_work:
+                    continue
+                faults.point("router.engine_step")
                 h.engine.step()
+            except Exception as e:
+                self._contain(h, e)
+        # reap move-once marks of moved requests that retired on their
+        # adoptive engine: a step()-driven server (never calling run())
+        # must not grow _requeued forever across incidents. Free in the
+        # steady state (the set is empty unless a failover happened);
+        # after one, a single guarded pass keeps only ids still live
+        # somewhere in the fleet.
+        if self._requeued:
+            live = self._live_req_ids()
+            if live is not None:
+                self._requeued &= live
+
+    def _live_req_ids(self) -> Optional[set]:
+        """Every req_id currently queued or in-flight on any non-down
+        engine; None when some engine's state is unreadable (reaping
+        aborts for that sweep rather than dropping a mark that might
+        still be live)."""
+        live: set = set()
+        try:
+            for h in self._handles.values():
+                if h.state == DOWN:
+                    continue  # evacuated: holds no router-managed work
+                eng = h.engine
+                for req in eng.scheduler.waiting:
+                    live.add(req.req_id)
+                for st in eng.slots:
+                    if st is not None:
+                        live.add(st.req.req_id)
+                if eng._active_prefill is not None:
+                    live.add(eng._active_prefill.req.req_id)
+        except Exception:
+            return None
+        return live
+
+    def _contain(self, h: EngineHandle, exc: BaseException) -> None:
+        """Contain one engine's failure: count it, record it on the
+        handle (surfaces via ``/healthz?engine=``), gate it ``down``,
+        and evacuate everything it held."""
+        self._m_crash.labels(engine_id=h.engine_id,
+                             model_id=h.model_id).inc()
+        h.last_error = repr(exc)
+        with self._lock:
+            h.state = DOWN
+        self._set_state_gauge(h)
+        self._evacuate(h)
+
+    def _evacuate(self, h: EngineHandle) -> None:
+        """Empty a just-downed engine: in-flight requests migrate FIRST
+        (their tokens are sunk cost and their streams have live
+        consumers — under tight sibling capacity they must not lose
+        their seat to a request that never started), then waiting
+        requests requeue — each exactly once. Nothing raises even if the
+        engine is too dead to cooperate (every engine touch inside is
+        guarded)."""
+        self._migrate_inflight(h)
+        self._requeue_waiting(h)
 
     def run(self) -> Dict[object, RequestOutput]:
         """Drive :meth:`step` until the whole fleet drains; returns every
         output finished since the last :meth:`run`, merged across engines
-        (a requeued request's output comes from its adoptive engine) —
-        exactly-once handout, same contract as ``ServingEngine.run``."""
+        (a requeued or migrated request's output comes from its adoptive
+        engine) — exactly-once handout, same contract as
+        ``ServingEngine.run``."""
         while self.has_work:
             self.step()
         out = self._stash
         self._stash = {}
         for h in self._handles.values():
             out.update(h.engine.take_outputs())
-        self._requeued -= set(out)  # delivered: drop the move-once marks
+        # the fleet is fully drained: every request has retired, so NO
+        # live request can still hold a move-once mark. Clearing (rather
+        # than subtracting the delivered ids) also reaps marks of
+        # requests that retired without router-visible output —
+        # cancelled on the adoptive engine, drained via engine.run() —
+        # which used to leak forever (tests assert the set is empty
+        # after every chaos drill)
+        self._requeued.clear()
         return out
 
     def stash_unclaimed(self, outputs: Dict[object, RequestOutput]) -> None:
@@ -379,21 +588,18 @@ class Router:
 
     def mark_down(self, engine_id: str) -> None:
         """Take an engine out NOW (state ``down``): waiting requests are
-        requeued (exactly once), in-flight requests are cancelled through
-        the existing ``engine.cancel`` machinery
-        (``finish_reason="cancelled"``), and the engine is no longer
-        stepped until :meth:`undrain`."""
+        requeued and in-flight requests MIGRATE by token journal (each
+        exactly once — the adoptive engine continues every stream
+        token-identically; unplaceable work retires ``"unavailable"``
+        with its tokens so far), and the engine is no longer stepped
+        until :meth:`undrain`. Never raises: every engine touch is
+        guarded, so an engine that is already dead — its ``cancel``/
+        ``step`` raising, its pool unusable — is still markable down."""
         h = self._require(engine_id)
         with self._lock:
             h.state = DOWN
         self._set_state_gauge(h)
-        self._requeue_waiting(h)
-        eng = h.engine
-        live = [st.req.req_id for st in eng.slots if st is not None]
-        if eng._active_prefill is not None:
-            live.append(eng._active_prefill.req.req_id)
-        for rid in live:
-            eng.cancel(rid)
+        self._evacuate(h)
 
     def undrain(self, engine_id: str) -> None:
         """Return a drained/down engine to rotation (state ``healthy``;
@@ -472,8 +678,22 @@ class Router:
         # every sibling next, so moving requests ahead of the wave would
         # double-move them — and the exactly-once failover budget belongs
         # to real failures, not planned maintenance.
-        while h.engine.has_work:
+        # bound the drain on the gate state too: if the engine crashes
+        # mid-drain AND is too dead to evacuate (its queue/slots stay
+        # populated), step() skips it as DOWN forever — without this
+        # condition the loop would spin on has_work for eternity. The
+        # probe itself rides _safe_has_work: a raising has_work gates
+        # the engine down (contained) instead of escaping reload()
+        # with the engine stuck DRAINING
+        while h.state != DOWN and self._safe_has_work(h):
             self.step()
+        if h.state == DOWN:
+            # the engine crashed while draining (step() containment
+            # already moved its work): don't push weights into a corpse,
+            # and don't resurrect it to healthy below
+            self._m_reloads.labels(result="error").inc()
+            return {"engine_id": h.engine_id, "result": "error",
+                    "error": h.last_error}
         try:
             missing, _unexpected = h.engine.model.set_state_dict(sd)
             if missing:
@@ -523,6 +743,17 @@ class Router:
         return warm.finish_reason in ("stop", "length"), warm.finish_reason
 
     # -------------------------------------------------------------- health
+    @staticmethod
+    def _engine_health_view(h: EngineHandle) -> Dict[str, object]:
+        """``engine.health()`` guarded for the scrape thread: a raising
+        probe reads as a non-ok status instead of 500-ing ``/healthz``.
+        Containment (gate down + evacuate) stays the DRIVE thread's job
+        — ``_refresh_health`` does it at the next ``router.step()``."""
+        try:
+            return dict(h.engine.health())
+        except Exception as e:
+            return {"status": f"probe-error: {e!r}"}
+
     def health(self, engine: Optional[str] = None) -> Dict[str, object]:
         """Aggregate (or per-engine, via ``engine=``) health view for
         ``MetricsServer(health_cb=router.health)``.
@@ -545,18 +776,20 @@ class Router:
                 return {"status": "unknown-engine",
                         "engine": str(engine),
                         "known": sorted(x.engine_id for x in handles)}
-            eh = h.engine.health()
+            eh = self._engine_health_view(h)
             ok = h.state == HEALTHY and eh["status"] == "ok"
             return {"status": "ok" if ok else
                     (h.state if h.state != HEALTHY else "degraded"),
                     "state": h.state, "model": h.model_id,
-                    "weights_step": h.weights_step, **{
+                    "weights_step": h.weights_step,
+                    "last_error": h.last_error, **{
                         k: v for k, v in eh.items() if k != "status"}}
         models: Dict[str, Dict[str, int]] = {}
         all_ok = True
         for mid, hs in model_map.items():
-            healthy = sum(1 for h in hs if h.state == HEALTHY
-                          and h.engine.health()["status"] == "ok")
+            healthy = sum(
+                1 for h in hs if h.state == HEALTHY
+                and self._engine_health_view(h)["status"] == "ok")
             models[mid] = {"healthy": healthy, "total": len(hs)}
             if healthy == 0:
                 all_ok = False

@@ -68,6 +68,15 @@ class Request:
     # seconds from enqueue until the engine retires the request with
     # finish_reason="timeout" (queued or mid-decode); None = no deadline
     deadline_s: Optional[float] = None
+    # resume journal (docs/RESILIENCE.md "In-flight migration"): tokens
+    # this request already generated on an engine that died. Set by
+    # ServingEngine.export_inflight; an adopting engine re-prefills
+    # prompt + resume_tokens and continues decoding at the journaled
+    # position — per-request deterministic sampling makes the continued
+    # stream token-identical to an uninterrupted run. The tokens were
+    # already streamed (stream_cb seq 0..len-1); emission resumes at
+    # seq=len(resume_tokens), so a client never sees a duplicate.
+    resume_tokens: Optional[List[int]] = None
     req_id: object = field(default_factory=lambda: next(_req_counter))
     # enqueue wall-clock (perf_counter domain): queue-wait and TTFT are
     # measured from here, so they include scheduling delay, not just
@@ -78,6 +87,15 @@ class Request:
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
+        # canonicalize the seed into int32 range (keep the low 32 bits):
+        # the compiled decode step stages per-slot seeds as an int32
+        # array, and numpy raises OverflowError staging e.g. 2**31 — a
+        # user-supplied seed must never be able to crash a decode step.
+        # Deterministic (same wide seed -> same stream) and applied
+        # before ANY key derivation, so host prefill and device decode
+        # agree on the exact same value.
+        s = int(self.seed) & 0xFFFFFFFF
+        self.seed = s - (1 << 32) if s >= (1 << 31) else s
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if self.deadline_s is not None and self.deadline_s < 0:
@@ -91,6 +109,22 @@ class Request:
     @property
     def max_total_tokens(self) -> int:
         return int(self.prompt.size) + int(self.max_new_tokens)
+
+    @property
+    def prefill_tokens(self) -> int:
+        """Tokens the admitting engine will actually prefill: the prompt,
+        plus the journaled generation for a migrated request (its ragged
+        re-prefill covers prompt + tokens-so-far) — what the scheduler's
+        per-step prefill budget must charge."""
+        return int(self.prompt.size) + len(self.resume_tokens or ())
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        """Decode tokens still owed (max_new_tokens minus any journaled
+        resume tokens) — the honest load-score weight for a migrated
+        request."""
+        return max(int(self.max_new_tokens)
+                   - len(self.resume_tokens or ()), 0)
 
 
 @dataclass
@@ -168,7 +202,7 @@ class FCFSScheduler:
                 f"~{hint:.3f}s", retry_after_s=hint,
                 queue_depth=len(self.waiting))
         self.waiting.append(request)
-        self._pending_steps += 1 + int(request.max_new_tokens)
+        self._pending_steps += 1 + request.remaining_new_tokens
         if request.deadline is not None:
             self._n_deadlined += 1
 
@@ -189,7 +223,7 @@ class FCFSScheduler:
         self.waiting = alive
         self._n_deadlined -= len(expired)
         for r in expired:
-            self._pending_steps -= 1 + int(r.max_new_tokens)
+            self._pending_steps -= 1 + r.remaining_new_tokens
         return expired
 
     def pop_all(self) -> List[Request]:
@@ -210,7 +244,7 @@ class FCFSScheduler:
         for i, r in enumerate(self.waiting):
             if r.req_id == req_id:
                 del self.waiting[i]
-                self._pending_steps -= 1 + int(r.max_new_tokens)
+                self._pending_steps -= 1 + r.remaining_new_tokens
                 if r.deadline is not None:
                     self._n_deadlined -= 1
                 return r
@@ -238,21 +272,31 @@ class FCFSScheduler:
         pending_pages = 0
         while self.waiting and free_slots > 0:
             req = self.waiting[0]
-            if req.prompt.size > budget and admitted:
+            # prefill_tokens, not prompt.size: a migrated request's
+            # ragged re-prefill covers prompt + journaled tokens, and the
+            # budget exists to bound prefill COMPUTE this step
+            if req.prefill_tokens > budget and admitted:
                 break  # budget spent this step; FCFS head keeps its turn
             # (an over-budget prompt with no batch-mates still runs, alone
             # this step, or it would starve forever)
             if not pool.can_admit(req.max_total_tokens, pending_pages):
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
-            self._pending_steps -= 1 + int(req.max_new_tokens)
+            self._pending_steps -= 1 + req.remaining_new_tokens
             if req.deadline is not None:
                 self._n_deadlined -= 1
             admitted.append(req)
-            self._m_queue_wait.observe(time.perf_counter() - req.arrival_t)
+            if not req.resume_tokens:
+                # queue-wait measures FIRST admission from the original
+                # enqueue; a migrated request's second admission would
+                # fold all its decode time on the dead engine into the
+                # histogram, spiking p95 during exactly the incidents
+                # operators read it for (same skew guard as TTFT)
+                self._m_queue_wait.observe(
+                    time.perf_counter() - req.arrival_t)
             pending_pages += pool.pages_needed(req.max_total_tokens)
             free_slots -= 1
-            budget -= int(req.prompt.size)
+            budget -= req.prefill_tokens
             if budget <= 0:
                 break
         return admitted

@@ -1,0 +1,49 @@
+"""CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
+1-10 — engine resilience, router failover/reload/dispatch, and the
+kill-engine-mid-decode migration drill) runs as slow-marked tests instead
+of only by hand, one test per scenario so a regression names its drill.
+
+The scenarios are imported from the tool itself — one source of truth;
+this file adds only pytest plumbing (module load, shared model, fault
+hygiene). Registry note: scenario 9 calls ``registry.reset()``, which
+zeroes series but keeps families + label children registered, so later
+tests' delta-based counter asserts are unaffected.
+"""
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.serving]
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_serve", os.path.join(REPO, "tools", "chaos_serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+chaos = _load_chaos()
+
+
+@pytest.fixture(scope="module")
+def model():
+    # one model for the whole ladder, exactly like chaos_serve.main()
+    return chaos._model()
+
+
+@pytest.mark.parametrize("name,scenario", chaos.SCENARIOS,
+                         ids=[n for n, _ in chaos.SCENARIOS])
+def test_chaos_scenario(name, scenario, model):
+    from paddle_tpu import faults
+
+    faults.reset()  # hermetic per scenario, like main()'s loop
+    try:
+        detail = scenario(model)
+    finally:
+        faults.reset()
+    assert detail  # every scenario returns its pass summary

@@ -247,19 +247,273 @@ class TestHealthGate:
         assert len(outs) == 3  # exactly once each, nothing extra
         assert e0.pool.used_pages == 0
 
-    def test_mark_down_cancels_in_flight(self):
+    def test_mark_down_migrates_in_flight(self):
+        """mark_down no longer kills in-flight work: it migrates by token
+        journal to the sibling and completes token-identically there."""
+        # reference: the same request uninterrupted on a lone engine
+        from paddle_tpu.serving import ServingEngine
+
+        ref_eng = ServingEngine(_model(), **_ENGINE_KW)
+        ref_id = ref_eng.add_request(P5, max_new_tokens=8)
+        ref = ref_eng.run()[ref_id].token_ids
+
         r = Router()
         r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
         e0 = r.engine("m/0")
         running = e0.add_request(P5, max_new_tokens=8)
         e0.step()
+        e0.step()  # a few tokens journaled before the engine goes down
         q = e0.add_request(P3, max_new_tokens=2)
+        migrated_before = _counter("paddle_tpu_router_migrated_total")
         r.mark_down("m/0")
         assert r.states()["m/0"] == "down"
         outs = r.run()
-        assert outs[running].finish_reason == "cancelled"
+        assert outs[running].finish_reason == "length"  # finished on m/1
+        assert list(outs[running].token_ids) == list(ref)  # token-identical
         assert outs[q].finish_reason == "length"  # moved to m/1
+        assert (_counter("paddle_tpu_router_migrated_total")
+                == migrated_before + 1)
         assert e0.pool.used_pages == 0
+        assert r._requeued == set()  # marks reaped after the drain
+
+
+# ──────────────── crash containment + in-flight migration ────────────────
+
+
+class TestCrashContainment:
+    """ISSUE 7 tentpole: an engine dying mid-decode is contained by
+    router.step() (never propagates), and its in-flight requests migrate
+    by token journal to a sibling that continues each stream
+    token-identically with exactly-once stream chunks."""
+
+    def _ref_tokens(self, prompt, n, seed, temperature):
+        """The same request decoded uninterrupted on a lone engine — the
+        determinism contract makes this THE reference for any migrated
+        run of the same (prompt, seed, temperature)."""
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(_model(), **_ENGINE_KW)
+        rid = eng.add_request(prompt, max_new_tokens=n, seed=seed,
+                              temperature=temperature)
+        return list(eng.run()[rid].token_ids)
+
+    def test_step_crash_contained_and_migrated_token_identically(self):
+        ref = self._ref_tokens(P5, 8, seed=3, temperature=0.8)
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        chunks = []  # 4-arg callback: receives the monotone seq numbers
+        rid = e0.add_request(
+            P5, max_new_tokens=8, temperature=0.8, seed=3,
+            stream_cb=lambda req_id, tok, fin, seq: chunks.append(
+                (seq, tok)))
+        e0.step()
+        e0.step()  # a couple of tokens journaled before the crash
+        crash0 = _counter("paddle_tpu_router_engine_crash_total",
+                          engine_id="m/0", model_id="m")
+        moved0 = _counter("paddle_tpu_router_migrated_total")
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("chip died"), times=1):
+            r.step()  # contained: must NOT raise
+        assert r.states()["m/0"] == "down"
+        assert (_counter("paddle_tpu_router_engine_crash_total",
+                         engine_id="m/0", model_id="m") == crash0 + 1)
+        outs = r.run()
+        assert outs[rid].finish_reason == "length"
+        assert list(outs[rid].token_ids) == ref  # bit-identical stream
+        assert (_counter("paddle_tpu_router_migrated_total")
+                == moved0 + 1)
+        # exactly-once streaming: seqs 0..7 each exactly once, in order,
+        # carrying exactly the reference tokens (terminal chunk: seq=8)
+        tok_chunks = [c for c in chunks if c[1] is not None]
+        assert [s for s, _ in tok_chunks] == list(range(8))
+        assert [t for _, t in tok_chunks] == ref
+        assert chunks[-1] == (8, None)
+        assert r._requeued == set()  # move-once marks reaped after drain
+        assert "chip died" in r.health(engine="m/0")["last_error"]
+
+    def test_unplaceable_inflight_retires_unavailable_with_tokens(self):
+        r = Router()
+        r.add_model("m", _model(), **_ENGINE_KW)  # NO sibling
+        e0 = r.engine("m/0")
+        rid = e0.add_request(P5, max_new_tokens=8)
+        e0.step()
+        e0.step()
+        journal = list(e0.slots[0].gen)  # tokens generated so far
+        un0 = _counter("paddle_tpu_router_unplaceable_total")
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("dead"), times=1):
+            r.step()
+        outs = r.run()
+        # the already-streamed tokens deliver with the terminal output
+        assert outs[rid].finish_reason == "unavailable"
+        assert list(outs[rid].token_ids) == journal
+        assert (_counter("paddle_tpu_router_unplaceable_total")
+                == un0 + 1)
+        assert r._requeued == set()
+
+    def test_migrated_inflight_never_moves_twice(self):
+        """Second engine death after a migration retires the request
+        (with its full journal) instead of bouncing it around the fleet
+        — the move-once discipline covers migration too."""
+        r = Router()
+        r.add_model("m", _model(), replicas=3, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        rid = e0.add_request(P5, max_new_tokens=16)
+        e0.step()
+        moved0 = _counter("paddle_tpu_router_migrated_total")
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("first death"), times=1):
+            r.step()  # e0 dies; rid migrates (once) to a sibling
+        assert (_counter("paddle_tpu_router_migrated_total")
+                == moved0 + 1)
+        adoptive = next(h for h in r._model_handles("m")
+                        if h.engine.has_work)
+        adoptive.engine.step()  # rid decoding IN-FLIGHT on the adoptive
+        n_gen = len(adoptive.engine.slots[0].gen)
+        assert n_gen >= 1
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("second death"), times=1):
+            r.step()  # adoptive dies; a healthy sibling exists, but the
+            #           request already used its one move
+        outs = r.run()
+        assert outs[rid].finish_reason == "unavailable"
+        assert len(outs[rid].token_ids) >= n_gen  # full journal delivered
+        assert (_counter("paddle_tpu_router_migrated_total")
+                == moved0 + 1)  # no second migration
+        assert r._requeued == set()
+
+    def test_mark_down_on_dead_engine_never_raises(self):
+        """Satellite: an engine too dead to cooperate — every control
+        surface raising — must still be markable down (the guard the old
+        in-flight cancel loop lacked). Its requests are SCRAPED from the
+        host-side state the broken methods sat on, so they still migrate
+        instead of silently vanishing."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        running = e0.add_request(P5, max_new_tokens=4)
+        e0.step()
+        waiting = e0.add_request(P3, max_new_tokens=2)
+
+        def boom(*a, **k):
+            raise RuntimeError("engine is gone")
+
+        e0.steal_queued = boom
+        e0.export_inflight = boom
+        e0.cancel = boom
+        e0.retire_queued = boom
+        e0.step = boom
+        r.mark_down("m/0")  # must not throw
+        assert r.states()["m/0"] == "down"
+        outs = r.run()  # the fleet keeps serving — and recovered BOTH
+        assert outs[running].finish_reason == "length"
+        assert outs[waiting].finish_reason == "length"
+
+    def test_raising_health_probe_is_contained(self):
+        """health()/has_work raising must not kill the fleet loop: the
+        broken engine gates down (crash-counted) and its work moves."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        rid = e0.add_request(P5, max_new_tokens=4)
+        e0.step()
+
+        def boom(*a, **k):
+            raise RuntimeError("probe exploded")
+
+        e0.health = boom
+        crash0 = _counter("paddle_tpu_router_engine_crash_total",
+                          engine_id="m/0", model_id="m")
+        r.step()  # must not raise
+        assert r.states()["m/0"] == "down"
+        assert (_counter("paddle_tpu_router_engine_crash_total",
+                         engine_id="m/0", model_id="m") == crash0 + 1)
+        outs = r.run()
+        assert outs[rid].finish_reason == "length"  # migrated, finished
+        assert "probe exploded" in r.health(engine="m/0")["last_error"]
+
+    def test_requeued_marks_cleared_without_router_visible_output(self):
+        """Satellite regression: a moved request that retires without its
+        output ever passing router.run() (cancelled on the adoptive
+        engine, drained via engine.run() directly) must not leak its
+        move-once mark forever."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        e0, e1 = r.engine("m/0"), r.engine("m/1")
+        b1 = e1.add_request(P4, max_new_tokens=8)
+        e1.step()  # e1's only slot busy
+        q = e0.add_request(P3, max_new_tokens=2)
+        _trip(e0)
+        r.step()  # q requeues m/0 -> m/1 and takes its move-once mark
+        assert q in r._requeued
+        e1.cancel(q)  # retired on the ADOPTIVE engine...
+        e1.run()      # ...and drained engine-side, bypassing router.run
+        r.run()
+        assert r._requeued == set()  # the mark did not leak
+
+    def test_inflight_migrates_before_waiting_under_tight_capacity(self):
+        """Evacuation order: the in-flight request (sunk tokens, live
+        stream) takes the sibling's last queue seat; the never-started
+        waiting request is the one that retires unavailable."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, max_queue=1, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        running = e0.add_request(P5, max_new_tokens=8)
+        e0.step()  # running mid-decode in e0's only slot
+        waiting = e0.add_request(P3, max_new_tokens=2)
+        r.mark_down("m/0")
+        outs = r.run()
+        assert outs[running].finish_reason == "length"  # kept its seat
+        assert outs[waiting].finish_reason == "unavailable"
+
+    def test_marks_reaped_in_step_driven_loop_without_run(self):
+        """A long-lived server driving the fleet with step() — never
+        run() — must not leak move-once marks after a failover: step()
+        reaps marks of moved requests that retired on their adoptive
+        engine."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        rid = e0.add_request(P5, max_new_tokens=6)
+        e0.step()
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("died"), times=1):
+            r.step()  # rid migrates and takes its move-once mark
+        assert rid in r._requeued
+        for _ in range(40):  # step-driven drain: run() never called
+            if not r.has_work:
+                break
+            r.step()
+        outs = {}
+        for eng in r.engines("m"):
+            outs.update(eng.take_outputs())
+        assert outs[rid].finish_reason == "length"
+        assert r._requeued == set()  # reaped without run()
+
+    def test_unavailable_inflight_on_broken_engine_synthesizes_output(self):
+        """Even when the source engine's emit path is dead, the caller
+        still gets its terminal output exactly once (router stash)."""
+        r = Router()
+        r.add_model("m", _model(), **_ENGINE_KW)  # no sibling
+        e0 = r.engine("m/0")
+        rid = e0.add_request(P5, max_new_tokens=8)
+        e0.step()
+        journal = list(e0.slots[0].gen)
+
+        def boom(*a, **k):
+            raise RuntimeError("emit path dead")
+
+        e0.retire_queued = boom
+        chunks = []
+        e0.slots[0].req.stream_cb = (
+            lambda r_, tok, fin, seq: chunks.append((seq, tok, fin)))
+        r.mark_down("m/0")
+        outs = r.run()
+        assert outs[rid].finish_reason == "unavailable"
+        assert list(outs[rid].token_ids) == journal
+        # the streaming client still gets its terminal chunk
+        assert chunks[-1] == (len(journal), None, "unavailable")
 
 
 # ─────────────────────────── /healthz wiring ───────────────────────────
@@ -373,6 +627,46 @@ class TestReload:
         outs = r.run()
         assert outs[rid].finish_reason == "length"  # not "unavailable"
 
+    def test_reload_survives_engine_crash_during_drain(self, tmp_path):
+        """A reload whose engine dies mid-drain — too dead even to
+        evacuate — must return an error result, not spin forever on
+        has_work for an engine step() will never touch again."""
+        self._ckpt(tmp_path)
+        r = Router()
+        r.add_model("m", _model(), **_ENGINE_KW)
+        e0 = r.engine("m/0")
+        e0.add_request(P4, max_new_tokens=3)
+
+        def boom(*a, **k):
+            raise RuntimeError("dead mid-drain")
+
+        e0.step = boom
+        e0.steal_queued = boom
+        e0.export_inflight = boom
+        summary = r.reload(str(tmp_path))
+        assert summary["engines"][0]["result"] == "error"
+        assert "dead mid-drain" in summary["engines"][0]["error"]
+        assert r.states()["m/0"] == "down"
+
+    def test_reload_survives_raising_has_work_probe(self, tmp_path):
+        """Even the drain loop's has_work PROBE raising must not escape
+        reload() or leave the engine stuck DRAINING: the probe is
+        contained (engine down) and the summary reports the error."""
+        self._ckpt(tmp_path)
+        r = Router()
+        r.add_model("m", _model(), **_ENGINE_KW)
+        e0 = r.engine("m/0")
+
+        class _Trashed:
+            def __getattr__(self, name):
+                raise RuntimeError("scheduler state trashed")
+
+        e0.scheduler = _Trashed()  # has_work now raises
+        summary = r.reload(str(tmp_path))
+        assert summary["engines"][0]["result"] == "error"
+        assert "trashed" in summary["engines"][0]["error"]
+        assert r.states()["m/0"] == "down"
+
     def test_bad_checkpoint_canary_gates_engine_down(self, tmp_path):
         donor = _model(1)
         sd = donor.state_dict()
@@ -398,6 +692,15 @@ class TestReload:
 
 
 class TestEnginePoolShim:
+    def test_engine_pool_construction_warns_deprecation(self):
+        """The shim actively steers callers to Router: constructing one
+        raises a DeprecationWarning (it stays fully functional)."""
+        with pytest.warns(DeprecationWarning,
+                          match="EnginePool is deprecated"):
+            pool = EnginePool(_model(), size=1, page_size=4,
+                              max_batch_slots=1)
+        assert len(pool) == 1  # still works after warning
+
     def test_modular_round_robin_and_inherited_control_plane(self):
         pool = EnginePool(_model(), size=2, page_size=4, max_batch_slots=1)
         a, b, c = pool.next(), pool.next(), pool.next()

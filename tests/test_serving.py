@@ -320,6 +320,152 @@ class TestEngineEquivalence:
         assert engine.run() == {}  # outputs drain: handed out exactly once
 
 
+# ──────────────── deterministic sampling (ISSUE 7 tentpole) ────────────────
+
+
+class TestDeterministicSampling:
+    """A sampled request's token stream is a pure function of
+    (prompt, seed, temperature): per-slot keys derive as
+    fold_in(PRNGKey(req.seed), position) INSIDE the compiled decode step,
+    so tokens never depend on batch composition, engine history, or a
+    mid-stream migration — the property that makes in-flight failover
+    token-identical."""
+
+    _SPEC = dict(max_new_tokens=8, temperature=0.9, seed=13)
+
+    def _alone(self, model):
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3)
+        rid = eng.add_request(_PROMPTS[0], **self._SPEC)
+        return list(eng.run()[rid].token_ids)
+
+    def test_batch_composition_independence_and_migration(self):
+        model = _llama()
+        ref = self._alone(model)
+        assert len(set(ref)) > 1  # sanity: actually sampling, not greedy
+
+        # same request alongside DIFFERENT batch mates (other seeds,
+        # temperatures, lengths; engine pre-warmed with unrelated work)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3)
+        eng.add_request(_PROMPTS[2], max_new_tokens=3, temperature=0.5,
+                        seed=99)
+        eng.step()  # engine history differs from the reference run
+        rid = eng.add_request(_PROMPTS[0], **self._SPEC)
+        eng.add_request(_PROMPTS[1], max_new_tokens=6, temperature=1.3,
+                        seed=7)
+        assert list(eng.run()[rid].token_ids) == ref
+
+        # same request REPLAYED on a fresh engine: bit-identical again
+        assert self._alone(model) == ref
+
+        # migrated mid-stream: journal 3 tokens, resume on another
+        # engine (ragged re-prefill of prompt + journal) — the continued
+        # stream must be token-identical to the uninterrupted run
+        adoptive = ServingEngine(model, page_size=4, max_batch_slots=2)
+        req = Request(prompt=_PROMPTS[0], **self._SPEC)
+        req.resume_tokens = ref[:3]
+        adoptive.adopt_request(req)
+        assert list(adoptive.run()[req.req_id].token_ids) == ref
+
+    def test_export_inflight_journals_and_resume_is_exact(self):
+        """export_inflight pops live requests with their journals; a
+        sibling adopting the journal continues the stream exactly where
+        the source stopped (no duplicated/missing stream chunks)."""
+        model = _llama()
+        ref = self._alone(model)
+        src = ServingEngine(model, page_size=4, max_batch_slots=2)
+        chunks = []
+        rid = src.add_request(
+            _PROMPTS[0],
+            stream_cb=lambda r, tok, fin, seq: chunks.append((seq, tok)),
+            **self._SPEC)
+        src.step()  # prefill (token 0) + one decode (token 1)
+        src.step()  # token 2
+        journals = src.export_inflight()
+        assert [j.req_id for j in journals] == [rid]
+        assert journals[0].resume_tokens == ref[:3]
+        assert src.slots == [None, None]  # popped, pages freed
+        assert src.pool.used_pages == 0
+
+        dst = ServingEngine(model, page_size=4, max_batch_slots=2)
+        dst.adopt_request(journals[0])
+        out = dst.run()[rid]
+        assert list(out.token_ids) == ref
+        # exactly-once streaming across the hop: monotone seqs, no gap,
+        # no repeat; terminal chunk carries the total count
+        tok_chunks = [c for c in chunks if c[1] is not None]
+        assert [s for s, _ in tok_chunks] == list(range(8))
+        assert [t for _, t in tok_chunks] == ref
+        assert chunks[-1] == (8, None)
+
+    def test_out_of_int32_seed_is_canonicalized_not_crashing(self):
+        """The compiled decode step stages seeds as int32: a 64-bit seed
+        must canonicalize deterministically (low 32 bits) instead of
+        letting one user request crash the decode step with an
+        OverflowError — which, behind a Router, would cascade an
+        engine-killing request across the fleet via migration."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+        rid = eng.add_request(_PROMPTS[2], max_new_tokens=4,
+                              temperature=0.9, seed=2 ** 31)
+        out = eng.run()[rid]
+        assert out.finish_reason == "length" and out.n_gen == 4
+        # canonicalization is deterministic: same wide seed, same stream
+        eng2 = ServingEngine(model, page_size=4, max_batch_slots=1)
+        rid2 = eng2.add_request(_PROMPTS[2], max_new_tokens=4,
+                                temperature=0.9, seed=2 ** 31)
+        assert eng2.run()[rid2].token_ids == out.token_ids
+
+    def test_legacy_three_arg_stream_cb_keeps_working(self):
+        """The seq number threads only into callbacks that ask for it —
+        the PR 1 cb(req_id, token, finished) contract is untouched."""
+        eng = ServingEngine(_llama(), page_size=4, max_batch_slots=1)
+        seen = []
+        rid = eng.add_request(
+            _PROMPTS[2], max_new_tokens=3,
+            stream_cb=lambda r, tok, fin: seen.append((tok, fin)))
+        outs = eng.run()
+        assert [t for t, _ in seen[:-1]] == list(outs[rid].token_ids)
+        assert seen[-1] == (None, "length")
+
+    def test_defaulted_fourth_param_cb_stays_legacy(self):
+        """A legacy callback that happens to carry an unrelated
+        DEFAULTED 4th parameter must not start receiving the seq int in
+        it on upgrade; opting in takes *args, a required 4th positional,
+        or a parameter named `seq`."""
+        from paddle_tpu.serving.engine import _cb_accepts_seq
+
+        assert not _cb_accepts_seq(lambda r, t, f: None)
+        assert not _cb_accepts_seq(lambda r, t, f, logger=None: None)
+        assert _cb_accepts_seq(lambda r, t, f, seq: None)
+        assert _cb_accepts_seq(lambda r, t, f, seq=0: None)
+        assert _cb_accepts_seq(lambda *a: None)
+        eng = ServingEngine(_llama(), page_size=4, max_batch_slots=1)
+        seen = []
+        rid = eng.add_request(
+            _PROMPTS[2], max_new_tokens=2,
+            stream_cb=lambda r, t, f, logger="L": seen.append(logger))
+        assert eng.run()[rid].finish_reason == "length"
+        assert seen == ["L"] * 3  # default untouched: 2 tokens + terminal
+
+    def test_migrated_admission_does_not_pollute_queue_wait(self):
+        """A migrated request's SECOND admission must not observe
+        queue-wait from the original enqueue — that would fold its
+        decode time on the dead engine into the histogram operators
+        read during exactly these incidents (same guard as TTFT)."""
+        from paddle_tpu import metrics
+
+        model = _llama()
+        wait = metrics.get_registry().get(
+            "paddle_tpu_serving_queue_wait_seconds")
+        eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+        req = Request(prompt=_PROMPTS[2], max_new_tokens=4)
+        req.resume_tokens = [5]
+        before = wait.count
+        eng.adopt_request(req)
+        assert eng.run()[req.req_id].finish_reason == "length"
+        assert wait.count == before
+
+
 # ──────────────────────────── front door (api) ────────────────────────────
 
 
@@ -356,6 +502,20 @@ class TestCompletionAPI:
         ids0 = [c["choices"][0]["token_id"] for c in tok_chunks
                 if c["choices"][0]["index"] == 0]
         assert ids0 == resp["choices"][0]["token_ids"]
+
+    def test_stream_chunks_carry_monotone_seq(self):
+        """OpenAI-ish chunks expose the engine's per-request sequence
+        numbers so a client can verify exactly-once delivery across a
+        migration (token chunks: 0-based index; terminal: total)."""
+        engine = ServingEngine(_llama(), page_size=4, max_batch_slots=1)
+        api = CompletionAPI(engine)
+        chunks = []
+        api.create_completion(_PROMPTS[2], max_tokens=4,
+                              stream_cb=chunks.append)
+        seqs = [c["choices"][0]["seq"] for c in chunks
+                if c["choices"][0]["token_id"] is not None]
+        assert seqs == [0, 1, 2, 3]
+        assert chunks[-1]["choices"][0]["seq"] == 4  # terminal: count
 
     def test_batch_prevalidation_leaves_no_orphans(self):
         """One bad prompt in a batch must reject the WHOLE call before

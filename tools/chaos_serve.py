@@ -6,17 +6,23 @@ The operational twin of tests/test_faults.py + tests/test_router.py
 (docs/RESILIENCE.md): scenarios 1-6 arm ``paddle_tpu.faults`` injections
 against a tiny llama engine — NaN quarantine, page-pool exhaustion,
 compile-failure retry, deadline expiry + cancellation, queue
-backpressure, watchdog trip + ``/healthz`` — and scenarios 7-9 drill the
+backpressure, watchdog trip + ``/healthz`` — and scenarios 7-10 drill the
 ROUTER control plane: a NaN-poisoned + degraded engine fails its waiting
 work over to a sibling exactly once (no duplicates, no drops), a rolling
 ``reload()`` across live traffic completes every request and lands every
 engine on the new checkpoint's weights with the decode program still
-compiled exactly once per engine, and least-loaded dispatch beats blind
-round-robin on p95 queue wait under skewed load. Each scenario asserts
-both the behavior AND the telemetry (every failure path must move its
-counter). Exit code 0 iff every scenario passes.
+compiled exactly once per engine, least-loaded dispatch beats blind
+round-robin on p95 queue wait under skewed load, and a seeded
+kill-engine-mid-decode drill (scenario 10): the busiest engine dies at a
+scheduled step under sampled streaming traffic, ``router.step()``
+contains the crash, and every in-flight request MIGRATES by token
+journal — final streams bit-identical to an uninterrupted run, zero
+duplicated or missing stream chunks. Each scenario asserts both the
+behavior AND the telemetry (every failure path must move its counter).
+Exit code 0 iff every scenario passes.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_serve.py
+CI:  the whole ladder also runs as tests/test_chaos_serve.py (slow lane).
 """
 import json
 import os
@@ -345,6 +351,76 @@ def scenario_router_least_loaded(model):
             f"{mean_rr*1e3:.1f}ms -> {mean_ll*1e3:.1f}ms")
 
 
+def scenario_kill_engine_mid_decode(model):
+    """Scenario 10 (ISSUE 7 acceptance): N sampled streaming requests;
+    the busiest engine is killed at a scheduled step via the
+    router.engine_step fault point. router.step() must contain the
+    crash (mark down + migrate in-flight by token journal + requeue
+    waiting), and every request must complete token-identical to an
+    uninterrupted run with zero duplicated/missing stream chunks —
+    deterministic decode makes engine death invisible to tenants."""
+    specs = [(P5, 10, 0.9, 21), (P9, 9, 0.7, 22), (P3, 8, 1.1, 23)]
+    # uninterrupted reference: a lone engine, same (prompt, seed, temp)
+    # per request — per-request deterministic sampling makes this THE
+    # oracle for the migrated run regardless of batch composition
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s) for p, n, t, s in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(any(len(set(toks)) > 1 for toks in refs),
+           "reference run is not actually sampling")
+
+    r = Router()
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2)
+    e0 = r.engine("m/0")  # the busiest engine: ALL traffic lands here
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tok, fin, seq: chunks[i].append((seq, tok))
+
+    rids = [e0.add_request(p, max_new_tokens=n, temperature=t, seed=s,
+                           stream_cb=cb(i))
+            for i, (p, n, t, s) in enumerate(specs)]
+    crash0 = _counter("paddle_tpu_router_engine_crash_total",
+                      engine_id="m/0", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    req0 = _counter("paddle_tpu_router_requeued_total")
+    for _ in range(3):
+        r.step()  # 2 in-flight mid-decode, 1 waiting behind them
+    with faults.inject("router.engine_step",
+                       raise_=RuntimeError("engine killed mid-decode"),
+                       times=1, seed=SEED):
+        r.step()  # the scheduled kill — must NOT escape router.step()
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_engine_crash_total",
+                    engine_id="m/0", model_id="m") == crash0 + 1,
+           "crash counter != exactly 1")
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the 2 in-flight requests at the kill")
+    _check(_counter("paddle_tpu_router_requeued_total") == req0 + 1,
+           "requeue counter != the 1 waiting request at the kill")
+    for i, (rid, ref) in enumerate(zip(rids, refs)):
+        _check(outs[rid].finish_reason == "length",
+               f"request {i} did not complete ({outs[rid].finish_reason})")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the uninterrupted run")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([s for s, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+           "pages leaked")
+    return ("m/0 killed at step 4: 2 in-flight migrated + 1 waiting "
+            "requeued; 3 sampled streams bit-identical to the "
+            "uninterrupted run, chunks exactly-once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -355,6 +431,7 @@ SCENARIOS = [
     ("router-failover-requeue-once", scenario_router_failover),
     ("router-rolling-reload", scenario_router_reload),
     ("router-least-loaded-dispatch", scenario_router_least_loaded),
+    ("kill-engine-mid-decode", scenario_kill_engine_mid_decode),
 ]
 
 
