@@ -2,8 +2,11 @@
 
 The production decode path the ROADMAP's "millions of users" north star
 needs and ``GenerationMixin.generate`` (one static batch, dense caches)
-cannot provide: paged KV memory (kv_cache.py), FCFS token-budget
-admission (scheduler.py), a single compiled ragged-paged-attention decode
+cannot provide: paged KV memory with refcounted copy-on-write sharing
+and a radix prefix cache — shared prompt prefixes admit without
+re-prefilling (kv_cache.py, docs/SERVING.md "Prefix caching") — FCFS
+token-budget admission charging only each request's uncovered suffix
+(scheduler.py), a single compiled ragged-paged-attention decode
 step over fixed batch slots (engine.py + ops/pallas/paged_attention.py),
 an OpenAI-ish front door with streaming (api.py), and a fleet-scale
 control plane (router.py): least-loaded dispatch, health-gated
@@ -30,14 +33,15 @@ is runnable):
 """
 from .api import CompletionAPI, EnginePool
 from .engine import ServingEngine
-from .kv_cache import PagedKVCachePool, page_bytes, pages_for_hbm_budget
+from .kv_cache import (PagedKVCachePool, PrefixCache, page_bytes,
+                       pages_for_hbm_budget)
 from .router import EngineHandle, NoHealthyEngineError, Router
 from .scheduler import (BackpressureError, FCFSScheduler, Request,
                         RequestOutput)
 
 __all__ = [
-    "ServingEngine", "PagedKVCachePool", "FCFSScheduler", "Request",
-    "RequestOutput", "CompletionAPI", "EnginePool", "BackpressureError",
-    "Router", "EngineHandle", "NoHealthyEngineError",
+    "ServingEngine", "PagedKVCachePool", "PrefixCache", "FCFSScheduler",
+    "Request", "RequestOutput", "CompletionAPI", "EnginePool",
+    "BackpressureError", "Router", "EngineHandle", "NoHealthyEngineError",
     "page_bytes", "pages_for_hbm_budget",
 ]

@@ -81,7 +81,8 @@ class CompletionAPI:
                           seed: int = 0, echo: bool = False,
                           stream_cb: Optional[Callable] = None,
                           deadline_s: Optional[float] = None,
-                          model: Optional[str] = None) -> dict:
+                          model: Optional[str] = None,
+                          prefix_cache: bool = True) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
@@ -94,7 +95,11 @@ class CompletionAPI:
         ``model=`` selects the tenant on a Router backend (batch-mates
         stay on one engine so they continuous-batch together); unknown
         ids raise an actionable ValueError, a fully gated-out model
-        raises :class:`NoHealthyEngineError`."""
+        raises :class:`NoHealthyEngineError`. ``prefix_cache=False``
+        opts every choice of this call out of the engine's prompt
+        prefix cache (docs/SERVING.md "Prefix caching"): full prefill
+        from token 0, no page sharing — for prompts that must not be
+        indexed (privacy) or A/B-measuring the cache itself."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         try:
@@ -120,7 +125,8 @@ class CompletionAPI:
                 req_ids.append(engine.add_request(
                     p, max_new_tokens=max_tokens, temperature=temperature,
                     eos_token_id=stop_token_id, seed=seed + idx,
-                    stream_cb=cb, deadline_s=deadline_s))
+                    stream_cb=cb, deadline_s=deadline_s,
+                    prefix_cache=prefix_cache))
                 if handle is not None:
                     self.router._count_dispatch(handle)
         except Exception:

@@ -9,7 +9,9 @@ fixed grid of ``max_batch_slots`` decode slots; each engine step
 2. **prefills** each admitted prompt through the model's dense-cache path
    at a power-of-two padded bucket length (bounded prefill program count),
    scatters the prompt KV into the sequence's pages, and samples the
-   first token,
+   first token — a radix prefix-cache hit (docs/SERVING.md "Prefix
+   caching") adopts the cached prefix pages by refcount and prefills
+   only the uncovered suffix over the loaded prefix KV,
 3. runs ONE **compiled decode step** for every live slot at once — shapes
    padded to the slot grid, block tables and positions riding in as data —
    so XLA compiles the decode program exactly once no matter how the live
@@ -55,7 +57,7 @@ from .. import faults, jit, metrics
 from ..autograd.engine import no_grad
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
-from .kv_cache import PagedKVCachePool
+from .kv_cache import PagedKVCachePool, PrefixCache
 from .scheduler import FCFSScheduler, Request, RequestOutput
 
 __all__ = ["ServingEngine"]
@@ -123,7 +125,8 @@ class _SeqState:
     sibling engine on migration.
     """
 
-    __slots__ = ("req", "pos", "last_token", "gen", "t_last")
+    __slots__ = ("req", "pos", "last_token", "gen", "t_last",
+                 "inserted_nodes")
 
     def __init__(self, req: Request, pos: int, last_token: int):
         self.req = req
@@ -134,6 +137,10 @@ class _SeqState:
         # numbers and max_new_tokens accounting continue, not restart
         self.gen = [last_token]
         self.t_last = time.perf_counter()  # last token's landing time (ITL)
+        # prefix-cache nodes created FROM this request's prefill KV: if a
+        # NaN quarantine makes that KV suspect, these (and their
+        # subtrees) are evicted so the poison cannot serve a later match
+        self.inserted_nodes = []
 
 
 class ServingEngine:
@@ -157,7 +164,8 @@ class ServingEngine:
                  watchdog_stall_s: Optional[float] = 30.0,
                  watchdog_recovery_steps: int = 3,
                  engine_id: Optional[str] = None,
-                 model_id: str = "default"):
+                 model_id: str = "default",
+                 prefix_cache: bool = True):
         self.model = model
         model.eval()
         # identity labels: every per-engine serving series carries
@@ -183,6 +191,13 @@ class ServingEngine:
                                      n_kv, head_dim, dtype=kv_dtype,
                                      engine_id=self.engine_id,
                                      model_id=self.model_id)
+        # radix prefix cache over the pool (docs/SERVING.md "Prefix
+        # caching"): admission longest-prefix-matches cached prompt pages
+        # and ragged-prefills only the uncovered suffix. prefix_cache=
+        # False opts the whole engine out (every admission prefills from
+        # token 0, exactly the pre-cache behavior).
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool) if prefix_cache else None)
         self.scheduler = FCFSScheduler(self.max_batch_slots,
                                        prefill_token_budget,
                                        max_queue=max_queue,
@@ -205,7 +220,12 @@ class ServingEngine:
         # silently ignored
         self._active_prefill: Optional[_SeqState] = None
         self._decode_prog = None
-        self._prefill_progs: Dict[int, jit.StaticFunction] = {}
+        # prefill programs keyed (suffix_bucket, cache_bucket): cold
+        # admissions use (b, b) exactly as before; a prefix-cache hit
+        # adds (suffix_b, cache_b) pairs — O(log^2 max_len) programs,
+        # with cur_len riding as DATA so one program serves every
+        # matched length of the same geometry
+        self._prefill_progs: Dict[tuple, jit.StaticFunction] = {}
         # NO engine-global RNG: decode sampling keys derive per slot from
         # fold_in(PRNGKey(req.seed), position) INSIDE the compiled step,
         # so a request's token stream never depends on batch composition
@@ -339,18 +359,24 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens: int = 32,
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None, seed: int = 0,
-                    stream_cb=None, deadline_s: Optional[float] = None):
+                    stream_cb=None, deadline_s: Optional[float] = None,
+                    prefix_cache: bool = True):
         """Queue a request; returns its ``req_id``. Generation starts at
         the next :meth:`step` with capacity (continuous batching — no
         barrier on the current batch). ``deadline_s`` bounds the whole
         request from ENQUEUE (queue wait included): past it, the engine
         retires it with ``finish_reason="timeout"``. Raises
         :class:`~.scheduler.BackpressureError` (with a ``retry_after_s``
-        hint) when a bounded queue (``max_queue=``) is full."""
+        hint) when a bounded queue (``max_queue=``) is full.
+        ``prefix_cache=False`` opts THIS request out of prefix-cache
+        matching and insertion (it prefills from token 0 and shares no
+        pages) — the per-request escape hatch next to the engine-level
+        ``prefix_cache=`` constructor flag."""
         req = Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       eos_token_id=eos_token_id, seed=seed,
-                      stream_cb=stream_cb, deadline_s=deadline_s)
+                      stream_cb=stream_cb, deadline_s=deadline_s,
+                      prefix_cache=prefix_cache)
         self.check_request(req.prompt.size, req.max_new_tokens)
         try:
             self.scheduler.add(req)
@@ -690,13 +716,21 @@ class ServingEngine:
         (timeout / cancelled / nan / error): pages freed this call, slot
         cleared, tokens generated so far delivered."""
         req = st.req
+        if reason == "nan" and st.inserted_nodes and \
+                self.prefix_cache is not None:
+            # prefix nodes built FROM this request's (now suspect) KV
+            # must never serve another admission: evict them and any
+            # subtree grown on top; pages pinned by live sequences stay
+            # until those retire, and the release is scrub-marked
+            self.prefix_cache.evict_nodes(st.inserted_nodes)
         if self.pool.has_seq(req.req_id):
             # scrub=True for NaN: the pool zeroes each freed page lazily
             # on reuse — attention masks give padding lanes weight 0,
             # but IEEE 0 * NaN = NaN, so a poisoned page handed to the
             # next sequence would re-poison it through its masked tail.
             # Normal retires skip it: finite garbage IS annihilated by
-            # the 0 weights.
+            # the 0 weights. Pages a sibling or the cache still
+            # references defer (scrub-pending, zeroed at refcount zero).
             self.pool.free(req.req_id, scrub=(reason == "nan"))
         if slot is not None:
             self.slots[slot] = None
@@ -717,16 +751,25 @@ class ServingEngine:
         return finished
 
     # ------------------------------------------------------------- prefill
-    def _make_prefill(self, bucket: int) -> jit.StaticFunction:
+    def _make_prefill(self, bucket: int,
+                      cache_len: int) -> jit.StaticFunction:
+        """One (suffix-)prefill program: ``ids`` [1, bucket] are the
+        tokens to ACTUALLY run (the whole prompt when cold, only the
+        uncovered suffix on a prefix-cache hit), ``cur_len`` is the
+        count of cached-prefix tokens already loaded into the
+        ``cache_len``-long KV buffers (0 when cold — then this is
+        exactly the original full prefill), and ``last_pos`` indexes the
+        last REAL token within ``ids``. The trunk's cached path ropes at
+        absolute positions ``cur_len..`` and masks causally over the
+        whole buffer, so suffix tokens attend to the loaded prefix KV
+        precisely as a full prefill would."""
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
 
-        def prefill_fn(ids, last_pos, *flat_caches):
+        def prefill_fn(ids, last_pos, cur_len, *flat_caches):
             caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
                       for i in range(n_layers)]
             with no_grad():
-                hidden, ncs = trunk(ids, caches=caches,
-                                    cur_len=Tensor(jnp.zeros((), jnp.int32),
-                                                   stop_gradient=True))
+                hidden, ncs = trunk(ids, caches=caches, cur_len=cur_len)
                 # slice the last REAL position before the vocab matmul:
                 # the padded bucket tail never touches the [V] projection
                 last_h = apply_op(
@@ -770,20 +813,55 @@ class ServingEngine:
         else:
             ids_full = req.prompt
         s = int(ids_full.size)
-        bucket = _bucket(s, self.max_model_len)
-        prog = self._prefill_progs.get(bucket)
+        # longest-prefix match against the radix cache (full pages only,
+        # capped at s-1: the sample at position s-1 needs its logits
+        # computed here, so at least one token always prefills). A
+        # migrated request matches over prompt + journal — failover of
+        # prefix-heavy traffic re-prefills only what the sibling's cache
+        # doesn't already hold.
+        cache = self.prefix_cache if req.prefix_cache else None
+        if cache is not None:
+            matched, shared_pages, _nodes = cache.match(ids_full)
+        else:
+            matched, shared_pages = 0, []
+        ns = s - matched                   # tokens actually prefilled
+        bucket = _bucket(ns, self.max_model_len)
+        # KV buffer length: cold = the bucket itself (the original
+        # program, bit for bit); warm = next power of two covering
+        # prefix + padded suffix, so dynamic_update_slice at cur_len
+        # never clamps and rope tables cover every real position
+        cache_len = (bucket if matched == 0
+                     else 1 << (matched + bucket - 1).bit_length())
+        key = (bucket, cache_len)
+        prog = self._prefill_progs.get(key)
         if prog is None:
-            prog = self._prefill_progs[bucket] = self._compile_with_retry(
+            prog = self._prefill_progs[key] = self._compile_with_retry(
                 "serving.compile_prefill",
-                lambda: self._make_prefill(bucket))
+                lambda: self._make_prefill(bucket, cache_len))
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :s] = ids_full
+        ids[0, :ns] = ids_full[matched:]
         n_kv, hd = self.pool.n_kv_heads, self.pool.head_dim
-        flat = [Tensor(jnp.zeros((1, bucket, n_kv, hd), self.pool.dtype),
-                       stop_gradient=True)
-                for _ in range(2 * self.n_layers)]
+        if matched:
+            # load the cached prefix KV (already rope'd at its absolute
+            # positions when first written) into rows 0..matched-1
+            prefix_kv = self.pool.gather_kv_range(shared_pages, matched)
+            flat = []
+            for k, v in prefix_kv:
+                kb = jnp.zeros((1, cache_len, n_kv, hd), self.pool.dtype)
+                vb = jnp.zeros((1, cache_len, n_kv, hd), self.pool.dtype)
+                flat.append(Tensor(
+                    kb.at[0, :matched].set(k.astype(self.pool.dtype)),
+                    stop_gradient=True))
+                flat.append(Tensor(
+                    vb.at[0, :matched].set(v.astype(self.pool.dtype)),
+                    stop_gradient=True))
+        else:
+            flat = [Tensor(jnp.zeros((1, cache_len, n_kv, hd),
+                                     self.pool.dtype), stop_gradient=True)
+                    for _ in range(2 * self.n_layers)]
         res = prog(Tensor(jnp.asarray(ids)),
-                   Tensor(jnp.asarray(s - 1, jnp.int32)), *flat)
+                   Tensor(jnp.asarray(ns - 1, jnp.int32)),
+                   Tensor(jnp.asarray(matched, jnp.int32)), *flat)
         last, fin, flat_kv = res[0], res[1], res[2:]
         if not bool(np.asarray(fin._value).reshape(())):
             # NaN/inf logits straight out of prefill: quarantine before
@@ -792,16 +870,30 @@ class ServingEngine:
             # already-streamed journal still delivers)
             return self._emit_terminal(req, journal, "nan")
 
+        # matched pages join the table by refcount (no free-list draw,
+        # bumped before any fresh page is taken so eviction can't race
+        # the adoption); only the suffix KV is scattered
         self.pool.allocate(req.req_id, s,
-                           max_total_tokens=req.max_total_tokens)
+                           max_total_tokens=req.max_total_tokens,
+                           prefix_pages=shared_pages,
+                           prefix_tokens=matched)
         self.pool.write_prompt_kv(req.req_id, [
-            (flat_kv[2 * i]._value[0, :s], flat_kv[2 * i + 1]._value[0, :s])
-            for i in range(self.n_layers)])
+            (flat_kv[2 * i]._value[0, matched:matched + ns],
+             flat_kv[2 * i + 1]._value[0, matched:matched + ns])
+            for i in range(self.n_layers)], start=matched)
 
         tok = int(np.asarray(self._sample_one(
             last._value, req.temperature, self._sample_key(req.seed,
                                                            s - 1))))
         state = _SeqState(req, pos=s, last_token=tok)
+        if cache is not None:
+            # index this prompt's full pages for the next admission
+            # (prompt only — generated suffixes are per-request noise);
+            # the created nodes ride the slot state so a NaN quarantine
+            # can evict exactly what THIS request contributed
+            state.inserted_nodes = cache.insert(
+                req.prompt, int(req.prompt.size),
+                self.pool.block_table(req.req_id))
         if journal:
             state.gen = journal + [tok]  # seq numbers/limits continue
         now = time.perf_counter()

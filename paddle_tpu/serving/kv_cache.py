@@ -13,6 +13,19 @@ slots carry all-zero tables, so their (masked) KV writes land harmlessly
 there instead of corrupting a live sequence. The allocator hands out pages
 1..num_pages-1.
 
+Sharing is REFCOUNTED and copy-on-write: ``fork`` shares every page of
+the source (full and partial tail alike) by bumping refcounts, and the
+first divergent append into a shared page copies it lazily
+(:meth:`extend`'s write guard) — the sibling's bytes are never mutated.
+:class:`PrefixCache` builds on the same refcounts: a per-engine radix
+index keyed on token ids maps cached prompt prefixes to page lists, so a
+request sharing a system prompt adopts the cached pages at admission and
+ragged-prefills only its uncovered suffix (docs/SERVING.md "Prefix
+caching"). Cache-resident pages that no live sequence references are
+RECLAIMABLE: they never cause an allocation failure — ``_take_page``
+evicts LRU cache nodes under pool pressure — and they are excluded from
+``used_pages`` (which counts pages live sequences pin).
+
 Allocation is LAZY (a page is taken from the free list only when a token
 actually lands in it) but admission is accounted against each sequence's
 worst case via ``reserve`` — the scheduler admits a request only if the
@@ -40,7 +53,8 @@ faults.declare_point(
     "PagedKVCachePool._take_page, before a page leaves the free list — "
     "arm ResourceExhausted here to drill pool-exhaustion handling")
 
-__all__ = ["PagedKVCachePool", "page_bytes", "pages_for_hbm_budget"]
+__all__ = ["PagedKVCachePool", "PrefixCache", "page_bytes",
+           "pages_for_hbm_budget"]
 
 
 def page_bytes(page_size: int, n_kv_heads: int, head_dim: int,
@@ -106,6 +120,15 @@ class PagedKVCachePool:
         # must never enter a new block table un-scrubbed. Lazy keeps the
         # quarantine itself O(1): no full-pool rewrite per retirement.
         self._dirty: set = set()
+        # refcount-aware deferred scrub (docs/RESILIENCE.md "Quarantine x
+        # refcounts"): a quarantined victim's free(scrub=True) must NOT
+        # zero a page a sibling fork / the prefix cache still reads —
+        # such pages are only MARKED here, and the mark converts to a
+        # real scrub when the LAST reference drops (whoever drops it),
+        # so a suspect page can never re-enter circulation un-scrubbed.
+        self._scrub_pending: set = set()
+        # optional per-engine prefix cache; PrefixCache attaches itself
+        self.prefix_cache: Optional["PrefixCache"] = None
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
         self._resv: Dict[object, int] = {}
@@ -141,7 +164,18 @@ class PagedKVCachePool:
 
     @property
     def used_pages(self) -> int:
-        return self.usable_pages - len(self._free)
+        """Pages pinned by LIVE sequences. Cache-resident pages no
+        sequence references are excluded: they are reclaimable on demand
+        (evict-then-retry in :meth:`_take_page`), so counting them as
+        used would make a warm cache read as pressure it isn't."""
+        return (self.usable_pages - len(self._free)
+                - self._reclaimable_pages())
+
+    def _reclaimable_pages(self) -> int:
+        """Pages held ONLY by the prefix cache — evictable the moment an
+        allocation needs them."""
+        return (self.prefix_cache.reclaimable_pages()
+                if self.prefix_cache is not None else 0)
 
     def utilization(self) -> float:
         return self.used_pages / max(self.usable_pages, 1)
@@ -156,20 +190,40 @@ class PagedKVCachePool:
                    for s, r in self._resv.items())
 
     def can_admit(self, max_total_tokens: int,
-                  pending_pages: int = 0) -> bool:
+                  pending_pages: int = 0, cached_pages: int = 0,
+                  pending_cached: int = 0) -> bool:
         """True when the pool can cover a new sequence's WORST CASE
         (``max_total_tokens`` = prompt + max_new_tokens) on top of every
         live sequence's outstanding reservation — the no-preemption
         admission guarantee. ``pending_pages`` charges pages promised to
         requests admitted earlier in the same scheduler step, whose
-        reservations are not recorded here until their prefill runs."""
-        need = self.pages_needed(max_total_tokens)
-        return (need + int(pending_pages)
-                <= len(self._free) - self._unallocated_reserved())
+        reservations are not recorded here until their prefill runs.
+        ``cached_pages`` discounts pages the prefix cache already holds
+        for this request's prompt (they join its table by refcount, not
+        by a free-list draw). Matched pages must ALSO leave the
+        reclaimable side: the moment the request adopts them their
+        refcount pins them, so counting them both as "not needed" and as
+        "evictable for someone else" would double-count and overcommit —
+        the victim being some LIVE sequence's reserved tail.
+        ``pending_cached`` extends the same exclusion to pages matched
+        by earlier same-step admissions (conservative when two
+        batch-mates match the SAME pages: under-admission just waits a
+        step; overcommit kills a tenant)."""
+        need = self.pages_needed(max_total_tokens) - int(cached_pages)
+        reclaim = max(self._reclaimable_pages() - int(cached_pages)
+                      - int(pending_cached), 0)
+        avail = len(self._free) + reclaim - self._unallocated_reserved()
+        return need + int(pending_pages) <= avail
 
     # ---------------------------------------------------------- allocation
     def _take_page(self) -> int:
         faults.point("serving.kv_alloc")
+        # cache-never-starves-tenants: under pool pressure, evict LRU
+        # unreferenced prefix-cache nodes until a page frees — the cache
+        # must never turn a coverable allocation into a failure
+        while not self._free and self.prefix_cache is not None:
+            if not self.prefix_cache.evict_one():
+                break
         if not self._free:
             raise RuntimeError(
                 "KV page pool exhausted — admission accounting should have "
@@ -199,17 +253,35 @@ class PagedKVCachePool:
         return p
 
     def allocate(self, seq_id, n_tokens: int,
-                 max_total_tokens: Optional[int] = None) -> List[int]:
+                 max_total_tokens: Optional[int] = None,
+                 prefix_pages: Sequence[int] = (),
+                 prefix_tokens: int = 0) -> List[int]:
         """Create a sequence holding ``n_tokens`` of KV (the prompt), with
         a worst-case reservation of ``max_total_tokens`` (defaults to
-        ``n_tokens``). Returns the block table."""
+        ``n_tokens``). Returns the block table.
+
+        ``prefix_pages``/``prefix_tokens`` seed the table with SHARED
+        pages (a prefix-cache hit): each is adopted by refcount — no
+        free-list draw, no KV copy — and the prefix refs are bumped
+        BEFORE any fresh page is taken, so a mid-allocate eviction can
+        never reclaim the very pages this sequence is adopting. Rollback
+        (:meth:`free`) drops shared and fresh pages uniformly."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
+        if prefix_tokens and int(prefix_tokens) % self.page_size:
+            raise ValueError(
+                f"prefix_tokens {prefix_tokens} must be page-aligned "
+                f"(page_size={self.page_size}) — prefix sharing is "
+                f"full-page granular")
         resv = self.pages_needed(max_total_tokens
                                  if max_total_tokens is not None
                                  else n_tokens)
-        self._tables[seq_id] = []
-        self._lens[seq_id] = 0
+        table: List[int] = []
+        for p in prefix_pages:
+            self._ref[p] += 1
+            table.append(p)
+        self._tables[seq_id] = table
+        self._lens[seq_id] = int(prefix_tokens)
         self._resv[seq_id] = resv
         try:
             self.extend(seq_id, n_tokens)
@@ -219,63 +291,110 @@ class PagedKVCachePool:
             # roll back pages already taken and the bookkeeping entries
             self.free(seq_id)
             raise
+        self.peak_used = max(self.peak_used, self.used_pages)
         return list(self._tables[seq_id])
 
     def extend(self, seq_id, total_tokens: int) -> None:
-        """Grow ``seq_id``'s table to cover ``total_tokens`` of KV."""
+        """Grow ``seq_id``'s table to cover ``total_tokens`` of KV, and
+        guarantee the LAST slot (the one about to be written) lives in a
+        page this sequence owns exclusively — the copy-on-write seam: a
+        fork/prefix-share diverging into a shared page copies it here,
+        first, so the sibling's (and the cache's) bytes are immutable."""
         table = self._tables[seq_id]
         need = self.pages_needed(total_tokens)
         while len(table) < need:
             table.append(self._take_page())
         self._lens[seq_id] = max(self._lens[seq_id], int(total_tokens))
+        self._ensure_writable(seq_id, int(total_tokens) - 1)
+
+    def _ensure_writable(self, seq_id, token_pos: int) -> None:
+        """Copy-on-write: if the page holding ``token_pos`` is shared
+        (refcount > 1 — a fork sibling or the prefix cache also holds
+        it), copy its contents into a fresh page and swap the block-table
+        entry, leaving the shared original untouched."""
+        if token_pos < 0:
+            return
+        table = self._tables[seq_id]
+        pi = token_pos // self.page_size
+        old = table[pi]
+        if self._ref[old] <= 1:
+            return
+        fresh = self._take_page()
+        for li in range(self.num_layers):
+            kp = self.k_pools[li]._value
+            vp = self.v_pools[li]._value
+            self.k_pools[li] = Tensor(kp.at[fresh].set(kp[old]),
+                                      stop_gradient=True)
+            self.v_pools[li] = Tensor(vp.at[fresh].set(vp[old]),
+                                      stop_gradient=True)
+        table[pi] = fresh
+        # the shared original loses OUR reference only (cannot hit zero:
+        # ref was > 1); scrub state, if any, stays with the original
+        self._ref[old] -= 1
+        self._m_page_events.labels(event="cow", **self._lbl).inc()
+        self.peak_used = max(self.peak_used, self.used_pages)
+        self._refresh_gauges()
 
     def append_token(self, seq_id) -> None:
         """Make room for one more token (the engine calls this right before
         the decode step writes position ``seq_len``)."""
         self.extend(seq_id, self._lens[seq_id] + 1)
 
+    def _release_ref(self, p: int, scrub: bool = False) -> bool:
+        """Drop ONE reference on page ``p`` (the single choreography every
+        release path — sequence retirement, cache eviction — goes
+        through, so scrub semantics cannot drift between them). Returns
+        True when the page actually hit the free list.
+
+        Refcount-aware scrub: a ``scrub=True`` release while siblings
+        still hold the page must neither zero it now (a healthy tenant
+        is reading those bytes) nor forget it — the page is marked
+        scrub-pending, and WHOEVER drops the last reference (even a
+        normal ``scrub=False`` retirement, even a cache eviction)
+        converts the mark into a real lazy scrub before reuse."""
+        self._ref[p] -= 1
+        if self._ref[p] > 0:
+            if scrub:
+                self._scrub_pending.add(p)
+            return False
+        self._free.append(p)
+        if scrub or p in self._scrub_pending:
+            self._dirty.add(p)
+        self._scrub_pending.discard(p)
+        self._m_page_events.labels(event="free", **self._lbl).inc()
+        return True
+
     def free(self, seq_id, scrub: bool = False) -> None:
         """Retire a sequence NOW: drop refcounts, return exclusive pages to
         the free list (immediate reuse — the continuous-batching payoff).
         ``scrub=True`` (NaN quarantine) marks the freed pages dirty so
-        :meth:`_take_page` zeroes each one lazily on reuse."""
+        :meth:`_take_page` zeroes each one lazily on reuse; pages a fork
+        sibling or the prefix cache still references are deferred via
+        :meth:`_release_ref` — scrubbed only at refcount zero."""
         table = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
         self._resv.pop(seq_id, None)
         for p in table:
-            self._ref[p] -= 1
-            if self._ref[p] == 0:
-                self._free.append(p)
-                if scrub:
-                    self._dirty.add(p)
-                self._m_page_events.labels(event="free", **self._lbl).inc()
+            self._release_ref(p, scrub=scrub)
         self._refresh_gauges()
 
     def fork(self, src_id, dst_id, max_total_tokens: Optional[int] = None
              ) -> List[int]:
-        """Fork ``src_id`` into ``dst_id`` sharing all FULL pages by
-        refcount (they are append-only once full, so sharing is free); the
-        partial tail page is copied into a fresh page so the two branches
-        can diverge. The substrate for prefix caching / parallel sampling."""
+        """Fork ``src_id`` into ``dst_id`` sharing EVERY page by refcount
+        — full pages and the partial tail alike. Nothing is copied at
+        fork time: the first divergent append into the shared tail
+        triggers copy-on-write (:meth:`extend`'s write guard), so a fork
+        that never diverges (parallel scoring, n-best over a shared
+        prompt) costs zero KV bytes. The substrate for prefix caching /
+        parallel sampling."""
         if dst_id in self._tables:
             raise ValueError(f"sequence {dst_id!r} already allocated")
         src = self._tables[src_id]
         n = self._lens[src_id]
-        full = n // self.page_size  # pages completely written
         table: List[int] = []
-        for p in src[:full]:
+        for p in src:
             self._ref[p] += 1
             table.append(p)
-        if full < len(src):  # copy the partial tail
-            tail = self._take_page()
-            for i in range(self.num_layers):
-                kv = self.k_pools[i]._value
-                vv = self.v_pools[i]._value
-                self.k_pools[i] = Tensor(
-                    kv.at[tail].set(kv[src[full]]), stop_gradient=True)
-                self.v_pools[i] = Tensor(
-                    vv.at[tail].set(vv[src[full]]), stop_gradient=True)
-            table.append(tail)
         self._tables[dst_id] = table
         self._lens[dst_id] = n
         self._resv[dst_id] = self.pages_needed(
@@ -283,24 +402,41 @@ class PagedKVCachePool:
         self.peak_used = max(self.peak_used, self.used_pages)
         return list(table)
 
-    def _slot_coords(self, seq_id, n_tokens: int):
-        """(page_ids, offs) device coords of a sequence's first
-        ``n_tokens`` KV slots — THE block-table indexing math, shared by
-        every pool-rewrite path so it cannot drift between them."""
+    def _slot_coords(self, seq_id, n_tokens: int, start: int = 0):
+        """(page_ids, offs) device coords of a sequence's KV slots
+        ``start .. start+n_tokens-1`` — THE block-table indexing math,
+        shared by every pool-rewrite path so it cannot drift between
+        them."""
         table = np.asarray(self._tables[seq_id], np.int32)
-        idx = np.arange(int(n_tokens))
+        idx = np.arange(int(start), int(start) + int(n_tokens))
         return (jnp.asarray(table[idx // self.page_size]),
                 jnp.asarray(idx % self.page_size))
 
     def poison_seq(self, seq_id, value: float = float("nan")) -> int:
         """Chaos helper (tests/test_faults.py, tools/chaos_serve.py):
-        overwrite every WRITTEN KV slot of one sequence with ``value``
-        (default NaN), all layers, K and V. Because attention gathers
-        strictly through block tables, the poison stays confined to this
-        sequence — the engine's NaN quarantine must retire it while its
-        batch-mates decode on untouched. Returns slots poisoned."""
+        overwrite every EXCLUSIVELY-OWNED written KV slot of one sequence
+        with ``value`` (default NaN), all layers, K and V. Shared pages
+        (refcount > 1 — a fork sibling or the prefix cache holds them)
+        are skipped: attention gathers shared bytes for REAL, so
+        poisoning them would corrupt healthy tenants — a different drill
+        than "this one sequence's KV went bad". Raises if the sequence
+        has no exclusive written slots (the drill would silently no-op).
+        Returns slots poisoned."""
         n = int(self._lens[seq_id])
-        page_ids, offs = self._slot_coords(seq_id, n)
+        table = self._tables[seq_id]
+        idx = np.arange(n)
+        excl = self._ref[np.asarray(table, np.int32)[
+            idx // self.page_size]] == 1
+        idx = idx[excl]
+        if idx.size == 0:
+            raise ValueError(
+                f"poison_seq({seq_id!r}): every written page is shared "
+                f"(fork sibling or prefix cache holds a reference) — "
+                f"poisoning would corrupt healthy tenants; poison a "
+                f"sequence with exclusive pages instead")
+        page_ids = jnp.asarray(
+            np.asarray(table, np.int32)[idx // self.page_size])
+        offs = jnp.asarray(idx % self.page_size)
         for li in range(self.num_layers):
             kp = self.k_pools[li]._value
             vp = self.v_pools[li]._value
@@ -310,7 +446,7 @@ class PagedKVCachePool:
             self.v_pools[li] = Tensor(
                 vp.at[page_ids, offs].set(jnp.asarray(value, vp.dtype)),
                 stop_gradient=True)
-        return n
+        return int(idx.size)
 
     # ------------------------------------------------------------- queries
     def has_seq(self, seq_id) -> bool:
@@ -337,6 +473,12 @@ class PagedKVCachePool:
             out[i, :len(t)] = t
         return out
 
+    # ---------------------------------------------------------- cache hooks
+    def attach_prefix_cache(self, cache: "PrefixCache") -> None:
+        if self.prefix_cache is not None and self.prefix_cache is not cache:
+            raise ValueError("pool already has a prefix cache attached")
+        self.prefix_cache = cache
+
     # ------------------------------------------------------- device arrays
     def set_arrays(self, k_arrays, v_arrays) -> None:
         """Swap in the pools a compiled decode step returned (functional
@@ -348,13 +490,17 @@ class PagedKVCachePool:
                         else Tensor(t, stop_gradient=True)
                         for t in v_arrays]
 
-    def write_prompt_kv(self, seq_id, layer_kv) -> None:
+    def write_prompt_kv(self, seq_id, layer_kv, start: int = 0) -> None:
         """Prefill's KV write hook: scatter a dense prompt cache into this
-        sequence's pages. ``layer_kv`` is a per-layer list of (k, v) arrays
-        ``[S, n_kv_heads, head_dim]`` (S = true prompt length; any padded
-        prefill tail must already be sliced off)."""
+        sequence's pages at positions ``start .. start+S-1``. ``layer_kv``
+        is a per-layer list of (k, v) arrays ``[S, n_kv_heads, head_dim]``
+        (S = true token count; any padded prefill tail must already be
+        sliced off). ``start`` > 0 is the prefix-cache suffix scatter:
+        matched (shared) pages cover 0..start-1 and are never written —
+        match granularity is full pages, so the suffix begins on a page
+        this sequence owns."""
         s = int(layer_kv[0][0].shape[0])
-        page_ids, offs = self._slot_coords(seq_id, s)
+        page_ids, offs = self._slot_coords(seq_id, s, start=start)
         for li, (k, v) in enumerate(layer_kv):
             kp = self.k_pools[li]._value
             vp = self.v_pools[li]._value
@@ -364,3 +510,269 @@ class PagedKVCachePool:
             self.v_pools[li] = Tensor(
                 vp.at[page_ids, offs].set(
                     jnp.asarray(v).astype(vp.dtype)), stop_gradient=True)
+
+    def gather_kv_range(self, page_ids: Sequence[int], n_tokens: int):
+        """Read ``n_tokens`` of KV back out through a page list: per-layer
+        list of (k, v) arrays ``[n_tokens, n_kv_heads, head_dim]`` — the
+        prefix-cache hit path loads these into the suffix prefill's dense
+        cache buffers (positions 0..n_tokens-1, already rope'd exactly as
+        the original prefill wrote them)."""
+        table = np.asarray(page_ids, np.int32)
+        idx = np.arange(int(n_tokens))
+        pages = jnp.asarray(table[idx // self.page_size])
+        offs = jnp.asarray(idx % self.page_size)
+        out = []
+        for li in range(self.num_layers):
+            out.append((self.k_pools[li]._value[pages, offs],
+                        self.v_pools[li]._value[pages, offs]))
+        return out
+
+    def prefix_match_len(self, token_ids) -> int:
+        """Read-only probe of the attached prefix cache (0 without one):
+        tokens a live admission would adopt instead of prefilling — the
+        scheduler charges its prefill budget with only the uncovered
+        suffix (docs/SERVING.md "Prefix caching")."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.probe(token_ids)
+
+
+class _PrefixNode:
+    """One radix-tree edge = one FULL page of tokens. The path from the
+    root to a node spells a token prefix (page_size tokens per hop); the
+    node holds the page id whose KV covers that path's last page — KV at
+    any position depends on every token before it (causal attention), so
+    a page is reusable exactly when the WHOLE prefix matches, which is
+    what keying each hop by its page's token bytes enforces."""
+
+    __slots__ = ("key", "page", "parent", "children", "last_used",
+                 "detached")
+
+    def __init__(self, key: bytes, page: int, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[bytes, "_PrefixNode"] = {}
+        self.last_used = 0
+        self.detached = False
+
+
+class PrefixCache:
+    """Per-engine radix index over cached prompt prefixes → page lists.
+
+    Built entirely on the pool's refcounts: every resident node holds ONE
+    reference on its page, a live sequence that matched the node holds its
+    own (via its block table), so a page is reclaimable exactly when the
+    cache's reference is the last one. Admission calls :meth:`match` for
+    the longest cached prefix (full-page granular, capped one token short
+    of the prompt so there is always a suffix to prefill — the sample at
+    position s-1 needs its logits computed), adopts the matched pages by
+    refcount, ragged-prefills only the uncovered suffix, and
+    :meth:`insert`\\ s its own full prompt pages for the next request.
+
+    Eviction is LRU over unreferenced nodes, leaf-first (a pinned
+    descendant pins nothing here: a sequence that matched a deep node
+    holds refs on every page along the path, so an unpinned node's whole
+    subtree is unpinned). The pool drives it from ``_take_page`` under
+    pressure — the cache can never turn a coverable allocation into a
+    failure — and the engine drives :meth:`evict_nodes` when a NaN
+    quarantine makes a just-inserted prefix suspect.
+
+    Telemetry ({engine_id, model_id} from the owning pool):
+    ``paddle_tpu_serving_prefix_{hits,misses}_total``,
+    ``paddle_tpu_serving_prefill_tokens_saved_total``,
+    ``paddle_tpu_serving_prefix_cached_pages`` gauge,
+    ``paddle_tpu_serving_prefix_evictions_total``.
+    """
+
+    def __init__(self, pool: PagedKVCachePool):
+        self.pool = pool
+        pool.attach_prefix_cache(self)
+        self.page_size = pool.page_size
+        self._root = _PrefixNode(b"", 0, None)
+        # id-keyed for O(1) removal on eviction (a warm cache evicts on
+        # the allocation hot path); _page_arr caches the resident page
+        # ids for the vectorized reclaimable count, rebuilt lazily only
+        # when the node set changes
+        self._nodes: Dict[int, _PrefixNode] = {}
+        self._page_arr: Optional[np.ndarray] = None
+        self._clock = 0
+        reg = metrics.get_registry()
+        _eng = ("engine_id", "model_id")
+        lbl = pool._lbl
+        self._m_hits = reg.counter(
+            "paddle_tpu_serving_prefix_hits_total",
+            "Admissions that matched a cached prefix and prefilled only "
+            "their uncovered suffix", labels=_eng).labels(**lbl)
+        self._m_misses = reg.counter(
+            "paddle_tpu_serving_prefix_misses_total",
+            "Admissions that found no cached prefix (full prefill)",
+            labels=_eng).labels(**lbl)
+        self._m_saved = reg.counter(
+            "paddle_tpu_serving_prefill_tokens_saved_total",
+            "Prompt tokens NOT prefilled because a cached prefix covered "
+            "them (the prefix-cache capacity win)",
+            labels=_eng).labels(**lbl)
+        self._m_pages = reg.gauge(
+            "paddle_tpu_serving_prefix_cached_pages",
+            "KV pages currently resident in the prefix cache (shared "
+            "pages pinned by live sequences included)",
+            labels=_eng).labels(**lbl)
+        self._m_evictions = reg.counter(
+            "paddle_tpu_serving_prefix_evictions_total",
+            "Cache nodes evicted (LRU under pool pressure, or quarantine "
+            "of a suspect prefix)", labels=_eng).labels(**lbl)
+        self._m_pages.set(0)
+
+    # -------------------------------------------------------------- queries
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def reclaimable_pages(self) -> int:
+        """Resident pages no live sequence references (pool refcount is
+        exactly the cache's own) — what eviction can hand back. O(cache)
+        per call; bounded by pool size."""
+        if not self._nodes:
+            return 0
+        if self._page_arr is None:
+            self._page_arr = np.fromiter(
+                (n.page for n in self._nodes.values()), np.int32,
+                len(self._nodes))
+        return int(np.count_nonzero(self.pool._ref[self._page_arr] == 1))
+
+    def _walk(self, ids: np.ndarray, touch: bool):
+        """Longest-prefix walk: full pages only, capped at len(ids)-1
+        tokens (at least one token must remain to prefill — its logits
+        produce the first sample). Returns the node path."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        max_pages = max(int(ids.size) - 1, 0) // self.page_size
+        path: List[_PrefixNode] = []
+        cur = self._root
+        for i in range(max_pages):
+            key = ids[i * self.page_size:(i + 1) * self.page_size].tobytes()
+            node = cur.children.get(key)
+            if node is None:
+                break
+            path.append(node)
+            cur = node
+        if touch and path:
+            self._clock += 1
+            for n in path:
+                n.last_used = self._clock
+        return path
+
+    def probe(self, ids) -> int:
+        """Read-only match length in tokens (no LRU touch, no counters) —
+        the scheduler's budget-honesty probe."""
+        return len(self._walk(ids, touch=False)) * self.page_size
+
+    def match(self, ids):
+        """Longest cached prefix for ``ids``: (matched_tokens,
+        page_ids, nodes). Touches LRU and moves the hit/miss counters;
+        the caller adopts the pages by refcount via
+        ``pool.allocate(..., prefix_pages=..., prefix_tokens=...)``."""
+        path = self._walk(ids, touch=True)
+        if not path:
+            self._m_misses.inc()
+            return 0, [], []
+        self._m_hits.inc()
+        matched = len(path) * self.page_size
+        self._m_saved.inc(matched)
+        return matched, [n.page for n in path], path
+
+    # ------------------------------------------------------------ mutation
+    def insert(self, ids, n_tokens: int, table: Sequence[int]
+               ) -> List[_PrefixNode]:
+        """Index every FULL page of ``ids[:n_tokens]`` (a just-prefilled
+        prompt), taking one cache reference per NEWLY created node on the
+        sequence's own page from ``table``. Pages whose prefix is already
+        cached keep the existing node (and its page — the newcomer's
+        private copy retires with it). Returns the nodes created here, in
+        shallow-to-deep order (the engine journals them for quarantine
+        eviction)."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        n_full = min(int(n_tokens), int(ids.size)) // self.page_size
+        created: List[_PrefixNode] = []
+        cur = self._root
+        self._clock += 1
+        for i in range(n_full):
+            key = ids[i * self.page_size:(i + 1) * self.page_size].tobytes()
+            node = cur.children.get(key)
+            if node is None:
+                node = _PrefixNode(key, int(table[i]), cur)
+                cur.children[key] = node
+                self.pool._ref[node.page] += 1
+                self._nodes[id(node)] = node
+                self._page_arr = None
+                created.append(node)
+            node.last_used = self._clock
+            cur = node
+        if created:
+            self._m_pages.set(len(self._nodes))
+            self.pool._refresh_gauges()
+        return created
+
+    def _detach(self, node: _PrefixNode, scrub: bool = False) -> bool:
+        """Remove one childless node from the index and release the
+        cache's page reference. Returns True when the page hit the free
+        list (it may stay allocated: a live sequence still holds it)."""
+        if node.detached:
+            return False
+        assert not node.children, "evicting a node with children"
+        node.detached = True
+        node.parent.children.pop(node.key, None)
+        self._nodes.pop(id(node), None)
+        self._page_arr = None
+        freed = self.pool._release_ref(node.page, scrub=scrub)
+        self._m_evictions.inc()
+        self._m_pages.set(len(self._nodes))
+        return freed
+
+    def evict_one(self) -> bool:
+        """LRU eviction step for ``_take_page`` under pool pressure:
+        drop the least-recently-used unreferenced LEAF (leaf-first keeps
+        the index consistent; an unpinned node's subtree is always
+        unpinned, see class docstring). Returns True when a page was
+        actually returned to the free list."""
+        best: Optional[_PrefixNode] = None
+        for n in self._nodes.values():
+            if n.children or self.pool._ref[n.page] != 1:
+                continue
+            if best is None or n.last_used < best.last_used:
+                best = n
+        if best is None:
+            return False
+        freed = self._detach(best)
+        self.pool._refresh_gauges()
+        return freed
+
+    def evict_nodes(self, nodes: Sequence[_PrefixNode]) -> None:
+        """Quarantine eviction (engine's NaN path): drop these nodes AND
+        their subtrees from the index — prefixes inserted from a
+        poisoned request's KV, plus anything built on top of them, must
+        never serve another admission. Pages pinned by live sequences
+        stay allocated until those retire; the release is scrub-marked
+        so a suspect page is zeroed before any reuse."""
+        for node in nodes:
+            self._evict_subtree(node, scrub=True)
+        self.pool._refresh_gauges()
+
+    def clear(self) -> int:
+        """Flush the whole index (returns nodes evicted). REQUIRED after
+        a weight change (``Router.reload``): cached KV was computed
+        under the old weights, so a warm hit would mix stale prefix KV
+        with new-weight suffix compute — silently wrong outputs. No
+        scrub: stale-but-finite bytes are annihilated by attention masks
+        like any retired page's."""
+        n = len(self._nodes)
+        for child in list(self._root.children.values()):
+            self._evict_subtree(child, scrub=False)
+        self.pool._refresh_gauges()
+        return n
+
+    def _evict_subtree(self, node: _PrefixNode, scrub: bool) -> None:
+        if node.detached:
+            return
+        for child in list(node.children.values()):
+            self._evict_subtree(child, scrub)
+        self._detach(node, scrub=scrub)

@@ -701,6 +701,13 @@ class Router:
                     f"checkpoint is missing {len(missing)} model keys "
                     f"(first: {missing[:3]}); refusing a partial weight "
                     f"load on engine {h.engine_id}")
+            if h.engine.prefix_cache is not None:
+                # the radix cache holds KV computed under the OLD
+                # weights: a warm hit after the push would mix stale
+                # prefix KV with new-weight suffix compute — flush it
+                # (pages return to the pool; the cache re-warms from
+                # post-reload traffic)
+                h.engine.prefix_cache.clear()
             canary_ok, reason = self._warm(h, warm_prompt)
         except Exception:
             # restore itself failed (shape mismatch, corrupt leaf): the

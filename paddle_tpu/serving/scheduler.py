@@ -68,6 +68,11 @@ class Request:
     # seconds from enqueue until the engine retires the request with
     # finish_reason="timeout" (queued or mid-decode); None = no deadline
     deadline_s: Optional[float] = None
+    # False opts this request out of prefix-cache matching AND insertion
+    # (docs/SERVING.md "Prefix caching"): it prefills from token 0 and
+    # shares no pages — the per-request escape hatch under the
+    # engine-level ServingEngine(prefix_cache=) flag
+    prefix_cache: bool = True
     # resume journal (docs/RESILIENCE.md "In-flight migration"): tokens
     # this request already generated on an engine that died. Set by
     # ServingEngine.export_inflight; an adopting engine re-prefills
@@ -117,6 +122,16 @@ class Request:
         re-prefill covers prompt + tokens-so-far) — what the scheduler's
         per-step prefill budget must charge."""
         return int(self.prompt.size) + len(self.resume_tokens or ())
+
+    def admission_ids(self) -> np.ndarray:
+        """The token ids an admitting engine will prefill: prompt, plus
+        the journal for a migrated request — what the prefix cache is
+        matched against (engine and scheduler probe the SAME ids, so the
+        budget charge and the actual match cannot drift)."""
+        if not self.resume_tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.resume_tokens, np.int32)])
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -268,18 +283,32 @@ class FCFSScheduler:
         # pages promised to THIS step's earlier admissions: the pool only
         # records a reservation at prefill (after admit returns), so
         # can_admit must be charged for batch-mates or two big requests
-        # admitted together could jointly over-commit the pool
+        # admitted together could jointly over-commit the pool.
+        # pending_cached tracks cache pages those admissions will PIN —
+        # they must stop counting as reclaimable for later batch-mates
         pending_pages = 0
+        pending_cached = 0
         while self.waiting and free_slots > 0:
             req = self.waiting[0]
-            # prefill_tokens, not prompt.size: a migrated request's
-            # ragged re-prefill covers prompt + journaled tokens, and the
-            # budget exists to bound prefill COMPUTE this step
-            if req.prefill_tokens > budget and admitted:
+            # prefill-cost honesty: the budget exists to bound prefill
+            # COMPUTE this step, so charge only what will actually run —
+            # prompt + journal (a migrated request's ragged re-prefill)
+            # MINUS the cached prefix the engine's radix cache already
+            # covers (the probe walks the same index the prefill will
+            # match, floor 1: the last token always prefills). Matched
+            # pages likewise don't draw from the free list, so admission
+            # discounts them from the page charge too.
+            matched = (pool.prefix_match_len(req.admission_ids())
+                       if req.prefix_cache else 0)
+            cost = max(req.prefill_tokens - matched, 1)
+            cached_pages = matched // pool.page_size
+            if cost > budget and admitted:
                 break  # budget spent this step; FCFS head keeps its turn
             # (an over-budget prompt with no batch-mates still runs, alone
             # this step, or it would starve forever)
-            if not pool.can_admit(req.max_total_tokens, pending_pages):
+            if not pool.can_admit(req.max_total_tokens, pending_pages,
+                                  cached_pages=cached_pages,
+                                  pending_cached=pending_cached):
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
             self._pending_steps -= 1 + req.remaining_new_tokens
@@ -294,9 +323,11 @@ class FCFSScheduler:
                 # operators read it for (same skew guard as TTFT)
                 self._m_queue_wait.observe(
                     time.perf_counter() - req.arrival_t)
-            pending_pages += pool.pages_needed(req.max_total_tokens)
+            pending_pages += (pool.pages_needed(req.max_total_tokens)
+                              - cached_pages)
+            pending_cached += cached_pages
             free_slots -= 1
-            budget -= req.prefill_tokens
+            budget -= cost
             if budget <= 0:
                 break
         return admitted

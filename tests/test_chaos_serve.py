@@ -1,7 +1,9 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-10 — engine resilience, router failover/reload/dispatch, and the
-kill-engine-mid-decode migration drill) runs as slow-marked tests instead
-of only by hand, one test per scenario so a regression names its drill.
+1-11 — engine resilience, router failover/reload/dispatch, the
+kill-engine-mid-decode migration drill, and the prefix-heavy failover
+drill that asserts migrated requests re-prefill through the adoptive
+sibling's prefix cache) runs as slow-marked tests instead of only by
+hand, one test per scenario so a regression names its drill.
 
 The scenarios are imported from the tool itself — one source of truth;
 this file adds only pytest plumbing (module load, shared model, fault

@@ -513,6 +513,94 @@ class TestServingChaos:
                 == trips_before + 1)
 
 
+# ───────────────── refcount-aware scrub (ISSUE 8 satellite) ─────────────────
+
+
+class TestRefcountAwareScrub:
+    """A quarantined victim must never scrub pages a healthy sibling
+    (fork or prefix cache) still reads — the scrub defers until the LAST
+    reference drops, then converts to a real lazy zero before reuse; and
+    ``poison_seq`` refuses to poison shared pages outright (attention
+    reads shared bytes for real — poisoning them is a different drill)."""
+
+    def _pool(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving import PagedKVCachePool as P
+
+        pool = P(num_layers=1, num_pages=9, page_size=4, n_kv_heads=2,
+                 head_dim=8)
+        k = jnp.full((9, 4, 2, 8), 7.0, jnp.float32)
+        pool.set_arrays([k], [k + 1.0])
+        return pool
+
+    def test_scrub_defers_while_sibling_holds_reference(self):
+        pool = self._pool()
+        pool.allocate("src", 6)
+        table = pool.block_table("src")
+        pool.fork("src", "dst")  # every page shared (ref 2)
+        pool.free("src", scrub=True)  # quarantine while dst still reads
+        # nothing freed, nothing zeroed: the sibling's bytes are intact
+        assert pool.used_pages == 2
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pools[0]._value[np.asarray(table)]), 7.0)
+        # last reference drops via a NORMAL free — the deferred mark
+        # must still convert: the pages are zeroed before reuse
+        pool.free("dst")
+        assert pool.used_pages == 0
+        t2 = pool.allocate("new", 8)
+        assert set(table) <= set(t2)  # LIFO free list: same pages reused
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pools[0]._value[np.asarray(table)]), 0.0)
+
+    def test_poison_seq_refuses_shared_pages_poisons_exclusive(self):
+        pool = self._pool()
+        pool.allocate("src", 6)
+        src_table = pool.block_table("src")
+        pool.fork("src", "dst")
+        with pytest.raises(ValueError, match="shared"):
+            pool.poison_seq("src")  # every page shared: would corrupt dst
+        pool.extend("dst", 7)  # divergent append -> CoW private tail
+        n = pool.poison_seq("dst")
+        assert n == 3  # only the private tail's written slots (4..6)
+        # src's pages (including the once-shared tail) stay finite
+        src_k = np.asarray(pool.k_pools[0]._value[np.asarray(src_table)])
+        assert np.isfinite(src_k).all()
+
+    def test_nan_quarantine_evicts_suspect_prefix_nodes(self):
+        """Prefix nodes inserted FROM a poisoned request's prefill must
+        stop serving matches (quarantine x refcount seam): the victim's
+        prompt re-runs as a MISS afterward, while a healthy tenant's
+        cached prefix keeps hitting."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        lbl = dict(engine_id=eng.engine_id, model_id=eng.model_id)
+        # DISTINCT prompts (the module _PROMPTS all share a seed-7 prefix
+        # and would legitimately keep matching each other's first page)
+        healthy_p = np.random.RandomState(50).randint(0, 128, (5,))
+        victim_p = np.random.RandomState(99).randint(0, 128, (9,))
+        eng.add_request(healthy_p, max_new_tokens=2)  # healthy prefix
+        eng.run()
+        victim = eng.add_request(victim_p, max_new_tokens=8)
+        eng.step()
+        with faults.inject("serving.decode_step",
+                           call=lambda: eng.pool.poison_seq(victim),
+                           times=1):
+            outs = eng.run()
+        assert outs[victim].finish_reason == "nan"
+        h0 = _counter("paddle_tpu_serving_prefix_hits_total", **lbl)
+        m0 = _counter("paddle_tpu_serving_prefix_misses_total", **lbl)
+        eng.add_request(victim_p, max_new_tokens=2)  # victim's prompt
+        eng.run()
+        assert _counter("paddle_tpu_serving_prefix_misses_total",
+                        **lbl) == m0 + 1  # suspect prefix evicted
+        eng.add_request(healthy_p, max_new_tokens=2)  # healthy prompt
+        eng.run()
+        assert _counter("paddle_tpu_serving_prefix_hits_total",
+                        **lbl) == h0 + 1  # healthy prefix still serves
+        assert eng.pool.used_pages == 0
+
+
 # ──────────────────────── front-door satellites ────────────────────────
 
 

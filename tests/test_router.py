@@ -606,6 +606,37 @@ class TestReload:
         assert (_counter("paddle_tpu_router_reloads_total", result="ok")
                 == ok_before + 2)
 
+    def test_reload_flushes_stale_prefix_cache(self, tmp_path):
+        """The radix prefix cache holds KV computed under the OLD
+        weights: reload() must flush it, or a post-push warm hit would
+        mix stale prefix KV with new-weight suffix compute — the same
+        prompt after the push must match a fresh engine running the
+        checkpoint's weights with no cache at all."""
+        self._ckpt(tmp_path)
+        r = Router()
+        r.add_model("m", _model(0), replicas=1, page_size=4,
+                    max_batch_slots=1)
+        eng = r.engine("m/0")
+        prompt = np.concatenate([P5, P4, P3])  # 12 tokens: 3 full pages
+        rid = r.submit(prompt, model="m", max_new_tokens=4,
+                       temperature=0.9, seed=3)
+        r.run()
+        assert len(eng.prefix_cache) > 0  # old-weight KV is indexed
+        r.reload(str(tmp_path))
+        assert len(eng.prefix_cache) == 0  # flushed with the weights
+        # oracle: cache-off engine on the checkpoint's weights
+        from paddle_tpu.serving import ServingEngine
+
+        oracle = ServingEngine(_model(1), page_size=4, max_batch_slots=1,
+                               prefix_cache=False)
+        want_id = oracle.add_request(prompt, max_new_tokens=4,
+                                     temperature=0.9, seed=3)
+        want = list(oracle.run()[want_id].token_ids)
+        rid2 = r.submit(prompt, model="m", max_new_tokens=4,
+                        temperature=0.9, seed=3)
+        got = list(r.run()[rid2].token_ids)
+        assert got == want and rid2 != rid
+
     def test_reload_requires_model_on_multi_tenant_router(self, tmp_path):
         """A checkpoint belongs to one architecture: reload() without
         model= must refuse on a multi-model router instead of pushing the

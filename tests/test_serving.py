@@ -121,7 +121,11 @@ class TestPagedKVCachePool:
         with pytest.raises(RuntimeError):
             pool.allocate("b", 4)
 
-    def test_fork_shares_full_pages_and_copies_tail(self):
+    def test_fork_shares_everything_and_copies_on_divergent_append(self):
+        """fork() shares EVERY page (full + partial tail) by refcount;
+        nothing copies until a branch appends into the shared tail —
+        then copy-on-write swaps in a private copy and the sibling's
+        bytes are untouched."""
         import jax.numpy as jnp
 
         pool = self._pool()
@@ -130,11 +134,20 @@ class TestPagedKVCachePool:
         pool.set_arrays([k], [k + 1000.0])
         src_table = pool.block_table("src")
         dst_table = pool.fork("src", "dst")
-        assert dst_table[0] == src_table[0]       # full page shared
-        assert dst_table[1] != src_table[1]       # tail copied
+        assert dst_table == src_table             # zero-copy fork
+        assert pool.used_pages == 2               # no extra page yet
+        src_tail_before = np.asarray(pool.k_pools[0]._value[src_table[1]])
+        pool.extend("dst", 7)  # dst diverges: append into the shared tail
+        dst_after = pool.block_table("dst")
+        assert dst_after[0] == src_table[0]       # full page still shared
+        assert dst_after[1] != src_table[1]       # tail CoW'd
+        # the copy carries the shared bytes; the sibling's are untouched
         np.testing.assert_array_equal(
-            np.asarray(pool.k_pools[0]._value[dst_table[1]]),
-            np.asarray(pool.k_pools[0]._value[src_table[1]]))
+            np.asarray(pool.k_pools[0]._value[dst_after[1]]),
+            src_tail_before)
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pools[0]._value[src_table[1]]),
+            src_tail_before)
         pool.free("src")  # shared page must survive the src retirement
         assert pool.has_seq("dst")
         used_after = pool.used_pages
@@ -464,6 +477,232 @@ class TestDeterministicSampling:
         eng.adopt_request(req)
         assert eng.run()[req.req_id].finish_reason == "length"
         assert wait.count == before
+
+
+# ──────────────── prefix caching (ISSUE 8 tentpole) ────────────────
+
+
+class TestPrefixCache:
+    """Copy-on-write prefix caching over the paged pool: a request
+    sharing a cached prompt prefix adopts the cached pages at admission
+    and ragged-prefills only its uncovered suffix — with warm streams
+    BIT-IDENTICAL to cold ones (the determinism contract survives the
+    optimization), sibling pages immutable under divergence, and LRU
+    eviction under pool pressure invisible to in-flight requests."""
+
+    _PREFIX = np.random.RandomState(21).randint(0, 128, (24,))
+
+    def _prompt(self, *suffix):
+        return np.concatenate([self._PREFIX,
+                               np.asarray(suffix, np.int32)])
+
+    @staticmethod
+    def _counter(name, eng):
+        fam = __import__("paddle_tpu").metrics.get_registry().get(name)
+        if fam is None:
+            return 0.0
+        return fam.labels(engine_id=eng.engine_id,
+                          model_id=eng.model_id).value
+
+    @staticmethod
+    def _run_one(eng, prompt, **spec):
+        rid = eng.add_request(prompt, **spec)
+        return list(eng.run()[rid].token_ids)
+
+    def test_warm_streams_bit_identical_and_counters(self):
+        """Property (1): warm-cache streams equal cold-prefill streams
+        at temperature>0 — same prompt AND shared-prefix-new-suffix —
+        while hits/misses/saved counters move exactly once per event and
+        decode stays at one compile."""
+        model = _llama()
+        off = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            prefix_cache=False)
+        spec = dict(max_new_tokens=8, temperature=0.9, seed=13)
+        pa, pb = self._prompt(1, 2, 3, 4, 5), self._prompt(9, 9)
+        ref_a = self._run_one(off, pa, **spec)
+        ref_b = self._run_one(off, pb, **spec)
+        assert len(set(ref_a)) > 1  # sanity: actually sampling
+
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        h0 = self._counter("paddle_tpu_serving_prefix_hits_total", eng)
+        m0 = self._counter("paddle_tpu_serving_prefix_misses_total", eng)
+        s0 = self._counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                           eng)
+        cold = self._run_one(eng, pa, **spec)
+        assert cold == ref_a  # cold through the unified program: same
+        assert self._counter(
+            "paddle_tpu_serving_prefix_misses_total", eng) == m0 + 1
+        warm_same = self._run_one(eng, pa, **spec)
+        assert warm_same == ref_a  # full-prompt hit (capped at s-1)
+        warm_diverged = self._run_one(eng, pb, **spec)
+        assert warm_diverged == ref_b  # shared 24-token prefix, new tail
+        assert self._counter(
+            "paddle_tpu_serving_prefix_hits_total", eng) == h0 + 2
+        # pa is 29 tokens: the identical re-run saves 28 (7 full pages,
+        # capped one short of the prompt); pb (26 tokens) shares the
+        # 24-token prefix = 6 pages
+        assert self._counter(
+            "paddle_tpu_serving_prefill_tokens_saved_total",
+            eng) == s0 + 28 + 24
+        assert eng.compile_counts()["decode"] == 1
+        assert eng.pool.used_pages == 0  # cache pages are not "used"
+        assert len(eng.prefix_cache) > 0
+
+    def test_cow_divergence_never_mutates_shared_pages(self):
+        """Property (2): decoding a request that adopted cached pages —
+        and a second one diverging right after the shared prefix — never
+        changes a byte of the shared pages (checksummed before/after)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        spec = dict(max_new_tokens=8, temperature=0.7, seed=5)
+        eng.run()  # no-op; keep shapes warm
+        eng.add_request(self._prompt(1, 2, 3), **spec)
+        eng.run()  # prefix now cached
+        matched, pages, _ = eng.prefix_cache.match(self._prompt(7, 7, 7))
+        assert matched == 24 and len(pages) == 6
+        def page_bytes_snapshot():
+            return [np.asarray(eng.pool.k_pools[li]._value[np.asarray(pages)])
+                    .copy() for li in range(eng.n_layers)]
+        before = page_bytes_snapshot()
+        r1 = eng.add_request(self._prompt(7, 7, 7), **spec)
+        r2 = eng.add_request(self._prompt(8, 8, 8, 8), **spec)
+        outs = eng.run()
+        assert outs[r1].n_gen == 8 and outs[r2].n_gen == 8
+        after = page_bytes_snapshot()
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, a)
+        assert eng.pool.used_pages == 0
+
+    def test_eviction_under_pressure_never_breaks_inflight(self):
+        """Property (3): a full pool evicts LRU cache nodes instead of
+        failing allocation, and an in-flight request decodes through the
+        eviction storm token-identical to a cache-off run."""
+        model = _llama()
+        rng = np.random.RandomState(31)
+        inflight_p = rng.randint(0, 128, (6,))
+        late_p = rng.randint(0, 128, (12,))
+        off = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            prefix_cache=False)
+        spec = dict(max_new_tokens=10, temperature=0.8, seed=3)
+        ref = self._run_one(off, inflight_p, **spec)
+
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            num_pages=12)  # 11 usable
+        for i in range(3):  # fill the cache: 3 x 2 full pages resident
+            eng.add_request(rng.randint(0, 128, (8,)), max_new_tokens=2)
+        eng.run()
+        assert len(eng.prefix_cache) == 6
+        ev0 = self._counter("paddle_tpu_serving_prefix_evictions_total",
+                            eng)
+        rid = eng.add_request(inflight_p, **spec)
+        eng.step()  # in-flight mid-decode, pinning its pages
+        late = eng.add_request(late_p, max_new_tokens=8)
+        outs = eng.run()
+        assert list(outs[rid].token_ids) == ref
+        assert outs[late].finish_reason == "length"
+        assert self._counter(
+            "paddle_tpu_serving_prefix_evictions_total", eng) > ev0
+        assert eng.pool.used_pages == 0
+
+    def test_can_admit_does_not_double_count_matched_pages(self):
+        """Admission regression: a request's matched prefix pages are
+        about to be PINNED by its own adoption, so they must not be
+        discounted from its need AND still counted as reclaimable —
+        that double-count admitted work whose fresh draws would starve
+        a live sequence's reserved tail mid-decode."""
+        from paddle_tpu.serving import PrefixCache
+
+        pool = PagedKVCachePool(num_layers=1, num_pages=11, page_size=4,
+                                n_kv_heads=2, head_dim=8)  # 10 usable
+        cache = PrefixCache(pool)
+        ids = np.arange(1, 18, dtype=np.int32)  # 17 tokens: 4 full pages
+        cache.insert(ids, 17, pool.allocate("warm", 17))
+        pool.free("warm")  # 4 pages stay cache-resident, 6 free
+        pool.allocate("live", 8, max_total_tokens=16)  # 2 now, 2 promised
+        assert pool.prefix_match_len(ids) == 16  # 4 pages would be adopted
+        # worst case 8 pages, 4 matched -> 4 fresh draws; truly spare:
+        # 4 free minus the live tail's 2 promised = 2 -> must NOT admit
+        # (the matched pages stop being evictable the moment they're
+        # adopted, so they cannot also serve as the eviction reserve)
+        assert not pool.can_admit(32, cached_pages=4)
+        # sanity: a cold 24-token request needs 6 fresh and CAN admit —
+        # the 4 unpinned cache pages genuinely evict for it
+        assert pool.can_admit(24)
+
+    def test_opt_out_flags(self):
+        """Engine-level prefix_cache=False builds no cache; the
+        per-request flag skips match AND insert for that request only."""
+        model = _llama()
+        off = ServingEngine(model, page_size=4, max_batch_slots=1,
+                            prefix_cache=False)
+        assert off.prefix_cache is None
+        off.add_request(self._prompt(1), max_new_tokens=2)
+        off.run()
+
+        eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+        h0 = self._counter("paddle_tpu_serving_prefix_hits_total", eng)
+        m0 = self._counter("paddle_tpu_serving_prefix_misses_total", eng)
+        eng.add_request(self._prompt(1), max_new_tokens=2,
+                        prefix_cache=False)
+        eng.run()
+        assert len(eng.prefix_cache) == 0  # nothing indexed
+        assert self._counter(
+            "paddle_tpu_serving_prefix_hits_total", eng) == h0
+        assert self._counter(
+            "paddle_tpu_serving_prefix_misses_total", eng) == m0
+
+    def test_scheduler_budget_charges_only_uncovered_suffix(self):
+        """prefill_tokens honesty: a warm prompt charges the per-step
+        prefill budget only its uncovered suffix, so it continuous-
+        batches alongside work a cold charge would have deferred."""
+        model = _llama()
+
+        def drive(warm):
+            eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                                prefill_token_budget=10)
+            if warm:
+                eng.add_request(self._PREFIX, max_new_tokens=1)
+                eng.run()  # cache the 24-token prefix (5 full pages used)
+            eng.add_request(np.arange(1, 6), max_new_tokens=4)  # cost 5
+            eng.add_request(self._PREFIX, max_new_tokens=4)
+            eng.step()
+            return eng.stats["running_seqs"]
+
+        # cold: 5 + 24 blows the 10-token budget -> the 24-token prompt
+        # waits a step; warm: 5 + (24 - 20 matched) = 9 fits -> admitted
+        # together
+        assert drive(warm=False) == 1
+        assert drive(warm=True) == 2
+
+    def test_migration_reprefill_rides_the_cache(self):
+        """A journaled request adopted by an engine whose cache holds the
+        prefix re-prefills only the uncovered tail (saved counter moves)
+        and continues the stream token-identically — failover of
+        prefix-heavy traffic is cheap (docs/RESILIENCE.md)."""
+        model = _llama()
+        spec = dict(max_new_tokens=8, temperature=0.9, seed=13)
+        prompt = self._prompt(2, 4, 6)
+        off = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            prefix_cache=False)
+        ref = self._run_one(off, prompt, **spec)
+
+        src = ServingEngine(model, page_size=4, max_batch_slots=2)
+        rid = src.add_request(prompt, **spec)
+        src.step()
+        src.step()  # 3 tokens generated
+        [journal] = src.export_inflight()
+        assert journal.resume_tokens == ref[:3]
+
+        dst = ServingEngine(model, page_size=4, max_batch_slots=2)
+        dst.add_request(prompt, max_new_tokens=1)  # prefix-heavy sibling
+        dst.run()
+        s0 = self._counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                           dst)
+        dst.adopt_request(journal)
+        out = dst.run()[rid]
+        assert list(out.token_ids) == ref
+        assert self._counter(
+            "paddle_tpu_serving_prefill_tokens_saved_total", dst) > s0
 
 
 # ──────────────────────────── front door (api) ────────────────────────────

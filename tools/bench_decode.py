@@ -12,6 +12,13 @@ engine tokens/s vs this dense loop at batch {1, 8, 32}, one JSON row per
 (mode, batch) in the same record shape as the dense rows
 (``*_paged_decode_tokens_per_sec_per_chip`` vs
 ``*_decode_tokens_per_sec_per_chip``).
+
+``--shared-prefix``: prefix-cache scenario (ISSUE 8) — N requests
+(BENCH_SHARED_N, default 100) sharing a BENCH_SHARED_PREFIX-token
+(default 1024) common prefix with unique 16-token suffixes. Reports
+``prefill_tokens_saved_total`` (expect ~(N-1) x prefix), cold-vs-warm
+prefill wall time, TTFT p50/p95, a bit-identity check of a warm stream
+against a cache-off cold run, and the decode compile count (must stay 1).
 """
 import json
 import os
@@ -190,6 +197,137 @@ def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
         f.write(json.dumps(rec) + "\n")
 
 
+def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
+    """Prefix-cache proof: N requests over one shared prefix. The first
+    request prefills the whole prompt (cold, and seeds the radix cache);
+    every later one matches the cached prefix pages and prefills only
+    its 16-token unique suffix — the saved-tokens counter and the
+    cold/warm wall-clock ratio are the row's payload."""
+    import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    n_req = int(os.environ.get("BENCH_SHARED_N", "6" if small else "100"))
+    if small:
+        prefix_len = min(prefix_len, 48)
+    suffix = 16
+    metric = f"{model_name}_shared_prefix_prefill_tokens_saved"
+    cfg_tag = f"-shared-prefix-b{n_req}-p{prefix_len}-n{new}-greedy"
+    if not small:
+        from _bench_timing import iter_notes_rows
+        if any(rec.get("metric") == metric
+               and rec.get("device") in ("tpu", "axon")
+               and str(rec.get("config", "")).endswith(cfg_tag)
+               for rec in iter_notes_rows(_NOTES)):
+            print(f"shared-prefix[{model_name}]: b{n_req}-p{prefix_len}-"
+                  f"n{new} already banked this round — skipping",
+                  file=sys.stderr)
+            return
+    model, vocab, label = _build(model_name, prefix_len + suffix, new,
+                                 small)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, vocab, (prefix_len,))
+    prompts = [np.concatenate([prefix, rng.integers(0, vocab, (suffix,))])
+               for _ in range(n_req)]
+
+    # bit-identity oracle: one prompt end-to-end on a CACHE-OFF engine
+    off = ServingEngine(model, page_size=16, max_batch_slots=2,
+                        prefill_token_budget=prefix_len + suffix,
+                        prefix_cache=False)
+    ref_id = off.add_request(prompts[1], max_new_tokens=new,
+                             temperature=0.8, seed=11)
+    ref = list(off.run()[ref_id].token_ids)
+
+    engine = ServingEngine(model, page_size=16,
+                           max_batch_slots=min(n_req, 8),
+                           prefill_token_budget=prefix_len + suffix)
+    # compile pass: one cold + one warm request builds the full-prefill
+    # AND suffix-prefill programs plus the single decode program, so the
+    # measured section below times serving, not XLA
+    wid = engine.add_request(prompts[0], max_new_tokens=1)
+    engine.run()
+    engine.add_request(prompts[1], max_new_tokens=1)
+    engine.run()
+    del wid
+
+    reg = metrics.get_registry()
+
+    def saved():
+        fam = reg.get("paddle_tpu_serving_prefill_tokens_saved_total")
+        return 0.0 if fam is None else fam.value
+
+    # cold measurement on the SAME engine via the per-request opt-out
+    # (programs already compiled; prefix_cache=False forces the full
+    # prefill a pre-cache engine would run) — apples-to-apples against
+    # the warm sweep below
+    t0 = time.perf_counter()
+    engine.add_request(prompts[0], max_new_tokens=new,
+                       prefix_cache=False)
+    engine.run()
+    cold_s = time.perf_counter() - t0 - rt
+
+    # isolate the measured warm section: reset zeroes every series
+    # (families and label children stay registered), THEN snapshot the
+    # compile counter so extra_jit_compiles counts only warm-sweep builds
+    metrics.get_registry().reset()
+    jit0 = _counter_value("paddle_tpu_jit_compiles_total",
+                          fn="serving_decode")
+    s0 = saved()
+    warm_tokens = {}
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        warm_tokens[engine.add_request(
+            p, max_new_tokens=new, temperature=0.8, seed=11 if i == 1
+            else i)] = i
+    outs = engine.run()
+    warm_s = time.perf_counter() - t0 - rt
+    tokens_saved = saved() - s0
+    warm_ref_id = next(r for r, i in warm_tokens.items() if i == 1)
+    warm_equals_cold = list(outs[warm_ref_id].token_ids) == ref
+
+    h = reg.get("paddle_tpu_serving_ttft_seconds")
+    ttft = ({f"p{int(q * 100)}": round(h.quantile(q) * 1e3, 3)
+             for q in (0.5, 0.95)} if h is not None and h.count else {})
+    rec = {
+        "metric": metric,
+        "value": round(tokens_saved, 1), "unit": "tokens",
+        "vs_baseline": 1.0,
+        "config": label + cfg_tag,
+        "requests": n_req, "prefix_len": prefix_len,
+        "expected_saved": (n_req - 1) * (prefix_len // 16) * 16,
+        "cold_run_s": round(cold_s, 3),
+        "warm_total_s": round(warm_s, 3),
+        "warm_per_req_s": round(warm_s / max(n_req, 1), 4),
+        "warm_equals_cold": bool(warm_equals_cold),
+        "decode_compiles": engine.compile_counts()["decode"],
+        "extra_jit_compiles": _counter_value(
+            "paddle_tpu_jit_compiles_total", fn="serving_decode") - jit0,
+        "ttft_ms": ttft,
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if not warm_equals_cold:
+        raise AssertionError(
+            "warm-cache stream diverged from the cache-off cold run")
+    if rec["extra_jit_compiles"]:
+        raise AssertionError("decode recompiled during the warm sweep")
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _counter_value(name, **labels):
+    from paddle_tpu import metrics
+
+    fam = metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
 def main():
     from _bench_timing import probe_or_exit, roundtrip_baseline
 
@@ -220,6 +358,21 @@ def main():
         sys.exit(2)
     rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
     failures = 0
+    if "--shared-prefix" in sys.argv:
+        # prefix-cache scenario (rides --paged's engine machinery): N
+        # requests x one shared prefix; geometry via BENCH_SHARED_N /
+        # BENCH_SHARED_PREFIX
+        shared_prefix = int(os.environ.get("BENCH_SHARED_PREFIX", "1024"))
+        for name in models:
+            try:
+                _bench_shared_prefix(name, rt, shared_prefix, new, dev,
+                                     small)
+            except Exception as e:
+                failures += 1
+                print(f"shared-prefix[{name}]: {type(e).__name__}: "
+                      f"{str(e)[:160]}", file=sys.stderr)
+        if "--paged" not in sys.argv:
+            sys.exit(1 if failures else 0)
     if "--paged" in sys.argv:
         # engine-vs-dense sweep: one dense and one paged row per batch
         batches = [int(b) for b in os.environ.get(

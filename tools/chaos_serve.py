@@ -17,9 +17,12 @@ kill-engine-mid-decode drill (scenario 10): the busiest engine dies at a
 scheduled step under sampled streaming traffic, ``router.step()``
 contains the crash, and every in-flight request MIGRATES by token
 journal — final streams bit-identical to an uninterrupted run, zero
-duplicated or missing stream chunks. Each scenario asserts both the
-behavior AND the telemetry (every failure path must move its counter).
-Exit code 0 iff every scenario passes.
+duplicated or missing stream chunks. Scenario 11 re-runs the kill drill
+under PREFIX-HEAVY traffic: migrated requests must re-prefill through the
+adoptive sibling's radix prefix cache (``prefill_tokens_saved_total``
+rises there), still bit-identical and exactly-once. Each scenario asserts
+both the behavior AND the telemetry (every failure path must move its
+counter). Exit code 0 iff every scenario passes.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_serve.py
 CI:  the whole ladder also runs as tests/test_chaos_serve.py (slow lane).
@@ -421,6 +424,91 @@ def scenario_kill_engine_mid_decode(model):
             "uninterrupted run, chunks exactly-once")
 
 
+def scenario_prefix_cache_failover(model):
+    """Scenario 11 (ISSUE 8): prefix-heavy streaming traffic — every
+    request shares a 24-token system prefix, both engines' radix caches
+    hold it, and the busiest engine dies mid-decode. The migrated
+    requests must re-prefill THROUGH the sibling's prefix cache
+    (prefill_tokens_saved_total rises on the adoptive engine — failover
+    of prefix-heavy traffic re-runs only the uncovered tail), with final
+    streams bit-identical to an uninterrupted run and stream chunks
+    exactly-once."""
+    rng = np.random.RandomState(17)
+    prefix = rng.randint(0, 128, (24,))
+    suffixes = [rng.randint(0, 128, (k,)) for k in (3, 5, 2)]
+    specs = [(np.concatenate([prefix, sfx]), n, t, s)
+             for sfx, (n, t, s) in zip(suffixes, ((10, 0.9, 31),
+                                                  (9, 0.7, 32),
+                                                  (8, 1.1, 33)))]
+    # uninterrupted oracle on a CACHE-LESS lone engine: deterministic
+    # sampling makes it THE reference for cold, warm, and migrated runs
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            prefix_cache=False)
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s) for p, n, t, s in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(any(len(set(toks)) > 1 for toks in refs),
+           "reference run is not actually sampling")
+
+    r = Router()
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2)
+    # prefix-heavy fleet: the shared system prefix is warm on BOTH
+    # engines (as it would be under routed traffic)
+    for eid in ("m/0", "m/1"):
+        e = r.engine(eid)
+        e.add_request(np.concatenate([prefix, np.asarray([1])]),
+                      max_new_tokens=1)
+        e.run()
+    e0, e1 = r.engine("m/0"), r.engine("m/1")
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tok, fin, seq: chunks[i].append((seq, tok))
+
+    rids = [e0.add_request(p, max_new_tokens=n, temperature=t, seed=s,
+                           stream_cb=cb(i))
+            for i, (p, n, t, s) in enumerate(specs)]
+    saved1_0 = _counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                        engine_id="m/1", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    for _ in range(3):
+        r.step()  # 2 in-flight mid-decode, 1 waiting behind them
+    with faults.inject("router.engine_step",
+                       raise_=RuntimeError("engine killed mid-decode"),
+                       times=1, seed=SEED):
+        r.step()  # the scheduled kill
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the 2 in-flight requests at the kill")
+    saved1 = _counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                      engine_id="m/1", model_id="m")
+    # each adopted request matches the sibling's cached 24-token prefix
+    # (6 full pages); the waiting one requeues and matches too
+    _check(saved1 >= saved1_0 + 3 * 24,
+           f"adoptive engine saved only {saved1 - saved1_0} prefill "
+           f"tokens — migration did not ride the prefix cache")
+    for i, (rid, ref) in enumerate(zip(rids, refs)):
+        _check(outs[rid].finish_reason == "length",
+               f"request {i} did not complete ({outs[rid].finish_reason})")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the uninterrupted run")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([s for s, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    _check(e1.pool.used_pages == 0, "pages leaked on the adoptive engine")
+    return ("m/0 killed at step 4 under prefix-heavy traffic: 2 migrated "
+            f"+ 1 requeued re-prefilled via m/1's cache "
+            f"({int(saved1 - saved1_0)} prefill tokens saved); streams "
+            "bit-identical, chunks exactly-once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -432,6 +520,7 @@ SCENARIOS = [
     ("router-rolling-reload", scenario_router_reload),
     ("router-least-loaded-dispatch", scenario_router_least_loaded),
     ("kill-engine-mid-decode", scenario_kill_engine_mid_decode),
+    ("prefix-cache-failover-migration", scenario_prefix_cache_failover),
 ]
 
 

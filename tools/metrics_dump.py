@@ -39,7 +39,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def _demo_registry():
     """Tiny CPU-fallback engine run (tests/test_serving.py scale): a few
-    requests through prefill+decode so every serving instrument is live."""
+    requests through prefill+decode so every serving instrument is live —
+    including the prefix-cache series (two requests share an 8-token
+    prefix, so hits/misses/saved and the cached-pages gauge all move)."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -56,6 +58,14 @@ def _demo_registry():
     for n, new in ((5, 4), (3, 6), (7, 3)):
         engine.add_request(rng.integers(1, 64, (n,)), max_new_tokens=new)
     engine.run()
+    # prefix-cache traffic: a shared 8-token system prefix — the second
+    # request is a warm hit (paddle_tpu_serving_prefix_hits_total,
+    # _prefill_tokens_saved_total, _prefix_cached_pages go live)
+    shared = rng.integers(1, 64, (8,))
+    for tail in (1, 2):
+        engine.add_request(np.concatenate([shared, [tail]]),
+                           max_new_tokens=3)
+        engine.run()
     return metrics.get_registry()
 
 
