@@ -201,6 +201,10 @@ class GradScaler:
         else:
             found = self._unscale_and_check(optimizer)
             optimizer._found_inf = Tensor(found)
+        # tag the skip's origin so Optimizer.step books it under
+        # paddle_tpu_amp_skipped_steps_total (the train sentinel reuses
+        # the same _found_inf path but counts its skips separately)
+        optimizer._found_inf_origin = "amp"
         try:
             optimizer.step()
         finally:
@@ -225,6 +229,7 @@ class GradScaler:
             return optimizer._found_inf._value
         found = self._unscale_and_check(optimizer)
         optimizer._found_inf = Tensor(found)
+        optimizer._found_inf_origin = "amp"
         self._unscaled.add(id(optimizer))
         return found
 

@@ -301,6 +301,40 @@ class CheckpointManager:
         finish(inner)
         return AsyncHandle(None)
 
+    def save_if_absent(self, step: int, state: Dict,
+                       async_save: bool = False) -> Optional[AsyncHandle]:
+        """:meth:`save` unless ``step`` is already committed — returns
+        None then, and tolerates losing a commit race (a concurrent save
+        publishing the step IS durability). The idempotent path shared by
+        the preemption signal handler and the train sentinel's
+        mark/emergency saves, where "someone already committed this step"
+        is success, not an error."""
+        step = int(step)
+        if step in set(self.all_steps()):
+            return None
+        try:
+            return self.save(step, state, async_save=async_save)
+        except ValueError:
+            if step in set(self.all_steps()):
+                return None
+            raise
+
+    def delete_step(self, step: int) -> bool:
+        """Remove one committed step. For callers that own their step
+        semantics beyond retention GC — the train sentinel prunes marks
+        AHEAD of a resumed timeline (an epoch-granular restore rewound
+        behind them; restoring such a mark would fast-forward params past
+        the data stream). Returns False when the step isn't committed."""
+        path = self.step_path(int(step))
+        if not os.path.isfile(os.path.join(path, _COMMIT_FILE)):
+            return False
+        with self._commit_lock():
+            shutil.rmtree(path, ignore_errors=True)
+        # an rmtree failure (EBUSY/EPERM on a network fs) must not report
+        # success: a caller pruning stale-timeline marks would otherwise
+        # believe a restorable step is gone
+        return not os.path.isfile(os.path.join(path, _COMMIT_FILE))
+
     @contextmanager
     def _commit_lock(self, timeout_s: float = 30.0):
         """Commit/GC serialization with a liveness escape hatch: if the
@@ -511,15 +545,10 @@ class CheckpointManager:
                 # and anything already queued should land before we exit
                 _drain_pending(drain_timeout_s)
                 step, state = state_fn()
-                if int(step) not in set(self.all_steps()):
-                    try:
-                        self.save(int(step), state)
-                    except ValueError:
-                        # a wedged async save may publish our step AFTER
-                        # the drain timed out — losing that race means the
-                        # checkpoint is durable, which is success here
-                        if int(step) not in set(self.all_steps()):
-                            raise
+                # a wedged async save may publish our step AFTER the
+                # drain timed out — losing that race means the checkpoint
+                # is durable, which is success here
+                self.save_if_absent(int(step), state)
             finally:
                 scope.uninstall()
             if exit_on_save:

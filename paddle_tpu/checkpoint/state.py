@@ -62,9 +62,14 @@ def set_rng_state_dict(state: Dict[str, Any]) -> None:
 
 def capture_train_state(model=None, optimizer=None, dataloader=None,
                         step: Optional[int] = None,
-                        extra: Optional[Dict] = None) -> Dict[str, Any]:
+                        extra: Optional[Dict] = None,
+                        sentinel=None) -> Dict[str, Any]:
     """One nested dict holding everything resume needs. Omitted pieces are
-    simply absent; ``step`` rides along as an exact python int."""
+    simply absent; ``step`` rides along as an exact python int.
+    ``sentinel`` (a :class:`~paddle_tpu.faults.TrainSentinel`) contributes
+    its journal + escalation state — pure scalars, so they land in the
+    checkpoint's ``scalars.json`` and a preempted run resumes mid-incident
+    with its anomaly memory intact."""
     state: Dict[str, Any] = {"rng": rng_state_dict()}
     if model is not None:
         state["model"] = model.state_dict()
@@ -72,6 +77,8 @@ def capture_train_state(model=None, optimizer=None, dataloader=None,
         state["optimizer"] = optimizer.state_dict()
     if dataloader is not None:
         state["dataloader"] = dataloader.state_dict()
+    if sentinel is not None:
+        state["sentinel"] = sentinel.state_dict()
     if step is not None:
         state["step"] = int(step)
     if extra:
@@ -80,9 +87,12 @@ def capture_train_state(model=None, optimizer=None, dataloader=None,
 
 
 def restore_train_state(state: Dict[str, Any], model=None, optimizer=None,
-                        dataloader=None) -> Optional[int]:
+                        dataloader=None, sentinel=None) -> Optional[int]:
     """Push a :func:`capture_train_state` dict back into live objects and
-    return the saved ``step`` (None if it wasn't captured)."""
+    return the saved ``step`` (None if it wasn't captured). ``sentinel``
+    is only restored when passed — a sentinel-driven ROLLBACK restores
+    params/optimizer/data from a mark but must keep its own live incident
+    state (region counts, journal), so rollback calls this without it."""
     if "rng" in state:
         set_rng_state_dict(state["rng"])
     if model is not None and "model" in state:
@@ -91,5 +101,7 @@ def restore_train_state(state: Dict[str, Any], model=None, optimizer=None,
         optimizer.set_state_dict(state["optimizer"])
     if dataloader is not None and "dataloader" in state:
         dataloader.set_state_dict(state["dataloader"])
+    if sentinel is not None and "sentinel" in state:
+        sentinel.set_state_dict(state["sentinel"])
     step = state.get("step")
     return None if step is None else int(step)

@@ -16,6 +16,13 @@ failure modes into *tested contracts* (docs/RESILIENCE.md). Four pieces:
 - **StepWatchdog** (watchdog.py): trips on an over-threshold engine
   step, detects live hangs from any thread (``stalled_now``), recovers
   after N healthy steps — the state behind ``/healthz`` degraded mode.
+- **TrainSentinel** (sentinel.py): self-healing training — anomaly
+  detectors over per-step health scalars (non-finite loss/grad, robust
+  z-score spikes, divergence EWMA) feeding a deterministic escalation
+  ladder: skip-batch → rollback to the last-known-good checkpoint with a
+  quarantine skip-forward → LR re-ramp + widened skip → abort with an
+  actionable journal. Wired into ``Model.fit(sentinel=...)``; guards any
+  loop via ``sentinel.guard(step_fn)``.
 
 Chaos drill in one breath:
 
@@ -33,11 +40,14 @@ from .injection import (CallbackError, FaultInjected, FaultSpec,
                         ResourceExhausted, active_faults, declare_point,
                         inject, known_points, point, reset)
 from .retry import backoff_delays, retry
+from .sentinel import (Action, SentinelAbort, SentinelConfig, StepReport,
+                       TrainSentinel)
 from .watchdog import StepWatchdog
 
 __all__ = [
-    "CallbackError", "Deadline", "DeadlineExceeded", "FaultInjected",
-    "FaultSpec", "ResourceExhausted", "StepWatchdog", "active_faults",
-    "backoff_delays", "declare_point", "inject", "known_points", "point",
-    "reset", "retry",
+    "Action", "CallbackError", "Deadline", "DeadlineExceeded",
+    "FaultInjected", "FaultSpec", "ResourceExhausted", "SentinelAbort",
+    "SentinelConfig", "StepReport", "StepWatchdog", "TrainSentinel",
+    "active_faults", "backoff_delays", "declare_point", "inject",
+    "known_points", "point", "reset", "retry",
 ]

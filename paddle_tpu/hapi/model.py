@@ -49,6 +49,7 @@ class Model:
         self.stop_training = False
         self._save_dir = None
         self._compiled_step = None
+        self._fit_sentinel = None
 
     # ------------------------------------------------------------ prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -94,6 +95,47 @@ class Model:
         metrics = self._update_metrics(outputs, labs)
         lv = float(np.asarray(loss.numpy(), dtype="float64"))
         return ([lv] + metrics) if metrics else [lv]
+
+    def _sentinel_batch(self, inputs, labels, sentinel):
+        """One sentinel-guarded train step. The health scalars the
+        detectors need — loss, global grad-norm, finite flag — are
+        stacked device-side and fetched in ONE host sync (the same fetch
+        ``train_batch`` already pays for the loss; no extra compiles: the
+        step stays eager jnp). The verdict lands BEFORE the update, so
+        SKIP suppresses it through the optimizer's ``_found_inf`` no-op
+        path and ROLLBACK leaves params untouched for the restore."""
+        from .. import faults
+        from ..faults.sentinel import _grad_health, _suppress_update
+
+        self.network.train()
+        sentinel.begin_step()
+        faults.point("train.step")
+        ins = _to_tensor_list(inputs)
+        labs = _to_tensor_list(labels) if labels is not None else []
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, labs)
+        loss.backward()
+        faults.point("train.grads")
+        loss_v, gnorm, finite = _grad_health(loss, self._optimizer)
+        action = sentinel.observe(loss_v, grad_norm=gnorm,
+                                  grads_finite=finite)
+        if action == sentinel.OK:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            sentinel.after_update(True)
+            # metrics only accumulate applied steps: a suppressed or
+            # rolled-back batch must not pollute the epoch's accuracy
+            metrics = self._update_metrics(outputs, labs)
+        elif action == sentinel.SKIP:
+            _suppress_update(self._optimizer)
+            self._optimizer.clear_grad()
+            sentinel.after_update(False)
+            metrics = []
+        else:  # ROLLBACK: the caller restores; these grads are moot
+            self._optimizer.clear_grad()
+            metrics = []
+        lv = float(loss_v)
+        return (([lv] + metrics) if metrics else [lv]), action
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -147,7 +189,8 @@ class Model:
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None,
             accumulate_grad_batches: int = 1, num_iters: Optional[int] = None,
-            checkpoint_dir: Optional[str] = None, resume: bool = True):
+            checkpoint_dir: Optional[str] = None, resume: bool = True,
+            sentinel=None):
         """reference: model.py fit — epoch/step loop + callbacks + periodic
         eval + checkpointing. ``accumulate_grad_batches`` applies the
         optimizer every N micro-batches (reference gradient merge).
@@ -158,13 +201,35 @@ class Model:
         epochs, and with ``resume=True`` (default) fit() first restores the
         newest valid step and continues from the following epoch — rerunning
         the same command after a crash or preemption picks the run back up.
-        (``save_dir`` remains the reference's plain .pdparams path.)"""
+        (``save_dir`` remains the reference's plain .pdparams path.)
+
+        ``sentinel`` (a :class:`paddle_tpu.faults.TrainSentinel`) makes
+        the loop self-healing: per-step health scalars feed its detectors
+        (one stacked host fetch — the same sync the loss read costs), a
+        suspect batch's update is suppressed, and a persistent anomaly
+        rolls params/optimizer/RNG/data back to the last-known-good step
+        and deterministically skips the quarantined batches
+        (docs/RESILIENCE.md "Self-healing training"). With
+        ``checkpoint_dir`` set, sentinel marks are committed under
+        ``<checkpoint_dir>/sentinel`` and the journal rides every
+        checkpoint's ``scalars.json``. An epoch interrupted by a rollback
+        restarts from the restored position and is only recorded as done
+        once it actually runs to its end."""
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
         self._save_dir = save_dir
         self.stop_training = False
+        if sentinel is not None:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError(
+                    "Model.prepare(optimizer, loss) before fit(sentinel=)")
+            if accumulate_grad_batches != 1:
+                raise ValueError(
+                    "sentinel guarding assumes one update per batch; "
+                    "accumulate_grad_batches > 1 is not supported yet")
+        self._fit_sentinel = sentinel
         cbks = config_callbacks(
             callbacks, model=self, batch_size=batch_size, epochs=epochs,
             steps=steps, log_freq=log_freq, verbose=verbose,
@@ -206,30 +271,106 @@ class Model:
                     f"resume=True to continue that run, or point "
                     f"checkpoint_dir at a fresh directory")
 
+        if sentinel is not None:
+            smgr = None
+            if checkpoint_dir is not None:
+                from .. import checkpoint as _ckpt
+
+                # marks live beside (never inside the step namespace of)
+                # fit's epoch checkpoints; bind() prunes marks ahead of a
+                # resumed epoch-granular timeline
+                smgr = _ckpt.CheckpointManager(
+                    os.path.join(checkpoint_dir, "sentinel"), max_to_keep=3)
+            sentinel.bind(model=self.network, optimizer=self._optimizer,
+                          dataloader=loader, manager=smgr)
+        from .. import metrics as _metrics
+
+        _amp_fam = _metrics.get_registry().get(
+            "paddle_tpu_amp_skipped_steps_total")
+        amp_skip_base = _amp_fam.value if _amp_fam is not None else 0.0
+
         cbks.on_train_begin()
         iters_done = 0
         logs = {}  # resume may satisfy every epoch: loop body never runs
-        for epoch in range(start_epoch, epochs):
+        epoch = start_epoch
+        while epoch < epochs:
             if self.stop_training:
                 break
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
-            epoch_completed = True  # False only on the mid-epoch break
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                x, y = (batch[0], batch[1]) if isinstance(
-                    batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
-                update = ((step + 1) % accumulate_grad_batches == 0)
-                result = self.train_batch(x, y, update=update)
-                logs = self._logs(result)
-                cbks.on_train_batch_end(step, logs)
-                iters_done += 1
-                if num_iters is not None and iters_done >= num_iters:
-                    self.stop_training = True
-                    epoch_completed = False
-                    break
+            if sentinel is not None:
+                sentinel.note_epoch(epoch)
+            # the restart loop: a sentinel ROLLBACK restores mid-epoch
+            # state (dataloader included, quarantine skip queued) and
+            # re-enters iteration from there. epoch_completed flips True
+            # only when the LAST pass ran to natural exhaustion — a
+            # rollback or num_iters break mid-epoch must not let the
+            # resume=True path record this epoch as done.
+            epoch_completed = False
+            epoch_rewind = None
+            restart = True
+            while restart and not self.stop_training:
+                restart = False
+                data_iter = iter(loader)
+                step = 0
+                if sentinel is not None and hasattr(loader, "state_dict"):
+                    # post-rollback the iterator starts mid-epoch; keep
+                    # callback step indices aligned with the data stream
+                    step = int(loader.state_dict().get("batch", 0))
+                while True:
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        epoch_completed = True
+                        break
+                    cbks.on_train_batch_begin(step)
+                    x, y = (batch[0], batch[1]) if isinstance(
+                        batch, (list, tuple)) and len(batch) >= 2 \
+                        else (batch, None)
+                    if sentinel is not None:
+                        result, action = self._sentinel_batch(x, y, sentinel)
+                        if action == sentinel.ROLLBACK:
+                            # pair the on_train_batch_begin above before
+                            # breaking — begin/end-scoped callbacks must
+                            # not leak an open span per rollback
+                            logs = self._logs(result)
+                            cbks.on_train_batch_end(step, logs)
+                            info = sentinel.rollback()
+                            if (info.get("epoch") is not None
+                                    and info["epoch"] != epoch):
+                                # the healthy window straddled the epoch
+                                # boundary: re-run the marked epoch's tail
+                                epoch_rewind = int(info["epoch"])
+                                break
+                            restart = True
+                            break
+                    else:
+                        update = ((step + 1) % accumulate_grad_batches == 0)
+                        result = self.train_batch(x, y, update=update)
+                    logs = self._logs(result)
+                    if sentinel is not None and sentinel.skipped_batches:
+                        logs["skipped_batches"] = sentinel.skipped_batches
+                    if _amp_fam is None:
+                        _amp_fam = _metrics.get_registry().get(
+                            "paddle_tpu_amp_skipped_steps_total")
+                    if (_amp_fam is not None
+                            and _amp_fam.value > amp_skip_base):
+                        logs["amp_skipped"] = int(
+                            _amp_fam.value - amp_skip_base)
+                    cbks.on_train_batch_end(step, logs)
+                    iters_done += 1
+                    step += 1
+                    if num_iters is not None and iters_done >= num_iters:
+                        self.stop_training = True
+                        break
+            if epoch_rewind is not None:
+                # close the aborted epoch's callback bracket before the
+                # rewound epoch opens its own with on_epoch_begin
+                cbks.on_epoch_end(epoch, logs)
+                epoch = epoch_rewind
+                continue
             cbks.on_epoch_end(epoch, logs)
             # only a COMPLETED epoch commits: a num_iters break mid-epoch
             # must not record epoch N as done, or resume would skip the
@@ -239,6 +380,13 @@ class Model:
             # manager, never a silent skip.)
             if ckpt_mgr is not None and epoch_completed \
                     and (epoch + 1) % save_freq == 0:
+                if sentinel is not None and epoch in set(ckpt_mgr.all_steps()):
+                    # a cross-epoch rollback replayed an epoch whose
+                    # marker is already committed — that marker holds the
+                    # PRE-rollback timeline (and pre-incident sentinel
+                    # state); replace it so resume can't resurrect the
+                    # path the rollback just repaired
+                    ckpt_mgr.delete_step(epoch)
                 ckpt_mgr.save(epoch, self._training_state(epoch))
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
@@ -255,6 +403,7 @@ class Model:
                 cbks.on_eval_end(eval_logs)
                 if self.stop_training:
                     break
+            epoch += 1
         cbks.on_train_end(logs if steps else None)
 
     def _wrap_callbacks(self, callbacks):
@@ -357,7 +506,8 @@ class Model:
         from .. import checkpoint as _ckpt
 
         state = _ckpt.capture_train_state(
-            model=self.network, optimizer=self._optimizer)
+            model=self.network, optimizer=self._optimizer,
+            sentinel=self._fit_sentinel)
         if epoch is not None:
             state["epoch"] = int(epoch)
         return state
@@ -366,7 +516,8 @@ class Model:
         from .. import checkpoint as _ckpt
 
         _ckpt.restore_train_state(state, model=self.network,
-                                  optimizer=self._optimizer)
+                                  optimizer=self._optimizer,
+                                  sentinel=self._fit_sentinel)
 
     def save_checkpoint(self, directory: str, step: int,
                         max_to_keep: Optional[int] = 5,

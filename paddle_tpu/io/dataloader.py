@@ -537,6 +537,20 @@ class DataLoader:
 
     load_state_dict = set_state_dict
 
+    def advance_batches(self, n: int):
+        """Queue ``n`` ADDITIONAL batches to skip at the next
+        ``__iter__``, on top of any pending resume position — the train
+        sentinel's rollback primitive: restore the last-known-good
+        position via :meth:`set_state_dict`, then advance past the
+        quarantined window so the replay deterministically trains only on
+        the batches a clean run would have (docs/RESILIENCE.md
+        "Self-healing training"). A skip running past the epoch's end
+        simply ends the epoch (quarantine clamps at the boundary)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"advance_batches needs n >= 0, got {n}")
+        self._resume_batches += n
+
     def _epoch_index_iter(self):
         """Lazy batch-index stream for the current epoch, the resume skip
         already consumed. The newest iterator owns the position: counters

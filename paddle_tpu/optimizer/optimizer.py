@@ -255,7 +255,27 @@ class Optimizer:
             else:
                 p._set_value(new_val)
             self._accumulators[p._uid] = new_accs
+        if found_inf is not None:
+            # the skip used to be silent; counted AFTER the update loop
+            # so the blocking host read of the flag overlaps the already-
+            # dispatched device work instead of serializing ahead of it.
+            # bool() on a traced flag raises (under jit the skip is data-
+            # dependent and the host can't observe it), so only eager
+            # skips count — which is where GradScaler runs. Sentinel-
+            # tagged skips count in paddle_tpu_train_skipped_batches_total
+            # instead.
+            try:
+                skip_now = bool(found_inf)
+            except Exception:
+                skip_now = False
+            if skip_now and getattr(self, "_found_inf_origin",
+                                    "amp") == "amp":
+                _reg.counter(
+                    "paddle_tpu_amp_skipped_steps_total",
+                    "Optimizer updates suppressed by the _found_inf skip "
+                    "path (GradScaler non-finite gradients)").inc()
         self._found_inf = None  # consume-once: a stale flag must not freeze future steps
+        self._found_inf_origin = "amp"  # consumed with the flag it tags
         self._global_step += 1
         # _t0 > 0 guard: if the registry was enabled mid-step, _t0 is the
         # 0.0 sentinel and observing perf_counter()-0 would poison the

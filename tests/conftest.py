@@ -41,6 +41,11 @@ def pytest_configure(config):
         "markers",
         "checkpoint: paddle_tpu.checkpoint crash-consistency suite — "
         "commit-protocol crash matrix + auto-resume (tier-1 fast lane)")
+    config.addinivalue_line(
+        "markers",
+        "sentinel: paddle_tpu.faults.TrainSentinel self-healing-training "
+        "suite — detectors, escalation state machine, rollback-and-skip "
+        "(tier-1 fast lane)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,44 @@
+"""CI wrapper for tools/chaos_train.py: the full training chaos ladder
+(scenarios 1-8 — the checkpoint commit-protocol crash matrix, corruption
+quarantine, SIGTERM preemption, retention, telemetry, and the ISSUE 9
+train-sentinel drills: seeded NaN skip-batch, rollback-and-skip
+determinism with zero extra compiles, escalation-to-abort) runs as
+slow-marked tests instead of only by hand, one test per scenario so a
+regression names its drill — mirroring tests/test_chaos_serve.py.
+
+The scenarios are imported from the tool itself — one source of truth;
+this file adds only pytest plumbing (module load, per-scenario tmp dirs,
+fault hygiene).
+"""
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.checkpoint,
+              pytest.mark.sentinel]
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_train", os.path.join(REPO, "tools", "chaos_train.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+chaos = _load_chaos()
+
+
+@pytest.mark.parametrize("name,scenario", chaos.SCENARIOS,
+                         ids=[n for n, _ in chaos.SCENARIOS])
+def test_chaos_scenario(name, scenario, tmp_path):
+    from paddle_tpu import faults
+
+    faults.reset()  # hermetic per scenario, like main()'s loop
+    try:
+        scenario(str(tmp_path))
+    finally:
+        faults.reset()

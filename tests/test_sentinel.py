@@ -1,0 +1,652 @@
+"""Self-healing training: TrainSentinel detector math, escalation state
+machine, journal persistence, fit() wiring (ISSUE 9).
+
+Tier-1 fast lane (`sentinel` marker): synthetic-series detector tests run
+without any model; the escalation/rollback tests drive a 3-parameter
+regression net so a full rollback drill stays well under a second. The
+operational twin is tools/chaos_train.py scenarios 6-8
+(tests/test_chaos_train.py runs them slow-marked).
+"""
+import importlib.util
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint as ck
+from paddle_tpu import faults, metrics
+from paddle_tpu.faults import (SentinelAbort, StepWatchdog, TrainSentinel)
+from paddle_tpu.io import DataLoader, Dataset
+
+pytestmark = pytest.mark.sentinel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_chaos():
+    """tools/chaos_train.py is the single source of truth for the
+    guarded-run driver and the journal->exclusion/clean-replay semantics
+    (tests/test_chaos_train.py imports it the same way)."""
+    spec = importlib.util.spec_from_file_location(
+        "chaos_train", os.path.join(REPO, "tools", "chaos_train.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _counter(name, **labels):
+    fam = metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+class RegressionDS(Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        x = np.float32([i / 32.0, 1.0 - i / 32.0, (i % 5) / 5.0])
+        return x, np.float32([x @ np.float32([0.5, -0.25, 1.0])])
+
+
+def build(seed=0, lr=0.05):
+    pt.seed(seed)
+    net = pt.nn.Linear(3, 1)
+    opt = pt.optimizer.AdamW(learning_rate=lr, parameters=net.parameters())
+    return net, opt, pt.nn.MSELoss()
+
+
+def params_of(net, opt):
+    out = {f"net.{k}": np.asarray(v.numpy())
+           for k, v in net.state_dict().items()}
+    for k, v in opt.state_dict().items():
+        if hasattr(v, "numpy"):
+            out[f"opt.{k}"] = np.asarray(v.numpy())
+    return out
+
+
+def _nan_grads(net):
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor import Tensor
+
+    def poison():
+        w = net.weight
+        if w.grad is not None:
+            w.grad = Tensor(jnp.full_like(w.grad._value, jnp.nan))
+    return poison
+
+
+# --------------------------------------------------------------------------
+# detector math on synthetic loss/grad series (no model, no binding)
+# --------------------------------------------------------------------------
+class TestDetectors:
+    def test_config_validation(self):
+        from paddle_tpu.faults import SentinelConfig
+
+        for bad in (dict(ewma_alpha=2.0), dict(divergence_factor=1.0),
+                    dict(window=1), dict(reramp_factor=0.0),
+                    dict(healthy_window=0)):
+            with pytest.raises(ValueError):
+                SentinelConfig(**bad)
+        with pytest.raises(ValueError):
+            TrainSentinel(SentinelConfig(), skip_limit=1)  # config XOR kw
+
+    def test_nonfinite_loss_and_grad(self):
+        s = TrainSentinel(skip_limit=5)
+        assert s.observe(float("nan")) == s.SKIP
+        assert s.observe(float("inf")) == s.SKIP
+        assert s.observe(1.0, grad_norm=float("nan")) == s.SKIP
+        assert s.observe(1.0, grad_norm=1.0, grads_finite=False) == s.SKIP
+        kinds = [e["kind"] for e in s.journal()]
+        assert kinds == ["nonfinite_loss", "nonfinite_loss",
+                         "nonfinite_grad", "nonfinite_grad"]
+
+    def test_loss_spike_robust_z(self):
+        s = TrainSentinel(min_history=8, skip_limit=5)
+        for i in range(12):
+            assert s.observe(1.0 + 0.001 * ((i % 5) - 2)) == s.OK
+            s.after_update(True)
+        assert s.observe(50.0) == s.SKIP
+        assert s.journal()[-1]["kind"] == "loss_spike"
+
+    def test_grad_spike(self):
+        s = TrainSentinel(min_history=8, skip_limit=5)
+        for i in range(12):
+            assert s.observe(1.0, grad_norm=1.0 + 0.01 * (i % 3)) == s.OK
+            s.after_update(True)
+        assert s.observe(1.0, grad_norm=500.0) == s.SKIP
+        assert s.journal()[-1]["kind"] == "grad_spike"
+
+    def test_plateau_no_false_positives(self):
+        # near-constant loss: MAD ~ 0 must not turn numeric dust into an
+        # incident (the scale floor in the robust z)
+        s = TrainSentinel(min_history=8)
+        for i in range(200):
+            assert s.observe(0.5 + 1e-4 * (i % 2),
+                             grad_norm=0.01) == s.OK
+            s.after_update(True)
+        assert s.journal() == []
+
+    def test_divergence_ewma(self):
+        # each step is individually unremarkable; the EWMA creep trips
+        s = TrainSentinel(min_history=4, ewma_alpha=0.5,
+                          divergence_factor=1.5, skip_limit=5)
+        i, kinds = 0, []
+        while i < 40 and not kinds:
+            a = s.observe(1.0 + 0.1 * i)
+            if a != s.OK:
+                kinds = [e["kind"] for e in s.journal()]
+            else:
+                s.after_update(True)
+            i += 1
+        assert kinds and kinds[-1] == "divergence"
+
+    def test_divergence_sound_for_negative_losses(self):
+        # review regression: `ewma > factor * best` flips meaning when
+        # best <= 0 — a steady log-likelihood-style loss of -5 must stay
+        # healthy, while a genuine climb out of it must still trip
+        s = TrainSentinel(min_history=4, ewma_alpha=0.5,
+                          divergence_factor=3.0, skip_limit=5,
+                          z_threshold=1e9)   # isolate the EWMA detector
+        for _ in range(50):
+            assert s.observe(-5.0) == s.OK
+            s.after_update(True)
+        assert s.journal() == []
+        i, tripped = 0, False
+        while i < 60 and not tripped:
+            a = s.observe(-5.0 + 0.5 * i)
+            tripped = a != s.OK
+            if not tripped:
+                s.after_update(True)
+            i += 1
+        assert tripped and s.journal()[-1]["kind"] == "divergence"
+
+    def test_anomaly_does_not_poison_baseline(self):
+        s = TrainSentinel(min_history=8, skip_limit=5)
+        for _ in range(10):
+            s.observe(1.0)
+            s.after_update(True)
+        assert s.observe(80.0) == s.SKIP       # spike skipped...
+        s.after_update(False)
+        assert s.observe(1.0) == s.OK          # ...baseline unchanged
+        assert s.observe(80.0) == s.SKIP       # and still detects
+
+
+# --------------------------------------------------------------------------
+# escalation state machine: exactly-once accounting
+# --------------------------------------------------------------------------
+class TestEscalation:
+    def test_skip_then_rollback_and_counters(self, tmp_path):
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(skip_limit=2, healthy_window=2)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)                         # init mark at step 0
+        assert mgr.all_steps() == [0]
+        a0 = _counter("paddle_tpu_train_anomalies_total",
+                      kind="nonfinite_loss")
+        sk0 = _counter("paddle_tpu_train_skipped_batches_total")
+        rb0 = _counter("paddle_tpu_train_rollbacks_total")
+        assert s.observe(float("nan")) == s.SKIP
+        s.after_update(False)
+        assert s.observe(float("nan")) == s.SKIP
+        s.after_update(False)
+        assert s.observe(float("nan")) == s.ROLLBACK
+        info = s.rollback()
+        assert info["step"] == 0 and info["skipped"] == 3
+        assert s.rollbacks == 1 and s.skipped_batches == 2 + 3
+        assert _counter("paddle_tpu_train_anomalies_total",
+                        kind="nonfinite_loss") == a0 + 3
+        assert _counter("paddle_tpu_train_skipped_batches_total") == sk0 + 5
+        assert _counter("paddle_tpu_train_rollbacks_total") == rb0 + 1
+        # the quarantine skip landed on the dataloader
+        assert loader._resume_batches == 3
+
+    def test_no_mark_keeps_skipping_then_aborts(self):
+        s = TrainSentinel(skip_limit=1, max_unrecoverable_skips=3)
+        assert s.observe(float("nan")) == s.SKIP     # streak 1
+        assert s.observe(float("nan")) == s.SKIP     # 2: no mark -> skip
+        assert s.observe(float("nan")) == s.SKIP     # 3
+        with pytest.raises(SentinelAbort) as ei:
+            s.observe(float("nan"))                  # 4 = 1 + 3 -> abort
+        assert ei.value.reason == "no_rollback_target"
+        assert s.skipped_batches == 3 and s.aborts == 1
+        assert s.journal()[-1]["event"] == "abort"
+
+    def test_region_escalation_reramp_then_abort(self, tmp_path):
+        net, opt, lossf = build(lr=0.05)
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(skip_limit=0, lr_reramp_after=2,
+                          abort_after_rollbacks=3)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)
+        rr0 = _counter("paddle_tpu_train_lr_reramps_total")
+        for n in (1, 2, 3):
+            assert s.observe(float("nan")) == s.ROLLBACK
+            info = s.rollback()
+            assert info["region_rollbacks"] == n
+        # the 2nd rollback into region 0 re-ramped the LR down
+        assert opt.get_lr() == pytest.approx(0.05 * 0.1)
+        assert _counter("paddle_tpu_train_lr_reramps_total") == rr0 + 1
+        with pytest.raises(SentinelAbort) as ei:
+            s.observe(float("nan"))
+        assert ei.value.reason == "rollback_limit"
+        assert s.rollbacks == 3
+
+    def test_lr_reramps_back_to_base(self, tmp_path):
+        net, opt, lossf = build(lr=0.04)
+        mgr = ck.CheckpointManager(str(tmp_path / "m"))
+        s = TrainSentinel(skip_limit=0, lr_reramp_after=1, reramp_steps=4)
+        s.bind(model=net, optimizer=opt, manager=mgr)
+        s.note_epoch(0)
+        assert s.observe(float("nan")) == s.ROLLBACK
+        s.rollback()
+        assert opt.get_lr() == pytest.approx(0.04 * 0.1)
+        for _ in range(4):
+            assert s.observe(0.5) == s.OK
+            s.after_update(True)
+        assert opt.get_lr() == pytest.approx(0.04)
+
+    def test_widened_skip_after_reramp_threshold(self, tmp_path):
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "m"))
+        s = TrainSentinel(skip_limit=0, lr_reramp_after=2, widen_factor=2,
+                          abort_after_rollbacks=10)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)
+        skips = []
+        for _ in range(3):
+            assert s.observe(float("nan")) == s.ROLLBACK
+            skips.append(s.rollback()["skipped"])
+        # window is 1 batch each time; the 2nd+ rollback into the region
+        # widens: 1, 1*2, 1*4
+        assert skips == [1, 2, 4]
+
+
+# --------------------------------------------------------------------------
+# journal + escalation state persist across a simulated preemption
+# --------------------------------------------------------------------------
+class TestPersistence:
+    def test_state_roundtrip_mid_incident(self, tmp_path):
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(skip_limit=0, lr_reramp_after=10,
+                          abort_after_rollbacks=10)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)
+        for _ in range(6):
+            s.observe(0.5)
+            s.after_update(True)
+        s.observe(float("nan"))
+        s.rollback()                     # mid-incident: region count = 1
+        # the journal rides a REAL checkpoint's scalars.json
+        state = ck.capture_train_state(model=net, optimizer=opt,
+                                       dataloader=loader, sentinel=s)
+        mgr2 = ck.CheckpointManager(str(tmp_path / "ckpt"))
+        mgr2.save(7, state)
+        restored, _ = mgr2.restore(7)
+
+        s2 = TrainSentinel(skip_limit=0, lr_reramp_after=10,
+                           abort_after_rollbacks=10)
+        s2.bind(manager=mgr, prune_future=False)
+        ck.restore_train_state(restored, sentinel=s2)
+        assert s2.journal() == s.journal()
+        assert s2.rollbacks == 1 and s2.global_step == s.global_step
+        assert s2._region_rollbacks == 1
+        # a second incident in the same region continues the escalation
+        # count instead of starting over
+        assert s2.observe(float("nan")) == s2.ROLLBACK
+        assert s2.rollback()["region_rollbacks"] == 2
+
+    def test_nan_values_journal_as_json(self):
+        import json
+
+        s = TrainSentinel()
+        s.observe(float("nan"), grad_norm=float("inf"))
+        blob = s.state_dict()["json"]
+        payload = json.loads(blob)
+        assert payload["journal"][0]["loss"] == "nan"
+
+    def test_restore_then_bind_reacquires_mark(self, tmp_path):
+        """Review regression: fit() restores the sentinel BEFORE binding
+        the manager — a mid-incident resume must still find its rollback
+        target instead of degrading to unrecoverable skips."""
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(skip_limit=0)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)
+        for _ in range(3):
+            s.observe(0.5)
+            s.after_update(True)
+        s.observe(float("nan"))           # open incident
+        saved = s.state_dict()
+
+        s2 = TrainSentinel(skip_limit=0)
+        s2.set_state_dict(saved)          # fit's order: restore first...
+        s2.bind(model=net, optimizer=opt, dataloader=loader,
+                manager=mgr)              # ...manager bound after
+        assert s2.observe(float("nan")) == s2.ROLLBACK
+        assert s2.rollback()["step"] == 0
+
+    def test_rollback_fallback_rekeys_on_actual_step(self, tmp_path):
+        """Review regression: when the target mark fails verification and
+        restore falls back to an older committed mark, the step clock,
+        region key, and quarantine skip must follow the ACTUAL restored
+        step (extended by the target-actual stretch)."""
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(skip_limit=0, healthy_window=2, mark_every=2)
+        s.bind(model=net, optimizer=opt, dataloader=loader, manager=mgr)
+        s.note_epoch(0)
+        for _ in range(4):
+            s.observe(0.5)
+            s.after_update(True)
+        assert s.last_good_step == 4 and 4 in mgr.all_steps()
+        # bit-rot the newest mark: CRC verification must reject it
+        step_dir = mgr.step_path(4)
+        victim = next(os.path.join(step_dir, f)
+                      for f in os.listdir(step_dir) if f.endswith(".npy"))
+        with open(victim, "r+b") as fh:
+            fh.seek(-1, os.SEEK_END)
+            last = fh.read(1)
+            fh.seek(-1, os.SEEK_END)
+            fh.write(bytes([last[0] ^ 0xFF]))
+        assert s.observe(float("nan")) == s.ROLLBACK
+        info = s.rollback()
+        assert info["step"] == 2     # fell back to the newest VALID mark
+        assert s.global_step == 2
+        assert s._region_step == 2
+        # window: 1 trigger batch + (target 4 - actual 2) stretch
+        assert info["skipped"] == 3
+        assert s.journal()[-1]["fallback_from"] == 4
+
+    def test_bind_prunes_marks_ahead_of_resumed_timeline(self, tmp_path):
+        net, opt, lossf = build()
+        mgr = ck.CheckpointManager(str(tmp_path / "marks"))
+        s = TrainSentinel(healthy_window=1, mark_every=1)
+        s.bind(model=net, optimizer=opt, manager=mgr)
+        s.note_epoch(0)
+        for _ in range(3):
+            s.observe(0.5)
+            s.after_update(True)
+        assert mgr.all_steps() == [0, 1, 2, 3]
+        # a coarser resume rewound to step 1: marks 2,3 are in its future
+        s2 = TrainSentinel()
+        s2.global_step = 1
+        s2.bind(model=net, optimizer=opt, manager=mgr)
+        assert mgr.all_steps() == [0, 1]
+
+
+# --------------------------------------------------------------------------
+# guard(): rollback determinism on a custom loop
+# --------------------------------------------------------------------------
+class TestGuard:
+    def test_rollback_matches_clean_run_on_healthy_batches(self):
+        chaos = _load_chaos()
+        compiles0 = _counter("paddle_tpu_jit_compiles_total")
+        net, opt, lossf = build()
+        s = TrainSentinel(skip_limit=1, healthy_window=2, mark_every=2,
+                          min_history=4)
+        # poisoned region: guarded-step grad hits 5..7 (seeded, scheduled)
+        with faults.inject("train.grads", call=_nan_grads(net),
+                           after=4, times=3):
+            loader = chaos._guarded_run(s, net, opt, lossf, steps=16)
+        assert s.rollbacks >= 1
+        # zero extra XLA compiles versus an unguarded (eager) run
+        assert _counter("paddle_tpu_jit_compiles_total") == compiles0
+        excluded = chaos._excluded_from_journal(s.journal())
+        assert excluded
+        # clean run: replay the same stream to the same final position,
+        # updating only on batches outside the quarantine
+        net2, opt2 = chaos._clean_replay(lossf, excluded,
+                                         loader.state_dict())
+        got, want = params_of(net, opt), params_of(net2, opt2)
+        for k, v in want.items():
+            assert np.array_equal(got[k], v), f"leaf {k} diverged"
+
+    def test_in_memory_rollback_truly_rewinds_params(self):
+        """Review regression: the in-memory mark must DETACH the model
+        state — ``state_dict()`` hands back the live Parameters the
+        optimizer mutates in place, so an un-detached snapshot makes
+        rollback a silent params no-op once any healthy update lands
+        between the mark and the incident."""
+        net, opt, lossf = build()
+        loader = DataLoader(RegressionDS(), batch_size=4)
+        # mark_every=100: the only mark is the forced init mark (step 0),
+        # so every healthy update below lands BETWEEN mark and rollback
+        s = TrainSentinel(skip_limit=0, healthy_window=2, mark_every=100)
+        s.bind(model=net, optimizer=opt, dataloader=loader)  # no manager
+        s.note_epoch(0)
+        assert s.last_good_step == 0
+        marked = params_of(net, opt)
+        guarded = s.guard(lambda x, y: lossf(net(x), y), optimizer=opt)
+        it = iter(loader)
+        for _ in range(4):                       # healthy updates PAST it
+            guarded(*next(it))
+        moved = params_of(net, opt)
+        assert not np.array_equal(moved["net.weight"],
+                                  marked["net.weight"])
+        with faults.inject("train.grads", call=_nan_grads(net), times=1):
+            rep = guarded(*next(it))
+        assert rep.rolled_back and rep.info["step"] == 0
+        got = params_of(net, opt)
+        for k, v in marked.items():
+            assert np.array_equal(got[k], v), f"leaf {k} not rewound"
+
+
+# --------------------------------------------------------------------------
+# Model.fit wiring
+# --------------------------------------------------------------------------
+def _fit_model(lr=0.05):
+    pt.seed(0)
+    net = pt.nn.Linear(3, 1)
+    m = pt.Model(net)
+    m.prepare(pt.optimizer.AdamW(learning_rate=lr,
+                                 parameters=net.parameters()),
+              pt.nn.MSELoss())
+    return m
+
+
+class TestFitIntegration:
+    def test_fit_skip_surfaces_in_logs(self):
+        m = _fit_model()
+        s = TrainSentinel(skip_limit=5, healthy_window=2)
+        seen = {}
+
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Spy(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.update(logs or {})
+
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=3, times=1):
+            m.fit(RegressionDS(), batch_size=4, epochs=1, verbose=0,
+                  sentinel=s, callbacks=[Spy()])
+        assert s.skipped_batches == 1
+        assert seen.get("skipped_batches") == 1
+
+    def test_fit_rollback_restarts_epoch_and_completes(self, tmp_path):
+        m = _fit_model()
+        s = TrainSentinel(skip_limit=0, healthy_window=2)
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=3, times=1):
+            m.fit(RegressionDS(), batch_size=4, epochs=2, verbose=0,
+                  checkpoint_dir=str(tmp_path / "ck"), sentinel=s)
+        assert s.rollbacks == 1
+        # the rolled-back epoch still completed (restart ran it to the
+        # end), so both epoch markers committed
+        assert ck.CheckpointManager(str(tmp_path / "ck")).all_steps() \
+            == [0, 1]
+
+    def test_rollback_mid_epoch_does_not_record_epoch(self, tmp_path):
+        """Regression (ISSUE 9 satellite): a sentinel rollback mid-epoch,
+        with training stopping before the restarted pass finishes, must
+        not let the resume=True path record the epoch as done — the
+        sibling of the existing num_iters mid-epoch guard."""
+        m = _fit_model()
+        s = TrainSentinel(skip_limit=0, healthy_window=2)
+        d = str(tmp_path / "ck")
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=3, times=1):
+            m.fit(RegressionDS(), batch_size=4, epochs=2, verbose=0,
+                  checkpoint_dir=d, sentinel=s, num_iters=4)
+        assert s.rollbacks == 1
+        assert ck.CheckpointManager(d).all_steps() == []
+        # rerunning resumes from scratch and trains the epoch it never
+        # recorded
+        m2 = _fit_model()
+        m2.fit(RegressionDS(), batch_size=4, epochs=1, verbose=0,
+               checkpoint_dir=d)
+        assert ck.CheckpointManager(d).all_steps() == [0]
+
+    def test_cross_epoch_rollback_refreshes_epoch_marker(self, tmp_path):
+        """Review regression: when a rollback lands in a previous epoch
+        and fit replays its tail, the already-committed epoch marker must
+        be REPLACED — the old one holds the pre-rollback timeline, and
+        resume would silently resurrect it."""
+        m = _fit_model()
+        s = TrainSentinel(skip_limit=1, healthy_window=2, min_history=4)
+        d = str(tmp_path / "ck")
+        # hits 6-9: the incident straddles the epoch 0 -> 1 boundary
+        # (8 batches per epoch), so the rollback targets an epoch-0 mark
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=5, times=4):
+            m.fit(RegressionDS(), batch_size=4, epochs=3, verbose=0,
+                  checkpoint_dir=d, sentinel=s)
+        rollback_epochs = [e.get("epoch") for e in s.journal()
+                           if e["event"] == "rollback"]
+        assert s.rollbacks >= 1
+        mgr = ck.CheckpointManager(d)
+        assert mgr.all_steps() == [0, 1, 2]
+        state, _ = mgr.restore(0)
+        # the re-committed epoch-0 marker carries the POST-incident
+        # sentinel state (the pre-rollback save had an empty journal)
+        assert "rollback" in state["sentinel"]["json"]
+        assert 0 in rollback_epochs or 1 in rollback_epochs
+
+    def test_fit_resume_restores_sentinel_journal(self, tmp_path):
+        d = str(tmp_path / "ck")
+        m = _fit_model()
+        s = TrainSentinel(skip_limit=5, healthy_window=2)
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=3, times=1):
+            m.fit(RegressionDS(), batch_size=4, epochs=1, verbose=0,
+                  checkpoint_dir=d, sentinel=s)
+        assert s.journal()
+        # "new process": fresh model + fresh sentinel resume mid-run
+        m2 = _fit_model()
+        s2 = TrainSentinel(skip_limit=5, healthy_window=2)
+        m2.fit(RegressionDS(), batch_size=4, epochs=2, verbose=0,
+               checkpoint_dir=d, sentinel=s2)
+        assert [e for e in s2.journal() if e["event"] == "anomaly"] \
+            == [e for e in s.journal() if e["event"] == "anomaly"]
+
+    def test_sentinel_requires_prepare_and_no_accumulation(self):
+        m = pt.Model(pt.nn.Linear(3, 1))
+        with pytest.raises(RuntimeError):
+            m.fit(RegressionDS(), sentinel=TrainSentinel(), verbose=0)
+        m2 = _fit_model()
+        with pytest.raises(ValueError):
+            m2.fit(RegressionDS(), sentinel=TrainSentinel(),
+                   accumulate_grad_batches=2, verbose=0)
+
+
+# --------------------------------------------------------------------------
+# watchdog wiring: hung step -> health degraded -> checkpoint-and-abort
+# --------------------------------------------------------------------------
+class TestWatchdogWiring:
+    def test_stall_trips_health_without_abort(self):
+        clock = [0.0]
+        s = TrainSentinel(abort_on_stall=False,
+                          watchdog=StepWatchdog(stall_threshold_s=1.0,
+                                                clock=lambda: clock[0]))
+        s.begin_step()
+        clock[0] = 5.0                       # live hang, step still open
+        assert s.watchdog.stalled_now()
+        assert s.health()["status"] == "degraded"
+        assert s.observe(0.5) == s.OK        # step lands over-threshold
+        assert s.stalls == 1
+        assert s.journal()[-1]["event"] == "stall"
+
+    def test_stall_checkpoints_and_aborts(self, tmp_path):
+        net, opt, lossf = build()
+        mgr = ck.CheckpointManager(str(tmp_path / "m"))
+        clock = [0.0]
+        s = TrainSentinel(watchdog=StepWatchdog(stall_threshold_s=1.0,
+                                                clock=lambda: clock[0]))
+        s.bind(model=net, optimizer=opt, manager=mgr)
+        for _ in range(3):
+            s.begin_step()
+            s.observe(0.5)
+            s.after_update(True)
+        s.begin_step()
+        clock[0] = 10.0
+        with pytest.raises(SentinelAbort) as ei:
+            s.observe(0.5)
+        assert ei.value.reason == "stall"
+        # checkpoint-and-exit: the pre-abort state committed
+        assert s.global_step in mgr.all_steps()
+
+    def test_health_cb_over_metrics_server(self):
+        s = TrainSentinel()
+        with metrics.MetricsServer(health_cb=s.health) as srv:
+            import urllib.request
+
+            with urllib.request.urlopen(srv.url + "/healthz") as r:
+                assert r.status == 200
+            s._anomaly_streak = 1
+            try:
+                urllib.request.urlopen(srv.url + "/healthz")
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+
+
+# --------------------------------------------------------------------------
+# satellite: AMP _found_inf skips are counted (and distinct from sentinel)
+# --------------------------------------------------------------------------
+class TestAmpSkipCounter:
+    def test_gradscaler_skip_counts(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.tensor import Tensor
+
+        net, opt, lossf = build()
+        scaler = pt.amp.GradScaler(init_loss_scaling=2.0)
+        x = pt.to_tensor(np.ones((4, 3), "float32"))
+        y = pt.to_tensor(np.zeros((4, 1), "float32"))
+        base = _counter("paddle_tpu_amp_skipped_steps_total")
+        loss = scaler.scale(lossf(net(x), y))
+        loss.backward()
+        net.weight.grad = Tensor(jnp.full_like(net.weight.grad._value,
+                                               jnp.inf))
+        before = params_of(net, opt)
+        scaler.step(opt)
+        assert _counter("paddle_tpu_amp_skipped_steps_total") == base + 1
+        after = params_of(net, opt)
+        for k in before:
+            if k.startswith("net."):
+                assert np.array_equal(before[k], after[k])
+
+    def test_sentinel_skip_does_not_count_as_amp(self):
+        m = _fit_model()
+        base = _counter("paddle_tpu_amp_skipped_steps_total")
+        s = TrainSentinel(skip_limit=5, healthy_window=2)
+        with faults.inject("train.grads", call=_nan_grads(m.network),
+                           after=2, times=1):
+            m.fit(RegressionDS(), batch_size=4, epochs=1, verbose=0,
+                  sentinel=s)
+        assert s.skipped_batches == 1
+        assert _counter("paddle_tpu_amp_skipped_steps_total") == base

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Chaos drill for the training checkpoint stack: kill saves at every
-phase of the commit protocol and prove no work is ever lost.
+"""Chaos drill for the training resilience stack: kill saves at every
+phase of the commit protocol, poison gradients on a schedule, and prove
+no work is ever lost and no anomaly survives.
 
-The operational twin of tests/test_checkpoint_manager.py (docs/
-RESILIENCE.md "Checkpoint commit protocol"): five scenarios arm
-``paddle_tpu.faults`` injections against a real train loop + a
-``checkpoint.CheckpointManager`` —
+The operational twin of tests/test_checkpoint_manager.py and
+tests/test_sentinel.py (docs/RESILIENCE.md "Checkpoint commit protocol" +
+"Self-healing training"): eight scenarios arm ``paddle_tpu.faults``
+injections against a real train loop —
 
 1. crash matrix   — a seeded fault at EVERY save phase (shard write,
                     fsync, manifest, COMMIT marker, publish rename;
@@ -20,11 +21,28 @@ RESILIENCE.md "Checkpoint commit protocol"): five scenarios arm
 4. retention      — GC keeps exactly max_to_keep committed steps;
 5. telemetry      — every failure path moved its counter
                     (saves_total{failed}, corrupt_total, fallback,
-                    last_committed_step gauge).
+                    last_committed_step gauge);
+6. sentinel skip  — seeded NaN gradients at a scheduled step: the
+                    TrainSentinel suppresses exactly that update; final
+                    params + moments bit-identical to a clean run that
+                    never applied the poisoned batch;
+7. sentinel rollback — a persistent NaN region: skip-batch escalates to
+                    rollback to the last-known-good COMMITTED mark
+                    (CheckpointManager.restore, checksum-verified) +
+                    deterministic skip-forward past the quarantined
+                    window; final params + moments bit-identical to a
+                    clean run trained only on the healthy batches, with
+                    ZERO extra XLA compiles (jit counter pinned);
+8. sentinel abort — anomalies that persist through every rollback walk
+                    the full escalation ladder (skip → rollback → LR
+                    re-ramp + widened skip → abort) with exact counters.
 
 Exit code 0 iff every scenario passes.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_train.py
+
+CI: tests/test_chaos_train.py runs every scenario as a slow-marked test
+(``SCENARIOS`` below is the single source of truth).
 """
 import os
 import signal
@@ -273,21 +291,213 @@ def scenario_telemetry(root):
           "histogram all moved")
 
 
+# ----------------------------------------------------------------------
+# sentinel scenarios (6-8): self-healing training, ISSUE 9
+# ----------------------------------------------------------------------
+def _nan_grads(net):
+    """Fault-point callback: poison the live gradients with NaN (the
+    seeded schedule on the ``train.grads`` point decides WHEN)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor import Tensor
+
+    def poison():
+        w = net.weight
+        if w.grad is not None:
+            w.grad = Tensor(jnp.full_like(w.grad._value, jnp.nan))
+    return poison
+
+
+def _guarded_run(sentinel, net, opt, loss, steps):
+    """Drive a guard()-wrapped custom loop for ``steps`` guarded calls;
+    returns the loader so callers can read the final stream position."""
+    loader = DataLoader(RegressionDS(), batch_size=4)
+    sentinel.bind(model=net, optimizer=opt, dataloader=loader)
+    sentinel.note_epoch(0)
+    guarded = sentinel.guard(lambda x, y: loss(net(x), y), optimizer=opt)
+    it, done = iter(loader), 0
+    while done < steps:
+        try:
+            x, y = next(it)
+        except StopIteration:
+            it = iter(loader)
+            continue
+        rep = guarded(x, y)
+        if rep.rolled_back:
+            it = iter(loader)  # restored position + quarantine skip
+        done += 1
+    return loader
+
+
+def _clean_replay(loss_cls, excluded, final):
+    """Reference run: same stream, to the same final position, updating
+    only on batches outside ``excluded`` {(epoch, batch), ...}."""
+    net, opt, loss = build()
+    loader = DataLoader(RegressionDS(), batch_size=4)
+    it, ep, b = iter(loader), 0, 0
+    while (ep, b) != (final["epoch"], final["batch"]):
+        try:
+            x, y = next(it)
+        except StopIteration:
+            it, ep, b = iter(loader), ep + 1, 0
+            continue
+        cur, b = (ep, b), b + 1
+        if cur in excluded:
+            continue
+        l = loss(net(x), y)
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    return net, opt
+
+
+def _excluded_from_journal(journal):
+    excluded = set()
+    for e in journal:
+        if e["event"] == "rollback":
+            d = e["data"]
+            excluded.update((d["epoch"], i) for i in
+                            range(d["batch"], d["batch"] + e["skipped"]))
+        elif e.get("action") == "skip":
+            excluded.add((e["data"]["epoch"], e["data"]["batch"] - 1))
+    return excluded
+
+
+def scenario_sentinel_skip(root):
+    """Seeded NaN injection at one scheduled step -> skip-batch, exact
+    counters, and bit-identity to a clean run without that batch."""
+    from paddle_tpu.faults import TrainSentinel
+
+    net, opt, loss = build()
+    sent = TrainSentinel(skip_limit=2, healthy_window=2, min_history=4)
+    a0 = _counter("paddle_tpu_train_anomalies_total", kind="nonfinite_grad")
+    s0 = _counter("paddle_tpu_train_skipped_batches_total")
+    with faults.inject("train.grads", call=_nan_grads(net), seed=SEED,
+                       after=5, times=1) as spec:
+        loader = _guarded_run(sent, net, opt, loss, steps=14)
+    _check(spec.fired == 1, "NaN fault never fired")
+    _check(sent.skipped_batches == 1 and sent.rollbacks == 0,
+           f"wanted exactly 1 skip, 0 rollbacks; got "
+           f"{sent.skipped_batches}/{sent.rollbacks}")
+    _check(_counter("paddle_tpu_train_anomalies_total",
+                    kind="nonfinite_grad") == a0 + 1,
+           "anomalies_total{nonfinite_grad} did not move exactly once")
+    _check(_counter("paddle_tpu_train_skipped_batches_total") == s0 + 1,
+           "skipped_batches_total did not move exactly once")
+    excluded = _excluded_from_journal(sent.journal())
+    _check(len(excluded) == 1, f"journal window wrong: {excluded}")
+    n2, o2 = _clean_replay(loss, excluded, loader.state_dict())
+    got, want = params_of(net, opt), params_of(n2, o2)
+    bad = [k for k, v in want.items() if not np.array_equal(got[k], v)]
+    _check(not bad, f"guarded run diverged from clean run: {bad}")
+    print("  [ok] sentinel skip: 1 NaN batch suppressed, counters exact, "
+          "params + moments bit-identical to clean run")
+
+
+def scenario_sentinel_rollback(root):
+    """Persistent NaN region -> rollback to the last committed mark +
+    deterministic skip-forward; bit-identity to a clean run on the
+    healthy batches; zero extra XLA compiles."""
+    from paddle_tpu.faults import TrainSentinel
+
+    compiles0 = _counter("paddle_tpu_jit_compiles_total")
+    net, opt, loss = build()
+    mgr = ck.CheckpointManager(os.path.join(root, "marks"))
+    sent = TrainSentinel(skip_limit=1, healthy_window=2, mark_every=2,
+                         min_history=4)
+    sent.bind(manager=mgr)
+    r0 = _counter("paddle_tpu_train_rollbacks_total")
+    with faults.inject("train.grads", call=_nan_grads(net), seed=SEED,
+                       after=5, times=3) as spec:
+        loader = _guarded_run(sent, net, opt, loss, steps=18)
+    _check(spec.fired == 3, f"region fault fired {spec.fired} != 3")
+    _check(sent.rollbacks == 1,
+           f"wanted exactly 1 rollback, got {sent.rollbacks}")
+    _check(_counter("paddle_tpu_train_rollbacks_total") == r0 + 1,
+           "rollbacks_total did not move exactly once")
+    _check(sent.last_good_step is not None
+           and sent.last_good_step in mgr.all_steps() + [sent.global_step],
+           "last-known-good mark not committed")
+    _check(_counter("paddle_tpu_jit_compiles_total") == compiles0,
+           "guarding cost an extra XLA compile")
+    excluded = _excluded_from_journal(sent.journal())
+    _check(excluded, "journal recorded no quarantine window")
+    n2, o2 = _clean_replay(loss, excluded, loader.state_dict())
+    got, want = params_of(net, opt), params_of(n2, o2)
+    bad = [k for k, v in want.items() if not np.array_equal(got[k], v)]
+    _check(not bad, f"rolled-back run diverged from clean run: {bad}")
+    print("  [ok] sentinel rollback: restored committed mark, skipped "
+          f"{sorted(excluded)} deterministically, bit-identical to clean "
+          "run, 0 extra compiles")
+
+
+def scenario_sentinel_abort(root):
+    """Anomalies that survive every rollback exhaust the ladder: skip ->
+    rollback -> LR re-ramp + widened skip -> abort, counters exact."""
+    from paddle_tpu.faults import SentinelAbort, TrainSentinel
+
+    net, opt, loss = build()
+    mgr = ck.CheckpointManager(os.path.join(root, "marks"))
+    sent = TrainSentinel(skip_limit=0, lr_reramp_after=2,
+                         abort_after_rollbacks=2, healthy_window=2)
+    a0 = _counter("paddle_tpu_train_anomalies_total", kind="nonfinite_grad")
+    r0 = _counter("paddle_tpu_train_rollbacks_total")
+    rr0 = _counter("paddle_tpu_train_lr_reramps_total")
+    ab0 = _counter("paddle_tpu_train_aborts_total", reason="rollback_limit")
+    aborted = False
+    try:
+        with faults.inject("train.grads", call=_nan_grads(net), seed=SEED,
+                           after=3):
+            _guarded_run(sent, net, opt, loss, steps=30)
+    except SentinelAbort as exc:
+        aborted = True
+        _check(exc.reason == "rollback_limit",
+               f"abort reason {exc.reason!r} != 'rollback_limit'")
+        _check(exc.journal and exc.journal[-1]["event"] == "abort",
+               "abort journal missing its terminal entry")
+    _check(aborted, "escalation never reached abort")
+    _check(sent.rollbacks == 2, f"rollbacks {sent.rollbacks} != 2")
+    _check(_counter("paddle_tpu_train_anomalies_total",
+                    kind="nonfinite_grad") == a0 + 3,
+           "anomaly counter not exactly 3 (rollback, rollback, abort)")
+    _check(_counter("paddle_tpu_train_rollbacks_total") == r0 + 2,
+           "rollbacks_total not exactly 2")
+    _check(_counter("paddle_tpu_train_lr_reramps_total") == rr0 + 1,
+           "lr_reramps_total not exactly 1")
+    _check(_counter("paddle_tpu_train_aborts_total",
+                    reason="rollback_limit") == ab0 + 1,
+           "aborts_total{rollback_limit} not exactly 1")
+    _check(opt.get_lr() < 0.05, "LR re-ramp never reduced the LR")
+    print("  [ok] sentinel abort: 2 rollbacks + re-ramp + widened skip, "
+          "then SentinelAbort with exact counters and journal")
+
+
+SCENARIOS = [
+    ("crash-matrix", scenario_crash_matrix),
+    ("corruption", scenario_corruption),
+    ("preemption", scenario_preemption),
+    ("retention", scenario_retention),
+    ("telemetry", scenario_telemetry),
+    ("sentinel-skip", scenario_sentinel_skip),
+    ("sentinel-rollback", scenario_sentinel_rollback),
+    ("sentinel-abort", scenario_sentinel_abort),
+]
+
+
 def main():
-    scenarios = [scenario_crash_matrix, scenario_corruption,
-                 scenario_preemption, scenario_retention,
-                 scenario_telemetry]
     failures = 0
     with tempfile.TemporaryDirectory() as root:
-        for fn in scenarios:
-            name = fn.__name__.replace("scenario_", "")
+        for name, fn in SCENARIOS:
             print(f"[chaos_train] {name} (seed={SEED})")
+            faults.reset()
             try:
                 fn(os.path.join(root, name))
             except Exception as exc:  # noqa: BLE001 - drill report
                 failures += 1
                 print(f"  [FAIL] {name}: {exc}")
-    print(f"[chaos_train] {len(scenarios) - failures}/{len(scenarios)} "
+            finally:
+                faults.reset()
+    print(f"[chaos_train] {len(SCENARIOS) - failures}/{len(SCENARIOS)} "
           f"scenarios passed")
     return 1 if failures else 0
 

@@ -66,7 +66,63 @@ def _demo_registry():
         engine.add_request(np.concatenate([shared, [tail]]),
                            max_new_tokens=3)
         engine.run()
+    _demo_train_sentinel()
     return metrics.get_registry()
+
+
+def _demo_train_sentinel():
+    """Tiny sentinel-guarded train loop with one injected NaN batch and a
+    persistent spike region, so the ISSUE 9 training-sentinel series
+    (paddle_tpu_train_anomalies_total{kind}, _rollbacks_total,
+    _skipped_batches_total, _last_good_step, loss/grad-norm histograms)
+    are all live in the --demo snapshot."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import faults
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+    from paddle_tpu.tensor import Tensor
+
+    class DS(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            x = np.float32([i / 32.0, 1.0 - i / 32.0, (i % 5) / 5.0])
+            return x, np.float32([x @ np.float32([0.5, -0.25, 1.0])])
+
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    loss = nn.MSELoss()
+    sent = faults.TrainSentinel(skip_limit=1, healthy_window=2,
+                                min_history=4)
+    loader = DataLoader(DS(), batch_size=4)
+    sent.bind(model=net, optimizer=opt, dataloader=loader)
+    sent.note_epoch(0)
+    guarded = sent.guard(lambda x, y: loss(net(x), y), optimizer=opt)
+
+    def poison():
+        if net.weight.grad is not None:
+            net.weight.grad = Tensor(
+                jnp.full_like(net.weight.grad._value, jnp.nan))
+
+    it, done = iter(loader), 0
+    # hits 6-8 of train.grads: one skip, then an escalation to rollback
+    with faults.inject("train.grads", call=poison, after=5, times=3):
+        while done < 14:
+            try:
+                x, y = next(it)
+            except StopIteration:
+                it = iter(loader)
+                continue
+            if guarded(x, y).rolled_back:
+                it = iter(loader)
+            done += 1
 
 
 def _demo_router_registry():
