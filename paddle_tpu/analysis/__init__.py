@@ -1,0 +1,41 @@
+"""paddle_tpu.analysis — tpulint, the repo's static invariant linter.
+
+Runtime drills prove the stack's invariants one scenario at a time;
+this package proves the *code shape* that makes those drills
+meaningful, on every file, at lint time:
+
+- **TPL001** no host sync (``.item()`` / ``float()`` / ``np.asarray`` /
+  ``device_get``) inside a compiled scope — the one-fetch discipline.
+- **TPL002** no retrace hazards: Python branches / f-strings over
+  traced values, time- or random-derived scalars into compiled
+  callables — "decode compiles exactly once" as a lint property.
+- **TPL003** metric-catalog parity with docs/OBSERVABILITY.md, both
+  directions, plus label-set consistency across ``.labels()`` sites.
+- **TPL004** fault-point parity with docs/RESILIENCE.md, both ways.
+- **TPL005** no unseeded randomness under serving/faults/checkpoint —
+  the (prompt, seed) determinism contract.
+- **TPL006** declared shared containers mutate only under their lock.
+
+CLI: ``python tools/tpulint.py paddle_tpu tools examples`` (add
+``--json`` for CI-diffable output). Suppress one site with
+``# tpulint: disable=TPL00N``; accept a pre-existing finding in
+``tools/tpulint_baseline.json``. Full catalog: docs/ANALYSIS.md.
+
+Stdlib-only and importable WITHOUT jax or the rest of paddle_tpu —
+``tools/tpulint.py`` loads it standalone so the linter can gate a
+commit that breaks the package import itself.
+"""
+from .catalog import (parse_fault_doc, parse_metric_doc,
+                      sanitize_metric_name)
+from .core import (Finding, LintConfig, LintResult, ModuleInfo, Project,
+                   iter_py_files, lint_paths, load_baseline, parse_module,
+                   split_baseline, to_json, to_text, write_baseline)
+from .rules import FILE_RULES, PROJECT_RULES, RULE_IDS
+
+__all__ = [
+    "FILE_RULES", "Finding", "LintConfig", "LintResult", "ModuleInfo",
+    "PROJECT_RULES", "Project", "RULE_IDS", "iter_py_files", "lint_paths",
+    "load_baseline", "parse_fault_doc", "parse_metric_doc", "parse_module",
+    "sanitize_metric_name", "split_baseline", "to_json", "to_text",
+    "write_baseline",
+]

@@ -1,0 +1,347 @@
+"""tpulint core: findings, suppressions, baseline, and the lint driver.
+
+The static half of the repo's invariants (docs/ANALYSIS.md): runtime
+tests prove "decode compiles once" / "no host sync in the step" /
+"catalogs match the code" one drill at a time; this pass makes each of
+them a property the repo cannot silently lose — a rule fires at the
+commit that breaks the invariant, not at the incident that reveals it.
+
+Stdlib-only **and paddle_tpu-import-free by design**: the linter must
+run (and CI must gate on it) without importing jax or the package under
+analysis — ``tools/tpulint.py`` loads this package standalone, so a
+broken ``paddle_tpu/__init__`` can't take the linter down with it.
+
+Vocabulary:
+
+- **Finding** — one rule violation at ``path:line:col``. Its identity
+  for baseline purposes is ``(rule, path, message)`` — line numbers are
+  display-only, so unrelated edits above a baselined finding don't
+  churn the baseline file.
+- **Suppression** — ``# tpulint: disable=TPL001`` (comma-list or
+  ``all``) on the flagged line, or on a comment-only line directly
+  above it. Suppressions are counted, never silent.
+- **Baseline** — ``tools/tpulint_baseline.json``: findings that predate
+  the rule and are accepted with a per-entry note. The CLI exits 0 when
+  every finding is baselined; ``--write-baseline`` regenerates the file.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "LintConfig", "LintResult", "ModuleInfo", "Project",
+    "iter_py_files", "lint_paths", "load_baseline", "parse_module",
+    "split_baseline", "to_json", "to_text", "write_baseline",
+]
+
+_DISABLE_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``key`` (rule, path, message) is the stable
+    identity the baseline matches on; ``line``/``col`` locate it for
+    humans and for same-line suppressions."""
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class LintConfig:
+    """Where the repo lives and where the doc catalogs are. Tests point
+    the doc paths at fixture files; the CLI uses the repo defaults."""
+
+    root: str
+    observability_doc: Optional[str] = None   # default <root>/docs/OBSERVABILITY.md
+    resilience_doc: Optional[str] = None      # default <root>/docs/RESILIENCE.md
+    # TPL005 only patrols the paths whose correctness depends on seeded
+    # determinism (PR 7's contract); fixtures widen this to ("",).
+    tpl005_scopes: Tuple[str, ...] = (
+        "paddle_tpu/serving", "paddle_tpu/faults", "paddle_tpu/checkpoint")
+    # TPL003's code->docs direction only demands documentation for
+    # instruments registered inside the package itself — a demo script
+    # registering a scratch series shouldn't gate CI.
+    metric_doc_scope: str = "paddle_tpu"
+
+    def __post_init__(self):
+        self.root = os.path.abspath(self.root)
+        if self.observability_doc is None:
+            self.observability_doc = os.path.join(
+                self.root, "docs", "OBSERVABILITY.md")
+        if self.resilience_doc is None:
+            self.resilience_doc = os.path.join(
+                self.root, "docs", "RESILIENCE.md")
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus everything rules need: the AST, the
+    raw lines, and the per-line suppression map."""
+
+    path: str                  # absolute
+    relpath: str               # repo-relative, posix
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """Same-line disable, or a disable on a comment-only line
+        directly above the finding."""
+        for cand in (line, line - 1):
+            rules = self.suppressions.get(cand)
+            if rules is None:
+                continue
+            if cand == line - 1 and not _COMMENT_ONLY_RE.match(
+                    self.lines[cand - 1] if cand - 1 < len(self.lines)
+                    else ""):
+                continue
+            if "all" in rules or rule in rules:
+                return True
+        return False
+
+
+@dataclass
+class Project:
+    """Everything the repo-level rules see: all parsed modules plus the
+    doc catalogs named by the config. ``full_scope`` is True when the
+    lint run covers the whole registration universe (the repo root or
+    the paddle_tpu package) — the docs→code parity direction only runs
+    then, so a targeted lint of one file isn't drowned in 'documented
+    but unregistered' findings whose registration sites simply weren't
+    in the linted subset."""
+
+    config: LintConfig
+    modules: List[ModuleInfo] = field(default_factory=list)
+    full_scope: bool = True
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int = 0
+    files: int = 0
+    baselined: int = 0
+
+
+def _collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Per-physical-line ``# tpulint: disable=...`` map, via tokenize so
+    a disable string inside a literal never arms a suppression."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparseable files already yield a TPL000 finding; no
+        # suppressions is the safe default
+        pass
+    return out
+
+
+def parse_module(path: str, root: str) -> Tuple[Optional[ModuleInfo],
+                                                Optional[Finding]]:
+    relpath = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, Finding("TPL000", relpath, e.lineno or 1, e.offset or 0,
+                             f"syntax error: {e.msg}")
+    except OSError as e:
+        return None, Finding("TPL000", relpath, 1, 0, f"unreadable: {e}")
+    mod = ModuleInfo(path=os.path.abspath(path), relpath=relpath,
+                     source=source, tree=tree,
+                     lines=source.splitlines(),
+                     suppressions=_collect_suppressions(source))
+    return mod, None
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    """Expand files/dirs into a sorted, de-duplicated .py file list.
+    ``__pycache__`` and hidden directories are skipped."""
+    seen: Set[str] = set()
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(p):
+            # a typo'd path must fail loudly — a gate that silently
+            # lints nothing is worse than no gate
+            raise FileNotFoundError(f"lint path does not exist: {p}")
+        if os.path.isfile(p):
+            if not p.endswith(".py"):
+                # same fail-loudly contract as the missing-path case:
+                # a lane pointed at a .pyi/.pyc/doc file must not
+                # "pass" by linting nothing
+                raise ValueError(f"not a .py file: {p}")
+            cand = [p]
+        else:
+            cand = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                cand.extend(os.path.join(dirpath, f)
+                            for f in sorted(filenames) if f.endswith(".py"))
+        for c in cand:
+            c = os.path.abspath(c)
+            if c not in seen:
+                seen.add(c)
+                out.append(c)
+    return out
+
+
+def lint_paths(paths: Sequence[str], config: LintConfig) -> LintResult:
+    """Parse every file under ``paths``, run the per-file rules, then
+    the repo-level (catalog-parity) rules, and apply suppressions."""
+    from .rules import FILE_RULES, PROJECT_RULES
+
+    roots = {os.path.abspath(config.root),
+             os.path.join(os.path.abspath(config.root), "paddle_tpu")}
+    expanded = {os.path.abspath(p if os.path.isabs(p)
+                                else os.path.join(config.root, p))
+                for p in paths}
+    project = Project(config=config,
+                      full_scope=bool(roots & expanded))
+    findings: List[Finding] = []
+    files = iter_py_files(paths, config.root)
+    for path in files:
+        mod, err = parse_module(path, config.root)
+        if err is not None:
+            findings.append(err)
+            continue
+        project.modules.append(mod)
+
+    for mod in project.modules:
+        for rule in FILE_RULES:
+            findings.extend(rule.check(mod, config))
+    for rule in PROJECT_RULES:
+        findings.extend(rule.check_project(project))
+
+    kept: List[Finding] = []
+    suppressed = 0
+    by_path = {m.relpath: m for m in project.modules}
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+    return LintResult(findings=kept, suppressed=suppressed, files=len(files))
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a tpulint baseline "
+                         "(expected {{'version': 1, 'entries': [...]}})")
+    entries = list(data["entries"])
+    for i, e in enumerate(entries):
+        # validate here so a hand-edit/bad merge is a clean exit-2
+        # "bad baseline", not an AttributeError deep in split_baseline
+        # masquerading as exit-1 findings
+        if not isinstance(e, dict):
+            raise ValueError(f"{path}: entries[{i}] is not an object")
+    return entries
+
+
+def split_baseline(findings: Sequence[Finding],
+                   entries: Sequence[dict]) -> Tuple[List[Finding],
+                                                     List[Finding]]:
+    """(new, baselined): a finding is baselined when an entry matches
+    its (rule, path, message) key. One entry absorbs any number of
+    identical findings (e.g. the same message at two call sites)."""
+    keys = {(e.get("rule"), e.get("path"), e.get("message"))
+            for e in entries}
+    new = [f for f in findings if f.key not in keys]
+    old = [f for f in findings if f.key in keys]
+    return new, old
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Regenerate the baseline. Notes of entries whose (rule, path,
+    message) key survives are PRESERVED — regeneration must never
+    destroy curated justifications; only new entries get the TODO."""
+    kept_notes: Dict[Tuple[str, str, str], str] = {}
+    if os.path.isfile(path):
+        try:
+            for e in load_baseline(path):
+                key = (e.get("rule"), e.get("path"), e.get("message"))
+                if e.get("note"):
+                    kept_notes[key] = e["note"]
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass    # unreadable old baseline: regenerate from scratch
+    entries = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({"rule": f.rule, "path": f.path, "line": f.line,
+                        "message": f.message,
+                        "note": kept_notes.get(f.key,
+                                               "TODO: justify or fix")})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2,
+                  sort_keys=False)
+        fh.write("\n")
+
+
+# ------------------------------------------------------------------ output
+def to_text(result: LintResult, new: Sequence[Finding]) -> str:
+    lines = [f.render() for f in new]
+    lines.append(f"tpulint: {len(new)} finding(s) "
+                 f"({result.baselined} baselined, "
+                 f"{result.suppressed} suppressed, "
+                 f"{result.files} files)")
+    return "\n".join(lines)
+
+
+def to_json(result: LintResult, new: Sequence[Finding]) -> str:
+    """Stable (sorted, timestamp-free) JSON for diffing in CI logs."""
+    payload = {
+        "version": 1,
+        "files": result.files,
+        "suppressed": result.suppressed,
+        "baselined": result.baselined,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "col": f.col, "message": f.message}
+            for f in new],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,382 @@
+"""Traced-context tracking: which functions compile, which values trace.
+
+Two questions every compiled-code rule needs answered:
+
+1. **Which function bodies run under a tracer?** Detected per module:
+   ``@jit`` / ``@to_static`` / ``@jax.jit``-style decorators, local
+   functions passed by name into ``jit.StaticFunction(...)`` /
+   ``jax.jit(...)`` / ``to_static(...)`` / ``BucketedFunction(...)``
+   (the engine's ``prefill_fn``/``step_fn`` idiom — renamed to
+   ``serving_prefill``/``serving_decode`` via ``__name__`` for the
+   compile counter, which is also recognized), and every function
+   lexically nested inside one (helpers like the decode step's
+   ``batched_sample``/``one_row`` trace with their parent).
+
+2. **Which values inside such a body are traced?** A lightweight taint
+   pass: the function's parameters seed the traced set; assignments,
+   loop targets, and comprehensions propagate it. Static escapes —
+   ``.shape`` / ``.dtype`` / ``.ndim`` / ``.size`` / ``len()`` — yield
+   Python values at trace time and drop the taint, so ``h.shape[-1]``
+   in an index position never fires a rule. Results of ``jnp.*`` /
+   ``jax.*`` calls are traced regardless of their arguments (a
+   ``jnp.zeros(())`` is a tracer even with constant args).
+
+The tracker is deliberately *per-module* and *syntactic*: no imports
+are resolved, no cross-file calls followed. That keeps false positives
+low (a trunk's ``forward`` is only linted when something in the same
+file compiles it) at the cost of not chasing invariants through call
+chains — the runtime drills still own that half.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["CompiledScopes", "Taint", "dotted_name"]
+
+# a call whose callee ends in one of these wraps/compiles its function
+# argument (jit.StaticFunction, jax.jit, paddle.jit.to_static, pjit, ...)
+_WRAPPER_TAILS = {"StaticFunction", "jit", "to_static", "pjit",
+                  "BucketedFunction"}
+# decorator names that mark the decorated def itself as compiled
+_DECORATOR_TAILS = _WRAPPER_TAILS
+# fn.__name__ = "<one of these>" marks fn as a compiled step fn even if
+# the wrap happens in code the walker can't see
+_KNOWN_COMPILED_NAMES = {"serving_prefill", "serving_decode"}
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+# methods whose RESULT is a host value, not a tracer — calling them on
+# a traced receiver is TPL001's finding; their result must not keep
+# propagating taint (float(x.item()) is one sync, one finding)
+_HOST_RESULT_METHODS = {"item", "tolist", "numpy"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "id", "print"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class CompiledScopes:
+    """Per-module index of compiled function defs (and why)."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        # name -> every def with that name, any nesting level
+        self._defs: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+        self.compiled: Dict[ast.AST, str] = {}
+        self._mark_decorated()
+        self._mark_wrapped()
+        self._mark_renamed()
+        self._mark_nested()
+        # names/attrs bound to compiled-callable objects in this module
+        # (for the TPL002 call-site check): "prog", "self._decode_prog"
+        self.compiled_bindings: Dict[str, Tuple[int, str]] = {}
+        self._collect_bindings()
+
+    # ---------------------------------------------------------- detection
+    def _mark(self, fn: ast.AST, reason: str) -> None:
+        self.compiled.setdefault(fn, reason)
+
+    def _mark_decorated(self) -> None:
+        for defs in self._defs.values():
+            for fn in defs:
+                for dec in fn.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    tail = _tail(target)
+                    if tail in _DECORATOR_TAILS:
+                        self._mark(fn, f"decorated @{tail}")
+                    # @functools.partial(jax.jit, ...)
+                    if (isinstance(dec, ast.Call)
+                            and _tail(dec.func) == "partial" and dec.args
+                            and _tail(dec.args[0]) in _WRAPPER_TAILS):
+                        self._mark(fn, "decorated @partial(jit)")
+
+    def _mark_wrapped(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _tail(node.func) not in _WRAPPER_TAILS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in self._defs:
+                    for fn in self._defs[arg.id]:
+                        self._mark(fn, f"passed to {_tail(node.func)}()")
+
+    def _mark_renamed(self) -> None:
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and node.targets[0].attr == "__name__"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value in _KNOWN_COMPILED_NAMES
+                    and isinstance(node.targets[0].value, ast.Name)):
+                for fn in self._defs.get(node.targets[0].value.id, []):
+                    self._mark(fn, f"renamed to {node.value.value!r}")
+
+    def _mark_nested(self) -> None:
+        for fn in list(self.compiled):
+            for sub in ast.walk(fn):
+                if (sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef))):
+                    self._mark(sub, f"nested in compiled {fn.name!r}")
+
+    def _collect_bindings(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and _tail(value.func) in _WRAPPER_TAILS):
+                continue
+            for t in node.targets:
+                name = dotted_name(t)
+                if name:
+                    self.compiled_bindings[name] = (
+                        node.lineno, _tail(value.func) or "jit")
+
+
+class Taint:
+    """Traced-value taint inside ONE compiled function body.
+
+    Single forward pass in source order. Taint is **position-gated**:
+    ``traced`` maps each name to the first line from which it carries a
+    tracer, and a ``Name`` use only counts as traced at or after that
+    line — so ``n = 4; for i in range(n): ...; n = x * 2`` does not
+    retroactively flag the loop. Loops don't iterate to a fixpoint
+    (taint flowing textually backward inside a loop body is a miss) —
+    consistent with the errs-toward-silence policy; the runtime drills
+    own that residue. Comprehension variables are scoped to the
+    comprehension, as in Python 3."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        # name -> first line (inclusive) from which it is traced
+        self.traced: Dict[str, int] = {}
+        # name -> [(start, end)) intervals closed by a later rebind to
+        # an untraced value — `n = x * 2; n = 0` stops carrying taint
+        # at the second assignment
+        self.closed: Dict[str, List[Tuple[int, int]]] = {}
+        self._taint_params(fn)
+        for stmt in fn.body:
+            self._visit_stmt(stmt)
+
+    def _taint_params(self, fn: ast.AST) -> None:
+        args = fn.args
+        names = [a.arg for a in (list(args.posonlyargs) + list(args.args)
+                                 + list(args.kwonlyargs))]
+        if args.vararg is not None:
+            names.append(args.vararg.arg)
+        if args.kwarg is not None:
+            names.append(args.kwarg.arg)
+        for n in names:
+            self._taint_name(n, fn.lineno)
+
+    def _taint_name(self, name: str, line: int) -> None:
+        prev = self.traced.get(name)
+        if prev is None or line < prev:
+            self.traced[name] = line
+
+    def _untaint_name(self, name: str, line: int) -> None:
+        start = self.traced.pop(name, None)
+        if start is not None and start < line:
+            self.closed.setdefault(name, []).append((start, line))
+
+    # ------------------------------------------------------------ traversal
+    def _visit_stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs trace with the parent; their params join the
+            # traced set under their own names
+            self._taint_params(node)
+            for stmt in node.body:
+                self._visit_stmt(stmt)
+            return
+        self._scan_named_exprs(node)
+        if isinstance(node, ast.Assign):
+            if self.is_traced(node.value):
+                for t in node.targets:
+                    self._taint_target(t, node.lineno)
+            else:
+                for t in node.targets:
+                    self._untaint_target(t, node.lineno)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                if self.is_traced(node.value):
+                    self._taint_target(node.target, node.lineno)
+                else:
+                    self._untaint_target(node.target, node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            if self.is_traced(node.value) or self.is_traced(node.target):
+                self._taint_target(node.target, node.lineno)
+        elif isinstance(node, ast.For):
+            if self.is_traced(node.iter):
+                self._taint_target(node.target, node.lineno)
+            else:
+                self._untaint_target(node.target, node.lineno)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if (item.optional_vars is not None
+                        and self.is_traced(item.context_expr)):
+                    self._taint_target(item.optional_vars, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            # excepthandler / match_case are not stmt subclasses but
+            # carry statement bodies — skipping them would blind the
+            # taint pass to everything inside except/case blocks
+            if isinstance(child, (ast.stmt, ast.excepthandler,
+                                  ast.match_case)):
+                self._visit_stmt(child)
+
+    def _scan_named_exprs(self, node: ast.AST) -> None:
+        """Walrus targets bind in the enclosing scope: taint (or
+        untaint) them from THIS statement's expressions, without
+        descending into nested statements — those bind at their own
+        visit."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler,
+                                  ast.match_case)):
+                continue
+            if isinstance(child, ast.NamedExpr):
+                if self.is_traced(child.value):
+                    self._taint_target(child.target, child.lineno)
+                else:
+                    self._untaint_target(child.target, child.lineno)
+            self._scan_named_exprs(child)
+
+    def _taint_target(self, target: ast.AST, line: int) -> None:
+        for n in self._target_names(target):
+            self._taint_name(n, line)
+
+    def _untaint_target(self, target: ast.AST, line: int) -> None:
+        for n in self._target_names(target):
+            self._untaint_name(n, line)
+
+    @staticmethod
+    def _target_names(target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in target.elts:
+                out.extend(Taint._target_names(elt))
+            return out
+        if isinstance(target, ast.Starred):
+            return Taint._target_names(target.value)
+        # subscript/attribute stores mutate an existing (already
+        # traced-or-not) object; nothing new to taint
+        return []
+
+    def _comp_is_traced(self, node: ast.AST, parts: List[ast.AST]) -> bool:
+        """Comprehension query with the loop variables tainted only for
+        the duration of the evaluation — they are scoped in Python 3
+        and must not leak into the enclosing body."""
+        saved: Dict[str, Optional[int]] = {}
+        for gen in node.generators:
+            if self.is_traced(gen.iter):
+                for n in self._target_names(gen.target):
+                    if n not in saved:
+                        saved[n] = self.traced.get(n)
+                    self.traced[n] = 0      # active at any line inside
+        try:
+            return any(self.is_traced(p) for p in parts)
+        finally:
+            for n, prev in saved.items():
+                if prev is None:
+                    self.traced.pop(n, None)
+                else:
+                    self.traced[n] = prev
+
+    # ------------------------------------------------------------ queries
+    def is_traced(self, node: ast.AST) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            line = getattr(node, "lineno", None)
+            since = self.traced.get(node.id)
+            if since is not None and (line is None or line >= since):
+                return True
+            if line is not None:
+                return any(start <= line < end for start, end
+                           in self.closed.get(node.id, ()))
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False        # static at trace time
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            tail = _tail(node.func)
+            if tail in _STATIC_CALLS:
+                return False
+            root = dotted_name(node.func) or ""
+            if root.split(".", 1)[0] in ("jnp", "jax"):
+                return True         # jnp/jax results are tracers
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr not in _HOST_RESULT_METHODS
+                    and self.is_traced(node.func.value)):
+                # method call on a traced receiver — x.sum(),
+                # x.astype(...): the paddle-style method API returns
+                # tracers just like the jnp.* spelling
+                return True
+            return (any(self.is_traced(a) for a in node.args)
+                    or any(self.is_traced(kw.value)
+                           for kw in node.keywords))
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not y` are identity checks on the
+            # PYTHON object — static under trace, never a sync
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self.is_traced(node.left)
+                    or any(self.is_traced(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self.is_traced(node.body) or self.is_traced(node.test)
+                    or self.is_traced(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return (any(k is not None and self.is_traced(k)
+                        for k in node.keys)
+                    or any(self.is_traced(v) for v in node.values))
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_traced(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._comp_is_traced(node, [node.elt])
+        if isinstance(node, ast.DictComp):
+            return self._comp_is_traced(node, [node.key, node.value])
+        if isinstance(node, ast.JoinedStr):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Lambda):
+            return False
+        return False

@@ -205,10 +205,12 @@ def declare_point(name: str, description: str = "") -> str:
     """Register a fault point in the catalog (docs/RESILIENCE.md is the
     human copy; ``known_points()`` the live one). Call at import time
     next to the subsystem that owns the ``point()`` site."""
-    _catalog[str(name)] = str(description)
+    with _lock:
+        _catalog[str(name)] = str(description)
     return name
 
 
 def known_points() -> Dict[str, str]:
     """Declared fault points: name -> description."""
-    return dict(_catalog)
+    with _lock:
+        return dict(_catalog)

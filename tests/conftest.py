@@ -46,6 +46,11 @@ def pytest_configure(config):
         "sentinel: paddle_tpu.faults.TrainSentinel self-healing-training "
         "suite — detectors, escalation state machine, rollback-and-skip "
         "(tier-1 fast lane)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: paddle_tpu.analysis tpulint suite — rule fixture "
+        "corpus, suppression/baseline round-trips, full-repo zero-finding "
+        "gate (tier-1 fast lane)")
 
 
 @pytest.fixture(autouse=True)

@@ -24,6 +24,12 @@ Output goes to stdout, or --out FILE. Examples:
   python tools/metrics_dump.py --demo | jq '.paddle_tpu_serving_ttft_seconds'
   python tools/metrics_dump.py --demo --router --prometheus | grep router_
   python tools/metrics_dump.py --url http://127.0.0.1:9100 --out snap.json
+
+--check-docs diffs the LIVE registry against the docs/OBSERVABILITY.md
+catalog through the same parser tpulint's TPL003 rule uses — the
+runtime cross-check of the static rule. A live family missing from the
+docs exits 1; documented families the workload didn't light up are
+listed informationally (a --demo run can't touch every subsystem).
 """
 from __future__ import annotations
 
@@ -172,6 +178,64 @@ def _demo_router_registry():
     return metrics.get_registry()
 
 
+def _load_analysis(root):
+    """paddle_tpu.analysis without importing paddle_tpu (which pulls
+    jax): a scrape-only monitoring host running `--url --check-docs`
+    has no jax. Same standalone spec load as tools/tpulint.py; the
+    package import is used when it is already loaded (e.g. --demo)."""
+    if "paddle_tpu" in sys.modules:
+        from paddle_tpu import analysis
+        return analysis
+    name = "_metrics_dump_analysis"
+    if name not in sys.modules:
+        import importlib.util
+        pkg_dir = os.path.join(root, "paddle_tpu", "analysis")
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(pkg_dir, "__init__.py"),
+            submodule_search_locations=[pkg_dir])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def _check_docs(live_names, root):
+    """Diff live metric families against the docs/OBSERVABILITY.md
+    catalog via paddle_tpu.analysis.catalog (the TPL003 parser — one
+    grammar, two checkers). Returns the exit code."""
+    parse_metric_doc = _load_analysis(root).parse_metric_doc
+
+    doc_path = os.path.join(root, "docs", "OBSERVABILITY.md")
+    documented = set(parse_metric_doc(doc_path))
+    live = set(live_names)
+    if not live:
+        # same fail-loudly contract as tpulint's empty lint path: a
+        # parity gate that checked zero families must not pass green
+        print("check-docs: ERROR: live registry is empty — nothing to "
+              "check (did you want --demo or --url?)")
+        return 1
+    undocumented = sorted(live - documented)
+    dark = sorted(documented - live)
+    print(f"check-docs: {len(live)} live famil"
+          f"{'y' if len(live) == 1 else 'ies'}, "
+          f"{len(documented)} documented")
+    if dark:
+        print(f"  note: {len(dark)} documented famil"
+              f"{'y' if len(dark) == 1 else 'ies'} not exercised by this "
+              f"workload (expected for subsystems the run didn't touch):")
+        for n in dark:
+            print(f"    - {n}")
+    if undocumented:
+        print(f"  ERROR: {len(undocumented)} live famil"
+              f"{'y' if len(undocumented) == 1 else 'ies'} missing from "
+              f"docs/OBSERVABILITY.md:")
+        for n in undocumented:
+            print(f"    - {n}")
+        return 1
+    print("  every live family is documented")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", help="scrape a running MetricsServer "
@@ -184,19 +248,31 @@ def main(argv=None):
                          "engine, lighting up the router metrics")
     ap.add_argument("--prometheus", action="store_true",
                     help="text exposition instead of JSON")
+    ap.add_argument("--check-docs", action="store_true",
+                    help="instead of dumping, diff the live registry "
+                         "against the docs/OBSERVABILITY.md catalog "
+                         "(shared TPL003 parser); exit 1 on an "
+                         "undocumented live family")
     ap.add_argument("--out", help="write here instead of stdout")
     args = ap.parse_args(argv)
+    if args.check_docs and (args.out or args.prometheus):
+        ap.error("--check-docs prints a diff report, not a snapshot — "
+                 "it cannot honor --out/--prometheus")
     if args.url and args.demo:
         ap.error("--url and --demo are mutually exclusive")
     if args.router and not args.demo:
         ap.error("--router is a --demo mode (a live fleet is scraped "
                  "with --url)")
 
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
     if args.url:
-        path = "/metrics" if args.prometheus else "/metrics.json"
+        path = ("/metrics.json" if args.check_docs
+                else "/metrics" if args.prometheus else "/metrics.json")
         with urllib.request.urlopen(args.url.rstrip("/") + path,
                                     timeout=10) as r:
             body = r.read().decode()
+        if args.check_docs:
+            return _check_docs(json.loads(body).keys(), root)
         text = body if args.prometheus else json.dumps(json.loads(body),
                                                        indent=2)
     else:
@@ -211,6 +287,8 @@ def main(argv=None):
                 print("warning: default registry is empty (no workload "
                       "ran in this process) — did you want --demo or "
                       "--url?", file=sys.stderr)
+        if args.check_docs:
+            return _check_docs(reg.snapshot().keys(), root)
         text = (reg.expose_prometheus() if args.prometheus
                 else json.dumps(reg.snapshot(), indent=2))
 
@@ -220,7 +298,8 @@ def main(argv=None):
         print(f"wrote {args.out}", file=sys.stderr)
     else:
         print(text)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -48,6 +48,7 @@ NAMESPACES = [
     "paddle_tpu.metrics",
     "paddle_tpu.faults",
     "paddle_tpu.checkpoint",
+    "paddle_tpu.analysis",
     "paddle_tpu.distribution",
     "paddle_tpu.sparse",
     "paddle_tpu.fft",

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""tpulint — static invariant linter for the paddle-tpu repo.
+
+Enforces the invariants the runtime drills prove dynamically (rule
+catalog: docs/ANALYSIS.md): TPL001 no-host-sync-in-compiled, TPL002
+recompile hazards, TPL003/TPL004 metric & fault-point catalog parity
+with the docs, TPL005 seeded determinism, TPL006 lock discipline.
+
+Usage:
+
+  python tools/tpulint.py paddle_tpu tools examples
+  python tools/tpulint.py --json paddle_tpu          # CI-diffable output
+  python tools/tpulint.py --write-baseline paddle_tpu tools examples
+
+Exit codes: 0 clean (every finding baselined), 1 findings, 2 bad usage
+or internal error. Inline suppression: ``# tpulint: disable=TPL001``
+(comma list or ``all``) on the flagged line or a comment line above it.
+The committed baseline (tools/tpulint_baseline.json) absorbs accepted
+pre-existing findings; regenerate with --write-baseline and justify
+every entry's ``note``.
+
+The linter never imports paddle_tpu (or jax): the analysis package is
+loaded standalone below, so tpulint still runs when the package import
+is the thing that broke.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                 "tpulint_baseline.json")
+
+
+def _load_analysis():
+    """Import paddle_tpu.analysis WITHOUT executing paddle_tpu/__init__
+    (which pulls jax): register the subpackage under a standalone name
+    so its relative imports resolve against the synthetic package."""
+    name = "_tpulint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_REPO_ROOT, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=["paddle_tpu", "tools", "examples"],
+                    help="files or directories to lint (default: "
+                         "paddle_tpu tools examples)")
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root (doc catalogs + relative paths)")
+    ap.add_argument("--json", action="store_true",
+                    help="stable JSON output (sorted, timestamp-free)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default {_DEFAULT_BASELINE} "
+                         f"when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into the baseline "
+                         "file and exit 0 (then justify every note)")
+    args = ap.parse_args(argv)
+
+    try:
+        analysis = _load_analysis()
+    except Exception as e:     # pragma: no cover - loader failure path
+        print(f"tpulint: cannot load paddle_tpu/analysis: {e}",
+              file=sys.stderr)
+        return 2
+
+    config = analysis.LintConfig(root=args.root)
+    try:
+        result = analysis.lint_paths(args.paths, config)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:   # the documented "internal error" exit —
+        # a crash must stay distinguishable from "findings present"
+        # for CI lanes branching on the code, and --json consumers
+        # must never get a traceback where JSON was promised
+        import traceback
+        traceback.print_exc()
+        print(f"tpulint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        _DEFAULT_BASELINE if os.path.isfile(_DEFAULT_BASELINE) else None)
+    if args.write_baseline:
+        path = args.baseline or _DEFAULT_BASELINE
+        analysis.write_baseline(path, result.findings)
+        print(f"tpulint: wrote {len(result.findings)} finding(s) to "
+              f"{os.path.relpath(path, args.root)} — justify every "
+              f"entry's note")
+        return 0
+
+    entries = []
+    if baseline_path and not args.no_baseline:
+        try:
+            entries = analysis.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"tpulint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, baselined = analysis.split_baseline(result.findings, entries)
+    result.baselined = len(baselined)
+
+    if args.json:
+        print(analysis.to_json(result, new))
+    else:
+        print(analysis.to_text(result, new))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
