@@ -43,8 +43,8 @@ for rid, out in sorted(outputs.items()):
     print(f"req {rid}: {out.n_gen} tokens, finish={out.finish_reason}")
 eng = router.engine("llama-tiny/0")
 print(f"fleet: {router.states()}, engine0 peak_pages="
-      f"{eng.pool.peak_used}, decode_compiles="
-      f"{eng.compile_counts()['decode']}")
+      f"{eng.pool.peak_used}, step_compiles="
+      f"{eng.compile_counts()['step']}")
 
 # OpenAI-completions-shaped facade over the same fleet: model= routes
 # (unknown ids raise an actionable error naming the served models)

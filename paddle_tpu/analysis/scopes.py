@@ -6,9 +6,9 @@ Two questions every compiled-code rule needs answered:
    ``@jit`` / ``@to_static`` / ``@jax.jit``-style decorators, local
    functions passed by name into ``jit.StaticFunction(...)`` /
    ``jax.jit(...)`` / ``to_static(...)`` / ``BucketedFunction(...)``
-   (the engine's ``prefill_fn``/``step_fn`` idiom — renamed to
-   ``serving_prefill``/``serving_decode`` via ``__name__`` for the
-   compile counter, which is also recognized), and every function
+   (the engine's ``step_fn`` idiom — renamed to ``serving_step`` via
+   ``__name__`` for the compile counter, which is also recognized), and
+   every function
    lexically nested inside one (helpers like the decode step's
    ``batched_sample``/``one_row`` trace with their parent).
 
@@ -42,7 +42,8 @@ _WRAPPER_TAILS = {"StaticFunction", "jit", "to_static", "pjit",
 _DECORATOR_TAILS = _WRAPPER_TAILS
 # fn.__name__ = "<one of these>" marks fn as a compiled step fn even if
 # the wrap happens in code the walker can't see
-_KNOWN_COMPILED_NAMES = {"serving_prefill", "serving_decode"}
+_KNOWN_COMPILED_NAMES = {"serving_step", "serving_prefill",
+                         "serving_decode"}
 
 _STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
 # methods whose RESULT is a host value, not a tracer — calling them on

@@ -212,12 +212,14 @@ class GPTAttention(nn.Layer):
         return self.out_proj(merged)
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
-        """Paged-KV decode step (serving engine): one token per sequence,
-        KV write hook scattering into the page pool at per-row positions,
-        then ragged paged attention over each sequence's block table
-        (ops/pallas/paged_attention.py). Position embeddings were already
-        added at the trunk level (GPTModel.forward_paged)."""
-        from ..ops.pallas.paged_attention import paged_attention
+        """Paged-KV ragged step (serving engine): one QUERY TOKEN per
+        row — decode tokens and prompt-chunk tokens alike (the unified
+        step's flattened grid; ops/pallas/paged_attention.py "Ragged
+        form") — KV write hook scattering into the page pool at per-row
+        positions, then ragged paged attention over each row's block
+        table masked at the row's own position. Position embeddings were
+        already added at the trunk level (GPTModel.forward_paged)."""
+        from ..ops.pallas.paged_attention import ragged_paged_attention
 
         B = x.shape[0]
         nh, hd = self.cfg.num_heads, self.head_dim
@@ -237,7 +239,8 @@ class GPTAttention(nn.Layer):
             offs = pos % page_size
             kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
             vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
-            ctx = paged_attention(qh, kp, vp, bt, pos + 1, scale=scale)
+            ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
+                                         scale=scale)
             return ctx.reshape(B, 1, nh_l * hd), kp, vp
 
         merged, new_k, new_v = apply_op(

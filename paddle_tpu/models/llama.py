@@ -162,17 +162,22 @@ class LlamaAttention(nn.Layer):
                                         initializer=_normal_init(proj_std)))
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
-        """Paged-KV decode step (serving engine): one token per sequence.
+        """Paged-KV ragged step (serving engine): one QUERY TOKEN per
+        row — a decode slot's next token, or one token of a prompt
+        chunk (the unified step flattens mixed per-slot query lengths
+        into rows; ops/pallas/paged_attention.py "Ragged form").
 
-        ``x`` [B, 1, H]; ``positions`` [B] absolute positions; the KV write
-        hook scatters this step's rope'd k/v into the page each sequence's
-        block table names at ``positions``, then ragged paged attention
-        (ops/pallas/paged_attention.py) runs over the page list. Returns
-        (out [B, 1, H], new_k_pool, new_v_pool) — same rope tables and
-        masked-softmax math as the dense cached_attn path, so paged decode
-        is token-compatible with ``generate()``.
+        ``x`` [T, 1, H]; ``positions`` [T] per-row absolute positions;
+        the KV write hook scatters every row's rope'd k/v into the page
+        its block-table row names at ``positions``, then ragged paged
+        attention runs each row over its page list masked at the row's
+        own position — which is what makes chunk rows causal over their
+        freshly written chunk-mates. Returns (out [T, 1, H], new_k_pool,
+        new_v_pool) — same rope tables and masked-softmax math as the
+        dense cached_attn path, so paged serving is token-compatible
+        with ``generate()``.
         """
-        from ..ops.pallas.paged_attention import paged_attention
+        from ..ops.pallas.paged_attention import ragged_paged_attention
 
         B = x.shape[0]
         cfg = self.cfg
@@ -212,7 +217,8 @@ class LlamaAttention(nn.Layer):
             offs = pos % page_size
             kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
             vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
-            ctx = paged_attention(qh, kp, vp, bt, pos + 1, scale=scale)
+            ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
+                                         scale=scale)
             return ctx.reshape(B, 1, nh_l * hd), kp, vp
 
         merged, new_k, new_v = apply_op(

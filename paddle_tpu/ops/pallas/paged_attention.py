@@ -28,6 +28,20 @@ gathered pages with masked lanes at -1e30 — tier-1 CPU tests drive the
 engine through this path and assert token-for-token equality with dense
 ``generate()``. Set PADDLE_TPU_PALLAS_INTERPRET=1 to run the real kernel
 on CPU (interpret mode), as the flash kernels do.
+
+**Ragged (mixed query-length) form** — ``ragged_paged_attention``: the
+unified serving step (engine.py) batches decode slots (q_len 1) and
+prompt chunks (q_len up to the token budget) in ONE launch by
+flattening every query token into a row of a ``[T, ...]`` grid: a
+slot's chunk contributes one row per token, each carrying the slot's
+block table and its own absolute position. Per-row ``seq_lens`` =
+position + 1 masks later keys, so a chunk token attends to the shared
+pool's KV — its own earlier chunk tokens included, because the step
+scatters the whole chunk's KV before the gather — exactly causally.
+Raggedness is therefore DATA (row→table mapping), not shape: one
+compiled program per token-grid bucket serves every prefill/decode mix
+(PAPERS.md, arXiv 2604.15464 — the same "queries of every length in
+one kernel" contract, expressed on the decode kernel's grid).
 """
 from __future__ import annotations
 
@@ -48,7 +62,8 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-__all__ = ["paged_attention", "ref_paged_attention"]
+__all__ = ["paged_attention", "ragged_paged_attention",
+           "ref_paged_attention"]
 
 NEG_INF = -1e30
 LANES = 128
@@ -219,3 +234,26 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
                                        seq_lens, scale)
     return ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
                                scale)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, row_block_tables, row_lens,
+                           scale: float = None, use_kernel: bool = None):
+    """Mixed query-length paged attention over a FLATTENED token grid
+    (module docstring, "Ragged form"): ``q`` is ``[T, nh, hd]`` — one
+    row per query token across every slot this step, decode tokens and
+    prompt-chunk tokens alike. ``row_block_tables`` ``[T, pages]``
+    repeats a slot's block table for each of its rows; ``row_lens``
+    ``[T]`` is each row's absolute position + 1 (keys at or past the
+    row's own position are masked, which is what makes an in-chunk
+    token causal over its chunk-mates' freshly scattered KV).
+
+    Contract: the caller has ALREADY scattered this step's KV for every
+    row into the pool (the unified step writes first, attends second —
+    the decode step's own idiom, generalized). Each row then reduces
+    over its named pages exactly like a decode query, so the kernel grid
+    (``(T, kv_heads, pages)``, scalar-prefetched tables, online-softmax
+    scratch) serves the ragged batch unchanged — per-row early-out over
+    ``row_lens`` is what keeps a 1-token decode row from paying a long
+    prompt's page walk."""
+    return paged_attention(q, k_pool, v_pool, row_block_tables, row_lens,
+                           scale=scale, use_kernel=use_kernel)

@@ -82,7 +82,8 @@ class CompletionAPI:
                           stream_cb: Optional[Callable] = None,
                           deadline_s: Optional[float] = None,
                           model: Optional[str] = None,
-                          prefix_cache: bool = True) -> dict:
+                          prefix_cache: bool = True,
+                          priority: int = 0) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
@@ -99,7 +100,12 @@ class CompletionAPI:
         opts every choice of this call out of the engine's prompt
         prefix cache (docs/SERVING.md "Prefix caching"): full prefill
         from token 0, no page sharing — for prompts that must not be
-        indexed (privacy) or A/B-measuring the cache itself."""
+        indexed (privacy) or A/B-measuring the cache itself.
+        ``priority`` is the request's SLO tier (lower = more urgent,
+        0 default): it orders admission and prompt-chunk scheduling on
+        the engine (docs/SERVING.md "Unified step & chunked prefill"),
+        so a latency-tier tenant's prompt chunks preempt a batch tier's
+        under a contended token budget."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         try:
@@ -126,7 +132,7 @@ class CompletionAPI:
                     p, max_new_tokens=max_tokens, temperature=temperature,
                     eos_token_id=stop_token_id, seed=seed + idx,
                     stream_cb=cb, deadline_s=deadline_s,
-                    prefix_cache=prefix_cache))
+                    prefix_cache=prefix_cache, priority=priority))
                 if handle is not None:
                     self.router._count_dispatch(handle)
         except Exception:

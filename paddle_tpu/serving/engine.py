@@ -2,45 +2,61 @@
 
 The serving counterpart of ``GenerationMixin.generate`` (one static batch,
 dense caches): requests join and retire MID-DECODE. The engine keeps a
-fixed grid of ``max_batch_slots`` decode slots; each engine step
+fixed grid of ``max_batch_slots`` slots and runs ONE **unified ragged
+step** for the whole batch — decode slots (one token each) and
+mid-prefill slots (a prompt chunk each) ride the same compiled program.
+Each engine step
 
-1. **admits** waiting requests FCFS into free slots (scheduler.py) under
-   the prefill token budget and the pool's worst-case page accounting,
-2. **prefills** each admitted prompt through the model's dense-cache path
-   at a power-of-two padded bucket length (bounded prefill program count),
-   scatters the prompt KV into the sequence's pages, and samples the
-   first token — a radix prefix-cache hit (docs/SERVING.md "Prefix
-   caching") adopts the cached prefix pages by refcount and prefills
-   only the uncovered suffix over the loaded prefix KV,
-3. runs ONE **compiled decode step** for every live slot at once — shapes
-   padded to the slot grid, block tables and positions riding in as data —
-   so XLA compiles the decode program exactly once no matter how the live
-   batch churns (asserted by tests via :meth:`compile_counts`),
+1. **admits** waiting requests into free slots (scheduler.py) in
+   (priority, arrival) order under the pool's worst-case page
+   accounting — a radix prefix-cache hit (docs/SERVING.md "Prefix
+   caching") adopts the cached prefix pages by refcount at admission, so
+   chunked prefill starts AFTER the covered prefix,
+2. **plans** the step's token mix under a fixed ``token_budget``: decode
+   tokens charged first (decode-first under load), prompt chunks sliced
+   to fill the remainder in SLO order (priority tier, earliest deadline,
+   arrival) — a 10k-token prompt admits immediately and trickles in
+   without ever displacing a decoding tenant's next token,
+3. runs the **unified compiled step**: every query token of the step —
+   decode tokens and chunk tokens alike — is one row of a flattened
+   ``[T, ...]`` grid (ops/pallas/paged_attention.py "Ragged form"), with
+   per-row block tables and absolute positions riding as DATA. ``T`` is
+   bucketed (the slot grid when the step fits it, powers of two above),
+   so XLA compiles a small fixed set of shapes no matter how prompts
+   chunk or the live batch churns (asserted via :meth:`compile_counts`
+   and ``paddle_tpu_jit_compiles_total{fn="serving_step"}``),
 4. **retires** finished sequences (eos or max tokens), freeing their pages
    immediately for the next admission.
 
-Idle slots carry the null block table (all page 0) and a zero position;
-their masked garbage rides along and is discarded on the host. Per-token
-streaming goes through each request's ``stream_cb`` with a monotone
-per-request sequence number.
+Chunked-prefill progress IS a cache length: a slot mid-prompt holds
+``pos`` tokens of KV and nothing else — exactly the state a prefix-cache
+hit restores, which is why a mid-prefill request migrates at its chunk
+boundary like a decoding one (journal = tokens generated so far, possibly
+none; the adoptive engine re-prefills what its own cache doesn't cover).
+
+Idle grid rows carry the null block table (all page 0) and a zero
+position; their masked garbage rides along and is discarded on the host.
+Per-token streaming goes through each request's ``stream_cb`` with a
+monotone per-request sequence number.
 
 Determinism contract (docs/SERVING.md "Seeds and determinism"): every
-sampled token is keyed ``fold_in(PRNGKey(req.seed), position)`` — prefill
-and the compiled decode step derive from the SAME per-request stream, so
-a request's tokens are a pure function of (prompt, seed, temperature),
-independent of batch composition and engine history. That purity is what
-makes in-flight migration exact: :meth:`export_inflight` journals each
-live request's generated tokens, and an adopting engine re-prefills
-prompt + journal (one ragged prefill) and continues decoding
+sampled token is keyed ``fold_in(PRNGKey(req.seed), position)`` — the
+final chunk's first-token sample and every decode sample derive from the
+SAME per-request stream inside the same compiled step, so a request's
+tokens are a pure function of (prompt, seed, temperature), independent of
+batch composition, chunk boundaries, and engine history. That purity is
+what makes in-flight migration exact: :meth:`export_inflight` journals
+each live request's generated tokens, and an adopting engine re-prefills
+prompt + journal (chunked like any admission) and continues decoding
 token-identically from the journaled position.
 
 Telemetry (docs/OBSERVABILITY.md): every step feeds the always-on
 ``paddle_tpu.metrics`` registry — TTFT / inter-token-latency / queue-wait
-/ step-time histograms, request lifecycle counters, and page/queue gauges
-(the latter via ``profiler.record_counter``, which ALSO lands them in the
-chrome trace next to the ``engine_step`` spans whenever a profiler is
-recording). ``engine.stats`` stays a thin per-step dict view over the
-same numbers.
+/ step-time histograms, the per-step prefill/decode token mix and chunk
+sizes, request lifecycle counters, and page/queue gauges (the latter via
+``profiler.record_counter``, which ALSO lands them in the chrome trace
+next to the ``engine_step`` spans whenever a profiler is recording).
+``engine.stats`` stays a thin per-step dict view over the same numbers.
 """
 from __future__ import annotations
 
@@ -62,31 +78,25 @@ from .scheduler import FCFSScheduler, Request, RequestOutput
 
 __all__ = ["ServingEngine"]
 
-_MIN_PREFILL_BUCKET = 16
+_MIN_GRID_TOKENS = 16
 _engine_counter = itertools.count()
 
 faults.declare_point(
     "serving.step", "top of ServingEngine.step(), before the deadline "
     "sweep — arm latency here to stall whole iterations")
 faults.declare_point(
-    "serving.prefill", "start of one request's prefill — a raise retires "
-    "that request with finish_reason=\"error\"; batch-mates proceed")
+    "serving.prefill", "admission of one request (cache match + page "
+    "adoption + slot parking) — a raise retires that request with "
+    "finish_reason=\"error\"; batch-mates proceed")
 faults.declare_point(
-    "serving.decode_step", "in _decode_once, after the KV-room loop and "
-    "before the compiled step consumes the pools — arm call= here to "
-    "corrupt state (e.g. pool.poison_seq), delay_s to trip the watchdog")
+    "serving.decode_step", "in _step_once, after the per-slot KV-room "
+    "loop and before the unified compiled step consumes the pools — arm "
+    "call= here to corrupt state (e.g. pool.poison_seq), delay_s to trip "
+    "the watchdog")
 faults.declare_point(
-    "serving.compile_decode", "building the decode program — a transient "
-    "raise exercises the faults.retry backoff path")
-faults.declare_point(
-    "serving.compile_prefill", "building one prefill-bucket program — "
-    "retried like serving.compile_decode")
-
-
-def _bucket(n: int, cap: int) -> int:
-    """Power-of-two prefill padding: program count is O(log max_len)."""
-    b = max(_MIN_PREFILL_BUCKET, 1 << (int(n) - 1).bit_length())
-    return min(b, cap)
+    "serving.compile_step", "building the unified ragged step program — "
+    "a transient raise exercises the faults.retry backoff path; each "
+    "token-grid bucket still compiles exactly once")
 
 
 def _cb_accepts_seq(cb) -> bool:
@@ -116,37 +126,54 @@ def _cb_accepts_seq(cb) -> bool:
 
 
 class _SeqState:
-    """One live slot: request + decode cursor.
+    """One live slot: request + unified-step cursor.
+
+    The slot's WHOLE generation state is ``(ids, pos, gen)``: ``ids`` is
+    the admission token stream (prompt + any migration journal), ``pos``
+    counts tokens of KV in the pool — chunked-prefill progress IS a
+    cache length — and ``gen`` the tokens sampled here (pre-seeded with
+    the journal for a migrated request so stream sequence numbers and
+    max_new_tokens accounting continue, not restart). While
+    ``pos < len(ids)`` the slot is mid-prefill: each step feeds its next
+    prompt chunk ``ids[pos:pos+c]``; the FINAL chunk's sample is the
+    stream's next token. Once ``pos == len(ids)`` it decodes:
+    ``last_token`` feeds back at position ``pos``.
 
     No PRNG state lives here: sampling keys are derived per token as
-    ``fold_in(PRNGKey(req.seed), position)`` inside the compiled step, so
-    the cursor (``pos``) and the journal (``gen``) are the WHOLE resume
-    state — exactly what :meth:`ServingEngine.export_inflight` ships to a
-    sibling engine on migration.
+    ``fold_in(PRNGKey(req.seed), position)`` inside the compiled step,
+    so (ids, gen) is the WHOLE resume state — exactly what
+    :meth:`ServingEngine.export_inflight` ships to a sibling engine on
+    migration, chunk boundaries included.
     """
 
-    __slots__ = ("req", "pos", "last_token", "gen", "t_last",
-                 "inserted_nodes")
+    __slots__ = ("req", "ids", "pos", "last_token", "gen", "t_last",
+                 "t_admit", "inserted_nodes")
 
-    def __init__(self, req: Request, pos: int, last_token: int):
+    def __init__(self, req: Request, ids: np.ndarray, pos: int):
         self.req = req
-        self.pos = pos              # tokens of KV written so far
-        self.last_token = last_token
-        # generated ids (incl. eos when hit); for a migrated request this
-        # is pre-seeded with the journaled tokens so stream sequence
-        # numbers and max_new_tokens accounting continue, not restart
-        self.gen = [last_token]
+        self.ids = np.asarray(ids, np.int32).reshape(-1)
+        self.pos = int(pos)          # tokens of KV written so far
+        self.last_token = -1         # meaningful once prefill completes
+        # generated ids (incl. eos when hit); journal-seeded for a
+        # migrated request
+        self.gen: List[int] = list(req.resume_tokens or ())
         self.t_last = time.perf_counter()  # last token's landing time (ITL)
+        self.t_admit = self.t_last   # chunked-prefill wall-time anchor
         # prefix-cache nodes created FROM this request's prefill KV: if a
         # NaN quarantine makes that KV suspect, these (and their
         # subtrees) are evicted so the poison cannot serve a later match
         self.inserted_nodes = []
 
+    @property
+    def prefilling(self) -> bool:
+        return self.pos < self.ids.size
+
 
 class ServingEngine:
     """Continuous-batching engine for any ``GenerationMixin`` model
-    (LlamaForCausalLM / GPTForCausalLM): paged KV pool + FCFS scheduler +
-    a single compiled ragged-paged-attention decode step.
+    (LlamaForCausalLM / GPTForCausalLM): paged KV pool + chunked-prefill
+    scheduler + a single unified ragged-paged-attention step (decode
+    tokens and prompt chunks in one compiled program).
 
     ``num_pages=None`` sizes the pool for ``max_batch_slots`` worst-case
     sequences of ``max_model_len`` tokens (+1 null page); pass an explicit
@@ -158,7 +185,9 @@ class ServingEngine:
                  num_pages: Optional[int] = None,
                  max_batch_slots: int = 8,
                  max_model_len: Optional[int] = None,
-                 prefill_token_budget: int = 1024,
+                 token_budget: int = 1024,
+                 prefill_token_budget: Optional[int] = None,
+                 min_step_tokens: Optional[int] = None,
                  kv_dtype=jnp.float32, seed: int = 0,
                  max_queue: Optional[int] = None,
                  watchdog_stall_s: Optional[float] = 30.0,
@@ -184,6 +213,21 @@ class ServingEngine:
         self.max_model_len = min(int(max_model_len or cfg_max), cfg_max)
         self.page_size = int(page_size)
         self.max_batch_slots = int(max_batch_slots)
+        # prefill_token_budget survives as the PR 1 spelling of the knob;
+        # the budget now bounds the WHOLE unified step's tokens (decode
+        # charged first, chunks in the remainder — scheduler.plan_chunks)
+        self.token_budget = int(prefill_token_budget
+                                if prefill_token_budget is not None
+                                else token_budget)
+        # operator-pinned step-grid floor (docs/SERVING.md "Unified step
+        # & chunked prefill"): with min_step_tokens == token_budget every
+        # step — decode-only or mixed — compiles and runs ONE shape, so
+        # prompt chunks ride rows the decode grid already paid for and
+        # the inter-token latency of decoding tenants is isolation-by-
+        # construction. None (default) lets decode-only steps use the
+        # cheaper slot-grid shape and mixed steps bucket up.
+        self.min_step_tokens = (None if min_step_tokens is None
+                                else int(min_step_tokens))
         self.pages_per_seq = -(-self.max_model_len // self.page_size)
         if num_pages is None:
             num_pages = self.max_batch_slots * self.pages_per_seq + 1
@@ -193,13 +237,13 @@ class ServingEngine:
                                      model_id=self.model_id)
         # radix prefix cache over the pool (docs/SERVING.md "Prefix
         # caching"): admission longest-prefix-matches cached prompt pages
-        # and ragged-prefills only the uncovered suffix. prefix_cache=
+        # and chunk-prefills only the uncovered suffix. prefix_cache=
         # False opts the whole engine out (every admission prefills from
         # token 0, exactly the pre-cache behavior).
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool) if prefix_cache else None)
         self.scheduler = FCFSScheduler(self.max_batch_slots,
-                                       prefill_token_budget,
+                                       self.token_budget,
                                        max_queue=max_queue,
                                        retry_after_cb=self
                                        ._estimate_retry_after)
@@ -214,19 +258,13 @@ class ServingEngine:
         # BackpressureError.retry_after_s (seeded at a plausible 50 ms)
         self._avg_step_s = 0.05
         self.slots: List[Optional[_SeqState]] = [None] * self.max_batch_slots
-        # the request currently mid-prefill (popped from the queue, not
-        # yet parked in a slot): cancel() must be able to see it, or a
-        # cancel issued from its own first-token callback would be
-        # silently ignored
-        self._active_prefill: Optional[_SeqState] = None
-        self._decode_prog = None
-        # prefill programs keyed (suffix_bucket, cache_bucket): cold
-        # admissions use (b, b) exactly as before; a prefix-cache hit
-        # adds (suffix_b, cache_b) pairs — O(log^2 max_len) programs,
-        # with cur_len riding as DATA so one program serves every
-        # matched length of the same geometry
-        self._prefill_progs: Dict[tuple, jit.StaticFunction] = {}
-        # NO engine-global RNG: decode sampling keys derive per slot from
+        # THE unified step program: one StaticFunction whose signature
+        # cache holds one compiled program per token-grid bucket —
+        # decode-only steps, mixed steps, and every chunk geometry reuse
+        # the same small set (compile_counts pins it)
+        self._step_prog: Optional[jit.StaticFunction] = None
+        self._grid_buckets_seen: set = set()
+        # NO engine-global RNG: sampling keys derive per slot from
         # fold_in(PRNGKey(req.seed), position) INSIDE the compiled step,
         # so a request's token stream never depends on batch composition
         # or engine history (the `seed` ctor arg survives for API compat
@@ -254,16 +292,28 @@ class ServingEngine:
             "sequence during decode", labels=_eng).labels(**self._lbl)
         self._m_step = reg.histogram(
             "paddle_tpu_serving_step_seconds",
-            "Full engine step: admit + prefill + batched decode + retire",
+            "Full engine step: admit + unified ragged step + retire",
             labels=_eng).labels(**self._lbl)
         self._m_prefill = reg.histogram(
             "paddle_tpu_serving_prefill_seconds",
-            "One request's prefill: bucketed forward + KV scatter + "
-            "first-token sample", labels=_eng).labels(**self._lbl)
+            "One request's whole chunked prefill: admission -> first "
+            "sampled token", labels=_eng).labels(**self._lbl)
         self._m_decode = reg.histogram(
             "paddle_tpu_serving_decode_step_seconds",
-            "One batched decode step over all live slots",
-            labels=_eng).labels(**self._lbl)
+            "One unified compiled step over all live slots (decode "
+            "tokens + prompt chunks)", labels=_eng).labels(**self._lbl)
+        self._m_mix = reg.histogram(
+            "paddle_tpu_serving_step_mix",
+            "Per-step token split of the unified step: tokens of each "
+            "kind (decode vs prefill chunk) the step carried",
+            labels=("kind",) + _eng)
+        self._m_mix_decode = self._m_mix.labels(kind="decode", **self._lbl)
+        self._m_mix_prefill = self._m_mix.labels(kind="prefill",
+                                                 **self._lbl)
+        self._m_chunk = reg.histogram(
+            "paddle_tpu_serving_prefill_chunk_tokens",
+            "Tokens per prompt chunk the scheduler sliced under the step "
+            "token budget", labels=_eng).labels(**self._lbl)
         self._m_requests = reg.counter(
             "paddle_tpu_serving_requests_total",
             "Requests by lifecycle event",
@@ -294,7 +344,7 @@ class ServingEngine:
         self._m_req_errors = reg.counter(
             "paddle_tpu_serving_request_errors_total",
             "Requests retired on an internal failure "
-            "(finish_reason=\"error\": prefill/alloc/callback faults)",
+            "(finish_reason=\"error\": admission/alloc/callback faults)",
             labels=_eng).labels(**self._lbl)
         self._m_unavailable = reg.counter(
             "paddle_tpu_serving_unavailable_total",
@@ -332,7 +382,7 @@ class ServingEngine:
             # AND its configured value in every rejection message
             self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise ValueError(
-                f"prompt_len {p} exceeds the prefill bucket cap (limit: "
+                f"prompt_len {p} exceeds the context window (limit: "
                 f"max_model_len={self.max_model_len}); truncate the prompt "
                 f"or construct the engine with a larger max_model_len")
         total = p + m
@@ -360,7 +410,7 @@ class ServingEngine:
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None, seed: int = 0,
                     stream_cb=None, deadline_s: Optional[float] = None,
-                    prefix_cache: bool = True):
+                    prefix_cache: bool = True, priority: int = 0):
         """Queue a request; returns its ``req_id``. Generation starts at
         the next :meth:`step` with capacity (continuous batching — no
         barrier on the current batch). ``deadline_s`` bounds the whole
@@ -371,12 +421,15 @@ class ServingEngine:
         ``prefix_cache=False`` opts THIS request out of prefix-cache
         matching and insertion (it prefills from token 0 and shares no
         pages) — the per-request escape hatch next to the engine-level
-        ``prefix_cache=`` constructor flag."""
+        ``prefix_cache=`` constructor flag. ``priority`` is the SLO tier
+        (lower = more urgent, 0 default): honored at admission order and
+        at prompt-chunk scheduling (docs/SERVING.md "Unified step &
+        chunked prefill")."""
         req = Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       eos_token_id=eos_token_id, seed=seed,
                       stream_cb=stream_cb, deadline_s=deadline_s,
-                      prefix_cache=prefix_cache)
+                      prefix_cache=prefix_cache, priority=priority)
         self.check_request(req.prompt.size, req.max_new_tokens)
         try:
             self.scheduler.add(req)
@@ -387,22 +440,15 @@ class ServingEngine:
 
     def cancel(self, req_id) -> bool:
         """Cancel a request wherever it is: pulled from the queue, or
-        retired mid-decode with its KV pages freed THIS call. The output
-        (tokens generated so far, ``finish_reason="cancelled"``) is
-        delivered through the usual :meth:`run` path and the terminal
-        stream callback fires. False if the request is unknown or
-        already finished — cancel is idempotent, never raises."""
+        retired mid-prefill/mid-decode with its KV pages freed THIS
+        call. The output (tokens generated so far,
+        ``finish_reason="cancelled"``) is delivered through the usual
+        :meth:`run` path and the terminal stream callback fires. False
+        if the request is unknown or already finished — cancel is
+        idempotent, never raises."""
         req = self.scheduler.remove(req_id)
         if req is not None:
             self._finish_queued(req, "cancelled")
-            return True
-        ap = self._active_prefill
-        if ap is not None and ap.req.req_id == req_id:
-            # mid-prefill (reentrant: we are inside its own stream
-            # callback) — retire now; _prefill notices the freed pages
-            # and skips parking it in a slot
-            self._active_prefill = None
-            self._retire_abnormal(ap, slot=None, reason="cancelled")
             return True
         for i, st in enumerate(self.slots):
             if st is not None and st.req.req_id == req_id:
@@ -430,8 +476,8 @@ class ServingEngine:
         }
 
     def _estimate_retry_after(self) -> float:
-        """Backpressure hint: FCFS drains roughly one admission per step
-        per free slot, so a full queue clears in about
+        """Backpressure hint: admission drains roughly one request per
+        step per free slot, so a full queue clears in about
         ``queue_depth x avg_step_time`` — rounded up to a 50 ms floor so
         clients never busy-spin on a hot engine."""
         return max(0.05, self.scheduler.queue_depth * self._avg_step_s)
@@ -469,15 +515,20 @@ class ServingEngine:
         return self.scheduler.pop_all()
 
     def export_inflight(self) -> List[Request]:
-        """Pop every IN-FLIGHT request (decode slots + a mid-prefill one)
-        off this engine and return resume journals: each Request comes
-        back with ``resume_tokens`` set to the tokens it generated here —
-        together with (prompt, seed, temperature, deadline) already on
-        the Request, the complete state a sibling needs to continue the
-        stream token-identically (ragged re-prefill of prompt + journal,
-        then decode from the journaled position; emission resumes at
-        stream seq ``len(resume_tokens)``). The router's migration path
-        for ``mark_down``/step-crash.
+        """Pop every IN-FLIGHT request (decode slots AND mid-chunked-
+        prefill slots) off this engine and return resume journals: each
+        Request comes back with ``resume_tokens`` set to the tokens it
+        generated here — together with (prompt, seed, temperature,
+        deadline, priority) already on the Request, the complete state a
+        sibling needs to continue the stream token-identically (chunked
+        re-prefill of prompt + journal, then decode from the journaled
+        position; emission resumes at stream seq ``len(resume_tokens)``).
+        A slot killed BETWEEN prompt chunks journals exactly its tokens
+        so far (usually none): its chunk progress was only a cache
+        length, which the adoptive engine's prefix cache re-covers — so
+        migration at a chunk boundary is the same move as migration
+        mid-decode. The router's migration path for
+        ``mark_down``/step-crash.
 
         No lifecycle counters move (the requests retire elsewhere), and
         pages are freed best-effort per sequence — a crashed engine's
@@ -487,9 +538,6 @@ class ServingEngine:
             if st is not None:
                 states.append(st)
                 self.slots[i] = None
-        if self._active_prefill is not None:
-            states.append(self._active_prefill)
-            self._active_prefill = None
         out: List[Request] = []
         for st in states:
             try:
@@ -507,8 +555,8 @@ class ServingEngine:
         so queue-wait/TTFT keep measuring from the original enqueue and the
         caller's streaming keeps working. A request journaled by
         :meth:`export_inflight` (``resume_tokens`` set) re-prefills
-        prompt + journal at admission and continues its stream
-        token-identically. Raises exactly like
+        prompt + journal at admission (in chunks, like any admission) and
+        continues its stream token-identically. Raises exactly like
         :meth:`add_request` (ValueError from :meth:`check_request`,
         BackpressureError from a full bounded queue) — the router treats a
         raise as requeue-impossible."""
@@ -538,36 +586,41 @@ class ServingEngine:
 
     def load_score(self) -> float:
         """Estimated seconds to drain this engine's current commitment:
-        outstanding work in STEPS (one prefill step + one decode step per
-        remaining token, per request — a 2-token short and a 128-token
-        hog must not weigh the same) x the step-time EWMA. The queue half
-        rides the scheduler's incremental tally (O(1)); the slot scan is
-        bounded by ``max_batch_slots``. The router's least-loaded
-        dispatch admits onto the minimum-score healthy engine; exact ties
-        (idle fleets) round-robin."""
+        outstanding work in STEPS x the step-time EWMA. A slot's charge
+        is its remaining prompt in CHUNK steps (ceil(remaining /
+        token_budget) — chunked-prefill progress counts: a 10k prompt
+        90% prefilled weighs a tenth of a fresh one) plus one decode
+        step per remaining token (a 2-token short and a 128-token hog
+        must not weigh the same). The queue half rides the scheduler's
+        incremental tally (O(1)); the slot scan is bounded by
+        ``max_batch_slots``. The router's least-loaded dispatch admits
+        onto the minimum-score healthy engine; exact ties (idle fleets)
+        round-robin."""
+        budget = max(self.scheduler.token_budget, 1)
         steps = self.scheduler.pending_steps
         for st in self.slots:
-            if st is not None:
-                steps += 1 + max(int(st.req.max_new_tokens)
-                                 - len(st.gen), 0)
-        if self._active_prefill is not None:
-            ap = self._active_prefill
-            steps += 1 + max(int(ap.req.max_new_tokens) - len(ap.gen), 0)
+            if st is None:
+                continue
+            remaining_prefill = max(int(st.ids.size) - st.pos, 0)
+            steps += -(-remaining_prefill // budget)
+            steps += max(int(st.req.max_new_tokens) - len(st.gen), 0)
         return steps * self._avg_step_s
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-program tally — the recompilation bound the tests
-        assert on: decode stays at 1 signature forever; prefill grows one
-        program per power-of-two bucket."""
-        d = len(self._decode_prog._cache) if self._decode_prog else 0
-        p = sum(len(f._cache) for f in self._prefill_progs.values())
-        return {"decode": d, "prefill": p,
-                "prefill_buckets": len(self._prefill_progs)}
+        assert on: ONE unified step function whose compiled signatures
+        are exactly the token-grid buckets seen, so ``step`` must equal
+        ``step_buckets`` forever (a drift means something non-bucketed —
+        a dtype, a shape — leaked into the program signature) and both
+        are bounded by the small fixed bucket set."""
+        n = len(self._step_prog._cache) if self._step_prog else 0
+        return {"step": n, "step_buckets": len(self._grid_buckets_seen)}
 
     # ---------------------------------------------------------------- step
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: admit → prefill → batched decode →
-        retire. Returns requests that finished during this step."""
+        """One engine iteration: admit → one unified ragged step (decode
+        tokens + prompt chunks under the token budget) → retire. Returns
+        requests that finished during this step."""
         from ..profiler import RecordEvent, record_counter
 
         t0 = time.perf_counter()
@@ -583,17 +636,16 @@ class ServingEngine:
                 for req in self.scheduler.admit(free, self.pool):
                     self._m_requests.labels(event="admitted", **self._lbl).inc()
                     try:
-                        out = self._prefill(req)
+                        # an admission failure (cache/alloc fault,
+                        # injected drill) fails THIS request, not the
+                        # engine: batch-mates keep decoding, the queue
+                        # keeps draining
+                        self._admit(req)
                     except Exception as e:
-                        # a prefill failure (compile fault, pool
-                        # exhaustion, injected drill) fails THIS request,
-                        # not the engine: batch-mates keep decoding, the
-                        # queue keeps draining
-                        out = self._fail_prefilled_request(req, e)
-                    if out is not None:
-                        finished.append(out)
+                        finished.append(
+                            self._fail_admitted_request(req, e))
                 if any(s is not None for s in self.slots):
-                    finished.extend(self._decode_once())
+                    finished.extend(self._step_once())
         finally:
             # the watchdog bracket must close even when the step body
             # raises (an armed fault, an unhandled bug) — otherwise
@@ -634,7 +686,8 @@ class ServingEngine:
         seeded backoff (ONE retry policy for every build site): a
         transient failure costs milliseconds, a persistent one surfaces
         to step()'s per-request isolation. The program still compiles
-        exactly once — only the successful build reaches XLA."""
+        exactly once per bucket — only the successful build reaches
+        XLA."""
         def build():
             faults.point(point_name)
             return make_fn()
@@ -700,9 +753,9 @@ class ServingEngine:
         return self._emit_terminal(req, list(req.resume_tokens or ()),
                                    reason)
 
-    def _fail_prefilled_request(self, req: Request,
-                                error: Exception) -> RequestOutput:
-        """Retire a request whose prefill failed partway; any pages its
+    def _fail_admitted_request(self, req: Request,
+                               error: Exception) -> RequestOutput:
+        """Retire a request whose admission failed partway; any pages its
         allocation grabbed go back to the pool now. A migrated request's
         journaled tokens still deliver — they were already streamed."""
         if self.pool.has_seq(req.req_id):
@@ -710,7 +763,7 @@ class ServingEngine:
         return self._emit_terminal(req, list(req.resume_tokens or ()),
                                    "error", error)
 
-    def _retire_abnormal(self, st: _SeqState, slot: Optional[int],
+    def _retire_abnormal(self, st: _SeqState, slot: int,
                          reason: str, error=None) -> RequestOutput:
         """Retire a LIVE sequence off the normal eos/length path
         (timeout / cancelled / nan / error): pages freed this call, slot
@@ -732,14 +785,14 @@ class ServingEngine:
             # the 0 weights. Pages a sibling or the cache still
             # references defer (scrub-pending, zeroed at refcount zero).
             self.pool.free(req.req_id, scrub=(reason == "nan"))
-        if slot is not None:
-            self.slots[slot] = None
+        self.slots[slot] = None
         return self._emit_terminal(req, st.gen, reason, error)
 
     def _sweep_deadlines(self) -> List[RequestOutput]:
-        """Retire every over-deadline request — queued or mid-decode —
-        with ``finish_reason="timeout"``; runs at the top of each step so
-        an overloaded queue sheds load instead of serving stale work."""
+        """Retire every over-deadline request — queued, mid-prefill, or
+        mid-decode — with ``finish_reason="timeout"``; runs at the top of
+        each step so an overloaded queue sheds load instead of serving
+        stale work."""
         finished: List[RequestOutput] = []
         for req in self.scheduler.pop_expired():
             finished.append(self._finish_queued(req, "timeout"))
@@ -750,209 +803,106 @@ class ServingEngine:
                     self._retire_abnormal(st, slot=i, reason="timeout"))
         return finished
 
-    # ------------------------------------------------------------- prefill
-    def _make_prefill(self, bucket: int,
-                      cache_len: int) -> jit.StaticFunction:
-        """One (suffix-)prefill program: ``ids`` [1, bucket] are the
-        tokens to ACTUALLY run (the whole prompt when cold, only the
-        uncovered suffix on a prefix-cache hit), ``cur_len`` is the
-        count of cached-prefix tokens already loaded into the
-        ``cache_len``-long KV buffers (0 when cold — then this is
-        exactly the original full prefill), and ``last_pos`` indexes the
-        last REAL token within ``ids``. The trunk's cached path ropes at
-        absolute positions ``cur_len..`` and masks causally over the
-        whole buffer, so suffix tokens attend to the loaded prefix KV
-        precisely as a full prefill would."""
-        trunk, model, n_layers = self.trunk, self.model, self.n_layers
-
-        def prefill_fn(ids, last_pos, cur_len, *flat_caches):
-            caches = [(flat_caches[2 * i], flat_caches[2 * i + 1])
-                      for i in range(n_layers)]
-            with no_grad():
-                hidden, ncs = trunk(ids, caches=caches, cur_len=cur_len)
-                # slice the last REAL position before the vocab matmul:
-                # the padded bucket tail never touches the [V] projection
-                last_h = apply_op(
-                    lambda h, lp: jax.lax.dynamic_slice(
-                        h, (jnp.int32(0), lp.astype(jnp.int32).reshape(()),
-                            jnp.int32(0)),
-                        (1, 1, h.shape[-1])),
-                    [ensure_tensor(hidden), ensure_tensor(last_pos)],
-                    name="prefill_last_hidden")
-                logits = model.logits(last_h)
-            last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
-                            [ensure_tensor(logits)], name="last_logits")
-            # same no-trust rule as decode: the host quarantines a
-            # non-finite prefill instead of streaming its garbage first
-            # token (argmax over all-NaN logits returns index 0)
-            fin = apply_op(lambda lv: jnp.isfinite(lv).all(),
-                           [last], name="prefill_logits_finite")
-            flat = [t for c in ncs for t in c]
-            return (last, fin, *flat)
-
-        # the compile counter labels by function name — make recompiles
-        # attributable on /metrics (jit_compiles_total{fn="serving_prefill"})
-        prefill_fn.__name__ = "serving_prefill"
-        return jit.StaticFunction(prefill_fn, observe=[self.model],
-                                  warmup=False, dy2static=False)
-
-    def _prefill(self, req: Request) -> Optional[RequestOutput]:
-        t0 = time.perf_counter()
+    # ----------------------------------------------------------- admission
+    def _admit(self, req: Request) -> None:
+        """Park a request in a free slot: longest-prefix match against
+        the radix cache (full pages, capped at s-1 so the final chunk
+        always computes the first sample's logits), adopt matched pages
+        by refcount, and set the chunk cursor. The prefill itself runs
+        inside the next unified steps, sliced under the token budget —
+        admission costs no model compute at all. A migrated request
+        (``resume_tokens`` set) admits over prompt + journal: chunked
+        re-prefill rebuilds the KV the dead engine held, and the final
+        chunk's sample IS the stream's next token (docs/RESILIENCE.md
+        "In-flight migration")."""
         faults.point("serving.prefill")
-        journal = list(req.resume_tokens or ())
-        if journal:
-            # migration resume (docs/RESILIENCE.md "In-flight
-            # migration"): ONE ragged prefill over prompt + journaled
-            # tokens rebuilds the KV the dead engine held, and the
-            # sample below IS the stream's next token — position
-            # len(ids)-1 keys identically to the decode step the old
-            # engine would have run, so the continued stream is
-            # token-identical to an uninterrupted run
-            ids_full = np.concatenate(
-                [req.prompt, np.asarray(journal, np.int32)])
-        else:
-            ids_full = req.prompt
-        s = int(ids_full.size)
-        # longest-prefix match against the radix cache (full pages only,
-        # capped at s-1: the sample at position s-1 needs its logits
-        # computed here, so at least one token always prefills). A
-        # migrated request matches over prompt + journal — failover of
-        # prefix-heavy traffic re-prefills only what the sibling's cache
-        # doesn't already hold.
+        ids = req.admission_ids()
         cache = self.prefix_cache if req.prefix_cache else None
         if cache is not None:
-            matched, shared_pages, _nodes = cache.match(ids_full)
+            matched, shared_pages, _nodes = cache.match(ids)
         else:
             matched, shared_pages = 0, []
-        ns = s - matched                   # tokens actually prefilled
-        bucket = _bucket(ns, self.max_model_len)
-        # KV buffer length: cold = the bucket itself (the original
-        # program, bit for bit); warm = next power of two covering
-        # prefix + padded suffix, so dynamic_update_slice at cur_len
-        # never clamps and rope tables cover every real position
-        cache_len = (bucket if matched == 0
-                     else 1 << (matched + bucket - 1).bit_length())
-        key = (bucket, cache_len)
-        prog = self._prefill_progs.get(key)
-        if prog is None:
-            prog = self._prefill_progs[key] = self._compile_with_retry(
-                "serving.compile_prefill",
-                lambda: self._make_prefill(bucket, cache_len))
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :ns] = ids_full[matched:]
-        n_kv, hd = self.pool.n_kv_heads, self.pool.head_dim
-        if matched:
-            # load the cached prefix KV (already rope'd at its absolute
-            # positions when first written) into rows 0..matched-1
-            prefix_kv = self.pool.gather_kv_range(shared_pages, matched)
-            flat = []
-            for k, v in prefix_kv:
-                kb = jnp.zeros((1, cache_len, n_kv, hd), self.pool.dtype)
-                vb = jnp.zeros((1, cache_len, n_kv, hd), self.pool.dtype)
-                flat.append(Tensor(
-                    kb.at[0, :matched].set(k.astype(self.pool.dtype)),
-                    stop_gradient=True))
-                flat.append(Tensor(
-                    vb.at[0, :matched].set(v.astype(self.pool.dtype)),
-                    stop_gradient=True))
-        else:
-            flat = [Tensor(jnp.zeros((1, cache_len, n_kv, hd),
-                                     self.pool.dtype), stop_gradient=True)
-                    for _ in range(2 * self.n_layers)]
-        res = prog(Tensor(jnp.asarray(ids)),
-                   Tensor(jnp.asarray(ns - 1, jnp.int32)),
-                   Tensor(jnp.asarray(matched, jnp.int32)), *flat)
-        last, fin, flat_kv = res[0], res[1], res[2:]
-        if not bool(np.asarray(fin._value).reshape(())):
-            # NaN/inf logits straight out of prefill: quarantine before
-            # any page is allocated or any token streamed — the prompt
-            # KV is as untrustworthy as the sample (a migrated request's
-            # already-streamed journal still delivers)
-            return self._emit_terminal(req, journal, "nan")
-
         # matched pages join the table by refcount (no free-list draw,
         # bumped before any fresh page is taken so eviction can't race
-        # the adoption); only the suffix KV is scattered
-        self.pool.allocate(req.req_id, s,
+        # the adoption); the chunk cursor starts AFTER the covered
+        # prefix — chunked-prefill progress and cache hits are the same
+        # thing, a cache length
+        self.pool.allocate(req.req_id, matched,
                            max_total_tokens=req.max_total_tokens,
                            prefix_pages=shared_pages,
                            prefix_tokens=matched)
-        self.pool.write_prompt_kv(req.req_id, [
-            (flat_kv[2 * i]._value[0, matched:matched + ns],
-             flat_kv[2 * i + 1]._value[0, matched:matched + ns])
-            for i in range(self.n_layers)], start=matched)
+        st = _SeqState(req, ids, pos=matched)
+        self.slots[self.slots.index(None)] = st
 
-        tok = int(np.asarray(self._sample_one(
-            last._value, req.temperature, self._sample_key(req.seed,
-                                                           s - 1))))
-        state = _SeqState(req, pos=s, last_token=tok)
-        if cache is not None:
-            # index this prompt's full pages for the next admission
-            # (prompt only — generated suffixes are per-request noise);
-            # the created nodes ride the slot state so a NaN quarantine
-            # can evict exactly what THIS request contributed
-            state.inserted_nodes = cache.insert(
-                req.prompt, int(req.prompt.size),
-                self.pool.block_table(req.req_id))
-        if journal:
-            state.gen = journal + [tok]  # seq numbers/limits continue
-        now = time.perf_counter()
-        self._m_prefill.observe(now - t0)
-        if not journal:  # a resumed request's first token landed long ago
-            self._m_ttft.observe(now - req.arrival_t)  # first token is OUT
-        self._m_tokens.inc()
-        self.stats["generated_tokens"] += 1
-        if req.stream_cb is not None:
-            # visible to cancel() for the duration of the callback (the
-            # request is in neither the queue nor a slot right now)
-            self._active_prefill = state
-            cb_err = self._safe_cb(req, tok, False, len(state.gen) - 1)
-            cancelled = self._active_prefill is None
-            self._active_prefill = None
-            if cancelled:  # cancel() ran inside the callback
-                return None
-            if cb_err is not None:
-                return self._retire_abnormal(state, slot=None,
-                                             reason="error", error=cb_err)
-        return self._maybe_retire(state, slot=None)
+    # --------------------------------------------------- unified step
+    def _grid_tokens(self, total: int) -> int:
+        """Token-grid bucket for one unified step: the slot grid B while
+        the step fits it (a decode-only step costs exactly what the old
+        decode-only program did, and a small chunk rides padding rows
+        that grid already pays for), else the next power of two (floored
+        at 16) — with an optional operator-pinned floor
+        (``min_step_tokens``) that freezes EVERY step to one shape, the
+        strongest inter-token-latency isolation: prompt chunks can never
+        change the compiled step's cost (docs/SERVING.md "Unified step &
+        chunked prefill")."""
+        floor_ = max(self.max_batch_slots, int(self.min_step_tokens or 0))
+        if total <= floor_:
+            return floor_
+        return max(_MIN_GRID_TOKENS, 1 << (int(total) - 1).bit_length())
 
-    @staticmethod
-    def _sample_key(seed, position):
-        """THE determinism contract, in one line: the key that samples
-        the token following ``position`` (0-based index of the last
-        consumed token) is ``fold_in(PRNGKey(seed), position)`` — a pure
-        function of (request seed, stream position). Prefill calls this
-        on the host; the compiled decode step computes the identical
-        expression per slot (traced, vmapped) — threefry is
-        deterministic, so both derive bit-equal keys and a request's
-        sampled stream is independent of batch composition, engine
-        history, and any migration."""
-        return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    def _make_step(self) -> jit.StaticFunction:
+        """THE unified ragged step program (tentpole of ISSUE 11): one
+        compiled function serving every prefill/decode mix. Inputs ride
+        as data, shapes only as the token-grid bucket T:
 
-    def _sample_one(self, last, temperature, key):
-        """First-token sample after prefill — delegates to the model's
-        ``GenerationMixin._sample`` so there is exactly one copy of the
-        greedy/temperature logic to keep token-identical with dense
-        ``generate()``."""
-        return self.model._sample(last, temperature, 0, key)[0]
+        - ``tok`` [T, 1] — every query token this step, flattened: one
+          row per decode slot, one row per prompt-chunk token,
+        - ``tok_pos`` [T] — each row's absolute position,
+        - ``tok_bt`` [T, pages_per_seq] — each row's OWNER's block table
+          (a chunk repeats its slot's table row per token),
+        - ``last_row`` [B] — grid row of each slot's LAST token (where
+          its sample reads logits; 0 for idle slots, discarded on host),
+        - ``sample_pos`` [B] — the position that keys each slot's sample,
+        - ``temps``/``seeds`` [B] — per-slot sampling params,
+        - ``*flat_pools`` — the paged KV pools, consumed and returned
+          functionally.
 
-    # -------------------------------------------------------------- decode
-    def _make_decode(self) -> jit.StaticFunction:
+        The trunk's ``forward_paged`` treats every row as "one token at
+        an arbitrary position over an arbitrary page list" — which is
+        the whole ragged trick (ops/pallas/paged_attention.py "Ragged
+        form"): each layer scatters ALL T rows' KV into the pool first,
+        then gathers per-row attention masked at the row's own position,
+        so chunk tokens causally see their chunk-mates and decode rows
+        are untouched by them. Sampling gathers the B slot rows BEFORE
+        the vocab matmul (the [V] projection runs on B rows, not T) and
+        derives per-slot keys fold_in(PRNGKey(seed), sample_pos) — the
+        _sample_key contract, traced."""
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
 
-        def step_fn(tok, pos, temps, seeds, bt, *flat_pools):
+        def step_fn(tok, tok_pos, tok_bt, last_row, sample_pos, temps,
+                    seeds, *flat_pools):
             caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
                       for i in range(n_layers)]
             with no_grad():
-                hidden, ncs = trunk.forward_paged(tok, pos, bt, caches)
-                logits = model.logits(hidden)
+                hidden, ncs = trunk.forward_paged(tok, tok_pos, tok_bt,
+                                                  caches)
+                # per-slot sample rows gathered BEFORE the vocab matmul:
+                # the grid carries up to token-budget rows but only
+                # max_batch_slots of them sample
+                last_h = apply_op(
+                    lambda h, li: h[li.astype(jnp.int32)],
+                    [ensure_tensor(hidden), ensure_tensor(last_row)],
+                    name="gather_sample_rows")
+                logits = model.logits(last_h)
             last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
                             [ensure_tensor(logits)], name="last_logits")
             # per-slot finite flag BEFORE sampling: the host quarantines
-            # any row whose logits went NaN/inf (poisoned KV, numeric
+            # any slot whose logits went NaN/inf (poisoned KV, numeric
             # blowup) without ever trusting its sampled token — and
             # because it rides in the same program, the check costs one
-            # fused reduction, not a second compile
+            # fused reduction, not a second compile. Mid-prompt chunks
+            # get the same canary: their sample row is real compute even
+            # though its sample is discarded.
             fin = apply_op(
                 lambda lv: jnp.isfinite(lv).all(axis=-1),
                 [last], name="logits_finite")
@@ -962,10 +912,10 @@ class ServingEngine:
                 # _sample_key contract, traced: each request samples
                 # from ITS OWN stream, so its tokens are a pure function
                 # of (prompt, seed, temperature) no matter which
-                # batch-mates ride the grid or which engine runs it.
-                # seeds and positions are DATA: no recompile, and an
-                # idle slot's (0, 0) key samples masked garbage that the
-                # host discards as before.
+                # batch-mates ride the grid, how its prompt was chunked,
+                # or which engine runs it. seeds and positions are DATA:
+                # no recompile, and an idle slot's (0, 0) key samples
+                # masked garbage that the host discards as before.
                 greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
                 t = jnp.maximum(tv.astype(jnp.float32), 1e-6)
 
@@ -980,61 +930,100 @@ class ServingEngine:
 
             nxt = apply_op(batched_sample,
                            [last, ensure_tensor(temps),
-                            ensure_tensor(seeds), ensure_tensor(pos)],
+                            ensure_tensor(seeds), ensure_tensor(sample_pos)],
                            name="serve_sample")
             flat = [t for c in ncs for t in c]
             return (nxt, fin, *flat)
 
-        # "decode compiles exactly once" becomes monitorable:
-        # jit_compiles_total{fn="serving_decode"} must pin at 1
-        step_fn.__name__ = "serving_decode"
+        # "the step compiles once per bucket" becomes monitorable:
+        # jit_compiles_total{fn="serving_step"} must pin at the
+        # bucket-set size
+        step_fn.__name__ = "serving_step"
         return jit.StaticFunction(step_fn, observe=[self.model],
                                   warmup=False, dy2static=False)
 
-    def _decode_once(self) -> List[RequestOutput]:
+    def _step_once(self) -> List[RequestOutput]:
         t0 = time.perf_counter()
-        if self._decode_prog is None:
-            self._decode_prog = self._compile_with_retry(
-                "serving.compile_decode", self._make_decode)
         B = self.max_batch_slots
-        tok = np.zeros((B, 1), np.int32)
-        pos = np.zeros(B, np.int32)
-        temps = np.zeros(B, np.float32)
-        seeds = np.zeros(B, np.int32)
-        seq_ids: List[Optional[object]] = [None] * B
         finished: List[RequestOutput] = []
+        decode_idx: List[int] = []
+        prefill_info = []
         for i, st in enumerate(self.slots):
             if st is None:
                 continue
+            if st.prefilling:
+                prefill_info.append((i, int(st.ids.size) - st.pos, st.req))
+            else:
+                decode_idx.append(i)
+        chunks = self.scheduler.plan_chunks(len(decode_idx), prefill_info)
+
+        # KV room per slot BEFORE the compiled step: decode rows reserve
+        # this step's one write via extend() (not append_token — a step
+        # aborted after this loop re-reserves the SAME position on retry
+        # instead of drifting _lens one phantom token per aborted step);
+        # chunk rows reserve their whole range via extend_write (CoW
+        # seam included). Out of pages (impossible unless injected/
+        # buggy): quarantine the victim, keep the rest of the batch —
+        # its row simply never joins the grid.
+        rows = []  # (slot, token ids [c], positions [c], is_chunk)
+        for i in decode_idx:
+            st = self.slots[i]
             try:
-                # room for this step's KV write at position st.pos —
-                # extend() (not append_token) so a step aborted after
-                # this loop re-reserves the SAME position on retry
-                # instead of drifting _lens past the admission
-                # accounting one phantom token per aborted step
                 self.pool.extend(st.req.req_id, st.pos + 1)
             except Exception as e:
-                # out of pages mid-decode (admission accounting makes
-                # this impossible unless injected/buggy): quarantine the
-                # victim, keep the rest of the batch decoding — its slot
-                # reads as idle (null block table) this step
                 finished.append(
                     self._retire_abnormal(st, slot=i, reason="error",
                                           error=e))
                 continue
-            tok[i, 0] = st.last_token
-            pos[i] = st.pos
+            rows.append((i, np.asarray([st.last_token], np.int32),
+                         np.asarray([st.pos], np.int32), False))
+        n_decode_tokens = len(rows)
+        for i, c in chunks:
+            st = self.slots[i]
+            try:
+                self.pool.extend_write(st.req.req_id, st.pos, st.pos + c)
+            except Exception as e:
+                finished.append(
+                    self._retire_abnormal(st, slot=i, reason="error",
+                                          error=e))
+                continue
+            rows.append((i, st.ids[st.pos:st.pos + c],
+                         np.arange(st.pos, st.pos + c, dtype=np.int32),
+                         True))
+        faults.point("serving.decode_step")
+        if not rows:
+            return finished
+        total = sum(r[1].size for r in rows)
+        T = self._grid_tokens(total)
+        self._grid_buckets_seen.add(T)
+        tok = np.zeros((T, 1), np.int32)
+        tok_pos = np.zeros(T, np.int32)
+        tok_bt = np.zeros((T, self.pages_per_seq), np.int32)
+        last_row = np.zeros(B, np.int32)
+        sample_pos = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        seeds = np.zeros(B, np.int32)
+        cur = 0
+        for i, toks, poss, _is_chunk in rows:
+            st = self.slots[i]
+            c = toks.size
+            tok[cur:cur + c, 0] = toks
+            tok_pos[cur:cur + c] = poss
+            table = self.pool.block_table(st.req.req_id)
+            tok_bt[cur:cur + c, :len(table)] = table
+            last_row[i] = cur + c - 1
+            sample_pos[i] = int(poss[-1])
             temps[i] = st.req.temperature
             seeds[i] = st.req.seed
-            seq_ids[i] = st.req.req_id
-        faults.point("serving.decode_step")
-        if not any(s is not None for s in self.slots):
-            return finished  # every slot aborted before the compiled step
-        bt = self.pool.block_table_array(seq_ids, self.pages_per_seq)
-        res = self._decode_prog(
-            Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(pos)),
-            Tensor(jnp.asarray(temps)), Tensor(jnp.asarray(seeds)),
-            Tensor(jnp.asarray(bt)),
+            cur += c
+        if self._step_prog is None:
+            self._step_prog = self._compile_with_retry(
+                "serving.compile_step", self._make_step)
+        res = self._step_prog(
+            Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(tok_pos)),
+            Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(last_row)),
+            Tensor(jnp.asarray(sample_pos)), Tensor(jnp.asarray(temps)),
+            Tensor(jnp.asarray(seeds)),
             *[p for i in range(self.n_layers)
               for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
         nxt, fin, flat = res[0], res[1], res[2:]
@@ -1044,63 +1033,117 @@ class ServingEngine:
         fin_host = np.asarray(fin.numpy()).reshape(B).astype(bool)
         now = time.perf_counter()
         self._m_decode.observe(now - t0)
+        self._m_mix_decode.observe(n_decode_tokens)
+        self._m_mix_prefill.observe(total - n_decode_tokens)
 
-        for i, st in enumerate(self.slots):
+        for i, toks, poss, is_chunk in rows:
+            st = self.slots[i]
             if st is None:
+                # an earlier row's callback cancelled THIS slot's
+                # request reentrantly — touching it again would
+                # double-free its pages (no admission runs mid-step, so
+                # a non-None slot is still the row's own state)
                 continue
             if not fin_host[i]:
-                # NaN/inf logits: quarantine ONLY this sequence — its
-                # sampled token is garbage and is never appended; pages
-                # return to the pool now; batch-mates are untouched
-                # because attention gathers strictly via block tables
+                # NaN/inf logits on the slot's sample row: quarantine
+                # ONLY this sequence — its sampled token is garbage and
+                # is never appended (for a chunk, the KV it wrote is as
+                # untrustworthy as the sample); pages return to the pool
+                # now; batch-mates are untouched because attention
+                # gathers strictly via block tables. Mid-prompt chunks
+                # get the same canary, so poison never survives to a
+                # later chunk.
+                if is_chunk:
+                    st.pos += toks.size
+                    self._m_chunk.observe(toks.size)
                 finished.append(
                     self._retire_abnormal(st, slot=i, reason="nan"))
                 continue
-            t = int(nxt_host[i])
-            st.pos += 1
-            st.last_token = t
-            st.gen.append(t)
-            # per-sequence inter-token latency: the streaming SLO — decode
-            # step time plus any step this sequence sat through
-            self._m_itl.observe(now - st.t_last)
-            st.t_last = now
-            self._m_tokens.inc()
-            self.stats["generated_tokens"] += 1
-            if st.req.stream_cb is not None:
-                cb_err = self._safe_cb(st.req, t, False, len(st.gen) - 1)
-                if self.slots[i] is not st:
-                    # cancel() ran inside the callback and already
-                    # retired this sequence — touching it again would
-                    # double-free its pages
-                    continue
-                if cb_err is not None:
-                    finished.append(
-                        self._retire_abnormal(st, slot=i, reason="error",
-                                              error=cb_err))
-                    continue
-            out = self._maybe_retire(st, slot=i)
+            if is_chunk:
+                c = toks.size
+                st.pos += c
+                self._m_chunk.observe(c)
+                if st.prefilling:
+                    continue  # mid-prompt: more chunks to go, no token
+                # FINAL chunk: the sample at position len(ids)-1 IS the
+                # stream's next token (first generated, or the journal's
+                # successor for a migrated request — key position s-1
+                # matches the decode the dead engine would have run)
+                cache = (self.prefix_cache if st.req.prefix_cache
+                         else None)
+                if cache is not None:
+                    # index this prompt's full pages for the next
+                    # admission (prompt only — journal/generated tokens
+                    # are per-request noise); the created nodes ride the
+                    # slot state so a NaN quarantine can evict exactly
+                    # what THIS request contributed
+                    st.inserted_nodes = cache.insert(
+                        st.req.prompt, int(st.req.prompt.size),
+                        self.pool.block_table(st.req.req_id))
+                self._m_prefill.observe(now - st.t_admit)
+                if not st.req.resume_tokens:
+                    # a resumed request's first token landed long ago
+                    self._m_ttft.observe(now - st.req.arrival_t)
+            else:
+                st.pos += 1
+                # per-sequence inter-token latency: the streaming SLO —
+                # step time plus any step this sequence sat through
+                self._m_itl.observe(now - st.t_last)
+            out = self._land_token(st, slot=i, token=int(nxt_host[i]),
+                                   now=now)
             if out is not None:
                 finished.append(out)
         return finished
 
+    def _land_token(self, st: _SeqState, slot: int, token: int,
+                    now: float) -> Optional[RequestOutput]:
+        """ONE copy of the token-landing choreography, shared by the
+        final-chunk first token and every decode token: append to the
+        journal, stream it (isolated, reentrant-cancel-aware), and
+        retire on eos/length. Returns the retirement output, if any."""
+        st.last_token = token
+        st.gen.append(token)
+        st.t_last = now
+        self._m_tokens.inc()
+        self.stats["generated_tokens"] += 1
+        if st.req.stream_cb is not None:
+            cb_err = self._safe_cb(st.req, token, False, len(st.gen) - 1)
+            if self.slots[slot] is not st:
+                # cancel() ran inside the callback and already retired
+                # this sequence — touching it again would double-free
+                return None
+            if cb_err is not None:
+                return self._retire_abnormal(st, slot=slot,
+                                             reason="error", error=cb_err)
+        return self._maybe_retire(st, slot=slot)
+
+    @staticmethod
+    def _sample_key(seed, position):
+        """THE determinism contract, in one line: the key that samples
+        the token following ``position`` (0-based index of the last
+        consumed token) is ``fold_in(PRNGKey(seed), position)`` — a pure
+        function of (request seed, stream position). The compiled step
+        computes the identical expression per slot (traced, vmapped) for
+        final-chunk first tokens and decode tokens alike — threefry is
+        deterministic, so every engine derives bit-equal keys and a
+        request's sampled stream is independent of batch composition,
+        chunk boundaries, engine history, and any migration."""
+        return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
     # -------------------------------------------------------------- retire
     def _maybe_retire(self, st: _SeqState,
-                      slot: Optional[int]) -> Optional[RequestOutput]:
+                      slot: int) -> Optional[RequestOutput]:
         req = st.req
         hit_eos = (req.eos_token_id is not None
                    and st.last_token == req.eos_token_id)
         if not hit_eos and len(st.gen) < req.max_new_tokens:
-            if slot is None:  # fresh prefill: park in a free slot
-                i = self.slots.index(None)
-                self.slots[i] = st
             return None
         # retire NOW: pages go back to the pool this very step (has_seq
         # guard: a reentrant cancel from the terminal-token's stream
         # callback may have freed them already)
         if self.pool.has_seq(req.req_id):
             self.pool.free(req.req_id)
-        if slot is not None:
-            self.slots[slot] = None
+        self.slots[slot] = None
         self._m_requests.labels(event="retired", **self._lbl).inc()
         self.stats["finished_requests"] += 1
         out = RequestOutput(req_id=req.req_id,

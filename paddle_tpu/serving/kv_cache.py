@@ -283,14 +283,22 @@ class PagedKVCachePool:
         self._tables[seq_id] = table
         self._lens[seq_id] = int(prefix_tokens)
         self._resv[seq_id] = resv
-        try:
-            self.extend(seq_id, n_tokens)
-        except Exception:
-            # atomic: a mid-allocate failure (real exhaustion or an armed
-            # serving.kv_alloc fault) must not leak a half-built sequence —
-            # roll back pages already taken and the bookkeeping entries
-            self.free(seq_id)
-            raise
+        if int(n_tokens) > int(prefix_tokens):
+            try:
+                self.extend(seq_id, n_tokens)
+            except Exception:
+                # atomic: a mid-allocate failure (real exhaustion or an
+                # armed serving.kv_alloc fault) must not leak a half-built
+                # sequence — roll back pages already taken and the
+                # bookkeeping entries
+                self.free(seq_id)
+                raise
+        # n_tokens == prefix_tokens is the chunked-prefill admission
+        # path: the sequence starts as EXACTLY its adopted prefix (zero
+        # fresh pages, zero writable-page checks — the next write lands
+        # at position prefix_tokens, a page this table doesn't hold yet,
+        # so CoW-copying the shared tail page here would only break the
+        # sharing the adoption just paid for)
         self.peak_used = max(self.peak_used, self.used_pages)
         return list(self._tables[seq_id])
 
@@ -307,6 +315,28 @@ class PagedKVCachePool:
         self._lens[seq_id] = max(self._lens[seq_id], int(total_tokens))
         self._ensure_writable(seq_id, int(total_tokens) - 1)
 
+    def extend_write(self, seq_id, start: int, total_tokens: int) -> None:
+        """Grow ``seq_id``'s table to cover ``total_tokens`` of KV and
+        make EVERY page holding positions ``start .. total_tokens-1``
+        exclusively owned — the multi-token variant of :meth:`extend`'s
+        one-slot CoW seam. A unified-step prompt chunk scatters a whole
+        token range in one compiled program, so any page it touches that
+        a fork sibling or the prefix cache still references must be
+        copied first (freshly drawn pages are exclusive by construction;
+        in practice only the range's FIRST page can be shared — a
+        partially written fork tail)."""
+        start, total = int(start), int(total_tokens)
+        if total <= start:
+            return
+        table = self._tables[seq_id]
+        need = self.pages_needed(total)
+        while len(table) < need:
+            table.append(self._take_page())
+        self._lens[seq_id] = max(self._lens[seq_id], total)
+        for pi in range(start // self.page_size,
+                        (total - 1) // self.page_size + 1):
+            self._ensure_page_writable(seq_id, pi)
+
     def _ensure_writable(self, seq_id, token_pos: int) -> None:
         """Copy-on-write: if the page holding ``token_pos`` is shared
         (refcount > 1 — a fork sibling or the prefix cache also holds
@@ -314,8 +344,12 @@ class PagedKVCachePool:
         entry, leaving the shared original untouched."""
         if token_pos < 0:
             return
+        self._ensure_page_writable(seq_id, token_pos // self.page_size)
+
+    def _ensure_page_writable(self, seq_id, pi: int) -> None:
+        """CoW one block-table entry by page index (the shared seam of
+        :meth:`extend` and :meth:`extend_write`)."""
         table = self._tables[seq_id]
-        pi = token_pos // self.page_size
         old = table[pi]
         if self._ref[old] <= 1:
             return

@@ -506,7 +506,13 @@ class Router:
         """Every req_id currently queued or in-flight on any non-down
         engine; None when some engine's state is unreadable (reaping
         aborts for that sweep rather than dropping a mark that might
-        still be live)."""
+        still be live). The slot scan covers EVERY in-flight request —
+        decoding slots and all concurrently chunk-prefilling slots alike
+        (the unified-step engine parks a request in its slot at
+        admission, so there is no out-of-slot "active prefill" state to
+        enumerate separately; the old single-`_active_prefill` probe
+        would silently drop every concurrent chunked prefill but one
+        from migration accounting)."""
         live: set = set()
         try:
             for h in self._handles.values():
@@ -518,8 +524,6 @@ class Router:
                 for st in eng.slots:
                     if st is not None:
                         live.add(st.req.req_id)
-                if eng._active_prefill is not None:
-                    live.add(eng._active_prefill.req.req_id)
         except Exception:
             return None
         return live

@@ -1,29 +1,45 @@
-"""Continuous-batching scheduler: FCFS admission under a token budget.
+"""Chunked-prefill scheduler: priority admission + a shared token budget.
 
 The policy half of the serving engine (the mechanism — pages, compiled
-steps — lives in engine.py/kv_cache.py). Requests queue FCFS; each engine
-step admits waiting requests into free batch slots as long as
+steps — lives in engine.py/kv_cache.py). Two decisions per engine step:
+
+**Admission** (:meth:`FCFSScheduler.admit`): waiting requests enter free
+batch slots in (priority tier, arrival) order as long as
 
 1. a fixed decode slot is free (the compiled step's batch is padded to
    ``max_batch_slots``, so slots — not requests — bound concurrency),
 2. the KV pool can cover the request's WORST CASE (prompt + max_new
    tokens) on top of every live reservation (kv_cache.can_admit) — with
-   no preemption, admitting on hope would strand a sequence mid-decode,
-3. this step's prefill token budget is not exhausted — prefill compute is
-   O(prompt²) while decode is O(1) per live sequence, so unbounded
-   admission would stall every running stream for one giant prompt
-   (the continuous-batching latency win this budget protects).
+   no preemption, admitting on hope would strand a sequence mid-decode.
 
-Head-of-line semantics: strict FCFS — if the head request doesn't fit,
-nothing behind it is admitted (no starvation of big prompts).
+Admission no longer gates on prompt length: a 10k-token prompt admits
+immediately and *prefills in chunks* across subsequent steps, so one
+giant prompt never has to wait for (or monopolize) a step.
+
+**Chunking** (:meth:`FCFSScheduler.plan_chunks`): every step has a fixed
+``token_budget`` shared by the whole batch. Decode tokens are charged
+FIRST — decode-first under load: a running stream's next token is never
+displaced by prompt work — and mid-prefill slots split the remainder in
+SLO order (priority tier, then earliest deadline, then arrival), each
+taking as much of its remaining prompt as the budget leaves. Prefill
+compute is O(prompt x cache) while decode is O(cache) per sequence, so
+the budget is what bounds a step's cost — and with it the inter-token
+latency every decoding tenant observes (docs/SERVING.md "Unified step &
+chunked prefill").
+
+Head-of-line semantics: strict within the priority order — if the head
+request doesn't fit the pool, nothing behind it is admitted (no
+starvation of big prompts by small ones of the same tier; a HIGHER tier
+request enqueues ahead and is not blocked by a lower tier's head).
 """
 from __future__ import annotations
 
 import itertools
+import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -73,6 +89,13 @@ class Request:
     # shares no pages — the per-request escape hatch under the
     # engine-level ServingEngine(prefix_cache=) flag
     prefix_cache: bool = True
+    # SLO tier: lower is more urgent (0 = default). Honored at ADMISSION
+    # (the queue orders by (priority, arrival) — a tier-0 request
+    # enqueues ahead of every waiting tier-1 request) and at CHUNKING
+    # (higher tiers' prompt chunks take the step's token budget first),
+    # docs/SERVING.md "Unified step & chunked prefill". Within a tier,
+    # deadline-bearing requests chunk earliest-deadline-first.
+    priority: int = 0
     # resume journal (docs/RESILIENCE.md "In-flight migration"): tokens
     # this request already generated on an engine that died. Set by
     # ServingEngine.export_inflight; an adopting engine re-prefills
@@ -101,6 +124,7 @@ class Request:
         # agree on the exact same value.
         s = int(self.seed) & 0xFFFFFFFF
         self.seed = s - (1 << 32) if s >= (1 << 31) else s
+        self.priority = int(self.priority)
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if self.deadline_s is not None and self.deadline_s < 0:
@@ -161,19 +185,28 @@ class RequestOutput:
 
 
 class FCFSScheduler:
-    """FCFS waiting queue + per-step admission (policy only: slot/page
-    bookkeeping stays in the engine/pool)."""
+    """Priority-tiered waiting queue + per-step admission + chunk
+    planning (policy only: slot/page bookkeeping stays in the
+    engine/pool). The name survives from the PR 1 pure-FCFS scheduler;
+    within one priority tier the order is still first-come-first-served,
+    and the default tier makes the whole queue plain FCFS."""
 
     def __init__(self, max_batch_slots: int,
-                 prefill_token_budget: int = 1024,
+                 token_budget: int = 1024,
                  max_queue: Optional[int] = None,
                  retry_after_cb: Optional[Callable[[], float]] = None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1 (or None: unbounded)")
+        if token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
         self.max_batch_slots = int(max_batch_slots)
-        self.prefill_token_budget = int(prefill_token_budget)
+        # the per-STEP token budget shared by decode (charged first) and
+        # prompt chunks (docs/SERVING.md "Unified step & chunked
+        # prefill") — the lever trading a long prompt's TTFT against
+        # every decoding tenant's inter-token latency
+        self.token_budget = int(token_budget)
         # backpressure bound: add() rejects with BackpressureError past
         # this depth. retry_after_cb computes the hint from live drain
         # rate (the engine installs its step-time EWMA); the fallback
@@ -199,15 +232,31 @@ class FCFSScheduler:
             "Requests rejected at enqueue because the bounded queue was "
             "full (BackpressureError)")
 
+    @property
+    def prefill_token_budget(self) -> int:
+        """Deprecated alias of :attr:`token_budget` (the PR 1 name): the
+        budget now bounds the WHOLE step's tokens — decode first, prompt
+        chunks in the remainder — not a separate prefill phase."""
+        return self.token_budget
+
     def _retry_after(self) -> float:
         if self._retry_after_cb is not None:
             return max(float(self._retry_after_cb()), 0.0)
         return max(0.05, 0.1 * len(self.waiting) / self.max_batch_slots)
 
+    def _step_charge(self, request: Request) -> int:
+        """Engine steps this request will consume end-to-end: its prompt
+        chunks under the step token budget (a 10k prompt at budget 256
+        is ~40 steps of prefill, and the router's least-loaded scoring
+        must see them) plus one decode step per remaining new token."""
+        chunks = -(-request.prefill_tokens // self.token_budget)
+        return max(chunks, 1) + request.remaining_new_tokens
+
     def add(self, request: Request) -> None:
-        """Queue a request FCFS, or raise :class:`BackpressureError` when
-        the bounded queue is full (never silently drops, never grows
-        unboundedly)."""
+        """Queue a request in (priority, arrival) order — FCFS within a
+        tier — or raise :class:`BackpressureError` when the bounded
+        queue is full (never silently drops, never grows unboundedly;
+        priority does not bypass backpressure)."""
         if self.max_queue is not None and len(self.waiting) >= self.max_queue:
             self._m_rejections.inc()
             hint = self._retry_after()
@@ -216,8 +265,16 @@ class FCFSScheduler:
                 f" waiting, limit: max_queue={self.max_queue}); retry in "
                 f"~{hint:.3f}s", retry_after_s=hint,
                 queue_depth=len(self.waiting))
-        self.waiting.append(request)
-        self._pending_steps += 1 + request.remaining_new_tokens
+        # stable tier insert: after every waiting request of <= priority
+        # (arrival order within a tier), before the first lower tier
+        idx = len(self.waiting)
+        while idx > 0 and self.waiting[idx - 1].priority > request.priority:
+            idx -= 1
+        if idx == len(self.waiting):
+            self.waiting.append(request)
+        else:
+            self.waiting.insert(idx, request)
+        self._pending_steps += self._step_charge(request)
         if request.deadline is not None:
             self._n_deadlined += 1
 
@@ -238,7 +295,7 @@ class FCFSScheduler:
         self.waiting = alive
         self._n_deadlined -= len(expired)
         for r in expired:
-            self._pending_steps -= 1 + r.remaining_new_tokens
+            self._pending_steps -= self._step_charge(r)
         return expired
 
     def pop_all(self) -> List[Request]:
@@ -259,7 +316,7 @@ class FCFSScheduler:
         for i, r in enumerate(self.waiting):
             if r.req_id == req_id:
                 del self.waiting[i]
-                self._pending_steps -= 1 + r.remaining_new_tokens
+                self._pending_steps -= self._step_charge(r)
                 if r.deadline is not None:
                     self._n_deadlined -= 1
                 return r
@@ -276,58 +333,92 @@ class FCFSScheduler:
         return self._pending_steps
 
     def admit(self, free_slots: int, pool) -> List[Request]:
-        """Pop the FCFS prefix that fits this step: free decode slots,
-        worst-case page reservations, and the prefill token budget."""
+        """Pop the (priority, arrival)-ordered prefix that fits this
+        step: free decode slots and worst-case page reservations.
+
+        Prompt LENGTH no longer gates admission — an admitted request's
+        prefill runs in chunks under :meth:`plan_chunks`'s per-step
+        budget, so a 10k-token prompt admits the moment a slot and its
+        worst-case pages are available, and its TTFT clock starts
+        making progress immediately instead of waiting for an idle
+        step."""
         admitted: List[Request] = []
-        budget = self.prefill_token_budget
         # pages promised to THIS step's earlier admissions: the pool only
-        # records a reservation at prefill (after admit returns), so
-        # can_admit must be charged for batch-mates or two big requests
-        # admitted together could jointly over-commit the pool.
-        # pending_cached tracks cache pages those admissions will PIN —
-        # they must stop counting as reclaimable for later batch-mates
+        # records a reservation when the engine parks the request (after
+        # admit returns), so can_admit must be charged for batch-mates or
+        # two big requests admitted together could jointly over-commit
+        # the pool. pending_cached tracks cache pages those admissions
+        # will PIN — they must stop counting as reclaimable for later
+        # batch-mates.
         pending_pages = 0
         pending_cached = 0
         while self.waiting and free_slots > 0:
             req = self.waiting[0]
-            # prefill-cost honesty: the budget exists to bound prefill
-            # COMPUTE this step, so charge only what will actually run —
-            # prompt + journal (a migrated request's ragged re-prefill)
-            # MINUS the cached prefix the engine's radix cache already
-            # covers (the probe walks the same index the prefill will
-            # match, floor 1: the last token always prefills). Matched
-            # pages likewise don't draw from the free list, so admission
-            # discounts them from the page charge too.
+            # matched prefix pages join the block table by refcount, not
+            # by a free-list draw (the probe walks the same radix index
+            # the admission will match), so the page charge discounts
+            # them — warm prompts admit alongside work a cold charge
+            # would have deferred
             matched = (pool.prefix_match_len(req.admission_ids())
                        if req.prefix_cache else 0)
-            cost = max(req.prefill_tokens - matched, 1)
             cached_pages = matched // pool.page_size
-            if cost > budget and admitted:
-                break  # budget spent this step; FCFS head keeps its turn
-            # (an over-budget prompt with no batch-mates still runs, alone
-            # this step, or it would starve forever)
             if not pool.can_admit(req.max_total_tokens, pending_pages,
                                   cached_pages=cached_pages,
                                   pending_cached=pending_cached):
                 break  # head-of-line blocks: no overtaking, no starvation
             self.waiting.popleft()
-            self._pending_steps -= 1 + req.remaining_new_tokens
+            self._pending_steps -= self._step_charge(req)
             if req.deadline is not None:
                 self._n_deadlined -= 1
             admitted.append(req)
-            if not req.resume_tokens:
+            if req.resume_tokens is None:
                 # queue-wait measures FIRST admission from the original
                 # enqueue; a migrated request's second admission would
-                # fold all its decode time on the dead engine into the
+                # fold all its time on the dead engine into the
                 # histogram, spiking p95 during exactly the incidents
-                # operators read it for (same skew guard as TTFT)
+                # operators read it for (same skew guard as TTFT).
+                # `is None`, not falsy: a request migrated BETWEEN its
+                # prompt chunks journals an EMPTY list — it was admitted
+                # once already and must not re-observe either
                 self._m_queue_wait.observe(
                     time.perf_counter() - req.arrival_t)
             pending_pages += (pool.pages_needed(req.max_total_tokens)
                               - cached_pages)
             pending_cached += cached_pages
             free_slots -= 1
-            budget -= cost
-            if budget <= 0:
-                break
         return admitted
+
+    def plan_chunks(self, n_decode: int,
+                    prefills: Sequence[Tuple[object, int, Request]]
+                    ) -> List[Tuple[object, int]]:
+        """Slice this step's prompt-chunk work under the shared token
+        budget. ``n_decode`` decode tokens are charged FIRST —
+        decode-first under load: a running stream's next token is never
+        displaced by prompt work — and mid-prefill slots split the
+        remainder in SLO order: priority tier, then earliest deadline
+        (an SLO-bearing request inside a tier prefills ahead of
+        unbounded ones), then arrival. ``prefills`` is
+        ``[(key, remaining_prompt_tokens, request)]``; returns
+        ``[(key, chunk_tokens)]`` in service order, chunks >= 1, for as
+        many slots as the budget covers this step. Slots left out simply
+        wait — decode retirements free budget within a bounded number of
+        steps, so a prefill can lag but never starves forever."""
+        left = max(self.token_budget - int(n_decode), 0)
+        plan: List[Tuple[object, int]] = []
+        if left <= 0 or not prefills:
+            return plan
+        order = sorted(
+            prefills,
+            key=lambda e: (e[2].priority,
+                           e[2].deadline.remaining()
+                           if e[2].deadline is not None else math.inf,
+                           e[2].arrival_t))
+        for key, remaining, _req in order:
+            if left <= 0:
+                break
+            chunk = min(int(remaining), left)
+            if chunk <= 0:
+                continue
+            plan.append((key, chunk))
+            left -= chunk
+        return plan

@@ -876,9 +876,8 @@ class TestCompiledScopeDetection:
             os.path.join(REPO, "paddle_tpu", "serving", "engine.py"), REPO)
         assert err is None
         names = {fn.name for fn in CompiledScopes(mod.tree).compiled}
-        # the decode/prefill programs AND their traced helpers
-        assert {"prefill_fn", "step_fn", "batched_sample",
-                "one_row"} <= names
+        # the unified step program AND its traced helpers
+        assert {"step_fn", "batched_sample", "one_row"} <= names
 
 
 # -------------------------------------------------- metrics_dump bridge

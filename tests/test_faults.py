@@ -136,7 +136,7 @@ class TestFaultPoints:
     def test_known_points_catalog_covers_serving(self):
         pts = faults.known_points()
         for name in ("serving.step", "serving.prefill",
-                     "serving.decode_step", "serving.compile_decode",
+                     "serving.decode_step", "serving.compile_step",
                      "serving.kv_alloc"):
             assert name in pts and pts[name]
 
@@ -241,7 +241,7 @@ class TestServingChaos:
         assert ref[m0].finish_reason == "length"
 
         jit_before = _counter("paddle_tpu_jit_compiles_total",
-                              fn="serving_decode")
+                              fn="serving_step")
         nan_before = _counter("paddle_tpu_serving_nan_quarantines_total")
         eng = ServingEngine(model, page_size=4, max_batch_slots=2)
         mate = eng.add_request(_PROMPTS[0], max_new_tokens=8)
@@ -264,13 +264,14 @@ class TestServingChaos:
         assert outs[mate].finish_reason == "length"
         # pages recovered to baseline (everything drained -> 0 used)
         assert eng.pool.used_pages == 0
-        # telemetry: one quarantine, and decode compiled EXACTLY once
-        # for this engine despite the injection
+        # telemetry: one quarantine, and the unified step compiled
+        # EXACTLY once per token-grid bucket despite the injection
         assert (_counter("paddle_tpu_serving_nan_quarantines_total")
                 == nan_before + 1)
-        assert eng.compile_counts()["decode"] == 1
+        counts = eng.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
         assert (_counter("paddle_tpu_jit_compiles_total",
-                         fn="serving_decode") == jit_before + 1)
+                         fn="serving_step") == jit_before + counts["step"])
 
     def test_prefill_nan_quarantined_before_any_token(self):
         """A non-finite PREFILL must quarantine before any page is
@@ -298,14 +299,14 @@ class TestServingChaos:
         still drains — no deadlock, no page leak."""
         model = _llama()
         engine = ServingEngine(model, page_size=4, max_batch_slots=2)
-        # victim prompt is 3 tokens: after prefill + one decode it sits
-        # at exactly page_size=4, so ITS next decode append needs a
-        # fresh page — which is where the armed fault lands (the len-4
-        # mate took its second page back in the un-armed first step)
-        victim = engine.add_request(_PROMPTS[2], max_new_tokens=6)
-        mate = engine.add_request(_PROMPTS[3], max_new_tokens=6)
+        # victim prompt is 4 tokens: its chunked prefill exactly fills
+        # page_size=4, so ITS first decode append needs a fresh page —
+        # which is where the armed fault lands (the len-3 mate's first
+        # decode still fits its prefill page)
+        victim = engine.add_request(_PROMPTS[3], max_new_tokens=6)
+        mate = engine.add_request(_PROMPTS[2], max_new_tokens=6)
         queued = engine.add_request(_PROMPTS[2], max_new_tokens=4)
-        engine.step()  # admit+prefill victim/mate (queued waits: 2 slots)
+        engine.step()  # admit + chunk victim/mate (queued waits: 2 slots)
         with faults.inject("serving.kv_alloc",
                            raise_=faults.ResourceExhausted, times=1):
             outs = engine.run()
@@ -316,7 +317,8 @@ class TestServingChaos:
         assert outs[mate].n_gen == 6
         assert outs[queued].finish_reason == "length"  # drained after free
         assert engine.pool.used_pages == 0
-        assert engine.compile_counts()["decode"] == 1
+        counts = engine.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
 
     def test_exhaustion_during_prefill_allocate_rolls_back(self):
         """An allocation failure inside prefill fails only that request
@@ -348,13 +350,14 @@ class TestServingChaos:
         engine = ServingEngine(model, page_size=4, max_batch_slots=1)
         retries_before = _counter("paddle_tpu_faults_retries_total")
         rid = engine.add_request(np.arange(1, 5), max_new_tokens=3)
-        with faults.inject("serving.compile_decode",
+        with faults.inject("serving.compile_step",
                            raise_=RuntimeError("flaky XLA"), times=1) as sp:
             outs = engine.run()
         assert sp.fired == 1
         assert outs[rid].finish_reason == "length" and outs[rid].n_gen == 3
         assert _counter("paddle_tpu_faults_retries_total") > retries_before
-        assert engine.compile_counts()["decode"] == 1
+        counts = engine.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
 
     def test_deadline_expiry_queued_and_mid_decode(self):
         model = _tiny_llama()

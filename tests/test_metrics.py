@@ -464,7 +464,8 @@ class TestEngineInstrumentation:
         """The ISSUE acceptance run: a CPU-fallback serving workload must
         leave non-empty TTFT and inter-token-latency histograms, the
         lifecycle counters/gauges, and a compile-event count of exactly
-        one decode compile; the exposition output must parse."""
+        one unified-step compile per token-grid bucket; the exposition
+        output must parse."""
         reg = get_registry()
         reg.reset()
         engine = _tiny_engine()
@@ -505,21 +506,23 @@ class TestEngineInstrumentation:
         assert "paddle_tpu_serving_page_utilization" in snap
         assert reg.get("paddle_tpu_serving_kv_pages_used").value == 0
         assert reg.get("paddle_tpu_serving_kv_pages_total").value > 0
-        # THE invariant, now a metric: decode compiled exactly once
+        # THE invariant, now a metric: the unified step compiled
+        # exactly once per token-grid bucket seen
         compiles = {s["labels"]["fn"]: s["value"]
                     for s in snap["paddle_tpu_jit_compiles_total"]["series"]}
-        assert compiles["serving_decode"] == 1, compiles
-        assert compiles["serving_prefill"] >= 1
+        counts = engine.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
+        assert compiles["serving_step"] == counts["step"], compiles
         # exposition round-trips through the parser with live values
         fams = parse_prometheus(reg.expose_prometheus())
         ttft = fams["paddle_tpu_serving_ttft_seconds"]
         assert ttft["type"] == "histogram"
         assert ("paddle_tpu_serving_ttft_seconds_count", lbl, 2.0) \
             in ttft["samples"]
-        decode_c = [v for _, lab, v
-                    in fams["paddle_tpu_jit_compiles_total"]["samples"]
-                    if lab.get("fn") == "serving_decode"]
-        assert decode_c == [1.0]
+        step_c = [v for _, lab, v
+                  in fams["paddle_tpu_jit_compiles_total"]["samples"]
+                  if lab.get("fn") == "serving_step"]
+        assert step_c == [float(counts["step"])]
 
     def test_rejected_request_counts(self):
         reg = get_registry()

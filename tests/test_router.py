@@ -6,9 +6,10 @@ WAITING requests are requeued onto healthy siblings EXACTLY ONCE (no
 duplicates, no drops — a request that cannot move retires
 deterministically with ``finish_reason="unavailable"``); ``reload()``
 across live traffic completes every request, leaves every engine on the
-new checkpoint's weights, and never recompiles the decode step
-(``paddle_tpu_jit_compiles_total{fn="serving_decode"}`` pins at one per
-engine); multi-model tenancy routes by id with actionable unknown-id
+new checkpoint's weights, and never recompiles the unified serving
+step (``paddle_tpu_jit_compiles_total{fn="serving_step"}`` pins at the
+bucket-set size per engine); multi-model tenancy routes by id with
+actionable unknown-id
 errors; ``MetricsServer(health_cb=router.health)`` serves aggregate and
 ``?engine=<id>`` health. The operational twin is tools/chaos_serve.py
 scenarios 7-9.
@@ -598,8 +599,9 @@ class TestReload:
             for k, v in sd.items():
                 np.testing.assert_array_equal(np.asarray(got[k].numpy()),
                                               np.asarray(v.numpy()))
-            # in-place restore: decode program survived the weight push
-            assert eng.compile_counts()["decode"] == 1
+            # in-place restore: the compiled step survived the push
+            counts = eng.compile_counts()
+            assert counts["step"] == counts["step_buckets"]
         assert r.states() == {"m/0": "healthy", "m/1": "healthy"}
         assert all(h.weights_step == 7
                    for h in r._model_handles("m"))

@@ -76,6 +76,45 @@ class TestPagedAttentionKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_ragged_flattened_rows_match_fallback(self, monkeypatch):
+        """The unified-step contract (ISSUE 11): mixed per-slot query
+        lengths ride as FLATTENED rows — a decode slot contributes one
+        row, a chunk slot one row per token, each with its slot's block
+        table repeated and consecutive positions. The kernel serves the
+        ragged grid unchanged (interpret mode) and matches the jnp
+        fallback."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas import paged_attention as pa
+
+        rng = np.random.default_rng(1)
+        nh, nkv, hd, page, pages, width = 4, 2, 64, 8, 20, 4
+        # slot A: decode (q_len 1 at pos 12); slot B: a 5-token chunk at
+        # positions 7..11; slot C: decode at pos 0 (first decode step)
+        q_lens = [1, 5, 1]
+        starts = [12, 7, 0]
+        T = sum(q_lens)
+        slot_bt = rng.integers(1, pages, (3, width)).astype(np.int32)
+        row_bt = np.concatenate([
+            np.repeat(slot_bt[i:i + 1], q_lens[i], axis=0)
+            for i in range(3)])
+        row_lens = np.concatenate([
+            np.arange(starts[i], starts[i] + q_lens[i]) + 1
+            for i in range(3)]).astype(np.int32)
+        q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((pages, page, nkv, hd)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((pages, page, nkv, hd)),
+                         jnp.float32)
+        ref = pa.ref_paged_attention(q, kp, vp, jnp.asarray(row_bt),
+                                     jnp.asarray(row_lens))
+        out = pa.ragged_paged_attention(q, kp, vp, jnp.asarray(row_bt),
+                                        jnp.asarray(row_lens),
+                                        use_kernel=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
 
 # ───────────────────────────── kv-cache pool ─────────────────────────────
 
@@ -167,21 +206,71 @@ class TestPagedKVCachePool:
 
 
 class TestFCFSScheduler:
-    def test_fcfs_order_token_budget_and_head_of_line(self):
+    def test_admission_ignores_prompt_length_fcfs_within_tier(self):
+        """Chunked prefill (ISSUE 11): prompt LENGTH no longer gates
+        admission — everything that has a slot and worst-case pages
+        admits at once, FCFS within the default tier, and the prefill
+        work is sliced later by plan_chunks."""
         pool = PagedKVCachePool(1, 64, 4, 2, 8)
-        sched = FCFSScheduler(max_batch_slots=4, prefill_token_budget=8)
+        sched = FCFSScheduler(max_batch_slots=4, token_budget=8)
         reqs = [Request(prompt=np.arange(1, 6), max_new_tokens=2),
                 Request(prompt=np.arange(1, 5), max_new_tokens=2),
                 Request(prompt=np.arange(1, 3), max_new_tokens=2)]
         for r in reqs:
             sched.add(r)
         first = sched.admit(free_slots=4, pool=pool)
-        # budget 8: req0 (5 tok) fits; req1 (4 tok) would overflow -> waits
-        assert [r.req_id for r in first] == [reqs[0].req_id]
-        assert sched.queue_depth == 2
-        # next step: req1 (4) + req2 (2) fit the fresh budget together
-        assert [r.req_id for r in sched.admit(4, pool)] == [
-            reqs[1].req_id, reqs[2].req_id]
+        assert [r.req_id for r in first] == [r.req_id for r in reqs]
+        assert sched.queue_depth == 0
+
+    def test_priority_orders_admission_within_backpressure(self):
+        """SLO tiers: a lower-priority-number (more urgent) request
+        enqueues ahead of every waiting request of a higher number;
+        within a tier, arrival order holds."""
+        pool = PagedKVCachePool(1, 64, 4, 2, 8)
+        sched = FCFSScheduler(max_batch_slots=2, token_budget=64)
+        batch0 = Request(prompt=np.arange(1, 4), priority=1)
+        batch1 = Request(prompt=np.arange(1, 4), priority=1)
+        urgent = Request(prompt=np.arange(1, 4), priority=0)
+        for r in (batch0, batch1, urgent):
+            sched.add(r)
+        assert [r.req_id for r in sched.waiting] == [
+            urgent.req_id, batch0.req_id, batch1.req_id]
+        got = sched.admit(free_slots=2, pool=pool)
+        assert [r.req_id for r in got] == [urgent.req_id, batch0.req_id]
+
+    def test_plan_chunks_decode_first_and_slo_order(self):
+        """The per-step token budget: decode charged FIRST (decode-first
+        under load), prompt chunks fill the remainder in (priority,
+        earliest-deadline, arrival) order — one slot may take the whole
+        remainder, later ones wait for the next step."""
+        sched = FCFSScheduler(max_batch_slots=8, token_budget=16)
+        tier1 = Request(prompt=np.arange(1, 4), priority=1)
+        tier0 = Request(prompt=np.arange(1, 4), priority=0)
+        slo = Request(prompt=np.arange(1, 4), priority=1, deadline_s=60.0)
+        # 6 decode tokens leave 10 budget; slot "a" (tier 0) takes its 8
+        # remaining, slot "c" (tier 1 + deadline) beats slot "b" for the
+        # last 2, "b" gets nothing this step
+        plan = sched.plan_chunks(6, [("b", 9, tier1), ("a", 8, tier0),
+                                     ("c", 5, slo)])
+        assert plan == [("a", 8), ("c", 2)]
+        # no decode load: the full budget goes to the head prefill
+        plan = sched.plan_chunks(0, [("b", 40, tier1)])
+        assert plan == [("b", 16)]
+        # budget exhausted by decode: prefill waits (decode retirements
+        # free budget in a bounded number of steps — no starvation)
+        assert sched.plan_chunks(16, [("b", 9, tier1)]) == []
+
+    def test_step_charge_counts_prompt_chunks(self):
+        """pending_steps (the router's queue-side load signal) charges a
+        queued prompt its CHUNK count under the token budget, not a flat
+        1 — a 10k-token prompt is ~40 steps of work at budget 256 and
+        least-loaded dispatch must see them."""
+        sched = FCFSScheduler(max_batch_slots=2, token_budget=8)
+        sched.add(Request(prompt=np.arange(1, 33), max_new_tokens=2))
+        # 32 prompt tokens / budget 8 = 4 chunk steps + 2 decode steps
+        assert sched.pending_steps == 6
+        sched.add(Request(prompt=np.arange(1, 4), max_new_tokens=1))
+        assert sched.pending_steps == 6 + 1 + 1
 
     def test_no_overtaking_when_pool_full(self):
         pool = PagedKVCachePool(1, 3, 4, 2, 8)  # 2 usable pages
@@ -250,9 +339,11 @@ class TestEngineEquivalence:
         # everything retired -> every page back on the free list
         assert engine.pool.used_pages == 0
 
-    def test_decode_compiles_bounded_across_live_batch_churn(self):
-        """The compiled decode step is padded to fixed slots: admission,
-        retirement, and ragged lengths must never retrace it."""
+    def test_step_compiles_bounded_across_live_batch_churn(self):
+        """The unified step compiles one program per token-grid bucket
+        and NOTHING else: admission, retirement, ragged prompt lengths,
+        and every decode/chunk mix must never retrace a bucket (the
+        ISSUE 11 compile-surface pin — `step` == `step_buckets`)."""
         model = _llama()
         engine = ServingEngine(model, page_size=4, max_batch_slots=3)
         rng = np.random.RandomState(3)
@@ -261,9 +352,10 @@ class TestEngineEquivalence:
             engine.step()  # live batch size churns every step
         engine.run()
         counts = engine.compile_counts()
-        assert counts["decode"] == 1, counts
-        # prefill buckets are powers of two: lengths 3..7 -> ONE bucket (16)
-        assert counts["prefill"] == 1, counts
+        assert counts["step"] == counts["step_buckets"], counts
+        # buckets: the slot grid (3) for decode-only steps, 16 (the
+        # floor) for mixed steps carrying prompts of 3..7 tokens
+        assert counts["step_buckets"] <= 2, counts
 
     def test_page_reuse_staggered_high_water_mark(self):
         """Retired sequences' pages serve later requests: on a staggered
@@ -391,8 +483,9 @@ class TestDeterministicSampling:
             _PROMPTS[0],
             stream_cb=lambda r, tok, fin, seq: chunks.append((seq, tok)),
             **self._SPEC)
-        src.step()  # prefill (token 0) + one decode (token 1)
-        src.step()  # token 2
+        src.step()  # admit + final prompt chunk -> token 0
+        src.step()  # decode -> token 1
+        src.step()  # decode -> token 2
         journals = src.export_inflight()
         assert [j.req_id for j in journals] == [rid]
         assert journals[0].resume_tokens == ref[:3]
@@ -479,6 +572,159 @@ class TestDeterministicSampling:
         assert wait.count == before
 
 
+# ──────────── unified ragged step + chunked prefill (ISSUE 11) ────────────
+
+
+class TestUnifiedStep:
+    """The prefill/decode split is gone: one compiled ragged step serves
+    decode tokens and prompt chunks together under a shared token
+    budget. Properties: streams are token-identical to the pre-chunking
+    engine (= dense generate / any chunking) at temperature>0 — alone,
+    with batch-mates, and across chunk-size sweeps; decode is never
+    starved by concurrent prefill chunks; and the compile surface stays
+    pinned to the token-grid bucket set."""
+
+    _SPEC = dict(max_new_tokens=8, temperature=0.9, seed=29)
+
+    def test_streams_identical_across_chunk_size_sweep(self):
+        """THE chunking property: (prompt, seed, temperature) fully
+        determines the stream no matter how the prompt is sliced — a
+        1-token-budget engine (maximal chunking), a mid-size one, and an
+        unchunked one (budget >= prompt) emit bit-identical tokens, all
+        equal to the dense generate() oracle."""
+        model = _llama()
+        prompt = np.random.RandomState(41).randint(0, 128, (23,))
+        paddle.seed(0)
+        ref = None
+        for budget in (1, 5, 16, 1024):
+            eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                                token_budget=budget)
+            rid = eng.add_request(prompt, **self._SPEC)
+            got = list(eng.run()[rid].token_ids)
+            if ref is None:
+                ref = got
+                assert len(set(ref)) > 1  # sanity: actually sampling
+            assert got == ref, f"stream diverged at token_budget={budget}"
+        # greedy chunked == dense generate (the pre-chunking oracle)
+        dense = _dense_gen(model, prompt, 6)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            token_budget=7)
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(
+            np.asarray(eng.run()[rid].token_ids), dense)
+
+    def test_streams_identical_with_chunking_batch_mates(self):
+        """A decoding request's stream is untouched by a long prompt
+        chunk-prefilling beside it (and vice versa) — the ragged grid
+        carries both, sampling keys are per-slot."""
+        model = _llama()
+        rng = np.random.RandomState(43)
+        long_prompt = rng.randint(0, 128, (40,))
+        ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        r = ref_eng.add_request(_PROMPTS[0], **self._SPEC)
+        ref = list(ref_eng.run()[r].token_ids)
+        long_ref_eng = ServingEngine(model, page_size=4,
+                                     max_batch_slots=2)
+        r = long_ref_eng.add_request(long_prompt, **self._SPEC)
+        long_ref = list(long_ref_eng.run()[r].token_ids)
+
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            token_budget=8)
+        dec = eng.add_request(_PROMPTS[0], **self._SPEC)
+        eng.step()  # decoding before the long prompt arrives
+        lng = eng.add_request(long_prompt, **self._SPEC)
+        outs = eng.run()
+        assert list(outs[dec].token_ids) == ref
+        assert list(outs[lng].token_ids) == long_ref
+
+    def test_decode_not_starved_by_concurrent_prefill(self):
+        """Decode-first under load: while a 40-token prompt trickles in
+        at token_budget=8, every already-decoding tenant still lands
+        EXACTLY one token per engine step — chunks only ever take the
+        budget decode left over."""
+        model = _llama()
+        rng = np.random.RandomState(47)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3,
+                            token_budget=8)
+        d0 = eng.add_request(_PROMPTS[0], max_new_tokens=20)
+        d1 = eng.add_request(_PROMPTS[1], max_new_tokens=20)
+        eng.step()  # both sampled their first token
+        lng = eng.add_request(rng.randint(0, 128, (40,)),
+                              max_new_tokens=2)
+        gens = {d0: 1, d1: 1}
+        for _ in range(5):  # the long prompt needs ceil(40/6)=7 chunks
+            before = {rid: self._gen_len(eng, rid) for rid in gens}
+            eng.step()
+            for rid in gens:
+                assert self._gen_len(eng, rid) == before[rid] + 1, (
+                    "a decoding tenant was starved by a prefill chunk")
+            assert self._gen_len(eng, lng) == 0  # still mid-prompt
+        outs = eng.run()
+        assert all(outs[r].finish_reason == "length" for r in outs)
+
+    @staticmethod
+    def _gen_len(eng, rid):
+        for st in eng.slots:
+            if st is not None and st.req.req_id == rid:
+                return len(st.gen)
+        return -1  # retired
+
+    def test_compile_surface_pinned_to_bucket_set(self):
+        """`paddle_tpu_jit_compiles_total{fn="serving_step"}` == the
+        bucket-set size across an adversarial workload sweep (ragged
+        prompts, churn, chunking, prefix hits): the ISSUE 11 metric
+        contract, monitorable in production."""
+        from paddle_tpu import metrics
+
+        def compiles():
+            fam = metrics.get_registry().get(
+                "paddle_tpu_jit_compiles_total")
+            return 0.0 if fam is None else fam.labels(
+                fn="serving_step").value
+
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            token_budget=8)
+        before = compiles()
+        rng = np.random.RandomState(53)
+        for n, new in ((3, 2), (30, 3), (7, 6), (41, 2), (30, 1)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new)
+            eng.step()
+        eng.run()
+        counts = eng.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
+        assert compiles() - before == counts["step"]
+        # re-running the same mix compiles NOTHING new
+        for n, new in ((30, 3), (3, 2)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new)
+        eng.run()
+        assert compiles() - before == counts["step"]
+        assert eng.compile_counts() == counts
+
+    def test_priority_tier_preempts_chunk_budget(self):
+        """SLO tiers at the chunk level: with two prompts mid-prefill,
+        the tier-0 one takes the whole step budget and reaches its
+        first token first even though the tier-1 prompt was admitted
+        earlier."""
+        model = _llama()
+        rng = np.random.RandomState(59)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            token_budget=8)
+        batch = eng.add_request(rng.randint(0, 128, (32,)),
+                                max_new_tokens=2, priority=1)
+        urgent = eng.add_request(rng.randint(0, 128, (32,)),
+                                 max_new_tokens=2, priority=0)
+        first = None
+        for _ in range(12):
+            eng.step()
+            for rid in (urgent, batch):
+                if first is None and self._gen_len(eng, rid) > 0:
+                    first = rid
+        assert first == urgent
+        outs = eng.run()
+        assert all(o.finish_reason == "length" for o in outs.values())
+
+
 # ──────────────── prefix caching (ISSUE 8 tentpole) ────────────────
 
 
@@ -544,7 +790,8 @@ class TestPrefixCache:
         assert self._counter(
             "paddle_tpu_serving_prefill_tokens_saved_total",
             eng) == s0 + 28 + 24
-        assert eng.compile_counts()["decode"] == 1
+        counts = eng.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
         assert eng.pool.used_pages == 0  # cache pages are not "used"
         assert len(eng.prefix_cache) > 0
 
@@ -651,28 +898,32 @@ class TestPrefixCache:
         assert self._counter(
             "paddle_tpu_serving_prefix_misses_total", eng) == m0
 
-    def test_scheduler_budget_charges_only_uncovered_suffix(self):
-        """prefill_tokens honesty: a warm prompt charges the per-step
-        prefill budget only its uncovered suffix, so it continuous-
-        batches alongside work a cold charge would have deferred."""
+    def test_chunk_budget_charges_only_uncovered_suffix(self):
+        """Budget honesty under chunked prefill: admission adopts the
+        cached prefix pages and sets the chunk cursor AFTER them, so a
+        warm prompt's first token lands in ONE budget-bounded step while
+        the identical cold prompt needs several chunk steps — the
+        prefix-cache win measured in steps-to-first-token."""
         model = _llama()
 
-        def drive(warm):
+        def steps_to_first_token(warm):
             eng = ServingEngine(model, page_size=4, max_batch_slots=2,
-                                prefill_token_budget=10)
+                                token_budget=10)
             if warm:
                 eng.add_request(self._PREFIX, max_new_tokens=1)
-                eng.run()  # cache the 24-token prefix (5 full pages used)
-            eng.add_request(np.arange(1, 6), max_new_tokens=4)  # cost 5
-            eng.add_request(self._PREFIX, max_new_tokens=4)
-            eng.step()
-            return eng.stats["running_seqs"]
+                eng.run()  # cache the 24-token prefix (5 full pages)
+            rid = eng.add_request(self._PREFIX, max_new_tokens=4)
+            for n in range(1, 10):
+                eng.step()
+                if any(st is not None and st.req.req_id == rid
+                       and st.gen for st in eng.slots):
+                    return n
+            raise AssertionError("no first token within 9 steps")
 
-        # cold: 5 + 24 blows the 10-token budget -> the 24-token prompt
-        # waits a step; warm: 5 + (24 - 20 matched) = 9 fits -> admitted
-        # together
-        assert drive(warm=False) == 1
-        assert drive(warm=True) == 2
+        # cold: 24 tokens / budget 10 = 3 chunk steps to the sample;
+        # warm: 20 matched (5 full pages), 4-token suffix = ONE step
+        assert steps_to_first_token(warm=False) == 3
+        assert steps_to_first_token(warm=True) == 1
 
     def test_migration_reprefill_rides_the_cache(self):
         """A journaled request adopted by an engine whose cache holds the
@@ -688,8 +939,8 @@ class TestPrefixCache:
 
         src = ServingEngine(model, page_size=4, max_batch_slots=2)
         rid = src.add_request(prompt, **spec)
-        src.step()
-        src.step()  # 3 tokens generated
+        for _ in range(3):
+            src.step()  # chunk (token 0) + two decodes -> 3 tokens
         [journal] = src.export_inflight()
         assert journal.resume_tokens == ref[:3]
 
@@ -827,5 +1078,6 @@ class TestBatchSweeps:
         for rid, want in zip(rids, dense):
             np.testing.assert_array_equal(
                 np.asarray(outs[rid].token_ids), want)
-        assert engine.compile_counts()["decode"] == 1
+        counts = engine.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
         assert engine.pool.used_pages == 0

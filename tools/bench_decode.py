@@ -18,7 +18,17 @@ engine tokens/s vs this dense loop at batch {1, 8, 32}, one JSON row per
 (default 1024) common prefix with unique 16-token suffixes. Reports
 ``prefill_tokens_saved_total`` (expect ~(N-1) x prefix), cold-vs-warm
 prefill wall time, TTFT p50/p95, a bit-identity check of a warm stream
-against a cache-off cold run, and the decode compile count (must stay 1).
+against a cache-off cold run, and the unified-step compile count (one
+per token-grid bucket).
+
+``--mixed``: long-prompt-admission scenario (ISSUE 11) — N decoding
+tenants (BENCH_MIXED_TENANTS, default 3) while one
+BENCH_MIXED_PROMPT-token (default 10000) prompt admits and
+chunk-prefills under BENCH_MIXED_BUDGET tokens/step through the unified
+ragged step with a pinned grid. Reports tenants' p50/p95/p99 ITL before
+vs during admission (asserts p95 within 15%), the long prompt's TTFT, a
+zero-recompile assert over the admission, and a bit-identity check of
+every stream against admission-free runs — BENCH_MIXED row.
 """
 import json
 import os
@@ -159,7 +169,7 @@ def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
     prompts = [rng.integers(0, vocab, (prompt,)) for _ in range(B)]
     engine = ServingEngine(
         model, page_size=16, max_batch_slots=B,
-        prefill_token_budget=max(B * prompt, 1024))
+        token_budget=max(B * prompt, 1024))
 
     def run_once():
         for p in prompts:
@@ -184,7 +194,7 @@ def _bench_paged_one(model_name, rt, B, prompt, new, dev, small):
         "config": label + "-paged" + _geometry(B, prompt, new),
         "total_s": round(best, 3), "compile_s": round(compile_s, 1),
         "per_token_ms": round(1e3 * best / new, 2),
-        "decode_compiles": engine.compile_counts()["decode"],
+        "step_compiles": engine.compile_counts()["step"],
         "peak_pages": engine.pool.peak_used,
         "device": str(dev.platform),
     }
@@ -233,7 +243,7 @@ def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
 
     # bit-identity oracle: one prompt end-to-end on a CACHE-OFF engine
     off = ServingEngine(model, page_size=16, max_batch_slots=2,
-                        prefill_token_budget=prefix_len + suffix,
+                        token_budget=prefix_len + suffix,
                         prefix_cache=False)
     ref_id = off.add_request(prompts[1], max_new_tokens=new,
                              temperature=0.8, seed=11)
@@ -241,7 +251,7 @@ def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
 
     engine = ServingEngine(model, page_size=16,
                            max_batch_slots=min(n_req, 8),
-                           prefill_token_budget=prefix_len + suffix)
+                           token_budget=prefix_len + suffix)
     # compile pass: one cold + one warm request builds the full-prefill
     # AND suffix-prefill programs plus the single decode program, so the
     # measured section below times serving, not XLA
@@ -272,7 +282,7 @@ def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
     # compile counter so extra_jit_compiles counts only warm-sweep builds
     metrics.get_registry().reset()
     jit0 = _counter_value("paddle_tpu_jit_compiles_total",
-                          fn="serving_decode")
+                          fn="serving_step")
     s0 = saved()
     warm_tokens = {}
     t0 = time.perf_counter()
@@ -300,9 +310,9 @@ def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
         "warm_total_s": round(warm_s, 3),
         "warm_per_req_s": round(warm_s / max(n_req, 1), 4),
         "warm_equals_cold": bool(warm_equals_cold),
-        "decode_compiles": engine.compile_counts()["decode"],
+        "step_compiles": engine.compile_counts()["step"],
         "extra_jit_compiles": _counter_value(
-            "paddle_tpu_jit_compiles_total", fn="serving_decode") - jit0,
+            "paddle_tpu_jit_compiles_total", fn="serving_step") - jit0,
         "ttft_ms": ttft,
         "device": str(dev.platform),
     }
@@ -311,7 +321,177 @@ def _bench_shared_prefix(model_name, rt, prefix_len, new, dev, small):
         raise AssertionError(
             "warm-cache stream diverged from the cache-off cold run")
     if rec["extra_jit_compiles"]:
-        raise AssertionError("decode recompiled during the warm sweep")
+        raise AssertionError("step recompiled during the warm sweep")
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _bench_mixed(model_name, rt, dev, small):
+    """Long-prompt-admission scenario (ISSUE 11): N decoding tenants +
+    one 10k-token prompt through the unified ragged step. The engine is
+    pinned to ONE step shape (``min_step_tokens=token_budget``), so a
+    prompt chunk rides grid rows a decode-only step already pays for —
+    the measured claim is that the decoding tenants' p95/p99 ITL stays
+    flat (within 15%) while the long prompt admits and chunk-prefills,
+    with ZERO recompiles during admission and every stream bit-identical
+    to an admission-free run (the determinism contract: chunking and
+    batch composition never change a token)."""
+    import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    prompt_len = int(os.environ.get("BENCH_MIXED_PROMPT", "10000"))
+    tenants = int(os.environ.get("BENCH_MIXED_TENANTS", "3"))
+    budget = int(os.environ.get("BENCH_MIXED_BUDGET",
+                                "64" if small else "256"))
+    new = int(os.environ.get("BENCH_MIXED_NEW", "64"))
+    long_new = 4
+    metric = f"{model_name}_mixed_admission_itl_p95_ratio"
+    cfg_tag = (f"-mixed-t{tenants}-p{prompt_len}-budget{budget}-n{new}"
+               f"-sampled")
+    if not small:
+        from _bench_timing import iter_notes_rows
+        if any(rec.get("metric") == metric
+               and rec.get("device") in ("tpu", "axon")
+               and str(rec.get("config", "")).endswith(cfg_tag)
+               for rec in iter_notes_rows(_NOTES)):
+            print(f"mixed[{model_name}]: {cfg_tag} already banked this "
+                  "round — skipping", file=sys.stderr)
+            return
+    if small:
+        # CPU smoke: a 1-layer trunk keeps the 10k-token page-gather
+        # tractable while exercising the full scheduler/step machinery
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=prompt_len + new + 8)
+        paddle.seed(0)
+        model, vocab, label = LlamaForCausalLM(cfg), 128, "llama-smoke"
+    else:
+        model, vocab, label = _build(model_name, prompt_len, new, small)
+    model.eval()
+    rng = np.random.default_rng(0)
+    tenant_prompts = [rng.integers(0, vocab, (16,)) for _ in range(tenants)]
+    long_prompt = rng.integers(0, vocab, (prompt_len,))
+    spec = dict(max_new_tokens=new, temperature=0.9)
+
+    def build_engine():
+        # min_step_tokens == token_budget pins the compiled grid: every
+        # step (decode-only or mixed) is ONE shape, so ITL flatness is
+        # the design's to lose, not the bucket set's
+        return ServingEngine(model, page_size=64,
+                             max_batch_slots=tenants + 1,
+                             max_model_len=prompt_len + new + 8,
+                             token_budget=budget,
+                             min_step_tokens=budget)
+
+    def drive(eng, admit_long):
+        """Run N tenants; optionally admit the long prompt after two
+        steps. Returns (per-tenant token (timestamp, id) lists, long
+        prompt (ttft_s, token_ids))."""
+        stamps = {i: [] for i in range(tenants)}
+
+        def cb(i):
+            return (lambda r, tok, fin, seq:
+                    stamps[i].append((time.perf_counter(), tok))
+                    if tok is not None else None)
+
+        for i, p in enumerate(tenant_prompts):
+            eng.add_request(p, stream_cb=cb(i), seed=100 + i, **spec)
+        eng.step()
+        eng.step()
+        long_info = {}
+        if admit_long:
+            # the zero-recompile window is THE ADMISSION: the engine
+            # compiled its one pinned grid bucket while the tenants
+            # started decoding above; from here to drain, the long
+            # prompt's chunks must add nothing
+            jit0 = _counter_value("paddle_tpu_jit_compiles_total",
+                                  fn="serving_step")
+            t0 = time.perf_counter()
+            long_first = []
+
+            def long_cb(r, tok, fin, seq):
+                if tok is not None and not long_first:
+                    long_first.append(time.perf_counter() - t0)
+
+            rid = eng.add_request(long_prompt, max_new_tokens=long_new,
+                                  temperature=0.9, seed=7,
+                                  stream_cb=long_cb)
+            outs = eng.run()
+            long_info = {"ttft_s": long_first[0],
+                         "tokens": list(outs[rid].token_ids),
+                         "extra_compiles": _counter_value(
+                             "paddle_tpu_jit_compiles_total",
+                             fn="serving_step") - jit0}
+        else:
+            eng.run()
+        return stamps, long_info
+
+    def itl_ms(stamps):
+        gaps = sorted(g for s in stamps.values()
+                      for g in np.diff([t for t, _ in s]))
+        if not gaps:
+            return {}
+        q = lambda f: round(1e3 * gaps[min(int(f * len(gaps)),
+                                           len(gaps) - 1)], 3)
+        return {"p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
+
+    # no separate compile pass: the compiled program cache is
+    # per-engine, so each phase's engine warms its one pinned grid
+    # bucket during its own first tenant steps — BEFORE any measured
+    # quantity (ITL gaps are between tokens, which all land after the
+    # first step's compile; the long prompt's TTFT clock starts at its
+    # enqueue, two steps after the grid compiled)
+
+    # phase A — no-admission baseline
+    base_stamps, _ = drive(build_engine(), admit_long=False)
+    base = itl_ms(base_stamps)
+    # long-prompt oracle: the same config, ALONE — batch composition
+    # must not change a single token of anyone's stream
+    _, long_alone = drive(build_engine(), admit_long=True)
+
+    # phase B — the measured admission run, zero-recompile asserted
+    eng = build_engine()
+    mixed_stamps, long_info = drive(eng, admit_long=True)
+    extra_compiles = long_info["extra_compiles"]
+    during = itl_ms(mixed_stamps)
+
+    streams_identical = (
+        long_info["tokens"] == long_alone["tokens"]
+        and all([t for _, t in mixed_stamps[i]]
+                == [t for _, t in base_stamps[i]]
+                for i in range(tenants)))
+    ratio = (during["p95"] / base["p95"]) if base.get("p95") else 0.0
+    rec = {
+        "metric": metric,
+        "value": round(ratio, 3), "unit": "ratio", "vs_baseline": 1.0,
+        "config": label + cfg_tag,
+        "tenants": tenants, "long_prompt_tokens": prompt_len,
+        "token_budget": budget,
+        "itl_before_ms": base, "itl_during_ms": during,
+        "ttft_long_ms": round(1e3 * long_info["ttft_s"], 1),
+        "extra_jit_compiles": extra_compiles,
+        "streams_identical": bool(streams_identical),
+        "step_compiles": eng.compile_counts()["step"],
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if extra_compiles:
+        raise AssertionError(
+            "the unified step recompiled during long-prompt admission")
+    if not streams_identical:
+        raise AssertionError(
+            "a stream diverged under admission — chunking/batch "
+            "composition leaked into sampling")
+    if ratio > 1.15:
+        raise AssertionError(
+            f"decoding tenants' p95 ITL degraded {ratio:.2f}x during "
+            f"admission (budget {budget}) — exceeds the 15% bound")
     if small:
         return  # CPU smoke: never pollute the round's evidence file
     rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
@@ -358,6 +538,21 @@ def main():
         sys.exit(2)
     rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
     failures = 0
+    if "--mixed" in sys.argv:
+        # long-prompt-admission scenario (ISSUE 11): N decoding tenants
+        # + one BENCH_MIXED_PROMPT-token prompt; reports p95/p99 ITL
+        # before/during admission, the long prompt's TTFT, a
+        # zero-recompile assert, and a stream bit-identity check —
+        # BENCH_MIXED row
+        for name in models:
+            try:
+                _bench_mixed(name, rt, dev, small)
+            except Exception as e:
+                failures += 1
+                print(f"mixed[{name}]: {type(e).__name__}: "
+                      f"{str(e)[:160]}", file=sys.stderr)
+        if "--paged" not in sys.argv and "--shared-prefix" not in sys.argv:
+            sys.exit(1 if failures else 0)
     if "--shared-prefix" in sys.argv:
         # prefix-cache scenario (rides --paged's engine machinery): N
         # requests x one shared prefix; geometry via BENCH_SHARED_N /

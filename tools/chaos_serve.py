@@ -20,9 +20,14 @@ journal — final streams bit-identical to an uninterrupted run, zero
 duplicated or missing stream chunks. Scenario 11 re-runs the kill drill
 under PREFIX-HEAVY traffic: migrated requests must re-prefill through the
 adoptive sibling's radix prefix cache (``prefill_tokens_saved_total``
-rises there), still bit-identical and exactly-once. Each scenario asserts
-both the behavior AND the telemetry (every failure path must move its
-counter). Exit code 0 iff every scenario passes.
+rises there), still bit-identical and exactly-once. Scenario 12 kills
+the busiest engine BETWEEN PROMPT CHUNKS of a long request (ISSUE 11):
+chunked-prefill progress is only a cache length, so the mid-prefill
+request migrates with an empty journal, resumes from its chunk boundary
+through the sibling's prefix cache, and streams bit-identically from
+seq 0 — chunks exactly-once. Each scenario asserts both the behavior
+AND the telemetry (every failure path must move its counter). Exit
+code 0 iff every scenario passes.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/chaos_serve.py
 CI:  the whole ladder also runs as tests/test_chaos_serve.py (slow lane).
@@ -98,7 +103,8 @@ def scenario_nan_quarantine(model):
     _check(eng.pool.used_pages == 0, "pages leaked")
     _check(_counter("paddle_tpu_serving_nan_quarantines_total")
            == before + 1, "quarantine counter")
-    _check(eng.compile_counts()["decode"] == 1, "decode recompiled")
+    counts = eng.compile_counts()
+    _check(counts["step"] == counts["step_buckets"], "step recompiled")
     return (f"victim n_gen={outs[victim].n_gen} reason=nan; mate "
             f"token-identical ({outs[mate].n_gen} tokens)")
 
@@ -107,8 +113,10 @@ def scenario_pool_exhaustion(model):
     """One injected allocation failure mid-decode: victim errors out,
     everything else (including queued work) drains."""
     eng = ServingEngine(model, page_size=4, max_batch_slots=2)
-    victim = eng.add_request(P3, max_new_tokens=6)
-    mate = eng.add_request(P4, max_new_tokens=6)
+    # the 4-token prompt exactly fills its prefill page, so ITS first
+    # decode append draws the armed page — it is the victim
+    victim = eng.add_request(P4, max_new_tokens=6)
+    mate = eng.add_request(P3, max_new_tokens=6)
     queued = eng.add_request(P3, max_new_tokens=4)
     eng.step()
     with faults.inject("serving.kv_alloc",
@@ -122,19 +130,21 @@ def scenario_pool_exhaustion(model):
 
 
 def scenario_compile_retry(model):
-    """A transient decode-build failure is retried; still one compile."""
+    """A transient step-build failure is retried; buckets still compile
+    exactly once each."""
     eng = ServingEngine(model, page_size=4, max_batch_slots=1)
     rid = eng.add_request(P4, max_new_tokens=3)
     before = _counter("paddle_tpu_faults_retries_total")
-    with faults.inject("serving.compile_decode",
+    with faults.inject("serving.compile_step",
                        raise_=RuntimeError("flaky build"), times=1,
                        seed=SEED):
         outs = eng.run()
     _check(outs[rid].finish_reason == "length", "request failed")
     _check(_counter("paddle_tpu_faults_retries_total") > before,
            "no retry recorded")
-    _check(eng.compile_counts()["decode"] == 1, "decode recompiled")
-    return "1 injected build failure, 1 retry, decode compiled once"
+    counts = eng.compile_counts()
+    _check(counts["step"] == counts["step_buckets"], "step recompiled")
+    return "1 injected build failure, 1 retry, step compiled once/bucket"
 
 
 def scenario_deadline_and_cancel(model):
@@ -275,7 +285,7 @@ def scenario_router_reload(model):
         live = [r.submit(p, model="m", max_new_tokens=6)
                 for p in (P5, P9, P3, P4)]
         jit0 = _counter("paddle_tpu_jit_compiles_total",
-                        fn="serving_decode")
+                        fn="serving_step")
         ok0 = _counter("paddle_tpu_router_reloads_total", result="ok")
         summary = r.reload(tmp)
         outs = r.run()
@@ -286,23 +296,26 @@ def scenario_router_reload(model):
         _check(all(outs[k].finish_reason == "length" for k in live),
                "a live request did not complete normally")
         k0 = next(iter(sd))
+        fleet_compiles = 0
         for eng in r.engines("m"):
             _check(np.allclose(np.asarray(eng.model.state_dict()[k0]
                                           .numpy()),
                                np.asarray(sd[k0].numpy())),
                    f"engine {eng.engine_id} not on the new weights")
-            _check(eng.compile_counts()["decode"] == 1,
-                   "decode recompiled across the weight push")
+            counts = eng.compile_counts()
+            _check(counts["step"] == counts["step_buckets"],
+                   "step recompiled across the weight push")
+            fleet_compiles += counts["step"]
         _check(_counter("paddle_tpu_jit_compiles_total",
-                        fn="serving_decode") == jit0 + 2,
-               "decode compiles != one per engine")
+                        fn="serving_step") == jit0 + fleet_compiles,
+               "step compiles != one per bucket per engine")
         _check(_counter("paddle_tpu_router_reloads_total", result="ok")
                == ok0 + 2, "reload counter")
         _check(all(h.weights_step == 1 for h in r._model_handles("m")),
                "weights_step not recorded")
         return ("4 live requests completed across a 2-engine rolling "
-                "push; weights=ckpt step 1 everywhere; decode still "
-                "1 compile/engine")
+                "push; weights=ckpt step 1 everywhere; step still "
+                "1 compile/bucket/engine")
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -509,6 +522,94 @@ def scenario_prefix_cache_failover(model):
             "bit-identical, chunks exactly-once")
 
 
+def scenario_kill_engine_mid_chunked_prefill(model):
+    """Scenario 12 (ISSUE 11): the busiest engine is killed BETWEEN
+    prompt chunks of a long request. Chunked-prefill progress is only a
+    cache length, so the migrated request carries an EMPTY journal (no
+    token had sampled yet), resumes on the sibling from its journaled
+    chunk boundary — which the sibling's radix prefix cache re-covers
+    (`prefill_tokens_saved_total` rises there) — and streams
+    bit-identically from seq 0 with zero duplicated or missing chunks.
+    A decoding tenant migrates alongside it, its stream also
+    exactly-once across the hop."""
+    rng = np.random.RandomState(23)
+    prefix = rng.randint(0, 128, (24,))
+    long_prompt = np.concatenate([prefix, rng.randint(0, 128, (20,))])
+    specs = [(P5, 10, 0.9, 41), (long_prompt, 6, 0.8, 42)]
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            prefix_cache=False)
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=sd) for p, n, t, sd in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(any(len(set(toks)) > 1 for toks in refs),
+           "reference run is not actually sampling")
+
+    r = Router()
+    # token_budget 8: the long prompt's 20 uncovered tokens need 3+
+    # chunk steps, so there IS a chunk boundary to die between
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2,
+                token_budget=8)
+    for eid in ("m/0", "m/1"):  # shared prefix warm on BOTH caches
+        e = r.engine(eid)
+        e.add_request(np.concatenate([prefix, np.asarray([1])]),
+                      max_new_tokens=1)
+        e.run()
+    e0, e1 = r.engine("m/0"), r.engine("m/1")
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tok, fin, seq: chunks[i].append((seq, tok))
+
+    dec = e0.add_request(P5, max_new_tokens=10, temperature=0.9, seed=41,
+                         stream_cb=cb(0))
+    r.step()
+    r.step()  # the tenant is decoding
+    lng = e0.add_request(long_prompt, max_new_tokens=6, temperature=0.8,
+                         seed=42, stream_cb=cb(1))
+    r.step()  # admit the long prompt + its first chunk
+    st = next(s for s in e0.slots if s is not None
+              and s.req.req_id == lng)
+    _check(st.prefilling and st.pos > 24 and not st.gen,
+           f"expected the long request mid-chunked-prefill at the kill "
+           f"(pos={st.pos}, gen={st.gen})")
+    boundary = st.pos
+    saved1_0 = _counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                        engine_id="m/1", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    with faults.inject("router.engine_step",
+                       raise_=RuntimeError("engine killed between chunks"),
+                       times=1, seed=SEED):
+        r.step()  # the scheduled kill — between prompt chunks
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the decode tenant + the mid-prefill one")
+    saved1 = _counter("paddle_tpu_serving_prefill_tokens_saved_total",
+                      engine_id="m/1", model_id="m")
+    _check(saved1 >= saved1_0 + 24,
+           f"adoptive engine saved only {saved1 - saved1_0} prefill "
+           f"tokens — resume did not ride the sibling's prefix cache")
+    for i, (rid, ref) in enumerate(zip((dec, lng), refs)):
+        _check(outs[rid].finish_reason == "length",
+               f"request {i} did not complete ({outs[rid].finish_reason})")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the uninterrupted run")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([sq for sq, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    _check(e1.pool.used_pages == 0, "pages leaked on the adoptive engine")
+    return (f"m/0 killed at chunk boundary pos={boundary} (prompt "
+            f"{long_prompt.size}): mid-prefill request resumed via m/1's "
+            f"cache ({int(saved1 - saved1_0)} tokens saved), both streams "
+            "bit-identical, chunks exactly-once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -521,6 +622,8 @@ SCENARIOS = [
     ("router-least-loaded-dispatch", scenario_router_least_loaded),
     ("kill-engine-mid-decode", scenario_kill_engine_mid_decode),
     ("prefix-cache-failover-migration", scenario_prefix_cache_failover),
+    ("kill-engine-mid-chunked-prefill",
+     scenario_kill_engine_mid_chunked_prefill),
 ]
 
 
