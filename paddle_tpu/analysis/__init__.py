@@ -15,9 +15,18 @@ meaningful, on every file, at lint time:
 - **TPL005** no unseeded randomness under serving/faults/checkpoint —
   the (prompt, seed) determinism contract.
 - **TPL006** declared shared containers mutate only under their lock.
+- **TPL007** the declared-lock acquisition graph is acyclic: a cycle
+  is a deadlock hazard, reported with every edge's witness path.
+- **TPL008** no check-then-act across a lock release: a guarded read
+  must not feed a guarded write in a different ``with`` of the same
+  lock (``# tpulint: atomic-ok`` opts out).
+- **TPL009** no blocking/unbounded work (file I/O, restore, compile
+  builds, sleeps, socket ops, thread joins, engine ``step``) reached
+  while a declared lock is held.
 
 CLI: ``python tools/tpulint.py paddle_tpu tools examples`` (add
-``--json`` for CI-diffable output). Suppress one site with
+``--json`` for CI-diffable output, ``--lock-graph`` for the DOT
+acquisition graph). Suppress one site with
 ``# tpulint: disable=TPL00N``; accept a pre-existing finding in
 ``tools/tpulint_baseline.json``. Full catalog: docs/ANALYSIS.md.
 
@@ -30,12 +39,14 @@ from .catalog import (parse_fault_doc, parse_metric_doc,
 from .core import (Finding, LintConfig, LintResult, ModuleInfo, Project,
                    iter_py_files, lint_paths, load_baseline, parse_module,
                    split_baseline, to_json, to_text, write_baseline)
-from .rules import FILE_RULES, PROJECT_RULES, RULE_IDS
+from .locks import LockWorld, lock_graph_dot, module_lock_decls
+from .rules import FILE_RULES, PROJECT_RULES, RULE_IDS, lock_graph_for
 
 __all__ = [
-    "FILE_RULES", "Finding", "LintConfig", "LintResult", "ModuleInfo",
-    "PROJECT_RULES", "Project", "RULE_IDS", "iter_py_files", "lint_paths",
-    "load_baseline", "parse_fault_doc", "parse_metric_doc", "parse_module",
-    "sanitize_metric_name", "split_baseline", "to_json", "to_text",
-    "write_baseline",
+    "FILE_RULES", "Finding", "LintConfig", "LintResult", "LockWorld",
+    "ModuleInfo", "PROJECT_RULES", "Project", "RULE_IDS", "iter_py_files",
+    "lint_paths", "load_baseline", "lock_graph_dot", "lock_graph_for",
+    "module_lock_decls", "parse_fault_doc", "parse_metric_doc",
+    "parse_module", "sanitize_metric_name", "split_baseline", "to_json",
+    "to_text", "write_baseline",
 ]

@@ -148,6 +148,10 @@ class LintResult:
     suppressed: int = 0
     files: int = 0
     baselined: int = 0
+    # the parsed project, for post-lint consumers (the CLI's
+    # --lock-graph reuses the modules + the cached LockWorld instead of
+    # re-parsing the repo)
+    project: Optional[Project] = None
 
 
 def _collect_suppressions(source: str) -> Dict[int, Set[str]]:
@@ -260,7 +264,8 @@ def lint_paths(paths: Sequence[str], config: LintConfig) -> LintResult:
         else:
             kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
-    return LintResult(findings=kept, suppressed=suppressed, files=len(files))
+    return LintResult(findings=kept, suppressed=suppressed,
+                      files=len(files), project=project)
 
 
 # ------------------------------------------------------------------ baseline
@@ -332,8 +337,11 @@ def to_text(result: LintResult, new: Sequence[Finding]) -> str:
     return "\n".join(lines)
 
 
-def to_json(result: LintResult, new: Sequence[Finding]) -> str:
-    """Stable (sorted, timestamp-free) JSON for diffing in CI logs."""
+def to_json(result: LintResult, new: Sequence[Finding],
+            lock_graph: Optional[dict] = None) -> str:
+    """Stable (sorted, timestamp-free) JSON for diffing in CI logs.
+    ``lock_graph`` (the TPL007 acquisition graph) rides along when the
+    caller passes it — the CLI always does."""
     payload = {
         "version": 1,
         "files": result.files,
@@ -344,4 +352,6 @@ def to_json(result: LintResult, new: Sequence[Finding]) -> str:
              "col": f.col, "message": f.message}
             for f in new],
     }
+    if lock_graph is not None:
+        payload["lock_graph"] = lock_graph
     return json.dumps(payload, indent=2, sort_keys=True)

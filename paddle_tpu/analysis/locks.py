@@ -1,0 +1,546 @@
+"""Concurrency analysis: the static lock-acquisition graph.
+
+The shared machinery behind TPL007/TPL008/TPL009 (docs/ANALYSIS.md):
+
+- **Declared locks.** A lock enters the analysis either through a
+  ``# tpulint: lock=<name>`` annotation on its creation line (the
+  canonical way — the name becomes the graph node, e.g. ``router`` or
+  ``metrics.registry``) or as the guard expression of a TPL006 row /
+  ``# tpulint: guard=`` annotation (fallback-named ``<stem>:<expr>``).
+  Only *declared* locks are tracked: an undeclared ``threading.Lock``
+  is invisible, so the rules err toward silence, never toward noise.
+
+- **Acquisition graph.** For every function we track which declared
+  locks are held (lexical ``with <lock>:`` nesting, TPL006-style) at
+  each further acquisition and at each call site. Call edges within
+  the linted code are followed interprocedurally — ``self.m()`` and
+  bare ``f()`` resolve within the module, ``x.m()`` resolves by method
+  name across the project (generic container/str tails excluded) — so
+  "holding `router`, a call chain reaches ``with metrics.family:``"
+  becomes the edge ``router → metrics.family`` with a witness site.
+
+- **Cycles** (TPL007) are deadlock hazards: two threads entering the
+  cycle from different nodes can block each other forever. Each cycle
+  is reported once, with the witness path of EVERY edge on it.
+
+- **Blocking reach** (TPL009): calls that can block or take unbounded
+  time (file I/O, checkpoint restore, compile builds, ``time.sleep``,
+  socket ops, ``Thread.join``, engine ``step``) reached — directly or
+  through calls — while a declared lock is held.
+
+Everything here is syntactic (AST + the lexical with-stack, no import
+resolution, no type inference), same contract as the rest of tpulint.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleInfo, Project
+from .scopes import dotted_name
+
+__all__ = [
+    "LockDecl", "LockWorld", "lock_graph", "lock_graph_dot",
+    "module_lock_decls",
+]
+
+_LOCK_DECL_RE = re.compile(r"#\s*tpulint:\s*lock=(\S+)")
+
+# Default graph-node names for locks the _LOCK_TABLE already knows but
+# whose source predates the ``# tpulint: lock=`` form. In-source
+# annotations take precedence; these keep the graph readable if an
+# annotation is ever dropped.
+_DEFAULT_LOCK_NAMES: Dict[Tuple[str, str], str] = {
+    ("paddle_tpu/serving/router.py", "self._lock"): "router",
+    ("paddle_tpu/faults/injection.py", "_lock"): "faults.catalog",
+    ("paddle_tpu/checkpoint/manager.py", "_LIVE_TMP_LOCK"): "ckpt.live_tmp",
+}
+
+# Method/attr tails NEVER followed in cross-module call resolution:
+# container and str methods shadow too many project functions (a
+# `d.get()` must not resolve to `MetricsRegistry.get`). Same-module
+# `self.m()` / bare `f()` calls are resolved precisely and don't pass
+# through this gate.
+_GENERIC_TAILS = frozenset({
+    "get", "pop", "popitem", "items", "keys", "values", "copy", "update",
+    "add", "append", "remove", "discard", "clear", "setdefault", "extend",
+    "insert", "sort", "index", "count", "join", "split", "rsplit",
+    "strip", "lstrip", "rstrip", "startswith", "endswith", "format",
+    "encode", "decode", "read", "write", "close", "open", "flush",
+    "acquire", "release", "locked", "put", "send", "recv", "next",
+    "wait", "notify", "notify_all", "start", "run", "is_alive", "reset",
+    "search", "match", "sub", "findall", "group", "lower", "upper",
+    "replace", "rename", "exists", "isfile", "isdir", "splitlines",
+})
+
+# -- blocking-call classification (TPL009) ------------------------------
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "os.fsync", "os.replace", "os.rename", "select.select",
+    "socket.create_connection", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen", "shutil.rmtree", "shutil.copytree",
+    "shutil.copyfile", "shutil.move",
+})
+_BLOCKING_TAILS = frozenset({
+    "restore", "_build", "sleep", "urlopen", "recv", "recv_into",
+    "sendall", "accept", "connect", "step",
+})
+
+
+def blocking_desc(call: ast.Call) -> Optional[str]:
+    """Human-readable description when ``call`` can block or take
+    unbounded time, else None. ``.join()`` is special-cased: thread
+    joins block, ``os.path.join`` / ``"sep".join`` don't (a Constant
+    receiver has no dotted name and never fires)."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "file I/O `open()`"
+    name = dotted_name(func)
+    if not name:
+        return None
+    if name in _BLOCKING_DOTTED:
+        return f"`{name}()`"
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail in _BLOCKING_TAILS:
+        return f"`{name}()`"
+    if tail == "join" and len(parts) >= 2:
+        recv = name.rsplit(".", 1)[0]
+        if not recv.endswith("path"):
+            return f"thread join `{name}()`"
+    return None
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    """One declared lock: the expression it is written as at use sites
+    (``self._lock`` / ``_pending_lock``), the graph-node name, and the
+    class that owns it (None for module-level locks)."""
+
+    expr: str
+    name: str
+    cls: Optional[str]
+    relpath: str
+    line: int
+
+
+def module_lock_decls(module: ModuleInfo,
+                      guard_locks: Sequence[str] = ()) -> List[LockDecl]:
+    """Declared locks of one module: ``# tpulint: lock=<name>``
+    annotations first (class-aware), then default-table rows, then a
+    fallback-named decl for every guard-lock expression TPL006 knows
+    that no annotation already covers."""
+    annotated_lines: Dict[int, str] = {}
+    for i, line in enumerate(module.lines, 1):
+        m = _LOCK_DECL_RE.search(line)
+        if m:
+            annotated_lines[i] = m.group(1)
+    decls: List[LockDecl] = []
+    seen_exprs: Set[str] = set()
+
+    def visit(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, cls)
+                continue
+            if isinstance(child, ast.Assign):
+                targets = child.targets
+            elif isinstance(child, ast.AnnAssign):
+                targets = [child.target]
+            else:
+                visit(child, cls)
+                continue
+            lock_name = annotated_lines.get(child.lineno)
+            if lock_name is not None:
+                for t in targets:
+                    expr = dotted_name(t)
+                    if expr:
+                        decls.append(LockDecl(expr, lock_name, cls,
+                                              module.relpath, child.lineno))
+                        seen_exprs.add(expr)
+            visit(child, cls)
+
+    visit(module.tree, None)
+    stem = module.relpath.rsplit("/", 1)[-1][:-3]
+    for expr in guard_locks:
+        if expr in seen_exprs:
+            continue
+        name = _DEFAULT_LOCK_NAMES.get((module.relpath, expr),
+                                       f"{stem}:{expr}")
+        decls.append(LockDecl(expr, name, None, module.relpath, 0))
+        seen_exprs.add(expr)
+    return decls
+
+
+@dataclass
+class _FnInfo:
+    """One function's lock-relevant summary, gathered in a single walk:
+    every acquisition and every call, each with the lock names held at
+    that point (lexical ``with`` nesting; nested defs get a fresh
+    frame, exactly like TPL006)."""
+
+    key: str
+    name: str
+    cls: Optional[str]
+    relpath: str
+    # (held lock names, acquired lock name, line)
+    acquisitions: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (held lock names, dotted call name, line)
+    calls: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (held lock names, blocking description, line)
+    blocking: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+
+
+@dataclass(frozen=True)
+class LockEdge:
+    """``src`` can be held when ``dst`` is acquired; ``witness`` is the
+    human-readable evidence path, anchored at ``path:line``."""
+
+    src: str
+    dst: str
+    path: str
+    line: int
+    witness: str
+
+
+@dataclass(frozen=True)
+class LockCycle:
+    nodes: Tuple[str, ...]
+    edges: Tuple[LockEdge, ...]
+
+
+class LockWorld:
+    """The project-wide lock universe: declarations, per-function
+    summaries, the interprocedural acquisition/blocking closures, and
+    the resulting edge set. Built once per lint run and shared by
+    TPL007 and TPL009 (cached on the Project object by rules.py)."""
+
+    def __init__(self, project: Project,
+                 guard_locks_of=None):
+        self.project = project
+        self.decls_by_module: Dict[str, List[LockDecl]] = {}
+        self.fns: Dict[str, _FnInfo] = {}
+        self._by_tail: Dict[str, List[_FnInfo]] = {}
+        self._plain_by_module: Dict[str, Dict[str, List[_FnInfo]]] = {}
+        for mod in project.modules:
+            guard_locks = (guard_locks_of(mod) if guard_locks_of else ())
+            self.decls_by_module[mod.relpath] = module_lock_decls(
+                mod, guard_locks)
+        # module-level lock attrs unique project-wide: lets a
+        # cross-module reference (`dist_ckpt._pending_lock`) match the
+        # declaring module's node
+        by_attr: Dict[str, Set[str]] = {}
+        for decls in self.decls_by_module.values():
+            for d in decls:
+                if d.cls is None and "." not in d.expr:
+                    by_attr.setdefault(d.expr, set()).add(d.name)
+        self._unique_module_attrs = {attr: next(iter(names))
+                                     for attr, names in by_attr.items()
+                                     if len(names) == 1}
+        for mod in project.modules:
+            self._walk_module(mod)
+        self.acquires = self._closure(lambda fn: fn.acquisitions)
+        self.blocks = self._closure(lambda fn: fn.blocking)
+        self.edges = self._build_edges()
+
+    # ---------------------------------------------------------------- walk
+    def _match_lock(self, relpath: str, cls: Optional[str],
+                    expr: str) -> Optional[str]:
+        cands = [d for d in self.decls_by_module.get(relpath, ())
+                 if d.expr == expr]
+        exact = [d for d in cands if d.cls == cls and d.cls is not None]
+        if exact:
+            return exact[0].name
+        mod_level = [d for d in cands if d.cls is None]
+        if mod_level:
+            return mod_level[0].name
+        if len(cands) == 1:
+            return cands[0].name
+        if cands:
+            return None          # ambiguous between classes: stay silent
+        parts = expr.split(".")
+        if len(parts) >= 2 and parts[0] != "self":
+            return self._unique_module_attrs.get(parts[-1])
+        return None
+
+    def _walk_module(self, mod: ModuleInfo) -> None:
+        def visit(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    self._walk_fn(mod, child, cls)
+                else:
+                    visit(child, cls)
+
+        visit(mod.tree, None)
+
+    def _walk_fn(self, mod: ModuleInfo, fn_node, cls: Optional[str]) -> None:
+        qual = f"{cls}.{fn_node.name}" if cls else fn_node.name
+        key = f"{mod.relpath}::{qual}@{fn_node.lineno}"
+        info = _FnInfo(key=key, name=fn_node.name, cls=cls,
+                       relpath=mod.relpath)
+        self.fns[key] = info
+        self._by_tail.setdefault(fn_node.name, []).append(info)
+        if cls is None:
+            self._plain_by_module.setdefault(
+                mod.relpath, {}).setdefault(fn_node.name, []).append(info)
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # fresh frame: lexical `with` scopes don't leak into
+                    # nested defs, which run on their own schedule
+                    self._walk_fn(mod, child, cls)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    continue
+                child_held = held
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        try:
+                            expr = ast.unparse(item.context_expr)
+                        except Exception:
+                            continue
+                        name = self._match_lock(mod.relpath, cls, expr)
+                        if name is None:
+                            continue
+                        if name not in child_held:
+                            info.acquisitions.append(
+                                (child_held, name, child.lineno))
+                            child_held = child_held + (name,)
+                if isinstance(child, ast.Call):
+                    callee = dotted_name(child.func)
+                    if callee:
+                        info.calls.append((child_held, callee,
+                                           child.lineno))
+                    desc = blocking_desc(child)
+                    if desc is not None:
+                        info.blocking.append((child_held, desc,
+                                              child.lineno))
+                walk(child, child_held)
+
+        walk(fn_node, ())
+
+    # ----------------------------------------------------------- resolution
+    def resolve(self, fn: _FnInfo, callname: str) -> List[_FnInfo]:
+        parts = callname.split(".")
+        if len(parts) == 1:
+            return list(self._plain_by_module.get(
+                fn.relpath, {}).get(parts[0], ()))
+        if parts[0] == "self" and len(parts) == 2:
+            cands = [g for g in self._by_tail.get(parts[1], ())
+                     if g.relpath == fn.relpath and g.cls is not None]
+            same_cls = [g for g in cands if g.cls == fn.cls]
+            return same_cls or cands
+        tail = parts[-1]
+        if tail in _GENERIC_TAILS:
+            return []
+        return list(self._by_tail.get(tail, ()))
+
+    # ------------------------------------------------------------- closures
+    def _closure(self, events_of):
+        """Transitive summary per function: for acquisitions, the set of
+        lock names a call into the function can take (with one witness
+        site + chain each); for blocking events, the set of blocking
+        descriptions reachable. Fixpoint over the syntactic call graph
+        — cycles converge because the maps only grow."""
+        out: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+        for key, fn in self.fns.items():
+            direct: Dict[str, Tuple[str, int, str]] = {}
+            for _held, what, line in events_of(fn):
+                direct.setdefault(what, (fn.relpath, line, ""))
+            out[key] = direct
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in sorted(self.fns.items()):
+                mine = out[key]
+                for _held, callname, line in fn.calls:
+                    for g in self.resolve(fn, callname):
+                        for what, (path, wline, chain) in out[g.key].items():
+                            if what not in mine:
+                                hop = f"`{callname}()`"
+                                mine[what] = (path, wline,
+                                              hop + (" → " + chain
+                                                     if chain else ""))
+                                changed = True
+        return out
+
+    # ---------------------------------------------------------------- edges
+    def _build_edges(self) -> Dict[Tuple[str, str], LockEdge]:
+        edges: Dict[Tuple[str, str], LockEdge] = {}
+
+        def add(src: str, dst: str, path: str, line: int, text: str):
+            if src == dst:
+                return       # re-entrancy is the sanitizer's job
+            edges.setdefault((src, dst),
+                             LockEdge(src, dst, path, line, text))
+
+        for key in sorted(self.fns):
+            fn = self.fns[key]
+            for held, lock, line in fn.acquisitions:
+                for h in held:
+                    add(h, lock, fn.relpath, line,
+                        f"holding `{h}`, `with {lock}:` entered at "
+                        f"{fn.relpath}:{line}")
+            for held, callname, line in fn.calls:
+                if not held:
+                    continue
+                for g in self.resolve(fn, callname):
+                    for lock, (path, wline, chain) in sorted(
+                            self.acquires[g.key].items()):
+                        for h in held:
+                            via = (f" via {chain}" if chain else "")
+                            add(h, lock, fn.relpath, line,
+                                f"holding `{h}`, call `{callname}()` at "
+                                f"{fn.relpath}:{line}{via} reaches "
+                                f"`with {lock}:` at {path}:{wline}")
+        return edges
+
+    # --------------------------------------------------------------- cycles
+    def cycles(self) -> List[LockCycle]:
+        """One representative simple cycle per strongly-connected
+        component of the edge graph (deterministic: nodes visited in
+        sorted order). An acyclic graph returns []."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for v in adj.values():
+            v.sort()
+        sccs = _tarjan(adj)
+        out: List[LockCycle] = []
+        for comp in sccs:
+            comp_set = set(comp)
+            if len(comp) == 1:
+                continue        # self-edges are filtered at build time
+            start = min(comp)
+            path = self._find_cycle(start, comp_set, adj)
+            if not path:
+                continue
+            cycle_edges = tuple(
+                self.edges[(path[i], path[(i + 1) % len(path)])]
+                for i in range(len(path)))
+            out.append(LockCycle(tuple(path), cycle_edges))
+        out.sort(key=lambda c: c.nodes)
+        return out
+
+    @staticmethod
+    def _find_cycle(start: str, comp: Set[str],
+                    adj: Dict[str, List[str]]) -> List[str]:
+        """DFS within one SCC from ``start`` back to ``start``."""
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        best: List[str] = []
+        seen: Set[Tuple[str, ...]] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    if not best or len(path) < len(best):
+                        best = path
+                    continue
+                if nxt in comp and nxt not in path:
+                    key = tuple(path) + (nxt,)
+                    if key not in seen:
+                        seen.add(key)
+                        stack.append((nxt, path + [nxt]))
+        return best
+
+    # ------------------------------------------------------------ exports
+    def graph(self) -> dict:
+        """JSON-ready acquisition graph (stable ordering)."""
+        nodes = sorted({d.name for decls in self.decls_by_module.values()
+                        for d in decls})
+        return {
+            "nodes": nodes,
+            "edges": [
+                {"from": e.src, "to": e.dst, "path": e.path,
+                 "line": e.line, "witness": e.witness}
+                for (_a, _b), e in sorted(self.edges.items())],
+            "cycles": [list(c.nodes) for c in self.cycles()],
+        }
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (recursion-free: the lock graph is tiny, but
+    the linter must never die on a pathological fixture)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            children = adj.get(node, ())
+            for i in range(pi, len(children)):
+                ch = children[i]
+                if ch not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((ch, 0))
+                    advanced = True
+                    break
+                if ch in on_stack:
+                    low[node] = min(low[node], index[ch])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(comp))
+    return sccs
+
+
+def lock_graph(world: LockWorld) -> dict:
+    return world.graph()
+
+
+def lock_graph_dot(graph: dict) -> str:
+    """The acquisition graph as Graphviz DOT — `tpulint --lock-graph`;
+    cycle edges are drawn red+bold so a hazard is visible at a glance."""
+    cyc_edges: Set[Tuple[str, str]] = set()
+    for cyc in graph.get("cycles", ()):
+        for i, a in enumerate(cyc):
+            cyc_edges.add((a, cyc[(i + 1) % len(cyc)]))
+    lines = ["digraph lock_order {", "  rankdir=LR;",
+             '  node [shape=box, fontname="monospace"];']
+    for n in graph["nodes"]:
+        lines.append(f'  "{n}";')
+    for e in graph["edges"]:
+        attrs = f'label="{e["path"]}:{e["line"]}"'
+        if (e["from"], e["to"]) in cyc_edges:
+            attrs += ', color=red, penwidth=2.0'
+        lines.append(f'  "{e["from"]}" -> "{e["to"]}" [{attrs}];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"

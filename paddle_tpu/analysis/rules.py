@@ -8,6 +8,9 @@
 | TPL004 | fault-point catalog == docs/RESILIENCE.md, both ways | PR 3 |
 | TPL005 | sampling is a pure function of (prompt, seed) | PR 7 |
 | TPL006 | shared registry/router state mutates under its lock | PR 2/5 |
+| TPL007 | the lock-acquisition graph is acyclic (no deadlock) | PR 13 |
+| TPL008 | check-then-act stays inside ONE critical section | PR 13 |
+| TPL009 | no blocking/unbounded work while a lock is held | PR 13 |
 
 Every rule is syntactic (per-module AST, no import resolution) and errs
 toward silence: a miss is caught by the runtime drills these rules
@@ -24,6 +27,7 @@ from .catalog import (FaultSite, MetricRegistration, collect_fault_sites,
                       collect_label_uses, collect_metric_registrations,
                       parse_fault_doc, parse_metric_doc, registration_of)
 from .core import Finding, LintConfig, ModuleInfo, Project
+from .locks import LockWorld, module_lock_decls
 from .scopes import CompiledScopes, Taint, dotted_name
 
 __all__ = ["FILE_RULES", "PROJECT_RULES", "RULE_IDS"]
@@ -593,11 +597,81 @@ _LOCK_TABLE: Dict[str, Tuple[Tuple[str, str], ...]] = {
         ("self._handles", "self._lock"),
         ("self._rr", "self._lock"),
     ),
+    "paddle_tpu/metrics/server.py": (
+        ("self._cb_engine_probe", "self._probe_lock"),
+    ),
+    "paddle_tpu/faults/watchdog.py": (
+        ("self._in_step_since", "self._lock"),
+        ("self._tripped", "self._lock"),
+        ("self._healthy_streak", "self._lock"),
+        ("self._trips", "self._lock"),
+    ),
+    "paddle_tpu/serving/api.py": (
+        ("self._rr_idx", "self._rr_lock"),
+    ),
+    "paddle_tpu/distributed/checkpoint/__init__.py": (
+        ("_pending", "_pending_lock"),
+    ),
 }
 
 _MUTATORS = {"append", "add", "remove", "discard", "clear", "pop",
              "popitem", "update", "setdefault", "extend", "insert"}
 _GUARD_RE = re.compile(r"#\s*tpulint:\s*guard=(\S+)")
+_ATOMIC_OK_RE = re.compile(r"#\s*tpulint:\s*atomic-ok")
+
+
+def _guard_map(module: ModuleInfo) -> Dict[str, str]:
+    """attr -> lock expr for one module: the _LOCK_TABLE rows plus
+    ``# tpulint: guard=<lock>`` birth-line annotations. Cached — TPL006,
+    TPL008, and the LockWorld seed all consume it."""
+    cached = getattr(module, "_guard_map_cache", None)
+    if cached is None:
+        cached = dict(_LOCK_TABLE.get(module.relpath, ()))
+        cached.update(_annotated_guards(module))
+        module._guard_map_cache = cached
+    return cached
+
+
+def _annotated_guards(module: ModuleInfo) -> Dict[str, str]:
+    """``self._foo = {}  # tpulint: guard=self._lock`` declares the
+    guard at the attr's birth line."""
+    lines_with_guard: Dict[int, str] = {}
+    for i, line in enumerate(module.lines, 1):
+        m = _GUARD_RE.search(line)
+        if m:
+            lines_with_guard[i] = m.group(1)
+    if not lines_with_guard:
+        return {}
+    found: Dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        lock = lines_with_guard.get(node.lineno)
+        if lock is None:
+            continue
+        for t in targets:
+            name = dotted_name(t)
+            if name:
+                found[name] = lock
+    return found
+
+
+def _lock_world(project: Project) -> LockWorld:
+    """One LockWorld per lint run (TPL007 and TPL009 share the
+    interprocedural pass — building it twice would double the fixpoint
+    and let the two rules drift on a future resolution fix)."""
+    world = getattr(project, "_lock_world", None)
+    if world is None:
+        world = LockWorld(
+            project,
+            guard_locks_of=lambda m: tuple(sorted(set(_guard_map(m)
+                                                      .values()))))
+        project._lock_world = world
+    return world
 
 
 class TPL006LockDiscipline:
@@ -610,41 +684,13 @@ class TPL006LockDiscipline:
     id = "TPL006"
 
     def check(self, module: ModuleInfo, config: LintConfig) -> List[Finding]:
-        guards: Dict[str, str] = dict(_LOCK_TABLE.get(module.relpath, ()))
-        guards.update(self._annotated_guards(module))
+        guards = _guard_map(module)
         if not guards:
             return []
         out: List[Finding] = []
         self._visit(module, module.tree, guards, with_stack=[],
                     fn_stack=[], out=out)
         return out
-
-    def _annotated_guards(self, module: ModuleInfo) -> Dict[str, str]:
-        """``self._foo = {}  # tpulint: guard=self._lock`` declares the
-        guard at the attr's birth line."""
-        lines_with_guard: Dict[int, str] = {}
-        for i, line in enumerate(module.lines, 1):
-            m = _GUARD_RE.search(line)
-            if m:
-                lines_with_guard[i] = m.group(1)
-        if not lines_with_guard:
-            return {}
-        found: Dict[str, str] = {}
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.Assign):
-                targets = node.targets
-            elif isinstance(node, ast.AnnAssign):
-                targets = [node.target]
-            else:
-                continue
-            lock = lines_with_guard.get(node.lineno)
-            if lock is None:
-                continue
-            for t in targets:
-                name = dotted_name(t)
-                if name:
-                    found[name] = lock
-        return found
 
     def _visit(self, module, node, guards, with_stack, fn_stack, out):
         if isinstance(node, ast.With):
@@ -714,7 +760,212 @@ class TPL006LockDiscipline:
                      f"`.{node.func.attr}()`")
 
 
+class TPL007LockOrderCycle:
+    """A cycle in the static lock-acquisition graph is a deadlock
+    hazard: two threads entering it from different nodes can block each
+    other forever. The graph is built interprocedurally by
+    :mod:`.locks` from the declared locks (``# tpulint: lock=<name>``
+    annotations + the TPL006 guard table), following call edges within
+    the linted code. Each cycle is reported ONCE, with the witness path
+    of every edge on it — both directions of a 2-cycle name the exact
+    acquisition sites to untangle."""
+
+    id = "TPL007"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        world = _lock_world(project)
+        for cyc in world.cycles():
+            ring = " → ".join(cyc.nodes + (cyc.nodes[0],))
+            wits = "; ".join(f"[{e.src}→{e.dst}] {e.witness}"
+                             for e in cyc.edges)
+            first = cyc.edges[0]
+            out.append(Finding(
+                self.id, first.path, first.line, 0,
+                f"lock-order cycle {ring} — deadlock hazard; {wits}"))
+        return out
+
+
+class TPL008AtomicityViolation:
+    """Check-then-act across a lock release: a value read from a
+    guarded container inside ``with <lock>:`` feeds a guarded write in
+    a *different* ``with`` block of the SAME lock. Between the two
+    blocks another thread may have invalidated the read — merge the
+    blocks, or annotate ``# tpulint: atomic-ok`` (read or write line)
+    when the gap is intentional (e.g. the value is a snapshot by
+    design)."""
+
+    id = "TPL008"
+
+    def check(self, module: ModuleInfo, config: LintConfig) -> List[Finding]:
+        guards = _guard_map(module)
+        decls = module_lock_decls(
+            module, tuple(sorted(set(guards.values()))))
+        lock_exprs = {d.expr for d in decls} | set(guards.values())
+        if not lock_exprs:
+            return []
+        ok_lines = {i for i, line in enumerate(module.lines, 1)
+                    if _ATOMIC_OK_RE.search(line)}
+
+        def annotated(line: int) -> bool:
+            return line in ok_lines or (line - 1) in ok_lines
+
+        out: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.extend(self._check_fn(module, fn, guards, lock_exprs,
+                                      annotated))
+        return out
+
+    def _check_fn(self, module, fn, guards, lock_exprs, annotated):
+        nested: Set[ast.AST] = set()
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.update(ast.walk(sub))
+        blocks: List[Tuple[str, ast.With]] = []
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                try:
+                    expr = ast.unparse(item.context_expr)
+                except Exception:
+                    continue
+                if expr in lock_exprs:
+                    blocks.append((expr, node))
+        out: List[Finding] = []
+        for i, (lock, block_a) in enumerate(blocks):
+            attrs = {a for a, lk in guards.items() if lk == lock}
+            if not attrs:
+                continue
+            reads = self._guarded_reads(block_a, attrs)
+            if not reads:
+                continue
+            a_nodes = set(ast.walk(block_a))
+            for lock_b, block_b in blocks[i + 1:]:
+                if lock_b != lock or block_b in a_nodes:
+                    continue
+                for wnode, attr in self._guarded_writes(block_b, attrs):
+                    used = {n.id for n in ast.walk(wnode)
+                            if isinstance(n, ast.Name)} & set(reads)
+                    if not used:
+                        continue
+                    name = sorted(used)[0]
+                    rline = reads[name]
+                    if annotated(wnode.lineno) or annotated(rline):
+                        continue
+                    out.append(Finding(
+                        self.id, module.relpath, wnode.lineno, 0,
+                        f"check-then-act across `{lock}` release: "
+                        f"`{name}` (read from a guarded container at "
+                        f"line {rline}) feeds this guarded write of "
+                        f"`{attr}` in a different `with {lock}:` block "
+                        f"— merge the critical sections or annotate "
+                        f"`# tpulint: atomic-ok`"))
+        return out
+
+    @staticmethod
+    def _guarded_reads(block: ast.With, attrs: Set[str]) -> Dict[str, int]:
+        """name -> read line for ``n = ...<guarded attr>...`` inside
+        the block."""
+        reads: Dict[str, int] = {}
+        for node in ast.walk(block):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            for sub in ast.walk(node.value):
+                if dotted_name(sub) in attrs:
+                    reads.setdefault(node.targets[0].id, node.lineno)
+                    break
+        return reads
+
+    @staticmethod
+    def _guarded_writes(block: ast.With, attrs: Set[str]):
+        """(statement node, attr) for every guarded-container write in
+        the block — same mutation shapes TPL006 patrols."""
+        for node in ast.walk(block):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS):
+                attr = dotted_name(node.func.value)
+                if attr in attrs:
+                    yield node, attr
+                continue
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = dotted_name(base)
+                if attr in attrs:
+                    yield node, attr
+
+
+class TPL009BlockingUnderLock:
+    """Blocking or unbounded-time work reached while a declared lock is
+    held — file I/O, ``CheckpointManager.restore``, compile builds
+    (``StaticFunction._build``), ``time.sleep``, socket ops,
+    ``Thread.join``, engine ``step``. Every other thread touching that
+    lock stalls behind the slow holder (the repo convention is
+    copy-under-lock, act outside). Interprocedural: a call chain that
+    reaches the blocking site counts, with the chain in the message."""
+
+    id = "TPL009"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        world = _lock_world(project)
+        for key in sorted(world.fns):
+            fn = world.fns[key]
+            direct_lines: Set[int] = set()
+            for held, desc, line in fn.blocking:
+                if not held:
+                    continue
+                direct_lines.add(line)
+                out.append(Finding(
+                    self.id, fn.relpath, line, 0,
+                    f"blocking call {desc} while holding lock "
+                    f"`{held[-1]}` — copy under the lock, do the slow "
+                    f"work outside"))
+            for held, callname, line in fn.calls:
+                if not held or line in direct_lines:
+                    continue
+                reached = {}
+                for g in world.resolve(fn, callname):
+                    for desc, site in world.blocks[g.key].items():
+                        reached.setdefault(desc, site)
+                if not reached:
+                    continue
+                desc = sorted(reached)[0]
+                path, wline, chain = reached[desc]
+                via = f" via {chain}" if chain else ""
+                out.append(Finding(
+                    self.id, fn.relpath, line, 0,
+                    f"call `{callname}()`{via} reaches blocking {desc} "
+                    f"({path}:{wline}) while holding lock "
+                    f"`{held[-1]}` — copy under the lock, do the slow "
+                    f"work outside"))
+        return out
+
+
 FILE_RULES = [TPL001HostSyncInCompiled(), TPL002RecompileHazard(),
-              TPL005UnseededRandomness(), TPL006LockDiscipline()]
-PROJECT_RULES = [TPL003MetricCatalogParity(), TPL004FaultPointParity()]
-RULE_IDS = ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006")
+              TPL005UnseededRandomness(), TPL006LockDiscipline(),
+              TPL008AtomicityViolation()]
+PROJECT_RULES = [TPL003MetricCatalogParity(), TPL004FaultPointParity(),
+                 TPL007LockOrderCycle(), TPL009BlockingUnderLock()]
+RULE_IDS = ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
+            "TPL007", "TPL008", "TPL009")
+
+
+def lock_graph_for(project: Project) -> dict:
+    """The static lock-acquisition graph of a linted project (nodes,
+    witnessed edges, cycles) — `tools/tpulint.py --lock-graph` and the
+    --json payload consume this; it is the same LockWorld TPL007/TPL009
+    judged, so what reviewers eyeball IS what the gate enforced."""
+    return _lock_world(project).graph()

@@ -74,7 +74,7 @@ _CORRUPT_PREFIX = "corrupt-"
 # a DIFFERENT CheckpointManager instance on the same directory (e.g. two
 # successive Model.save_checkpoint calls each build their own manager)
 _LIVE_TMP: set = set()
-_LIVE_TMP_LOCK = threading.RLock()  # reentrant: see _pending_lock's note
+_LIVE_TMP_LOCK = threading.RLock()  # tpulint: lock=ckpt.live_tmp (reentrant: see _pending_lock's note)
 
 faults.declare_point(
     "ckpt.commit",
@@ -183,7 +183,7 @@ class CheckpointManager:
         # serializes commit/GC phases; REENTRANT because the save_on_signal
         # handler runs on the main thread and may interrupt a save that is
         # inside its own locked commit — a plain Lock would self-deadlock
-        self._save_lock = threading.RLock()
+        self._save_lock = threading.RLock()  # tpulint: lock=ckpt.save
         os.makedirs(self.directory, exist_ok=True)
 
     # ------------------------------------------------------------- steps

@@ -62,7 +62,7 @@ _pending: list = []
 # REENTRANT: the save_on_signal preemption handler runs on the main thread
 # and may interrupt a frame that is inside this lock — a plain Lock would
 # self-deadlock the handler
-_pending_lock = threading.RLock()
+_pending_lock = threading.RLock()  # tpulint: lock=ckpt.pending
 
 faults.declare_point("ckpt.write", "before each checkpoint file write")
 faults.declare_point("ckpt.fsync", "before each checkpoint fsync")

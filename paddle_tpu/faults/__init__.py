@@ -13,6 +13,11 @@ failure modes into *tested contracts* (docs/RESILIENCE.md). Four pieces:
   sleep, optional deadline bound. The final failure re-raises unchanged.
 - **Deadline** (deadline.py): an absolute time budget on an injectable
   clock — the currency of request timeouts and retry bounds.
+- **LockSanitizer** (sanitizer.py): opt-in instrumented lock wrapper —
+  per-thread acquisition stacks, live lock-order-inversion and
+  non-reentrant re-acquisition detection, per-lock hold/wait
+  histograms. The runtime half of tpulint's TPL007-009; chaos drills
+  switch it on.
 - **StepWatchdog** (watchdog.py): trips on an over-threshold engine
   step, detects live hangs from any thread (``stalled_now``), recovers
   after N healthy steps — the state behind ``/healthz`` degraded mode.
@@ -40,14 +45,16 @@ from .injection import (CallbackError, FaultInjected, FaultSpec,
                         ResourceExhausted, active_faults, declare_point,
                         inject, known_points, point, reset)
 from .retry import backoff_delays, retry
+from .sanitizer import LockSanitizer, LockViolation
 from .sentinel import (Action, SentinelAbort, SentinelConfig, StepReport,
                        TrainSentinel)
 from .watchdog import StepWatchdog
 
 __all__ = [
     "Action", "CallbackError", "Deadline", "DeadlineExceeded",
-    "FaultInjected", "FaultSpec", "ResourceExhausted", "SentinelAbort",
-    "SentinelConfig", "StepReport", "StepWatchdog", "TrainSentinel",
+    "FaultInjected", "FaultSpec", "LockSanitizer", "LockViolation",
+    "ResourceExhausted", "SentinelAbort", "SentinelConfig", "StepReport",
+    "StepWatchdog", "TrainSentinel",
     "active_faults", "backoff_delays", "declare_point", "inject",
     "known_points", "point", "reset", "retry",
 ]

@@ -58,7 +58,7 @@ class CallbackError(RuntimeError):
     callback isolation can attribute the failure to user code."""
 
 
-_lock = threading.RLock()
+_lock = threading.RLock()  # tpulint: lock=faults.catalog
 _active: List["FaultSpec"] = []
 _catalog: Dict[str, str] = {}
 

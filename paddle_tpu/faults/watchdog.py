@@ -34,7 +34,7 @@ class StepWatchdog:
         self.stall_threshold_s = float(stall_threshold_s)
         self.recovery_steps = int(recovery_steps)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # tpulint: lock=watchdog
         self._in_step_since: Optional[float] = None
         self._tripped = False
         self._healthy_streak = 0

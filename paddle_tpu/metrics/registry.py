@@ -256,7 +256,7 @@ class _MetricFamily:
         # not registered anywhere — use registry.counter()/gauge()/
         # histogram() to get exported series
         self._registry = registry if registry is not None else get_registry()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # tpulint: lock=metrics.family
         self._children: Dict[Tuple[str, ...], object] = {}
         if not self.label_names:
             self._children[()] = self._child_cls(self)
@@ -463,7 +463,7 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = True):
         self._metrics: Dict[str, _MetricFamily] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # tpulint: lock=metrics.registry
         self.enabled = bool(enabled)
 
     # -- declaration ------------------------------------------------------

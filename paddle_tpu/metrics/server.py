@@ -52,6 +52,10 @@ class MetricsServer:
         # JSON body — ServingEngine.health fits directly). None keeps
         # the bare liveness behavior (always 200 ok).
         self.health_cb = health_cb
+        # probe-cache lock: /healthz scrapes run on ThreadingHTTPServer
+        # worker threads, so the (callback, takes_engine) cache write
+        # below must not race a concurrent probe's
+        self._probe_lock = threading.Lock()  # tpulint: lock=metrics.server.probe
         self._cb_engine_probe = None  # (callback, takes_engine) cache
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -74,7 +78,8 @@ class MetricsServer:
                     break
         except (TypeError, ValueError):  # builtins/partials: be safe
             ok = False
-        self._cb_engine_probe = (self.health_cb, ok)
+        with self._probe_lock:
+            self._cb_engine_probe = (self.health_cb, ok)
         return ok
 
     def _health(self, query: str = ""):

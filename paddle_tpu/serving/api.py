@@ -268,7 +268,7 @@ class EnginePool(Router):
         # math in CPython, but a slow drift toward bignum arithmetic on
         # the hot path — and a pointless one)
         self._rr_idx = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = threading.Lock()  # tpulint: lock=pool.rr
 
     @property
     def _engines(self) -> List[ServingEngine]:

@@ -147,7 +147,7 @@ class Router:
         self._models: Dict[str, List[EngineHandle]] = {}
         self._handles: Dict[str, EngineHandle] = {}
         self._rr: Dict[str, int] = {}          # per-model tie-break cursor
-        self._lock = threading.Lock()          # rr cursors + state flips
+        self._lock = threading.Lock()  # tpulint: lock=router (rr cursors + state flips)
         self._requeued: set = set()            # req_ids moved once already
         self._stash: Dict[object, RequestOutput] = {}
         reg = metrics.get_registry()

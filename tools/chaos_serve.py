@@ -25,7 +25,13 @@ the busiest engine BETWEEN PROMPT CHUNKS of a long request (ISSUE 11):
 chunked-prefill progress is only a cache length, so the mid-prefill
 request migrates with an empty journal, resumes from its chunk boundary
 through the sibling's prefix cache, and streams bit-identically from
-seq 0 — chunks exactly-once. Each scenario asserts both the behavior
+seq 0 — chunks exactly-once. Scenario 13 thread-fuzzes the control
+plane under ``faults.LockSanitizer``: a driver thread (submit / step /
+rolling reload), a /metrics+/healthz scraper and a health()/states()
+prober race through 200 barrier-synced, seed-jittered iterations with
+the router / registry / probe-cache / watchdog locks instrumented —
+zero lock-order or reentrancy violations allowed, fleet must end
+consistent. Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
 
@@ -610,6 +616,125 @@ def scenario_kill_engine_mid_chunked_prefill(model):
             "bit-identical, chunks exactly-once")
 
 
+def scenario_thread_fuzz_control_plane(model):
+    """Scenario 13: thread-fuzz the CONTROL PLANE under LockSanitizer —
+    one driver thread runs submit/step/rolling-reload, a scraper hammers
+    /metrics + /metrics.json + /healthz, a prober spins health()/states()
+    (the any-thread half of the router's threading contract), all
+    synchronized through a barrier each iteration with seeded per-thread
+    jitter so the interleavings vary but reproduce. The sanitizer wraps
+    the router, registry, probe-cache and watchdog locks; the drill
+    passes iff ZERO lock-discipline violations were observed AND the
+    fleet ends consistent (every request completed, no leaked pages)."""
+    import threading
+    import time
+
+    iters = int(os.environ.get("CHAOS_FUZZ_ITERS", "200"))
+    tmp = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    san = faults.LockSanitizer(
+        order=("router", "engine", "scheduler", "pool"),
+        leaves=("metrics.registry", "metrics.server.probe",
+                "watchdog/0", "watchdog/1"))
+    registry = metrics.get_registry()
+    orig_reg_lock = None
+    try:
+        paddle.seed(SEED + 13)
+        donor = LlamaForCausalLM(llama_tiny(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64))
+        CheckpointManager(tmp, max_to_keep=None).save(
+            1, {"model": donor.state_dict()})
+        r = Router()
+        r.add_model("m", [_model(), _model()], page_size=4,
+                    max_batch_slots=1)
+        san.attach(r, "_lock", "router")
+        # the registry lock is process-global: restore it in finally
+        orig_reg_lock = san.attach(registry, "_lock", "metrics.registry")
+        for i, eng in enumerate(r.engines("m")):
+            san.attach(eng.watchdog, "_lock", f"watchdog/{i}")
+
+        barrier = threading.Barrier(3)
+        errors, live, prompts = [], [], (P5, P9, P3, P4)
+        counts = {"drive": 0, "scrape": 0, "probe": 0}
+
+        def drive(i, rng):
+            if i % 5 == 0:
+                live.append(r.submit(prompts[int(rng.randint(4))],
+                                     model="m", max_new_tokens=2))
+            r.step()
+            if i % 67 == 66:  # rolling weight pushes mid-fuzz
+                summary = r.reload(tmp)
+                _check(all(e["result"] == "ok"
+                           for e in summary["engines"]),
+                       f"reload failed mid-fuzz: {summary}")
+
+        def scrape(i, rng):
+            path = ("/metrics", "/metrics.json",
+                    "/healthz?engine=m/0")[i % 3]
+            try:
+                with urllib.request.urlopen(srv.url + path,
+                                            timeout=10) as resp:
+                    _check(resp.status == 200, f"{path}: {resp.status}")
+            except urllib.error.HTTPError as e:
+                # a scrape that lands mid-reload may read degraded: 503
+                # on /healthz is consistent, a 5xx on /metrics is not
+                _check(path.startswith("/healthz") and e.code == 503,
+                       f"{path}: HTTP {e.code}")
+
+        def probe(i, rng):
+            h = r.health()
+            _check(h.get("status") in ("ok", "degraded"),
+                   f"health() shape: {h}")
+            r.states()
+
+        def worker(key, fn, idx):
+            rng = np.random.RandomState(SEED * 997 + idx)
+            try:
+                for i in range(iters):
+                    barrier.wait(timeout=60)
+                    time.sleep(float(rng.uniform(0.0, 5e-4)))
+                    fn(i, rng)
+                    counts[key] += 1
+            except threading.BrokenBarrierError:
+                pass
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((key, e))
+                barrier.abort()
+
+        with metrics.MetricsServer(health_cb=r.health, port=0) as srv:
+            san.attach(srv, "_probe_lock", "metrics.server.probe")
+            threads = [threading.Thread(target=worker, args=args,
+                                        name=f"fuzz-{args[0]}")
+                       for args in (("drive", drive, 1),
+                                    ("scrape", scrape, 2),
+                                    ("probe", probe, 3))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            _check(not any(t.is_alive() for t in threads),
+                   "fuzz thread wedged")
+        _check(not errors, f"fuzz thread failures: {errors}")
+        _check(all(c == iters for c in counts.values()),
+               f"threads did not complete all iterations: {counts}")
+        outs = r.run()   # drain whatever the driver left in flight
+        _check(sorted(outs) == sorted(live),
+               "requests dropped or duplicated under fuzz")
+        _check(all(outs[k].finish_reason == "length" for k in live),
+               "a fuzzed request did not complete normally")
+        _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+               "pages leaked under fuzz")
+        san.assert_clean()
+        return (f"{iters} barrier-synced iterations x 3 threads "
+                f"({len(live)} requests, {iters // 67} reloads, "
+                f"{iters} scrapes): 0 sanitizer violations, fleet "
+                "consistent")
+    finally:
+        if orig_reg_lock is not None:
+            registry._lock = orig_reg_lock
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -624,6 +749,7 @@ SCENARIOS = [
     ("prefix-cache-failover-migration", scenario_prefix_cache_failover),
     ("kill-engine-mid-chunked-prefill",
      scenario_kill_engine_mid_chunked_prefill),
+    ("thread-fuzz-control-plane", scenario_thread_fuzz_control_plane),
 ]
 
 

@@ -4,12 +4,15 @@
 Enforces the invariants the runtime drills prove dynamically (rule
 catalog: docs/ANALYSIS.md): TPL001 no-host-sync-in-compiled, TPL002
 recompile hazards, TPL003/TPL004 metric & fault-point catalog parity
-with the docs, TPL005 seeded determinism, TPL006 lock discipline.
+with the docs, TPL005 seeded determinism, TPL006 lock discipline,
+TPL007 lock-order cycles, TPL008 check-then-act atomicity, TPL009
+blocking-under-lock.
 
 Usage:
 
   python tools/tpulint.py paddle_tpu tools examples
   python tools/tpulint.py --json paddle_tpu          # CI-diffable output
+  python tools/tpulint.py --lock-graph paddle_tpu    # acquisition graph, DOT
   python tools/tpulint.py --write-baseline paddle_tpu tools examples
 
 Exit codes: 0 clean (every finding baselined), 1 findings, 2 bad usage
@@ -62,7 +65,12 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=_REPO_ROOT,
                     help="repo root (doc catalogs + relative paths)")
     ap.add_argument("--json", action="store_true",
-                    help="stable JSON output (sorted, timestamp-free)")
+                    help="stable JSON output (sorted, timestamp-free; "
+                         "includes the lock acquisition graph)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the declared-lock acquisition graph as "
+                         "Graphviz DOT (cycle edges red) and exit; pipe "
+                         "into `dot -Tsvg` to eyeball ordering")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default {_DEFAULT_BASELINE} "
                          f"when it exists)")
@@ -95,6 +103,13 @@ def main(argv=None) -> int:
         print(f"tpulint: internal error: {e}", file=sys.stderr)
         return 2
 
+    if args.lock_graph:
+        graph = analysis.lock_graph_for(result.project)
+        print(analysis.lock_graph_dot(graph), end="")
+        # findings still gate the exit code: a red edge in the SVG and
+        # a green CI lane must not disagree
+        return 1 if any(f.rule == "TPL007" for f in result.findings) else 0
+
     baseline_path = args.baseline or (
         _DEFAULT_BASELINE if os.path.isfile(_DEFAULT_BASELINE) else None)
     if args.write_baseline:
@@ -117,7 +132,8 @@ def main(argv=None) -> int:
     result.baselined = len(baselined)
 
     if args.json:
-        print(analysis.to_json(result, new))
+        print(analysis.to_json(result, new,
+                               analysis.lock_graph_for(result.project)))
     else:
         print(analysis.to_text(result, new))
     return 1 if new else 0
