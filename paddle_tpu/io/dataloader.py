@@ -19,6 +19,7 @@ import itertools
 import queue
 import threading
 import traceback
+from multiprocessing import TimeoutError as _mp_TimeoutError
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -192,6 +193,19 @@ def _process_fetch(indices):
     return [ds[i] for i in indices]
 
 
+def _workers_crash_looping(pool, seen_pids, num_workers):
+    """True when the pool is respawning dead-on-arrival workers. A healthy
+    pool keeps a stable set of worker PIDs for its whole life; a worker
+    whose initializer (or spawn import) dies is silently replaced by
+    mp.Pool with a fresh process — forever — so the submitted tasks never
+    run and every result.get() blocks. Distinct-PID churn past 3x the
+    pool size is that loop, not a slow dataset."""
+    for p in getattr(pool, "_pool", None) or []:
+        if p.pid is not None:
+            seen_pids.add(p.pid)
+    return len(seen_pids) > 3 * max(num_workers, 1)
+
+
 class _ProcessPoolIter:
     """Multiprocess sample fetching (reference: dataloader_iter.py's
     _DataLoaderIterMultiProcess — worker subprocesses + shared queues).
@@ -220,6 +234,7 @@ class _ProcessPoolIter:
         self._capacity = max(2, loader.prefetch_factor * loader.num_workers)
         self._pending = deque()
         self._next_submit = 0
+        self._seen_pids: set = set()
         self._fill()
 
     def _fill(self):
@@ -238,11 +253,22 @@ class _ProcessPoolIter:
             _end_epoch_once(self)
             raise StopIteration
         res = self._pending.popleft()
-        try:
-            samples = res.get()
-        except Exception:
-            self.close()
-            raise
+        while True:
+            try:
+                samples = res.get(timeout=1.0)
+                break
+            except _mp_TimeoutError:
+                if _workers_crash_looping(self._pool, self._seen_pids,
+                                          self._loader.num_workers):
+                    self.close()
+                    raise RuntimeError(
+                        "process dataloader: worker processes are "
+                        f"crash-looping ({len(self._seen_pids)} distinct "
+                        f"workers spawned for {self._loader.num_workers} "
+                        "slots) — worker init failed; see worker stderr")
+            except Exception:
+                self.close()
+                raise
         self._fill()
         collate = self._loader.collate_fn or default_collate_fn
         batch = collate(samples)
@@ -300,6 +326,7 @@ class _ShmProcessPoolIter:
         self._next_submit = 0
         self._next_seq = 0  # next batch owed to the consumer, in order
         self._stash = {}    # out-of-order batches parked by seq
+        self._seen_pids: set = set()
         try:
             self._channel = ShmChannel()  # owner: unlinked on close
             ctx = mp.get_context("spawn")
@@ -356,6 +383,14 @@ class _ShmProcessPoolIter:
                     raise RuntimeError(
                         "shm dataloader: workers ended without producing "
                         f"batch {want}")
+                if _workers_crash_looping(self._pool, self._seen_pids,
+                                          self._loader.num_workers):
+                    self.close()
+                    raise RuntimeError(
+                        "shm dataloader: worker processes are crash-looping "
+                        f"({len(self._seen_pids)} distinct workers spawned "
+                        f"for {self._loader.num_workers} slots) — worker "
+                        "init failed; see worker stderr")
         samples = self._stash.pop(want)
         self._next_seq += 1
         collate = self._loader.collate_fn or default_collate_fn

@@ -20,7 +20,9 @@ import numpy as np
 from ..autograd import no_grad
 from ..nn.layer_base import Layer
 from ..tensor import Tensor
-from .static_function import InputSpec, StaticFunction, _flatten_out, _rebuild_out
+from .static_function import (InputSpec, StaticFunction, _flatten_out,
+                              _rebuild_out, clear_compile_cache,
+                              get_compile_cache_dir, set_compile_cache_dir)
 from .bucketing import (  # noqa: F401
     BucketedFunction, bucket_for, pad_to_bucket, pow2_buckets,
 )
@@ -30,6 +32,7 @@ __all__ = [
     "StaticFunction", "InputSpec", "enable_to_static", "ignore_module",
     "set_code_level", "set_verbosity",
     "BucketedFunction", "bucket_for", "pad_to_bucket", "pow2_buckets",
+    "set_compile_cache_dir", "get_compile_cache_dir", "clear_compile_cache",
 ]
 
 _to_static_enabled = True

@@ -24,7 +24,10 @@ parameters in place (the buffer-donation answer to the reference's inplace
 from __future__ import annotations
 
 import gc
+import hashlib
 import os
+import pickle
+import tempfile
 import weakref
 from typing import Any, Callable, Optional, Sequence
 
@@ -38,7 +41,8 @@ from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
 from ..tensor import Tensor
 
-__all__ = ["StaticFunction", "InputSpec"]
+__all__ = ["StaticFunction", "InputSpec", "set_compile_cache_dir",
+           "get_compile_cache_dir", "clear_compile_cache"]
 
 
 class InputSpec:
@@ -293,7 +297,12 @@ def _spec_key(spec, arrays, meta):
     kind, payload = spec
     if kind in ("T", "A"):
         a = arrays[payload]
-        return (kind, tuple(a.shape), str(a.dtype), meta[payload])
+        # weak_type participates: jax.jit would silently retrace on a
+        # weak/strong flip, but an AOT-loaded executable (persistent
+        # compile cache) REJECTS the mismatched aval — keying on it keeps
+        # both paths one-signature-one-program
+        return (kind, tuple(a.shape), str(a.dtype), meta[payload],
+                bool(getattr(a, "weak_type", False)))
     if kind == "S":
         v = payload.v
         try:
@@ -369,13 +378,150 @@ def _unalias(state_vals, protected):
     return out
 
 
+# -------------------------------------------------- persistent compile cache
+# Executable reuse across processes (and across StaticFunction instances in
+# one process): `_build` consults a process-wide memory layer, then an
+# on-disk layer of serialized XLA executables, before paying a fresh trace +
+# XLA compile. Fully disabled unless a cache directory is configured — via
+# the StaticFunction ``cache_dir=`` ctor arg, :func:`set_compile_cache_dir`,
+# or the ``PADDLE_TPU_COMPILE_CACHE`` env var — so default behavior (and the
+# jax.jit execution path) is untouched. Every materialization increments
+# paddle_tpu_jit_compiles_total{fn, source="memory|disk|fresh"} exactly
+# once: the per-fn SUM keeps the old one-inc-per-build meaning, while the
+# source split makes warm restarts and rolling reloads monitorable
+# (docs/OBSERVABILITY.md).
+_cache_dir_override: Optional[str] = None
+_MEMORY_CACHE: dict = {}  # full key string -> (aot_executable, out_spec)
+
+
+def set_compile_cache_dir(path: Optional[str]) -> None:
+    """Enable (or, with None, disable) the persistent compile cache for
+    every StaticFunction that doesn't pin its own ``cache_dir=``. The
+    directory is created lazily on first store."""
+    global _cache_dir_override
+    _cache_dir_override = None if path is None else str(path)
+
+
+def get_compile_cache_dir() -> Optional[str]:
+    """The process-default cache dir: :func:`set_compile_cache_dir` wins,
+    else the ``PADDLE_TPU_COMPILE_CACHE`` env var, else None (disabled)."""
+    if _cache_dir_override is not None:
+        return _cache_dir_override
+    return os.environ.get("PADDLE_TPU_COMPILE_CACHE") or None
+
+
+def clear_compile_cache(memory: bool = True, disk: bool = False) -> int:
+    """Drop cached executables; returns how many entries were dropped.
+    ``memory`` clears the process-wide layer (tests use this to force the
+    next build through the DISK path, simulating a cold process);
+    ``disk`` unlinks every ``*.jitcache`` file in the resolved cache dir."""
+    n = 0
+    if memory:
+        n += len(_MEMORY_CACHE)
+        _MEMORY_CACHE.clear()
+    if disk:
+        d = get_compile_cache_dir()
+        if d is not None and os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.endswith(".jitcache"):
+                    try:
+                        os.unlink(os.path.join(d, name))
+                        n += 1
+                    except OSError:
+                        pass
+    return n
+
+
+def _code_fingerprint(fn) -> str:
+    """sha256 over the function's bytecode, constants, and names —
+    recursing into nested code objects (closures, comprehensions) — so a
+    source edit invalidates cached executables even when shapes match.
+    Unintrospectable callables fingerprint by qualified name: better a
+    coarse key than a stale executable."""
+    h = hashlib.sha256()
+
+    def feed(code):
+        h.update(code.co_code)
+        h.update(repr(code.co_names).encode())
+        for c in code.co_consts:
+            if hasattr(c, "co_code"):
+                # recurse INSTEAD of repr-ing: a code object's repr
+                # embeds its memory address, which would make the
+                # fingerprint process-unique and defeat the disk cache
+                feed(c)
+            else:
+                h.update(repr(c).encode())
+
+    target = getattr(fn, "__wrapped__", fn)
+    code = getattr(target, "__code__", None)
+    if code is None:
+        h.update(repr(getattr(fn, "__qualname__", fn)).encode())
+    else:
+        feed(code)
+    return h.hexdigest()
+
+
+def _load_disk_entry(path: str, full_key: str):
+    """(aot, out_spec) deserialized from ``path``, or None. ANY failure —
+    missing file, truncated pickle, version/device drift surfacing as a
+    deserialization error, a digest collision caught by the stored
+    full-key mismatch — means "not cached": the caller falls back to a
+    fresh build, never crashes."""
+    try:
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        if entry.get("key") != full_key:
+            return None
+        from jax.experimental import serialize_executable
+
+        aot = serialize_executable.deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"])
+        return aot, entry["out_spec"]
+    except Exception:
+        return None
+
+
+def _store_disk_entry(path: str, full_key: str, aot, out_spec) -> None:
+    """Serialize an AOT executable to ``path`` atomically (tmp file +
+    os.replace: a concurrently starting process reads either the old
+    complete entry or the new one, never a torn write). Best-effort: an
+    unserializable executable or unwritable dir just means the next
+    process compiles fresh."""
+    try:
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(aot)
+        blob = pickle.dumps({"key": full_key, "payload": payload,
+                             "in_tree": in_tree, "out_tree": out_tree,
+                             "out_spec": out_spec})
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except Exception:
+        pass
+
+
 # ------------------------------------------------------------ StaticFunction
 class _Compiled:
-    __slots__ = ("jitted", "out_spec")
+    __slots__ = ("jitted", "out_spec", "aot")
 
-    def __init__(self, jitted, out_spec=None):
+    def __init__(self, jitted, out_spec=None, aot=None):
         self.jitted = jitted
         self.out_spec = out_spec
+        # AOT executable (persistent-cache path): used for calls when
+        # set; `jitted` stays alive regardless so cost_analysis/lower
+        # keep working on disk-cache hits
+        self.aot = aot
 
 
 class StaticFunction:
@@ -385,7 +531,9 @@ class StaticFunction:
 
     def __init__(self, function: Callable, input_spec=None, build_strategy=None,
                  property=False, full_graph=True, observe: Sequence[Any] = (),
-                 warmup: bool = True, dy2static: bool = True):
+                 warmup: bool = True, dy2static: bool = True,
+                 cache_dir: Optional[str] = None,
+                 cache_key_extra: Optional[str] = None):
         if dy2static and os.environ.get("PADDLE_TPU_DY2STATIC") != "0":
             # AST pass rewriting Python if/while on tensor values into
             # static.nn control flow (jit/dy2static.py — reference:
@@ -405,6 +553,16 @@ class StaticFunction:
         self._cache: dict = {}
         self._abstract_args: dict = {}  # cache key -> ShapeDtypeStruct tree
         self._warmed_up = False
+        # persistent compile cache: an instance-pinned dir beats the
+        # process default (set_compile_cache_dir / PADDLE_TPU_COMPILE_CACHE).
+        # cache_key_extra folds caller context the shape-only spec key
+        # can't see — constants baked into the traced program (model
+        # config, pool geometry) — into the persistent key, so two
+        # functions with equal signatures but different closures never
+        # share an executable.
+        self._cache_dir = None if cache_dir is None else str(cache_dir)
+        self._cache_key_extra = ("" if cache_key_extra is None
+                                 else str(cache_key_extra))
         self.__name__ = getattr(function, "__name__", "static_fn")
         self.__doc__ = getattr(function, "__doc__", None)
 
@@ -455,12 +613,13 @@ class StaticFunction:
             _spec_key(spec, arrays, meta),
             tuple(l.training for l in self._layers),
         )
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = self._build(spec, tuple(meta))
-            self._cache[key] = compiled
         state_vals = _unalias([s.get() for s in self._slots], arrays)
         lr_vals = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._opts]
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(spec, tuple(meta), key,
+                                   (state_vals, lr_vals, list(arrays)))
+            self._cache[key] = compiled
         return compiled.jitted.lower(state_vals, lr_vals, list(arrays))
 
     # -- paddle API surface --------------------------------------------------
@@ -503,19 +662,43 @@ class StaticFunction:
         return out
 
     # -- compile -------------------------------------------------------------
-    def _build(self, spec, meta):
-        # every cache miss IS a compile event: counting here makes the
-        # "decode compiles exactly once" invariant a monitorable metric
-        # (paddle_tpu_jit_compiles_total{fn=...}), not just a test
-        # assertion — a recompile storm shows up on /metrics before it
-        # shows up as a latency cliff
+    def _resolve_cache_dir(self) -> Optional[str]:
+        return (self._cache_dir if self._cache_dir is not None
+                else get_compile_cache_dir())
+
+    def _persistent_key(self, key, example) -> str:
+        """The FULL persistent-cache key, as a stable string: everything
+        that shapes the executable's bytes or its calling convention.
+        Signature key (shapes/dtypes/weak_type of args, training flags),
+        state/lr avals, the function's code fingerprint and caller-
+        supplied extra, the donation policy, and the jax + device
+        fingerprint (a different jaxlib or device kind must miss)."""
+        state_vals, lr_vals, arrays = example
+        dev = jax.devices()[0]
+        state_avals = tuple((tuple(v.shape), str(v.dtype),
+                             bool(getattr(v, "weak_type", False)))
+                            for v in state_vals)
+        return repr((
+            self.__name__, _code_fingerprint(self._fn),
+            self._cache_key_extra, key, state_avals, len(lr_vals),
+            os.environ.get("PADDLE_TPU_NO_DONATE") == "1",
+            jax.__version__, jax.lib.__version__,
+            dev.platform, dev.device_kind,
+        ))
+
+    def _build(self, spec, meta, key=None, example=None):
+        # every signature-cache miss materializes ONE program, counted
+        # exactly once with its source: "fresh" paid a trace + XLA
+        # compile, "disk" deserialized a persisted executable (warm
+        # restart), "memory" reused another StaticFunction's build in
+        # this process (e.g. a second engine replica). The per-fn SUM
+        # across sources keeps the old one-inc-per-build meaning — the
+        # "decode compiles exactly once" invariant stays a monitorable
+        # metric (paddle_tpu_jit_compiles_total{fn,source}), and a
+        # recompile storm shows up on /metrics before it shows up as a
+        # latency cliff
         from ..metrics import get_registry
 
-        get_registry().counter(
-            "paddle_tpu_jit_compiles_total",
-            "XLA program compiles (one per new StaticFunction input "
-            "signature)", labels=("fn",),
-        ).labels(fn=self.__name__).inc()
         slots, opts, fn = self._slots, self._opts, self._fn
         holder = _Compiled(None)
 
@@ -541,6 +724,48 @@ class StaticFunction:
         # TPU-only — PADDLE_TPU_NO_DONATE=1 disables it as a bisect axis.
         donate = () if os.environ.get("PADDLE_TPU_NO_DONATE") == "1" else (0,)
         holder.jitted = jax.jit(_functional, donate_argnums=donate)
+        source = "fresh"
+        cache_dir = self._resolve_cache_dir()
+        if cache_dir is not None and example is not None:
+            full_key = self._persistent_key(key, example)
+            path = os.path.join(
+                cache_dir,
+                f"{self.__name__}-"
+                f"{hashlib.sha256(full_key.encode()).hexdigest()[:32]}"
+                ".jitcache")
+            ent = _MEMORY_CACHE.get(full_key)
+            if ent is not None:
+                holder.aot, holder.out_spec = ent
+                source = "memory"
+            else:
+                ent = _load_disk_entry(path, full_key)
+                if ent is not None:
+                    holder.aot, holder.out_spec = ent
+                    _MEMORY_CACHE[full_key] = ent
+                    source = "disk"
+                else:
+                    try:
+                        # AOT build so the executable is serializable;
+                        # the trace fires _functional, which captures
+                        # out_spec on `holder` as a side effect
+                        lowered = holder.jitted.lower(*example)
+                        holder.aot = lowered.compile()
+                        _MEMORY_CACHE[full_key] = (holder.aot,
+                                                   holder.out_spec)
+                        _store_disk_entry(path, full_key, holder.aot,
+                                          holder.out_spec)
+                    except Exception:
+                        # an unlowerable corner falls back to the plain
+                        # jax.jit path — correctness never depends on
+                        # the cache
+                        holder.aot = None
+        get_registry().counter(
+            "paddle_tpu_jit_compiles_total",
+            "XLA programs materialized into a StaticFunction signature "
+            "cache, by source: \"fresh\" paid an XLA compile, \"disk\" "
+            "loaded the persistent compile cache, \"memory\" reused a "
+            "process-wide build", labels=("fn", "source"),
+        ).labels(fn=self.__name__, source=source).inc()
         return holder
 
     # -- call ----------------------------------------------------------------
@@ -572,17 +797,32 @@ class StaticFunction:
             _spec_key(spec, arrays, meta),
             tuple(l.training for l in self._layers),
         )
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = self._build(spec, tuple(meta))
-            self._cache[key] = compiled
         state_vals = _unalias([s.get() for s in self._slots], arrays)
         lr_vals = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._opts]
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(spec, tuple(meta), key,
+                                   (state_vals, lr_vals, list(arrays)))
+            self._cache[key] = compiled
         self._abstract_args.pop(key, None)  # move-to-end: dict order = recency
         self._abstract_args[key] = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (state_vals, lr_vals, list(arrays)))
-        out_arrays, new_state = compiled.jitted(state_vals, lr_vals, arrays)
+        if compiled.aot is not None:
+            try:
+                out_arrays, new_state = compiled.aot(
+                    state_vals, lr_vals, arrays)
+            except Exception:
+                # an AOT calling-convention mismatch (aval drift the key
+                # missed) degrades to the jax.jit path for good — the
+                # signature check fails BEFORE execution, so the donated
+                # buffers are still intact for the retry
+                compiled.aot = None
+                out_arrays, new_state = compiled.jitted(
+                    state_vals, lr_vals, arrays)
+        else:
+            out_arrays, new_state = compiled.jitted(
+                state_vals, lr_vals, arrays)
         for slot, v in zip(self._slots, new_state):
             slot.set(v)
             slot.sanitize()

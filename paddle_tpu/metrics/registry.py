@@ -295,6 +295,26 @@ class _MetricFamily:
                 ".labels(...) first")
         return self._children[()]
 
+    def sum_labels(self, **kv) -> float:
+        """Aggregate ``value`` over every child whose labels match ``kv``
+        — a SUBSET of the declared labels, unlike :meth:`labels` which
+        demands the exact set. The partial-dimension read: e.g.
+        ``jit_compiles_total.sum_labels(fn="serving_step")`` totals the
+        fn across its ``source`` breakdown the way a family-level
+        ``value`` totals everything. Counters and gauges only (a
+        histogram child has no scalar ``value``)."""
+        unknown = set(kv) - set(self.label_names)
+        if unknown:
+            raise ValueError(
+                f"unknown labels {sorted(unknown)}; {self.name} declares "
+                f"{sorted(self.label_names)}")
+        want = {self.label_names.index(k): str(v) for k, v in kv.items()}
+        total = 0.0
+        for values, child in self._series():
+            if all(values[i] == v for i, v in want.items()):
+                total += child.value
+        return total
+
     def _series(self):
         with self._lock:
             return list(self._children.items())

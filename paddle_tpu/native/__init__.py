@@ -49,6 +49,13 @@ def _python_embed_flags() -> list:
 # per-library extra build flags
 _EXTRA_FLAGS = {
     "pd_inference_c": _python_embed_flags,
+    # shm_open/shm_unlink live in librt on glibc < 2.34. Without the
+    # explicit link the miss is invisible whenever some other loaded
+    # library (torch, notably) already pulled librt into the process —
+    # and fatal in fresh spawn children, where dlopen fails with
+    # "undefined symbol: shm_open" and DataLoader shm workers die on
+    # init. On glibc >= 2.34 librt is a stub, so the flag is harmless.
+    "shm_ring": lambda: ["-lrt"],
 }
 
 
@@ -106,8 +113,13 @@ def load_library(name: str) -> ctypes.CDLL:
                                     f"({src_path})")
         suffix = f".{san}.so" if san else ".so"
         out_path = os.path.join(_LIB, f"lib{name}{suffix}")
+        # rebuild when the .so is older than the source OR this builder:
+        # a flags change (e.g. a new _EXTRA_FLAGS entry) must invalidate
+        # cached artifacts just like a source edit does
+        stale_after = max(os.path.getmtime(src_path),
+                          os.path.getmtime(os.path.abspath(__file__)))
         if (not os.path.exists(out_path)
-                or os.path.getmtime(out_path) < os.path.getmtime(src_path)):
+                or os.path.getmtime(out_path) < stale_after):
             # pass the resolved mode: flags and filename must come from
             # the SAME read (a mislabeled cached .so would silently
             # report "clean" in every future sanitizer run)

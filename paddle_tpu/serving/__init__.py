@@ -38,10 +38,11 @@ from .kv_cache import (PagedKVCachePool, PrefixCache, page_bytes,
 from .router import EngineHandle, NoHealthyEngineError, Router
 from .scheduler import (BackpressureError, FCFSScheduler, Request,
                         RequestOutput)
+from .spec import NGramDrafter
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "PrefixCache", "FCFSScheduler",
     "Request", "RequestOutput", "CompletionAPI", "EnginePool",
     "BackpressureError", "Router", "EngineHandle", "NoHealthyEngineError",
-    "page_bytes", "pages_for_hbm_budget",
+    "NGramDrafter", "page_bytes", "pages_for_hbm_budget",
 ]

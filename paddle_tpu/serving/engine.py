@@ -15,10 +15,13 @@ Each engine step
 2. **plans** the step's token mix under a fixed ``token_budget``: decode
    tokens charged first (decode-first under load), prompt chunks sliced
    to fill the remainder in SLO order (priority tier, earliest deadline,
-   arrival) — a 10k-token prompt admits immediately and trickles in
-   without ever displacing a decoding tenant's next token,
+   arrival), then — with ``spec_k > 0`` — speculative draft rows from
+   whatever budget is left (``scheduler.plan_drafts``) — a 10k-token
+   prompt admits immediately and trickles in without ever displacing a
+   decoding tenant's next token,
 3. runs the **unified compiled step**: every query token of the step —
-   decode tokens and chunk tokens alike — is one row of a flattened
+   decode tokens, chunk tokens, and draft tokens alike — is one row of a
+   flattened
    ``[T, ...]`` grid (ops/pallas/paged_attention.py "Ragged form"), with
    per-row block tables and absolute positions riding as DATA. ``T`` is
    bucketed (the slot grid when the step fits it, powers of two above),
@@ -63,6 +66,7 @@ from __future__ import annotations
 import inspect
 import itertools
 import time
+import warnings
 from typing import Dict, List, Optional
 
 import jax
@@ -75,6 +79,7 @@ from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
 from .kv_cache import PagedKVCachePool, PrefixCache
 from .scheduler import FCFSScheduler, Request, RequestOutput
+from .spec import NGramDrafter
 
 __all__ = ["ServingEngine"]
 
@@ -188,13 +193,28 @@ class ServingEngine:
                  token_budget: int = 1024,
                  prefill_token_budget: Optional[int] = None,
                  min_step_tokens: Optional[int] = None,
-                 kv_dtype=jnp.float32, seed: int = 0,
+                 kv_dtype=jnp.float32, seed: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  watchdog_stall_s: Optional[float] = 30.0,
                  watchdog_recovery_steps: int = 3,
                  engine_id: Optional[str] = None,
                  model_id: str = "default",
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 drafter=None,
+                 compile_cache_dir: Optional[str] = None):
+        if seed is not None:
+            # dead since the per-request determinism contract landed:
+            # sampling keys derive from fold_in(PRNGKey(req.seed), pos)
+            # inside the compiled step, so this arg seeds NOTHING —
+            # accepting it silently lets callers believe they pinned
+            # reproducibility through a knob that does not exist
+            warnings.warn(
+                "ServingEngine(seed=...) is deprecated and has no "
+                "effect: sampling is keyed per request via "
+                "Request.seed (add_request(seed=...)); drop the "
+                "constructor argument (docs/SERVING.md \"Seeds and "
+                "determinism\")", DeprecationWarning, stacklevel=2)
         self.model = model
         model.eval()
         # identity labels: every per-engine serving series carries
@@ -228,6 +248,31 @@ class ServingEngine:
         # cheaper slot-grid shape and mixed steps bucket up.
         self.min_step_tokens = (None if min_step_tokens is None
                                 else int(min_step_tokens))
+        # speculative decoding (docs/SERVING.md "Speculative decoding"):
+        # spec_k > 0 arms a host-side drafter that proposes up to k
+        # tokens per decoding slot; the unified step scores them as
+        # extra grid rows (data, like chunk rows — zero new compiled
+        # programs) and the accept/reject below is an exact-match
+        # against the per-position sampled targets, so streams are
+        # bit-identical with speculation on or off. A custom `drafter`
+        # (anything with propose(ids, k) -> np.ndarray) overrides the
+        # built-in NGramDrafter.
+        self.spec_k = max(int(spec_k), 0)
+        if drafter is not None:
+            self.drafter = drafter
+            self.spec_k = max(self.spec_k, 1)
+        elif self.spec_k > 0:
+            self.drafter = NGramDrafter(k=self.spec_k,
+                                        max_ngram=int(spec_ngram))
+        else:
+            self.drafter = None
+        # sample-grid width: every slot owns spec_k+1 sample rows (base
+        # token + drafts); a fixed per-engine constant so the compiled
+        # step's signature never varies with how many drafts a given
+        # step actually carries
+        self._spec_rows = self.spec_k + 1
+        self._compile_cache_dir = (None if compile_cache_dir is None
+                                   else str(compile_cache_dir))
         self.pages_per_seq = -(-self.max_model_len // self.page_size)
         if num_pages is None:
             num_pages = self.max_batch_slots * self.pages_per_seq + 1
@@ -305,11 +350,28 @@ class ServingEngine:
         self._m_mix = reg.histogram(
             "paddle_tpu_serving_step_mix",
             "Per-step token split of the unified step: tokens of each "
-            "kind (decode vs prefill chunk) the step carried",
-            labels=("kind",) + _eng)
+            "kind (decode, prefill chunk, speculative draft) the step "
+            "carried", labels=("kind",) + _eng)
         self._m_mix_decode = self._m_mix.labels(kind="decode", **self._lbl)
         self._m_mix_prefill = self._m_mix.labels(kind="prefill",
                                                  **self._lbl)
+        self._m_mix_draft = self._m_mix.labels(kind="draft", **self._lbl)
+        # speculative-decoding instruments: acceptance is THE health
+        # number (accepted/drafted ~ how much free throughput the
+        # drafter is buying; near 0 means drafts are wasted grid rows)
+        self._m_spec_drafted = reg.counter(
+            "paddle_tpu_serving_spec_drafted_tokens_total",
+            "Draft tokens proposed by the speculative drafter and scored "
+            "as extra unified-step rows", labels=_eng).labels(**self._lbl)
+        self._m_spec_accepted = reg.counter(
+            "paddle_tpu_serving_spec_accepted_tokens_total",
+            "Draft tokens accepted (exact match against the per-position "
+            "sampled target); the rest rolled back by KV truncation",
+            labels=_eng).labels(**self._lbl)
+        self._m_spec_accept = reg.histogram(
+            "paddle_tpu_serving_spec_acceptance_ratio",
+            "Per-burst acceptance: accepted/drafted for each decode step "
+            "that carried draft rows", labels=_eng).labels(**self._lbl)
         self._m_chunk = reg.histogram(
             "paddle_tpu_serving_prefill_chunk_tokens",
             "Tokens per prompt chunk the scheduler sliced under the step "
@@ -860,9 +922,12 @@ class ServingEngine:
         - ``tok_pos`` [T] — each row's absolute position,
         - ``tok_bt`` [T, pages_per_seq] — each row's OWNER's block table
           (a chunk repeats its slot's table row per token),
-        - ``last_row`` [B] — grid row of each slot's LAST token (where
-          its sample reads logits; 0 for idle slots, discarded on host),
-        - ``sample_pos`` [B] — the position that keys each slot's sample,
+        - ``sample_rows`` [B, S] — grid rows where each slot's samples
+          read logits (S = spec_k+1: the slot's last/chunk-final token
+          plus its draft rows; column 0 is the pre-speculation
+          ``last_row``, unused columns and idle slots point at row 0 and
+          are discarded on host),
+        - ``sample_pos`` [B, S] — the positions that key each sample,
         - ``temps``/``seeds`` [B] — per-slot sampling params,
         - ``*flat_pools`` — the paged KV pools, consumed and returned
           functionally.
@@ -872,14 +937,19 @@ class ServingEngine:
         the whole ragged trick (ops/pallas/paged_attention.py "Ragged
         form"): each layer scatters ALL T rows' KV into the pool first,
         then gathers per-row attention masked at the row's own position,
-        so chunk tokens causally see their chunk-mates and decode rows
-        are untouched by them. Sampling gathers the B slot rows BEFORE
-        the vocab matmul (the [V] projection runs on B rows, not T) and
-        derives per-slot keys fold_in(PRNGKey(seed), sample_pos) — the
-        _sample_key contract, traced."""
+        so chunk tokens causally see their chunk-mates, decode rows are
+        untouched by them, and a DRAFT row at position p+j attends the
+        KV its burst-mates scattered this very step — speculation's
+        in-step causality for free. Sampling gathers the B*S sample
+        rows BEFORE the vocab matmul (the [V] projection runs on B*S
+        rows, not T) and derives per-row keys
+        fold_in(PRNGKey(seed), sample_pos) — the _sample_key contract,
+        traced: a draft row's target at position p+j is EXACTLY the
+        token the stream would sample there without speculation, which
+        is why acceptance-by-equality preserves bit-identical streams."""
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
 
-        def step_fn(tok, tok_pos, tok_bt, last_row, sample_pos, temps,
+        def step_fn(tok, tok_pos, tok_bt, sample_rows, sample_pos, temps,
                     seeds, *flat_pools):
             caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
                       for i in range(n_layers)]
@@ -888,10 +958,10 @@ class ServingEngine:
                                                   caches)
                 # per-slot sample rows gathered BEFORE the vocab matmul:
                 # the grid carries up to token-budget rows but only
-                # max_batch_slots of them sample
+                # max_batch_slots * (spec_k+1) of them sample
                 last_h = apply_op(
-                    lambda h, li: h[li.astype(jnp.int32)],
-                    [ensure_tensor(hidden), ensure_tensor(last_row)],
+                    lambda h, li: h[li.reshape(-1).astype(jnp.int32)],
+                    [ensure_tensor(hidden), ensure_tensor(sample_rows)],
                     name="gather_sample_rows")
                 logits = model.logits(last_h)
             last = apply_op(lambda lv: lv[:, -1, :].astype(jnp.float32),
@@ -908,16 +978,24 @@ class ServingEngine:
                 [last], name="logits_finite")
 
             def batched_sample(lv, tv, sv, pv):
-                # per-slot key = fold_in(PRNGKey(seed), position) — the
+                # per-row key = fold_in(PRNGKey(seed), position) — the
                 # _sample_key contract, traced: each request samples
                 # from ITS OWN stream, so its tokens are a pure function
                 # of (prompt, seed, temperature) no matter which
                 # batch-mates ride the grid, how its prompt was chunked,
                 # or which engine runs it. seeds and positions are DATA:
-                # no recompile, and an idle slot's (0, 0) key samples
-                # masked garbage that the host discards as before.
+                # no recompile, and an idle sample row's (0, 0) key
+                # samples masked garbage that the host discards as
+                # before. lv is [B*S, V]; temps/seeds broadcast across
+                # each slot's S sample rows (one request, one stream),
+                # positions arrive per row — a draft row at p+j samples
+                # with the SAME key the plain decode at p+j would use.
+                S = pv.shape[1]
+                tvf = jnp.repeat(tv.astype(jnp.float32), S)
+                svf = jnp.repeat(sv, S)
+                pvf = pv.reshape(-1)
                 greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
-                t = jnp.maximum(tv.astype(jnp.float32), 1e-6)
+                t = jnp.maximum(tvf, 1e-6)
 
                 def one_row(seed_i, pos_i, row):
                     key = jax.random.fold_in(jax.random.PRNGKey(seed_i),
@@ -925,8 +1003,8 @@ class ServingEngine:
                     return jax.random.categorical(key, row)
 
                 sampled = jax.vmap(one_row)(
-                    sv, pv, lv / t[:, None]).astype(jnp.int32)
-                return jnp.where(tv > 0, sampled, greedy)
+                    svf, pvf, lv / t[:, None]).astype(jnp.int32)
+                return jnp.where(tvf > 0, sampled, greedy)
 
             nxt = apply_op(batched_sample,
                            [last, ensure_tensor(temps),
@@ -937,10 +1015,21 @@ class ServingEngine:
 
         # "the step compiles once per bucket" becomes monitorable:
         # jit_compiles_total{fn="serving_step"} must pin at the
-        # bucket-set size
+        # bucket-set size. cache_key_extra folds the model architecture
+        # and pool geometry into the persistent compile-cache key:
+        # config values are baked into the traced program as CONSTANTS,
+        # invisible to the shape-only spec key, so two engines whose
+        # pools merely have equal shapes must not share an executable.
         step_fn.__name__ = "serving_step"
+        cfg = self.model.config
+        extra = repr((type(self.model).__name__, sorted(
+            (k, v) for k, v in vars(cfg).items()
+            if isinstance(v, (bool, int, float, str, type(None)))),
+            self.page_size, self.pages_per_seq, self._spec_rows))
         return jit.StaticFunction(step_fn, observe=[self.model],
-                                  warmup=False, dy2static=False)
+                                  warmup=False, dy2static=False,
+                                  cache_dir=self._compile_cache_dir,
+                                  cache_key_extra=extra)
 
     def _step_once(self) -> List[RequestOutput]:
         t0 = time.perf_counter()
@@ -957,27 +1046,78 @@ class ServingEngine:
                 decode_idx.append(i)
         chunks = self.scheduler.plan_chunks(len(decode_idx), prefill_info)
 
+        # speculative drafts ride the budget's LEFTOVER only — charged
+        # strictly after decode tokens and prompt chunks, so speculation
+        # can never displace a running stream's next token or slow a
+        # prefill (scheduler.plan_drafts splits the remainder in the
+        # same SLO order as chunks). Each slot's draft count is further
+        # capped so the burst can never overrun max_new_tokens (the base
+        # decode emits >= 1, hence remaining-1) or the request's page
+        # reservation / context window.
+        drafts: Dict[int, np.ndarray] = {}
+        if self.drafter is not None and decode_idx:
+            leftover = (self.token_budget - len(decode_idx)
+                        - sum(c for _, c in chunks))
+            if leftover > 0:
+                wants = []
+                for i in decode_idx:
+                    st = self.slots[i]
+                    limit = min(
+                        int(st.req.prompt.size) + int(st.req.max_new_tokens),
+                        self.max_model_len)
+                    cap = min(self.spec_k,
+                              int(st.req.max_new_tokens) - len(st.gen) - 1,
+                              limit - (st.pos + 1))
+                    if cap > 0:
+                        wants.append((i, cap, st.req))
+                for i, d in self.scheduler.plan_drafts(leftover, wants):
+                    st = self.slots[i]
+                    # the full stream so far: prompt + gen covers a
+                    # migrated request too (gen is journal-seeded), so
+                    # drafting is migration-invariant like sampling
+                    prop = self.drafter.propose(
+                        np.concatenate([st.req.prompt,
+                                        np.asarray(st.gen, np.int32)]), d)
+                    prop = np.asarray(prop, np.int32).reshape(-1)[:d]
+                    if prop.size:
+                        drafts[i] = prop
+
         # KV room per slot BEFORE the compiled step: decode rows reserve
-        # this step's one write via extend() (not append_token — a step
-        # aborted after this loop re-reserves the SAME position on retry
-        # instead of drifting _lens one phantom token per aborted step);
-        # chunk rows reserve their whole range via extend_write (CoW
-        # seam included). Out of pages (impossible unless injected/
+        # this step's writes via extend()/extend_write() (not
+        # append_token — a step aborted after this loop re-reserves the
+        # SAME positions on retry instead of drifting _lens one phantom
+        # token per aborted step); a slot with draft rows reserves the
+        # whole burst range like a chunk does (CoW seam included —
+        # rejected drafts roll back by pool.truncate, which relies on
+        # this exclusivity); chunk rows reserve their whole range via
+        # extend_write. Out of pages (impossible unless injected/
         # buggy): quarantine the victim, keep the rest of the batch —
         # its row simply never joins the grid.
-        rows = []  # (slot, token ids [c], positions [c], is_chunk)
+        rows = []  # (slot, token ids [c], positions [c], is_chunk, n_draft)
+        n_decode_tokens = 0
+        n_draft_tokens = 0
         for i in decode_idx:
             st = self.slots[i]
+            d_toks = drafts.get(i)
+            d = 0 if d_toks is None else int(d_toks.size)
             try:
-                self.pool.extend(st.req.req_id, st.pos + 1)
+                if d:
+                    self.pool.extend_write(st.req.req_id, st.pos,
+                                           st.pos + 1 + d)
+                else:
+                    self.pool.extend(st.req.req_id, st.pos + 1)
             except Exception as e:
                 finished.append(
                     self._retire_abnormal(st, slot=i, reason="error",
                                           error=e))
                 continue
-            rows.append((i, np.asarray([st.last_token], np.int32),
-                         np.asarray([st.pos], np.int32), False))
-        n_decode_tokens = len(rows)
+            toks = (np.concatenate([[st.last_token], d_toks]).astype(np.int32)
+                    if d else np.asarray([st.last_token], np.int32))
+            rows.append((i, toks,
+                         np.arange(st.pos, st.pos + 1 + d, dtype=np.int32),
+                         False, d))
+            n_decode_tokens += 1
+            n_draft_tokens += d
         for i, c in chunks:
             st = self.slots[i]
             try:
@@ -989,30 +1129,38 @@ class ServingEngine:
                 continue
             rows.append((i, st.ids[st.pos:st.pos + c],
                          np.arange(st.pos, st.pos + c, dtype=np.int32),
-                         True))
+                         True, 0))
         faults.point("serving.decode_step")
         if not rows:
             return finished
         total = sum(r[1].size for r in rows)
         T = self._grid_tokens(total)
         self._grid_buckets_seen.add(T)
+        S = self._spec_rows
         tok = np.zeros((T, 1), np.int32)
         tok_pos = np.zeros(T, np.int32)
         tok_bt = np.zeros((T, self.pages_per_seq), np.int32)
-        last_row = np.zeros(B, np.int32)
-        sample_pos = np.zeros(B, np.int32)
+        sample_rows = np.zeros((B, S), np.int32)
+        sample_pos = np.zeros((B, S), np.int32)
         temps = np.zeros(B, np.float32)
         seeds = np.zeros(B, np.int32)
         cur = 0
-        for i, toks, poss, _is_chunk in rows:
+        for i, toks, poss, is_chunk, d in rows:
             st = self.slots[i]
             c = toks.size
             tok[cur:cur + c, 0] = toks
             tok_pos[cur:cur + c] = poss
             table = self.pool.block_table(st.req.req_id)
             tok_bt[cur:cur + c, :len(table)] = table
-            last_row[i] = cur + c - 1
-            sample_pos[i] = int(poss[-1])
+            if is_chunk:
+                sample_rows[i, 0] = cur + c - 1
+                sample_pos[i, 0] = int(poss[-1])
+            else:
+                # base decode row + its d draft rows are contiguous:
+                # sample column j targets position pos+j, i.e. the token
+                # FOLLOWING the j-th burst token
+                sample_rows[i, :d + 1] = np.arange(cur, cur + d + 1)
+                sample_pos[i, :d + 1] = poss
             temps[i] = st.req.temperature
             seeds[i] = st.req.seed
             cur += c
@@ -1021,7 +1169,7 @@ class ServingEngine:
                 "serving.compile_step", self._make_step)
         res = self._step_prog(
             Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(tok_pos)),
-            Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(last_row)),
+            Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(sample_rows)),
             Tensor(jnp.asarray(sample_pos)), Tensor(jnp.asarray(temps)),
             Tensor(jnp.asarray(seeds)),
             *[p for i in range(self.n_layers)
@@ -1029,14 +1177,16 @@ class ServingEngine:
         nxt, fin, flat = res[0], res[1], res[2:]
         self.pool.set_arrays([flat[2 * i] for i in range(self.n_layers)],
                              [flat[2 * i + 1] for i in range(self.n_layers)])
-        nxt_host = np.asarray(nxt.numpy()).reshape(B)
-        fin_host = np.asarray(fin.numpy()).reshape(B).astype(bool)
+        nxt_host = np.asarray(nxt.numpy()).reshape(B, S)
+        fin_host = np.asarray(fin.numpy()).reshape(B, S).astype(bool)
         now = time.perf_counter()
         self._m_decode.observe(now - t0)
         self._m_mix_decode.observe(n_decode_tokens)
-        self._m_mix_prefill.observe(total - n_decode_tokens)
+        self._m_mix_draft.observe(n_draft_tokens)
+        self._m_mix_prefill.observe(total - n_decode_tokens
+                                    - n_draft_tokens)
 
-        for i, toks, poss, is_chunk in rows:
+        for i, toks, poss, is_chunk, d in rows:
             st = self.slots[i]
             if st is None:
                 # an earlier row's callback cancelled THIS slot's
@@ -1044,7 +1194,8 @@ class ServingEngine:
                 # double-free its pages (no admission runs mid-step, so
                 # a non-None slot is still the row's own state)
                 continue
-            if not fin_host[i]:
+            n_sample = 1 if is_chunk else d + 1
+            if not fin_host[i, :n_sample].all():
                 # NaN/inf logits on the slot's sample row: quarantine
                 # ONLY this sequence — its sampled token is garbage and
                 # is never appended (for a chunk, the KV it wrote is as
@@ -1084,15 +1235,43 @@ class ServingEngine:
                 if not st.req.resume_tokens:
                     # a resumed request's first token landed long ago
                     self._m_ttft.observe(now - st.req.arrival_t)
-            else:
+                out = self._land_token(st, slot=i,
+                                       token=int(nxt_host[i, 0]), now=now)
+                if out is not None:
+                    finished.append(out)
+                continue
+            # decode burst: sample column j holds the stream's token at
+            # position pos+j+1 — the EXACT token a plain decode would
+            # sample there (same fold_in key, same logits given the same
+            # prefix). Accept the longest prefix of drafts that equals
+            # those targets, then land accepted drafts' targets plus the
+            # free "bonus" token from the first mismatching (or final)
+            # column. Rejected draft rows wrote KV for tokens the stream
+            # never took: roll the pool length back BEFORE landing (a
+            # landed token may retire the request and free its pages).
+            targets = nxt_host[i, :d + 1]
+            a = 0
+            while a < d and int(toks[a + 1]) == int(targets[a]):
+                a += 1
+            if d:
+                self._m_spec_drafted.inc(d)
+                self._m_spec_accepted.inc(a)
+                self._m_spec_accept.observe(a / d)
+                if a < d:
+                    self.pool.truncate(st.req.req_id, st.pos + a + 1)
+            for t in targets[:a + 1]:
                 st.pos += 1
                 # per-sequence inter-token latency: the streaming SLO —
                 # step time plus any step this sequence sat through
+                # (accepted drafts land with near-zero gaps: speculation
+                # collapses ITL, which is the whole point)
                 self._m_itl.observe(now - st.t_last)
-            out = self._land_token(st, slot=i, token=int(nxt_host[i]),
-                                   now=now)
-            if out is not None:
-                finished.append(out)
+                out = self._land_token(st, slot=i, token=int(t), now=now)
+                if out is not None:
+                    finished.append(out)
+                    break
+                if self.slots[i] is not st:
+                    break  # reentrant cancel inside the stream callback
         return finished
 
     def _land_token(self, st: _SeqState, slot: int, token: int,

@@ -337,6 +337,27 @@ class PagedKVCachePool:
                         (total - 1) // self.page_size + 1):
             self._ensure_page_writable(seq_id, pi)
 
+    def truncate(self, seq_id, total_tokens: int) -> None:
+        """Roll ``seq_id``'s KV length back to ``total_tokens`` — the
+        speculative-decoding reject path: draft rows past the accepted
+        prefix wrote KV for tokens that were never committed, and
+        lowering ``_lens`` is ALL the rollback there is. The pages stay
+        in the table (they sit inside the admission-time reservation, so
+        nothing else can claim them) and their stale bytes are inert:
+        paged attention masks every row at its own position, so KV past
+        the sequence length is never gathered, and the next committed
+        write at those positions scatters right over it. Refcounts are
+        untouched — the rejected range was already made exclusively
+        owned by the :meth:`extend_write` that reserved it, and a CoW'd
+        page stays correctly owned for the retry."""
+        total = int(total_tokens)
+        cur = self._lens[seq_id]
+        if total < 0 or total > cur:
+            raise ValueError(
+                f"truncate({seq_id!r}, {total}) outside [0, {cur}] — "
+                f"rollback can only shorten a sequence")
+        self._lens[seq_id] = total
+
     def _ensure_writable(self, seq_id, token_pos: int) -> None:
         """Copy-on-write: if the page holding ``token_pos`` is shared
         (refcount > 1 — a fork sibling or the prefix cache also holds

@@ -638,8 +638,8 @@ class Router:
 
         The restore is IN-PLACE (``set_state_dict``), so the compiled
         decode step sees the new weights as data: no recompile, and
-        ``paddle_tpu_jit_compiles_total{fn="serving_decode"}`` stays at
-        one compile per engine across the push. A canary that retires
+        ``paddle_tpu_jit_compiles_total{fn="serving_step"}`` stays at
+        one compile per bucket per engine across the push. A canary that retires
         ``nan``/``error`` marks that engine ``down`` (bad checkpoint never
         re-enters rotation) and the push continues; the summary reports
         per-engine results. Accepts a ``capture_train_state``-shaped state

@@ -422,3 +422,37 @@ class FCFSScheduler:
             plan.append((key, chunk))
             left -= chunk
         return plan
+
+    def plan_drafts(self, leftover: int,
+                    wants: Sequence[Tuple[object, int, Request]]
+                    ) -> List[Tuple[object, int]]:
+        """Allocate speculative draft rows from the budget this step
+        would otherwise leave idle. ``leftover`` is what remains AFTER
+        decode tokens and prompt chunks are charged — speculation is
+        strictly opportunistic: it never displaces a decoding tenant's
+        next token (decode-first) nor a prompt chunk (prefill progress
+        bounds TTFT; a rejected draft row is worthless next to it). The
+        leftover splits in the same SLO order as :meth:`plan_chunks`
+        (priority tier, earliest deadline, arrival), so when drafts must
+        be rationed the latency-bounded streams speculate first.
+        ``wants`` is ``[(key, max_draft_tokens, request)]``; returns
+        ``[(key, granted)]`` with granted >= 1."""
+        left = max(int(leftover), 0)
+        plan: List[Tuple[object, int]] = []
+        if left <= 0 or not wants:
+            return plan
+        order = sorted(
+            wants,
+            key=lambda e: (e[2].priority,
+                           e[2].deadline.remaining()
+                           if e[2].deadline is not None else math.inf,
+                           e[2].arrival_t))
+        for key, want, _req in order:
+            if left <= 0:
+                break
+            d = min(int(want), left)
+            if d <= 0:
+                continue
+            plan.append((key, d))
+            left -= d
+        return plan

@@ -1,0 +1,74 @@
+"""Host-side draft proposers for speculative decoding on the unified step.
+
+Speculative decoding (docs/SERVING.md "Speculative decoding") needs a
+cheap guess at the next few tokens of a stream; the engine then scores
+the guesses as EXTRA ROWS of the very same compiled ragged step it runs
+anyway, accepts the matching prefix, and rolls the KV length back over
+the rest. The drafter is pure host-side numpy — it never touches the
+compiled program, so speculation adds ZERO compiled signatures.
+
+:class:`NGramDrafter` is the reference-free baseline (the "prompt
+lookup" family): the best predictor of a stream that repeats itself is
+the stream itself. It suffix-matches the last ``n`` tokens of the
+request's (prompt + generated) ids against every earlier occurrence and
+proposes the continuation of the LATEST match. Great on code, quoting,
+templated text, and any decode loop that has settled into a cycle;
+harmless elsewhere — a wrong draft costs one discarded grid row, never
+a wrong token (acceptance is exact-match against the per-position
+sampled targets, see the engine's determinism contract).
+
+Custom drafters only need ``propose(ids, k) -> np.ndarray`` (up to ``k``
+int32 draft tokens, possibly empty); the engine treats the proposal as
+untrusted either way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NGramDrafter"]
+
+_EMPTY = np.empty(0, np.int32)
+
+
+class NGramDrafter:
+    """Propose draft tokens by n-gram suffix match over the stream itself.
+
+    ``max_ngram`` bounds the match length tried (longest first — a longer
+    matched suffix is stronger evidence the continuation will repeat);
+    ``min_ngram`` the shortest worth acting on. ``k`` is the default
+    proposal cap; the engine passes its own per-call cap (budget- and
+    length-limited) which takes precedence.
+    """
+
+    def __init__(self, k: int = 4, max_ngram: int = 3, min_ngram: int = 1):
+        self.k = int(k)
+        self.max_ngram = max(int(max_ngram), 1)
+        self.min_ngram = max(int(min_ngram), 1)
+        if self.min_ngram > self.max_ngram:
+            raise ValueError(
+                f"min_ngram {self.min_ngram} > max_ngram {self.max_ngram}")
+
+    def propose(self, ids: np.ndarray, k: int | None = None) -> np.ndarray:
+        """Up to ``k`` draft tokens continuing ``ids`` (the request's
+        prompt + generated stream), or an empty array when no suffix of
+        length >= min_ngram recurs. Pure and stateless: proposals depend
+        only on ``ids``, so a migrated request drafts identically on its
+        adoptive engine."""
+        k = self.k if k is None else int(k)
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        n_total = ids.size
+        if k <= 0 or n_total < self.min_ngram + 1:
+            return _EMPTY
+        for n in range(min(self.max_ngram, n_total - 1),
+                       self.min_ngram - 1, -1):
+            suffix = ids[n_total - n:]
+            # all length-n windows that could be followed by >= 1 token
+            windows = np.lib.stride_tricks.sliding_window_view(
+                ids[:n_total - 1], n)
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            if hits.size == 0:
+                continue
+            # the LATEST earlier occurrence: recent context beats stale
+            start = int(hits[-1]) + n
+            return ids[start:start + k].copy()
+        return _EMPTY

@@ -1,5 +1,5 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-13 — engine resilience, router failover/reload/dispatch, the
+1-14 — engine resilience, router failover/reload/dispatch, the
 kill-engine-mid-decode migration drill, the prefix-heavy failover
 drill that asserts migrated requests re-prefill through the adoptive
 sibling's prefix cache, the kill-engine-mid-chunked-prefill drill
@@ -8,8 +8,11 @@ journaled chunk boundary via the sibling's cache, and the
 thread-fuzz-control-plane drill that races driver/scraper/prober
 threads over 200 seeded barrier-synced iterations under
 ``faults.LockSanitizer`` and requires zero lock-discipline
-violations) runs as slow-marked tests instead of only by hand, one
-test per scenario so a regression names its drill.
+violations, and the kill-engine-mid-spec-burst drill that kills a
+speculatively-decoding engine and asserts migration journals carry
+only committed tokens — never unaccepted drafts — with streams
+bit-identical to a spec-off run) runs as slow-marked tests instead of
+only by hand, one test per scenario so a regression names its drill.
 
 The scenarios are imported from the tool itself — one source of truth;
 this file adds only pytest plumbing (module load, shared model, fault

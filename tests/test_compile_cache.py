@@ -1,0 +1,326 @@
+"""Persistent on-disk compile cache (ISSUE 14 tentpole b).
+
+StaticFunction serializes every built executable to
+``<cache_dir>/<name>-<sha>.jitcache``, keyed by (fn name, bytecode
+fingerprint, caller extra, input-signature key, state avals, jax +
+device fingerprint); ``_build`` consults memory -> disk -> fresh XLA
+and ``paddle_tpu_jit_compiles_total{fn,source}`` records where each
+materialization came from. Properties under test: streams are
+bit-identical whatever the source; a corrupt or truncated entry falls
+back to a fresh compile instead of crashing; the key changes when the
+traced code changes (a stale entry is never served); the cache is OFF
+unless a dir is configured; and a second process — or a restarted
+engine fleet behind the Router — starts from disk with zero fresh
+compiles.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, metrics
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import Router, ServingEngine
+
+pytestmark = pytest.mark.serving
+
+_SOURCES = ("fresh", "disk", "memory")
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+
+
+def _src(source, fn=None):
+    fam = metrics.get_registry().get("paddle_tpu_jit_compiles_total")
+    if fam is None:
+        return 0.0
+    kv = {"source": source}
+    if fn is not None:
+        kv["fn"] = fn
+    return fam.sum_labels(**kv)
+
+
+def _srcs(fn=None):
+    return {s: _src(s, fn) for s in _SOURCES}
+
+
+def _delta(before, fn=None):
+    now = _srcs(fn)
+    return {s: int(now[s] - before[s]) for s in _SOURCES}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache_layers():
+    """The memory layer is process-global and keyed independently of the
+    cache dir — clear it around every test so one test's entries can't
+    satisfy another's lookups, and always restore the disabled default."""
+    jit.clear_compile_cache(memory=True)
+    yield
+    jit.set_compile_cache_dir(None)
+    jit.clear_compile_cache(memory=True)
+
+
+# ───────────────────── StaticFunction-level hygiene ─────────────────────
+
+
+def _double_plus_one(x):
+    return x * 2.0 + 1.0
+
+
+def _double_plus_three(x):
+    return x * 2.0 + 3.0
+
+
+def _sf(fn, cache_dir=None, extra=None):
+    return jit.StaticFunction(fn, warmup=False, dy2static=False,
+                              cache_dir=cache_dir, cache_key_extra=extra)
+
+
+def test_dir_resolution_precedence(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", "/env/dir")
+    assert jit.get_compile_cache_dir() == "/env/dir"
+    jit.set_compile_cache_dir(str(tmp_path))
+    assert jit.get_compile_cache_dir() == str(tmp_path)
+    jit.set_compile_cache_dir(None)
+    monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE")
+    assert jit.get_compile_cache_dir() is None
+
+
+def test_disabled_by_default_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE", raising=False)
+    before = _srcs()
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out = _sf(_double_plus_one)(x).numpy()
+    np.testing.assert_allclose(out, np.arange(4) * 2.0 + 1.0)
+    assert _delta(before) == {"fresh": 1, "disk": 0, "memory": 0}
+    assert list(tmp_path.iterdir()) == []  # nothing leaked to disk
+
+
+def test_fresh_then_memory_then_disk_progression(tmp_path):
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    want = np.arange(4) * 2.0 + 1.0
+    before = _srcs()
+    np.testing.assert_allclose(
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x).numpy(), want)
+    assert _delta(before) == {"fresh": 1, "disk": 0, "memory": 0}
+    files = list(tmp_path.glob("*.jitcache"))
+    assert len(files) == 1  # the executable landed on disk
+
+    # a sibling StaticFunction of the same code: memory layer, no build
+    np.testing.assert_allclose(
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x).numpy(), want)
+    assert _delta(before)["memory"] == 1
+
+    # cold-process simulation: drop memory, next build loads from disk
+    jit.clear_compile_cache(memory=True)
+    np.testing.assert_allclose(
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x).numpy(), want)
+    d = _delta(before)
+    assert d == {"fresh": 1, "disk": 1, "memory": 1}
+
+
+@pytest.mark.parametrize("corruption", ["garbage", "truncated", "wrong_key"])
+def test_corrupt_entry_falls_back_to_fresh(tmp_path, corruption):
+    """A damaged cache file must cost one recompile, never a crash —
+    and the recompile overwrites it with a good entry."""
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    want = np.arange(4) * 2.0 + 1.0
+    _sf(_double_plus_one, cache_dir=str(tmp_path))(x)
+    [path] = tmp_path.glob("*.jitcache")
+    if corruption == "garbage":
+        path.write_bytes(b"\x00not a pickle")
+    elif corruption == "truncated":
+        path.write_bytes(path.read_bytes()[:20])
+    else:  # well-formed pickle whose stored key doesn't match
+        path.write_bytes(pickle.dumps({"key": "stale", "payload": b""}))
+    jit.clear_compile_cache(memory=True)
+    before = _srcs()
+    np.testing.assert_allclose(
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x).numpy(), want)
+    assert _delta(before) == {"fresh": 1, "disk": 0, "memory": 0}
+    # the fresh build re-stored a loadable entry
+    jit.clear_compile_cache(memory=True)
+    np.testing.assert_allclose(
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x).numpy(), want)
+    assert _delta(before)["disk"] == 1
+
+
+def test_code_change_changes_key_never_serves_stale(tmp_path):
+    """Same name + same signature but different bytecode must miss: a
+    cache hit here would silently run last deploy's program."""
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    v2 = _double_plus_three
+    assert v2.__name__ != _double_plus_one.__name__
+    v2.__name__ = _double_plus_one.__name__  # collide everything but code
+    try:
+        _sf(_double_plus_one, cache_dir=str(tmp_path))(x)
+        jit.clear_compile_cache(memory=True)
+        before = _srcs()
+        out = _sf(v2, cache_dir=str(tmp_path))(x).numpy()
+        np.testing.assert_allclose(out, np.arange(4) * 2.0 + 3.0)
+        assert _delta(before) == {"fresh": 1, "disk": 0, "memory": 0}
+        assert len(list(tmp_path.glob("*.jitcache"))) == 2
+    finally:
+        v2.__name__ = "_double_plus_three"
+
+
+def test_cache_key_extra_partitions_entries(tmp_path):
+    """Closure constants are invisible to bytecode + signature — callers
+    fold them in via cache_key_extra, and two equal-signature functions
+    with different extras never share an executable."""
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+
+    def make(c):
+        return lambda t: t * 2.0 + c
+
+    a = _sf(make(1.0), cache_dir=str(tmp_path), extra="c=1")(x).numpy()
+    jit.clear_compile_cache(memory=True)
+    b = _sf(make(5.0), cache_dir=str(tmp_path), extra="c=5")(x).numpy()
+    np.testing.assert_allclose(a, np.arange(4) * 2.0 + 1.0)
+    np.testing.assert_allclose(b, np.arange(4) * 2.0 + 5.0)
+    assert len(list(tmp_path.glob("*.jitcache"))) == 2
+
+
+def test_clear_disk_reports_and_unlinks(tmp_path):
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    jit.set_compile_cache_dir(str(tmp_path))
+    _sf(_double_plus_one)(x)
+    assert list(tmp_path.glob("*.jitcache"))
+    n = jit.clear_compile_cache(memory=True, disk=True)
+    assert n >= 2  # the memory entry + the disk file
+    assert list(tmp_path.glob("*.jitcache")) == []
+
+
+# ─────────────────────── engine + fleet integration ───────────────────────
+
+
+_PROMPT = np.random.RandomState(11).randint(0, 128, (7,))
+
+
+def _serve_once(model, cache_dir):
+    eng = ServingEngine(model, page_size=4, max_batch_slots=1,
+                        compile_cache_dir=cache_dir)
+    rid = eng.add_request(_PROMPT, max_new_tokens=6, temperature=0.9,
+                          seed=11)
+    return list(eng.run()[rid].token_ids)
+
+
+def test_engine_restart_materializes_from_disk_bit_identically(tmp_path):
+    model = _model()
+    before = _srcs("serving_step")
+    cold = _serve_once(model, str(tmp_path))
+    d1 = _delta(before, "serving_step")
+    assert d1["fresh"] > 0 and d1["disk"] == 0 == d1["memory"]
+    assert list(tmp_path.glob("serving_step-*.jitcache"))
+
+    # same process, new engine: the memory layer serves every program
+    assert _serve_once(model, str(tmp_path)) == cold
+    assert _delta(before, "serving_step")["memory"] == d1["fresh"]
+
+    # restart simulation: memory dropped, every program comes from disk
+    jit.clear_compile_cache(memory=True)
+    assert _serve_once(model, str(tmp_path)) == cold
+    d3 = _delta(before, "serving_step")
+    assert d3["disk"] == d1["fresh"] and d3["fresh"] == d1["fresh"]
+
+
+def test_router_fleet_shares_cache_and_reload_compiles_nothing(tmp_path):
+    """Replica 1 of a fleet never recompiles what replica 0 built (the
+    memory layer is cross-engine); a post-restart fleet on the same
+    cache dir starts from disk; and a rolling Router.reload — in-place
+    weight push + canary per engine — materializes zero fresh programs
+    on top of the cached set."""
+    cache = str(tmp_path / "jitcache")
+    ck = str(tmp_path / "ckpt")
+    donor = _model(0)
+    CheckpointManager(ck, max_to_keep=None).save(
+        7, {"model": donor.state_dict()})
+
+    before = _srcs("serving_step")
+    r = Router()
+    r.add_model("m", [_model(0), _model(0)], page_size=4,
+                max_batch_slots=1, compile_cache_dir=cache)
+    rids = [r.submit(_PROMPT, model="m", max_new_tokens=6,
+                     temperature=0.9, seed=21 + i) for i in range(2)]
+    outs = r.run()
+    streams = [list(outs[rid].token_ids) for rid in rids]
+    d1 = _delta(before, "serving_step")
+    assert d1["fresh"] > 0 and d1["memory"] > 0  # replica 1 reused it
+
+    # restarted fleet (new Router, memory dropped): disk-only start
+    jit.clear_compile_cache(memory=True)
+    r2 = Router()
+    r2.add_model("m", [_model(0), _model(0)], page_size=4,
+                 max_batch_slots=1, compile_cache_dir=cache)
+    mid = _srcs("serving_step")
+    rids2 = [r2.submit(_PROMPT, model="m", max_new_tokens=6,
+                       temperature=0.9, seed=21 + i) for i in range(2)]
+    outs2 = r2.run()
+    assert [list(outs2[rid].token_ids) for rid in rids2] == streams
+    d2 = _delta(mid, "serving_step")
+    assert d2["fresh"] == 0 and d2["disk"] > 0
+
+    # rolling reload on the restarted fleet: draining, weight push and
+    # canary all ride already-materialized programs
+    pre_reload = _srcs("serving_step")
+    summary = r2.reload(ck)
+    assert [e["result"] for e in summary["engines"]] == ["ok", "ok"]
+    assert _delta(pre_reload, "serving_step")["fresh"] == 0
+
+
+_CHILD = r"""
+import json
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import metrics
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import ServingEngine
+
+paddle.seed(0)
+model = LlamaForCausalLM(llama_tiny(
+    vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+    num_key_value_heads=1, max_position_embeddings=32))
+model.eval()
+eng = ServingEngine(model, page_size=4, max_batch_slots=1)
+rid = eng.add_request(np.arange(5, dtype=np.int64), max_new_tokens=4,
+                      temperature=0.9, seed=3)
+toks = [int(t) for t in eng.run()[rid].token_ids]
+fam = metrics.get_registry().get("paddle_tpu_jit_compiles_total")
+srcs = {s: fam.sum_labels(fn="serving_step", source=s)
+        for s in ("fresh", "disk", "memory")}
+print(json.dumps({"toks": toks, "srcs": srcs}))
+"""
+
+
+@pytest.mark.slow
+def test_second_process_starts_from_disk(tmp_path):
+    """THE cross-process claim: a brand-new interpreter pointed at the
+    same PADDLE_TPU_COMPILE_CACHE dir deserializes every serving_step
+    program (source="disk", zero fresh) and emits the same tokens."""
+    env = dict(os.environ, PADDLE_TPU_COMPILE_CACHE=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                             capture_output=True, text=True, timeout=600,
+                             cwd=root)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    a = run()
+    assert a["srcs"]["fresh"] > 0 and a["srcs"]["disk"] == 0
+    b = run()
+    assert b["srcs"]["fresh"] == 0
+    assert b["srcs"]["disk"] == a["srcs"]["fresh"]
+    assert b["toks"] == a["toks"]

@@ -51,6 +51,10 @@ def _counter(name, **labels):
     fam = metrics.get_registry().get(name)
     if fam is None:
         return 0.0
+    if labels and set(labels) != set(fam.label_names):
+        # partial label set: aggregate the unnamed dimensions (e.g.
+        # jit_compiles_total{fn=...} summed across its source split)
+        return fam.sum_labels(**labels)
     return (fam.labels(**labels) if labels else fam).value
 
 

@@ -222,6 +222,12 @@ def test_random_sampler_bounded_generator():
     assert list(s) == [0, 1, 2, 3, 4]
 
 
+def _die(worker_id):  # worker_init_fn for the crash-loop watchdog test
+    import os
+
+    os._exit(3)
+
+
 class TestProcessWorkers:
     def test_process_workers_parallel_and_ordered(self):
         import os
@@ -241,6 +247,28 @@ class TestProcessWorkers:
         assert vals == [float(i * i) for i in range(32)]  # order preserved
         assert os.getpid() not in pids  # fetched in child processes
         assert len(pids) >= 1
+
+    @pytest.mark.slow
+    def test_crash_looping_workers_raise_instead_of_hanging(self):
+        """A worker whose init dies is silently replaced by mp.Pool with a
+        fresh process, forever — the classic failure is an iterator that
+        blocks on result.get() while the pool respawns behind it (seen
+        live when libshm_ring.so missed its librt link and every spawn
+        child died on dlopen). The loader must detect the PID churn and
+        raise, not hang."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _worker_dataset import SquaresDataset
+
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader(SquaresDataset(8), batch_size=4, num_workers=1,
+                            worker_mode="process", worker_init_fn=_die)
+        with pytest.raises(RuntimeError, match="crash-looping"):
+            for _ in loader:
+                pass
 
     def test_bad_worker_mode_rejected(self):
         from paddle_tpu.io import DataLoader, Dataset

@@ -508,8 +508,10 @@ class TestEngineInstrumentation:
         assert reg.get("paddle_tpu_serving_kv_pages_total").value > 0
         # THE invariant, now a metric: the unified step compiled
         # exactly once per token-grid bucket seen
-        compiles = {s["labels"]["fn"]: s["value"]
-                    for s in snap["paddle_tpu_jit_compiles_total"]["series"]}
+        compiles = {}  # per fn, summed across the source label
+        for s in snap["paddle_tpu_jit_compiles_total"]["series"]:
+            k = s["labels"]["fn"]
+            compiles[k] = compiles.get(k, 0) + s["value"]
         counts = engine.compile_counts()
         assert counts["step"] == counts["step_buckets"]
         assert compiles["serving_step"] == counts["step"], compiles
@@ -519,10 +521,10 @@ class TestEngineInstrumentation:
         assert ttft["type"] == "histogram"
         assert ("paddle_tpu_serving_ttft_seconds_count", lbl, 2.0) \
             in ttft["samples"]
-        step_c = [v for _, lab, v
-                  in fams["paddle_tpu_jit_compiles_total"]["samples"]
-                  if lab.get("fn") == "serving_step"]
-        assert step_c == [float(counts["step"])]
+        step_c = sum(v for _, lab, v
+                     in fams["paddle_tpu_jit_compiles_total"]["samples"]
+                     if lab.get("fn") == "serving_step")
+        assert step_c == float(counts["step"])
 
     def test_rejected_request_counts(self):
         reg = get_registry()

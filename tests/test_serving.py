@@ -677,10 +677,12 @@ class TestUnifiedStep:
         from paddle_tpu import metrics
 
         def compiles():
+            # summed across the source="memory|disk|fresh" split: one
+            # inc per materialized program either way
             fam = metrics.get_registry().get(
                 "paddle_tpu_jit_compiles_total")
-            return 0.0 if fam is None else fam.labels(
-                fn="serving_step").value
+            return 0.0 if fam is None else fam.sum_labels(
+                fn="serving_step")
 
         model = _llama()
         eng = ServingEngine(model, page_size=4, max_batch_slots=2,
@@ -723,6 +725,216 @@ class TestUnifiedStep:
         assert first == urgent
         outs = eng.run()
         assert all(o.finish_reason == "length" for o in outs.values())
+
+
+# ──────── speculative decoding on the unified step (ISSUE 14) ────────
+
+
+class _OracleDrafter:
+    """Proposes the reference continuation itself — 100% acceptance, so
+    every decode step lands a full (k+1)-token burst; exercises the
+    multi-token landing path deterministically."""
+
+    def __init__(self, prompt_len, ref):
+        self.prompt_len, self.ref = int(prompt_len), list(ref)
+
+    def propose(self, ids, k=None):
+        done = len(ids) - self.prompt_len
+        return np.asarray(self.ref[done:done + (k or 1)], np.int32)
+
+
+class _GarbageDrafter:
+    """Proposes a fixed token the model (almost) never emits — the
+    all-rejected rollback path runs on every decode step."""
+
+    def propose(self, ids, k=None):
+        return np.full(k or 1, 127, np.int32)
+
+
+class TestSpeculativeDecoding:
+    """ISSUE 14 tentpole: host-side drafts ride the unified ragged step
+    as extra grid rows — data, not new compiled programs — and
+    verification compares drafts against the per-position sampled
+    targets the determinism contract already pins. So streams are
+    bit-identical with speculation on or off, for ANY drafter: a good
+    one only changes how many grid rows each step retires."""
+
+    def _ref(self, model, prompt, **spec):
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        rid = eng.add_request(prompt, **spec)
+        return list(eng.run()[rid].token_ids)
+
+    @pytest.mark.parametrize("temperature", [0.0, 0.9])
+    def test_streams_bit_identical_spec_on_vs_off(self, temperature):
+        """The headline property, greedy AND sampled: an n-gram-drafted
+        engine emits exactly the spec-off streams for a mixed batch."""
+        model = _llama()
+        spec = dict(max_new_tokens=10, temperature=temperature, seed=17)
+        refs = [self._ref(model, p, **spec) for p in _PROMPTS]
+        if temperature:
+            assert any(len(set(r)) > 1 for r in refs)  # actually sampling
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3,
+                            spec_k=3)
+        rids = [eng.add_request(p, **spec) for p in _PROMPTS]
+        outs = eng.run()
+        assert [list(outs[r].token_ids) for r in rids] == refs
+
+    def test_oracle_drafter_lands_multi_token_bursts(self):
+        """With a drafter proposing the true continuation every draft is
+        accepted, so the request drains in ~1/(k+1) the decode steps —
+        proof the accept path lands real bursts, not one token with
+        extra ceremony — and the stream is still bit-identical."""
+        from paddle_tpu import metrics
+
+        model = _llama()
+        spec = dict(max_new_tokens=12, temperature=0.9, seed=23)
+        ref = self._ref(model, _PROMPTS[0], **spec)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3,
+                            drafter=_OracleDrafter(_PROMPTS[0].size, ref))
+        reg = metrics.get_registry()
+        d0 = reg.get("paddle_tpu_serving_spec_drafted_tokens_total").value
+        a0 = reg.get("paddle_tpu_serving_spec_accepted_tokens_total").value
+        toks, done = [], []
+        eng.add_request(
+            _PROMPTS[0],
+            stream_cb=lambda r, t, f, s: (toks.append(t) if t is not None
+                                          else done.append(f)),
+            **spec)
+        steps = 0
+        while not done:
+            eng.step()
+            steps += 1
+            assert steps < 16  # would mean speculation stalled the drain
+        assert toks == ref
+        # prefill step lands token 0; 11 more at 4/step -> 4 steps total
+        assert steps <= 5
+        drafted = reg.get(
+            "paddle_tpu_serving_spec_drafted_tokens_total").value - d0
+        accepted = reg.get(
+            "paddle_tpu_serving_spec_accepted_tokens_total").value - a0
+        assert drafted == accepted > 0  # the oracle is never rejected
+
+    def test_rejected_drafts_roll_back_bit_identically(self):
+        """The a=0 path: a drafter proposing garbage every step forces
+        the KV rollback (pool.truncate) on every burst — the stream must
+        still match the spec-off run token for token."""
+        from paddle_tpu import metrics
+
+        model = _llama()
+        spec = dict(max_new_tokens=8, temperature=0.9, seed=31)
+        ref = self._ref(model, _PROMPTS[1], **spec)
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3, drafter=_GarbageDrafter())
+        reg = metrics.get_registry()
+        d0 = reg.get("paddle_tpu_serving_spec_drafted_tokens_total").value
+        a0 = reg.get("paddle_tpu_serving_spec_accepted_tokens_total").value
+        rid = eng.add_request(_PROMPTS[1], **spec)
+        assert list(eng.run()[rid].token_ids) == ref
+        drafted = reg.get(
+            "paddle_tpu_serving_spec_drafted_tokens_total").value - d0
+        accepted = reg.get(
+            "paddle_tpu_serving_spec_accepted_tokens_total").value - a0
+        assert drafted > 0 and accepted < drafted
+
+    def test_compile_surface_pinned_with_speculation(self):
+        """Drafts are grid rows, not programs: with spec_k=3 armed, the
+        ISSUE 11 contract still holds — jit compiles for serving_step ==
+        the bucket-set size across a ragged churn sweep, and replaying
+        the mix compiles nothing new."""
+        from paddle_tpu import metrics
+
+        def compiles():
+            fam = metrics.get_registry().get(
+                "paddle_tpu_jit_compiles_total")
+            return 0.0 if fam is None else fam.sum_labels(
+                fn="serving_step")
+
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            token_budget=8, spec_k=3)
+        before = compiles()
+        rng = np.random.RandomState(61)
+        for n, new in ((3, 6), (30, 3), (7, 6), (20, 2)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new,
+                            temperature=0.9, seed=n)
+            eng.step()
+        eng.run()
+        counts = eng.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
+        assert compiles() - before == counts["step"]
+        # the same mix again — drafts and all — compiles NOTHING new
+        for n, new in ((30, 3), (3, 6)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new)
+        eng.run()
+        assert compiles() - before == counts["step"]
+        assert eng.compile_counts() == counts
+
+    def test_drafts_yield_to_decode_and_prefill_chunks(self):
+        """Budget order is decode > chunks > drafts: while a 40-token
+        prompt trickles in at token_budget=8, every decoding tenant
+        still lands at least its guaranteed token per step and the
+        chunk cadence is untouched (drafts take only the leftover,
+        which is zero during admission)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3,
+                            token_budget=8, spec_k=3)
+        d0 = eng.add_request(_PROMPTS[0], max_new_tokens=20)
+        d1 = eng.add_request(_PROMPTS[1], max_new_tokens=20)
+        eng.step()  # both sampled their first token
+        lng = eng.add_request(np.random.RandomState(67).randint(
+            0, 128, (40,)), max_new_tokens=2)
+        gl = TestUnifiedStep._gen_len
+        for _ in range(5):  # same cadence as the spec-off starvation test
+            before = {r: gl(eng, r) for r in (d0, d1)}
+            eng.step()
+            for r in (d0, d1):
+                assert gl(eng, r) >= before[r] + 1, (
+                    "a decoding tenant was starved with speculation on")
+            assert gl(eng, lng) == 0  # still mid-prompt: chunks kept pace
+        outs = eng.run()
+        assert all(outs[r].finish_reason == "length" for r in outs)
+
+    def test_export_mid_burst_journals_only_committed_tokens(self):
+        """Chaos contract: exporting a slot mid-speculative-run journals
+        exactly the tokens already streamed — never unaccepted drafts —
+        and a sibling adopting the journal (its own drafter re-drafting
+        over prompt+journal) finishes the stream bit-identically with
+        exactly-once chunk seqs."""
+        model = _llama()
+        spec = dict(max_new_tokens=10, temperature=0.9, seed=37)
+        ref = self._ref(model, _PROMPTS[2], **spec)
+        src = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3,
+                            drafter=_OracleDrafter(_PROMPTS[2].size, ref))
+        chunks = []
+        rid = src.add_request(
+            _PROMPTS[2],
+            stream_cb=lambda r, t, f, s: chunks.append((s, t)),
+            **spec)
+        src.step()  # prefill -> token 0
+        src.step()  # full burst: drafts 1..3 accepted + bonus -> 4 more
+        [journal] = src.export_inflight()
+        streamed = [t for _, t in chunks if t is not None]
+        assert len(streamed) == 5  # the burst actually landed 4 tokens
+        assert journal.resume_tokens == streamed == ref[:5]
+        assert src.pool.used_pages == 0  # rollback/export left no pages
+
+        dst = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3)
+        dst.adopt_request(journal)
+        assert list(dst.run()[rid].token_ids) == ref
+        tok_chunks = [c for c in chunks if c[1] is not None]
+        assert [s for s, _ in tok_chunks] == list(range(10))
+        assert [t for _, t in tok_chunks] == ref
+
+    def test_engine_seed_kwarg_deprecated(self):
+        """ServingEngine(seed=...) never seeded anything (sampling is
+        keyed per request); passing it now warns instead of silently
+        implying a determinism knob that does not exist."""
+        with pytest.warns(DeprecationWarning, match="ServingEngine"):
+            ServingEngine(_llama(), page_size=4, max_batch_slots=1,
+                          seed=0)
 
 
 # ──────────────── prefix caching (ISSUE 8 tentpole) ────────────────

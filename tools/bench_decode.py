@@ -29,6 +29,17 @@ ragged step with a pinned grid. Reports tenants' p50/p95/p99 ITL before
 vs during admission (asserts p95 within 15%), the long prompt's TTFT, a
 zero-recompile assert over the admission, and a bit-identity check of
 every stream against admission-free runs — BENCH_MIXED row.
+
+``--spec``: speculative decoding (ISSUE 14) — BENCH_SPEC_BATCH greedy
+decoders with period-3 repeating prompts run spec-off then spec-on
+(NGramDrafter, k=BENCH_SPEC_K). Drafts ride the unified step as extra
+grid rows (data, not programs) and verification reuses the
+per-position sampling keys, so the row asserts every stream
+bit-identical spec on vs off and reports tokens/s for both modes plus
+the drafted/accepted acceptance rate. Also emits a cold-vs-warm
+engine start-up row: a first engine compiles fresh into a persistent
+compile-cache dir, a second identical engine (in-process memory layer
+dropped) must materialize every program from disk and start faster.
 """
 import json
 import os
@@ -499,16 +510,245 @@ def _bench_mixed(model_name, rt, dev, small):
         f.write(json.dumps(rec) + "\n")
 
 
+def _bench_spec(model_name, rt, dev, small):
+    """Speculative-decoding scenario (ISSUE 14): B greedy decoders with
+    period-3 repeating prompts — an n-gram drafter's best case — run
+    spec-off then spec-on through the unified ragged step. Drafts enter
+    as extra grid rows of programs the engine already compiled, and
+    acceptance compares drafts against the per-position sampled targets,
+    so every stream must be bit-identical to the spec-off run; the row
+    reports tokens/s for both modes and the drafted/accepted counters'
+    acceptance rate. Both phases share one persistent compile-cache dir
+    so each timed engine materializes its programs from cache, keeping
+    XLA out of the throughput window."""
+    import tempfile
+
+    import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu.serving import ServingEngine
+
+    B = int(os.environ.get("BENCH_SPEC_BATCH", "4"))
+    new = int(os.environ.get("BENCH_SPEC_NEW", "32" if small else "128"))
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    metric = f"{model_name}_spec_decode_speedup_ratio"
+    cfg_tag = f"-spec-b{B}-k{spec_k}-n{new}-greedy"
+    if not small:
+        from _bench_timing import iter_notes_rows
+        if any(rec.get("metric") == metric
+               and rec.get("device") in ("tpu", "axon")
+               and str(rec.get("config", "")).endswith(cfg_tag)
+               for rec in iter_notes_rows(_NOTES)):
+            print(f"spec[{model_name}]: {cfg_tag} already banked this "
+                  "round — skipping", file=sys.stderr)
+            return
+    model, vocab, label = _build(model_name, 64, new + spec_k + 2, small)
+    model.eval()
+    # period-3 prompts: the suffix always recurs earlier, so the n-gram
+    # drafter proposes from step one — and greedy decode tends to lock
+    # into the cycle, giving real (not vacuous) acceptance
+    prompts = [np.tile((np.arange(3) + 5 * i) % vocab, 8).astype(np.int64)
+               for i in range(B)]
+    cache_dir = tempfile.mkdtemp(prefix="bench_spec_jitcache_")
+
+    def run(spec_on):
+        eng = ServingEngine(
+            model, page_size=16, max_batch_slots=B,
+            max_model_len=int(prompts[0].size) + new + spec_k + 2,
+            spec_k=spec_k if spec_on else 0,
+            compile_cache_dir=cache_dir)
+        stamps = []
+
+        def cb(r, tok, fin, seq):
+            if tok is not None:
+                stamps.append(time.perf_counter())
+
+        for i, p in enumerate(prompts):
+            eng.add_request(p, max_new_tokens=new, temperature=0.0,
+                            seed=11 + i, stream_cb=cb)
+        eng.step()  # prefill (and its compile) outside the timed window
+        eng.step()  # first decode step: materialize the decode bucket
+        t0 = time.perf_counter()
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        toks = [list(outs[r].token_ids) for r in sorted(outs)]
+        tps = sum(1 for t in stamps if t >= t0) / dt if dt else 0.0
+        return eng, toks, tps
+
+    # warmup pass per mode seeds the persistent cache; the timed pass's
+    # engine then materializes from memory/disk instead of compiling
+    run(False)
+    _, toks_off, tps_off = run(False)
+    run(True)
+    d0 = _counter_value("paddle_tpu_serving_spec_drafted_tokens_total")
+    a0 = _counter_value("paddle_tpu_serving_spec_accepted_tokens_total")
+    eng_on, toks_on, tps_on = run(True)
+    drafted = _counter_value(
+        "paddle_tpu_serving_spec_drafted_tokens_total") - d0
+    accepted = _counter_value(
+        "paddle_tpu_serving_spec_accepted_tokens_total") - a0
+    streams_identical = toks_on == toks_off
+    ratio = tps_on / tps_off if tps_off else 0.0
+    rec = {
+        "metric": metric,
+        "value": round(ratio, 3), "unit": "ratio", "vs_baseline": 1.0,
+        "config": label + cfg_tag,
+        "batch": B, "spec_k": spec_k, "new_tokens": new,
+        "tokens_per_sec_spec_off": round(tps_off, 1),
+        "tokens_per_sec_spec_on": round(tps_on, 1),
+        "drafted_tokens": int(drafted), "accepted_tokens": int(accepted),
+        "acceptance_rate": (round(accepted / drafted, 3)
+                            if drafted else 0.0),
+        "streams_identical": bool(streams_identical),
+        "step_compiles": eng_on.compile_counts()["step"],
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if not streams_identical:
+        raise AssertionError(
+            "a stream diverged with speculation on — drafting leaked "
+            "into sampling")
+    if not drafted:
+        raise AssertionError(
+            "drafter proposed nothing on period-3 prompts — the suffix "
+            "match is broken")
+    if not small and ratio <= 1.0:
+        raise AssertionError(
+            f"speculation did not improve decode throughput "
+            f"({ratio:.2f}x at k={spec_k}, "
+            f"acceptance {rec['acceptance_rate']})")
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _bench_cache_startup(model_name, rt, dev, small):
+    """Cold-vs-warm engine start-up (ISSUE 14): the first engine in a
+    fresh compile-cache dir compiles from XLA (source="fresh") and
+    serializes each executable; a second identical engine — with the
+    in-process memory layer dropped — must materialize every step
+    program from disk (source="disk", zero fresh) and produce
+    bit-identical tokens. The row reports both wall times and the
+    per-source jit_compiles_total deltas."""
+    import tempfile
+
+    import paddle_tpu as paddle  # noqa: F401  (model seed side effect)
+    from paddle_tpu import jit
+    from paddle_tpu.serving import ServingEngine
+
+    new = 8
+    metric = f"{model_name}_engine_startup_warm_vs_cold_ratio"
+    cfg_tag = f"-cachestart-n{new}"
+    if not small:
+        from _bench_timing import iter_notes_rows
+        if any(rec.get("metric") == metric
+               and rec.get("device") in ("tpu", "axon")
+               and str(rec.get("config", "")).endswith(cfg_tag)
+               for rec in iter_notes_rows(_NOTES)):
+            print(f"cache-startup[{model_name}]: {cfg_tag} already "
+                  "banked this round — skipping", file=sys.stderr)
+            return
+    model, vocab, label = _build(model_name, 32, new + 2, small)
+    model.eval()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, vocab, (16,))
+    cache_dir = tempfile.mkdtemp(prefix="bench_jitcache_")
+    sources = ("fresh", "disk", "memory")
+
+    def serve():
+        src0 = {s: _counter_value("paddle_tpu_jit_compiles_total",
+                                  source=s) for s in sources}
+        t0 = time.perf_counter()
+        eng = ServingEngine(model, page_size=16, max_batch_slots=1,
+                            max_model_len=int(prompt.size) + new + 2,
+                            compile_cache_dir=cache_dir)
+        rid = eng.add_request(prompt, max_new_tokens=new, temperature=0.0,
+                              seed=5)
+        toks = list(eng.run()[rid].token_ids)
+        dt = time.perf_counter() - t0
+        srcs = {s: int(_counter_value("paddle_tpu_jit_compiles_total",
+                                      source=s) - src0[s])
+                for s in sources}
+        return dt, srcs, toks
+
+    cold_dt, cold_src, cold_toks = serve()
+    jit.clear_compile_cache(memory=True)  # force the disk layer
+    warm_dt, warm_src, warm_toks = serve()
+    ratio = warm_dt / cold_dt if cold_dt else 0.0
+    rec = {
+        "metric": metric,
+        "value": round(ratio, 3), "unit": "ratio", "vs_baseline": 1.0,
+        "config": label + cfg_tag,
+        "cold_start_s": round(cold_dt, 3), "warm_start_s": round(warm_dt, 3),
+        "cold_sources": cold_src, "warm_sources": warm_src,
+        "streams_identical": bool(warm_toks == cold_toks),
+        "device": str(dev.platform),
+    }
+    print(json.dumps(rec))
+    if warm_toks != cold_toks:
+        raise AssertionError(
+            "warm (disk-cached) engine's stream diverged from the cold "
+            "compile's — serialization changed the program")
+    if not cold_src["fresh"]:
+        raise AssertionError("cold start compiled nothing fresh — the "
+                             "cache dir was not cold")
+    if warm_src["fresh"] or not warm_src["disk"]:
+        raise AssertionError(
+            f"warm start did not come from disk: {warm_src}")
+    if small:
+        return  # CPU smoke: never pollute the round's evidence file
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(_NOTES, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def _counter_value(name, **labels):
     from paddle_tpu import metrics
 
     fam = metrics.get_registry().get(name)
     if fam is None:
         return 0.0
+    if labels and set(labels) != set(fam.label_names):
+        # partial label set: aggregate the unnamed dimensions (e.g.
+        # jit_compiles_total{fn=...} summed across its source split)
+        return fam.sum_labels(**labels)
     return (fam.labels(**labels) if labels else fam).value
 
 
+def _parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench_decode",
+        description="Decode benchmarks: dense while_loop decode by "
+                    "default; flags select engine scenarios (combinable "
+                    "— each selected scenario emits its own BENCH rows).",
+        epilog="Geometry via env: BENCH_BATCH, BENCH_PROMPT, "
+               "BENCH_NEW_TOKENS, BENCH_DECODE_MODELS (comma list of "
+               "gpt,llama), BENCH_DECODE_SMALL=1 for a CPU smoke that "
+               "never writes the notes file. Per-scenario knobs: "
+               "BENCH_PAGED_BATCHES, BENCH_SHARED_N/BENCH_SHARED_PREFIX, "
+               "BENCH_MIXED_*, BENCH_SPEC_BATCH/BENCH_SPEC_K/"
+               "BENCH_SPEC_NEW.")
+    ap.add_argument("--paged", action="store_true",
+                    help="continuous-batching engine sweep vs the dense "
+                         "loop at BENCH_PAGED_BATCHES")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    dest="shared_prefix",
+                    help="prefix-cache scenario (ISSUE 8): N requests "
+                         "sharing one common prefix")
+    ap.add_argument("--mixed", action="store_true",
+                    help="long-prompt-admission scenario (ISSUE 11): "
+                         "tenant ITL flatness under chunked prefill")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding (ISSUE 14): spec on/off "
+                         "tokens/s + acceptance rate, plus a cold-vs-"
+                         "warm compile-cache start-up row")
+    return ap.parse_args(argv)
+
+
 def main():
+    args = _parse_args()
     from _bench_timing import probe_or_exit, roundtrip_baseline
 
     small = os.environ.get("BENCH_DECODE_SMALL") == "1"
@@ -538,37 +778,31 @@ def main():
         sys.exit(2)
     rt = roundtrip_baseline(lambda m: print(m, file=sys.stderr))
     failures = 0
-    if "--mixed" in sys.argv:
-        # long-prompt-admission scenario (ISSUE 11): N decoding tenants
-        # + one BENCH_MIXED_PROMPT-token prompt; reports p95/p99 ITL
-        # before/during admission, the long prompt's TTFT, a
-        # zero-recompile assert, and a stream bit-identity check —
-        # BENCH_MIXED row
+
+    def attempt(tag, fn, *fargs):
+        # one scenario's OOM/regression must not lose the others' rows
+        nonlocal failures
+        try:
+            fn(*fargs)
+        except Exception as e:
+            failures += 1
+            print(f"{tag}: {type(e).__name__}: {str(e)[:160]}",
+                  file=sys.stderr)
+
+    if args.spec:
         for name in models:
-            try:
-                _bench_mixed(name, rt, dev, small)
-            except Exception as e:
-                failures += 1
-                print(f"mixed[{name}]: {type(e).__name__}: "
-                      f"{str(e)[:160]}", file=sys.stderr)
-        if "--paged" not in sys.argv and "--shared-prefix" not in sys.argv:
-            sys.exit(1 if failures else 0)
-    if "--shared-prefix" in sys.argv:
-        # prefix-cache scenario (rides --paged's engine machinery): N
-        # requests x one shared prefix; geometry via BENCH_SHARED_N /
-        # BENCH_SHARED_PREFIX
+            attempt(f"spec[{name}]", _bench_spec, name, rt, dev, small)
+            attempt(f"cache-startup[{name}]", _bench_cache_startup,
+                    name, rt, dev, small)
+    if args.mixed:
+        for name in models:
+            attempt(f"mixed[{name}]", _bench_mixed, name, rt, dev, small)
+    if args.shared_prefix:
         shared_prefix = int(os.environ.get("BENCH_SHARED_PREFIX", "1024"))
         for name in models:
-            try:
-                _bench_shared_prefix(name, rt, shared_prefix, new, dev,
-                                     small)
-            except Exception as e:
-                failures += 1
-                print(f"shared-prefix[{name}]: {type(e).__name__}: "
-                      f"{str(e)[:160]}", file=sys.stderr)
-        if "--paged" not in sys.argv:
-            sys.exit(1 if failures else 0)
-    if "--paged" in sys.argv:
+            attempt(f"shared-prefix[{name}]", _bench_shared_prefix,
+                    name, rt, shared_prefix, new, dev, small)
+    if args.paged:
         # engine-vs-dense sweep: one dense and one paged row per batch
         batches = [int(b) for b in os.environ.get(
             "BENCH_PAGED_BATCHES", "1,8,32").split(",") if b.strip()]
@@ -576,20 +810,12 @@ def main():
             for b in batches:
                 for fn, tag in ((_bench_one, "decode"),
                                 (_bench_paged_one, "paged")):
-                    try:
-                        fn(name, rt, b, prompt, new, dev, small)
-                    except Exception as e:
-                        failures += 1
-                        print(f"{tag}[{name}] b{b}: {type(e).__name__}: "
-                              f"{str(e)[:160]}", file=sys.stderr)
-        sys.exit(1 if failures else 0)
-    for name in models:
-        try:
-            _bench_one(name, rt, B, prompt, new, dev, small)
-        except Exception as e:  # one model's OOM must not lose the other's
-            failures += 1
-            print(f"decode[{name}]: {type(e).__name__}: {str(e)[:160]}",
-                  file=sys.stderr)
+                    attempt(f"{tag}[{name}] b{b}", fn,
+                            name, rt, b, prompt, new, dev, small)
+    if not (args.spec or args.mixed or args.shared_prefix or args.paged):
+        for name in models:
+            attempt(f"decode[{name}]", _bench_one,
+                    name, rt, B, prompt, new, dev, small)
     sys.exit(1 if failures else 0)
 
 

@@ -31,7 +31,13 @@ rolling reload), a /metrics+/healthz scraper and a health()/states()
 prober race through 200 barrier-synced, seed-jittered iterations with
 the router / registry / probe-cache / watchdog locks instrumented —
 zero lock-order or reentrancy violations allowed, fleet must end
-consistent. Each scenario asserts both the behavior
+consistent. Scenario 14 re-runs the kill drill under SPECULATIVE
+decoding (ISSUE 14): both replicas draft with spec_k=3 — one tenant
+bursting at 100% acceptance, one fed always-rejected garbage — the
+busiest engine dies between bursts, and every migrated journal must
+carry only committed tokens (never an unaccepted draft), with final
+streams bit-identical to a spec-off lone engine and chunks
+exactly-once. Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
 
@@ -74,6 +80,10 @@ def _counter(name, **labels):
     fam = metrics.get_registry().get(name)
     if fam is None:
         return 0.0
+    if labels and set(labels) != set(fam.label_names):
+        # partial label set: aggregate the unnamed dimensions (e.g.
+        # jit_compiles_total{fn=...} summed across its source split)
+        return fam.sum_labels(**labels)
     return (fam.labels(**labels) if labels else fam).value
 
 
@@ -735,6 +745,122 @@ def scenario_thread_fuzz_control_plane(model):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+class _SpecOracle:
+    """Chaos drafter: proposes the known reference continuation for the
+    prompts it was given (100% acceptance — every decode step is a full
+    multi-token burst) and garbage for everyone else (0% acceptance —
+    the KV rollback runs every step). Stateless, so one instance serves
+    every replica, including post-migration re-drafting over
+    prompt + journal."""
+
+    def __init__(self, table):
+        self.table = [(np.asarray(p).tolist(), [int(t) for t in ref])
+                      for p, ref in table]
+
+    def propose(self, ids, k=None):
+        l = np.asarray(ids).tolist()
+        for p, ref in self.table:
+            done = len(l) - len(p)
+            if 0 <= done and l[:len(p)] == p \
+                    and l[len(p):] == ref[:done]:
+                return np.asarray(ref[done:done + (k or 1)], np.int32)
+        return np.full(k or 1, 127, np.int32)  # rejected every burst
+
+
+def scenario_kill_engine_mid_spec_burst(model):
+    """Scenario 14 (ISSUE 14): the kill-engine drill under SPECULATIVE
+    decoding. Both replicas run spec_k=3 with a drafter that bursts
+    4 tokens/step for two requests and feeds always-rejected garbage to
+    the third, so at the kill the dying engine holds multi-token-burst
+    progress AND a request whose every draft was rolled back. The
+    migration journal is only ever committed tokens (accepted drafts
+    commit inside the step; rejected ones truncate before landing), so
+    every stream must end bit-identical to a lone SPEC-OFF engine —
+    chunks exactly-once, drafts never leaking into a journal."""
+    specs = [(P5, 10, 0.9, 21), (P9, 9, 0.7, 22), (P3, 8, 1.1, 23)]
+    # the oracle is a SPEC-OFF lone engine: identical streams here prove
+    # speculation + crash + migration changed no token anywhere
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s) for p, n, t, s in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(any(len(set(toks)) > 1 for toks in refs),
+           "reference run is not actually sampling")
+
+    drafter = _SpecOracle([(specs[0][0], refs[0]), (specs[1][0], refs[1])])
+    r = Router()
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2,
+                spec_k=3, drafter=drafter)
+    e0 = r.engine("m/0")  # the busiest engine: ALL traffic lands here
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tok, fin, seq: chunks[i].append((seq, tok))
+
+    rids = [e0.add_request(p, max_new_tokens=n, temperature=t, seed=s,
+                           stream_cb=cb(i))
+            for i, (p, n, t, s) in enumerate(specs)]
+    crash0 = _counter("paddle_tpu_router_engine_crash_total",
+                      engine_id="m/0", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    req0 = _counter("paddle_tpu_router_requeued_total")
+    drafted0 = _counter("paddle_tpu_serving_spec_drafted_tokens_total")
+    accept0 = _counter("paddle_tpu_serving_spec_accepted_tokens_total")
+    for _ in range(2):
+        r.step()  # step 2 bursts both decoders to gen=5; req 2 waits
+    drafted_pre = _counter(
+        "paddle_tpu_serving_spec_drafted_tokens_total") - drafted0
+    accept_pre = _counter(
+        "paddle_tpu_serving_spec_accepted_tokens_total") - accept0
+    _check(accept_pre > 0, "no accepted burst landed before the kill")
+    with faults.inject("router.engine_step",
+                       raise_=RuntimeError("engine killed mid-spec-burst"),
+                       times=1, seed=SEED):
+        r.step()  # the scheduled kill — must NOT escape router.step()
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    # committed-tokens-only contract, visible at the kill: everything
+    # streamed so far is a prefix of the spec-off oracle — an unaccepted
+    # draft leaking into a journal/stream would diverge here
+    for i, ref in enumerate(refs):
+        got = [t for _, t in chunks[i] if t is not None]
+        _check(got == ref[:len(got)],
+               f"request {i} streamed a non-committed token by the kill")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_engine_crash_total",
+                    engine_id="m/0", model_id="m") == crash0 + 1,
+           "crash counter != exactly 1")
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the 2 in-flight requests at the kill")
+    _check(_counter("paddle_tpu_router_requeued_total") == req0 + 1,
+           "requeue counter != the 1 waiting request at the kill")
+    for i, (rid, ref) in enumerate(zip(rids, refs)):
+        _check(outs[rid].finish_reason == "length",
+               f"request {i} did not complete ({outs[rid].finish_reason})")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the spec-off oracle")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([s for s, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    drafted = _counter(
+        "paddle_tpu_serving_spec_drafted_tokens_total") - drafted0
+    accepted = _counter(
+        "paddle_tpu_serving_spec_accepted_tokens_total") - accept0
+    _check(drafted > accepted,
+           "the garbage-drafted request never exercised rejection")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+           "pages leaked")
+    return (f"m/0 killed mid-burst (drafted {int(drafted)}, accepted "
+            f"{int(accepted)} incl. an always-rejected tenant): journals "
+            "carried only committed tokens; 3 streams bit-identical to "
+            "the spec-off run, chunks exactly-once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -750,6 +876,7 @@ SCENARIOS = [
     ("kill-engine-mid-chunked-prefill",
      scenario_kill_engine_mid_chunked_prefill),
     ("thread-fuzz-control-plane", scenario_thread_fuzz_control_plane),
+    ("kill-engine-mid-spec-burst", scenario_kill_engine_mid_spec_burst),
 ]
 
 
