@@ -77,6 +77,7 @@ from . import text  # noqa: E402
 from . import metrics  # noqa: E402
 from . import profiler  # noqa: E402
 from . import serving  # noqa: E402
+from . import loadgen  # noqa: E402
 from . import reader  # noqa: E402
 from . import framework  # noqa: E402
 from . import checkpoint  # noqa: E402

@@ -77,7 +77,8 @@ class LintConfig:
     # TPL005 only patrols the paths whose correctness depends on seeded
     # determinism (PR 7's contract); fixtures widen this to ("",).
     tpl005_scopes: Tuple[str, ...] = (
-        "paddle_tpu/serving", "paddle_tpu/faults", "paddle_tpu/checkpoint")
+        "paddle_tpu/serving", "paddle_tpu/faults", "paddle_tpu/checkpoint",
+        "paddle_tpu/loadgen")
     # TPL003's code->docs direction only demands documentation for
     # instruments registered inside the package itself — a demo script
     # registering a scratch series shouldn't gate CI.

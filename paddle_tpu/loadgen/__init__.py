@@ -1,0 +1,54 @@
+"""paddle_tpu.loadgen — trace-driven load harness + fleet autoscaler.
+
+The capacity-measurement instrument for the serving stack (ROADMAP
+item 5): where ``tools/chaos_serve.py`` proves correctness under
+faults, loadgen measures behavior under production-shaped load — and
+closes the elasticity loop.
+
+Three pieces (one module each):
+
+- :mod:`~paddle_tpu.loadgen.trace` — seeded, deterministic request
+  streams: Zipf-shared prompt prefixes (exercises the radix prefix
+  cache), Poisson + burst arrivals, heavy-tail lengths, SLO tiers,
+  slow consumers, all on an injectable :class:`VirtualClock`.
+- :mod:`~paddle_tpu.loadgen.driver` — replays a trace against a
+  ``Router`` fleet paced on ``router.step()``, consumes the
+  seq-numbered streams with exactly-once accounting, and scores a
+  :class:`LoadReport` from the metrics registry (per-tier SLO
+  attainment, goodput, unavailable/timeout rates, prefix-hit ratio,
+  spec acceptance).
+- :mod:`~paddle_tpu.loadgen.autoscaler` — queue-depth
+  :class:`QueueDepthAutoscaler` driving ``router.add_engine`` /
+  ``drain`` / ``remove_engine`` with hysteresis + cooldown; scale-down
+  strictly drain-then-remove, so no request is ever dropped.
+
+Quick drill::
+
+    from paddle_tpu import loadgen
+    from paddle_tpu.serving import Router
+
+    router = Router()
+    router.add_model("m", model, replicas=1, page_size=4,
+                     max_batch_slots=4)
+    trace = loadgen.generate_trace(loadgen.TraceConfig(
+        seed=0, num_requests=64, burst_start=1.0, burst_duration=3.0))
+    scaler = loadgen.QueueDepthAutoscaler(
+        router, config=loadgen.AutoscalerConfig(max_engines=3))
+    report = loadgen.LoadDriver(router, trace, autoscaler=scaler).run()
+    assert report.exactly_once, report.violations
+
+docs/SERVING.md "Load testing & autoscaling" documents the knobs and
+the scaling state machine; docs/OBSERVABILITY.md catalogs the
+``paddle_tpu_loadgen_*`` / ``paddle_tpu_autoscaler_*`` families.
+"""
+from .autoscaler import AutoscalerConfig, QueueDepthAutoscaler
+from .driver import LoadDriver, LoadReport, TierReport
+from .trace import (DEFAULT_TIERS, TierSpec, Trace, TraceConfig,
+                    TraceRequest, VirtualClock, generate_trace, zipf_pmf)
+
+__all__ = [
+    "AutoscalerConfig", "QueueDepthAutoscaler",
+    "LoadDriver", "LoadReport", "TierReport",
+    "DEFAULT_TIERS", "TierSpec", "Trace", "TraceConfig", "TraceRequest",
+    "VirtualClock", "generate_trace", "zipf_pmf",
+]

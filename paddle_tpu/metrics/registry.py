@@ -185,6 +185,39 @@ class _HistogramChild:
             counts = list(self._counts)
         return _quantile_from_counts(counts, total, fam.buckets, q)
 
+    def fraction_le(self, bound: float) -> Optional[float]:
+        """Fraction of observations ``<= bound`` — the quantile read run
+        backwards (SLO attainment: "what share of TTFTs beat 200 ms?"),
+        interpolated inside the bucket containing ``bound`` exactly like
+        :meth:`quantile`. None before any observation."""
+        fam = self._family
+        with fam._lock:
+            total = self._count
+            counts = list(self._counts)
+        return _fraction_from_counts(counts, total, fam.buckets, bound)
+
+
+def _fraction_from_counts(counts, total, bounds,
+                          bound: float) -> Optional[float]:
+    """Inverse of the quantile math: cumulative share at ``bound`` with
+    linear interpolation in its bucket (first bucket interpolates from 0;
+    past the last finite bound everything counts)."""
+    if total == 0:
+        return None
+    b = float(bound)
+    if b >= bounds[-1]:
+        return 1.0
+    if b < 0.0:
+        return 0.0
+    cum = 0.0
+    for i, hi in enumerate(bounds):
+        lo = 0.0 if i == 0 else bounds[i - 1]
+        if b <= hi:
+            frac = 0.0 if hi == lo else (b - lo) / (hi - lo)
+            return (cum + counts[i] * max(0.0, frac)) / total
+        cum += counts[i]
+    return cum / total
+
 
 def _quantile_from_counts(counts, total, bounds, q: float) -> Optional[float]:
     """The one copy of the bucket-interpolation math, shared by per-series
@@ -457,6 +490,17 @@ class Histogram(_MetricFamily):
             counts, total = self._merged_counts()
             return _quantile_from_counts(counts, total, self.buckets, q)
         return self._default_child().quantile(q)
+
+    def fraction_le(self, bound: float) -> Optional[float]:
+        """Fraction of observations ``<= bound`` (family-level reads
+        merge every child's buckets, same contract as :meth:`quantile`) —
+        the registry-native SLO-attainment read ``paddle_tpu.loadgen``
+        scores tiers with."""
+        if self.label_names:
+            counts, total = self._merged_counts()
+            return _fraction_from_counts(counts, total, self.buckets,
+                                         bound)
+        return self._default_child().fraction_le(bound)
 
     @property
     def count(self) -> int:

@@ -54,6 +54,13 @@ door over a fleet of :class:`~.engine.ServingEngine` replicas:
   raise an actionable ValueError naming the served models
   (``CompletionAPI(router)`` forwards its ``model=`` field here).
 
+- **Runtime topology** — :meth:`add_engine` stamps out one more replica
+  from the model's ``add_model`` construction spec (monotone, never
+  reused engine ids; a warm persistent compile cache makes the spawn
+  zero-fresh-compile) and :meth:`remove_engine` retires an engine that
+  is already gated out and empty — the drain-then-remove pair
+  ``paddle_tpu.loadgen``'s queue-depth autoscaler closes its loop on.
+
 Threading contract: dispatch/step/run/reload are single-threaded like the
 engines they drive (one driver thread owns the control plane);
 :meth:`health` is safe to call from a scrape thread, which is how
@@ -147,6 +154,13 @@ class Router:
         self._models: Dict[str, List[EngineHandle]] = {}
         self._handles: Dict[str, EngineHandle] = {}
         self._rr: Dict[str, int] = {}          # per-model tie-break cursor
+        # per-model construction spec (shared model ref + engine kwargs)
+        # so add_engine() can stamp out identical replicas at runtime,
+        # and a monotone id cursor so engine ids are NEVER reused across
+        # a remove/add cycle (metrics label children and journals keyed
+        # by engine_id must stay unambiguous)
+        self._specs: Dict[str, tuple] = {}
+        self._next_idx: Dict[str, int] = {}
         self._lock = threading.Lock()  # tpulint: lock=router (rr cursors + state flips)
         self._requeued: set = set()            # req_ids moved once already
         self._stash: Dict[object, RequestOutput] = {}
@@ -222,7 +236,80 @@ class Router:
                 self._handles[h.engine_id] = h
                 self._set_state_gauge(h)
             self._rr.setdefault(model_id, 0)
+            self._specs[model_id] = (models[0], dict(engine_kwargs))
+            self._next_idx[model_id] = len(models)
         return [h.engine_id for h in handles]
+
+    def add_engine(self, model_id: Optional[str] = None, model=None,
+                   **engine_overrides) -> str:
+        """Spawn ONE more engine for an already-registered model at
+        runtime — the autoscaler's scale-up primitive. The new replica
+        reuses the ``add_model`` construction spec (shared model ref —
+        jax arrays are immutable, so weight sharing is free — plus the
+        original ``engine_kwargs``, including ``compile_cache_dir``: a
+        warm persistent compile cache means the newcomer materializes
+        its step programs from disk with ZERO fresh compiles);
+        ``model=`` / keyword overrides replace pieces of the spec. The
+        engine id is ``"<model_id>/<n>"`` with a monotone ``n`` that is
+        never reused after :meth:`remove_engine`, and the replica
+        enters rotation ``healthy`` immediately."""
+        mid = self._resolve_model(model_id)
+        base_model, kwargs = self._specs[mid]
+        kwargs = dict(kwargs)
+        kwargs.update(engine_overrides)
+        with self._lock:
+            idx = self._next_idx[mid]
+            self._next_idx[mid] = idx + 1
+        eid = f"{mid}/{idx}"
+        eng = ServingEngine(base_model if model is None else model,
+                            engine_id=eid, model_id=mid, **kwargs)
+        h = EngineHandle(eng, eid, mid)
+        with self._lock:
+            self._models[mid].append(h)
+            self._handles[eid] = h
+        self._set_state_gauge(h)
+        return eid
+
+    def remove_engine(self, engine_id: str) -> None:
+        """Retire one engine from the fleet — the autoscaler's
+        scale-down primitive, and deliberately the UNFORGIVING half of
+        drain-then-remove: the engine must already be gated out of
+        admission (``draining``/``down``, via :meth:`drain` or
+        :meth:`mark_down`) and must hold no work (its in-flight
+        requests finished locally while draining; a downed engine was
+        evacuated), and it must not be the model's last engine. Any
+        violation raises instead of dropping requests — callers that
+        want best-effort shedding have ``mark_down`` + migration for
+        that. The engine's state gauge lands on the ``down`` code (its
+        label child outlives the handle; 3 reads as "out of rotation"
+        on dashboards)."""
+        h = self._require(engine_id)
+        if h.state == HEALTHY:
+            raise ValueError(
+                f"engine {h.engine_id!r} is still healthy (admitting) — "
+                f"drain({h.engine_id!r}) first, step until its work "
+                f"finishes, then remove")
+        if self._safe_has_work(h):
+            raise ValueError(
+                f"engine {h.engine_id!r} still has queued or in-flight "
+                f"work — keep stepping the fleet until it drains")
+        # scoop outputs the engine finished but nobody collected yet:
+        # after the handle is gone take_outputs() can't reach them, and
+        # exactly-once handout must survive any remove/collect ordering
+        try:
+            self._stash.update(h.engine.take_outputs())
+        except Exception:
+            pass
+        with self._lock:
+            if len(self._models[h.model_id]) <= 1:
+                raise ValueError(
+                    f"engine {h.engine_id!r} is the last engine of model "
+                    f"{h.model_id!r} — a served model must keep at least "
+                    f"one replica (use drain() to just gate it out)")
+            self._models[h.model_id].remove(h)
+            del self._handles[h.engine_id]
+            h.state = DOWN
+        self._set_state_gauge(h)
 
     @property
     def models(self) -> List[str]:
@@ -236,6 +323,17 @@ class Router:
 
     def engine(self, engine_id: str) -> ServingEngine:
         return self._require(engine_id).engine
+
+    def handles(self, model: Optional[str] = None) -> List[EngineHandle]:
+        """Snapshot of one model's (or the whole fleet's) handles —
+        (engine, id, state) triples for controllers that read topology
+        without mutating it (the loadgen autoscaler's signal scan)."""
+        if model is not None:
+            mid = self._resolve_model(model)
+            with self._lock:
+                return list(self._models[mid])
+        with self._lock:
+            return list(self._handles.values())
 
     def states(self) -> Dict[str, str]:
         """{engine_id: state} snapshot of the whole fleet (safe from any
@@ -551,6 +649,24 @@ class Router:
         self._migrate_inflight(h)
         self._requeue_waiting(h)
 
+    def take_outputs(self) -> Dict[object, RequestOutput]:
+        """Outputs finished fleet-wide since the last collection, merged
+        across engines plus anything the router synthesized
+        (``_retire_unavailable`` dead ends) — exactly-once handout. The
+        incremental collector a PACED driver (``paddle_tpu.loadgen``)
+        needs: call it after each :meth:`step` instead of waiting for
+        :meth:`run` to drain the whole fleet."""
+        out = self._stash
+        self._stash = {}
+        for h in list(self._handles.values()):
+            try:
+                out.update(h.engine.take_outputs())
+            except Exception:
+                # a dead engine's outputs were already evacuated/stashed
+                # by containment; never let its corpse break collection
+                pass
+        return out
+
     def run(self) -> Dict[object, RequestOutput]:
         """Drive :meth:`step` until the whole fleet drains; returns every
         output finished since the last :meth:`run`, merged across engines
@@ -559,10 +675,7 @@ class Router:
         ``ServingEngine.run``."""
         while self.has_work:
             self.step()
-        out = self._stash
-        self._stash = {}
-        for h in self._handles.values():
-            out.update(h.engine.take_outputs())
+        out = self.take_outputs()
         # the fleet is fully drained: every request has retired, so NO
         # live request can still hold a move-once mark. Clearing (rather
         # than subtracting the delivered ids) also reaps marks of

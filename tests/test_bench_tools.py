@@ -102,3 +102,45 @@ def test_probe_backend_reports_cpu_platform():
                        capture_output=True, text=True, timeout=180)
     assert r.returncode == 0, r.stderr
     assert "PLAT cpu" in r.stdout
+
+
+def test_bench_load_row_schema_is_stable():
+    """The committed BENCH_LOAD.json (the fleet-level bench artifact,
+    ISSUE 15) must carry exactly the schema tools/bench_load.py pins —
+    values are host-dependent, keys are the contract BENCH digests and
+    future sessions rely on."""
+    bl = _load("bl_test", "bench_load.py")
+    with open(os.path.join(REPO, "BENCH_LOAD.json")) as f:
+        row = json.load(f)
+
+    assert set(row) == set(bl.ROW_KEYS)
+    assert row["metric"] == "BENCH_LOAD"
+    assert row["unit"] == "tokens/s"
+    assert row["value"] > 0
+    rep = row["report"]
+    assert set(rep) == set(bl.REPORT_KEYS)
+    assert rep["exactly_once"] is True and rep["violations"] == []
+    assert sum(rep["outcomes"].values()) == rep["num_requests"]
+    assert rep["engines_peak"] >= rep["engines_final"] >= 1
+    assert set(rep["tiers"]) == {"interactive", "standard", "batch"}
+    for tier in rep["tiers"].values():
+        assert set(tier) == set(bl.TIER_KEYS)
+        for k in ("ttft_attainment", "itl_attainment"):
+            assert tier[k] is None or 0.0 <= tier[k] <= 1.0
+
+
+def test_bench_load_build_row_trims_to_schema():
+    """build_row keeps ONLY the schema-stable keys (a LoadReport field
+    added later must not silently widen the committed artifact)."""
+    bl = _load("bl_row_test", "bench_load.py")
+    tier = {k: 1.0 for k in bl.TIER_KEYS}
+    tier["extra_tier_field"] = "drop me"
+    rep = {k: 0 for k in bl.REPORT_KEYS}
+    rep.update(goodput_tok_s=123.456, outcomes={"length": 2},
+               tiers={"gold": tier}, violations=[], exactly_once=True,
+               extra_report_field="drop me")
+    row = bl.build_row(rep, "cfg-label", "cpu")
+    assert set(row) == set(bl.ROW_KEYS)
+    assert row["value"] == 123.5
+    assert set(row["report"]) == set(bl.REPORT_KEYS)
+    assert set(row["report"]["tiers"]["gold"]) == set(bl.TIER_KEYS)

@@ -1,5 +1,5 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-14 — engine resilience, router failover/reload/dispatch, the
+1-15 — engine resilience, router failover/reload/dispatch, the
 kill-engine-mid-decode migration drill, the prefix-heavy failover
 drill that asserts migrated requests re-prefill through the adoptive
 sibling's prefix cache, the kill-engine-mid-chunked-prefill drill
@@ -11,7 +11,11 @@ threads over 200 seeded barrier-synced iterations under
 violations, and the kill-engine-mid-spec-burst drill that kills a
 speculatively-decoding engine and asserts migration journals carry
 only committed tokens — never unaccepted drafts — with streams
-bit-identical to a spec-off run) runs as slow-marked tests instead of
+bit-identical to a spec-off run, and the autoscale-under-burst drill
+that replays a seeded loadgen Poisson burst against a 1-engine fleet
+and asserts the queue-depth autoscaler scales 1->N->1 with exactly-once
+completion and zero fresh compiles on scale-up) runs as slow-marked
+tests instead of
 only by hand, one test per scenario so a regression names its drill.
 
 The scenarios are imported from the tool itself — one source of truth;

@@ -23,8 +23,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import faults, metrics
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny
-from paddle_tpu.serving import (BackpressureError, CompletionAPI, EnginePool,
-                                PagedKVCachePool, ServingEngine)
+from paddle_tpu.serving import (BackpressureError, CompletionAPI,
+                                PagedKVCachePool, Router, ServingEngine)
 
 pytestmark = pytest.mark.faults
 
@@ -665,15 +665,16 @@ class TestFrontDoorSatellites:
         resp = api.create_completion(np.arange(1, 4), max_tokens=2)
         assert resp["choices"][0]["finish_reason"] == "length"
 
-    def test_engine_pool_retrieve_bounds_and_next_round_robin(self):
-        pool = EnginePool(_tiny_llama(), size=2, page_size=4,
-                          max_batch_slots=1)
-        with pytest.raises(IndexError, match="size 2"):
-            pool.retrieve(2)
-        with pytest.raises(IndexError, match="size 2"):
-            pool.retrieve(-1)
-        a, b, c = pool.next(), pool.next(), pool.next()
-        assert a is pool.retrieve(0) and b is pool.retrieve(1) and c is a
+    def test_router_unknown_engine_and_idle_tie_rotation(self):
+        # the old EnginePool bounds/next() contract, on the Router
+        # surface: bad ids raise actionably, idle ties rotate modularly
+        router = Router()
+        router.add_model("default", _tiny_llama(), replicas=2,
+                         page_size=4, max_batch_slots=1)
+        with pytest.raises(KeyError, match="unknown engine id"):
+            router.engine("default/9")
+        a, b, c = (router.select().engine_id for _ in range(3))
+        assert a != b and c == a
 
 
 class TestLockSanitizer:

@@ -1,0 +1,460 @@
+"""paddle_tpu.loadgen: trace harness + queue-depth autoscaler (ISSUE 15).
+
+Acceptance gates: same-seed traces are BYTE-identical (`to_jsonl`), the
+arrival process matches its closed-form Poisson mean and the prompt-
+family mix matches the closed-form bounded-Zipf pmf within statistical
+tolerance; `Histogram.fraction_le` (the SLO-attainment read) agrees
+with hand-computed bucket interpolation; the autoscaler never flaps on
+an oscillating signal, scales up only after `hot_steps` consecutive hot
+observations + cooldown, and scales down strictly drain-then-remove —
+an engine with in-flight work is never removed and a drain cancels when
+demand returns; `Router.add_engine`/`remove_engine` enforce monotone
+never-reused ids, drain-first, and last-replica protection. The slow
+lane replays a full heavy-tail trace (Zipf sharing + Poisson burst +
+slow consumer + mixed tiers) against a fleet and asserts the LoadReport
+schema and exactly-once completion accounting twice with the same seed.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import loadgen, metrics
+from paddle_tpu.loadgen import (AutoscalerConfig, LoadDriver,
+                                QueueDepthAutoscaler, TierSpec, Trace,
+                                TraceConfig, VirtualClock,
+                                generate_trace, zipf_pmf)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import Router
+
+pytestmark = pytest.mark.serving
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=32, hidden_size=16, num_layers=1, num_heads=1,
+        num_key_value_heads=1, max_position_embeddings=32))
+
+
+_ENGINE_KW = dict(page_size=4, num_pages=64, max_batch_slots=2,
+                  max_model_len=32, token_budget=16, min_step_tokens=16,
+                  max_queue=64)
+
+
+# ───────────────────────────── trace ─────────────────────────────
+
+
+class TestTrace:
+    def test_same_seed_byte_identical(self):
+        cfg = TraceConfig(seed=11, num_requests=50, burst_start=0.5,
+                          burst_duration=1.0, slow_consumer_fraction=0.2)
+        a, b = generate_trace(cfg), generate_trace(cfg)
+        assert a.to_jsonl() == b.to_jsonl()
+        # and actually parseable, one object per request
+        lines = a.to_jsonl().splitlines()
+        assert len(lines) == 50
+        assert json.loads(lines[0])["index"] == 0
+
+    def test_different_seed_differs(self):
+        a = generate_trace(TraceConfig(seed=1, num_requests=30))
+        b = generate_trace(TraceConfig(seed=2, num_requests=30))
+        assert a.to_jsonl() != b.to_jsonl()
+
+    def test_poisson_interarrival_matches_closed_form(self):
+        rate = 20.0
+        cfg = TraceConfig(seed=5, num_requests=4000, arrival_rate=rate)
+        tr = generate_trace(cfg)
+        arr = np.asarray([r.arrival_s for r in tr.requests])
+        gaps = np.diff(np.concatenate([[0.0], arr]))
+        assert np.all(gaps > 0)          # strictly increasing arrivals
+        # mean gap = 1/rate; with n=4000 the sample mean sits within
+        # ~5 sigma = 5/(rate*sqrt(n)) of the closed form
+        assert abs(gaps.mean() - 1.0 / rate) < 5.0 / (rate * np.sqrt(4000))
+        # exponential: std ≈ mean (CV ~ 1), loose band
+        assert 0.8 < gaps.std() / gaps.mean() < 1.2
+
+    def test_burst_window_multiplies_rate(self):
+        cfg = TraceConfig(seed=7, num_requests=3000, arrival_rate=10.0,
+                          burst_start=0.0, burst_duration=1e9,
+                          burst_factor=5.0)
+        tr = generate_trace(cfg)
+        gaps = np.diff([0.0] + [r.arrival_s for r in tr.requests])
+        # the whole trace is inside the window: mean gap = 1/(rate*factor)
+        assert abs(np.mean(gaps) - 1.0 / 50.0) < 5.0 / (50.0 * np.sqrt(3000))
+
+    def test_zipf_family_share_matches_pmf(self):
+        cfg = TraceConfig(seed=9, num_requests=5000,
+                          num_prompt_families=6, zipf_a=1.2)
+        tr = generate_trace(cfg)
+        counts = np.bincount([r.family for r in tr.requests], minlength=6)
+        pmf = zipf_pmf(6, 1.2)
+        assert abs(pmf.sum() - 1.0) < 1e-12
+        assert np.all(np.diff(pmf) < 0)  # strictly rank-decreasing
+        emp = counts / counts.sum()
+        # binomial std per family ~ sqrt(p(1-p)/n) <= 0.0071; 5 sigma
+        assert np.max(np.abs(emp - pmf)) < 5 * np.sqrt(0.25 / 5000)
+        # every same-family prompt shares the same prefix (the radix
+        # cache bait), different families don't collide
+        by_fam = {}
+        for r in tr.requests:
+            by_fam.setdefault(r.family, set()).add(
+                r.prompt[:cfg.prefix_len])
+        assert all(len(s) == 1 for s in by_fam.values())
+
+    def test_heavy_tail_lengths_capped_and_spread(self):
+        cfg = TraceConfig(seed=3, num_requests=2000)
+        tr = generate_trace(cfg)
+        plens = [len(r.prompt) for r in tr.requests]
+        olens = [r.max_new_tokens for r in tr.requests]
+        assert max(plens) <= cfg.max_prompt_len
+        assert min(plens) >= cfg.prefix_len + 1
+        assert 1 <= min(olens) and max(olens) <= cfg.max_output_len
+        assert len(set(olens)) > 3       # an actual mix, not a constant
+
+    def test_tier_mix_and_validation(self):
+        tiers = (TierSpec("a", priority=0, weight=3.0, ttft_slo_s=0.5),
+                 TierSpec("b", priority=1, weight=1.0))
+        tr = generate_trace(TraceConfig(seed=1, num_requests=2000,
+                                        tiers=tiers))
+        counts = tr.tier_counts()
+        assert 0.7 < counts["a"] / 2000 < 0.8     # 3:1 weights
+        with pytest.raises(ValueError, match="hysteresis|greater"):
+            AutoscalerConfig(scale_up_depth=1.0, scale_down_depth=2.0)
+        with pytest.raises(ValueError, match="arrival_rate"):
+            TraceConfig(arrival_rate=0.0)
+        with pytest.raises(ValueError, match="prefix_len"):
+            TraceConfig(prefix_len=32, max_prompt_len=32)
+
+    def test_virtual_clock(self):
+        c = VirtualClock()
+        assert c.now() == 0.0 and c() == 0.0
+        c.advance(1.5)
+        assert c() == 1.5
+        with pytest.raises(ValueError):
+            c.advance(-1.0)
+
+
+# ─────────────────────── fraction_le (SLO read) ───────────────────────
+
+
+class TestFractionLe:
+    def test_matches_hand_computed_buckets(self):
+        reg = metrics.MetricsRegistry()
+        h = reg.histogram("h_test_seconds", "t",
+                          buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        assert h.fraction_le(1.0) == pytest.approx(0.25)   # the 0.5
+        # 2.0 covers bucket1 fully + bucket2 fully: 2 of 4
+        assert h.fraction_le(2.0) == pytest.approx(0.5)
+        # 3.0 interpolates half of bucket (2,4]: 2.5 of 4
+        assert h.fraction_le(3.0) == pytest.approx(0.625)
+        # at/above the top bound the +Inf bucket counts as attained
+        # (mirrors quantile()'s clamp to the last finite bound)
+        assert h.fraction_le(4.0) == 1.0
+        assert h.fraction_le(-1.0) == 0.0
+
+    def test_empty_and_labeled_merge(self):
+        reg = metrics.MetricsRegistry()
+        h = reg.histogram("h_test2_seconds", "t", labels=("tier",),
+                          buckets=(1.0, 2.0))
+        assert h.fraction_le(1.0) is None
+        h.labels(tier="a").observe(0.5)
+        h.labels(tier="b").observe(1.5)
+        assert h.labels(tier="a").fraction_le(1.0) == pytest.approx(1.0)
+        assert h.fraction_le(1.0) == pytest.approx(0.5)  # family merge
+
+
+# ─────────────────────── router topology surface ───────────────────────
+
+
+class TestRouterTopology:
+    def test_add_engine_monotone_ids_never_reused(self):
+        r = Router()
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        e1 = r.add_engine("m")
+        assert e1 == "m/1" and len(r) == 2
+        r.drain(e1)
+        r.remove_engine(e1)
+        assert len(r) == 1
+        # the freed index is NOT recycled: metrics/journals keyed by
+        # engine_id stay unambiguous across scale cycles
+        assert r.add_engine("m") == "m/2"
+
+    def test_remove_refuses_healthy_busy_and_last(self):
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        with pytest.raises(ValueError, match="healthy"):
+            r.remove_engine("m/0")
+        # busy: start a request ON m/1 (one step moves it queued ->
+        # in-flight slot), then drain — waiting work would requeue to a
+        # sibling, but IN-FLIGHT work finishes locally, so removal must
+        # refuse while it lives
+        rid = r.engine("m/1").add_request(np.array([1, 2], np.int32),
+                                          max_new_tokens=4)
+        r.step()
+        r.drain("m/1")
+        assert r.engine("m/1").has_work
+        with pytest.raises(ValueError, match="work"):
+            r.remove_engine("m/1")
+        out = r.run()
+        assert out[rid].finish_reason in ("stop", "length")
+        r.remove_engine("m/1")          # drained AND empty: fine now
+        r.drain("m/0")
+        with pytest.raises(ValueError, match="last engine"):
+            r.remove_engine("m/0")
+
+    def test_add_engine_inherits_spec(self):
+        r = Router()
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        eid = r.add_engine("m")
+        eng = r.engine(eid)
+        assert eng.pool.page_size == _ENGINE_KW["page_size"]
+        assert eng.scheduler.max_batch_slots == _ENGINE_KW["max_batch_slots"]
+        # the newcomer serves traffic immediately
+        rid = r.submit(np.array([3, 4, 5], np.int32), max_new_tokens=2)
+        outs = r.run()
+        assert outs[rid].finish_reason in ("stop", "length")
+
+
+# ───────────────────────────── autoscaler ─────────────────────────────
+
+
+class _FakeSched:
+    def __init__(self):
+        self.queue_depth = 0
+        self.waiting = []
+
+
+class _FakeEngine:
+    """Host-only engine stand-in: just the signal surface the scaler
+    reads (queue_depth, avg_step_s, load_score, has_work)."""
+
+    def __init__(self):
+        self.scheduler = _FakeSched()
+        self.avg_step_s = 0.05
+        self.has_work = False
+
+    def load_score(self):
+        return self.scheduler.queue_depth * self.avg_step_s
+
+
+class _FakeRouter:
+    """Topology + gating double for hysteresis unit tests (no jax)."""
+
+    def __init__(self, n=1):
+        from paddle_tpu.serving.router import (DRAINING, HEALTHY,
+                                               EngineHandle)
+        self._H, self._D = HEALTHY, DRAINING
+        self._hs = []
+        self._next = 0
+        for _ in range(n):
+            self._spawn()
+        self.removed = []
+
+    def _spawn(self):
+        from paddle_tpu.serving.router import EngineHandle
+        h = EngineHandle(_FakeEngine(), f"m/{self._next}", "m")
+        self._next += 1
+        self._hs.append(h)
+        return h.engine_id
+
+    def _resolve_model(self, model):
+        return "m"
+
+    def handles(self, model=None):
+        return list(self._hs)
+
+    def states(self):
+        return {h.engine_id: h.state for h in self._hs}
+
+    def engine(self, eid):
+        return next(h.engine for h in self._hs if h.engine_id == eid)
+
+    def add_engine(self, model):
+        return self._spawn()
+
+    def drain(self, eid):
+        next(h for h in self._hs if h.engine_id == eid).state = self._D
+
+    def undrain(self, eid):
+        next(h for h in self._hs if h.engine_id == eid).state = self._H
+
+    def remove_engine(self, eid):
+        h = next(h for h in self._hs if h.engine_id == eid)
+        if h.engine.has_work:
+            raise ValueError("still has work")
+        self._hs.remove(h)
+        self.removed.append(eid)
+
+    def set_depth(self, d):
+        for h in self._hs:
+            h.engine.scheduler.queue_depth = d
+
+
+def _scaler(router, **kw):
+    kw.setdefault("scale_up_depth", 4.0)
+    kw.setdefault("scale_down_depth", 1.0)
+    kw.setdefault("hot_steps", 3)
+    kw.setdefault("cold_steps", 3)
+    kw.setdefault("cooldown_steps", 5)
+    kw.setdefault("max_engines", 4)
+    return QueueDepthAutoscaler(router, config=AutoscalerConfig(**kw))
+
+
+class TestAutoscalerHysteresis:
+    def test_oscillating_depth_never_flaps(self):
+        r = _FakeRouter(2)
+        s = _scaler(r)
+        # oscillate INSIDE the hysteresis band and across it, but never
+        # long enough to satisfy hot_steps/cold_steps consecutively
+        for depth in (6, 0, 6, 0, 6, 0, 6, 0, 2, 3, 2, 3):
+            r.set_depth(depth)
+            assert s.observe() == "steady"
+        assert len(r.handles()) == 2 and s.events == []
+
+    def test_scale_up_needs_consecutive_hot_and_cooldown(self):
+        r = _FakeRouter(1)
+        s = _scaler(r)
+        r.set_depth(10)
+        assert s.observe() == "steady"
+        assert s.observe() == "steady"
+        assert s.observe() == "scale-up"          # 3rd consecutive hot
+        assert len(r.handles()) == 2
+        # still hot, but the cooldown window holds the fleet
+        for _ in range(5):
+            assert s.observe() == "cooldown"
+        # demand persisted through the whole window: the next tick grows
+        # again — a sustained burst ramps ONE engine per cooldown window
+        assert s.observe() == "scale-up"
+        assert len(r.handles()) == 3
+
+    def test_max_engines_is_a_ceiling(self):
+        r = _FakeRouter(2)
+        s = _scaler(r, max_engines=2)
+        r.set_depth(50)
+        for _ in range(10):
+            assert s.observe() == "steady"
+        assert len(r.handles()) == 2
+
+    def test_scale_down_drain_then_remove(self):
+        r = _FakeRouter(3)
+        s = _scaler(r, cold_steps=2)
+        r.set_depth(0)
+        assert s.observe() == "steady"
+        assert s.observe() == "draining"          # 2nd cold: drain starts
+        drained = [h for h in r.handles() if h.state == "draining"]
+        assert len(drained) == 1
+        # residual in-flight work: removal must wait
+        drained[0].engine.has_work = True
+        assert s.observe() == "draining"
+        assert len(r.handles()) == 3
+        drained[0].engine.has_work = False
+        assert s.observe() == "scale-down"
+        assert len(r.handles()) == 2
+        assert r.removed == [drained[0].engine_id]
+        # cooldown after the event
+        assert s.observe() == "cooldown"
+
+    def test_drain_cancels_when_demand_returns(self):
+        r = _FakeRouter(2)
+        s = _scaler(r, cold_steps=1)
+        r.set_depth(0)
+        assert s.observe() == "draining"
+        target = next(h for h in r.handles() if h.state == "draining")
+        target.engine.has_work = True      # still finishing its work
+        r.set_depth(20)                    # burst arrives mid-drain
+        assert s.observe() == "cancel-drain"
+        assert target.state == "healthy"   # back in rotation, not removed
+        assert r.removed == []
+
+    def test_min_engines_floor(self):
+        r = _FakeRouter(1)
+        s = _scaler(r, cold_steps=1)
+        r.set_depth(0)
+        for _ in range(6):
+            assert s.observe() == "steady"
+        assert len(r.handles()) == 1
+
+    def test_draining_engine_excluded_from_signal(self):
+        r = _FakeRouter(2)
+        s = _scaler(r)
+        r.set_depth(8)
+        r.drain(r.handles()[0].engine_id)
+        # only the healthy engine counts: signal is 8, not 16/2
+        assert s.signal() == pytest.approx(8.0)
+
+
+class TestDrainNeverStrands:
+    def test_scale_down_with_inflight_completes_everything(self):
+        """Drain-then-remove on a REAL fleet mid-traffic: every request
+        retires normally, and the removed engine exits only once empty."""
+        r = Router()
+        r.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        s = QueueDepthAutoscaler(r, config=AutoscalerConfig(
+            min_engines=1, max_engines=2, scale_up_depth=100.0,
+            scale_down_depth=0.5, hot_steps=1, cold_steps=1,
+            cooldown_steps=0))
+        rids = [r.submit(np.array([1 + i, 2, 3], np.int32),
+                         max_new_tokens=3) for i in range(4)]
+        outs = {}
+        for _ in range(200):
+            r.step()
+            s.observe()
+            outs.update(r.take_outputs())
+            if len(r.handles("m")) == 1 and not r.has_work:
+                break
+        outs.update(r.take_outputs())
+        assert len(r.handles("m")) == 1           # scaled down to floor
+        assert sorted(outs) == sorted(rids)       # nobody stranded
+        assert all(outs[i].finish_reason in ("stop", "length")
+                   for i in rids)
+        assert any(d == "scale-down" for d, _ in s.events)
+
+
+# ─────────────────────── end-to-end fleet drill ───────────────────────
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def _drill(self):
+        r = Router()
+        r.add_model("m", _model(), replicas=3, **_ENGINE_KW)
+        cfg = TraceConfig(
+            seed=42, num_requests=24, vocab_size=32, arrival_rate=12.0,
+            burst_start=0.3, burst_duration=1.0, burst_factor=5.0,
+            num_prompt_families=4, prefix_len=6, max_prompt_len=20,
+            max_output_len=6, slow_consumer_fraction=0.08,
+            tiers=(TierSpec("gold", 0, 1.0, None, 1.0, 0.5),
+                   TierSpec("bronze", 2, 1.0, None, 8.0, 4.0)))
+        trace = generate_trace(cfg)
+        rep = LoadDriver(r, trace).run()
+        return trace, rep
+
+    def test_loadreport_schema_and_same_seed_accounting(self):
+        t1, r1 = self._drill()
+        t2, r2 = self._drill()
+        # same seed: same request stream...
+        assert t1.to_jsonl() == t2.to_jsonl()
+        # ...and the same exactly-once completion accounting
+        assert r1.exactly_once and r2.exactly_once, (r1.violations,
+                                                     r2.violations)
+        assert r1.outcomes == r2.outcomes
+        assert r1.submitted == r2.submitted == 24
+        d = r1.to_dict()
+        for key in ("seed", "num_requests", "goodput_tok_s", "outcomes",
+                    "tiers", "unavailable_rate", "timeout_rate",
+                    "prefix_hit_ratio", "engines_peak", "violations"):
+            assert key in d
+        assert set(d["tiers"]) == {"gold", "bronze"}
+        for tier in d["tiers"].values():
+            assert set(tier) >= {"requests", "ttft_attainment",
+                                 "itl_attainment", "ttft_slo_s"}
+            assert tier["requests"] > 0
+            assert tier["ttft_attainment"] is None \
+                or 0.0 <= tier["ttft_attainment"] <= 1.0
+        assert d["goodput_tok_s"] > 0
+        assert d["prefix_hit_ratio"] is not None  # Zipf sharing hit
+        assert json.dumps(d)                      # JSON-serializable

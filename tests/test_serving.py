@@ -15,9 +15,10 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.models import (GPTForCausalLM, LlamaForCausalLM, gpt_tiny,
                                llama_tiny)
-from paddle_tpu.serving import (CompletionAPI, EnginePool, FCFSScheduler,
-                                PagedKVCachePool, Request, ServingEngine,
-                                page_bytes, pages_for_hbm_budget)
+from paddle_tpu.serving import (CompletionAPI, FCFSScheduler,
+                                PagedKVCachePool, Request, Router,
+                                ServingEngine, page_bytes,
+                                pages_for_hbm_budget)
 
 pytestmark = pytest.mark.serving
 
@@ -1243,12 +1244,17 @@ class TestCompletionAPI:
                               seed=7)
         assert seeds == [7, 8]
 
-    def test_engine_pool_retrieve(self):
-        pool = EnginePool(_llama(), size=2, page_size=4, max_batch_slots=1)
-        assert len(pool) == 2
-        assert pool.retrieve(0) is not pool.retrieve(1)
-        rid = pool.retrieve(1).add_request(_PROMPTS[2], max_new_tokens=2)
-        outs = pool.retrieve(1).run()
+    def test_router_replicas_distinct_and_individually_drivable(self):
+        # the old EnginePool.retrieve() contract, on the Router surface:
+        # replicas are distinct engines and each can be driven alone
+        router = Router()
+        router.add_model("default", _llama(), replicas=2, page_size=4,
+                         max_batch_slots=1)
+        engines = router.engines()
+        assert len(router) == 2
+        assert engines[0] is not engines[1]
+        rid = engines[1].add_request(_PROMPTS[2], max_new_tokens=2)
+        outs = engines[1].run()
         assert outs[rid].n_gen == 2
 
 

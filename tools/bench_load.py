@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Fleet-level load benchmark: replay a seeded paddle_tpu.loadgen trace
+against a Router fleet with the queue-depth autoscaler attached and
+emit ONE ``BENCH_LOAD`` row — goodput tok/s, per-tier SLO attainment,
+unavailable rate, scale trajectory — the first bench artifact that
+measures the fleet, not a lone engine (ISSUE 15).
+
+The committed ``BENCH_LOAD.json`` comes from the CPU smoke::
+
+    JAX_PLATFORMS=cpu python tools/bench_load.py --out BENCH_LOAD.json
+
+Fixed seed + fixed fleet: the REQUEST STREAM and the completion
+accounting are reproducible (same trace bytes, same outcome counts,
+exactly-once always); latencies and goodput are whatever the host does
+that day, which is why ``tests/test_bench_tools.py`` asserts the
+artifact's SCHEMA, never its values. Knobs ride argv/env:
+``--requests/--seed/--max-engines`` (or BENCH_LOAD_REQUESTS etc.) size
+the drill; the defaults finish in seconds on CPU.
+
+The row shape follows tools/bench_decode.py (metric/value/unit/
+vs_baseline/config/device) so BENCH digests treat fleet rows like
+engine rows; the fleet-only evidence lands under ``"report"``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# every key a BENCH_LOAD row must carry — tests/test_bench_tools.py
+# pins this schema against the committed BENCH_LOAD.json
+ROW_KEYS = ("metric", "value", "unit", "vs_baseline", "config", "device",
+            "report")
+REPORT_KEYS = ("seed", "num_requests", "goodput_tok_s", "outcomes",
+               "tiers", "unavailable_rate", "timeout_rate",
+               "prefix_hit_ratio", "engines_peak", "engines_final",
+               "scale_ups", "scale_downs", "exactly_once", "violations")
+TIER_KEYS = ("requests", "ttft_slo_s", "itl_slo_s", "ttft_attainment",
+             "itl_attainment")
+
+
+def build_row(report_dict: dict, config_label: str, device: str) -> dict:
+    """The one BENCH_LOAD row, schema-pinned: headline value is goodput
+    tok/s; the LoadReport evidence (already a plain dict) rides along
+    trimmed to the schema-stable keys."""
+    rep = {k: report_dict[k] for k in REPORT_KEYS}
+    rep["tiers"] = {
+        name: {k: tier[k] for k in TIER_KEYS}
+        for name, tier in report_dict["tiers"].items()}
+    return {
+        "metric": "BENCH_LOAD",
+        "value": round(float(report_dict["goodput_tok_s"]), 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "config": config_label,
+        "device": device,
+        "report": rep,
+    }
+
+
+def run_drill(seed: int, requests: int, max_engines: int):
+    """Seeded heavy-tail drill: Zipf sharing + Poisson burst + slow
+    consumers + mixed tiers against a 1-engine fleet the autoscaler may
+    grow to ``max_engines``. Returns (LoadReport, config_label,
+    device_platform)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import loadgen
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Router
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+    router = Router()
+    router.add_model("bench", model, replicas=1, page_size=4,
+                     num_pages=128, max_batch_slots=4, max_model_len=64,
+                     token_budget=32, min_step_tokens=32, max_queue=128)
+    cfg = loadgen.TraceConfig(
+        seed=seed, num_requests=requests, vocab_size=128,
+        arrival_rate=8.0, burst_start=0.3, burst_duration=1.5,
+        burst_factor=6.0, num_prompt_families=6, prefix_len=8,
+        max_prompt_len=28, max_output_len=8,
+        slow_consumer_fraction=0.05)
+    trace = loadgen.generate_trace(cfg)
+    scaler = loadgen.QueueDepthAutoscaler(
+        router, config=loadgen.AutoscalerConfig(
+            min_engines=1, max_engines=max_engines, scale_up_depth=2.0,
+            scale_down_depth=0.25, hot_steps=2, cold_steps=6,
+            cooldown_steps=6))
+    report = loadgen.LoadDriver(router, trace, autoscaler=scaler).run()
+    label = (f"llama-tiny fleet 1..{max_engines} seed={seed} "
+             f"n={requests} burst=6x zipf=1.2 slow=5%")
+    return report, label, str(jax.devices()[0].platform)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("BENCH_LOAD_SEED", "0")))
+    ap.add_argument("--requests", type=int,
+                    default=int(os.environ.get("BENCH_LOAD_REQUESTS",
+                                               "32")))
+    ap.add_argument("--max-engines", type=int,
+                    default=int(os.environ.get("BENCH_LOAD_MAX_ENGINES",
+                                               "3")))
+    ap.add_argument("--out", default=None,
+                    help="write the row to this file (e.g. "
+                         "BENCH_LOAD.json); stdout always gets it")
+    args = ap.parse_args(argv)
+
+    report, label, device = run_drill(args.seed, args.requests,
+                                      args.max_engines)
+    row = build_row(report.to_dict(), label, device)
+    print(json.dumps(row, indent=2, sort_keys=True))
+    if not report.exactly_once:
+        print(f"ACCOUNTING VIOLATIONS: {report.violations}",
+              file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(row, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

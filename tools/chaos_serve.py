@@ -37,7 +37,14 @@ bursting at 100% acceptance, one fed always-rejected garbage — the
 busiest engine dies between bursts, and every migrated journal must
 carry only committed tokens (never an unaccepted draft), with final
 streams bit-identical to a spec-off lone engine and chunks
-exactly-once. Each scenario asserts both the behavior
+exactly-once. Scenario 15 replays a seeded Poisson-burst loadgen trace
+(ISSUE 15) against a 1-engine fleet with the queue-depth autoscaler
+attached: the burst must scale the fleet up (new engines materialize
+their pinned step shape from the persistent compile cache with ZERO
+fresh compiles) and the post-burst cold signal must drain-then-remove
+back to exactly 1 engine — every trace request completing or retiring
+``"unavailable"`` exactly-once, no leaked pages or move-once marks.
+Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
 
@@ -861,6 +868,75 @@ def scenario_kill_engine_mid_spec_burst(model):
             "the spec-off run, chunks exactly-once")
 
 
+def scenario_autoscale_under_burst(model):
+    """Scenario 15 (ISSUE 15): the loadgen autoscaler drill. A seeded
+    Poisson trace with an 8x burst window replays against a 1-engine
+    fleet whose queue-depth autoscaler may grow to 3; the burst must
+    scale the fleet up and the post-burst cold signal must drain it
+    back to exactly 1 — strictly drain-then-remove, so every one of the
+    trace's requests completes (or retires ``"unavailable"``)
+    exactly-once, with zero duplicated outputs, zero leaked pages, zero
+    leaked move-once marks, AND zero fresh jit compiles after the warm
+    phase: every engine the scaler spawns materializes its pinned step
+    shape from the shared persistent compile cache (ISSUE 14)."""
+    from paddle_tpu import loadgen
+
+    cache_dir = tempfile.mkdtemp(prefix="chaos15-compile-cache-")
+    try:
+        r = Router()
+        r.add_model("m", model, replicas=1, page_size=4, num_pages=128,
+                    max_batch_slots=4, max_model_len=64, token_budget=32,
+                    min_step_tokens=32, max_queue=128,
+                    compile_cache_dir=cache_dir)
+        # warm phase: one request compiles THE pinned step shape
+        # (min_step_tokens=token_budget -> a single grid bucket) and
+        # persists it; from here on, scale-up must be compile-free
+        r.submit(P5, max_new_tokens=2)
+        r.run()
+        cfg = loadgen.TraceConfig(
+            seed=SEED + 15, num_requests=32, vocab_size=128,
+            arrival_rate=8.0, burst_start=0.2, burst_duration=1.5,
+            burst_factor=8.0, num_prompt_families=4, prefix_len=6,
+            max_prompt_len=24, max_output_len=6,
+            slow_consumer_fraction=0.05)
+        trace = loadgen.generate_trace(cfg)
+        scaler = loadgen.QueueDepthAutoscaler(
+            r, config=loadgen.AutoscalerConfig(
+                min_engines=1, max_engines=3, scale_up_depth=2.0,
+                scale_down_depth=0.25, hot_steps=2, cold_steps=6,
+                cooldown_steps=6))
+        rep = loadgen.LoadDriver(r, trace, autoscaler=scaler).run()
+        _check(rep.exactly_once,
+               f"completion accounting violated: {rep.violations[:3]}")
+        _check(rep.engines_peak >= 2, "the burst never scaled the fleet")
+        _check(rep.engines_final == 1,
+               f"fleet did not drain back to 1 ({rep.engines_final})")
+        _check(rep.scale_ups >= 1 and rep.scale_downs >= 1,
+               f"missing scale events (ups={rep.scale_ups}, "
+               f"downs={rep.scale_downs})")
+        _check(rep.scale_ups == rep.scale_downs,
+               "unbalanced scale events for a fleet that returned home")
+        bad = {k: v for k, v in rep.outcomes.items()
+               if k not in ("stop", "length", "unavailable")}
+        _check(not bad, f"requests neither completed nor retired "
+               f"unavailable: {bad}")
+        _check(sum(rep.outcomes.values()) == cfg.num_requests,
+               "outcome count != trace size")
+        _check(rep.fresh_compiles == 0,
+               f"{rep.fresh_compiles} fresh compiles on scale-up "
+               f"(persistent cache missed)")
+        _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+               "pages leaked")
+        _check(r._requeued == set(), "move-once marks leaked")
+        return (f"burst scaled 1->{rep.engines_peak}->1 "
+                f"({rep.scale_ups} up, {rep.scale_downs} down), "
+                f"{cfg.num_requests} requests exactly-once "
+                f"({rep.outcomes}), 0 fresh compiles on scale-up, "
+                f"goodput {rep.goodput_tok_s:.0f} tok/s")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -877,6 +953,7 @@ SCENARIOS = [
      scenario_kill_engine_mid_chunked_prefill),
     ("thread-fuzz-control-plane", scenario_thread_fuzz_control_plane),
     ("kill-engine-mid-spec-burst", scenario_kill_engine_mid_spec_burst),
+    ("autoscale-under-burst", scenario_autoscale_under_burst),
 ]
 
 

@@ -73,7 +73,41 @@ def _demo_registry():
                            max_new_tokens=3)
         engine.run()
     _demo_train_sentinel()
+    _demo_loadgen()
     return metrics.get_registry()
+
+
+def _demo_loadgen():
+    """Short loadgen drill: a seeded burst trace against a 1-engine
+    fleet whose autoscaler may grow to 2, so every ISSUE 15 series
+    (paddle_tpu_loadgen_{ttft,itl}_seconds{tier}, _requests_total
+    {tier,outcome}, _submit_retries_total, paddle_tpu_autoscaler_
+    engines/backlog_seconds/scale_events_total/decisions_total) is
+    live in the --demo snapshot."""
+    import paddle_tpu as paddle
+    from paddle_tpu import loadgen
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Router
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32))
+    router = Router()
+    router.add_model("loadgen-demo", model, replicas=1, page_size=4,
+                     num_pages=64, max_batch_slots=2, max_model_len=32,
+                     token_budget=16, min_step_tokens=16, max_queue=64)
+    trace = loadgen.generate_trace(loadgen.TraceConfig(
+        seed=0, num_requests=12, vocab_size=64, arrival_rate=10.0,
+        burst_start=0.1, burst_duration=0.8, burst_factor=6.0,
+        prefix_len=5, max_prompt_len=16, max_output_len=4,
+        slow_consumer_fraction=0.1))
+    scaler = loadgen.QueueDepthAutoscaler(
+        router, config=loadgen.AutoscalerConfig(
+            min_engines=1, max_engines=2, scale_up_depth=1.5,
+            scale_down_depth=0.25, hot_steps=2, cold_steps=4,
+            cooldown_steps=4))
+    loadgen.LoadDriver(router, trace, autoscaler=scaler).run()
 
 
 def _demo_train_sentinel():

@@ -56,6 +56,7 @@ NAMESPACES = [
     "paddle_tpu.onnx",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.loadgen",
     "paddle_tpu.quantization",
     "paddle_tpu.profiler",
     "paddle_tpu.incubate.nn",
