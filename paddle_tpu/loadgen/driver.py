@@ -98,6 +98,12 @@ class LoadReport:
     engines_final: int = 0
     scale_ups: int = 0
     scale_downs: int = 0
+    # multi-tenancy (ISSUE 16): delivered-goodput tok/s per LoRA tenant
+    # (key "" is the base-model share), and the fraction of constrained
+    # requests whose delivered tokens VALIDATE against their grammar
+    # (None: the trace ran no constrained requests)
+    adapter_goodput: Dict[str, float] = field(default_factory=dict)
+    constrained_validity: Optional[float] = None
     exactly_once: bool = True
     violations: List[str] = field(default_factory=list)
 
@@ -144,11 +150,21 @@ class LoadDriver:
                  submit_retries: int = 50,
                  max_steps: int = 20000,
                  settle_steps: int = 400,
-                 clock: Optional[VirtualClock] = None):
+                 clock: Optional[VirtualClock] = None,
+                 tokenizer=None):
         self._router = router
         self._trace = trace
         self._model = model
         self._scaler = autoscaler
+        # grammar patterns in the trace are strings; compile each ONCE
+        # against the tokenizer (default: the toy tokenizer over the
+        # trace's vocab) and reuse — interning on the engine side then
+        # dedups by (pattern, vocab, eos) too
+        if tokenizer is None:
+            from ..serving.grammar import toy_tokenizer
+            tokenizer = toy_tokenizer(trace.config.vocab_size)
+        self._tokenizer = tokenizer
+        self._fsm_cache: Dict[str, object] = {}
         self._clock = clock or VirtualClock()
         self._step_dt = (float(step_dt) if step_dt is not None
                          else 2.0 / trace.config.arrival_rate)
@@ -267,17 +283,30 @@ class LoadDriver:
         return self._score(recs, rejected, outputs, dup_outputs, deltas,
                            wall_s, steps, engines_start, engines_peak)
 
+    def _fsm(self, pattern: str):
+        fsm = self._fsm_cache.get(pattern)
+        if fsm is None:
+            from ..serving.grammar import GrammarFSM
+            fsm = GrammarFSM.compile(pattern, self._tokenizer)
+            self._fsm_cache[pattern] = fsm
+        return fsm
+
     def _try_submit(self, rec: _RequestRecord) -> bool:
         tr = rec.trace_req
         rec.attempts += 1
         rec.t_submit = time.perf_counter()
+        kwargs = {}
+        if tr.adapter_id is not None:
+            kwargs["adapter_id"] = tr.adapter_id
+        if tr.grammar is not None:
+            kwargs["grammar"] = self._fsm(tr.grammar)
         try:
             rec.rid = self._router.submit(
                 np.asarray(tr.prompt, np.int32), model=self._model,
                 max_new_tokens=tr.max_new_tokens,
                 temperature=tr.temperature, seed=tr.seed,
                 deadline_s=tr.deadline_s, priority=tr.priority,
-                stream_cb=self._make_cb(rec))
+                stream_cb=self._make_cb(rec), **kwargs)
             return True
         except (BackpressureError, NoHealthyEngineError):
             self._m_retries.inc()
@@ -302,6 +331,8 @@ class LoadDriver:
                          engines_final=len(
                              self._router.handles(self._model)))
         rejected_set = set(id(r) for r in rejected)
+        self._adp_tokens: Dict[str, int] = {}
+        self._constrained = [0, 0]   # [validated, finished-constrained]
         tier_specs = {t.name: t for t in self._trace.config.tiers}
         for name, spec in tier_specs.items():
             rep.tiers[name] = TierReport(ttft_slo_s=spec.ttft_slo_s,
@@ -353,6 +384,12 @@ class LoadDriver:
                 1 for d, _ in self._scaler.events if d == "scale-up")
             rep.scale_downs = sum(
                 1 for d, _ in self._scaler.events if d == "scale-down")
+        if wall_s > 0:
+            rep.adapter_goodput = {
+                k: v / wall_s for k, v in sorted(self._adp_tokens.items())}
+        if self._constrained[1]:
+            rep.constrained_validity = (self._constrained[0]
+                                        / self._constrained[1])
         rep.exactly_once = not rep.violations
         return rep
 
@@ -386,8 +423,25 @@ class LoadDriver:
                 f"{tag}: output has {len(out.token_ids)} tokens, "
                 f"stream delivered {len(rec.seqs)}")
         rep.total_tokens += len(out.token_ids)
+        tr = rec.trace_req
         if out.finish_reason in ("stop", "length"):
             rep.goodput_tokens += len(out.token_ids)
+            key = tr.adapter_id or ""
+            self._adp_tokens[key] = (self._adp_tokens.get(key, 0)
+                                     + len(out.token_ids))
+            if tr.grammar is not None:
+                # validity is re-derived from the DELIVERED tokens, not
+                # trusted from the engine: the drill's acceptance gate.
+                # A "stop" that fails to validate is an engine bug and a
+                # violation; a "length" truncation mid-structure only
+                # lowers the rate (the client asked for too few tokens).
+                self._constrained[1] += 1
+                if self._fsm(tr.grammar).validates(out.token_ids):
+                    self._constrained[0] += 1
+                elif out.finish_reason == "stop":
+                    rep.violations.append(
+                        f"{tag}: constrained output does not validate "
+                        f"against {tr.grammar!r}")
         return out.finish_reason
 
 

@@ -125,6 +125,16 @@ class TraceConfig:
     slow_consumer_fraction: float = 0.0
     slow_consumer_work: int = 2000
     tiers: Tuple[TierSpec, ...] = DEFAULT_TIERS
+    # multi-tenancy mixes (ISSUE 16). adapter_mix: weighted
+    # (adapter_id, weight) pairs — None as an id means "no adapter"
+    # (the base model share). schema_mix: weighted (regex, weight)
+    # pairs of CONSTRAINT PATTERNS (strings, so the trace stays
+    # JSON-serializable; the driver compiles each to a GrammarFSM
+    # against its tokenizer) — None as a pattern means unconstrained.
+    # Both default None = feature off: NO extra rng draws happen, so
+    # pre-ISSUE-16 traces byte-reproduce unchanged.
+    adapter_mix: Optional[Tuple[Tuple[Optional[str], float], ...]] = None
+    schema_mix: Optional[Tuple[Tuple[Optional[str], float], ...]] = None
 
     def __post_init__(self):
         if self.num_requests < 1:
@@ -140,6 +150,20 @@ class TraceConfig:
                              "(prefix_len < max_prompt_len)")
         if not 0.0 <= self.slow_consumer_fraction <= 1.0:
             raise ValueError("slow_consumer_fraction must be in [0, 1]")
+        for knob in ("adapter_mix", "schema_mix"):
+            mix = getattr(self, knob)
+            if mix is None:
+                continue
+            if not mix:
+                raise ValueError(f"{knob} must be None (off) or a "
+                                 "non-empty weighted tuple")
+            for entry, w in mix:
+                if entry is not None and not isinstance(entry, str):
+                    raise ValueError(
+                        f"{knob} entries must be str or None, got "
+                        f"{entry!r}")
+                if w <= 0:
+                    raise ValueError(f"{knob} weights must be > 0")
 
 
 @dataclass(frozen=True)
@@ -162,6 +186,11 @@ class TraceRequest:
     ttft_slo_s: float
     itl_slo_s: float
     slow_consumer: bool
+    # multi-tenancy (ISSUE 16): the LoRA tenant and the constraint
+    # PATTERN (a regex string — the driver compiles it). Defaults keep
+    # asdict()/to_jsonl() append-only vs pre-16 traces.
+    adapter_id: Optional[str] = None
+    grammar: Optional[str] = None
 
 
 @dataclass
@@ -260,11 +289,24 @@ def generate_trace(config: TraceConfig) -> Trace:
         tier = cfg.tiers[int(rng.choice(len(cfg.tiers), p=tier_p))]
         req_seed = int(rng.integers(0, 2**31 - 1))
         slow = bool(rng.random() < cfg.slow_consumer_fraction)
+        # tenancy draws are GATED on the knob being set: an off knob
+        # consumes no rng state, so pre-ISSUE-16 configs byte-reproduce
+        adapter = None
+        if cfg.adapter_mix is not None:
+            aw = np.asarray([w for _, w in cfg.adapter_mix], np.float64)
+            adapter = cfg.adapter_mix[
+                int(rng.choice(len(cfg.adapter_mix), p=aw / aw.sum()))][0]
+        pattern = None
+        if cfg.schema_mix is not None:
+            sw = np.asarray([w for _, w in cfg.schema_mix], np.float64)
+            pattern = cfg.schema_mix[
+                int(rng.choice(len(cfg.schema_mix), p=sw / sw.sum()))][0]
         reqs.append(TraceRequest(
             index=i, arrival_s=float(t_arr),
             prompt=prefixes[fam] + suffix, family=fam,
             max_new_tokens=n_out, temperature=cfg.temperature,
             seed=req_seed, tier=tier.name, priority=tier.priority,
             deadline_s=tier.deadline_s, ttft_slo_s=tier.ttft_slo_s,
-            itl_slo_s=tier.itl_slo_s, slow_consumer=slow))
+            itl_slo_s=tier.itl_slo_s, slow_consumer=slow,
+            adapter_id=adapter, grammar=pattern))
     return Trace(config=cfg, requests=reqs)

@@ -211,20 +211,30 @@ class GPTAttention(nn.Layer):
                           [ensure_tensor(ctx)], name="merge_heads")
         return self.out_proj(merged)
 
-    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
+                      adapters=None, layer_idx=0):
         """Paged-KV ragged step (serving engine): one QUERY TOKEN per
         row — decode tokens and prompt-chunk tokens alike (the unified
         step's flattened grid; ops/pallas/paged_attention.py "Ragged
         form") — KV write hook scattering into the page pool at per-row
         positions, then ragged paged attention over each row's block
         table masked at the row's own position. Position embeddings were
-        already added at the trunk level (GPTModel.forward_paged)."""
+        already added at the trunk level (GPTModel.forward_paged).
+
+        ``adapters`` (docs/SERVING.md "Multi-LoRA adapters"): per-row
+        gathered LoRA stacks ``{site: (A, B)}``; GPT's fused QKV takes
+        ONE delta on the concatenated [B, 1, 3H] output (the delta
+        splits with it), out_proj one on the merged context."""
         from ..ops.pallas.paged_attention import ragged_paged_attention
 
         B = x.shape[0]
         nh, hd = self.cfg.num_heads, self.head_dim
         scale = 1.0 / math.sqrt(hd)
         qkv = self.qkv_proj(x)  # [B, 1, 3H]
+        if adapters is not None:
+            from ..serving.adapters import lora_delta
+
+            qkv = qkv + lora_delta(x, *adapters["qkv_proj"], layer_idx)
 
         def paged_step(qkv_v, kp, vp, bt, pos):
             pos = pos.astype(jnp.int32).reshape(B)
@@ -249,7 +259,13 @@ class GPTAttention(nn.Layer):
              ensure_tensor(v_pool), ensure_tensor(block_tables),
              ensure_tensor(positions)],
             name="gpt_paged_attention")
-        return self.out_proj(merged), (new_k, new_v)
+        out = self.out_proj(merged)
+        if adapters is not None:
+            from ..serving.adapters import lora_delta
+
+            out = out + lora_delta(merged, *adapters["out_proj"],
+                                   layer_idx)
+        return out, (new_k, new_v)
 
 
 class GPTMLP(nn.Layer):
@@ -275,8 +291,15 @@ class GPTMLP(nn.Layer):
                 initializer=_normal_init(proj_std)))
         self._gelu_approx = config.gelu_approximate
 
-    def forward(self, x):
-        return self.fc2(F.gelu(self.fc1(x), approximate=self._gelu_approx))
+    def forward(self, x, adapters=None, layer_idx=0):
+        if adapters is None:
+            return self.fc2(F.gelu(self.fc1(x),
+                                   approximate=self._gelu_approx))
+        from ..serving.adapters import lora_delta
+
+        h = self.fc1(x) + lora_delta(x, *adapters["fc1"], layer_idx)
+        a = F.gelu(h, approximate=self._gelu_approx)
+        return self.fc2(a) + lora_delta(a, *adapters["fc2"], layer_idx)
 
 
 class GPTDecoderLayer(nn.Layer):
@@ -307,11 +330,15 @@ class GPTDecoderLayer(nn.Layer):
             h = F.dropout(h, self.drop_p)
         return x + h
 
-    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
+                      adapters=None, layer_idx=0):
         h, nc = self.attn.forward_paged(self.ln1(x), positions,
-                                        block_tables, k_pool, v_pool)
+                                        block_tables, k_pool, v_pool,
+                                        adapters=adapters,
+                                        layer_idx=layer_idx)
         x = x + h
-        return x + self.mlp(self.ln2(x)), nc
+        return x + self.mlp(self.ln2(x), adapters=adapters,
+                            layer_idx=layer_idx), nc
 
 
 class GPTModel(nn.Layer):
@@ -418,12 +445,15 @@ class GPTModel(nn.Layer):
                 x = layer(x)
         return self.ln_f(x)
 
-    def forward_paged(self, input_ids, positions, block_tables, caches):
+    def forward_paged(self, input_ids, positions, block_tables, caches,
+                      adapters=None):
         """Paged decode trunk (serving engine): ``input_ids`` [B, 1],
         ``positions`` [B] per-row absolute positions (the learned position
         embedding is gathered per row — the paged counterpart of the
         cur_len-offset decode_positions), ``caches`` a per-layer list of
-        (k_pool, v_pool) page pools. Returns (hidden, new_caches)."""
+        (k_pool, v_pool) page pools. ``adapters``: per-row gathered LoRA
+        stacks ``{site: (A, B)}`` applied at every projection per layer
+        (zero for slot-0 rows). Returns (hidden, new_caches)."""
         if self._pp > 1:
             raise NotImplementedError(
                 "paged decode requires pp=1 (same single-program scope as "
@@ -434,8 +464,9 @@ class GPTModel(nn.Layer):
             [ensure_tensor(positions)], name="paged_positions")
         x = self.embeddings(ids) + self.position_embeddings(pos_ids)
         new_caches = []
-        for layer, (kp, vp) in zip(self.layers, caches):
-            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp)
+        for li, (layer, (kp, vp)) in enumerate(zip(self.layers, caches)):
+            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp,
+                                        adapters=adapters, layer_idx=li)
             new_caches.append(nc)
         return self.ln_f(x), new_caches
 
@@ -504,3 +535,15 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         cfg = self.config
         return (cfg.num_layers, cfg.num_heads,
                 cfg.hidden_size // cfg.num_heads)
+
+    def lora_sites(self):
+        """The AdapterStore contract (serving/adapters.py): ordered
+        ``(site, in_dim, out_dim)`` triples plus the layer count. GPT's
+        QKV is FUSED, so one ``qkv_proj`` site covers all three with a
+        [H → 3H] delta that splits alongside the base projection.
+        Dims are unsharded — multi-LoRA serving assumes mp=1."""
+        cfg = self.config
+        h, ff = cfg.hidden_size, cfg.intermediate_size
+        sites = [("qkv_proj", h, 3 * h), ("out_proj", h, h),
+                 ("fc1", h, ff), ("fc2", ff, h)]
+        return sites, cfg.num_layers

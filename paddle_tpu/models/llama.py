@@ -161,7 +161,8 @@ class LlamaAttention(nn.Layer):
                                     weight_attr=nn.ParamAttr(
                                         initializer=_normal_init(proj_std)))
 
-    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
+                      adapters=None, layer_idx=0):
         """Paged-KV ragged step (serving engine): one QUERY TOKEN per
         row — a decode slot's next token, or one token of a prompt
         chunk (the unified step flattens mixed per-slot query lengths
@@ -176,6 +177,11 @@ class LlamaAttention(nn.Layer):
         new_v_pool) — same rope tables and masked-softmax math as the
         dense cached_attn path, so paged serving is token-compatible
         with ``generate()``.
+
+        ``adapters`` (docs/SERVING.md "Multi-LoRA adapters"): per-row
+        gathered LoRA stacks ``{site: (A, B)}`` — each projection adds
+        its ``lora_delta`` at ``layer_idx``; rows on adapter slot 0 add
+        an exact zero, keeping non-adapter tenants bit-identical.
         """
         from ..ops.pallas.paged_attention import ragged_paged_attention
 
@@ -188,6 +194,12 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x)
         k = self.k_proj(x)
         v = self.v_proj(x)
+        if adapters is not None:
+            from ..serving.adapters import lora_delta
+
+            q = q + lora_delta(x, *adapters["q_proj"], layer_idx)
+            k = k + lora_delta(x, *adapters["k_proj"], layer_idx)
+            v = v + lora_delta(x, *adapters["v_proj"], layer_idx)
 
         def paged_step(qv, kv, vv, kp, vp, bt, pos):
             pos = pos.astype(jnp.int32).reshape(B)
@@ -227,7 +239,12 @@ class LlamaAttention(nn.Layer):
              ensure_tensor(k_pool), ensure_tensor(v_pool),
              ensure_tensor(block_tables), ensure_tensor(positions)],
             name="llama_paged_attention")
-        return self.o_proj(merged), (new_k, new_v)
+        out = self.o_proj(merged)
+        if adapters is not None:
+            from ..serving.adapters import lora_delta
+
+            out = out + lora_delta(merged, *adapters["o_proj"], layer_idx)
+        return out, (new_k, new_v)
 
     def forward(self, x, cache=None, cur_len=None):
         B, S, _ = x.shape
@@ -365,8 +382,19 @@ class LlamaMLP(nn.Layer):
                                        weight_attr=nn.ParamAttr(
                                            initializer=_normal_init(proj_std)))
 
-    def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+    def forward(self, x, adapters=None, layer_idx=0):
+        if adapters is None:
+            return self.down_proj(F.silu(self.gate_proj(x))
+                                  * self.up_proj(x))
+        from ..serving.adapters import lora_delta
+
+        g = self.gate_proj(x) + lora_delta(x, *adapters["gate_proj"],
+                                           layer_idx)
+        u = self.up_proj(x) + lora_delta(x, *adapters["up_proj"],
+                                         layer_idx)
+        a = F.silu(g) * u
+        return self.down_proj(a) + lora_delta(a, *adapters["down_proj"],
+                                              layer_idx)
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -388,11 +416,14 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + self.self_attn(self.input_layernorm(x))
         return x + self.mlp(self.post_attention_layernorm(x))
 
-    def forward_paged(self, x, positions, block_tables, k_pool, v_pool):
+    def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
+                      adapters=None, layer_idx=0):
         h, nc = self.self_attn.forward_paged(
-            self.input_layernorm(x), positions, block_tables, k_pool, v_pool)
+            self.input_layernorm(x), positions, block_tables, k_pool,
+            v_pool, adapters=adapters, layer_idx=layer_idx)
         x = x + h
-        return x + self.mlp(self.post_attention_layernorm(x)), nc
+        return x + self.mlp(self.post_attention_layernorm(x),
+                            adapters=adapters, layer_idx=layer_idx), nc
 
 
 class LlamaModel(nn.Layer):
@@ -455,14 +486,19 @@ class LlamaModel(nn.Layer):
                 x = layer(x)
         return self.norm(x)
 
-    def forward_paged(self, input_ids, positions, block_tables, caches):
+    def forward_paged(self, input_ids, positions, block_tables, caches,
+                      adapters=None):
         """Paged decode trunk (serving engine): ``input_ids`` [B, 1],
         ``positions`` [B], ``caches`` a per-layer list of (k_pool, v_pool)
-        page pools. Returns (hidden [B, 1, H], new_caches)."""
+        page pools. ``adapters``: per-row gathered LoRA stacks
+        ``{site: (A [T, L, r, in], B [T, L, out, r])}`` applied at every
+        projection site per layer (zero for slot-0 rows). Returns
+        (hidden [B, 1, H], new_caches)."""
         x = self.embed_tokens(ensure_tensor(input_ids))
         new_caches = []
-        for layer, (kp, vp) in zip(self.layers, caches):
-            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp)
+        for li, (layer, (kp, vp)) in enumerate(zip(self.layers, caches)):
+            x, nc = layer.forward_paged(x, positions, block_tables, kp, vp,
+                                        adapters=adapters, layer_idx=li)
             new_caches.append(nc)
         return self.norm(x), new_caches
 
@@ -496,6 +532,24 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         # pre-repeat kv heads: GQA's memory saving applies to the cache too
         return (cfg.num_layers, cfg.num_key_value_heads,
                 cfg.hidden_size // cfg.num_heads)
+
+    def lora_sites(self):
+        """The AdapterStore contract (serving/adapters.py): ordered
+        ``(site, in_dim, out_dim)`` triples for every projection the
+        paged trunk offers a LoRA delta at, plus the layer count.
+        Dims are the UNSHARDED shapes — multi-LoRA serving assumes the
+        single-program (mp=1) serving path."""
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_heads
+        h = cfg.hidden_size
+        q_out = cfg.num_heads * hd
+        kv_out = cfg.num_key_value_heads * hd
+        ff = cfg.intermediate_size
+        sites = [("q_proj", h, q_out), ("k_proj", h, kv_out),
+                 ("v_proj", h, kv_out), ("o_proj", q_out, h),
+                 ("gate_proj", h, ff), ("up_proj", h, ff),
+                 ("down_proj", ff, h)]
+        return sites, cfg.num_layers
 
     def forward(self, input_ids, labels=None):
         hidden = self.llama(input_ids)

@@ -19,6 +19,11 @@ lifecycle counters, page-pool gauges — lands in ``paddle_tpu.metrics``
 queue that rejects with a ``retry_after_s`` hint (BackpressureError),
 NaN-logit quarantine that never poisons batch-mates, isolated stream
 callbacks, and a step watchdog surfaced through ``/healthz``.
+Multi-tenancy rides the ONE compiled step as data: batched multi-LoRA
+adapters (adapters.py — hot-loaded fleet-wide with zero recompiles,
+routed by ``(model_id, adapter_id)``) and token-level constrained
+decoding (grammar.py — JSON-schema/regex compiled to a DFA whose
+allow-masks gate sampling in-step, migration-safe via FSM journals).
 
 Quick start (docs/SERVING.md has the sizing math; examples/serve_llama.py
 is runnable):
@@ -31,8 +36,10 @@ is runnable):
     engine.add_request(prompt_ids, max_new_tokens=64, eos_token_id=2)
     outputs = engine.run()          # continuous batching until drained
 """
-from .api import CompletionAPI, EnginePool
+from .adapters import AdapterStore, random_adapter
+from .api import CompletionAPI
 from .engine import ServingEngine
+from .grammar import GrammarFSM, ToyTokenizer, schema_to_regex, toy_tokenizer
 from .kv_cache import (PagedKVCachePool, PrefixCache, page_bytes,
                        pages_for_hbm_budget)
 from .router import EngineHandle, NoHealthyEngineError, Router
@@ -42,7 +49,9 @@ from .spec import NGramDrafter
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "PrefixCache", "FCFSScheduler",
-    "Request", "RequestOutput", "CompletionAPI", "EnginePool",
+    "Request", "RequestOutput", "CompletionAPI",
     "BackpressureError", "Router", "EngineHandle", "NoHealthyEngineError",
     "NGramDrafter", "page_bytes", "pages_for_hbm_budget",
+    "AdapterStore", "random_adapter", "GrammarFSM", "ToyTokenizer",
+    "toy_tokenizer", "schema_to_regex",
 ]

@@ -12,18 +12,14 @@ a router, ``create_completion(model=...)`` routes through least-loaded
 dispatch and health gating, and the whole fleet is driven so a request
 requeued off a draining engine still delivers here. Token ids in, token
 ids out: tokenization is the caller's concern (pass ``detokenize=`` to
-get ``text`` filled in the response).
-
-``EnginePool`` — the PR 1 round-robin pool — survives as a thin
-DEPRECATED shim over ``Router`` (one model id, ``retrieve``/``next``
-kept) so existing callers keep working; new code should hold a Router.
+get ``text`` filled in the response). ``adapter_id=`` selects a LoRA
+tenant (routed only to engines holding it); ``grammar=`` constrains
+every choice to a compiled :class:`~.grammar.GrammarFSM`.
 """
 from __future__ import annotations
 
 import itertools
-import threading
 import time
-import warnings
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -32,7 +28,7 @@ from .. import faults, metrics
 from .engine import ServingEngine
 from .router import NoHealthyEngineError, Router
 
-__all__ = ["CompletionAPI", "EnginePool"]
+__all__ = ["CompletionAPI"]
 
 _cmpl_counter = itertools.count()
 
@@ -60,10 +56,13 @@ class CompletionAPI:
             "Whole create_completion latency: queue + prefill + decode "
             "to the last choice finishing")
 
-    def _route(self, model: Optional[str]):
+    def _route(self, model: Optional[str],
+               adapter_id: Optional[str] = None):
         """(engine, handle, response_model_name) for this completion."""
         if self.router is not None:
-            handle = self.router.select(model)  # ValueError on unknown id
+            # ValueError on unknown id; tenancy is (model, adapter) —
+            # only engines holding the adapter are candidates
+            handle = self.router.select(model, adapter_id=adapter_id)
             # echo the tenant the caller named; the display name covers
             # the single-model default (same as the engine-backed path)
             return handle.engine, handle, (model if model is not None
@@ -83,7 +82,9 @@ class CompletionAPI:
                           deadline_s: Optional[float] = None,
                           model: Optional[str] = None,
                           prefix_cache: bool = True,
-                          priority: int = 0) -> dict:
+                          priority: int = 0,
+                          adapter_id: Optional[str] = None,
+                          grammar=None) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
@@ -105,11 +106,15 @@ class CompletionAPI:
         0 default): it orders admission and prompt-chunk scheduling on
         the engine (docs/SERVING.md "Unified step & chunked prefill"),
         so a latency-tier tenant's prompt chunks preempt a batch tier's
-        under a contended token budget."""
+        under a contended token budget. ``adapter_id`` names a LoRA
+        adapter every choice decodes through (on a Router backend,
+        placement narrows to engines holding it); ``grammar`` is a
+        compiled :class:`~.grammar.GrammarFSM` constraining every
+        choice's tokens (docs/SERVING.md "Constrained decoding")."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         try:
-            engine, handle, resp_model = self._route(model)
+            engine, handle, resp_model = self._route(model, adapter_id)
         except (ValueError, NoHealthyEngineError):
             self._m_completions.labels(status="rejected").inc()
             raise
@@ -132,7 +137,8 @@ class CompletionAPI:
                     p, max_new_tokens=max_tokens, temperature=temperature,
                     eos_token_id=stop_token_id, seed=seed + idx,
                     stream_cb=cb, deadline_s=deadline_s,
-                    prefix_cache=prefix_cache, priority=priority))
+                    prefix_cache=prefix_cache, priority=priority,
+                    adapter_id=adapter_id, grammar=grammar))
                 if handle is not None:
                     self.router._count_dispatch(handle)
         except Exception:
@@ -241,55 +247,3 @@ class CompletionAPI:
         if arr.ndim == 2:
             return [row.astype(np.int32) for row in arr]
         raise ValueError(f"prompt rank {arr.ndim} unsupported")
-
-
-class EnginePool(Router):
-    """DEPRECATED thin shim over :class:`Router` — the PR 1 pool surface
-    (``retrieve(i)`` / thread-safe ``next()`` round-robin / ``len``) on
-    top of a single-model router, kept so existing callers and examples
-    keep working. New code should construct a ``Router`` and use
-    ``select``/``submit`` (least-loaded, health-gated) instead of blind
-    rotation; the full control plane (drain/reload/health) is inherited
-    and fully functional here."""
-
-    _MODEL_ID = "default"
-
-    def __init__(self, model, size: int = 1, **engine_kwargs):
-        warnings.warn(
-            "EnginePool is deprecated: construct a serving.Router and "
-            "use select()/submit() (least-loaded, health-gated) instead "
-            "of blind round-robin rotation", DeprecationWarning,
-            stacklevel=2)
-        super().__init__()
-        self.add_model(self._MODEL_ID, model, replicas=int(size),
-                       **engine_kwargs)
-        # modular index, not itertools.count: the old unbounded counter
-        # grew without limit on a long-lived server (harmless for int
-        # math in CPython, but a slow drift toward bignum arithmetic on
-        # the hot path — and a pointless one)
-        self._rr_idx = 0
-        self._rr_lock = threading.Lock()  # tpulint: lock=pool.rr
-
-    @property
-    def _engines(self) -> List[ServingEngine]:
-        return self.engines(self._MODEL_ID)
-
-    def retrieve(self, idx: int) -> ServingEngine:
-        engines = self._engines
-        if not 0 <= int(idx) < len(engines):
-            raise IndexError(
-                f"engine index {idx} out of range for EnginePool of size "
-                f"{len(engines)} (valid: 0..{len(engines) - 1})")
-        return engines[int(idx)]
-
-    def next(self) -> ServingEngine:
-        """Round-robin handout: the ROTATION is thread-safe, the engines
-        are not — size the pool to at least the worker count so no two
-        concurrent callers drive one engine (same contract as
-        ``retrieve``: one engine per thread at a time). Blind rotation —
-        ``select()`` is the load- and health-aware replacement."""
-        engines = self._engines
-        with self._rr_lock:
-            i = self._rr_idx
-            self._rr_idx = (self._rr_idx + 1) % len(engines)
-        return engines[i]

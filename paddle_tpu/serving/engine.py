@@ -77,6 +77,7 @@ from .. import faults, jit, metrics
 from ..autograd.engine import no_grad
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
+from .adapters import AdapterStore
 from .kv_cache import PagedKVCachePool, PrefixCache
 from .scheduler import FCFSScheduler, Request, RequestOutput
 from .spec import NGramDrafter
@@ -152,7 +153,8 @@ class _SeqState:
     """
 
     __slots__ = ("req", "ids", "pos", "last_token", "gen", "t_last",
-                 "t_admit", "inserted_nodes")
+                 "t_admit", "inserted_nodes", "adp_slot", "fsm",
+                 "fsm_off", "fsm_state")
 
     def __init__(self, req: Request, ids: np.ndarray, pos: int):
         self.req = req
@@ -168,6 +170,19 @@ class _SeqState:
         # NaN quarantine makes that KV suspect, these (and their
         # subtrees) are evicted so the poison cannot serve a later match
         self.inserted_nodes = []
+        # adapter slot in THIS engine's AdapterStore (0 = base model):
+        # resolved from req.adapter_id at admission — names travel,
+        # slots are engine-local (docs/SERVING.md "Multi-LoRA adapters")
+        self.adp_slot = 0
+        # constrained decoding (docs/SERVING.md "Constrained decoding"):
+        # the request's GrammarFSM, its interned offset in the engine's
+        # grammar table, and the LOCAL DFA state advanced per landed
+        # token. (fsm_off + fsm_state) is the absolute table row the
+        # slot's sample rows gather their logit mask from; fsm_state
+        # alone is what export_inflight journals (engine-independent)
+        self.fsm = None
+        self.fsm_off = 0
+        self.fsm_state = 0
 
     @property
     def prefilling(self) -> bool:
@@ -202,7 +217,9 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  spec_k: int = 0, spec_ngram: int = 3,
                  drafter=None,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 adapter_capacity: int = 4, adapter_rank: int = 4,
+                 grammar_states: int = 64):
         if seed is not None:
             # dead since the per-request determinism contract landed:
             # sampling keys derive from fold_in(PRNGKey(req.seed), pos)
@@ -273,6 +290,36 @@ class ServingEngine:
         self._spec_rows = self.spec_k + 1
         self._compile_cache_dir = (None if compile_cache_dir is None
                                    else str(compile_cache_dir))
+        # multi-LoRA store (docs/SERVING.md "Multi-LoRA adapters"):
+        # ALWAYS built, even when no adapter is ever registered — its
+        # stacked (A, B) arrays ride EVERY compiled step as arguments,
+        # so registering a tenant later is a pure value write into
+        # already-traced shapes (zero recompiles; compile_counts pins
+        # it). Slot 0 is the zero-delta identity every base request
+        # indexes.
+        self.adapters = AdapterStore.from_model(
+            model, rank=adapter_rank, capacity=adapter_capacity,
+            dtype=jnp.float32)
+        # constrained-decoding mask table (docs/SERVING.md "Constrained
+        # decoding"): ONE [grammar_states, vocab] boolean table shared
+        # by every interned grammar. Row 0 is the all-True identity that
+        # unconstrained sample rows point at — jnp.where against it
+        # returns the logits bitwise-unchanged, the grammar-off
+        # bit-identity guarantee. Grammars intern as refcounted row
+        # segments (first-fit); per-slot states ride the step as
+        # offset+local ints. Like the adapter arrays, the table is a
+        # step ARGUMENT with a fixed shape: interning is a value write.
+        self._vocab_size = int(model.config.vocab_size)
+        self._grammar_cap = int(grammar_states)
+        if self._grammar_cap < 2:
+            raise ValueError("grammar_states must be >= 2 (row 0 is the "
+                             f"reserved identity), got {grammar_states}")
+        self._grammar_table = np.zeros(
+            (self._grammar_cap, self._vocab_size), bool)
+        self._grammar_table[0, :] = True
+        self._grammar_device = jnp.asarray(self._grammar_table)
+        # fsm.key -> [offset, n_states, refcount, fsm]
+        self._grammar_segments: Dict[object, list] = {}
         self.pages_per_seq = -(-self.max_model_len // self.page_size)
         if num_pages is None:
             num_pages = self.max_batch_slots * self.pages_per_seq + 1
@@ -432,6 +479,47 @@ class ServingEngine:
             "nan": self._m_nan_quarantines, "error": self._m_req_errors,
             "unavailable": self._m_unavailable,
         }
+        # multi-LoRA + constrained-decoding instruments (ISSUE 16,
+        # docs/OBSERVABILITY.md): tenancy split per adapter name, store
+        # occupancy, constrained traffic volume, end-of-stream validity
+        # (THE constrained-decoding health number: invalid > 0 means a
+        # mask or migration bug), spec-draft filtering, and table rows
+        self._m_adapter_req = reg.counter(
+            "paddle_tpu_serving_adapter_requests_total",
+            "Requests admitted under a named LoRA adapter (base/slot-0 "
+            "requests are not counted)", labels=("adapter_id",) + _eng)
+        self._m_adapter_slots = reg.gauge(
+            "paddle_tpu_serving_adapter_slots",
+            "Named adapters currently registered in this engine's "
+            "AdapterStore (the slot-0 identity is not counted)",
+            labels=_eng).labels(**self._lbl)
+        self._m_grammar_req = reg.counter(
+            "paddle_tpu_serving_grammar_requests_total",
+            "Grammar-constrained requests admitted (regex/JSON-schema "
+            "FSM attached)", labels=_eng).labels(**self._lbl)
+        self._m_grammar_tokens = reg.counter(
+            "paddle_tpu_serving_grammar_tokens_total",
+            "Tokens landed under an in-step grammar mask (FSM advanced "
+            "on the host)", labels=_eng).labels(**self._lbl)
+        self._m_grammar_completions = reg.counter(
+            "paddle_tpu_serving_grammar_completions_total",
+            "Constrained requests retired normally (stop/length) by "
+            "whether the finished stream walks its grammar to an "
+            "accepting state", labels=("result",) + _eng)
+        for r in ("valid", "invalid"):
+            self._m_grammar_completions.labels(result=r, **self._lbl)
+        self._m_grammar_filtered = reg.counter(
+            "paddle_tpu_serving_grammar_draft_filtered_total",
+            "Speculative draft tokens dropped before staging because "
+            "they would leave the proposer slot's grammar (an unmasked "
+            "draft would collapse acceptance)",
+            labels=_eng).labels(**self._lbl)
+        self._m_grammar_states = reg.gauge(
+            "paddle_tpu_serving_grammar_states",
+            "Grammar-table rows in use (interned DFA states plus the "
+            "row-0 identity) out of the grammar_states capacity",
+            labels=_eng).labels(**self._lbl)
+        self._m_grammar_states.set(1.0)
 
     # ------------------------------------------------------------ frontend
     def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -468,11 +556,45 @@ class ServingEngine:
                 f"page_size={self.pool.page_size}); raise num_pages or "
                 f"lower max_new_tokens")
 
+    def _check_features(self, req: Request) -> None:
+        """Adapter/grammar feasibility gate, the :meth:`check_request`
+        sibling for the ISSUE 16 features: reject at ENQUEUE anything
+        this engine could never serve — an adapter it does not hold, a
+        grammar compiled against the wrong vocab, or a DFA larger than
+        the grammar table — with the limit named in the message."""
+        if (req.adapter_id is not None
+                and not self.adapters.holds(req.adapter_id)):
+            self._m_requests.labels(event="rejected", **self._lbl).inc()
+            raise ValueError(
+                f"adapter {req.adapter_id!r} is not registered on this "
+                f"engine (holding {list(self.adapters.names())}); "
+                f"register it first (Router.register_adapter hot-loads "
+                f"fleet-wide) or route via select(adapter_id=...)")
+        fsm = req.grammar
+        if fsm is not None:
+            if int(fsm.vocab_size) != self._vocab_size:
+                self._m_requests.labels(event="rejected",
+                                        **self._lbl).inc()
+                raise ValueError(
+                    f"grammar was compiled for vocab_size "
+                    f"{int(fsm.vocab_size)} but this model's vocab is "
+                    f"{self._vocab_size}; recompile the GrammarFSM "
+                    f"against this model's tokenizer")
+            if fsm.n_states > self._grammar_cap - 1:
+                self._m_requests.labels(event="rejected",
+                                        **self._lbl).inc()
+                raise ValueError(
+                    f"grammar needs {fsm.n_states} DFA states but the "
+                    f"table holds at most {self._grammar_cap - 1} "
+                    f"(limit: grammar_states={self._grammar_cap}); "
+                    f"simplify the pattern or raise grammar_states")
+
     def add_request(self, prompt, max_new_tokens: int = 32,
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None, seed: int = 0,
                     stream_cb=None, deadline_s: Optional[float] = None,
-                    prefix_cache: bool = True, priority: int = 0):
+                    prefix_cache: bool = True, priority: int = 0,
+                    adapter_id: Optional[str] = None, grammar=None):
         """Queue a request; returns its ``req_id``. Generation starts at
         the next :meth:`step` with capacity (continuous batching — no
         barrier on the current batch). ``deadline_s`` bounds the whole
@@ -486,13 +608,18 @@ class ServingEngine:
         ``prefix_cache=`` constructor flag. ``priority`` is the SLO tier
         (lower = more urgent, 0 default): honored at admission order and
         at prompt-chunk scheduling (docs/SERVING.md "Unified step &
-        chunked prefill")."""
+        chunked prefill"). ``adapter_id`` names a LoRA adapter this
+        engine must already hold (``register_adapter``); ``grammar`` is
+        a compiled :class:`~.grammar.GrammarFSM` constraining every
+        sampled token (docs/SERVING.md "Constrained decoding")."""
         req = Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       eos_token_id=eos_token_id, seed=seed,
                       stream_cb=stream_cb, deadline_s=deadline_s,
-                      prefix_cache=prefix_cache, priority=priority)
+                      prefix_cache=prefix_cache, priority=priority,
+                      adapter_id=adapter_id, grammar=grammar)
         self.check_request(req.prompt.size, req.max_new_tokens)
+        self._check_features(req)
         try:
             self.scheduler.add(req)
         except Exception:
@@ -589,8 +716,11 @@ class ServingEngine:
         so far (usually none): its chunk progress was only a cache
         length, which the adoptive engine's prefix cache re-covers — so
         migration at a chunk boundary is the same move as migration
-        mid-decode. The router's migration path for
-        ``mark_down``/step-crash.
+        mid-decode. A CONSTRAINED request additionally journals its DFA
+        position in ``resume_fsm_state`` (the engine-independent LOCAL
+        state — table offsets differ per engine), so the sibling resumes
+        mid-structure without re-walking the grammar. The router's
+        migration path for ``mark_down``/step-crash.
 
         No lifecycle counters move (the requests retire elsewhere), and
         pages are freed best-effort per sequence — a crashed engine's
@@ -608,6 +738,9 @@ class ServingEngine:
             except Exception:
                 pass  # dead pool: journaling must still succeed
             st.req.resume_tokens = list(st.gen)
+            if st.fsm is not None:
+                st.req.resume_fsm_state = st.fsm_state
+            self._grammar_release(st)
             out.append(st.req)
         return out
 
@@ -619,10 +752,12 @@ class ServingEngine:
         :meth:`export_inflight` (``resume_tokens`` set) re-prefills
         prompt + journal at admission (in chunks, like any admission) and
         continues its stream token-identically. Raises exactly like
-        :meth:`add_request` (ValueError from :meth:`check_request`,
-        BackpressureError from a full bounded queue) — the router treats a
-        raise as requeue-impossible."""
+        :meth:`add_request` (ValueError from :meth:`check_request` or
+        :meth:`_check_features` — an adapter this engine doesn't hold is
+        a placement error, BackpressureError from a full bounded queue)
+        — the router treats a raise as requeue-impossible."""
         self.check_request(req.prompt.size, req.max_new_tokens)
+        self._check_features(req)
         try:
             self.scheduler.add(req)
         except Exception:
@@ -638,6 +773,87 @@ class ServingEngine:
         normal :meth:`run`/:meth:`take_outputs` path — exactly once, like
         every other retirement."""
         return self._finish_queued(req, reason)
+
+    # ------------------------------------------------ adapters and grammars
+    def register_adapter(self, name: str, weights) -> int:
+        """Install (or hot-swap) LoRA adapter ``name`` on THIS engine —
+        a pure value write into the stacked adapter arrays, so the
+        compiled step is untouched (``compile_counts()`` before == after)
+        and in-flight work never notices. Fleet-wide hot-load goes
+        through ``Router.register_adapter``, which adds the canary."""
+        slot = self.adapters.register(name, weights)
+        self._m_adapter_slots.set(float(len(self.adapters.names())))
+        return slot
+
+    def unregister_adapter(self, name: str) -> None:
+        """Zero and free adapter ``name``'s slot. Refuses while any
+        admitted OR queued request still points at it — unregistering
+        under a live tenant would silently flip its deltas to zero
+        mid-stream."""
+        if self._adapter_in_use(name):
+            raise ValueError(
+                f"adapter {name!r} is in use by an admitted or queued "
+                f"request; drain it before unregistering")
+        self.adapters.unregister(name)
+        self._m_adapter_slots.set(float(len(self.adapters.names())))
+
+    def _adapter_in_use(self, name: str) -> bool:
+        for st in self.slots:
+            if st is not None and st.req.adapter_id == name:
+                return True
+        return any(r.adapter_id == name for r in self.scheduler.waiting)
+
+    def _grammar_intern(self, fsm) -> int:
+        """Refcounted first-fit interning of a compiled DFA into the ONE
+        ``[grammar_states, vocab]`` device table the step consumes:
+        returns the row offset for this grammar. Same ``fsm.key`` →
+        same rows (a popular schema costs its states once, not per
+        request). Row 0 is the reserved all-True identity."""
+        seg = self._grammar_segments.get(fsm.key)
+        if seg is not None:
+            seg[2] += 1
+            return seg[0]
+        n = int(fsm.n_states)
+        taken = sorted((s[0], s[1]) for s in self._grammar_segments.values())
+        off, ok = 1, False
+        for seg_off, seg_n in taken:
+            if off + n <= seg_off:
+                ok = True
+                break
+            off = seg_off + seg_n
+        if not ok and off + n > self._grammar_cap:
+            held = {str(k[0]): s[1] for k, s in
+                    self._grammar_segments.items()}
+            raise ValueError(
+                f"grammar table full: need {n} rows but only "
+                f"{self._grammar_cap - off} remain of "
+                f"grammar_states={self._grammar_cap} (holding {held}); "
+                f"raise grammar_states or drain constrained requests")
+        self._grammar_table[off:off + n] = fsm.mask_table
+        self._grammar_device = jnp.asarray(self._grammar_table)
+        self._grammar_segments[fsm.key] = [off, n, 1, fsm]
+        self._m_grammar_states.set(float(1 + sum(
+            s[1] for s in self._grammar_segments.values())))
+        return off
+
+    def _grammar_release(self, st: "_SeqState") -> None:
+        """Drop ``st``'s reference on its interned grammar; at refcount
+        zero the rows are zeroed and the segment freed. Idempotent —
+        every retirement path calls it unconditionally."""
+        fsm, st.fsm = st.fsm, None
+        if fsm is None:
+            return
+        seg = self._grammar_segments.get(fsm.key)
+        if seg is None:
+            return
+        seg[2] -= 1
+        if seg[2] <= 0:
+            off, n = seg[0], seg[1]
+            self._grammar_table[off:off + n] = False
+            self._grammar_device = jnp.asarray(self._grammar_table)
+            del self._grammar_segments[fsm.key]
+        self._m_grammar_states.set(float(1 + sum(
+            s[1] for s in self._grammar_segments.values())))
 
     @property
     def avg_step_s(self) -> float:
@@ -848,6 +1064,7 @@ class ServingEngine:
             # references defer (scrub-pending, zeroed at refcount zero).
             self.pool.free(req.req_id, scrub=(reason == "nan"))
         self.slots[slot] = None
+        self._grammar_release(st)
         return self._emit_terminal(req, st.gen, reason, error)
 
     def _sweep_deadlines(self) -> List[RequestOutput]:
@@ -876,7 +1093,11 @@ class ServingEngine:
         (``resume_tokens`` set) admits over prompt + journal: chunked
         re-prefill rebuilds the KV the dead engine held, and the final
         chunk's sample IS the stream's next token (docs/RESILIENCE.md
-        "In-flight migration")."""
+        "In-flight migration"). Admission also binds ISSUE 16's tenancy
+        data: the request's adapter slot index, and its interned grammar
+        (offset + DFA state — seeded from ``resume_fsm_state`` for a
+        migrated request, else by walking the journal, so constrained
+        streams resume mid-structure)."""
         faults.point("serving.prefill")
         ids = req.admission_ids()
         cache = self.prefix_cache if req.prefix_cache else None
@@ -894,6 +1115,23 @@ class ServingEngine:
                            prefix_pages=shared_pages,
                            prefix_tokens=matched)
         st = _SeqState(req, ids, pos=matched)
+        try:
+            st.adp_slot = self.adapters.slot(req.adapter_id)
+        except KeyError as e:
+            raise ValueError(str(e))
+        if req.adapter_id is not None:
+            self._m_adapter_req.labels(adapter_id=req.adapter_id,
+                                       **self._lbl).inc()
+        if req.grammar is not None:
+            st.fsm_off = self._grammar_intern(req.grammar)
+            st.fsm = req.grammar
+            if req.resume_fsm_state is not None:
+                st.fsm_state = int(req.resume_fsm_state)
+            else:
+                # fresh admission: the journal (if any) was generated
+                # under this same grammar — walk it to the live state
+                st.fsm_state = st.fsm.advance(0, req.resume_tokens or ())
+            self._m_grammar_req.inc()
         self.slots[self.slots.index(None)] = st
 
     # --------------------------------------------------- unified step
@@ -928,9 +1166,22 @@ class ServingEngine:
           ``last_row``, unused columns and idle slots point at row 0 and
           are discarded on host),
         - ``sample_pos`` [B, S] — the positions that key each sample,
+        - ``tok_adp`` [T] — each row's OWNER's adapter slot in the
+          stacked LoRA arrays (0 = reserved zero-delta identity),
         - ``temps``/``seeds`` [B] — per-slot sampling params,
-        - ``*flat_pools`` — the paged KV pools, consumed and returned
-          functionally.
+        - ``fsm_state`` [B, S] — each sample's ABSOLUTE grammar-table
+          row (0 = reserved all-True identity row; draft columns carry
+          host-precomputed hypothetical states),
+        - ``grammar_table`` [grammar_states, V] — the interned DFA
+          allow-masks, one device table for every live grammar,
+        - ``*rest`` — the stacked adapter (A, B) arrays per site, then
+          the paged KV pools, consumed and returned functionally.
+
+        Adapters and grammars are ALWAYS in the program — disabled is a
+        VALUE (slot 0's zero weights add exactly 0.0; row 0's all-True
+        mask selects the raw logits bitwise), never a branch, so
+        adapter/grammar on/off shares one compiled signature and
+        ``compile_counts()`` stays pinned (ISSUE 16).
 
         The trunk's ``forward_paged`` treats every row as "one token at
         an arbitrary position over an arbitrary page list" — which is
@@ -948,14 +1199,33 @@ class ServingEngine:
         token the stream would sample there without speculation, which
         is why acceptance-by-equality preserves bit-identical streams."""
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
+        site_names = [s for s, _, _ in self.adapters.sites]
+        n_adp = 2 * len(site_names)
 
-        def step_fn(tok, tok_pos, tok_bt, sample_rows, sample_pos, temps,
-                    seeds, *flat_pools):
+        def step_fn(tok, tok_pos, tok_bt, tok_adp, sample_rows, sample_pos,
+                    temps, seeds, fsm_state, grammar_table, *rest):
+            adp_flat, flat_pools = rest[:n_adp], rest[n_adp:]
             caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
                       for i in range(n_layers)]
             with no_grad():
+                # per-row adapter gather: every grid row pulls ITS
+                # owner's (A, B) stack by index — slot 0 rows pull the
+                # zero identity, so the delta below is + 0.0 exactly
+                adapters = {}
+                for si, site in enumerate(site_names):
+                    ga = apply_op(
+                        lambda a, ix: a[ix.reshape(-1).astype(jnp.int32)],
+                        [ensure_tensor(adp_flat[2 * si]),
+                         ensure_tensor(tok_adp)],
+                        name="gather_adapter_a")
+                    gb = apply_op(
+                        lambda b, ix: b[ix.reshape(-1).astype(jnp.int32)],
+                        [ensure_tensor(adp_flat[2 * si + 1]),
+                         ensure_tensor(tok_adp)],
+                        name="gather_adapter_b")
+                    adapters[site] = (ga, gb)
                 hidden, ncs = trunk.forward_paged(tok, tok_pos, tok_bt,
-                                                  caches)
+                                                  caches, adapters=adapters)
                 # per-slot sample rows gathered BEFORE the vocab matmul:
                 # the grid carries up to token-budget rows but only
                 # max_batch_slots * (spec_k+1) of them sample
@@ -976,6 +1246,22 @@ class ServingEngine:
             fin = apply_op(
                 lambda lv: jnp.isfinite(lv).all(axis=-1),
                 [last], name="logits_finite")
+            # constrained decoding: each sample row gathers its DFA
+            # state's allow-mask from the ONE interned grammar table and
+            # masks disallowed tokens to -1e30 BEFORE sampling — so
+            # greedy, temperature, and draft-target sampling are all
+            # constrained by the same op. Row 0 is all-True:
+            # where(True, lv, -1e30) IS lv, bitwise — the grammar-off
+            # identity that keeps this in the one compiled signature.
+            # NaN-quarantine ordering: fin reads the RAW logits above,
+            # so a poisoned row still trips the canary even if the mask
+            # would have hidden its non-finite lanes.
+            masked = apply_op(
+                lambda lv, gt, fs: jnp.where(
+                    gt[fs.reshape(-1).astype(jnp.int32)], lv,
+                    jnp.float32(-1e30)),
+                [last, ensure_tensor(grammar_table),
+                 ensure_tensor(fsm_state)], name="grammar_mask")
 
             def batched_sample(lv, tv, sv, pv):
                 # per-row key = fold_in(PRNGKey(seed), position) — the
@@ -1007,7 +1293,7 @@ class ServingEngine:
                 return jnp.where(tvf > 0, sampled, greedy)
 
             nxt = apply_op(batched_sample,
-                           [last, ensure_tensor(temps),
+                           [masked, ensure_tensor(temps),
                             ensure_tensor(seeds), ensure_tensor(sample_pos)],
                            name="serve_sample")
             flat = [t for c in ncs for t in c]
@@ -1025,7 +1311,9 @@ class ServingEngine:
         extra = repr((type(self.model).__name__, sorted(
             (k, v) for k, v in vars(cfg).items()
             if isinstance(v, (bool, int, float, str, type(None)))),
-            self.page_size, self.pages_per_seq, self._spec_rows))
+            self.page_size, self.pages_per_seq, self._spec_rows,
+            self.adapters.capacity, self.adapters.rank,
+            self._grammar_cap))
         return jit.StaticFunction(step_fn, observe=[self.model],
                                   warmup=False, dy2static=False,
                                   cache_dir=self._compile_cache_dir,
@@ -1079,6 +1367,24 @@ class ServingEngine:
                         np.concatenate([st.req.prompt,
                                         np.asarray(st.gen, np.int32)]), d)
                     prop = np.asarray(prop, np.int32).reshape(-1)[:d]
+                    if st.fsm is not None and prop.size:
+                        # constrained slot: keep only the longest
+                        # grammar-valid prefix of the proposal — an
+                        # invalid draft could never equal its (masked)
+                        # target, so rows past the first violation are
+                        # guaranteed-wasted compute, and the hypothetical
+                        # FSM states its sample columns need would not
+                        # even exist
+                        s_, keep = st.fsm_state, 0
+                        for t_ in prop:
+                            s_ = st.fsm.next_state(s_, int(t_))
+                            if s_ < 0:
+                                break
+                            keep += 1
+                        if keep < prop.size:
+                            self._m_grammar_filtered.inc(
+                                int(prop.size) - keep)
+                            prop = prop[:keep]
                     if prop.size:
                         drafts[i] = prop
 
@@ -1140,10 +1446,14 @@ class ServingEngine:
         tok = np.zeros((T, 1), np.int32)
         tok_pos = np.zeros(T, np.int32)
         tok_bt = np.zeros((T, self.pages_per_seq), np.int32)
+        tok_adp = np.zeros(T, np.int32)
         sample_rows = np.zeros((B, S), np.int32)
         sample_pos = np.zeros((B, S), np.int32)
         temps = np.zeros(B, np.float32)
         seeds = np.zeros(B, np.int32)
+        # absolute grammar-table rows per sample; idle/unconstrained
+        # entries stay 0 = the all-True identity row (mask is a no-op)
+        fsm_state = np.zeros((B, S), np.int32)
         cur = 0
         for i, toks, poss, is_chunk, d in rows:
             st = self.slots[i]
@@ -1152,15 +1462,34 @@ class ServingEngine:
             tok_pos[cur:cur + c] = poss
             table = self.pool.block_table(st.req.req_id)
             tok_bt[cur:cur + c, :len(table)] = table
+            tok_adp[cur:cur + c] = st.adp_slot
             if is_chunk:
                 sample_rows[i, 0] = cur + c - 1
                 sample_pos[i, 0] = int(poss[-1])
+                if st.fsm is not None:
+                    # only the FINAL chunk's sample lands, and it is the
+                    # stream's next token — mask it at the current (post-
+                    # journal) DFA state; mid-prompt chunks' discarded
+                    # samples get the same row harmlessly
+                    fsm_state[i, 0] = st.fsm_off + st.fsm_state
             else:
                 # base decode row + its d draft rows are contiguous:
                 # sample column j targets position pos+j, i.e. the token
                 # FOLLOWING the j-th burst token
                 sample_rows[i, :d + 1] = np.arange(cur, cur + d + 1)
                 sample_pos[i, :d + 1] = poss
+                if st.fsm is not None:
+                    # column j masks the token AFTER burst token j, so it
+                    # needs the HYPOTHETICAL state once drafts 1..j have
+                    # landed — host-walked here; drafts were pre-filtered
+                    # to grammar-valid, so the walk stays live. Without
+                    # this, unmasked draft targets could never match a
+                    # constrained stream and acceptance would collapse.
+                    s_ = st.fsm_state
+                    fsm_state[i, 0] = st.fsm_off + s_
+                    for j in range(1, d + 1):
+                        s_ = st.fsm.next_state(s_, int(toks[j]))
+                        fsm_state[i, j] = st.fsm_off + s_
             temps[i] = st.req.temperature
             seeds[i] = st.req.seed
             cur += c
@@ -1169,9 +1498,12 @@ class ServingEngine:
                 "serving.compile_step", self._make_step)
         res = self._step_prog(
             Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(tok_pos)),
-            Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(sample_rows)),
+            Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(tok_adp)),
+            Tensor(jnp.asarray(sample_rows)),
             Tensor(jnp.asarray(sample_pos)), Tensor(jnp.asarray(temps)),
-            Tensor(jnp.asarray(seeds)),
+            Tensor(jnp.asarray(seeds)), Tensor(jnp.asarray(fsm_state)),
+            self._grammar_device,
+            *self.adapters.arrays(),
             *[p for i in range(self.n_layers)
               for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
         nxt, fin, flat = res[0], res[1], res[2:]
@@ -1278,13 +1610,23 @@ class ServingEngine:
                     now: float) -> Optional[RequestOutput]:
         """ONE copy of the token-landing choreography, shared by the
         final-chunk first token and every decode token: append to the
-        journal, stream it (isolated, reentrant-cancel-aware), and
-        retire on eos/length. Returns the retirement output, if any."""
+        journal, advance the grammar DFA, stream it (isolated,
+        reentrant-cancel-aware), and retire on eos/length/grammar-
+        complete. Returns the retirement output, if any."""
         st.last_token = token
         st.gen.append(token)
         st.t_last = now
         self._m_tokens.inc()
         self.stats["generated_tokens"] += 1
+        if st.fsm is not None and (st.req.eos_token_id is None
+                                   or token != st.req.eos_token_id):
+            # host mirror of the device mask: the DFA walks every landed
+            # non-eos token (the mask guarantees it is allowed, so the
+            # walk can't die; eos is terminal and has no DFA edge)
+            nxt = st.fsm.next_state(st.fsm_state, token)
+            if nxt >= 0:
+                st.fsm_state = nxt
+            self._m_grammar_tokens.inc()
         if st.req.stream_cb is not None:
             cb_err = self._safe_cb(st.req, token, False, len(st.gen) - 1)
             if self.slots[slot] is not st:
@@ -1315,8 +1657,17 @@ class ServingEngine:
         req = st.req
         hit_eos = (req.eos_token_id is not None
                    and st.last_token == req.eos_token_id)
-        if not hit_eos and len(st.gen) < req.max_new_tokens:
+        # a constrained request whose DFA can only accept is DONE — the
+        # mask admits no further token, so decoding past this point
+        # would sample from an all -1e30 row
+        done_fsm = st.fsm is not None and st.fsm.is_complete(st.fsm_state)
+        if not (hit_eos or done_fsm) and len(st.gen) < req.max_new_tokens:
             return None
+        if st.fsm is not None:
+            valid = st.fsm.is_accepting(st.fsm_state)
+            self._m_grammar_completions.labels(
+                result="valid" if valid else "invalid", **self._lbl).inc()
+        self._grammar_release(st)
         # retire NOW: pages go back to the pool this very step (has_seq
         # guard: a reentrant cancel from the terminal-token's stream
         # callback may have freed them already)
@@ -1328,7 +1679,8 @@ class ServingEngine:
         out = RequestOutput(req_id=req.req_id,
                             prompt_token_ids=req.prompt,
                             token_ids=list(st.gen),
-                            finish_reason="stop" if hit_eos else "length")
+                            finish_reason=("stop" if hit_eos or done_fsm
+                                           else "length"))
         self._outputs[out.req_id] = out  # eager: survives a later raise
         if req.stream_cb is not None:
             # terminal call: `finished` is the reason string (truthy, so

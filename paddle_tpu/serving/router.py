@@ -195,6 +195,14 @@ class Router:
             labels=("result",))
         for r in ("ok", "error"):
             self._m_reloads.labels(result=r)   # pre-create: scrapes show 0
+        self._m_adapter_loads = reg.counter(
+            "paddle_tpu_serving_adapter_loads_total",
+            "Fleet-wide LoRA adapter hot-loads via "
+            "Router.register_adapter, by per-engine result (a canary "
+            "failure rolls that engine's install back)",
+            labels=("result",))
+        for r in ("ok", "error"):
+            self._m_adapter_loads.labels(result=r)
         self._m_state = reg.gauge(
             "paddle_tpu_router_engine_state",
             "Router gate state per engine: 0 healthy, 1 degraded, "
@@ -372,14 +380,27 @@ class Router:
                              model_id=h.model_id).set(_STATE_CODE[h.state])
 
     # ------------------------------------------------------------- dispatch
-    def select(self, model: Optional[str] = None) -> EngineHandle:
+    def select(self, model: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> EngineHandle:
         """Least-loaded healthy engine for ``model`` (the single served
         model when omitted): minimum ``engine.load_score()``; exact ties
-        rotate round-robin. Raises ValueError for an unknown model and
+        rotate round-robin. ``adapter_id`` narrows tenancy to
+        ``(model_id, adapter_id)``: only engines whose AdapterStore
+        holds the adapter are candidates (every engine holds ``None``).
+        Raises ValueError for an unknown model and
         :class:`NoHealthyEngineError` when every engine of the model is
-        gated out."""
+        gated out (or none holds the adapter)."""
         mid = self._resolve_model(model)
         cands = [h for h in self._models[mid] if h.state == HEALTHY]
+        if adapter_id is not None:
+            holders = [h for h in cands
+                       if h.engine.adapters.holds(adapter_id)]
+            if cands and not holders:
+                raise NoHealthyEngineError(
+                    f"no healthy engine for model {mid!r} holds adapter "
+                    f"{adapter_id!r}; register_adapter() hot-loads it "
+                    f"fleet-wide")
+            cands = holders
         if not cands:
             states = {h.engine_id: h.state for h in self._models[mid]}
             raise NoHealthyEngineError(
@@ -400,8 +421,10 @@ class Router:
         """Route one request: least-loaded placement + dispatch counter.
         Returns the engine's ``req_id``; raises like
         ``ServingEngine.add_request`` (plus the routing errors of
-        :meth:`select`). Drive the fleet with :meth:`run`."""
-        h = self.select(model)
+        :meth:`select`). A request carrying ``adapter_id=`` routes only
+        to engines holding that adapter. Drive the fleet with
+        :meth:`run`."""
+        h = self.select(model, adapter_id=request_kwargs.get("adapter_id"))
         rid = h.engine.add_request(prompt, **request_kwargs)
         self._m_dispatch.labels(engine_id=h.engine_id,
                                 model_id=h.model_id).inc()
@@ -502,7 +525,11 @@ class Router:
             target: Optional[EngineHandle] = None
             if req.req_id not in self._requeued:
                 try:
-                    target = self.select(h.model_id)
+                    # tenancy-aware failover: a constrained/adapter
+                    # request may only land on a sibling HOLDING its
+                    # adapter — adopt_request would reject any other
+                    target = self.select(h.model_id,
+                                         adapter_id=req.adapter_id)
                 except (ValueError, NoHealthyEngineError):
                     target = None
             if target is None:
@@ -850,14 +877,18 @@ class Router:
         return {"engine_id": h.engine_id, "result": "ok",
                 "weights_step": ck_step}
 
-    def _warm(self, h: EngineHandle, warm_prompt: Sequence[int]):
+    def _warm(self, h: EngineHandle, warm_prompt: Sequence[int],
+              **canary_kwargs):
         """Canary decode on the freshly loaded weights: one tiny request
         end-to-end (prefill + one decode token) re-warms the compiled
         programs and proves the checkpoint produces finite logits before
-        the engine rejoins rotation. Returns (ok, finish_reason)."""
+        the engine rejoins rotation. Extra kwargs ride the canary
+        request — ``register_adapter`` warms THROUGH the new adapter
+        (``adapter_id=``), proving its weights finite under live
+        compute. Returns (ok, finish_reason)."""
         eng = h.engine
         wid = eng.add_request(np.asarray(warm_prompt, np.int32),
-                              max_new_tokens=1)
+                              max_new_tokens=1, **canary_kwargs)
         while eng.has_work:
             eng.step()
         outs = eng.take_outputs()
@@ -865,6 +896,71 @@ class Router:
         if outs:  # real outputs scooped alongside the canary: hand back
             self._stash.update(outs)
         return warm.finish_reason in ("stop", "length"), warm.finish_reason
+
+    # ------------------------------------------------------------- adapters
+    def register_adapter(self, name: str, weights,
+                         model: Optional[str] = None,
+                         warm_prompt: Sequence[int] = (1,)
+                         ) -> Dict[str, object]:
+        """Hot-load LoRA adapter ``name`` onto EVERY non-down engine of
+        ``model``, under live traffic: per engine, install the weights
+        (a pure value write into the stacked adapter arrays — the
+        compiled step is untouched, so zero recompiles and zero dropped
+        in-flight work; no drain, unlike :meth:`reload`) and prove them
+        with a one-token canary routed THROUGH the adapter. A canary
+        that retires abnormally rolls that engine's install back
+        (unregister) and reports ``"error"`` — a bad adapter never
+        enters rotation, and siblings that passed keep serving it.
+        Returns a per-engine summary; after an all-ok push,
+        ``select(adapter_id=name)`` sees the whole fleet."""
+        mid = self._resolve_model(model)
+        results: List[Dict[str, object]] = []
+        for h in self._models[mid]:
+            if h.state == DOWN:
+                results.append({"engine_id": h.engine_id,
+                                "result": "skipped-down"})
+                continue
+            try:
+                h.engine.register_adapter(name, weights)
+                canary_ok, reason = self._warm(h, warm_prompt,
+                                               adapter_id=name)
+            except Exception as e:
+                self._m_adapter_loads.labels(result="error").inc()
+                results.append({"engine_id": h.engine_id,
+                                "result": "error", "error": repr(e)})
+                continue
+            if not canary_ok:
+                # roll back: the adapter produced non-finite logits (or
+                # the canary died) — this engine must not advertise it
+                try:
+                    h.engine.unregister_adapter(name)
+                except Exception:
+                    pass
+                self._m_adapter_loads.labels(result="error").inc()
+                results.append({"engine_id": h.engine_id,
+                                "result": "error",
+                                "canary_finish_reason": reason})
+                continue
+            self._m_adapter_loads.labels(result="ok").inc()
+            results.append({"engine_id": h.engine_id, "result": "ok"})
+        return {"adapter": name, "engines": results}
+
+    def unregister_adapter(self, name: str,
+                           model: Optional[str] = None) -> None:
+        """Remove adapter ``name`` from every non-down engine of
+        ``model``. Raises (before touching ANY engine) if a live request
+        still uses it anywhere — drain the tenant first."""
+        mid = self._resolve_model(model)
+        ups = [h for h in self._models[mid] if h.state != DOWN]
+        for h in ups:
+            if h.engine.adapters.holds(name) \
+                    and h.engine._adapter_in_use(name):
+                raise ValueError(
+                    f"adapter {name!r} is in use on engine "
+                    f"{h.engine_id}; drain it before unregistering")
+        for h in ups:
+            if h.engine.adapters.holds(name):
+                h.engine.unregister_adapter(name)
 
     # -------------------------------------------------------------- health
     @staticmethod

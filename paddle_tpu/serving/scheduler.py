@@ -105,6 +105,25 @@ class Request:
     # already streamed (stream_cb seq 0..len-1); emission resumes at
     # seq=len(resume_tokens), so a client never sees a duplicate.
     resume_tokens: Optional[List[int]] = None
+    # multi-LoRA tenancy (docs/SERVING.md "Multi-LoRA adapters"): the
+    # NAME of the adapter this request decodes under, or None for the
+    # base model (slot 0, the zero-delta identity). Names — not slots —
+    # travel with the request: each engine resolves the name against
+    # ITS AdapterStore at admission, so a migrated request lands on
+    # whatever slot the adoptive engine holds the same weights in
+    adapter_id: Optional[str] = None
+    # constrained decoding (docs/SERVING.md "Constrained decoding"): a
+    # compiled serving.grammar.GrammarFSM, or None for free text. The
+    # engine interns its mask table at admission and masks this
+    # request's sample rows inside the compiled step
+    grammar: Optional[object] = None
+    # FSM journal, the grammar sibling of resume_tokens
+    # (docs/RESILIENCE.md "In-flight migration"): the LOCAL DFA state
+    # after the journaled tokens, set by ServingEngine.export_inflight.
+    # Engine-independent (local, not table-offset), so an adoptive
+    # engine resumes mid-structure without replaying the walk — and a
+    # None journal is recomputed from resume_tokens, which must agree
+    resume_fsm_state: Optional[int] = None
     req_id: object = field(default_factory=lambda: next(_req_counter))
     # enqueue wall-clock (perf_counter domain): queue-wait and TTFT are
     # measured from here, so they include scheduling delay, not just
@@ -125,6 +144,15 @@ class Request:
         s = int(self.seed) & 0xFFFFFFFF
         self.seed = s - (1 << 32) if s >= (1 << 31) else s
         self.priority = int(self.priority)
+        if self.adapter_id is not None and not isinstance(self.adapter_id,
+                                                          str):
+            raise ValueError("adapter_id must be a registered adapter "
+                             "NAME (str) or None for the base model")
+        if self.grammar is not None and not hasattr(self.grammar,
+                                                    "mask_table"):
+            raise ValueError(
+                "grammar must be a compiled serving.grammar.GrammarFSM "
+                "(use GrammarFSM.compile(pattern, tokenizer))")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if self.deadline_s is not None and self.deadline_s < 0:

@@ -1,0 +1,330 @@
+"""paddle_tpu.serving.adapters + the ISSUE 16 tenancy compile surface.
+
+Acceptance gates: the AdapterStore is a slotted value store (slot 0 the
+reserved zero-delta identity; register validates-then-writes, first-fit
+reuses freed slots, a full store and shape mismatches raise with the
+limit named); requests with ``adapter_id=None`` and no grammar are
+BIT-IDENTICAL at temperature>0 to a pre-tenancy engine — the identity-
+values proof that adapters and grammar ride the step as data; hot-load
+under live traffic costs ZERO recompiles; and the one-program contract
+``compile_counts()["step"] == ["step_buckets"]`` survives every feature
+combination (adapters / grammar / speculation / all three at once).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (AdapterStore, GrammarFSM, Router,
+                                ServingEngine, random_adapter,
+                                toy_tokenizer)
+
+pytestmark = pytest.mark.serving
+
+TOK = toy_tokenizer(128)
+
+
+def _llama():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+
+
+_PROMPTS = [np.random.RandomState(17).randint(0, 128, (n,))
+            for n in (5, 9, 3)]
+
+
+# ─────────────────────────── AdapterStore ───────────────────────────
+
+
+class TestAdapterStore:
+    def _store(self, capacity=4):
+        return AdapterStore([("q", 8, 8), ("mlp", 8, 16)], num_layers=2,
+                            rank=2, capacity=capacity)
+
+    def test_slot0_reserved_identity(self):
+        s = self._store()
+        assert s.slot(None) == 0
+        for A, B in zip(s.arrays()[::2], s.arrays()[1::2]):
+            assert not np.asarray(A).any() and not np.asarray(B).any()
+
+    def test_register_first_fit_and_reuse(self):
+        s = self._store()
+        assert s.register("a", random_adapter(s, seed=1)) == 1
+        assert s.register("b", random_adapter(s, seed=2)) == 2
+        s.unregister("a")
+        assert not s.holds("a")
+        # freed slot 1 is the first fit for the next tenant
+        assert s.register("c", random_adapter(s, seed=3)) == 1
+        assert sorted(s.names()) == ["b", "c"]
+
+    def test_reregister_hot_swaps_in_place(self):
+        s = self._store()
+        slot = s.register("a", random_adapter(s, seed=1))
+        assert s.register("a", random_adapter(s, seed=9)) == slot
+
+    def test_full_store_raises(self):
+        s = self._store(capacity=2)  # one usable slot beside the identity
+        s.register("a", random_adapter(s, seed=1))
+        with pytest.raises(ValueError, match="adapter store full"):
+            s.register("b", random_adapter(s, seed=2))
+
+    def test_validate_before_write(self):
+        s = self._store()
+        w = random_adapter(s, seed=1)
+        bad = dict(w)
+        A, B = bad["mlp"]
+        bad["mlp"] = (A[:, :1], B)  # wrong rank on ONE site
+        with pytest.raises(ValueError, match="expected A"):
+            s.register("x", bad)
+        assert not s.holds("x")      # nothing partially written
+        with pytest.raises(ValueError, match="missing sites"):
+            s.register("y", {"q": w["q"]})
+
+    def test_unknown_lookups_raise(self):
+        s = self._store()
+        with pytest.raises(KeyError, match="not registered"):
+            s.slot("ghost")
+        with pytest.raises(ValueError, match="capacity must be >= 2"):
+            AdapterStore([("q", 4, 4)], num_layers=1, capacity=1)
+
+    def test_arrays_fixed_order_and_shapes(self):
+        s = self._store()
+        arrs = s.arrays()
+        assert len(arrs) == 4        # (A, B) per site, site order
+        assert tuple(np.asarray(arrs[0]).shape) == (4, 2, 2, 8)
+        assert tuple(np.asarray(arrs[1]).shape) == (4, 2, 8, 2)
+        assert tuple(np.asarray(arrs[3]).shape) == (4, 2, 16, 2)
+
+    def test_unregister_zeroes_the_slot(self):
+        s = self._store()
+        slot = s.register("a", random_adapter(s, seed=1))
+        assert np.asarray(s.arrays()[0])[slot].any()
+        s.unregister("a")
+        assert not np.asarray(s.arrays()[0])[slot].any()
+
+
+# ─────────────────────── engine-level tenancy ───────────────────────
+
+
+class TestEngineTenancy:
+    def _run(self, eng, **kw):
+        rids = [eng.add_request(p, max_new_tokens=6, temperature=0.8,
+                                seed=40 + i, **kw)
+                for i, p in enumerate(_PROMPTS)]
+        outs = eng.run()
+        return [list(outs[r].token_ids) for r in rids]
+
+    def test_base_requests_bit_identical_with_tenants_loaded(self):
+        """The identity-values contract: a registered adapter and an
+        interned grammar (for OTHER requests) change NOTHING for a
+        base-model request — bitwise, at temperature>0 — because slot 0
+        is all-zero deltas (+0.0) and row 0 is an all-True mask."""
+        model = _llama()
+        base = self._run(ServingEngine(model, page_size=4,
+                                       max_batch_slots=4))
+        eng = ServingEngine(model, page_size=4, max_batch_slots=4)
+        eng.register_adapter("acme", random_adapter(eng.adapters, seed=3))
+        fsm = GrammarFSM.compile("[ab]{1,8}", TOK)
+        eng.add_request(np.arange(4), max_new_tokens=4, temperature=0.8,
+                        seed=99, adapter_id="acme", grammar=fsm)
+        assert self._run(eng) == base
+
+    def test_adapter_actually_changes_tokens(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        eng.register_adapter(
+            "loud", random_adapter(eng.adapters, seed=5, scale=1.0))
+        rid_b = eng.add_request(_PROMPTS[0], max_new_tokens=8)
+        rid_a = eng.add_request(_PROMPTS[0], max_new_tokens=8,
+                                adapter_id="loud")
+        outs = eng.run()
+        assert list(outs[rid_a].token_ids) != list(outs[rid_b].token_ids)
+
+    def test_constrained_greedy_validates_and_fsm_stops(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        fsm = GrammarFSM.compile("[ab]{1,4}", TOK)
+        rid = eng.add_request(_PROMPTS[1], max_new_tokens=16, grammar=fsm)
+        out = eng.run()[rid]
+        # the DFA completes at 4 tokens: the host retires with "stop"
+        # even though the model has no eos and 16 tokens were allowed
+        assert out.finish_reason == "stop"
+        assert len(out.token_ids) == 4
+        assert fsm.validates(out.token_ids)
+
+    def test_spec_drafts_composed_with_grammar(self):
+        """ISSUE 16 acceptance: speculation stays PROFITABLE under a
+        grammar. Drafts are host-filtered to their longest grammar-valid
+        prefix before riding the step, so an oracle proposing the
+        (grammar-valid) reference continuation keeps full acceptance
+        and zero filtering, while a drafter proposing grammar-INVALID
+        tokens is filtered (and counted) instead of poisoning the
+        verifier — and every stream is bit-identical to spec-off."""
+        from paddle_tpu import metrics
+
+        model = _llama()
+        fsm = GrammarFSM.compile("[ab]{1,12}", TOK)
+        spec = dict(max_new_tokens=12, grammar=fsm)  # greedy
+        base = ServingEngine(model, page_size=4, max_batch_slots=2)
+        rid = base.add_request(_PROMPTS[0], **spec)
+        ref = list(base.run()[rid].token_ids)
+        assert fsm.validates(ref)
+
+        class _Oracle:
+            def propose(self, ids, k=None):
+                done = len(ids) - _PROMPTS[0].size
+                return np.asarray(ref[done:done + (k or 1)], np.int32)
+
+        class _Invalid:  # token 32 = ' ': never allowed by [ab]{1,12}
+            def propose(self, ids, k=None):
+                return np.full(k or 1, 32, np.int32)
+
+        reg = metrics.get_registry()
+        ACC = "paddle_tpu_serving_spec_accepted_tokens_total"
+        FIL = "paddle_tpu_serving_grammar_draft_filtered_total"
+        a0, f0 = reg.get(ACC).value, reg.get(FIL).value
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3, drafter=_Oracle())
+        rid = eng.add_request(_PROMPTS[0], **spec)
+        assert list(eng.run()[rid].token_ids) == ref
+        assert reg.get(ACC).value - a0 > 0  # acceptance did not collapse
+        assert reg.get(FIL).value == f0  # valid drafts pass untouched
+
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3, drafter=_Invalid())
+        rid = eng.add_request(_PROMPTS[0], **spec)
+        assert list(eng.run()[rid].token_ids) == ref
+        assert reg.get(FIL).value - f0 > 0  # garbage was masked out
+
+    def test_grammar_interning_shared_and_released(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=4)
+        fsm = GrammarFSM.compile("[ab]{1,4}", TOK)
+        for p in _PROMPTS:  # same pattern: ONE segment, refcount 3
+            eng.add_request(p, max_new_tokens=4, grammar=fsm)
+        eng.step()
+        assert len(eng._grammar_segments) == 1
+        [seg] = eng._grammar_segments.values()
+        assert seg[2] == 3 and seg[0] == 1  # first-fit right after row 0
+        eng.run()
+        assert eng._grammar_segments == {}  # released at retirement
+
+    def test_hot_load_under_traffic_zero_recompiles(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+
+        def traffic(**req_kw):
+            slow = eng.add_request(_PROMPTS[0], max_new_tokens=12,
+                                   temperature=0.6, seed=7)
+            eng.step()  # slow is live when the tenant request arrives
+            if req_kw:  # the hot-load happens MID-traffic
+                eng.register_adapter(
+                    "acme", random_adapter(eng.adapters, seed=3))
+            rid = eng.add_request(_PROMPTS[2], max_new_tokens=4, **req_kw)
+            return slow, rid, eng.run()
+
+        traffic()  # warm phase: same shapes, no tenants — every bucket
+        counts = eng.compile_counts()
+        slow, rid, outs = traffic(
+            adapter_id="acme",
+            grammar=GrammarFSM.compile("[ab]{1,6}", TOK))
+        assert eng.compile_counts() == counts  # value write, no program
+        assert len(outs[slow].token_ids) == 12
+        assert outs[rid].finish_reason in ("stop", "length")
+
+    def test_enqueue_rejects_unserveable_features(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            grammar_states=8)
+        with pytest.raises(ValueError, match="not registered on this"):
+            eng.add_request(_PROMPTS[0], adapter_id="ghost")
+        with pytest.raises(ValueError, match="vocab_size"):
+            eng.add_request(_PROMPTS[0],
+                            grammar=GrammarFSM.compile(
+                                "[AB]", toy_tokenizer(64)))
+        with pytest.raises(ValueError, match="grammar needs"):
+            eng.add_request(_PROMPTS[0],
+                            grammar=GrammarFSM.compile("[ab]{9}", TOK))
+
+    def test_unregister_refuses_while_in_use(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+        eng.register_adapter("acme", random_adapter(eng.adapters, seed=3))
+        eng.add_request(_PROMPTS[0], max_new_tokens=4, adapter_id="acme")
+        with pytest.raises(ValueError, match="in use"):
+            eng.unregister_adapter("acme")
+        eng.run()
+        eng.unregister_adapter("acme")  # drained: now fine
+        assert not eng.adapters.holds("acme")
+
+
+# ──────────────────── the one-program contract ────────────────────
+
+
+class TestTenancyCompileSurface:
+    """`compile_counts()["step"] == ["step_buckets"]` — exactly one
+    program per grid bucket, no matter which tenancy features are live.
+    Adapters, grammars, and speculation are all DATA to the same step."""
+
+    @pytest.mark.parametrize("features", ["adapters", "grammar", "spec",
+                                          "all"])
+    def test_step_equals_bucket_count(self, features):
+        model = _llama()
+        kw = dict(page_size=4, max_batch_slots=2, token_budget=16)
+        if features in ("spec", "all"):
+            kw["spec_k"] = 2
+        eng = ServingEngine(model, **kw)
+        req = {}
+        if features in ("adapters", "all"):
+            eng.register_adapter("t", random_adapter(eng.adapters, seed=2))
+            req["adapter_id"] = "t"
+        if features in ("grammar", "all"):
+            req["grammar"] = GrammarFSM.compile("[ab]{1,12}", TOK)
+        rng = np.random.RandomState(23)
+        for n, new in ((3, 2), (24, 3), (7, 5), (24, 2)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new,
+                            **req)
+            eng.step()
+        eng.run()
+        counts = eng.compile_counts()
+        assert counts["step"] == counts["step_buckets"]
+        # replaying the mix compiles nothing new
+        eng.add_request(rng.randint(0, 128, (24,)), max_new_tokens=2,
+                        **req)
+        eng.run()
+        assert eng.compile_counts() == counts
+
+
+# ───────────────────────── router tenancy ─────────────────────────
+
+
+class TestRouterTenancy:
+    def test_fleet_hot_load_canary_and_routing(self):
+        model = _llama()
+        r = Router()
+        r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2)
+        from paddle_tpu.serving import NoHealthyEngineError
+        with pytest.raises(NoHealthyEngineError, match="holds adapter"):
+            r.select("m", adapter_id="acme")
+        res = r.register_adapter(
+            "acme", random_adapter(r.engine("m/0").adapters, seed=3),
+            model="m")
+        assert [e["result"] for e in res["engines"]] == ["ok", "ok"]
+        assert all(r.engine(f"m/{i}").adapters.holds("acme")
+                   for i in range(2))
+        h = r.select("m", adapter_id="acme")
+        assert h.model_id == "m"
+
+    def test_bad_adapter_rolls_back_on_canary(self):
+        model = _llama()
+        r = Router()
+        r.add_model("m", model, replicas=1, page_size=4, max_batch_slots=2)
+        store = r.engine("m/0").adapters
+        poison = {site: (np.full_like(np.asarray(A), np.nan), B)
+                  for site, (A, B) in
+                  random_adapter(store, seed=4).items()}
+        res = r.register_adapter("bad", poison, model="m")
+        assert [e["result"] for e in res["engines"]] == ["error"]
+        assert not store.holds("bad")  # rolled back, never in rotation

@@ -1,0 +1,246 @@
+"""paddle_tpu.serving.grammar: regex/JSON-schema -> token-level DFA
+(ISSUE 16).
+
+Acceptance gates: the regex subset (literals, classes, escapes, groups,
+alternation, ``* + ?`` and ``{m,n}`` bounds) compiles to a DFA whose
+walks agree with hand-enumerable languages; dead states are pruned so
+"token allowed" always means "can still complete"; the eos column opens
+exactly in accepting states; ``schema_to_regex`` emits real JSON (every
+accepted string round-trips ``json.loads``); ``compile`` is bit-
+deterministic in (pattern, tokenizer) — the property FSM-journal
+migration rests on; and corrupt journals (tokens that leave the
+grammar) raise instead of resuming.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (GrammarFSM, ToyTokenizer, schema_to_regex,
+                                toy_tokenizer)
+from paddle_tpu.serving.grammar import _dfa
+
+pytestmark = pytest.mark.serving
+
+# one id per printable character (plus an eos id): walks below can
+# encode any ASCII sample string directly
+TOK = toy_tokenizer(96, eos_token_id=95)
+
+
+def _fsm(pattern):
+    return GrammarFSM.compile(pattern, TOK)
+
+
+def _accepts(fsm, text):
+    return fsm.validates(TOK.encode(text))
+
+
+# ───────────────────────────── tokenizer ─────────────────────────────
+
+
+class TestToyTokenizer:
+    def test_decode_encode_roundtrip(self):
+        t = toy_tokenizer(96)
+        for ch in " azAZ09{}\"[]~!":
+            [tid] = t.encode(ch)
+            assert t.decode_token(tid) == ch
+
+    def test_eos_decodes_empty(self):
+        t = toy_tokenizer(96, eos_token_id=95)
+        assert t.decode_token(95) == ""
+        assert t.decode_token(0) == " "
+
+    def test_vocab_wraps_one_alphabet_cycle(self):
+        t = toy_tokenizer(200)
+        assert t.decode_token(7) == t.decode_token(7 + 95)
+
+
+# ─────────────────────────── regex -> DFA ───────────────────────────
+
+
+class TestRegexDFA:
+    @pytest.mark.parametrize("pattern,yes,no", [
+        ("abc", ["abc"], ["ab", "abcd", "abd", ""]),
+        ("a|bc", ["a", "bc"], ["b", "c", "abc", ""]),
+        ("ab*", ["a", "ab", "abbbb"], ["b", "aab", ""]),
+        ("ab+c", ["abc", "abbc"], ["ac", "ab", "bc"]),
+        ("ab?c", ["ac", "abc"], ["abbc", "a", "c"]),
+        ("a{3}", ["aaa"], ["aa", "aaaa", ""]),
+        ("a{2,4}", ["aa", "aaa", "aaaa"], ["a", "aaaaa"]),
+        ("a{2,}", ["aa", "a" * 9], ["a", ""]),
+        ("[a-c]{2}", ["ab", "cc"], ["ad", "a", "abc"]),
+        ("[^a-y]", ["z", "!", "0"], ["a", "m", "y", "zz"]),
+        ("(ab|cd)+", ["ab", "cdab"], ["abc", "a", ""]),
+        ("x.z", ["xaz", "x!z"], ["xz", "xaaz"]),
+        ("\\d{1,2}", ["7", "42"], ["a", "123", ""]),
+        ("\\w+", ["a9_Z"], ["a b", "!", ""]),
+        ("\\[\\d\\]", ["[4]"], ["[44]", "4"]),
+        ("", [""], ["a"]),
+    ])
+    def test_language_membership(self, pattern, yes, no):
+        fsm = _fsm(pattern)
+        for text in yes:
+            assert _accepts(fsm, text), (pattern, text)
+        for text in no:
+            assert not _accepts(fsm, text), (pattern, text)
+
+    @pytest.mark.parametrize("pattern,msg", [
+        ("(ab", "unbalanced"),
+        ("ab)", "unconsumed"),
+        ("[ab", "unbalanced"),
+        ("*a", "dangling quantifier"),
+        ("a{4,2}", "bad bounds"),
+        ("[z-a]", "bad range"),
+        ("a\\", "dangling backslash"),
+    ])
+    def test_parse_errors(self, pattern, msg):
+        with pytest.raises(ValueError, match=msg):
+            _dfa(pattern)
+
+    def test_impossible_pattern_raises(self):
+        # \n is outside the printable alphabet: the whole language is
+        # empty, and an empty grammar must fail at compile, not at mask
+        with pytest.raises(ValueError, match="matches nothing"):
+            _dfa("a\\nb")
+
+    def test_dead_branches_pruned_from_masks(self):
+        # the "a\n" branch cannot complete, so after 'a' the only
+        # allowed continuation is the 'b' of the live branch — a token
+        # entering a dead corner must be masked, not strand the stream
+        fsm = _fsm("ab|a\\nc")
+        s = fsm.next_state(0, TOK.encode("a")[0])
+        allowed = set(fsm.allowed(s))
+        assert allowed == {TOK.encode("b")[0]}
+
+    def test_start_state_is_zero(self):
+        fsm = _fsm("ab")
+        assert fsm.start_state == 0
+        assert fsm.next_state(0, TOK.encode("a")[0]) > 0
+
+
+# ───────────────────────────── the FSM ─────────────────────────────
+
+
+class TestGrammarFSM:
+    def test_mask_and_transition_tables_agree(self):
+        fsm = _fsm("[ab]{1,3}c")
+        assert fsm.mask_table.shape == (fsm.n_states, 96)
+        assert np.array_equal(fsm.mask_table[:, :95],
+                              fsm.token_next[:, :95] >= 0)
+
+    def test_eos_column_only_in_accepting_states(self):
+        fsm = _fsm("ab?")
+        eos_open = {s for s in range(fsm.n_states)
+                    if fsm.mask_table[s, 95]}
+        assert eos_open == {s for s in range(fsm.n_states)
+                            if fsm.is_accepting(s)}
+        assert eos_open  # the pattern does accept something
+
+    def test_validates_strips_trailing_eos(self):
+        fsm = _fsm("ab")
+        toks = TOK.encode("ab")
+        assert fsm.validates(toks)
+        assert fsm.validates(toks + [95])
+        assert not fsm.validates([95])          # eos on an empty stream
+        assert not fsm.validates(TOK.encode("a"))
+
+    def test_advance_raises_on_corrupt_journal(self):
+        fsm = _fsm("ab")
+        good = fsm.advance(0, TOK.encode("a"))
+        assert fsm.is_accepting(fsm.advance(good, TOK.encode("b")))
+        with pytest.raises(ValueError, match="disallowed in state"):
+            fsm.advance(0, TOK.encode("ba"))
+
+    def test_is_complete_when_no_continuation(self):
+        fsm = _fsm("a{1,3}")
+        s = fsm.advance(0, TOK.encode("a"))
+        assert fsm.is_accepting(s) and not fsm.is_complete(s)
+        s = fsm.advance(s, TOK.encode("aa"))
+        assert fsm.is_complete(s)               # 3 a's: nothing may follow
+
+    def test_compile_is_bit_deterministic(self):
+        # the migration contract: sibling engines compiling the same
+        # (pattern, tokenizer) build bit-equal tables, so a journaled
+        # integer state means the same thing everywhere
+        a, b = _fsm("(ab|cd){1,4}x?"), _fsm("(ab|cd){1,4}x?")
+        assert np.array_equal(a.mask_table, b.mask_table)
+        assert np.array_equal(a.token_next, b.token_next)
+        assert a.key == b.key
+
+    def test_key_distinguishes_vocab_and_eos(self):
+        assert _fsm("AB").key != GrammarFSM.compile(
+            "AB", toy_tokenizer(64)).key
+
+    def test_uncoverable_grammar_raises(self):
+        # a tokenizer whose vocab cannot emit 'b' leaves the post-'a'
+        # state with an empty row: compile must fail fast, because the
+        # in-step mask would otherwise sample uniform garbage
+        class OnlyA:
+            vocab_size = 1
+            eos_token_id = None
+
+            def decode_token(self, t):
+                return "a"
+
+        with pytest.raises(ValueError, match="allows no token"):
+            GrammarFSM.compile("ab", OnlyA())
+
+
+# ─────────────────────────── JSON schemas ───────────────────────────
+
+
+class TestSchemaToRegex:
+    @pytest.mark.parametrize("schema,value", [
+        ({"type": "boolean"}, True),
+        ({"type": "null"}, None),
+        ({"type": "integer"}, -407),
+        ({"type": "number"}, 3.25),
+        ({"const": {"ok": 1}}, {"ok": 1}),
+        ({"enum": ["red", "green"]}, "green"),
+        ({"type": "object",
+          "properties": {"a": {"type": "integer"},
+                         "b": {"type": "boolean"}}}, {"a": 12, "b": False}),
+        ({"type": "array", "items": {"type": "integer"},
+          "minItems": 1, "maxItems": 3}, [1, 22, 333]),
+    ])
+    def test_canonical_serialization_accepted(self, schema, value):
+        fsm = GrammarFSM.compile(schema, TOK)
+        text = json.dumps(value, separators=(",", ":"))
+        assert _accepts(fsm, text)
+        # non-canonical spacing is NOT in the language: constrained
+        # decoding needs exactly one serialization per instance
+        spaced = json.dumps(value, separators=(", ", ": "))
+        if spaced != text:
+            assert not _accepts(fsm, spaced)
+
+    def test_every_accepted_string_is_real_json(self):
+        # greedy generative walk: from every reachable state take each
+        # allowed continuation once, close at the first accepting state
+        # hit after the fork — all harvested strings must json.loads
+        schema = {"type": "object",
+                  "properties": {"n": {"type": "integer"},
+                                 "t": {"type": "boolean"}}}
+        fsm = GrammarFSM.compile(schema, TOK)
+        rng = np.random.default_rng(0)
+        for _ in range(25):
+            state, out = 0, []
+            for _ in range(64):
+                if fsm.is_complete(state) or (
+                        fsm.is_accepting(state) and rng.random() < 0.5):
+                    break
+                choices = [t for t in fsm.allowed(state) if t != 95]
+                tok = int(choices[rng.integers(len(choices))])
+                out.append(tok)
+                state = fsm.next_state(state, tok)
+            assert fsm.is_accepting(state)
+            decoded = "".join(TOK.decode_token(t) for t in out)
+            obj = json.loads(decoded)
+            assert set(obj) == {"n", "t"}
+
+    def test_array_bounds_validated(self):
+        with pytest.raises(ValueError, match="array bounds"):
+            schema_to_regex({"type": "array", "maxItems": 0})
+
+    def test_unsupported_schema_raises(self):
+        with pytest.raises(ValueError, match="unsupported schema"):
+            schema_to_regex({"type": "tuple"})

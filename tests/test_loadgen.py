@@ -127,6 +127,44 @@ class TestTrace:
         with pytest.raises(ValueError, match="prefix_len"):
             TraceConfig(prefix_len=32, max_prompt_len=32)
 
+    def test_tenancy_mixes_seeded_and_match_weights(self):
+        """ISSUE 16 knobs: adapter_mix / schema_mix draw seeded
+        categorical tenancy per request — same seed, same bytes — and
+        the empirical shares match the weights within 5 sigma."""
+        cfg = TraceConfig(
+            seed=21, num_requests=4000,
+            adapter_mix=((None, 0.5), ("acme", 0.3), ("zen", 0.2)),
+            schema_mix=((None, 0.75), ("[ab]{1,6}", 0.25)))
+        tr = generate_trace(cfg)
+        assert tr.to_jsonl() == generate_trace(cfg).to_jsonl()
+        n = cfg.num_requests
+        for got, want in (
+                (sum(r.adapter_id == "acme" for r in tr.requests), 0.3),
+                (sum(r.adapter_id == "zen" for r in tr.requests), 0.2),
+                (sum(r.grammar is not None for r in tr.requests), 0.25)):
+            assert abs(got / n - want) < 5 * np.sqrt(0.25 / n)
+        # the grammar rides the trace as its PATTERN string (jsonl-able;
+        # each replayer compiles it against its own tokenizer)
+        pats = {r.grammar for r in tr.requests if r.grammar is not None}
+        assert pats == {"[ab]{1,6}"}
+        assert json.loads(tr.to_jsonl().splitlines()[0]).keys() >= {
+            "adapter_id", "grammar"}
+
+    def test_tenancy_mixes_off_draw_nothing(self):
+        # knobs off: no rng consumed, every request is a base-model
+        # unconstrained one — the pre-ISSUE-16 stream, bit-for-bit
+        tr = generate_trace(TraceConfig(seed=21, num_requests=200))
+        assert all(r.adapter_id is None and r.grammar is None
+                   for r in tr.requests)
+
+    def test_tenancy_mix_validation(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            TraceConfig(adapter_mix=())
+        with pytest.raises(ValueError, match="weights must be > 0"):
+            TraceConfig(schema_mix=(("x", 0.0),))
+        with pytest.raises(ValueError, match="str or None"):
+            TraceConfig(adapter_mix=((3, 1.0),))
+
     def test_virtual_clock(self):
         c = VirtualClock()
         assert c.now() == 0.0 and c() == 0.0
@@ -458,3 +496,41 @@ class TestEndToEnd:
         assert d["goodput_tok_s"] > 0
         assert d["prefix_hit_ratio"] is not None  # Zipf sharing hit
         assert json.dumps(d)                      # JSON-serializable
+
+
+# ───────────────────── tenancy replay (ISSUE 16) ─────────────────────
+
+
+class TestTenancyReplay:
+    def test_report_carries_adapter_goodput_and_validity(self):
+        """A mixed adapter/constrained trace replays through the driver:
+        per-adapter goodput splits by tenant (the '' key is the base
+        model), every constrained completion validates against its
+        compiled grammar, and both fields ride LoadReport.to_dict()."""
+        from paddle_tpu.serving import random_adapter
+
+        r = Router()
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        r.register_adapter(
+            "acme", random_adapter(r.engine("m/0").adapters, seed=6),
+            model="m")
+        cfg = TraceConfig(
+            seed=33, num_requests=14, vocab_size=32, arrival_rate=10.0,
+            prefix_len=5, max_prompt_len=16, max_output_len=6,
+            adapter_mix=((None, 0.5), ("acme", 0.5)),
+            schema_mix=((None, 0.5), ("[0-9]{1,6}", 0.5)))
+        trace = generate_trace(cfg)
+        assert any(t.adapter_id == "acme" for t in trace.requests)
+        assert any(t.grammar is not None for t in trace.requests)
+        rep = LoadDriver(r, trace).run()
+        assert rep.exactly_once, rep.violations
+        assert set(rep.adapter_goodput) <= {"", "acme"}
+        assert "acme" in rep.adapter_goodput
+        assert all(v > 0 for v in rep.adapter_goodput.values())
+        # a "stop" that fails its grammar would be a violation above;
+        # validity < 1.0 can only come from "length" truncation
+        assert rep.constrained_validity is not None
+        assert 0.0 <= rep.constrained_validity <= 1.0
+        d = rep.to_dict()
+        assert d["adapter_goodput"] == rep.adapter_goodput
+        assert d["constrained_validity"] == rep.constrained_validity

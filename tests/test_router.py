@@ -25,8 +25,8 @@ import paddle_tpu as paddle
 from paddle_tpu import faults, metrics
 from paddle_tpu.checkpoint import CheckpointManager
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny
-from paddle_tpu.serving import (CompletionAPI, EnginePool,
-                                NoHealthyEngineError, Router)
+from paddle_tpu.serving import (CompletionAPI, NoHealthyEngineError,
+                                Router)
 
 pytestmark = pytest.mark.serving
 
@@ -721,33 +721,41 @@ class TestReload:
                 == err_before + 1)
 
 
-# ─────────────────────────── EnginePool shim ───────────────────────────
+# ─────────────── rotation + label coverage (ex-EnginePool shim) ───────────────
 
 
-class TestEnginePoolShim:
-    def test_engine_pool_construction_warns_deprecation(self):
-        """The shim actively steers callers to Router: constructing one
-        raises a DeprecationWarning (it stays fully functional)."""
-        with pytest.warns(DeprecationWarning,
-                          match="EnginePool is deprecated"):
-            pool = EnginePool(_model(), size=1, page_size=4,
-                              max_batch_slots=1)
-        assert len(pool) == 1  # still works after warning
+class TestRotationAndLabels:
+    """The EnginePool shim is gone (ISSUE 16); its remaining guarantees
+    — bounded round-robin rotation, indexable engines, per-engine metric
+    labels — are asserted on the Router surface directly."""
 
-    def test_modular_round_robin_and_inherited_control_plane(self):
-        pool = EnginePool(_model(), size=2, page_size=4, max_batch_slots=1)
-        a, b, c = pool.next(), pool.next(), pool.next()
-        assert a is pool.retrieve(0) and b is pool.retrieve(1) and c is a
-        assert pool._rr_idx == 1  # modular index, not an unbounded count
-        assert len(pool) == 2
-        # the full Router surface rides along on the shim
-        assert pool.select().engine_id in ("default/0", "default/1")
-        assert pool.health()["status"] == "ok"
+    def test_engine_pool_shim_is_deleted(self):
+        import paddle_tpu.serving as serving
+        assert not hasattr(serving, "EnginePool")
+        assert not hasattr(serving.api, "EnginePool")
+
+    def test_modular_round_robin_tie_break(self):
+        router = Router()
+        router.add_model("default", _model(), replicas=2, page_size=4,
+                         max_batch_slots=1)
+        # an idle fleet is an exact load tie: the cursor rotates and
+        # stays MODULAR (never an unbounded count)
+        picks = [router.select().engine_id for _ in range(4)]
+        assert picks == ["default/0", "default/1",
+                         "default/0", "default/1"]
+        assert router._rr["default"] in (0, 1)
+        assert len(router) == 2
+        # indexable engines survived the shim: engines() is ordered
+        engines = router.engines("default")
+        assert engines[0] is router.engine("default/0")
+        assert router.health()["status"] == "ok"
 
     def test_serving_series_carry_engine_and_model_labels(self):
-        pool = EnginePool(_model(), size=2, page_size=4, max_batch_slots=1)
-        rid = pool.submit(P3, max_new_tokens=2)
-        outs = pool.run()
+        router = Router()
+        router.add_model("default", _model(), replicas=2, page_size=4,
+                         max_batch_slots=1)
+        rid = router.submit(P3, max_new_tokens=2)
+        outs = router.run()
         assert outs[rid].finish_reason == "length"
         snap = metrics.get_registry().snapshot()
         labels = [s["labels"] for s in

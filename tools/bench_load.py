@@ -40,7 +40,8 @@ ROW_KEYS = ("metric", "value", "unit", "vs_baseline", "config", "device",
 REPORT_KEYS = ("seed", "num_requests", "goodput_tok_s", "outcomes",
                "tiers", "unavailable_rate", "timeout_rate",
                "prefix_hit_ratio", "engines_peak", "engines_final",
-               "scale_ups", "scale_downs", "exactly_once", "violations")
+               "scale_ups", "scale_downs", "adapter_goodput",
+               "constrained_validity", "exactly_once", "violations")
 TIER_KEYS = ("requests", "ttft_slo_s", "itl_slo_s", "ttft_attainment",
              "itl_attainment")
 
@@ -74,7 +75,7 @@ def run_drill(seed: int, requests: int, max_engines: int):
     import paddle_tpu as paddle
     from paddle_tpu import loadgen
     from paddle_tpu.models import LlamaForCausalLM, llama_tiny
-    from paddle_tpu.serving import Router
+    from paddle_tpu.serving import Router, random_adapter
 
     paddle.seed(0)
     model = LlamaForCausalLM(llama_tiny(
@@ -84,12 +85,24 @@ def run_drill(seed: int, requests: int, max_engines: int):
     router.add_model("bench", model, replicas=1, page_size=4,
                      num_pages=128, max_batch_slots=4, max_model_len=64,
                      token_budget=32, min_step_tokens=32, max_queue=128)
+    # two LoRA tenants, hot-loaded fleet-wide before traffic; the spec
+    # propagates so autoscaler-spawned replicas hold them too
+    store = router.engine("bench/0").adapters
+    router.register_adapter("acme", random_adapter(store, seed=11),
+                            model="bench")
+    router.register_adapter("zen", random_adapter(store, seed=12),
+                            model="bench")
     cfg = loadgen.TraceConfig(
         seed=seed, num_requests=requests, vocab_size=128,
         arrival_rate=8.0, burst_start=0.3, burst_duration=1.5,
         burst_factor=6.0, num_prompt_families=6, prefix_len=8,
         max_prompt_len=28, max_output_len=8,
-        slow_consumer_fraction=0.05)
+        slow_consumer_fraction=0.05,
+        # tenancy mixes (ISSUE 16): 50% base model, two adapter tenants;
+        # a third of requests constrained to short letter runs — the
+        # {1,6} lower bound keeps even a 1-token truncation grammar-valid
+        adapter_mix=((None, 0.5), ("acme", 0.3), ("zen", 0.2)),
+        schema_mix=((None, 0.67), ("[ab]{1,6}", 0.33)))
     trace = loadgen.generate_trace(cfg)
     scaler = loadgen.QueueDepthAutoscaler(
         router, config=loadgen.AutoscalerConfig(
@@ -98,7 +111,8 @@ def run_drill(seed: int, requests: int, max_engines: int):
             cooldown_steps=6))
     report = loadgen.LoadDriver(router, trace, autoscaler=scaler).run()
     label = (f"llama-tiny fleet 1..{max_engines} seed={seed} "
-             f"n={requests} burst=6x zipf=1.2 slow=5%")
+             f"n={requests} burst=6x zipf=1.2 slow=5% "
+             f"adapters=2@50% constrained=33%")
     return report, label, str(jax.devices()[0].platform)
 
 

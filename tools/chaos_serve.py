@@ -44,6 +44,13 @@ their pinned step shape from the persistent compile cache with ZERO
 fresh compiles) and the post-burst cold signal must drain-then-remove
 back to exactly 1 engine — every trace request completing or retiring
 ``"unavailable"`` exactly-once, no leaked pages or move-once marks.
+Scenario 16 kills the busiest engine mid-stream under MULTI-LoRA +
+CONSTRAINED traffic (ISSUE 16): every request decodes through a
+hot-loaded adapter slot AND a grammar DFA mask, the migration journal
+carries the per-request FSM state, and the adoptive sibling must resume
+the grammar walk mid-structure — final streams bit-identical to an
+uninterrupted lone-engine run, every output grammar-valid, chunks
+exactly-once, grammar mask segments fully released afterward.
 Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
@@ -70,8 +77,9 @@ import paddle_tpu as paddle  # noqa: E402
 from paddle_tpu import faults, metrics  # noqa: E402
 from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
-from paddle_tpu.serving import (BackpressureError, Router,  # noqa: E402
-                                ServingEngine)
+from paddle_tpu.serving import (BackpressureError, GrammarFSM,  # noqa: E402
+                                Router, ServingEngine, random_adapter,
+                                toy_tokenizer)
 
 SEED = int(os.environ.get("CHAOS_SEED", "0"))
 
@@ -937,6 +945,125 @@ def scenario_autoscale_under_burst(model):
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def scenario_kill_engine_mid_constrained_adapter_stream(model):
+    """Scenario 16 (ISSUE 16): the kill drill under MULTI-LoRA +
+    CONSTRAINED decoding. Every request samples through a hot-loaded
+    adapter slot and a grammar DFA mask; the busiest engine dies after
+    two decode steps, so every in-flight request is MID-STRUCTURE —
+    its FSM state is a nonzero interior state that rides the migration
+    journal (``resume_fsm_state``) to the sibling, which must resume
+    the grammar walk where the dead engine left it. Streams must end
+    bit-identical to an uninterrupted lone engine holding the same
+    adapter weights, every output must validate against its grammar
+    (including the FSM-driven ``"stop"``), chunks exactly-once, and the
+    released mask segments must return every engine's grammar table to
+    its identity row."""
+    tok = toy_tokenizer(128)
+    fsms = [GrammarFSM.compile(pat, tok)
+            for pat in ("[ab]{1,4}", "[abc]{2,12}", "[ab]{1,6}")]
+    specs = [(P5, fsms[0], 10, 0.9, 31), (P9, fsms[1], 8, 0.7, 32),
+             (P3, fsms[2], 6, 1.1, 33)]
+    # the oracle: a lone engine with the SAME adapter weights
+    # (random_adapter is deterministic in (store shape, seed)) and the
+    # same grammars, never killed — identical streams prove the crash +
+    # FSM-journal migration changed no token anywhere
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    ref_eng.register_adapter("acme", random_adapter(ref_eng.adapters,
+                                                    seed=16))
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s, adapter_id="acme", grammar=g)
+               for p, g, n, t, s in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(all(g.validates(toks) for g, toks in zip(fsms, refs)),
+           "oracle run produced a grammar-invalid stream")
+    _check(ref_outs[ref_ids[0]].finish_reason == "stop",
+           "request 0 never exercised the FSM-driven stop")
+
+    r = Router()
+    r.add_model("m", model, replicas=2, page_size=4, max_batch_slots=2)
+    r.register_adapter("acme",
+                       random_adapter(r.engine("m/0").adapters, seed=16),
+                       model="m")
+    e0 = r.engine("m/0")  # the busiest engine: ALL traffic lands here
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tk, fin, seq: chunks[i].append((seq, tk))
+
+    rids = [e0.add_request(p, max_new_tokens=n, temperature=t, seed=s,
+                           adapter_id="acme", grammar=g, stream_cb=cb(i))
+            for i, (p, g, n, t, s) in enumerate(specs)]
+    crash0 = _counter("paddle_tpu_router_engine_crash_total",
+                      engine_id="m/0", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    req0 = _counter("paddle_tpu_router_requeued_total")
+    gtok0 = _counter("paddle_tpu_serving_grammar_tokens_total")
+    valid0 = _counter("paddle_tpu_serving_grammar_completions_total",
+                      result="valid")
+    invalid0 = _counter("paddle_tpu_serving_grammar_completions_total",
+                        result="invalid")
+    for _ in range(2):
+        r.step()  # both decoders reach gen=2: mid-structure; req 2 waits
+    _check(_counter("paddle_tpu_serving_grammar_tokens_total") - gtok0
+           >= 4, "no grammar-masked tokens landed before the kill")
+    with faults.inject(
+            "router.engine_step",
+            raise_=RuntimeError("engine killed mid-constrained-stream"),
+            times=1, seed=SEED):
+        r.step()  # the scheduled kill — must NOT escape router.step()
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    # everything streamed so far must be a prefix of the oracle — a
+    # grammar-divergent sample or a stale FSM state would diverge here
+    for i, ref in enumerate(refs):
+        got = [t for _, t in chunks[i] if t is not None]
+        _check(got == ref[:len(got)],
+               f"request {i} streamed a grammar-divergent token")
+        if i < 2:  # the two decoding slots; request 2 is still queued
+            _check(got and len(got) < len(ref),
+                   f"request {i} not mid-structure at the kill")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_engine_crash_total",
+                    engine_id="m/0", model_id="m") == crash0 + 1,
+           "crash counter != exactly 1")
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the 2 in-flight requests at the kill")
+    _check(_counter("paddle_tpu_router_requeued_total") == req0 + 1,
+           "requeue counter != the 1 waiting request at the kill")
+    for i, (rid, ref, fsm) in enumerate(zip(rids, refs, fsms)):
+        _check(outs[rid].finish_reason == ref_outs[ref_ids[i]]
+               .finish_reason,
+               f"request {i} finish_reason diverged from the oracle")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the uninterrupted oracle")
+        _check(fsm.validates(outs[rid].token_ids),
+               f"request {i} completed grammar-invalid after migration")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([s for s, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    valid = _counter("paddle_tpu_serving_grammar_completions_total",
+                     result="valid") - valid0
+    _check(valid == len(specs),
+           f"grammar-valid completions counter moved {valid}, "
+           f"want {len(specs)}")
+    _check(_counter("paddle_tpu_serving_grammar_completions_total",
+                    result="invalid") == invalid0,
+           "a completion retired grammar-invalid")
+    _check(all(len(e._grammar_segments) == 0 for e in r.engines("m")),
+           "grammar mask segments leaked after the drill")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+           "pages leaked")
+    return (f"m/0 killed mid-structure: FSM journals resumed on the "
+            f"sibling, {len(specs)} adapter+grammar streams "
+            "bit-identical to the uninterrupted run, every output "
+            "grammar-valid, chunks exactly-once, mask segments released")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -954,6 +1081,8 @@ SCENARIOS = [
     ("thread-fuzz-control-plane", scenario_thread_fuzz_control_plane),
     ("kill-engine-mid-spec-burst", scenario_kill_engine_mid_spec_burst),
     ("autoscale-under-burst", scenario_autoscale_under_burst),
+    ("kill-engine-mid-constrained-adapter-stream",
+     scenario_kill_engine_mid_constrained_adapter_stream),
 ]
 
 

@@ -74,7 +74,48 @@ def _demo_registry():
         engine.run()
     _demo_train_sentinel()
     _demo_loadgen()
+    _demo_adapters_grammar()
     return metrics.get_registry()
+
+
+def _demo_adapters_grammar():
+    """Multi-LoRA + constrained-decoding drill (ISSUE 16): hot-load an
+    adapter through the Router (canary warm-up included), then decode
+    one adapter-routed constrained request and one base-model
+    constrained request through a garbage drafter whose every proposal
+    the grammar pre-filter drops — so the whole
+    paddle_tpu_serving_adapter_* / _grammar_* family set plus
+    paddle_tpu_serving_adapter_loads_total is live in the snapshot."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import (GrammarFSM, Router, random_adapter,
+                                    toy_tokenizer)
+
+    class _Garbage:
+        def propose(self, ids, k=None):
+            # token 0 decodes to ' ' — never inside [AB]{1,6}, so every
+            # draft against the grammar is host-filtered before the step
+            return np.zeros(k or 1, np.int32)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32))
+    router = Router()
+    router.add_model("tenancy-demo", model, replicas=1, page_size=4,
+                     max_batch_slots=2, spec_k=2, drafter=_Garbage())
+    store = router.engine("tenancy-demo/0").adapters
+    router.register_adapter("acme", random_adapter(store, seed=1),
+                            model="tenancy-demo")
+    fsm = GrammarFSM.compile("[AB]{1,6}", toy_tokenizer(64))
+    rng = np.random.default_rng(1)
+    router.submit(rng.integers(1, 64, (5,)), model="tenancy-demo",
+                  max_new_tokens=6, adapter_id="acme", grammar=fsm)
+    router.submit(rng.integers(1, 64, (4,)), model="tenancy-demo",
+                  max_new_tokens=4, grammar=fsm)
+    router.run()
 
 
 def _demo_loadgen():
