@@ -30,6 +30,13 @@ Code grammar:
   ``faults.declare_point`` / ``faults.inject`` (or those names imported
   bare). Non-literal names (``faults.point(point_name)``) are skipped —
   the literal appears at the caller that chose the name.
+- A trace emit site (TPL010) is ``<tracer>.emit("name", ...)`` with a
+  literal name where ``<tracer>`` looks like a tracer (``trace`` /
+  ``_trace`` / ``tracer`` / ``_tracer`` tail, or a ``get_tracer()``
+  call) — the receiver shape is the discriminator that keeps
+  unrelated ``.emit(...)`` APIs (the ONNX node builder) out of the
+  catalog. Doc side: a backtick span in the FIRST cell of an
+  OBSERVABILITY.md table row matching ``req.name`` / ``step.name``.
 """
 from __future__ import annotations
 
@@ -41,14 +48,21 @@ from typing import Dict, List, Optional, Tuple
 from .scopes import dotted_name
 
 __all__ = [
-    "FaultSite", "MetricRegistration", "collect_fault_sites",
-    "collect_label_uses", "collect_metric_registrations",
-    "parse_fault_doc", "parse_metric_doc", "sanitize_metric_name",
+    "FaultSite", "MetricRegistration", "TraceEmit",
+    "collect_fault_sites", "collect_label_uses",
+    "collect_metric_registrations", "collect_trace_emits",
+    "parse_event_doc", "parse_fault_doc", "parse_metric_doc",
+    "sanitize_metric_name",
 ]
 
 _METRIC_TOKEN_RE = re.compile(
     r"^(paddle_tpu_[a-zA-Z0-9_]+)(\{([a-zA-Z0-9_,\s]*)\})?$")
 _FAULT_TOKEN_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+# trace events are namespaced req./step. — disjoint from fault tokens
+# only by convention, so the event catalog lives in OBSERVABILITY.md
+# (TPL010) while fault points live in RESILIENCE.md (TPL004)
+_EVENT_TOKEN_RE = re.compile(r"^(req|step)\.[a-z][a-z0-9_]*$")
+_TRACER_RECEIVER_RE = re.compile(r"^_?tracer?$")
 _BACKTICK_RE = re.compile(r"`([^`]+)`")
 _REGISTRY_RECEIVER_RE = re.compile(r"^_?reg(istry)?$", re.IGNORECASE)
 
@@ -126,6 +140,22 @@ def parse_fault_doc(path: str) -> Dict[str, int]:
     return out
 
 
+def parse_event_doc(path: str) -> Dict[str, int]:
+    """{trace_event_name: lineno} from the first cell of catalog table
+    rows — the docs/OBSERVABILITY.md event-name table (TPL010)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    out: Dict[str, int] = {}
+    for lineno, row in _table_rows(text):
+        cells = [c.strip() for c in row.strip("|").split("|")]
+        if not cells:
+            continue
+        for span in _BACKTICK_RE.findall(cells[0]):
+            if _EVENT_TOKEN_RE.match(span.strip()):
+                out.setdefault(span.strip(), lineno)
+    return out
+
+
 # ----------------------------------------------------------------- code side
 @dataclass(frozen=True)
 class MetricRegistration:
@@ -140,6 +170,13 @@ class MetricRegistration:
 class FaultSite:
     name: str
     kind: str                  # point / declare_point / inject
+    relpath: str
+    line: int
+
+
+@dataclass(frozen=True)
+class TraceEmit:
+    name: str
     relpath: str
     line: int
 
@@ -260,4 +297,35 @@ def collect_fault_sites(tree: ast.Module, relpath: str) -> List[FaultSite]:
         if isinstance(first, ast.Constant) and isinstance(first.value, str):
             out.append(FaultSite(name=first.value, kind=kind,
                                  relpath=relpath, line=node.lineno))
+    return out
+
+
+def _is_tracer_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return bool(_TRACER_RECEIVER_RE.match(node.id))
+    if isinstance(node, ast.Attribute):
+        # self._trace / tracing_module.tracer style — the TAIL decides,
+        # so a bare ``self.emit(...)`` (the ONNX builder) never matches
+        return bool(_TRACER_RECEIVER_RE.match(node.attr))
+    if isinstance(node, ast.Call):
+        tail = dotted_name(node.func)
+        return bool(tail and tail.split(".")[-1] == "get_tracer")
+    return False
+
+
+def collect_trace_emits(tree: ast.Module, relpath: str) -> List[TraceEmit]:
+    """Literal trace-event names at tracer ``.emit(...)`` call sites
+    (see the module docstring's trace-emit grammar)."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args
+                and _is_tracer_receiver(node.func.value)):
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                out.append(TraceEmit(name=first.value, relpath=relpath,
+                                     line=node.lineno))
     return out

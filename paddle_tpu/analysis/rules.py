@@ -11,6 +11,7 @@
 | TPL007 | the lock-acquisition graph is acyclic (no deadlock) | PR 13 |
 | TPL008 | check-then-act stays inside ONE critical section | PR 13 |
 | TPL009 | no blocking/unbounded work while a lock is held | PR 13 |
+| TPL010 | trace-event catalog == docs/OBSERVABILITY.md, both ways | PR 17 |
 
 Every rule is syntactic (per-module AST, no import resolution) and errs
 toward silence: a miss is caught by the runtime drills these rules
@@ -23,9 +24,11 @@ import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from .catalog import (FaultSite, MetricRegistration, collect_fault_sites,
-                      collect_label_uses, collect_metric_registrations,
-                      parse_fault_doc, parse_metric_doc, registration_of)
+from .catalog import (FaultSite, MetricRegistration, TraceEmit,
+                      collect_fault_sites, collect_label_uses,
+                      collect_metric_registrations, collect_trace_emits,
+                      parse_event_doc, parse_fault_doc, parse_metric_doc,
+                      registration_of)
 from .core import Finding, LintConfig, ModuleInfo, Project
 from .locks import LockWorld, module_lock_decls
 from .scopes import CompiledScopes, Taint, dotted_name
@@ -954,13 +957,63 @@ class TPL009BlockingUnderLock:
         return out
 
 
+class TPL010TraceEventParity:
+    """Every literal tracer ``.emit("name", ...)`` site uses an event
+    name cataloged in docs/OBSERVABILITY.md's event table, and every
+    cataloged event has an emit site. The trace is the post-mortem
+    record of the request lifecycle — an undocumented event is a dump
+    nobody can read, a documented ghost is a timeline gap nobody will
+    notice until the 3 a.m. incident (same discipline as TPL003/004)."""
+
+    id = "TPL010"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        config = project.config
+        emits: List[TraceEmit] = []
+        for mod in project.modules:
+            emits.extend(collect_trace_emits(mod.tree, mod.relpath))
+        doc_path = config.observability_doc
+        doc_rel = os.path.relpath(doc_path, config.root).replace(os.sep, "/")
+        if not emits and not project.full_scope:
+            return out     # targeted lint of trace-free modules
+        if not os.path.isfile(doc_path):
+            if emits:
+                out.append(Finding(self.id, doc_rel, 1, 0,
+                                   "observability catalog doc not found"))
+            return out
+        documented = parse_event_doc(doc_path)
+        by_name: Dict[str, List[TraceEmit]] = {}
+        for e in emits:
+            by_name.setdefault(e.name, []).append(e)
+        for name, elist in sorted(by_name.items()):
+            first = min(elist, key=lambda e: (e.relpath, e.line))
+            if not _in_scope(first.relpath, config.metric_doc_scope):
+                continue
+            if name not in documented:
+                out.append(Finding(
+                    self.id, first.relpath, first.line, 0,
+                    f"trace event `{name}` is emitted but not cataloged "
+                    f"in {doc_rel}"))
+        if project.full_scope:
+            # docs→code direction: full-scope runs only (see TPL003)
+            for name, lineno in sorted(documented.items()):
+                if name not in by_name:
+                    out.append(Finding(
+                        self.id, doc_rel, lineno, 0,
+                        f"cataloged trace event `{name}` has no literal "
+                        f"emit site in the linted code"))
+        return out
+
+
 FILE_RULES = [TPL001HostSyncInCompiled(), TPL002RecompileHazard(),
               TPL005UnseededRandomness(), TPL006LockDiscipline(),
               TPL008AtomicityViolation()]
 PROJECT_RULES = [TPL003MetricCatalogParity(), TPL004FaultPointParity(),
-                 TPL007LockOrderCycle(), TPL009BlockingUnderLock()]
+                 TPL007LockOrderCycle(), TPL009BlockingUnderLock(),
+                 TPL010TraceEventParity()]
 RULE_IDS = ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
-            "TPL007", "TPL008", "TPL009")
+            "TPL007", "TPL008", "TPL009", "TPL010")
 
 
 def lock_graph_for(project: Project) -> dict:

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import metrics
+from ..serving import tracing
 from ..serving.router import NoHealthyEngineError
 from ..serving.scheduler import BackpressureError
 from .trace import Trace, VirtualClock
@@ -66,6 +67,10 @@ class TierReport:
     ttft_attainment: Optional[float] = None
     itl_attainment: Optional[float] = None
     ttft_p95_s: Optional[float] = None
+    # mean seconds per attribution bucket (tracing.TTFT_BUCKETS) from
+    # the always-on trace journal; buckets sum to the tier's mean
+    # measured TTFT (None: tracing disabled or no first tokens)
+    ttft_breakdown: Optional[Dict[str, float]] = None
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -116,13 +121,14 @@ class LoadReport:
 class _RequestRecord:
     """One trace request's stream trail, written by its callback."""
 
-    __slots__ = ("trace_req", "rid", "t_submit", "t_prev", "seqs",
-                 "terminals", "attempts")
+    __slots__ = ("trace_req", "rid", "t_submit", "t_first", "t_prev",
+                 "seqs", "terminals", "attempts")
 
     def __init__(self, trace_req):
         self.trace_req = trace_req
         self.rid = None
         self.t_submit: Optional[float] = None
+        self.t_first: Optional[float] = None
         self.t_prev: Optional[float] = None
         self.seqs: List[int] = []
         self.terminals: List[tuple] = []   # (reason, seq)
@@ -191,6 +197,12 @@ class LoadDriver:
             "paddle_tpu_loadgen_submit_retries_total",
             "Submit attempts bounced by backpressure (429) or a fully "
             "gated fleet (503) and retried on a later sweep")
+        self._m_breakdown = reg.histogram(
+            "paddle_tpu_loadgen_ttft_breakdown_seconds",
+            "Per-request TTFT attribution from the trace journal: "
+            "seconds attributed to each named bucket "
+            "(queue/compile/cold_prefill/warm_prefill/decode/migration/"
+            "host_overhead), per SLO tier", labels=("tier", "bucket"))
 
     # ------------------------------------------------------------ callbacks
     def _make_cb(self, rec: _RequestRecord):
@@ -209,6 +221,7 @@ class LoadDriver:
                 rec.terminals.append((finished, seq))
                 return
             if not rec.seqs:
+                rec.t_first = now
                 ttft.observe(now - rec.t_submit)
             elif rec.t_prev is not None:
                 itl.observe(now - rec.t_prev)
@@ -370,6 +383,37 @@ class LoadDriver:
             tr.ttft_attainment = h_ttft.fraction_le(tr.ttft_slo_s)
             tr.itl_attainment = h_itl.fraction_le(tr.itl_slo_s)
             tr.ttft_p95_s = h_ttft.quantile(0.95)
+
+        # TTFT attribution (ISSUE 17): decompose each first-token wait
+        # into named buckets from the always-on trace journal. Per
+        # request the buckets sum to (t_first - t_submit) exactly —
+        # attribute_ttft pins the residual into host_overhead — so the
+        # tier means below sum to the tier's mean measured TTFT.
+        tracer = tracing.get_tracer()
+        by_req: Dict[object, list] = {}
+        for ev in tracer.events():
+            by_req.setdefault(ev["req_id"], []).append(ev)
+        bd_sums: Dict[str, Dict[str, float]] = {}
+        bd_counts: Dict[str, int] = {}
+        for rec in recs:
+            if rec.rid is None or rec.t_first is None:
+                continue
+            evs = by_req.get(rec.rid)
+            if not evs:
+                continue
+            bd = tracing.attribute_ttft(evs, rec.t_submit, rec.t_first)
+            tier = rec.trace_req.tier
+            sums = bd_sums.setdefault(
+                tier, {b: 0.0 for b in tracing.TTFT_BUCKETS})
+            for b, v in bd.items():
+                sums[b] += v
+                self._m_breakdown.labels(tier=tier, bucket=b).observe(v)
+            bd_counts[tier] = bd_counts.get(tier, 0) + 1
+        for name, n_tier in bd_counts.items():
+            rep.tiers[name].ttft_breakdown = {
+                b: bd_sums[name][b] / n_tier
+                for b in tracing.TTFT_BUCKETS}
+        tracer.flush_metrics()
         rep.prefix_hit_ratio = deltas.ratio(
             "paddle_tpu_serving_prefix_hits_total",
             "paddle_tpu_serving_prefix_misses_total")

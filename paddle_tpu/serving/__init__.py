@@ -46,6 +46,8 @@ from .router import EngineHandle, NoHealthyEngineError, Router
 from .scheduler import (BackpressureError, FCFSScheduler, Request,
                         RequestOutput)
 from .spec import NGramDrafter
+from .tracing import (TTFT_BUCKETS, RequestTracer, attribute_ttft,
+                      get_tracer, set_tracer, validate_events)
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "PrefixCache", "FCFSScheduler",
@@ -54,4 +56,6 @@ __all__ = [
     "NGramDrafter", "page_bytes", "pages_for_hbm_budget",
     "AdapterStore", "random_adapter", "GrammarFSM", "ToyTokenizer",
     "toy_tokenizer", "schema_to_regex",
+    "RequestTracer", "TTFT_BUCKETS", "attribute_ttft", "get_tracer",
+    "set_tracer", "validate_events",
 ]

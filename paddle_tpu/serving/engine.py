@@ -77,6 +77,7 @@ from .. import faults, jit, metrics
 from ..autograd.engine import no_grad
 from ..ops._apply import apply_op, ensure_tensor
 from ..tensor import Tensor
+from . import tracing
 from .adapters import AdapterStore
 from .kv_cache import PagedKVCachePool, PrefixCache
 from .scheduler import FCFSScheduler, Request, RequestOutput
@@ -243,6 +244,10 @@ class ServingEngine:
                           else str(next(_engine_counter)))
         self.model_id = str(model_id)
         self._lbl = {"engine_id": self.engine_id, "model_id": self.model_id}
+        # the fleet-global request tracer (tracing.py): per-request event
+        # seqs live THERE, so a migrated request's timeline stays one
+        # contiguous stream across engines
+        self._trace = tracing.get_tracer()
         self.trunk = model._decode_trunk()
         n_layers, n_kv, head_dim = model._cache_spec()
         self.n_layers = n_layers
@@ -625,6 +630,8 @@ class ServingEngine:
         except Exception:
             self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise
+        self._trace.emit("req.enqueue", req.req_id,
+                         arg=float(req.prompt.size), label=self.engine_id)
         return req.req_id
 
     def cancel(self, req_id) -> bool:
@@ -741,6 +748,9 @@ class ServingEngine:
             if st.fsm is not None:
                 st.req.resume_fsm_state = st.fsm_state
             self._grammar_release(st)
+            self._trace.emit("req.export", st.req.req_id,
+                             arg=float(len(st.req.resume_tokens)),
+                             label=self.engine_id)
             out.append(st.req)
         return out
 
@@ -763,6 +773,9 @@ class ServingEngine:
         except Exception:
             self._m_requests.labels(event="rejected", **self._lbl).inc()
             raise
+        self._trace.emit("req.adopt", req.req_id,
+                         arg=float(len(req.resume_tokens or ())),
+                         label=self.engine_id)
 
     def retire_queued(self, req: Request,
                       reason: str = "unavailable") -> RequestOutput:
@@ -955,6 +968,11 @@ class ServingEngine:
                        self.stats["tokens_per_sec"])
         record_counter("serving.page_utilization",
                        self.stats["page_utilization"])
+        # engine-scoped trace event: step.tokens keys on the engine_id,
+        # so trace_dump renders engine throughput as a counter track
+        # next to the per-request tracks
+        self._trace.emit("step.tokens", self.engine_id,
+                         arg=float(tokens_this_step))
         # outputs were registered in self._outputs eagerly at retirement
         return finished
 
@@ -1009,6 +1027,7 @@ class ServingEngine:
         (exactly once per event), lifecycle counter, terminal stream
         callback (isolated), RequestOutput."""
         self._reason_counters[reason].inc()
+        self._trace.emit("req.retire", req.req_id, label=reason)
         self._m_requests.labels(event="retired", **self._lbl).inc()
         self.stats["finished_requests"] += 1
         out = RequestOutput(req_id=req.req_id, prompt_token_ids=req.prompt,
@@ -1133,6 +1152,11 @@ class ServingEngine:
                 st.fsm_state = st.fsm.advance(0, req.resume_tokens or ())
             self._m_grammar_req.inc()
         self.slots[self.slots.index(None)] = st
+        self._trace.emit("req.admit", req.req_id, arg=float(matched),
+                         label=self.engine_id)
+        if matched:
+            self._trace.emit("req.prefix_hit", req.req_id,
+                             arg=float(matched))
 
     # --------------------------------------------------- unified step
     def _grid_tokens(self, total: int) -> int:
@@ -1333,6 +1357,9 @@ class ServingEngine:
             else:
                 decode_idx.append(i)
         chunks = self.scheduler.plan_chunks(len(decode_idx), prefill_info)
+        for i, c in chunks:
+            self._trace.emit("req.chunk_planned",
+                             self.slots[i].req.req_id, arg=float(c))
 
         # speculative drafts ride the budget's LEFTOVER only — charged
         # strictly after decode tokens and prompt chunks, so speculation
@@ -1387,6 +1414,8 @@ class ServingEngine:
                             prop = prop[:keep]
                     if prop.size:
                         drafts[i] = prop
+                        self._trace.emit("req.drafts", st.req.req_id,
+                                         arg=float(prop.size))
 
         # KV room per slot BEFORE the compiled step: decode rows reserve
         # this step's writes via extend()/extend_write() (not
@@ -1441,6 +1470,10 @@ class ServingEngine:
             return finished
         total = sum(r[1].size for r in rows)
         T = self._grid_tokens(total)
+        # a bucket this engine never ran compiles (or deserializes from
+        # the disk cache) inside the coming program call — remember it
+        # now so the wall time lands in the trace's compile bucket
+        fresh_bucket = T not in self._grid_buckets_seen
         self._grid_buckets_seen.add(T)
         S = self._spec_rows
         tok = np.zeros((T, 1), np.int32)
@@ -1494,8 +1527,10 @@ class ServingEngine:
             seeds[i] = st.req.seed
             cur += c
         if self._step_prog is None:
+            fresh_bucket = True
             self._step_prog = self._compile_with_retry(
                 "serving.compile_step", self._make_step)
+        t_prog = time.perf_counter()
         res = self._step_prog(
             Tensor(jnp.asarray(tok)), Tensor(jnp.asarray(tok_pos)),
             Tensor(jnp.asarray(tok_bt)), Tensor(jnp.asarray(tok_adp)),
@@ -1517,6 +1552,16 @@ class ServingEngine:
         self._m_mix_draft.observe(n_draft_tokens)
         self._m_mix_prefill.observe(total - n_decode_tokens
                                     - n_draft_tokens)
+        if fresh_bucket:
+            # a fresh token-grid bucket compiled inside this program
+            # call: charge its wall time to every rider, so the
+            # attribution pass can name compile (not prefill) as where
+            # a cold request's TTFT went
+            for _ci, _ct, _cp, _cic, _cd in rows:
+                _cst = self.slots[_ci]
+                if _cst is not None:
+                    self._trace.emit("req.compile", _cst.req.req_id,
+                                     arg=now - t_prog, t=now)
 
         for i, toks, poss, is_chunk, d in rows:
             st = self.slots[i]
@@ -1546,6 +1591,8 @@ class ServingEngine:
                 c = toks.size
                 st.pos += c
                 self._m_chunk.observe(c)
+                self._trace.emit("req.chunk", st.req.req_id,
+                                 arg=float(c), t=now)
                 if st.prefilling:
                     continue  # mid-prompt: more chunks to go, no token
                 # FINAL chunk: the sample at position len(ids)-1 IS the
@@ -1589,7 +1636,11 @@ class ServingEngine:
                 self._m_spec_drafted.inc(d)
                 self._m_spec_accepted.inc(a)
                 self._m_spec_accept.observe(a / d)
+                self._trace.emit("req.spec_accept", st.req.req_id,
+                                 arg=float(a), t=now)
                 if a < d:
+                    self._trace.emit("req.spec_reject", st.req.req_id,
+                                     arg=float(d - a), t=now)
                     self.pool.truncate(st.req.req_id, st.pos + a + 1)
             for t in targets[:a + 1]:
                 st.pos += 1
@@ -1618,6 +1669,8 @@ class ServingEngine:
         st.t_last = now
         self._m_tokens.inc()
         self.stats["generated_tokens"] += 1
+        self._trace.emit("req.token", st.req.req_id,
+                         arg=float(len(st.gen) - 1), t=now)
         if st.fsm is not None and (st.req.eos_token_id is None
                                    or token != st.req.eos_token_id):
             # host mirror of the device mask: the DFA walks every landed
@@ -1627,6 +1680,8 @@ class ServingEngine:
             if nxt >= 0:
                 st.fsm_state = nxt
             self._m_grammar_tokens.inc()
+            self._trace.emit("req.grammar_mask", st.req.req_id,
+                             arg=float(st.fsm_state), t=now)
         if st.req.stream_cb is not None:
             cb_err = self._safe_cb(st.req, token, False, len(st.gen) - 1)
             if self.slots[slot] is not st:
@@ -1681,6 +1736,8 @@ class ServingEngine:
                             token_ids=list(st.gen),
                             finish_reason=("stop" if hit_eos or done_fsm
                                            else "length"))
+        self._trace.emit("req.retire", req.req_id,
+                         label=out.finish_reason)
         self._outputs[out.req_id] = out  # eager: survives a later raise
         if req.stream_cb is not None:
             # terminal call: `finished` is the reason string (truthy, so

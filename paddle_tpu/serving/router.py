@@ -87,6 +87,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import faults, metrics
+from . import tracing
 from .engine import ServingEngine
 from .scheduler import Request, RequestOutput
 
@@ -164,6 +165,12 @@ class Router:
         self._lock = threading.Lock()  # tpulint: lock=router (rr cursors + state flips)
         self._requeued: set = set()            # req_ids moved once already
         self._stash: Dict[object, RequestOutput] = {}
+        # fleet tracer + flight recorder (tracing.py): dispatch/requeue/
+        # migrate land in the same journal the engines write, and the
+        # recorder auto-dumps on crash containment and on the aggregate
+        # /healthz ok→degraded transition
+        self._trace = tracing.get_tracer()
+        self._last_health_ok = True
         reg = metrics.get_registry()
         self._m_dispatch = reg.counter(
             "paddle_tpu_router_dispatch_total",
@@ -428,6 +435,7 @@ class Router:
         rid = h.engine.add_request(prompt, **request_kwargs)
         self._m_dispatch.labels(engine_id=h.engine_id,
                                 model_id=h.model_id).inc()
+        self._trace.emit("req.dispatch", rid, label=h.engine_id)
         return rid
 
     def _count_dispatch(self, h: EngineHandle) -> None:
@@ -546,6 +554,14 @@ class Router:
                 self._retire_unavailable(h, req)
                 continue
             moved_counter.inc()
+            # literal event names at BOTH sites (not one parameterized
+            # emit): the TPL010 docs-parity collector only sees literals
+            if moved_counter is self._m_migrated:
+                self._trace.emit("req.migrate", req.req_id,
+                                 label=target.engine_id)
+            else:
+                self._trace.emit("req.requeue", req.req_id,
+                                 label=target.engine_id)
 
     def _retire_unavailable(self, h: EngineHandle, req: Request) -> None:
         """Deterministic dead end: retire ``req`` with
@@ -664,6 +680,15 @@ class Router:
             h.state = DOWN
         self._set_state_gauge(h)
         self._evacuate(h)
+        try:
+            # post-mortem first responder: the last window_s seconds of
+            # fleet timeline — the victim's per-request histories with
+            # the export/adopt hop just taken — land on disk before
+            # anyone asks. A failed dump (armed tracing.dump fault,
+            # full disk) loses diagnostics, never containment.
+            self._trace.dump_flight(reason="crash")
+        except Exception:
+            pass
 
     def _evacuate(self, h: EngineHandle) -> None:
         """Empty a just-downed engine: in-flight requests migrate FIRST
@@ -1013,6 +1038,16 @@ class Router:
             models[mid] = {"healthy": healthy, "total": len(hs)}
             if healthy == 0:
                 all_ok = False
+        if self._last_health_ok and not all_ok:
+            # the /healthz 200→503 transition (some model just went
+            # fully dark): auto-dump the recorder exactly once per
+            # transition, from whichever thread (driver or scrape)
+            # observed it first
+            try:
+                self._trace.dump_flight(reason="healthz")
+            except Exception:
+                pass
+        self._last_health_ok = all_ok
         return {"status": "ok" if all_ok else "degraded",
                 "models": models,
                 "engines": {h.engine_id: h.state for h in handles}}

@@ -877,6 +877,65 @@ class TestTPL009BlockingUnderLock:
         assert "TPL009" not in rules_fired(res), res.findings
 
 
+# ------------------------------------------- TPL010 trace-event parity
+_OBS_WITH_EVENT = ("# O\n\n| event | when |\n|---|---|\n"
+                   "| `req.fixture` | on fixture |\n")
+
+
+class TestTPL010TraceEventParity:
+    EMIT = """
+        from paddle_tpu.serving import tracing
+
+        tracer = tracing.get_tracer()
+        tracer.emit("req.fixture", "r1", arg=1.0)
+    """
+
+    def test_uncataloged_event_fails(self, tmp_path):
+        res = run_lint(tmp_path, {"mod.py": self.EMIT},
+                       metric_doc_scope="")
+        msgs = [f.message for f in res.findings if f.rule == "TPL010"]
+        assert any("req.fixture" in m and "not cataloged" in m
+                   for m in msgs), res.findings
+
+    def test_cataloging_it_passes(self, tmp_path):
+        res = run_lint(tmp_path, {"mod.py": self.EMIT},
+                       obs_doc=_OBS_WITH_EVENT, metric_doc_scope="")
+        assert "TPL010" not in rules_fired(res), res.findings
+
+    def test_cataloged_but_absent_event_fails(self, tmp_path):
+        res = run_lint(tmp_path, {"mod.py": "x = 1\n"},
+                       obs_doc=_OBS_WITH_EVENT)
+        msgs = [f.message for f in res.findings if f.rule == "TPL010"]
+        assert any("req.fixture" in m and "no literal emit site" in m
+                   for m in msgs), res.findings
+
+    def test_self_trace_attribute_counts(self, tmp_path):
+        # the production shape: an engine emitting via self._trace
+        res = run_lint(tmp_path, {"mod.py": """
+            class Engine:
+                def __init__(self, trace):
+                    self._trace = trace
+
+                def step(self):
+                    self._trace.emit("req.fixture", "r1")
+        """}, obs_doc=_OBS_WITH_EVENT, metric_doc_scope="")
+        assert "TPL010" not in rules_fired(res), res.findings
+
+    def test_unrelated_emit_api_is_ignored(self, tmp_path):
+        # the ONNX node builder's self.emit("Sqrt", ...) must not be
+        # mistaken for a trace site: the receiver is not tracer-shaped
+        res = run_lint(tmp_path, {"mod.py": """
+            class Converter:
+                def emit(self, op, *a):
+                    pass
+
+                def convert(self):
+                    self.emit("Sqrt", "x")
+                    self.emit("req.looking_name", "y")
+        """}, metric_doc_scope="")
+        assert "TPL010" not in rules_fired(res), res.findings
+
+
 # ------------------------------------------------- suppressions + baseline
 class TestSuppressionAndBaseline:
     SNIPPET = """

@@ -127,6 +127,18 @@ def test_bench_load_row_schema_is_stable():
         assert set(tier) == set(bl.TIER_KEYS)
         for k in ("ttft_attainment", "itl_attainment"):
             assert tier[k] is None or 0.0 <= tier[k] <= 1.0
+        # ISSUE 17: the per-tier TTFT attribution rides along — exactly
+        # the named buckets, every share a finite non-negative seconds
+        bd = tier["ttft_breakdown"]
+        assert bd is None or set(bd) == set(bl.BREAKDOWN_KEYS)
+        if bd is not None:
+            # host_overhead is an exact residual; ±1 ms is the same
+            # slack the ISSUE 17 sum-acceptance bound grants
+            assert all(isinstance(v, float) and v >= -1e-3
+                       for v in bd.values())
+    assert any(t["ttft_breakdown"] is not None
+               for t in rep["tiers"].values()), \
+        "committed artifact carries no TTFT attribution at all"
 
 
 def test_bench_load_build_row_trims_to_schema():

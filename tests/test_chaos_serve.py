@@ -14,7 +14,11 @@ only committed tokens — never unaccepted drafts — with streams
 bit-identical to a spec-off run, and the autoscale-under-burst drill
 that replays a seeded loadgen Poisson burst against a 1-engine fleet
 and asserts the queue-depth autoscaler scales 1->N->1 with exactly-once
-completion and zero fresh compiles on scale-up) runs as slow-marked
+completion and zero fresh compiles on scale-up, and the
+flight-recorder-on-crash drill that kills the busiest engine with the
+always-armed trace ring installed and asserts crash containment
+auto-dumps every victim request's timeline with the migration hop
+visible and seqs exactly-once across the hop) runs as slow-marked
 tests instead of
 only by hand, one test per scenario so a regression names its drill.
 

@@ -43,7 +43,12 @@ REPORT_KEYS = ("seed", "num_requests", "goodput_tok_s", "outcomes",
                "scale_ups", "scale_downs", "adapter_goodput",
                "constrained_validity", "exactly_once", "violations")
 TIER_KEYS = ("requests", "ttft_slo_s", "itl_slo_s", "ttft_attainment",
-             "itl_attainment")
+             "itl_attainment", "ttft_breakdown")
+# the attribution buckets a tier's ttft_breakdown carries (ISSUE 17) —
+# mirrors serving.tracing.TTFT_BUCKETS, literal here so the schema is
+# readable without importing the stack
+BREAKDOWN_KEYS = ("queue", "compile", "cold_prefill", "warm_prefill",
+                  "decode", "migration", "host_overhead")
 
 
 def build_row(report_dict: dict, config_label: str, device: str) -> dict:

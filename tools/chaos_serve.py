@@ -51,6 +51,12 @@ carries the per-request FSM state, and the adoptive sibling must resume
 the grammar walk mid-structure — final streams bit-identical to an
 uninterrupted lone-engine run, every output grammar-valid, chunks
 exactly-once, grammar mask segments fully released afterward.
+Scenario 17 re-runs the kill drill with the FLIGHT RECORDER under test
+(ISSUE 17): the always-armed trace ring must auto-dump the last window
+of fleet timeline from crash containment — the dumped file carries the
+victim requests' full per-request timelines with the export → adopt
+migration hop visible and every ``(req_id, seq)`` exactly-once across
+the hop — while the streams stay bit-identical to an uninterrupted run.
 Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
@@ -1064,6 +1070,105 @@ def scenario_kill_engine_mid_constrained_adapter_stream(model):
             "grammar-valid, chunks exactly-once, mask segments released")
 
 
+def scenario_flight_recorder_on_crash(model):
+    """Scenario 17 (ISSUE 17): the kill drill with the FLIGHT RECORDER
+    under test. A fresh tracer (tiny window, scenario-owned flight dir)
+    is installed BEFORE the fleet is built, all sampled streaming
+    traffic lands on m/0, and the busiest engine dies mid-decode. Crash
+    containment must auto-dump the last window of fleet timeline: the
+    dumped JSON carries each victim request's timeline with the
+    export -> adopt migration hop visible and every ``(req_id, seq)``
+    exactly-once ACROSS the hop (one fleet-global seq stream per
+    request), the dumps counter moves with reason="crash", and the
+    streams still end bit-identical to an uninterrupted run — the
+    recorder observes the crash, never perturbs it."""
+    from paddle_tpu.serving import tracing
+
+    specs = [(P5, 10, 0.9, 21), (P9, 9, 0.7, 22), (P3, 8, 1.1, 23)]
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2)
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s) for p, n, t, s in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+
+    flight_dir = tempfile.mkdtemp(prefix="chaos17_flight_")
+    old = None
+    try:
+        # install BEFORE building the fleet: engines and router capture
+        # the process tracer at construction
+        old = tracing.set_tracer(tracing.RequestTracer(
+            capacity=8192, flight_dir=flight_dir, window_s=120.0))
+        tracer = tracing.get_tracer()
+        dumps0 = _counter("paddle_tpu_trace_recorder_dumps_total",
+                          reason="crash")
+        r = Router()
+        r.add_model("m", model, replicas=2, page_size=4,
+                    max_batch_slots=2)
+        e0 = r.engine("m/0")  # the busiest engine: ALL traffic here
+        rids = [e0.add_request(p, max_new_tokens=n, temperature=t,
+                               seed=s) for p, n, t, s in specs]
+        for _ in range(3):
+            r.step()  # 2 in-flight mid-decode, 1 waiting behind them
+        with faults.inject("router.engine_step",
+                           raise_=RuntimeError("engine killed"),
+                           times=1, seed=SEED):
+            r.step()  # the kill — containment must dump the recorder
+        _check(r.states()["m/0"] == "down", "crashed engine not gated")
+        files = sorted(os.listdir(flight_dir))
+        _check(len(files) == 1,
+               f"expected exactly 1 auto-dump, found {files}")
+        _check("crash" in files[0], f"dump not tagged crash: {files[0]}")
+        with open(os.path.join(flight_dir, files[0])) as f:
+            dump = json.load(f)
+        _check(dump["reason"] == "crash", "dump reason")
+        _check(_counter("paddle_tpu_trace_recorder_dumps_total",
+                        reason="crash") == dumps0 + 1,
+               "dumps counter != exactly 1 crash dump")
+        # every victim request's timeline is in the dump, with the
+        # migration hop visible: exported off m/0, adopted (or
+        # requeued) onto m/1, seqs contiguous ACROSS the hop
+        for i, rid in enumerate(rids):
+            tl = dump["requests"].get(str(rid))
+            _check(tl, f"request {i} missing from the dump")
+            names = [e["name"] for e in tl]
+            _check("req.enqueue" in names,
+                   f"request {i} dump lost its admission history")
+            hop = {"req.adopt", "req.requeue"} & set(names)
+            _check(hop, f"request {i} dump shows no migration hop "
+                   f"({names})")
+            _check(tracing.validate_events(tl) == [],
+                   f"request {i} seqs not exactly-once across the hop: "
+                   f"{tracing.validate_events(tl)}")
+            hopper = next(e for e in tl if e["name"] in hop)
+            _check(hopper["label"] == "m/1",
+                   f"request {i} hop landed on {hopper['label']!r}")
+        outs = r.run()
+        for i, (rid, ref) in enumerate(zip(rids, refs)):
+            _check(list(outs[rid].token_ids) == ref,
+                   f"request {i} diverged from the uninterrupted run")
+        # the full live journal (not just the dump window) stays
+        # exactly-once after the drill drains
+        _check(tracing.validate_events(tracer.events()) == [],
+               "live journal lost exactly-once after the drill")
+        _check(tracer.dropped == 0, "ring wrapped mid-drill (sizing)")
+        retired = [e for e in tracer.events()
+                   if e["name"] == "req.retire"
+                   and e["req_id"] in set(rids)]
+        _check(len(retired) == len(rids),
+               f"{len(retired)} retire events for {len(rids)} requests")
+        _check(r._requeued == set(), "move-once marks leaked")
+        _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+               "pages leaked")
+        n_ev = len(dump["events"])
+        return (f"m/0 killed at step 4: containment auto-dumped "
+                f"{n_ev} events; all {len(rids)} victim timelines in "
+                f"the file with the m/0->m/1 hop visible, seqs "
+                f"exactly-once across the hop, streams bit-identical")
+    finally:
+        tracing.set_tracer(old)  # old None = back to lazy env default
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -1083,6 +1188,7 @@ SCENARIOS = [
     ("autoscale-under-burst", scenario_autoscale_under_burst),
     ("kill-engine-mid-constrained-adapter-stream",
      scenario_kill_engine_mid_constrained_adapter_stream),
+    ("flight-recorder-on-crash", scenario_flight_recorder_on_crash),
 ]
 
 

@@ -75,6 +75,7 @@ def _demo_registry():
     _demo_train_sentinel()
     _demo_loadgen()
     _demo_adapters_grammar()
+    _demo_tracing()
     return metrics.get_registry()
 
 
@@ -116,6 +117,30 @@ def _demo_adapters_grammar():
     router.submit(rng.integers(1, 64, (4,)), model="tenancy-demo",
                   max_new_tokens=4, grammar=fsm)
     router.run()
+
+
+def _demo_tracing():
+    """Trace-journal drill (ISSUE 17): overflow a deliberately tiny
+    private ring and dump one flight record into a scratch dir, so the
+    tracing series (paddle_tpu_trace_dropped_events_total,
+    paddle_tpu_trace_recorder_dumps_total{reason}) are live in the
+    snapshot — the loadgen drill above already lights the attribution
+    histogram paddle_tpu_loadgen_ttft_breakdown_seconds{tier,bucket}
+    through the driver's scoring pass."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import tracing
+
+    tmp = tempfile.mkdtemp(prefix="metrics_demo_flight_")
+    try:
+        tracer = tracing.RequestTracer(capacity=16, flight_dir=tmp)
+        for i in range(24):             # 8 past capacity → drops count
+            tracer.emit("req.token", "r%d" % (i % 4), arg=float(i))
+        tracer.flush_metrics()
+        tracer.dump_flight(reason="demo")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _demo_loadgen():
