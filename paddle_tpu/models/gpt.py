@@ -212,7 +212,8 @@ class GPTAttention(nn.Layer):
         return self.out_proj(merged)
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
-                      adapters=None, layer_idx=0):
+                      adapters=None, layer_idx=0, k_scale=None,
+                      v_scale=None):
         """Paged-KV ragged step (serving engine): one QUERY TOKEN per
         row — decode tokens and prompt-chunk tokens alike (the unified
         step's flattened grid; ops/pallas/paged_attention.py "Ragged
@@ -224,19 +225,27 @@ class GPTAttention(nn.Layer):
         ``adapters`` (docs/SERVING.md "Multi-LoRA adapters"): per-row
         gathered LoRA stacks ``{site: (A, B)}``; GPT's fused QKV takes
         ONE delta on the concatenated [B, 1, 3H] output (the delta
-        splits with it), out_proj one on the merged context."""
+        splits with it), out_proj one on the merged context.
+
+        ``k_scale``/``v_scale`` arm int8 KV pages exactly as in
+        LlamaAttention.forward_paged: quantize-on-write in the scatter,
+        in-kernel dequant in attention, cache tuple grows to
+        ``(k, v, k_scale, v_scale)`` — a static Python branch, not a new
+        program."""
         from ..ops.pallas.paged_attention import ragged_paged_attention
+        from ..quantization.observers import quantize_kv
 
         B = x.shape[0]
         nh, hd = self.cfg.num_heads, self.head_dim
         scale = 1.0 / math.sqrt(hd)
+        quantized = k_scale is not None
         qkv = self.qkv_proj(x)  # [B, 1, 3H]
         if adapters is not None:
             from ..serving.adapters import lora_delta
 
             qkv = qkv + lora_delta(x, *adapters["qkv_proj"], layer_idx)
 
-        def paged_step(qkv_v, kp, vp, bt, pos):
+        def paged_step(qkv_v, kp, vp, bt, pos, *scales):
             pos = pos.astype(jnp.int32).reshape(B)
             bt = bt.astype(jnp.int32)
             page_size = kp.shape[1]
@@ -247,25 +256,38 @@ class GPTAttention(nn.Layer):
             vh = vv.reshape(B, nh_l, hd)
             page_ids = bt[jnp.arange(B), pos // page_size]
             offs = pos % page_size
+            if scales:
+                ks, vs = scales
+                kq, ksc = quantize_kv(kh)
+                vq, vsc = quantize_kv(vh)
+                kp = kp.at[page_ids, offs].set(kq)
+                vp = vp.at[page_ids, offs].set(vq)
+                ks = ks.at[page_ids, offs].set(ksc)
+                vs = vs.at[page_ids, offs].set(vsc)
+                ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
+                                             scale=scale, k_scale=ks,
+                                             v_scale=vs)
+                return ctx.reshape(B, 1, nh_l * hd), kp, vp, ks, vs
             kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
             vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
             ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
                                          scale=scale)
             return ctx.reshape(B, 1, nh_l * hd), kp, vp
 
-        merged, new_k, new_v = apply_op(
-            paged_step,
-            [ensure_tensor(qkv), ensure_tensor(k_pool),
-             ensure_tensor(v_pool), ensure_tensor(block_tables),
-             ensure_tensor(positions)],
-            name="gpt_paged_attention")
+        operands = [ensure_tensor(qkv), ensure_tensor(k_pool),
+                    ensure_tensor(v_pool), ensure_tensor(block_tables),
+                    ensure_tensor(positions)]
+        if quantized:
+            operands += [ensure_tensor(k_scale), ensure_tensor(v_scale)]
+        merged, *new_cache = apply_op(
+            paged_step, operands, name="gpt_paged_attention")
         out = self.out_proj(merged)
         if adapters is not None:
             from ..serving.adapters import lora_delta
 
             out = out + lora_delta(merged, *adapters["out_proj"],
                                    layer_idx)
-        return out, (new_k, new_v)
+        return out, tuple(new_cache)
 
 
 class GPTMLP(nn.Layer):
@@ -331,11 +353,13 @@ class GPTDecoderLayer(nn.Layer):
         return x + h
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
-                      adapters=None, layer_idx=0):
+                      adapters=None, layer_idx=0, k_scale=None,
+                      v_scale=None):
         h, nc = self.attn.forward_paged(self.ln1(x), positions,
                                         block_tables, k_pool, v_pool,
                                         adapters=adapters,
-                                        layer_idx=layer_idx)
+                                        layer_idx=layer_idx,
+                                        k_scale=k_scale, v_scale=v_scale)
         x = x + h
         return x + self.mlp(self.ln2(x), adapters=adapters,
                             layer_idx=layer_idx), nc
@@ -451,7 +475,8 @@ class GPTModel(nn.Layer):
         ``positions`` [B] per-row absolute positions (the learned position
         embedding is gathered per row — the paged counterpart of the
         cur_len-offset decode_positions), ``caches`` a per-layer list of
-        (k_pool, v_pool) page pools. ``adapters``: per-row gathered LoRA
+        (k_pool, v_pool) page pools — or (k_pool, v_pool, k_scales,
+        v_scales) for int8 pages. ``adapters``: per-row gathered LoRA
         stacks ``{site: (A, B)}`` applied at every projection per layer
         (zero for slot-0 rows). Returns (hidden, new_caches)."""
         if self._pp > 1:
@@ -464,9 +489,13 @@ class GPTModel(nn.Layer):
             [ensure_tensor(positions)], name="paged_positions")
         x = self.embeddings(ids) + self.position_embeddings(pos_ids)
         new_caches = []
-        for li, (layer, (kp, vp)) in enumerate(zip(self.layers, caches)):
+        for li, (layer, cache) in enumerate(zip(self.layers, caches)):
+            kp, vp = cache[0], cache[1]
+            ks = cache[2] if len(cache) > 2 else None
+            vs = cache[3] if len(cache) > 2 else None
             x, nc = layer.forward_paged(x, positions, block_tables, kp, vp,
-                                        adapters=adapters, layer_idx=li)
+                                        adapters=adapters, layer_idx=li,
+                                        k_scale=ks, v_scale=vs)
             new_caches.append(nc)
         return self.ln_f(x), new_caches
 

@@ -162,7 +162,8 @@ class LlamaAttention(nn.Layer):
                                         initializer=_normal_init(proj_std)))
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
-                      adapters=None, layer_idx=0):
+                      adapters=None, layer_idx=0, k_scale=None,
+                      v_scale=None):
         """Paged-KV ragged step (serving engine): one QUERY TOKEN per
         row — a decode slot's next token, or one token of a prompt
         chunk (the unified step flattens mixed per-slot query lengths
@@ -182,14 +183,25 @@ class LlamaAttention(nn.Layer):
         gathered LoRA stacks ``{site: (A, B)}`` — each projection adds
         its ``lora_delta`` at ``layer_idx``; rows on adapter slot 0 add
         an exact zero, keeping non-adapter tenants bit-identical.
+
+        ``k_scale``/``v_scale`` (both or neither — int8 pages,
+        docs/SERVING.md "KV page tiers & quantization"): the write hook
+        quantizes each row's k/v per-slot (quantization/observers.py
+        absmax rule) and scatters codes + scales; attention dequantizes
+        in-kernel. The cache tuple returned grows to
+        ``(k, v, k_scale, v_scale)`` — a static Python branch, so the
+        unquantized trace is unchanged and quantization rides as dtype +
+        extra operands, never a new program.
         """
         from ..ops.pallas.paged_attention import ragged_paged_attention
+        from ..quantization.observers import quantize_kv
 
         B = x.shape[0]
         cfg = self.cfg
         hd = self.head_dim
         scale = 1.0 / math.sqrt(hd)
         max_pos = cfg.max_position_embeddings
+        quantized = k_scale is not None
 
         q = self.q_proj(x)
         k = self.k_proj(x)
@@ -201,7 +213,7 @@ class LlamaAttention(nn.Layer):
             k = k + lora_delta(x, *adapters["k_proj"], layer_idx)
             v = v + lora_delta(x, *adapters["v_proj"], layer_idx)
 
-        def paged_step(qv, kv, vv, kp, vp, bt, pos):
+        def paged_step(qv, kv, vv, kp, vp, bt, pos, *scales):
             pos = pos.astype(jnp.int32).reshape(B)
             bt = bt.astype(jnp.int32)
             page_size = kp.shape[1]
@@ -227,24 +239,37 @@ class LlamaAttention(nn.Layer):
             # landing their writes on the pool's reserved null page 0.
             page_ids = bt[jnp.arange(B), pos // page_size]
             offs = pos % page_size
+            if scales:
+                ks, vs = scales
+                kq, ksc = quantize_kv(kh)
+                vq, vsc = quantize_kv(vh)
+                kp = kp.at[page_ids, offs].set(kq)
+                vp = vp.at[page_ids, offs].set(vq)
+                ks = ks.at[page_ids, offs].set(ksc)
+                vs = vs.at[page_ids, offs].set(vsc)
+                ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
+                                             scale=scale, k_scale=ks,
+                                             v_scale=vs)
+                return ctx.reshape(B, 1, nh_l * hd), kp, vp, ks, vs
             kp = kp.at[page_ids, offs].set(kh.astype(kp.dtype))
             vp = vp.at[page_ids, offs].set(vh.astype(vp.dtype))
             ctx = ragged_paged_attention(qh, kp, vp, bt, pos + 1,
                                          scale=scale)
             return ctx.reshape(B, 1, nh_l * hd), kp, vp
 
-        merged, new_k, new_v = apply_op(
-            paged_step,
-            [ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
-             ensure_tensor(k_pool), ensure_tensor(v_pool),
-             ensure_tensor(block_tables), ensure_tensor(positions)],
-            name="llama_paged_attention")
+        operands = [ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+                    ensure_tensor(k_pool), ensure_tensor(v_pool),
+                    ensure_tensor(block_tables), ensure_tensor(positions)]
+        if quantized:
+            operands += [ensure_tensor(k_scale), ensure_tensor(v_scale)]
+        merged, *new_cache = apply_op(
+            paged_step, operands, name="llama_paged_attention")
         out = self.o_proj(merged)
         if adapters is not None:
             from ..serving.adapters import lora_delta
 
             out = out + lora_delta(merged, *adapters["o_proj"], layer_idx)
-        return out, (new_k, new_v)
+        return out, tuple(new_cache)
 
     def forward(self, x, cache=None, cur_len=None):
         B, S, _ = x.shape
@@ -417,10 +442,12 @@ class LlamaDecoderLayer(nn.Layer):
         return x + self.mlp(self.post_attention_layernorm(x))
 
     def forward_paged(self, x, positions, block_tables, k_pool, v_pool,
-                      adapters=None, layer_idx=0):
+                      adapters=None, layer_idx=0, k_scale=None,
+                      v_scale=None):
         h, nc = self.self_attn.forward_paged(
             self.input_layernorm(x), positions, block_tables, k_pool,
-            v_pool, adapters=adapters, layer_idx=layer_idx)
+            v_pool, adapters=adapters, layer_idx=layer_idx,
+            k_scale=k_scale, v_scale=v_scale)
         x = x + h
         return x + self.mlp(self.post_attention_layernorm(x),
                             adapters=adapters, layer_idx=layer_idx), nc
@@ -490,15 +517,21 @@ class LlamaModel(nn.Layer):
                       adapters=None):
         """Paged decode trunk (serving engine): ``input_ids`` [B, 1],
         ``positions`` [B], ``caches`` a per-layer list of (k_pool, v_pool)
-        page pools. ``adapters``: per-row gathered LoRA stacks
-        ``{site: (A [T, L, r, in], B [T, L, out, r])}`` applied at every
-        projection site per layer (zero for slot-0 rows). Returns
-        (hidden [B, 1, H], new_caches)."""
+        page pools — or (k_pool, v_pool, k_scales, v_scales) for int8
+        pages (the scale arrays thread through to the in-kernel dequant
+        and come back updated in ``new_caches``). ``adapters``: per-row
+        gathered LoRA stacks ``{site: (A [T, L, r, in], B [T, L, out,
+        r])}`` applied at every projection site per layer (zero for
+        slot-0 rows). Returns (hidden [B, 1, H], new_caches)."""
         x = self.embed_tokens(ensure_tensor(input_ids))
         new_caches = []
-        for li, (layer, (kp, vp)) in enumerate(zip(self.layers, caches)):
+        for li, (layer, cache) in enumerate(zip(self.layers, caches)):
+            kp, vp = cache[0], cache[1]
+            ks = cache[2] if len(cache) > 2 else None
+            vs = cache[3] if len(cache) > 2 else None
             x, nc = layer.forward_paged(x, positions, block_tables, kp, vp,
-                                        adapters=adapters, layer_idx=li)
+                                        adapters=adapters, layer_idx=li,
+                                        k_scale=ks, v_scale=vs)
             new_caches.append(nc)
         return self.norm(x), new_caches
 

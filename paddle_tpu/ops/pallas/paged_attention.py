@@ -77,13 +77,19 @@ def _interpret() -> bool:
 
 
 def ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                        scale: float = None):
+                        scale: float = None, k_scale=None, v_scale=None):
     """Gather-based paged attention, pure jnp — the CPU/equivalence path.
 
     Math-identical to the dense cached_attn (einsum in f32, -1e30 masked
     lanes, softmax over the key axis): a masked key contributes exactly 0
     to every sum, so outputs match the dense decode bit-for-bit on the
     positions both paths share.
+
+    ``k_scale``/``v_scale`` (``[num_pages, page_size, nkv]`` f32, both or
+    neither) arm int8-page dequantization: gathered blocks are widened
+    per-block (``q * scale``) right here in the reduction — the full
+    bf16/f32 page array is never materialized, mirroring the in-kernel
+    dequant of the Pallas path.
     """
     B, nh, hd = q.shape
     nkv = k_pool.shape[2]
@@ -94,6 +100,11 @@ def ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
     # [B, pages_per_seq, page, nkv, hd] -> [B, K, nkv, hd]
     k = k_pool[block_tables].reshape(B, -1, nkv, hd)
     v = v_pool[block_tables].reshape(B, -1, nkv, hd)
+    if k_scale is not None:
+        ks = k_scale[block_tables].reshape(B, -1, nkv)
+        vs = v_scale[block_tables].reshape(B, -1, nkv)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     if groups > 1:  # GQA: repeat kv per query group (same as dense path)
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
@@ -110,16 +121,27 @@ def ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
 # ───────────────────────── pallas kernel ─────────────────────────
 
 
-def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                       acc_ref, m_ref, l_ref, *,
-                       scale: float, page_size: int, groups: int):
+def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                       scale: float, page_size: int, groups: int,
+                       quantized: bool = False):
     """One (sequence b, kv head h, page j) step of online-softmax decode.
 
     bt_ref/len_ref are the scalar-prefetched block table and seq lens —
     already consumed by the k/v index maps; len_ref masks the tail of the
     last live page here. q block is the head group [groups, hd]; scratch
     carries (acc, m, l) across the page axis (innermost, 'arbitrary').
+
+    ``quantized`` (a Python-time flag, so the unquantized trace is
+    byte-identical to before) threads two extra per-page scale blocks
+    (``ks_ref``/``vs_ref``, [1, page, 1]) and widens the int8 k/v blocks
+    in VMEM right before the dot — the dequant never touches HBM.
     """
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        o_ref, acc_ref, m_ref, l_ref = rest[2:]
+    else:
+        ks_ref = vs_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
     npages = pl.num_programs(2)
@@ -140,6 +162,9 @@ def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]  # [groups, hd]
         k = k_ref[0, :, 0, :]  # [page, hd]
         v = v_ref[0, :, 0, :]
+        if quantized:  # in-kernel dequant: int8 block × per-slot scale
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * jnp.float32(scale)
@@ -171,30 +196,41 @@ def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_attention_pallas(q, k_pool, v_pool, block_tables, seq_lens,
-                            scale: float):
+                            scale: float, k_scale=None, v_scale=None):
     B, nh, hd = q.shape
     num_pages, page_size, nkv, _ = k_pool.shape
     groups = nh // nkv
     pages_per_seq = block_tables.shape[1]
+    quantized = k_scale is not None
     # q regrouped so each kv head's query group is one contiguous block
     qg = q.reshape(B, nkv, groups, hd)
 
     bt = block_tables.astype(jnp.int32)
     sl = seq_lens.astype(jnp.int32)
 
+    kv_spec = pl.BlockSpec((1, page_size, 1, hd),
+                           lambda b, h, j, bt_ref, len_ref:
+                           (bt_ref[b, j], 0, h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, groups, hd),
+                     lambda b, h, j, bt_ref, len_ref: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quantized:
+        # per-slot scale blocks ride the same page-indexed DMA pattern
+        sc_spec = pl.BlockSpec((1, page_size, 1),
+                               lambda b, h, j, bt_ref, len_ref:
+                               (bt_ref[b, j], 0, h))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, seq_lens
         grid=(B, nkv, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, 1, groups, hd),
-                         lambda b, h, j, bt_ref, len_ref: (b, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, hd),
-                         lambda b, h, j, bt_ref, len_ref:
-                         (bt_ref[b, j], 0, h, 0)),
-            pl.BlockSpec((1, page_size, 1, hd),
-                         lambda b, h, j, bt_ref, len_ref:
-                         (bt_ref[b, j], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, groups, hd),
                                lambda b, h, j, bt_ref, len_ref: (b, h, 0, 0)),
         scratch_shapes=[
@@ -205,11 +241,12 @@ def _paged_attention_pallas(q, k_pool, v_pool, block_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, scale=scale,
-                          page_size=page_size, groups=groups),
+                          page_size=page_size, groups=groups,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nkv, groups, hd), q.dtype),
         interpret=_interpret(),
-    )(bt, sl, qg, k_pool, v_pool)
+    )(bt, sl, *operands)
     return out.reshape(B, nh, hd)
 
 
@@ -217,12 +254,19 @@ def _paged_attention_pallas(q, k_pool, v_pool, block_tables, seq_lens,
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                    scale: float = None, use_kernel: bool = None):
+                    scale: float = None, use_kernel: bool = None,
+                    k_scale=None, v_scale=None):
     """Ragged paged-attention decode: one query token per sequence over its
     page list. ``use_kernel=None`` picks the Pallas kernel on TPU backends
     (or under PADDLE_TPU_PALLAS_INTERPRET=1) and the jnp gather fallback
     elsewhere — both compute the identical masked-softmax math, so the
-    serving engine's numerics don't depend on the backend."""
+    serving engine's numerics don't depend on the backend.
+
+    ``k_scale``/``v_scale`` (pass both or neither; f32
+    ``[num_pages, page_size, nkv]``) switch the pools to int8 pages with
+    per-slot dequant applied inside the reduction on BOTH backends."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if use_kernel is None:
@@ -231,13 +275,15 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
             or jax.default_backend() in ("tpu", "axon"))
     if use_kernel and _HAS_PLTPU:
         return _paged_attention_pallas(q, k_pool, v_pool, block_tables,
-                                       seq_lens, scale)
+                                       seq_lens, scale,
+                                       k_scale=k_scale, v_scale=v_scale)
     return ref_paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                               scale)
+                               scale, k_scale=k_scale, v_scale=v_scale)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, row_block_tables, row_lens,
-                           scale: float = None, use_kernel: bool = None):
+                           scale: float = None, use_kernel: bool = None,
+                           k_scale=None, v_scale=None):
     """Mixed query-length paged attention over a FLATTENED token grid
     (module docstring, "Ragged form"): ``q`` is ``[T, nh, hd]`` — one
     row per query token across every slot this step, decode tokens and
@@ -256,4 +302,5 @@ def ragged_paged_attention(q, k_pool, v_pool, row_block_tables, row_lens,
     ``row_lens`` is what keeps a 1-token decode row from paying a long
     prompt's page walk."""
     return paged_attention(q, k_pool, v_pool, row_block_tables, row_lens,
-                           scale=scale, use_kernel=use_kernel)
+                           scale=scale, use_kernel=use_kernel,
+                           k_scale=k_scale, v_scale=v_scale)

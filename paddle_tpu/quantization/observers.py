@@ -1,6 +1,61 @@
 """paddle.quantization.observers (reference:
 python/paddle/quantization/observers/__init__.py — __all__ =
-['AbsmaxObserver'])."""
+['AbsmaxObserver']).
+
+Extended with the KV-page calibration helpers (ISSUE 18): the serving
+engine quantizes paged KV to int8 with PER-SLOT absmax scales — the
+vectorized, trace-safe form of :class:`AbsmaxObserver`'s running-absmax
+rule (``scale = max|x| / qmax``), computed per (token slot, kv head)
+over the head dimension at every KV write instead of once over a
+calibration run. One scale family, two consumers: the model observers
+above and the paged pool (serving/kv_cache.py), so the quantization
+grid cannot drift between training-time PTQ and serving-time KV pages.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
 from . import AbsmaxObserver  # noqa: F401
 
-__all__ = ["AbsmaxObserver"]
+__all__ = ["AbsmaxObserver", "KV_QMAX", "KV_SCALE_FLOOR",
+           "kv_absmax_scales", "quantize_kv", "dequantize_kv"]
+
+# int8 symmetric grid: values land in [-127, 127] (the -128 code is
+# unused, keeping the grid symmetric like the reference absmax quanters)
+KV_QMAX = 127.0
+# scale floor: an all-zero (or denormal-small) slot still gets a
+# nonzero scale so dequant is exact-zero instead of 0/0 — slots whose
+# absmax underflows this floor are what the
+# ``kv_dequant_scale_clip_total`` counter tallies (docs/OBSERVABILITY.md)
+KV_SCALE_FLOOR = 1e-8
+
+
+def kv_absmax_scales(x, qmax: float = KV_QMAX,
+                     floor: float = KV_SCALE_FLOOR):
+    """Per-slot absmax scales over the LAST axis (head_dim): ``x``
+    ``[..., head_dim]`` → f32 scales ``[...]``. The same rule as
+    :class:`AbsmaxObserver` (scale = max|x| / qmax), vectorized per KV
+    slot and floored so dequantization never divides by zero."""
+    ax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    return jnp.maximum(ax / jnp.float32(qmax), jnp.float32(floor))
+
+
+def quantize_kv(x, qmax: float = KV_QMAX, floor: float = KV_SCALE_FLOOR):
+    """Symmetric int8 quantization of one KV slab ``[..., head_dim]``:
+    returns ``(q int8 [..., head_dim], scales f32 [...])`` with
+    ``q = clip(round(x / scale), -qmax, qmax)``. Trace-safe (pure jnp):
+    the unified serving step quantizes on write inside the ONE compiled
+    program — dtype and scale arrays ride as data, never as new
+    programs (serving/engine.py compile-surface pin)."""
+    s = kv_absmax_scales(x, qmax=qmax, floor=floor)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -qmax, qmax).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, scales):
+    """Inverse of :func:`quantize_kv`: ``q int8 [..., head_dim]`` ×
+    ``scales [...]`` → f32. The paged-attention kernels apply exactly
+    this expression per gathered block (in-kernel dequant — full-width
+    pages are never materialized in HBM)."""
+    return q.astype(jnp.float32) * scales[..., None].astype(jnp.float32)

@@ -155,7 +155,7 @@ class _SeqState:
 
     __slots__ = ("req", "ids", "pos", "last_token", "gen", "t_last",
                  "t_admit", "inserted_nodes", "adp_slot", "fsm",
-                 "fsm_off", "fsm_state")
+                 "fsm_off", "fsm_state", "parked")
 
     def __init__(self, req: Request, ids: np.ndarray, pos: int):
         self.req = req
@@ -184,6 +184,13 @@ class _SeqState:
         self.fsm = None
         self.fsm_off = 0
         self.fsm_state = 0
+        # host-tier park flag (docs/SERVING.md "KV page tiers"): a
+        # parked slot keeps its _SeqState (stream position, grammar
+        # state, journal) but contributes ZERO rows to the unified step
+        # — its KV pages live in the pool's HostPageStore until unpark.
+        # False | "auto" (pressure policy; auto-restored) | "manual"
+        # (park_request; sticky until unpark_request)
+        self.parked = False
 
     @property
     def prefilling(self) -> bool:
@@ -209,7 +216,8 @@ class ServingEngine:
                  token_budget: int = 1024,
                  prefill_token_budget: Optional[int] = None,
                  min_step_tokens: Optional[int] = None,
-                 kv_dtype=jnp.float32, seed: Optional[int] = None,
+                 kv_dtype=jnp.float32, host_offload: bool = False,
+                 seed: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  watchdog_stall_s: Optional[float] = 30.0,
                  watchdog_recovery_steps: int = 3,
@@ -332,6 +340,13 @@ class ServingEngine:
                                      n_kv, head_dim, dtype=kv_dtype,
                                      engine_id=self.engine_id,
                                      model_id=self.model_id)
+        # host offload tier (docs/SERVING.md "KV page tiers &
+        # quantization"): when armed, admission pressure parks cold
+        # lower-urgency slots — their pages swap to the pool's
+        # HostPageStore and come back bit-exact at unpark, always BEFORE
+        # the slot's next step (the compiled step never blocks on a
+        # host→HBM copy; a violation shows up on kv_prefetch_late_total)
+        self._host_offload = bool(host_offload)
         # radix prefix cache over the pool (docs/SERVING.md "Prefix
         # caching"): admission longest-prefix-matches cached prompt pages
         # and chunk-prefills only the uncovered suffix. prefix_cache=
@@ -525,6 +540,14 @@ class ServingEngine:
             "row-0 identity) out of the grammar_states capacity",
             labels=_eng).labels(**self._lbl)
         self._m_grammar_states.set(1.0)
+        # host-tier SLO guard (docs/OBSERVABILITY.md): pages restored by
+        # a BLOCKING prefetch inside _step_once — the unpark policy
+        # failed to hide the host→HBM copy before the slot's step
+        self._m_prefetch_late = reg.counter(
+            "paddle_tpu_serving_kv_prefetch_late_total",
+            "KV pages prefetched host→HBM inside the step path (late: "
+            "the unpark-time prefetch should have restored them first)",
+            labels=_eng).labels(**self._lbl)
 
     # ------------------------------------------------------------ frontend
     def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -923,6 +946,14 @@ class ServingEngine:
             faults.point("serving.step")
             with RecordEvent("engine_step"):
                 finished.extend(self._sweep_deadlines())
+                if self._host_offload:
+                    # page pressure relief BEFORE admission: parking a
+                    # cold low-priority slot moves its pages (and its
+                    # worst-case tail reservation) to the host tier, so
+                    # can_admit sees the reclaimed capacity this very
+                    # step — offload-before-reject, and before the
+                    # prefix cache gets evicted for the same pages
+                    self._park_for_pressure()
                 free = sum(1 for s in self.slots if s is None)
                 for req in self.scheduler.admit(free, self.pool):
                     self._m_requests.labels(event="admitted", **self._lbl).inc()
@@ -935,6 +966,11 @@ class ServingEngine:
                     except Exception as e:
                         finished.append(
                             self._fail_admitted_request(req, e))
+                if self._host_offload:
+                    # restore parked slots whose pages now fit again —
+                    # AFTER admission so a just-admitted head request is
+                    # never displaced by the stream it preempted
+                    self._unpark_ready()
                 if any(s is not None for s in self.slots):
                     finished.extend(self._step_once())
         finally:
@@ -975,6 +1011,114 @@ class ServingEngine:
                          arg=float(tokens_this_step))
         # outputs were registered in self._outputs eagerly at retirement
         return finished
+
+    # ------------------------------------------------- host-tier parking
+    def _find_slot(self, req_id):
+        for i, st in enumerate(self.slots):
+            if st is not None and st.req.req_id == req_id:
+                return i, st
+        raise KeyError(f"unknown or finished request: {req_id!r}")
+
+    def park_request(self, req_id) -> int:
+        """Park a live request: its exclusively-owned KV pages swap to
+        the pool's host tier, its unwritten-tail reservation is released,
+        and the slot contributes ZERO rows to the unified step until
+        :meth:`unpark_request`. The slot itself stays occupied — parking
+        frees PAGES, not slots — and the whole stream state (position,
+        grammar DFA, journal) survives in place. Returns pages moved;
+        idempotent on an already-parked request.
+
+        A park requested through THIS public API is sticky: the per-step
+        pressure policy never auto-unparks it (an external controller
+        parked it for reasons the engine cannot see); only pressure
+        parks (``_park_for_pressure``) auto-restore via
+        ``_unpark_ready``."""
+        return self._park(req_id, mode="manual")
+
+    def _park(self, req_id, mode: str) -> int:
+        if not self._host_offload:
+            raise RuntimeError(
+                "host_offload is disabled on this engine "
+                "(ServingEngine(host_offload=True) to enable the tier)")
+        _, st = self._find_slot(req_id)
+        if st.parked:
+            return 0
+        n = self.pool.offload_seq(req_id)
+        st.parked = mode
+        self._trace.emit("req.park", req_id, arg=float(n))
+        return n
+
+    def unpark_request(self, req_id) -> int:
+        """Restore a parked request's offloaded pages into HBM (bit-exact
+        — bytes and int8 scales scattered back verbatim) and re-assume
+        its tail reservation; the slot rejoins the next step's grid.
+        Raises if the pool cannot cover the restore — callers gate on
+        ``pool.can_prefetch``. Returns pages restored."""
+        if not self._host_offload:
+            raise RuntimeError(
+                "host_offload is disabled on this engine "
+                "(ServingEngine(host_offload=True) to enable the tier)")
+        _, st = self._find_slot(req_id)
+        if not st.parked:
+            return 0
+        n = self.pool.prefetch_seq(req_id)
+        st.parked = False
+        self._trace.emit("req.unpark", req_id, arg=float(n))
+        return n
+
+    def _park_for_pressure(self) -> None:
+        """Offload-before-reject: when the queue head cannot admit for
+        PAGES while a decode slot sits free, park the coldest strictly
+        lower-priority streams until the head's worst case fits. Runs
+        before admission each step; victims keep their slots (their
+        pages and tail reservations are what the head needs), so this
+        only helps when slots outnumber page capacity — exactly the
+        overcommitted sizing the host tier exists for."""
+        sched = self.scheduler
+        if not sched.waiting:
+            return
+        if not any(s is None for s in self.slots):
+            return  # no free slot: parking frees pages, not slots
+        head = sched.waiting[0]
+        matched = (self.pool.prefix_match_len(head.admission_ids())
+                   if head.prefix_cache else 0)
+        cached = matched // self.page_size
+        if self.pool.can_admit(head.max_total_tokens, cached_pages=cached):
+            return
+        cands = [(st.t_last, st.req.req_id, st.req)
+                 for st in self.slots
+                 if st is not None and not st.parked and not st.prefilling]
+        for rid in sched.offload_victims(head, cands):
+            self._park(rid, mode="auto")
+            if self.pool.can_admit(head.max_total_tokens,
+                                   cached_pages=cached):
+                return
+
+    def _unpark_ready(self) -> None:
+        """Restore parked tenants whose pages fit again, highest
+        priority / oldest first. Anti-thrash: when the queue still has a
+        head, an unpark must leave that head's worst case admittable —
+        otherwise the next step would park the same slot right back."""
+        parked = [(st.req.priority, st.req.arrival_t, st.req.req_id)
+                  for st in self.slots
+                  if st is not None and st.parked == "auto"]
+        if not parked:
+            return
+        head_need = 0
+        if self.scheduler.waiting:
+            head = self.scheduler.waiting[0]
+            matched = (self.pool.prefix_match_len(head.admission_ids())
+                       if head.prefix_cache else 0)
+            head_need = max(
+                self.pool.pages_needed(head.max_total_tokens)
+                - matched // self.page_size, 0)
+        for _, _, rid in sorted(parked):
+            if not self.pool.can_prefetch(rid):
+                continue
+            if (head_need and self.pool.spare_pages()
+                    - self.pool.prefetch_cost(rid) < head_need):
+                continue
+            self.unpark_request(rid)
 
     # -------------------------------------------------- resilience helpers
     def _compile_with_retry(self, point_name: str, make_fn):
@@ -1225,11 +1369,16 @@ class ServingEngine:
         trunk, model, n_layers = self.trunk, self.model, self.n_layers
         site_names = [s for s, _, _ in self.adapters.sites]
         n_adp = 2 * len(site_names)
+        # pool arrays per layer: (k, v) for bf16/f32 pools, (k, v,
+        # k_scales, v_scales) for int8 — the stride is a Python constant
+        # at trace time, so quantization changes WHICH arrays ride as
+        # data, never the program count
+        stride = self.pool.step_stride
 
         def step_fn(tok, tok_pos, tok_bt, tok_adp, sample_rows, sample_pos,
                     temps, seeds, fsm_state, grammar_table, *rest):
             adp_flat, flat_pools = rest[:n_adp], rest[n_adp:]
-            caches = [(flat_pools[2 * i], flat_pools[2 * i + 1])
+            caches = [tuple(flat_pools[stride * i: stride * (i + 1)])
                       for i in range(n_layers)]
             with no_grad():
                 # per-row adapter gather: every grid row pulls ITS
@@ -1337,7 +1486,7 @@ class ServingEngine:
             if isinstance(v, (bool, int, float, str, type(None)))),
             self.page_size, self.pages_per_seq, self._spec_rows,
             self.adapters.capacity, self.adapters.rank,
-            self._grammar_cap))
+            self._grammar_cap, str(jnp.dtype(self.pool.dtype))))
         return jit.StaticFunction(step_fn, observe=[self.model],
                                   warmup=False, dy2static=False,
                                   cache_dir=self._compile_cache_dir,
@@ -1352,6 +1501,25 @@ class ServingEngine:
         for i, st in enumerate(self.slots):
             if st is None:
                 continue
+            if st.parked:
+                # parked slot: zero rows this step — its KV lives on the
+                # host tier and its block table holds null sentinels
+                continue
+            if (self._host_offload
+                    and self.pool.offloaded_pages(st.req.req_id)):
+                # LATE prefetch: an active slot reached the step path
+                # with pages still on the host (unpark restored the flag
+                # but not the pages, or a caller flipped `parked` by
+                # hand). Restore NOW — blocking, which is exactly the
+                # stall the unpark-time prefetch exists to avoid — and
+                # count it so operators can see the policy miss
+                try:
+                    n = self.pool.prefetch_seq(st.req.req_id)
+                    self._m_prefetch_late.inc(float(n))
+                except Exception as e:
+                    finished.append(self._retire_abnormal(
+                        st, slot=i, reason="error", error=e))
+                    continue
             if st.prefilling:
                 prefill_info.append((i, int(st.ids.size) - st.pos, st.req))
             else:
@@ -1540,10 +1708,19 @@ class ServingEngine:
             self._grammar_device,
             *self.adapters.arrays(),
             *[p for i in range(self.n_layers)
-              for p in (self.pool.k_pools[i], self.pool.v_pools[i])])
+              for p in self.pool.step_arrays(i)])
         nxt, fin, flat = res[0], res[1], res[2:]
-        self.pool.set_arrays([flat[2 * i] for i in range(self.n_layers)],
-                             [flat[2 * i + 1] for i in range(self.n_layers)])
+        self.pool.set_step_flat(flat)
+        if self.pool.quantized and total:
+            # absmax-floor accounting for THIS step's written slots: a
+            # clipped scale means a (page, pos, head) row whose KV
+            # underflowed the quantizer's dynamic range (kv_cache docs)
+            w_pages = tok_bt[np.arange(total),
+                             tok_pos[:total] // self.page_size]
+            live = w_pages > 0
+            if live.any():
+                self.pool.record_scale_clips(
+                    w_pages[live], (tok_pos[:total] % self.page_size)[live])
         nxt_host = np.asarray(nxt.numpy()).reshape(B, S)
         fin_host = np.asarray(fin.numpy()).reshape(B, S).astype(bool)
         now = time.perf_counter()

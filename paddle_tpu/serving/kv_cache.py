@@ -36,6 +36,28 @@ Sharding note (GSPMD, arxiv 2105.04663): the pool keeps the kv-head axis
 third, matching the dense cache layout the mp mesh shards today — a later
 multi-chip serving PR can shard ``n_kv_heads`` over 'mp' without touching
 the allocator or block tables (page ids are replicated host metadata).
+
+Page TIERS (docs/SERVING.md "KV page tiers & quantization"):
+
+- **int8 pages** — ``PagedKVCachePool(dtype="int8")`` stores pages as
+  int8 with per-slot f32 absmax scales (``k_scales``/``v_scales``,
+  ``[num_pages, page_size, n_kv_heads]``; quantization/observers.py owns
+  the scale rule). Writes quantize inside the compiled step; reads
+  dequantize in-kernel (ops/pallas/paged_attention.py) — a full-width
+  page never exists in HBM. Every allocator semantic treats a scale row
+  as part of its page: CoW copies scales with bytes, lazy scrub zeroes
+  both, poison lands in the SCALES (int8 cannot hold NaN; q × NaN = NaN
+  through dequant), and fork/prefix adoption share scale rows for free
+  because scales are page-indexed.
+- **host tier** — :meth:`offload_seq` swaps a parked sequence's
+  exclusively-owned written pages (bytes + scales, verbatim) into a
+  host-RAM :class:`HostPageStore` and returns the HBM pages to the free
+  list, ALSO releasing the sequence's unwritten-tail reservation — a
+  parked tenant is a real preemption, so ``can_admit``/``used_pages``
+  stay honest and admission prefers offload over rejection.
+  :meth:`prefetch_seq` re-takes pages and scatters the saved bytes back
+  bit-exactly BEFORE the slot's next step (the engine prefetches at
+  unpark; the compiled step never blocks on a host→HBM copy).
 """
 from __future__ import annotations
 
@@ -46,6 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults, metrics
+from ..quantization.observers import (KV_SCALE_FLOOR, quantize_kv)
 from ..tensor import Tensor
 
 faults.declare_point(
@@ -53,23 +76,94 @@ faults.declare_point(
     "PagedKVCachePool._take_page, before a page leaves the free list — "
     "arm ResourceExhausted here to drill pool-exhaustion handling")
 
-__all__ = ["PagedKVCachePool", "PrefixCache", "page_bytes",
-           "pages_for_hbm_budget"]
+__all__ = ["PagedKVCachePool", "PrefixCache", "HostPageStore",
+           "page_bytes", "pages_for_hbm_budget", "normalize_kv_dtype"]
+
+_KV_DTYPE_ALIASES = {
+    "f32": jnp.float32, "fp32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f16": jnp.float16, "fp16": jnp.float16, "float16": jnp.float16,
+    "int8": jnp.int8,
+}
+
+
+def normalize_kv_dtype(dtype):
+    """Resolve a KV-page dtype knob — a string alias (``"bf16"``,
+    ``"int8"``, ...) or a jnp/np dtype — to the jnp dtype the pool
+    stores. int8 means QUANTIZED pages (per-slot scales ride along)."""
+    if isinstance(dtype, str):
+        try:
+            return _KV_DTYPE_ALIASES[dtype.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown kv_dtype {dtype!r}; expected one of "
+                f"{sorted(_KV_DTYPE_ALIASES)}") from None
+    return dtype
 
 
 def page_bytes(page_size: int, n_kv_heads: int, head_dim: int,
-               num_layers: int, dtype_bytes: int = 4) -> int:
-    """Bytes one page costs across ALL layers (K and V)."""
+               num_layers: int, dtype_bytes: int = None,
+               kv_dtype=None) -> int:
+    """HBM bytes one page costs across ALL layers (K and V). Pass
+    ``kv_dtype`` to derive the element width from the pool's ACTUAL page
+    dtype (bf16 → 2, int8 → 1 plus the 4-byte f32 scale each slot
+    carries); ``dtype_bytes`` is the legacy scalar override (defaults to
+    4 = f32) and ignores scale overhead."""
+    if kv_dtype is not None:
+        if dtype_bytes is not None:
+            raise ValueError("pass kv_dtype or dtype_bytes, not both")
+        dt = jnp.dtype(normalize_kv_dtype(kv_dtype))
+        scale_bytes = 4 if dt == jnp.int8 else 0
+        return (2 * num_layers * page_size * n_kv_heads
+                * (head_dim * dt.itemsize + scale_bytes))
+    if dtype_bytes is None:
+        dtype_bytes = 4
     return 2 * num_layers * page_size * n_kv_heads * head_dim * dtype_bytes
 
 
 def pages_for_hbm_budget(hbm_bytes: int, page_size: int, n_kv_heads: int,
                          head_dim: int, num_layers: int,
-                         dtype_bytes: int = 4) -> int:
+                         dtype_bytes: int = None, kv_dtype=None) -> int:
     """Pool sizing math (docs/SERVING.md): pages = HBM budget / page bytes,
-    minus nothing — the caller budgets weights/activations separately."""
-    per = page_bytes(page_size, n_kv_heads, head_dim, num_layers, dtype_bytes)
+    minus nothing — the caller budgets weights/activations separately.
+    ``kv_dtype`` sizes against the real page dtype incl. scale overhead
+    (the users/chip lever: int8 roughly halves bytes/page)."""
+    per = page_bytes(page_size, n_kv_heads, head_dim, num_layers,
+                     dtype_bytes=dtype_bytes, kv_dtype=kv_dtype)
     return max(int(hbm_bytes) // per, 0)
+
+
+class HostPageStore:
+    """Host-RAM second page tier: a dict of ``(seq_id, page_index) →``
+    per-layer numpy slabs, written by :meth:`PagedKVCachePool.offload_seq`
+    and drained by :meth:`prefetch_seq`. Bytes (and int8 scales) are
+    stored verbatim — device→host→device round-trips are bit-exact by
+    construction (the warm_equals_cold contract of the offload tier).
+    Plain host memory, no device handles: survives pool array swaps and
+    costs zero HBM."""
+
+    def __init__(self):
+        self._pages: Dict[tuple, dict] = {}
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def put(self, seq_id, page_index: int, payload: dict) -> None:
+        self._pages[(seq_id, int(page_index))] = payload
+
+    def pop(self, seq_id, page_index: int) -> dict:
+        return self._pages.pop((seq_id, int(page_index)))
+
+    def seq_pages(self, seq_id) -> List[int]:
+        return sorted(pi for (s, pi) in self._pages if s == seq_id)
+
+    def drop_seq(self, seq_id) -> int:
+        """Discard a retiring sequence's host copies (no device writes —
+        there is nothing to scrub: host bytes never enter a gather)."""
+        keys = [k for k in self._pages if k[0] == seq_id]
+        for k in keys:
+            del self._pages[k]
+        return len(keys)
 
 
 class PagedKVCachePool:
@@ -101,15 +195,38 @@ class PagedKVCachePool:
         self.page_size = int(page_size)
         self.n_kv_heads = int(n_kv_heads)
         self.head_dim = int(head_dim)
-        self.dtype = dtype
+        self.dtype = normalize_kv_dtype(dtype)
+        # int8 pages carry per-slot f32 absmax scales (module docstring,
+        # "Page TIERS"); every page-granular allocator path below mirrors
+        # its byte operation onto the scale arrays
+        self.quantized = jnp.dtype(self.dtype) == jnp.int8
         shape = (self.num_pages, self.page_size, self.n_kv_heads,
                  self.head_dim)
         self.k_pools: List[Tensor] = [
-            Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+            Tensor(jnp.zeros(shape, self.dtype), stop_gradient=True)
             for _ in range(self.num_layers)]
         self.v_pools: List[Tensor] = [
-            Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+            Tensor(jnp.zeros(shape, self.dtype), stop_gradient=True)
             for _ in range(self.num_layers)]
+        if self.quantized:
+            sshape = shape[:3]  # [num_pages, page_size, n_kv_heads]
+            self.k_scales: Optional[List[Tensor]] = [
+                Tensor(jnp.zeros(sshape, jnp.float32), stop_gradient=True)
+                for _ in range(self.num_layers)]
+            self.v_scales: Optional[List[Tensor]] = [
+                Tensor(jnp.zeros(sshape, jnp.float32), stop_gradient=True)
+                for _ in range(self.num_layers)]
+        else:
+            self.k_scales = None
+            self.v_scales = None
+        # host offload tier: parked sequences' page bytes live here while
+        # their HBM pages serve other tenants; _host_idx maps seq_id →
+        # set of offloaded page indices (their table entries hold the
+        # null-page sentinel 0), _parked_resv journals the tail
+        # reservation released while parked
+        self.host_store = HostPageStore()
+        self._host_idx: Dict[object, set] = {}
+        self._parked_resv: Dict[object, int] = {}
         # page 0 reserved: free list covers 1..num_pages-1 (LIFO for reuse
         # locality — a just-freed page is the next handed out)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
@@ -146,16 +263,38 @@ class PagedKVCachePool:
         self._m_page_events = reg.counter(
             "paddle_tpu_serving_kv_page_events_total",
             "Page allocator traffic", labels=("event",) + _eng)
+        _tier = reg.gauge(
+            "paddle_tpu_serving_kv_page_tier",
+            "KV pages currently resident per tier: hbm = pages pinned by "
+            "live sequences, host = pages parked in the HostPageStore",
+            labels=("tier",) + _eng)
+        self._m_tier_hbm = _tier.labels(tier="hbm", **self._lbl)
+        self._m_tier_host = _tier.labels(tier="host", **self._lbl)
+        self._m_offload = reg.counter(
+            "paddle_tpu_serving_kv_offload_pages_total",
+            "KV pages swapped HBM → host by offload_seq (parked tenants)",
+            labels=_eng).labels(**self._lbl)
+        self._m_prefetch = reg.counter(
+            "paddle_tpu_serving_kv_prefetch_pages_total",
+            "KV pages swapped host → HBM by prefetch_seq (unpark)",
+            labels=_eng).labels(**self._lbl)
+        self._m_scale_clips = reg.counter(
+            "paddle_tpu_serving_kv_dequant_scale_clip_total",
+            "Quantized KV slots written at the absmax scale floor "
+            "(absmax underflowed KV_SCALE_FLOOR — dynamic range lost)",
+            labels=_eng).labels(**self._lbl)
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
-        """Re-set BOTH pool gauges on every allocator event: the total is
+        """Re-set the pool gauges on every allocator event: the totals are
         re-published (not just set once at construction) so a registry
         ``reset()`` mid-life self-heals instead of reporting 0 capacity
         forever. Each pool owns its {engine_id, model_id} series; the
         family-level read aggregates the fleet (docs/OBSERVABILITY.md)."""
         self._m_pages_used.set(self.used_pages)
         self._m_pages_total.set(self.usable_pages)
+        self._m_tier_hbm.set(self.used_pages)
+        self._m_tier_host.set(len(self.host_store))
 
     # ---------------------------------------------------------- accounting
     @property
@@ -245,6 +384,17 @@ class PagedKVCachePool:
                 self.v_pools[li] = Tensor(
                     vp.at[pages].set(jnp.zeros((), vp.dtype)),
                     stop_gradient=True)
+                if self.quantized:
+                    # poison lives in the SCALE rows on int8 pools —
+                    # scrub them with the page bytes
+                    ks = self.k_scales[li]._value
+                    vs = self.v_scales[li]._value
+                    self.k_scales[li] = Tensor(
+                        ks.at[pages].set(jnp.zeros((), ks.dtype)),
+                        stop_gradient=True)
+                    self.v_scales[li] = Tensor(
+                        vs.at[pages].set(jnp.zeros((), vs.dtype)),
+                        stop_gradient=True)
             self._dirty.clear()
         self._ref[p] = 1
         self.peak_used = max(self.peak_used, self.used_pages)
@@ -308,6 +458,7 @@ class PagedKVCachePool:
         page this sequence owns exclusively — the copy-on-write seam: a
         fork/prefix-share diverging into a shared page copies it here,
         first, so the sibling's (and the cache's) bytes are immutable."""
+        self._assert_resident(seq_id, "extend")
         table = self._tables[seq_id]
         need = self.pages_needed(total_tokens)
         while len(table) < need:
@@ -328,6 +479,7 @@ class PagedKVCachePool:
         start, total = int(start), int(total_tokens)
         if total <= start:
             return
+        self._assert_resident(seq_id, "extend_write")
         table = self._tables[seq_id]
         need = self.pages_needed(total)
         while len(table) < need:
@@ -382,6 +534,15 @@ class PagedKVCachePool:
                                       stop_gradient=True)
             self.v_pools[li] = Tensor(vp.at[fresh].set(vp[old]),
                                       stop_gradient=True)
+            if self.quantized:
+                # CoW copies SCALES with pages — a sibling diverging into
+                # a shared int8 page must not rescale the original's slots
+                ks = self.k_scales[li]._value
+                vs = self.v_scales[li]._value
+                self.k_scales[li] = Tensor(ks.at[fresh].set(ks[old]),
+                                           stop_gradient=True)
+                self.v_scales[li] = Tensor(vs.at[fresh].set(vs[old]),
+                                           stop_gradient=True)
         table[pi] = fresh
         # the shared original loses OUR reference only (cannot hit zero:
         # ref was > 1); scrub state, if any, stays with the original
@@ -425,11 +586,24 @@ class PagedKVCachePool:
         ``scrub=True`` (NaN quarantine) marks the freed pages dirty so
         :meth:`_take_page` zeroes each one lazily on reuse; pages a fork
         sibling or the prefix cache still references are deferred via
-        :meth:`_release_ref` — scrubbed only at refcount zero."""
+        :meth:`_release_ref` — scrubbed only at refcount zero.
+
+        A sequence retiring with OFFLOADED pages (parked, then cancelled
+        or deadline-swept, or exported for migration) drops its host
+        copies without any device write: those table entries hold the
+        null-page sentinel — their HBM pages were already released at
+        offload time — so releasing them again would corrupt page 0's
+        refcount. Host bytes never enter a gather, so there is nothing
+        to scrub on that tier (docs/RESILIENCE.md)."""
         table = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
         self._resv.pop(seq_id, None)
-        for p in table:
+        self._parked_resv.pop(seq_id, None)
+        off = self._host_idx.pop(seq_id, ())
+        self.host_store.drop_seq(seq_id)
+        for pi, p in enumerate(table):
+            if pi in off:
+                continue
             self._release_ref(p, scrub=scrub)
         self._refresh_gauges()
 
@@ -444,6 +618,7 @@ class PagedKVCachePool:
         parallel sampling."""
         if dst_id in self._tables:
             raise ValueError(f"sequence {dst_id!r} already allocated")
+        self._assert_resident(src_id, "fork")
         src = self._tables[src_id]
         n = self._lens[src_id]
         table: List[int] = []
@@ -456,6 +631,203 @@ class PagedKVCachePool:
             max_total_tokens if max_total_tokens is not None else n)
         self.peak_used = max(self.peak_used, self.used_pages)
         return list(table)
+
+    # ------------------------------------------------------- host tier
+    def _assert_resident(self, seq_id, op: str) -> None:
+        """Writes, forks, and poison require every page in HBM — an
+        offloaded table entry is the null-page sentinel 0, so touching it
+        would read/write the reserved page. The engine upholds this by
+        excluding parked slots from the step grid and prefetching at
+        unpark; this guard turns a policy bug into a loud error instead
+        of silent corruption."""
+        if self._host_idx.get(seq_id):
+            raise RuntimeError(
+                f"{op}({seq_id!r}): sequence has "
+                f"{len(self._host_idx[seq_id])} offloaded page(s) — "
+                f"prefetch_seq() must restore them first")
+
+    def offloaded_pages(self, seq_id=None) -> int:
+        """Pages currently parked on the host tier — for one sequence, or
+        pool-wide with ``seq_id=None``."""
+        if seq_id is not None:
+            return len(self._host_idx.get(seq_id, ()))
+        return len(self.host_store)
+
+    def spare_pages(self) -> int:
+        """Pages the pool could hand out RIGHT NOW without breaking any
+        live sequence's reservation: free + cache-reclaimable − promised
+        lazy tails. The engine's park/unpark policy reasons in this
+        currency (admit the queue head, re-admit a parked tenant)."""
+        return (len(self._free) + self._reclaimable_pages()
+                - self._unallocated_reserved())
+
+    def can_prefetch(self, seq_id) -> bool:
+        """True when :meth:`prefetch_seq` can restore ``seq_id`` AND
+        re-assume its worst-case tail reservation without overcommitting
+        — unpark is an admission in reverse, held to the same
+        no-preemption arithmetic as :meth:`can_admit`."""
+        off = self._host_idx.get(seq_id)
+        if not off:
+            return True
+        tail = max(self._parked_resv.get(seq_id, 0)
+                   - len(self._tables[seq_id]), 0)
+        return len(off) + tail <= self.spare_pages()
+
+    def prefetch_cost(self, seq_id) -> int:
+        """Pages :meth:`prefetch_seq` would charge against
+        :meth:`spare_pages` — offloaded pages to restore plus the
+        journaled tail reservation to re-assume. The engine's anti-thrash
+        check subtracts this before unparking so the queue head's next
+        admission is never displaced by the tenant it preempted."""
+        off = self._host_idx.get(seq_id)
+        if not off:
+            return 0
+        tail = max(self._parked_resv.get(seq_id, 0)
+                   - len(self._tables[seq_id]), 0)
+        return len(off) + tail
+
+    def offload_seq(self, seq_id) -> int:
+        """Swap ``seq_id``'s exclusively-owned written pages to the host
+        tier (bytes + int8 scales, verbatim — the round-trip is
+        bit-exact) and release BOTH the HBM pages and the sequence's
+        unwritten-tail reservation. Shared pages (prefix cache / fork
+        siblings hold them) stay resident: other tenants gather them for
+        real. Returns pages moved; idempotent on a parked sequence.
+
+        Capacity honesty: freed pages land on the free list, the tail
+        reservation is journaled into ``_parked_resv`` and zeroed, so
+        ``can_admit`` sees a parked tenant as fully preempted — the
+        eviction order "offload before prefix-evict" follows because the
+        engine parks victims BEFORE any allocation walks
+        :meth:`_take_page`'s cache-eviction loop."""
+        table = self._tables[seq_id]
+        n = int(self._lens[seq_id])
+        off = self._host_idx.setdefault(seq_id, set())
+        written = self.pages_needed(n) if n > 0 else 0
+        move = [pi for pi in range(min(written, len(table)))
+                if pi not in off and self._ref[table[pi]] == 1]
+        if seq_id not in self._parked_resv:
+            self._parked_resv[seq_id] = self._resv.get(seq_id, 0)
+            self._resv[seq_id] = 0
+        if move:
+            pages = jnp.asarray(np.asarray([table[pi] for pi in move],
+                                           np.int32))
+            for pi in move:
+                payload = {"k": [], "v": []}
+                if self.quantized:
+                    payload["ks"], payload["vs"] = [], []
+                self.host_store.put(seq_id, pi, payload)
+            # one gather per layer per array, then split per page — the
+            # device→host copy happens HERE (park time, off the step
+            # path), never inside a compiled step
+            for li in range(self.num_layers):
+                kslab = np.asarray(self.k_pools[li]._value[pages])
+                vslab = np.asarray(self.v_pools[li]._value[pages])
+                for j, pi in enumerate(move):
+                    pl = self.host_store._pages[(seq_id, pi)]
+                    pl["k"].append(kslab[j])
+                    pl["v"].append(vslab[j])
+                if self.quantized:
+                    ksc = np.asarray(self.k_scales[li]._value[pages])
+                    vsc = np.asarray(self.v_scales[li]._value[pages])
+                    for j, pi in enumerate(move):
+                        pl = self.host_store._pages[(seq_id, pi)]
+                        pl["ks"].append(ksc[j])
+                        pl["vs"].append(vsc[j])
+            for pi in move:
+                off.add(pi)
+                self._release_ref(table[pi])
+                table[pi] = 0
+            self._m_offload.inc(len(move))
+            self._m_page_events.labels(event="offload", **self._lbl).inc(
+                len(move))
+        self._refresh_gauges()
+        return len(move)
+
+    def prefetch_seq(self, seq_id) -> int:
+        """Restore every offloaded page of ``seq_id`` into freshly drawn
+        HBM pages (bytes + scales scattered back verbatim → bit-exact)
+        and re-assume the journaled tail reservation. All-or-nothing: if
+        the pool cannot cover the restore, pages taken so far return to
+        the free list and the sequence stays parked. The engine calls
+        this at UNPARK, before the slot re-enters the step grid — the
+        compiled step itself never waits on a host→HBM copy."""
+        off = self._host_idx.get(seq_id)
+        if not off:
+            # nothing on the host tier; still restore a journaled tail
+            # reservation (a park that moved zero pages — all shared)
+            if seq_id in self._parked_resv:
+                self._resv[seq_id] = max(self._parked_resv.pop(seq_id),
+                                         self._resv.get(seq_id, 0))
+            return 0
+        table = self._tables[seq_id]
+        idxs = sorted(off)
+        fresh: List[int] = []
+        try:
+            for _ in idxs:
+                fresh.append(self._take_page())
+        except Exception:
+            for p in fresh:
+                self._release_ref(p)
+            self._refresh_gauges()
+            raise
+        pages = jnp.asarray(np.asarray(fresh, np.int32))
+        payloads = [self.host_store.pop(seq_id, pi) for pi in idxs]
+        for li in range(self.num_layers):
+            kp = self.k_pools[li]._value
+            vp = self.v_pools[li]._value
+            kslab = jnp.asarray(np.stack([p["k"][li] for p in payloads]))
+            vslab = jnp.asarray(np.stack([p["v"][li] for p in payloads]))
+            self.k_pools[li] = Tensor(kp.at[pages].set(kslab),
+                                      stop_gradient=True)
+            self.v_pools[li] = Tensor(vp.at[pages].set(vslab),
+                                      stop_gradient=True)
+            if self.quantized:
+                ks = self.k_scales[li]._value
+                vs = self.v_scales[li]._value
+                kssl = jnp.asarray(np.stack([p["ks"][li]
+                                             for p in payloads]))
+                vssl = jnp.asarray(np.stack([p["vs"][li]
+                                             for p in payloads]))
+                self.k_scales[li] = Tensor(ks.at[pages].set(kssl),
+                                           stop_gradient=True)
+                self.v_scales[li] = Tensor(vs.at[pages].set(vssl),
+                                           stop_gradient=True)
+        for pi, p in zip(idxs, fresh):
+            table[pi] = p
+        self._host_idx.pop(seq_id, None)
+        if seq_id in self._parked_resv:
+            self._resv[seq_id] = max(self._parked_resv.pop(seq_id),
+                                     self._resv.get(seq_id, 0))
+        self._m_prefetch.inc(len(idxs))
+        self._m_page_events.labels(event="prefetch", **self._lbl).inc(
+            len(idxs))
+        self.peak_used = max(self.peak_used, self.used_pages)
+        self._refresh_gauges()
+        return len(idxs)
+
+    def record_scale_clips(self, page_ids, offs) -> int:
+        """Count this step's written slots whose absmax scale clamped at
+        KV_SCALE_FLOOR (all layers, K and V) and move the
+        ``kv_dequant_scale_clip_total`` counter. The engine calls this
+        with the step's (page, offset) coords right after the program
+        returns — a floor-clamped slot quantized with its dynamic range
+        collapsed (absmax underflow), the one int8 failure mode absmax
+        scaling cannot round away (docs/OBSERVABILITY.md)."""
+        if not self.quantized or len(page_ids) == 0:
+            return 0
+        pages = jnp.asarray(np.asarray(page_ids, np.int32))
+        oo = jnp.asarray(np.asarray(offs, np.int32))
+        floor = jnp.float32(KV_SCALE_FLOOR)
+        n = 0
+        for li in range(self.num_layers):
+            n += int(jnp.sum(
+                self.k_scales[li]._value[pages, oo] <= floor))
+            n += int(jnp.sum(
+                self.v_scales[li]._value[pages, oo] <= floor))
+        if n:
+            self._m_scale_clips.inc(n)
+        return n
 
     def _slot_coords(self, seq_id, n_tokens: int, start: int = 0):
         """(page_ids, offs) device coords of a sequence's KV slots
@@ -476,7 +848,14 @@ class PagedKVCachePool:
         poisoning them would corrupt healthy tenants — a different drill
         than "this one sequence's KV went bad". Raises if the sequence
         has no exclusive written slots (the drill would silently no-op).
-        Returns slots poisoned."""
+        Returns slots poisoned.
+
+        int8 pools poison the SCALE rows instead of the page bytes: an
+        int8 slot cannot hold NaN, but ``q × NaN = NaN`` through the
+        in-kernel dequant, so a poisoned scale contaminates attention
+        exactly like a poisoned bf16 slot would — and the lazy scrub
+        zeroes scale rows with their pages (:meth:`_take_page`)."""
+        self._assert_resident(seq_id, "poison_seq")
         n = int(self._lens[seq_id])
         table = self._tables[seq_id]
         idx = np.arange(n)
@@ -492,6 +871,17 @@ class PagedKVCachePool:
         page_ids = jnp.asarray(
             np.asarray(table, np.int32)[idx // self.page_size])
         offs = jnp.asarray(idx % self.page_size)
+        if self.quantized:
+            for li in range(self.num_layers):
+                ks = self.k_scales[li]._value
+                vs = self.v_scales[li]._value
+                self.k_scales[li] = Tensor(
+                    ks.at[page_ids, offs].set(
+                        jnp.asarray(value, ks.dtype)), stop_gradient=True)
+                self.v_scales[li] = Tensor(
+                    vs.at[page_ids, offs].set(
+                        jnp.asarray(value, vs.dtype)), stop_gradient=True)
+            return int(idx.size)
         for li in range(self.num_layers):
             kp = self.k_pools[li]._value
             vp = self.v_pools[li]._value
@@ -535,15 +925,52 @@ class PagedKVCachePool:
         self.prefix_cache = cache
 
     # ------------------------------------------------------- device arrays
-    def set_arrays(self, k_arrays, v_arrays) -> None:
+    def set_arrays(self, k_arrays, v_arrays, k_scales=None,
+                   v_scales=None) -> None:
         """Swap in the pools a compiled decode step returned (functional
-        update — the engine's step owns the only in-flight copy)."""
+        update — the engine's step owns the only in-flight copy). A
+        quantized pool's step also returns the updated scale arrays."""
         self.k_pools = [t if isinstance(t, Tensor)
                         else Tensor(t, stop_gradient=True)
                         for t in k_arrays]
         self.v_pools = [t if isinstance(t, Tensor)
                         else Tensor(t, stop_gradient=True)
                         for t in v_arrays]
+        if k_scales is not None:
+            self.k_scales = [t if isinstance(t, Tensor)
+                             else Tensor(t, stop_gradient=True)
+                             for t in k_scales]
+            self.v_scales = [t if isinstance(t, Tensor)
+                             else Tensor(t, stop_gradient=True)
+                             for t in v_scales]
+
+    @property
+    def step_stride(self) -> int:
+        """Device arrays one layer contributes to the compiled step's
+        flat cache operands: (k, v) or (k, v, k_scale, v_scale)."""
+        return 4 if self.quantized else 2
+
+    def step_arrays(self, li: int):
+        """Layer ``li``'s cache tuple in step-operand order — the single
+        definition both the engine's program invocation and its
+        result-unpacking use, so the stride cannot drift."""
+        if self.quantized:
+            return (self.k_pools[li], self.v_pools[li],
+                    self.k_scales[li], self.v_scales[li])
+        return (self.k_pools[li], self.v_pools[li])
+
+    def set_step_flat(self, flat) -> None:
+        """Inverse of per-layer :meth:`step_arrays` concatenation: accept
+        the compiled step's flat cache outputs and swap every array (and
+        scale array, when quantized) back in."""
+        s = self.step_stride
+        self.set_arrays(
+            [flat[s * i] for i in range(self.num_layers)],
+            [flat[s * i + 1] for i in range(self.num_layers)],
+            k_scales=([flat[s * i + 2] for i in range(self.num_layers)]
+                      if self.quantized else None),
+            v_scales=([flat[s * i + 3] for i in range(self.num_layers)]
+                      if self.quantized else None))
 
     def write_prompt_kv(self, seq_id, layer_kv, start: int = 0) -> None:
         """Prefill's KV write hook: scatter a dense prompt cache into this
@@ -553,33 +980,62 @@ class PagedKVCachePool:
         sliced off). ``start`` > 0 is the prefix-cache suffix scatter:
         matched (shared) pages cover 0..start-1 and are never written —
         match granularity is full pages, so the suffix begins on a page
-        this sequence owns."""
+        this sequence owns. Quantized pools quantize here (per-slot
+        absmax, quantization/observers.py) and scatter values + scales —
+        the same grid the in-step scatter writes, so prefill-written and
+        decode-written slots dequantize identically."""
+        self._assert_resident(seq_id, "write_prompt_kv")
         s = int(layer_kv[0][0].shape[0])
         page_ids, offs = self._slot_coords(seq_id, s, start=start)
         for li, (k, v) in enumerate(layer_kv):
             kp = self.k_pools[li]._value
             vp = self.v_pools[li]._value
+            if self.quantized:
+                kq, ksc = quantize_kv(jnp.asarray(k))
+                vq, vsc = quantize_kv(jnp.asarray(v))
+                self.k_pools[li] = Tensor(
+                    kp.at[page_ids, offs].set(kq), stop_gradient=True)
+                self.v_pools[li] = Tensor(
+                    vp.at[page_ids, offs].set(vq), stop_gradient=True)
+                ks = self.k_scales[li]._value
+                vs = self.v_scales[li]._value
+                self.k_scales[li] = Tensor(
+                    ks.at[page_ids, offs].set(ksc), stop_gradient=True)
+                self.v_scales[li] = Tensor(
+                    vs.at[page_ids, offs].set(vsc), stop_gradient=True)
+                continue
             self.k_pools[li] = Tensor(
                 kp.at[page_ids, offs].set(
                     jnp.asarray(k).astype(kp.dtype)), stop_gradient=True)
             self.v_pools[li] = Tensor(
                 vp.at[page_ids, offs].set(
                     jnp.asarray(v).astype(vp.dtype)), stop_gradient=True)
+        if self.quantized:
+            self.record_scale_clips(np.asarray(page_ids),
+                                    np.asarray(offs))
 
     def gather_kv_range(self, page_ids: Sequence[int], n_tokens: int):
         """Read ``n_tokens`` of KV back out through a page list: per-layer
         list of (k, v) arrays ``[n_tokens, n_kv_heads, head_dim]`` — the
         prefix-cache hit path loads these into the suffix prefill's dense
         cache buffers (positions 0..n_tokens-1, already rope'd exactly as
-        the original prefill wrote them)."""
+        the original prefill wrote them). Quantized pools return the
+        DEQUANTIZED f32 values (toleranced, like quantized attention
+        itself) — callers consume values, not codes."""
         table = np.asarray(page_ids, np.int32)
         idx = np.arange(int(n_tokens))
         pages = jnp.asarray(table[idx // self.page_size])
         offs = jnp.asarray(idx % self.page_size)
         out = []
         for li in range(self.num_layers):
-            out.append((self.k_pools[li]._value[pages, offs],
-                        self.v_pools[li]._value[pages, offs]))
+            k = self.k_pools[li]._value[pages, offs]
+            v = self.v_pools[li]._value[pages, offs]
+            if self.quantized:
+                k = (k.astype(jnp.float32)
+                     * self.k_scales[li]._value[pages, offs][..., None])
+                v = (v.astype(jnp.float32)
+                     * self.v_scales[li]._value[pages, offs][..., None])
+            out.append((k, v))
         return out
 
     def prefix_match_len(self, token_ids) -> int:

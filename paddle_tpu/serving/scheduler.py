@@ -416,6 +416,23 @@ class FCFSScheduler:
             free_slots -= 1
         return admitted
 
+    @staticmethod
+    def offload_victims(head: Request,
+                        candidates: Sequence[Tuple[float, object, Request]]
+                        ) -> List[object]:
+        """Pick which live slots may be parked to the host KV tier so the
+        blocked queue head can admit (docs/SERVING.md "KV page tiers").
+        ``candidates`` is ``[(last_active_t, key, request)]`` for slots
+        eligible to park; returns their keys in park order. Two rules:
+        only STRICTLY lower-priority tenants are preempted (a tie never
+        thrashes two equal streams swapping each other out), and among
+        those the coldest stream — oldest ``last_active_t`` — parks
+        first, so the pages least likely to be needed next step leave
+        HBM first."""
+        eligible = [c for c in candidates if c[2].priority > head.priority]
+        eligible.sort(key=lambda c: c[0])
+        return [c[1] for c in eligible]
+
     def plan_chunks(self, n_decode: int,
                     prefills: Sequence[Tuple[object, int, Request]]
                     ) -> List[Tuple[object, int]]:

@@ -141,6 +141,73 @@ def test_bench_load_row_schema_is_stable():
         "committed artifact carries no TTFT attribution at all"
 
 
+def test_bench_kv_row_schema_is_stable():
+    """The committed BENCH_KV.json (the KV-memory-economics artifact,
+    ISSUE 18) carries exactly the schema tools/bench_decode.py pins.
+    Timings are host-dependent; the sizing math (users_ratio — pure
+    page-byte arithmetic) and the determinism-contract booleans
+    (host-tier round trip bit-exact, compile surface pinned with every
+    feature armed) are NOT, so those are asserted by value."""
+    bd = _load("bd_test", "bench_decode.py")
+    with open(os.path.join(REPO, "BENCH_KV.json")) as f:
+        row = json.load(f)
+
+    assert set(row) == set(bd.KV_ROW_KEYS)
+    assert row["metric"] == "BENCH_KV"
+    assert row["unit"] == "ratio"
+    rep = row["report"]
+    assert set(rep) == set(bd.KV_REPORT_KEYS)
+    assert set(rep["tiers"]) == {"bf16", "int8"}
+    for tier in rep["tiers"].values():
+        assert set(tier) == set(bd.KV_TIER_KEYS)
+        assert tier["tokens_per_sec"] > 0
+        assert tier["itl_matched_p95_ms"] > 0
+        # the compile surface stays pinned per dtype: quantization rides
+        # as dtype + scale arrays, never as new programs
+        assert tier["step_compiles"] == tier["step_buckets"]
+    # users/chip at one HBM budget is arithmetic, not timing: head_dim
+    # 128 makes the int8 page-byte ratio (2*128)/(128+4) = 1.94x
+    assert row["value"] == rep["users_ratio"] >= 1.9
+    i8, bf = rep["tiers"]["int8"], rep["tiers"]["bf16"]
+    assert i8["users_per_chip"] >= 1.9 * bf["users_per_chip"]
+    assert i8["page_bytes"] < bf["page_bytes"]
+    # quantized-attention quality guard: toleranced, not bit-checked
+    assert i8["spec_acceptance_rate"] >= bf["spec_acceptance_rate"] - 0.25
+    host = rep["host_tier"]
+    assert set(host) == set(bd.KV_HOST_KEYS)
+    assert host["parked_seen"] is True
+    assert host["round_trip_bit_exact"] is True
+    assert host["prefetch_late"] == 0
+    assert host["prefetch_pages"] == host["offload_pages"] > 0
+    arm = rep["full_arm"]
+    assert set(arm) == set(bd.KV_ARM_KEYS)
+    assert set(arm["features"]) == {"int8", "host_offload", "spec",
+                                    "grammar"}
+    assert arm["step_compiles"] == arm["step_buckets"]
+    assert arm["extra_jit_compiles"] == 0
+
+
+def test_bench_kv_build_row_trims_to_schema():
+    """build_kv_row keeps ONLY the schema-stable keys — a report field
+    added later must not silently widen the committed artifact."""
+    bd = _load("bd_row_test", "bench_decode.py")
+    tier = {k: 1.0 for k in bd.KV_TIER_KEYS}
+    tier["extra_tier_field"] = "drop me"
+    report = {k: 0 for k in bd.KV_REPORT_KEYS}
+    report.update(
+        users_ratio=2.14159, tiers={"bf16": tier, "int8": dict(tier)},
+        host_tier={k: 0 for k in bd.KV_HOST_KEYS + ("extra_host",)},
+        full_arm={k: 0 for k in bd.KV_ARM_KEYS + ("extra_arm",)},
+        extra_report_field="drop me")
+    row = bd.build_kv_row(report, "cfg-label", "cpu")
+    assert set(row) == set(bd.KV_ROW_KEYS)
+    assert row["value"] == 2.142
+    assert set(row["report"]) == set(bd.KV_REPORT_KEYS)
+    assert set(row["report"]["tiers"]["int8"]) == set(bd.KV_TIER_KEYS)
+    assert set(row["report"]["host_tier"]) == set(bd.KV_HOST_KEYS)
+    assert set(row["report"]["full_arm"]) == set(bd.KV_ARM_KEYS)
+
+
 def test_bench_load_build_row_trims_to_schema():
     """build_row keeps ONLY the schema-stable keys (a LoadReport field
     added later must not silently widen the committed artifact)."""

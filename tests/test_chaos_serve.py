@@ -1,5 +1,5 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-15 — engine resilience, router failover/reload/dispatch, the
+1-18 — engine resilience, router failover/reload/dispatch, the
 kill-engine-mid-decode migration drill, the prefix-heavy failover
 drill that asserts migrated requests re-prefill through the adoptive
 sibling's prefix cache, the kill-engine-mid-chunked-prefill drill
@@ -18,7 +18,12 @@ completion and zero fresh compiles on scale-up, and the
 flight-recorder-on-crash drill that kills the busiest engine with the
 always-armed trace ring installed and asserts crash containment
 auto-dumps every victim request's timeline with the migration hop
-visible and seqs exactly-once across the hop) runs as slow-marked
+visible and seqs exactly-once across the hop, and the
+kill-engine-with-offloaded-pages drill that kills an engine whose
+victim stream is PARKED on the int8 host KV tier and asserts the dead
+engine's HostPageStore drains while the equally page-starved sibling
+re-serves both migrants through its own park/unpark cycle with
+streams bit-identical) runs as slow-marked
 tests instead of
 only by hand, one test per scenario so a regression names its drill.
 

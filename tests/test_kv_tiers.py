@@ -1,0 +1,383 @@
+"""KV page tiers (ISSUE 18): int8 quantized pages + host-RAM offload.
+
+Acceptance gates: quantized pools keep EVERY pool semantic (CoW copies
+scales with pages, truncate rolls back spec bursts, the prefix cache
+hits quantized pages), host offload round-trips bit-exact (codes AND
+scales verbatim), parked capacity is honest (admission sees it), the
+unpark-time prefetch lands BEFORE the slot's next step, and the compile
+surface stays pinned — quantization and the host tier ride as dtype +
+data, never as new programs (step == step_buckets).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.quantization.observers import KV_SCALE_FLOOR
+from paddle_tpu.serving import (PagedKVCachePool, ServingEngine, page_bytes,
+                                pages_for_hbm_budget)
+
+pytestmark = pytest.mark.serving
+
+
+def _llama():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+
+
+def _pool(pages=9, dtype="int8", layers=1):
+    return PagedKVCachePool(num_layers=layers, num_pages=pages, page_size=4,
+                            n_kv_heads=2, head_dim=8, dtype=dtype)
+
+
+def _rand_kv(rng, n, n_kv=2, hd=8):
+    return (rng.standard_normal((n, n_kv, hd)).astype(np.float32),
+            rng.standard_normal((n, n_kv, hd)).astype(np.float32))
+
+
+def _counter(name, eng):
+    fam = paddle.metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return fam.labels(engine_id=eng.engine_id, model_id=eng.model_id).value
+
+
+# ─────────────────────────── quantized pages ───────────────────────────
+
+
+class TestQuantizedPages:
+    def test_write_gather_roundtrip_within_absmax_tolerance(self):
+        """Per-(pos, head) absmax scaling bounds the dequant error at
+        absmax/127 per slot — the documented int8 tolerance."""
+        pool = _pool()
+        rng = np.random.default_rng(0)
+        k, v = _rand_kv(rng, 7)
+        pool.allocate("a", 7)
+        pool.write_prompt_kv("a", [(k, v)])
+        gk, gv = pool.gather_kv_range(pool.block_table("a"), 7)[0]
+        for ref, got in ((k, np.asarray(gk)), (v, np.asarray(gv))):
+            bound = np.abs(ref).max(axis=-1, keepdims=True) / 127.0 + 1e-6
+            assert (np.abs(ref - got) <= bound).all()
+
+    def test_cow_copies_scales_with_pages_sibling_untouched(self):
+        """The fork CoW seam must copy the scale rows WITH the page
+        bytes: after the branch diverges, the sibling's codes and scales
+        are bit-identical to before (checksum), and the fork's copied
+        page starts from the shared values."""
+        pool = _pool()
+        rng = np.random.default_rng(1)
+        k, v = _rand_kv(rng, 6)  # page0 full, page1 partial (2 tokens)
+        pool.allocate("src", 6)
+        pool.write_prompt_kv("src", [(k, v)])
+        src_table = pool.block_table("src")
+        before = {
+            "k": np.asarray(pool.k_pools[0]._value[src_table[1]]),
+            "ks": np.asarray(pool.k_scales[0]._value[src_table[1]]),
+            "vs": np.asarray(pool.v_scales[0]._value[src_table[1]]),
+        }
+        pool.fork("src", "dst")
+        pool.extend("dst", 7)  # diverge into the shared tail -> CoW
+        dst_table = pool.block_table("dst")
+        assert dst_table[1] != src_table[1]
+        # the copy carried codes AND scales
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_scales[0]._value[dst_table[1]]), before["ks"])
+        # sibling bit-identical
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pools[0]._value[src_table[1]]), before["k"])
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_scales[0]._value[src_table[1]]), before["ks"])
+        np.testing.assert_array_equal(
+            np.asarray(pool.v_scales[0]._value[src_table[1]]), before["vs"])
+        pool.free("src")
+        pool.free("dst")
+        assert pool.used_pages == 0
+
+    def test_truncate_then_rewrite_is_exact(self):
+        """The speculative reject path on a quantized pool: truncate
+        lowers the length, the re-written slots land new codes AND new
+        scales, and the accepted prefix is untouched."""
+        pool = _pool()
+        rng = np.random.default_rng(2)
+        k, v = _rand_kv(rng, 8)
+        pool.allocate("a", 8, max_total_tokens=12)
+        pool.write_prompt_kv("a", [(k, v)])
+        keep = pool.gather_kv_range(pool.block_table("a"), 5)[0]
+        pool.truncate("a", 5)
+        k2, v2 = _rand_kv(rng, 3)
+        pool.extend_write("a", 5, 8)
+        pool.write_prompt_kv("a", [(k2, v2)], start=5)
+        gk, gv = pool.gather_kv_range(pool.block_table("a"), 8)[0]
+        # accepted prefix: bit-identical dequant (codes+scales untouched)
+        np.testing.assert_array_equal(np.asarray(gk)[:5],
+                                      np.asarray(keep[0]))
+        np.testing.assert_array_equal(np.asarray(gv)[:5],
+                                      np.asarray(keep[1]))
+        # re-speculated tail quantized from the NEW values
+        bound = np.abs(k2).max(axis=-1, keepdims=True) / 127.0 + 1e-6
+        assert (np.abs(k2 - np.asarray(gk)[5:]) <= bound).all()
+
+    def test_prefix_cache_hits_quantized_pages(self):
+        """A warm prompt on an int8 engine adopts cached quantized pages
+        and the warm stream equals the cold one (same request params →
+        same tokens: adoption replays the SAME codes+scales)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            kv_dtype="int8")
+        prompt = np.random.RandomState(11).randint(0, 128, (13,))
+        spec = dict(max_new_tokens=5, temperature=0.0)
+        r0 = eng.add_request(prompt, **spec)
+        cold = list(eng.run()[r0].token_ids)
+        h0 = _counter("paddle_tpu_serving_prefix_hits_total", eng)
+        r1 = eng.add_request(prompt, **spec)
+        warm = list(eng.run()[r1].token_ids)
+        assert _counter("paddle_tpu_serving_prefix_hits_total", eng) > h0
+        assert warm == cold
+
+    def test_spec_streams_identical_and_acceptance_not_degraded(self):
+        """Speculation on a quantized pool: spec-on == spec-off streams
+        (bit-identical — drafts are scored by the same quantized step),
+        and the oracle-style n-gram acceptance ratio on a repetitive
+        prompt is no worse than the f32 pool's on the same workload
+        (the ISSUE 18 acceptance-ratio guard)."""
+        from paddle_tpu import metrics
+
+        prompt = np.tile(np.arange(1, 5), 6)  # strongly repetitive
+        spec = dict(max_new_tokens=10, temperature=0.0)
+
+        def run(kv_dtype):
+            model = _llama()
+            reg = metrics.get_registry()
+            ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                                    kv_dtype=kv_dtype)
+            rr = ref_eng.add_request(prompt, **spec)
+            ref = list(ref_eng.run()[rr].token_ids)
+            d0 = reg.get(
+                "paddle_tpu_serving_spec_drafted_tokens_total").value
+            a0 = reg.get(
+                "paddle_tpu_serving_spec_accepted_tokens_total").value
+            eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                                spec_k=3, kv_dtype=kv_dtype)
+            gr = eng.add_request(prompt, **spec)
+            got = list(eng.run()[gr].token_ids)
+            assert got == ref  # spec-on == spec-off, quantized or not
+            drafted = reg.get(
+                "paddle_tpu_serving_spec_drafted_tokens_total").value - d0
+            accepted = reg.get(
+                "paddle_tpu_serving_spec_accepted_tokens_total").value - a0
+            return accepted / max(drafted, 1.0)
+
+        r_f32 = run("float32")
+        r_int8 = run("int8")
+        # quantization noise may flip a borderline draft either way; it
+        # must not collapse acceptance (docs/SERVING.md tolerance note)
+        assert r_int8 >= r_f32 - 0.25
+
+    def test_scale_clip_counter_fires_on_underflow(self):
+        """KV whose absmax underflows KV_SCALE_FLOOR * 127 clamps its
+        scale at the floor — dynamic range collapsed — and the pool's
+        clip counter must say so."""
+        from paddle_tpu import metrics
+
+        pool = _pool()
+        tiny = np.full((4, 2, 8), KV_SCALE_FLOOR * 10.0, np.float32)
+        big = np.ones((4, 2, 8), np.float32)
+        fam = metrics.get_registry().get(
+            "paddle_tpu_serving_kv_dequant_scale_clip_total")
+        c0 = fam.labels(engine_id="", model_id="").value
+        pool.allocate("a", 4)
+        pool.write_prompt_kv("a", [(tiny, big)])
+        # 4 positions x 2 heads x 1 layer, K side only
+        assert fam.labels(engine_id="", model_id="").value - c0 == 8
+
+    def test_sizing_math_derives_from_kv_dtype(self):
+        """page_bytes/pages_for_hbm_budget must price the ACTUAL page
+        dtype: bf16 = 2 B/elem, int8 = 1 B/elem + 4 B/slot f32 scale —
+        and at head_dim 128 the int8 page is >= 1.9x smaller, which is
+        where the bench's users/chip headroom comes from."""
+        bf16 = page_bytes(16, 32, 128, 32, kv_dtype="bf16")
+        i8 = page_bytes(16, 32, 128, 32, kv_dtype="int8")
+        assert bf16 == 8 * 2 ** 20  # the docs/SERVING.md worked example
+        assert bf16 / i8 >= 1.9
+        assert (pages_for_hbm_budget(10 * 2 ** 30, 16, 32, 128, 32,
+                                     kv_dtype="int8")
+                > pages_for_hbm_budget(10 * 2 ** 30, 16, 32, 128, 32,
+                                       kv_dtype="bf16"))
+        with pytest.raises(ValueError):
+            page_bytes(16, 32, 128, 32, dtype_bytes=2, kv_dtype="int8")
+
+    def test_compile_surface_pinned_with_quantization(self):
+        """int8 + spec + grammar armed: step == step_buckets — the
+        quantized arrays ride the ONE program as data."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            spec_k=3, kv_dtype="int8")
+        rng = np.random.RandomState(3)
+        for n, new in ((4, 2), (6, 4), (3, 3), (5, 5)):
+            eng.add_request(rng.randint(0, 128, (n,)), max_new_tokens=new)
+            eng.step()
+        eng.run()
+        c = eng.compile_counts()
+        assert c["step"] == c["step_buckets"], c
+
+
+# ──────────────────────────── host page tier ────────────────────────────
+
+
+class TestHostTier:
+    @pytest.mark.parametrize("dtype", ["float32", "int8"])
+    def test_offload_prefetch_roundtrip_bit_exact(self, dtype):
+        """Park moves exclusively-owned written pages to the host store
+        and releases HBM; prefetch scatters the SAME bytes (and scales)
+        back — np.array_equal, not allclose."""
+        pool = _pool(dtype=dtype, layers=2)
+        rng = np.random.default_rng(4)
+        k, v = _rand_kv(rng, 7)
+        pool.allocate("a", 7, max_total_tokens=12)
+        pool.write_prompt_kv("a", [(k, v), (v, k)])
+        table = np.asarray(pool.block_table("a"))
+        before = [np.asarray(pool.k_pools[li]._value[table])
+                  for li in range(2)]
+        before_s = ([np.asarray(pool.k_scales[li]._value[table])
+                     for li in range(2)] if pool.quantized else None)
+        used0 = pool.used_pages
+        n = pool.offload_seq("a")
+        assert n == 2 and pool.offloaded_pages("a") == 2
+        assert pool.used_pages == used0 - 2
+        assert all(p == 0 for p in pool.block_table("a"))  # sentinels
+        m = pool.prefetch_seq("a")
+        assert m == 2 and pool.offloaded_pages("a") == 0
+        t2 = np.asarray(pool.block_table("a"))
+        for li in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(pool.k_pools[li]._value[t2]), before[li])
+            if before_s is not None:
+                np.testing.assert_array_equal(
+                    np.asarray(pool.k_scales[li]._value[t2]), before_s[li])
+        pool.free("a")
+        assert pool.used_pages == 0 and pool.offloaded_pages() == 0
+
+    def test_offload_releases_reservation_for_admission(self):
+        """Parked tenants are honest capacity: a head request can_admit
+        only AFTER the victim's pages + unwritten tail move out, and
+        can_prefetch re-checks the same arithmetic for the way back."""
+        pool = _pool(pages=6)  # 5 usable
+        rng = np.random.default_rng(5)
+        k, v = _rand_kv(rng, 8)
+        pool.allocate("victim", 8, max_total_tokens=16)  # 2 written + 2 tail
+        pool.write_prompt_kv("victim", [(k, v)])
+        assert not pool.can_admit(12)  # 3 pages wanted, 1 spare
+        assert pool.offload_seq("victim") == 2
+        assert pool.can_admit(12)  # tail reservation released too
+        pool.allocate("head", 12)
+        assert not pool.can_prefetch("victim")  # head holds the pages
+        pool.free("head")
+        assert pool.can_prefetch("victim")
+        pool.prefetch_seq("victim")
+        assert pool.seq_len("victim") == 8
+        # the journaled worst-case tail is re-assumed
+        assert not pool.can_admit(12)
+
+    def test_operations_on_offloaded_seq_raise(self):
+        pool = _pool()
+        pool.allocate("a", 5)
+        pool.write_prompt_kv("a", [_rand_kv(np.random.default_rng(6), 5)])
+        pool.offload_seq("a")
+        with pytest.raises(RuntimeError, match="offloaded"):
+            pool.extend("a", 6)
+        with pytest.raises(RuntimeError, match="offloaded"):
+            pool.fork("a", "b")
+        pool.free("a")  # freeing a parked seq drops host entries too
+        assert pool.offloaded_pages() == 0 and pool.used_pages == 0
+
+    def test_tier_gauge_and_flow_counters(self):
+        from paddle_tpu import metrics
+
+        pool = _pool()
+        reg = metrics.get_registry()
+
+        def gauge(tier):
+            return reg.get("paddle_tpu_serving_kv_page_tier").labels(
+                tier=tier, engine_id="", model_id="").value
+
+        off0 = reg.get(
+            "paddle_tpu_serving_kv_offload_pages_total").labels(
+                engine_id="", model_id="").value
+        pool.allocate("a", 7)
+        pool.write_prompt_kv("a", [_rand_kv(np.random.default_rng(7), 7)])
+        pool.offload_seq("a")
+        assert gauge("host") == 2.0 and gauge("hbm") == 0.0
+        assert reg.get(
+            "paddle_tpu_serving_kv_offload_pages_total").labels(
+                engine_id="", model_id="").value - off0 == 2
+        pool.prefetch_seq("a")
+        assert gauge("host") == 0.0 and gauge("hbm") == 2.0
+        pool.free("a")
+
+    def test_engine_parks_under_pressure_instead_of_waiting(self):
+        """Offload-before-reject, end to end: a page-starved engine
+        parks the cold low-priority stream, the urgent head admits
+        against the reclaimed capacity and finishes FIRST, the victim
+        unparks and completes — bit-identical to an uncontended run —
+        and every prefetch landed before the slot's next step (the late
+        counter never moves)."""
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=3,
+                            num_pages=8, host_offload=True,
+                            kv_dtype="int8")
+        late0 = _counter("paddle_tpu_serving_kv_prefetch_late_total", eng)
+        lo = eng.add_request(np.arange(1, 9), max_new_tokens=10, priority=5)
+        eng.step(); eng.step()
+        hi = eng.add_request(np.arange(2, 10), max_new_tokens=4, priority=0)
+        parked_seen = False
+        hi_done_while_lo_live = False
+        outs = {}
+        for _ in range(60):
+            for o in eng.step():
+                outs[o.req_id] = o
+            if eng.pool.offloaded_pages(lo):
+                parked_seen = True
+            if hi in outs and lo not in outs:
+                hi_done_while_lo_live = True
+            if not eng.has_work:
+                break
+        assert parked_seen, "pressure never parked the victim"
+        assert hi_done_while_lo_live, "urgent request did not overtake"
+        assert outs[lo].n_gen == 10 and outs[hi].n_gen == 4
+        assert _counter("paddle_tpu_serving_kv_prefetch_late_total",
+                        eng) == late0
+        assert eng.pool.used_pages == 0 and eng.pool.offloaded_pages() == 0
+        c = eng.compile_counts()
+        assert c["step"] == c["step_buckets"], c
+        # park/unpark must not perturb the stream
+        m2 = _llama()
+        solo = ServingEngine(m2, page_size=4, max_batch_slots=3,
+                             kv_dtype="int8")
+        sr = solo.add_request(np.arange(1, 9), max_new_tokens=10)
+        ref = list(solo.run()[sr].token_ids)
+        assert list(outs[lo].token_ids) == ref
+
+    def test_park_unpark_public_api(self):
+        model = _llama()
+        eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            host_offload=True, kv_dtype="int8")
+        rid = eng.add_request(np.arange(1, 9), max_new_tokens=6)
+        eng.step(); eng.step()
+        n = eng.park_request(rid)
+        assert n > 0 and eng.pool.offloaded_pages(rid) == n
+        assert eng.park_request(rid) == 0  # idempotent
+        eng.step()  # parked slot contributes zero rows; nothing breaks
+        assert eng.unpark_request(rid) == n
+        outs = eng.run()
+        assert outs[rid].n_gen == 6
+        # disabled engines refuse: the tier is opt-in
+        e2 = ServingEngine(_llama(), page_size=4, max_batch_slots=2)
+        r2 = e2.add_request(np.arange(1, 5), max_new_tokens=1)
+        e2.step()
+        with pytest.raises(RuntimeError, match="host_offload"):
+            e2.park_request(r2)
+        e2.run()

@@ -57,6 +57,12 @@ of fleet timeline from crash containment — the dumped file carries the
 victim requests' full per-request timelines with the export → adopt
 migration hop visible and every ``(req_id, seq)`` exactly-once across
 the hop — while the streams stay bit-identical to an uninterrupted run.
+Scenario 18 re-runs the kill drill with the HOST KV TIER armed
+(ISSUE 18): int8 quantized pages on a page-starved pool, the victim
+stream PARKED (its pages in host RAM) at the kill — containment must
+drain the dead engine's HostPageStore, the adoptive (equally starved)
+sibling must re-serve both migrants through its own park/unpark cycle,
+and the streams stay bit-identical with chunks exactly-once.
 Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
@@ -1169,6 +1175,99 @@ def scenario_flight_recorder_on_crash(model):
         shutil.rmtree(flight_dir, ignore_errors=True)
 
 
+def scenario_kill_engine_with_offloaded_pages(model):
+    """Scenario 18 (ISSUE 18): the kill drill with the HOST KV TIER
+    armed. Both replicas run int8 KV pages + host_offload on a
+    page-starved pool, all traffic lands on m/0, and admission pressure
+    PARKS the low-priority stream — its quantized pages live in host RAM
+    — before the engine is killed. Containment must evacuate a parked
+    slot exactly like a resident one (its resume state is only the token
+    journal; host pages are abandoned KV that re-prefills on the
+    sibling), the dead engine's HostPageStore must drain (no leaked host
+    RAM), and the adoptive sibling — just as page-starved — must repeat
+    the park/unpark dance to serve both migrants, with final streams
+    bit-identical to an uncontended lone-engine run and chunks
+    exactly-once."""
+    specs = [(P9, 10, 0.9, 51, 5), (np.concatenate([P5, P3]), 4, 0.7,
+                                    52, 0)]
+    # uncontended oracle: a lone int8 engine with ample pages — park,
+    # migration and re-prefill must all be invisible to the streams
+    ref_eng = ServingEngine(model, page_size=4, max_batch_slots=2,
+                            kv_dtype="int8")
+    ref_ids = [ref_eng.add_request(p, max_new_tokens=n, temperature=t,
+                                   seed=s) for p, n, t, s, _ in specs]
+    ref_outs = ref_eng.run()
+    refs = [list(ref_outs[r].token_ids) for r in ref_ids]
+    _check(any(len(set(toks)) > 1 for toks in refs),
+           "reference run is not actually sampling")
+
+    r = Router()
+    # 7 usable pages vs 5+3 worst-case pages: the two requests can
+    # never be resident together — parking is the only way both serve
+    r.add_model("m", model, replicas=2, page_size=4, num_pages=8,
+                max_batch_slots=3, kv_dtype="int8", host_offload=True)
+    e0, e1 = r.engine("m/0"), r.engine("m/1")
+    chunks = {i: [] for i in range(len(specs))}
+
+    def cb(i):
+        return lambda rid, tk, fin, seq: chunks[i].append((seq, tk))
+
+    off0 = _counter("paddle_tpu_serving_kv_offload_pages_total",
+                    engine_id="m/0", model_id="m")
+    mig0 = _counter("paddle_tpu_router_migrated_total")
+    p0, n0, t0, s0, pr0 = specs[0]
+    lo = e0.add_request(p0, max_new_tokens=n0, temperature=t0, seed=s0,
+                        priority=pr0, stream_cb=cb(0))
+    r.step()
+    r.step()  # lo is decoding and holds the pool's worst-case pages
+    p1, n1, t1, s1, pr1 = specs[1]
+    hi = e0.add_request(p1, max_new_tokens=n1, temperature=t1, seed=s1,
+                        priority=pr1, stream_cb=cb(1))
+    r.step()  # pressure parks lo; hi admits against its pages
+    _check(e0.pool.offloaded_pages(lo) > 0,
+           "pressure never parked the low-priority stream")
+    _check(_counter("paddle_tpu_serving_kv_offload_pages_total",
+                    engine_id="m/0", model_id="m") > off0,
+           "offload counter never moved")
+    with faults.inject("router.engine_step",
+                       raise_=RuntimeError("engine killed while parked"),
+                       times=1, seed=SEED):
+        r.step()  # the kill — a parked slot is among the victims
+    _check(r.states()["m/0"] == "down", "crashed engine not gated down")
+    # the dead engine's host tier must drain with the evacuation: host
+    # RAM holding abandoned quantized pages is a leak, not a tier
+    _check(e0.pool.offloaded_pages() == 0,
+           "dead engine's HostPageStore leaked offloaded pages")
+    _check(e0.pool.used_pages == 0, "dead engine leaked HBM pages")
+    outs = r.run()
+    _check(_counter("paddle_tpu_router_migrated_total") == mig0 + 2,
+           "migrated counter != the 2 in-flight requests at the kill")
+    for i, (rid, ref) in enumerate(zip((lo, hi), refs)):
+        _check(outs[rid].finish_reason == "length",
+               f"request {i} did not complete ({outs[rid].finish_reason})")
+        _check(list(outs[rid].token_ids) == ref,
+               f"request {i} diverged from the uncontended run")
+        toks = [c for c in chunks[i] if c[1] is not None]
+        _check([sq for sq, _ in toks] == list(range(len(ref))),
+               f"request {i} stream chunks duplicated or missing")
+        _check([t for _, t in toks] == ref,
+               f"request {i} streamed tokens != final token_ids")
+        _check(chunks[i][-1] == (len(ref), None),
+               f"request {i} missing terminal chunk")
+    _check(e1.pool.used_pages == 0 and e1.pool.offloaded_pages() == 0,
+           "adoptive engine leaked pages across its own park/unpark")
+    _check(_counter("paddle_tpu_serving_kv_prefetch_late_total",
+                    engine_id="m/1", model_id="m") == 0,
+           "a prefetch landed late inside the step path on the sibling")
+    _check(r._requeued == set(), "move-once marks leaked after the drill")
+    counts = e1.compile_counts()
+    _check(counts["step"] == counts["step_buckets"],
+           "quantized step recompiled on the adoptive engine")
+    return ("m/0 killed with a PARKED int8 stream: host store drained, "
+            "both migrants re-served through m/1's own park/unpark, "
+            "streams bit-identical, chunks exactly-once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -1189,6 +1288,8 @@ SCENARIOS = [
     ("kill-engine-mid-constrained-adapter-stream",
      scenario_kill_engine_mid_constrained_adapter_stream),
     ("flight-recorder-on-crash", scenario_flight_recorder_on_crash),
+    ("kill-engine-with-offloaded-pages",
+     scenario_kill_engine_with_offloaded_pages),
 ]
 
 
