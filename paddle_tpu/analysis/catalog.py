@@ -58,10 +58,11 @@ __all__ = [
 _METRIC_TOKEN_RE = re.compile(
     r"^(paddle_tpu_[a-zA-Z0-9_]+)(\{([a-zA-Z0-9_,\s]*)\})?$")
 _FAULT_TOKEN_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
-# trace events are namespaced req./step. — disjoint from fault tokens
-# only by convention, so the event catalog lives in OBSERVABILITY.md
-# (TPL010) while fault points live in RESILIENCE.md (TPL004)
-_EVENT_TOKEN_RE = re.compile(r"^(req|step)\.[a-z][a-z0-9_]*$")
+# trace events are namespaced req./step./brownout. — disjoint from
+# fault tokens only by convention, so the event catalog lives in
+# OBSERVABILITY.md (TPL010) while fault points live in RESILIENCE.md
+# (TPL004)
+_EVENT_TOKEN_RE = re.compile(r"^(req|step|brownout)\.[a-z][a-z0-9_]*$")
 _TRACER_RECEIVER_RE = re.compile(r"^_?tracer?$")
 _BACKTICK_RE = re.compile(r"`([^`]+)`")
 _REGISTRY_RECEIVER_RE = re.compile(r"^_?reg(istry)?$", re.IGNORECASE)

@@ -5,7 +5,7 @@ item 5): where ``tools/chaos_serve.py`` proves correctness under
 faults, loadgen measures behavior under production-shaped load — and
 closes the elasticity loop.
 
-Three pieces (one module each):
+Four pieces (one module each):
 
 - :mod:`~paddle_tpu.loadgen.trace` — seeded, deterministic request
   streams: Zipf-shared prompt prefixes (exercises the radix prefix
@@ -21,6 +21,10 @@ Three pieces (one module each):
   :class:`QueueDepthAutoscaler` driving ``router.add_engine`` /
   ``drain`` / ``remove_engine`` with hysteresis + cooldown; scale-down
   strictly drain-then-remove, so no request is ever dropped.
+- :mod:`~paddle_tpu.loadgen.chaos` — a seeded :class:`FaultSchedule`
+  (engine kills with timed revival, injected step latency) riding the
+  trace replay on the same virtual clock, so ``LoadReport`` scores
+  goodput-under-chaos deterministically (ISSUE 19).
 
 Quick drill::
 
@@ -42,12 +46,14 @@ the scaling state machine; docs/OBSERVABILITY.md catalogs the
 ``paddle_tpu_loadgen_*`` / ``paddle_tpu_autoscaler_*`` families.
 """
 from .autoscaler import AutoscalerConfig, QueueDepthAutoscaler
+from .chaos import FaultEvent, FaultSchedule
 from .driver import LoadDriver, LoadReport, TierReport
 from .trace import (DEFAULT_TIERS, TierSpec, Trace, TraceConfig,
                     TraceRequest, VirtualClock, generate_trace, zipf_pmf)
 
 __all__ = [
     "AutoscalerConfig", "QueueDepthAutoscaler",
+    "FaultEvent", "FaultSchedule",
     "LoadDriver", "LoadReport", "TierReport",
     "DEFAULT_TIERS", "TierSpec", "Trace", "TraceConfig", "TraceRequest",
     "VirtualClock", "generate_trace", "zipf_pmf",

@@ -36,6 +36,7 @@ themselves.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -44,6 +45,7 @@ import numpy as np
 
 from .. import metrics
 from ..serving import tracing
+from ..serving.overload import AdmissionShedError
 from ..serving.router import NoHealthyEngineError
 from ..serving.scheduler import BackpressureError
 from .trace import Trace, VirtualClock
@@ -51,8 +53,11 @@ from .trace import Trace, VirtualClock
 __all__ = ["LoadDriver", "LoadReport", "TierReport"]
 
 # outcomes a trace request can score (finish reasons + driver-side ones)
+# — "shed" is driver-side (refused at admission by the overload
+# controller, a terminal answer unlike the retried "rejected" 429),
+# "expired" is the engine finish reason for queued deadline lapses
 OUTCOMES = ("stop", "length", "timeout", "cancelled", "nan", "error",
-            "unavailable", "rejected", "lost")
+            "unavailable", "rejected", "lost", "shed", "expired")
 
 
 @dataclass
@@ -93,6 +98,11 @@ class LoadReport:
     outcomes: Dict[str, int] = field(default_factory=dict)
     unavailable_rate: float = 0.0
     timeout_rate: float = 0.0
+    # overload outcomes (ISSUE 19): fractions of the trace shed at
+    # admission / expired while queued — the price the overload
+    # controller paid, reported next to the attainment it bought
+    shed_rate: float = 0.0
+    expired_rate: float = 0.0
     rejected: int = 0
     tiers: Dict[str, TierReport] = field(default_factory=dict)
     prefix_hit_ratio: Optional[float] = None   # delta hits/(hits+misses)
@@ -122,7 +132,7 @@ class _RequestRecord:
     """One trace request's stream trail, written by its callback."""
 
     __slots__ = ("trace_req", "rid", "t_submit", "t_first", "t_prev",
-                 "seqs", "terminals", "attempts")
+                 "seqs", "terminals", "attempts", "shed")
 
     def __init__(self, trace_req):
         self.trace_req = trace_req
@@ -133,6 +143,7 @@ class _RequestRecord:
         self.seqs: List[int] = []
         self.terminals: List[tuple] = []   # (reason, seq)
         self.attempts = 0
+        self.shed = False   # refused at admission by overload control
 
 
 class LoadDriver:
@@ -147,7 +158,15 @@ class LoadDriver:
     ``submit_retries`` bounds how many sweeps a 429/503-rejected
     request retries before scoring ``rejected``; ``settle_steps``
     bounds the post-drain idle phase that lets an attached autoscaler
-    shrink the fleet back to ``min_engines``."""
+    shrink the fleet back to ``min_engines``.
+
+    ``overload`` is an optional
+    :class:`~paddle_tpu.serving.overload.OverloadController` — ticked
+    once per sweep exactly like the autoscaler. ``fault_schedule`` is
+    an optional :class:`~.chaos.FaultSchedule`: its events fire as the
+    virtual clock sweeps past their instants, so the same seed replays
+    the same incident (chaos-in-the-loop; latency injections are
+    disarmed when the run ends, success or raise)."""
 
     def __init__(self, router, trace: Trace,
                  model: Optional[str] = None,
@@ -157,11 +176,15 @@ class LoadDriver:
                  max_steps: int = 20000,
                  settle_steps: int = 400,
                  clock: Optional[VirtualClock] = None,
-                 tokenizer=None):
+                 tokenizer=None,
+                 overload=None,
+                 fault_schedule=None):
         self._router = router
         self._trace = trace
         self._model = model
         self._scaler = autoscaler
+        self._overload = overload
+        self._schedule = fault_schedule
         # grammar patterns in the trace are strings; compile each ONCE
         # against the tokenizer (default: the toy tokenizer over the
         # trace's vocab) and reuse — interning on the engine side then
@@ -237,6 +260,13 @@ class LoadDriver:
 
     # -------------------------------------------------------------- driving
     def run(self) -> LoadReport:
+        # the ExitStack owns every fault injection the schedule arms:
+        # whatever happens mid-run, the process-global fault registry
+        # is clean when run() returns
+        with contextlib.ExitStack() as stack:
+            return self._run(stack)
+
+    def _run(self, stack) -> LoadReport:
         router, trace = self._router, self._trace
         recs = [_RequestRecord(r) for r in trace.requests]
         pending: List[_RequestRecord] = []   # due, awaiting admission
@@ -256,6 +286,8 @@ class LoadDriver:
                 break
             self._clock.advance(self._step_dt)
             now_v = self._clock.now()
+            if self._schedule is not None:
+                self._schedule.apply(router, self._model, now_v, stack)
             while (next_i < len(recs)
                    and recs[next_i].trace_req.arrival_s <= now_v):
                 pending.append(recs[next_i])
@@ -274,23 +306,40 @@ class LoadDriver:
                 self._scaler.observe()
                 engines_peak = max(engines_peak,
                                    len(router.handles(self._model)))
+            if self._overload is not None:
+                self._overload.observe()
             self._collect(router, outputs, dup_outputs)
         wall_s = time.perf_counter() - t0
         self._collect(router, outputs, dup_outputs)
 
         # settle: with the trace drained the signal goes cold — give an
         # attached autoscaler bounded idle sweeps to drain-then-remove
-        # back to min_engines (scale-down is never instantaneous)
-        if self._scaler is not None:
+        # back to min_engines (scale-down is never instantaneous), and
+        # an attached overload controller bounded sweeps to walk the
+        # brownout ladder back to level 0 (de-escalation is paced by
+        # cold_steps + cooldown, never instantaneous either)
+        if self._scaler is not None or self._overload is not None:
             for _ in range(self._settle_steps):
-                at_floor = (len(router.handles(self._model))
-                            <= self._scaler.config.min_engines
-                            and self._scaler._drain_target is None)
-                if at_floor and not router.has_work:
+                at_floor = (self._scaler is None
+                            or (len(router.handles(self._model))
+                                <= self._scaler.config.min_engines
+                                and self._scaler._drain_target is None))
+                restored = (self._overload is None
+                            or self._overload.level == 0)
+                if at_floor and restored and not router.has_work:
                     break
+                if self._schedule is not None:
+                    # keep virtual time flowing so timed revivals of
+                    # killed engines still fire during settle
+                    self._clock.advance(self._step_dt)
+                    self._schedule.apply(router, self._model,
+                                         self._clock.now(), stack)
                 router.step()
                 steps += 1
-                self._scaler.observe()
+                if self._scaler is not None:
+                    self._scaler.observe()
+                if self._overload is not None:
+                    self._overload.observe()
                 self._collect(router, outputs, dup_outputs)
 
         return self._score(recs, rejected, outputs, dup_outputs, deltas,
@@ -320,6 +369,13 @@ class LoadDriver:
                 temperature=tr.temperature, seed=tr.seed,
                 deadline_s=tr.deadline_s, priority=tr.priority,
                 stream_cb=self._make_cb(rec), **kwargs)
+            return True
+        except AdmissionShedError:
+            # a shed is a TERMINAL answer (the controller predicted the
+            # deadline is unmeetable, or the ladder is at
+            # interactive-only) — scoring it, not retrying it, is the
+            # honest-client behavior the retry_after_s contract implies
+            rec.shed = True
             return True
         except (BackpressureError, NoHealthyEngineError):
             self._m_retries.inc()
@@ -359,6 +415,16 @@ class LoadDriver:
             if id(rec) in rejected_set:
                 outcome = "rejected"
                 rep.rejected += 1
+            elif rec.shed:
+                outcome = "shed"
+                # exactly-once extends to shed: a request the gate
+                # refused must have NO engine-side life at all
+                if rec.rid is not None or rec.seqs or rec.terminals:
+                    rep.violations.append(
+                        f"trace #{rec.trace_req.index}: shed at "
+                        f"admission but has engine-side state "
+                        f"(rid={rec.rid!r}, {len(rec.seqs)} tokens, "
+                        f"{len(rec.terminals)} terminals)")
             elif rec.rid is None:
                 # due but never admitted before the step cap — the run
                 # was truncated, not the fleet's fault; score it lost
@@ -375,12 +441,34 @@ class LoadDriver:
         n = len(recs)
         rep.unavailable_rate = rep.outcomes.get("unavailable", 0) / n
         rep.timeout_rate = rep.outcomes.get("timeout", 0) / n
+        rep.shed_rate = rep.outcomes.get("shed", 0) / n
+        rep.expired_rate = rep.outcomes.get("expired", 0) / n
         rep.goodput_tok_s = (rep.goodput_tokens / wall_s
                              if wall_s > 0 else 0.0)
+        # TTFT attainment is EXACT — counted from the per-request
+        # timestamps the driver holds, not read back through the
+        # histogram (whose x2 exponential buckets interpolate: an SLO
+        # bound inside a bucket would credit observations fractionally,
+        # smearing a crisp count into a value that wobbles with bucket
+        # geometry). ITL attainment stays a histogram read: thousands
+        # of observations per tier make the interpolation error
+        # negligible, and holding every gap would cost real memory.
+        ttft_ok: Dict[str, int] = {}
+        ttft_n: Dict[str, int] = {}
+        for rec in recs:
+            if rec.t_first is None:
+                continue
+            tier = rec.trace_req.tier
+            ttft_n[tier] = ttft_n.get(tier, 0) + 1
+            if (rec.t_first - rec.t_submit
+                    <= rep.tiers[tier].ttft_slo_s):
+                ttft_ok[tier] = ttft_ok.get(tier, 0) + 1
         for name, tr in rep.tiers.items():
             h_ttft = self._m_ttft.labels(tier=name)
             h_itl = self._m_itl.labels(tier=name)
-            tr.ttft_attainment = h_ttft.fraction_le(tr.ttft_slo_s)
+            if ttft_n.get(name):
+                tr.ttft_attainment = (ttft_ok.get(name, 0)
+                                      / ttft_n[name])
             tr.itl_attainment = h_itl.fraction_le(tr.itl_slo_s)
             tr.ttft_p95_s = h_ttft.quantile(0.95)
 

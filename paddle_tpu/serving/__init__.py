@@ -42,6 +42,8 @@ from .engine import ServingEngine
 from .grammar import GrammarFSM, ToyTokenizer, schema_to_regex, toy_tokenizer
 from .kv_cache import (HostPageStore, PagedKVCachePool, PrefixCache,
                        normalize_kv_dtype, page_bytes, pages_for_hbm_budget)
+from .overload import (AdmissionShedError, DrainEstimator, OverloadConfig,
+                       OverloadController, RetryBudget)
 from .router import EngineHandle, NoHealthyEngineError, Router
 from .scheduler import (BackpressureError, FCFSScheduler, Request,
                         RequestOutput)
@@ -59,4 +61,6 @@ __all__ = [
     "toy_tokenizer", "schema_to_regex",
     "RequestTracer", "TTFT_BUCKETS", "attribute_ttft", "get_tracer",
     "set_tracer", "validate_events",
+    "OverloadController", "OverloadConfig", "DrainEstimator",
+    "AdmissionShedError", "RetryBudget",
 ]

@@ -80,7 +80,8 @@ from ..tensor import Tensor
 from . import tracing
 from .adapters import AdapterStore
 from .kv_cache import PagedKVCachePool, PrefixCache
-from .scheduler import FCFSScheduler, Request, RequestOutput
+from .scheduler import (BackpressureError, FCFSScheduler, Request,
+                        RequestOutput)
 from .spec import NGramDrafter
 
 __all__ = ["ServingEngine"]
@@ -369,6 +370,17 @@ class ServingEngine:
         # EWMA of step wall-time: the drain-rate estimate behind
         # BackpressureError.retry_after_s (seeded at a plausible 50 ms)
         self._avg_step_s = 0.05
+        # the ONE shared queue-drain predictor (docs/RESILIENCE.md
+        # "Overload & brownout"): backs BOTH the backpressure
+        # retry_after_s hint and the overload admission gate, so the
+        # hint and the shed decision can never disagree. Imported
+        # lazily: overload -> router -> engine would cycle at module
+        # import time.
+        from .overload import DrainEstimator
+        self._estimator = DrainEstimator()
+        # OverloadController attached by overload.attach(); None = stock
+        # behavior (no admission gate, no brownout actions)
+        self._overload = None
         self.slots: List[Optional[_SeqState]] = [None] * self.max_batch_slots
         # THE unified step program: one StaticFunction whose signature
         # cache holds one compiled program per token-grid bucket —
@@ -458,9 +470,15 @@ class ServingEngine:
         # telemetry alongside behavior
         self._m_timeouts = reg.counter(
             "paddle_tpu_serving_request_timeouts_total",
-            "Requests retired on deadline expiry "
-            "(finish_reason=\"timeout\"), queued or mid-decode",
+            "Admitted requests retired on deadline expiry mid-stream "
+            "(finish_reason=\"timeout\"); queued expiry counts "
+            "paddle_tpu_serving_expired_total instead",
             labels=_eng).labels(**self._lbl)
+        self._m_expired = reg.counter(
+            "paddle_tpu_serving_expired_total",
+            "QUEUED requests whose deadline lapsed before admission "
+            "(finish_reason=\"expired\"): retired with pages never "
+            "allocated", labels=_eng).labels(**self._lbl)
         self._m_cancels = reg.counter(
             "paddle_tpu_serving_cancellations_total",
             "Requests retired by cancel() (finish_reason=\"cancelled\")",
@@ -498,6 +516,7 @@ class ServingEngine:
             "timeout": self._m_timeouts, "cancelled": self._m_cancels,
             "nan": self._m_nan_quarantines, "error": self._m_req_errors,
             "unavailable": self._m_unavailable,
+            "expired": self._m_expired,
         }
         # multi-LoRA + constrained-decoding instruments (ISSUE 16,
         # docs/OBSERVABILITY.md): tenancy split per adapter name, store
@@ -648,6 +667,17 @@ class ServingEngine:
                       adapter_id=adapter_id, grammar=grammar)
         self.check_request(req.prompt.size, req.max_new_tokens)
         self._check_features(req)
+        if self._overload is not None:
+            # deadline-aware admission (docs/RESILIENCE.md "Overload &
+            # brownout"): shed doomed work BEFORE it enters the queue.
+            # Only fresh submits are gated — adopt_request (failover of
+            # already-accepted work) bypasses on purpose.
+            try:
+                self._overload.admission_check(self, req)
+            except BackpressureError:
+                self._m_requests.labels(event="rejected",
+                                        **self._lbl).inc()
+                raise
         try:
             self.scheduler.add(req)
         except Exception:
@@ -698,8 +728,10 @@ class ServingEngine:
         """Backpressure hint: admission drains roughly one request per
         step per free slot, so a full queue clears in about
         ``queue_depth x avg_step_time`` — rounded up to a 50 ms floor so
-        clients never busy-spin on a hot engine."""
-        return max(0.05, self.scheduler.queue_depth * self._avg_step_s)
+        clients never busy-spin on a hot engine. Delegates to the ONE
+        shared :class:`~.overload.DrainEstimator` so this hint and the
+        overload admission gate agree by construction."""
+        return self._estimator.for_engine(self)
 
     @property
     def has_work(self) -> bool:
@@ -946,6 +978,13 @@ class ServingEngine:
             faults.point("serving.step")
             with RecordEvent("engine_step"):
                 finished.extend(self._sweep_deadlines())
+                if self._overload is not None:
+                    # brownout level >= 3: preempt batch-tier decode
+                    # slots (journal + requeue, the migration move
+                    # turned inward) — BEFORE admission so the freed
+                    # slots and pages are available to interactive
+                    # work this very step
+                    self._brownout_enforce()
                 if self._host_offload:
                     # page pressure relief BEFORE admission: parking a
                     # cold low-priority slot moves its pages (and its
@@ -955,7 +994,10 @@ class ServingEngine:
                     # prefix cache gets evicted for the same pages
                     self._park_for_pressure()
                 free = sum(1 for s in self.slots if s is None)
-                for req in self.scheduler.admit(free, self.pool):
+                _cap = (None if self._overload is None
+                        else self._overload.admit_priority_cap())
+                for req in self.scheduler.admit(free, self.pool,
+                                                max_priority=_cap):
                     self._m_requests.labels(event="admitted", **self._lbl).inc()
                     try:
                         # an admission failure (cache/alloc fault,
@@ -1094,11 +1136,62 @@ class ServingEngine:
                                    cached_pages=cached):
                 return
 
+    def _brownout_enforce(self) -> None:
+        """Brownout ladder level >= 3 (``batch-parked``): preempt every
+        live batch-tier decode slot — journal its generated tokens onto
+        the Request (:meth:`export_inflight`'s move, turned inward),
+        free its pages AND its slot, and requeue it behind higher
+        tiers. Host-tier parking keeps the slot (it frees pages only),
+        which is exactly wrong when slots are the scarce resource under
+        overload; the journal costs a chunked re-prefill on restore —
+        which the prefix cache largely covers — and buys a whole slot.
+
+        Restoration is ordinary admission: the requeued request carries
+        ``resume_tokens``, the ladder's admission hold (level >= 3
+        holds the batch tier; see ``FCFSScheduler.admit``) keeps it
+        queued until de-escalation, and the resumed stream is
+        token-identical (sampling is keyed on (seed, position), never
+        on the slot) — the same contract migration already proves.
+        A preemption that would overflow the bounded queue is skipped:
+        a stream is never dropped to make room for one.
+
+        The victim set widens with the ladder
+        (``OverloadController.preempt_priority_cut``): ``batch-parked``
+        evicts the batch tier; ``interactive-only`` evicts every
+        non-interactive tier."""
+        cut = self._overload.preempt_priority_cut()
+        if cut is None:
+            return
+        sched = self.scheduler
+        for i, st in enumerate(self.slots):
+            if (st is None or st.parked or st.prefilling
+                    or st.req.priority < cut):
+                continue
+            if (sched.max_queue is not None
+                    and len(sched.waiting) >= sched.max_queue):
+                return
+            self.slots[i] = None
+            try:
+                if self.pool.has_seq(st.req.req_id):
+                    self.pool.free(st.req.req_id)
+            except Exception:
+                pass  # pool fault: the journal must still requeue
+            st.req.resume_tokens = list(st.gen)
+            if st.fsm is not None:
+                st.req.resume_fsm_state = st.fsm_state
+            self._grammar_release(st)
+            self._trace.emit("req.preempt", st.req.req_id,
+                             arg=float(len(st.req.resume_tokens)),
+                             label=self.engine_id)
+            self._m_requests.labels(event="preempted", **self._lbl).inc()
+            sched.add(st.req)
+
     def _unpark_ready(self) -> None:
         """Restore parked tenants whose pages fit again, highest
         priority / oldest first. Anti-thrash: when the queue still has a
         head, an unpark must leave that head's worst case admittable —
-        otherwise the next step would park the same slot right back."""
+        otherwise the next step would park the same slot right back.
+        Manual parks never auto-restore."""
         parked = [(st.req.priority, st.req.arrival_t, st.req.req_id)
                   for st in self.slots
                   if st is not None and st.parked == "auto"]
@@ -1231,13 +1324,26 @@ class ServingEngine:
         return self._emit_terminal(req, st.gen, reason, error)
 
     def _sweep_deadlines(self) -> List[RequestOutput]:
-        """Retire every over-deadline request — queued, mid-prefill, or
-        mid-decode — with ``finish_reason="timeout"``; runs at the top of
-        each step so an overloaded queue sheds load instead of serving
-        stale work."""
+        """Retire every over-deadline request; runs at the top of each
+        step so an overloaded queue sheds load instead of serving stale
+        work. Still-QUEUED requests retire ``finish_reason="expired"``
+        — their deadline lapsed while waiting, pages never allocated —
+        while admitted (mid-prefill / mid-decode) requests retire
+        ``"timeout"`` with the tokens generated so far. The split keeps
+        the overload story honest: ``expired`` counts work the fleet
+        never touched, ``timeout`` counts work it started but could not
+        finish in time. A queued request carrying a journal (migrated
+        or brownout-preempted — the fleet DID touch it) therefore
+        retires ``"timeout"``, keeping ``expired`` an exact count of
+        never-admitted work."""
         finished: List[RequestOutput] = []
         for req in self.scheduler.pop_expired():
-            finished.append(self._finish_queued(req, "timeout"))
+            if req.resume_tokens is not None:
+                finished.append(self._finish_queued(req, "timeout"))
+                continue
+            self._trace.emit("req.expire", req.req_id,
+                             label=self.engine_id)
+            finished.append(self._finish_queued(req, "expired"))
         for i, st in enumerate(self.slots):
             if (st is not None and st.req.deadline is not None
                     and st.req.deadline.expired()):
@@ -1524,7 +1630,16 @@ class ServingEngine:
                 prefill_info.append((i, int(st.ids.size) - st.pos, st.req))
             else:
                 decode_idx.append(i)
-        chunks = self.scheduler.plan_chunks(len(decode_idx), prefill_info)
+        # brownout hooks (overload.OverloadController): both are pure
+        # planning data — chunk sizes and draft gating never touch the
+        # compiled step's shape set, so the compile surface is invariant
+        # across every ladder level
+        _ovl = self._overload
+        chunks = self.scheduler.plan_chunks(
+            len(decode_idx), prefill_info,
+            batch_cap=None if _ovl is None else _ovl.chunk_cap(),
+            batch_priority=(2 if _ovl is None
+                            else _ovl.config.batch_priority))
         for i, c in chunks:
             self._trace.emit("req.chunk_planned",
                              self.slots[i].req.req_id, arg=float(c))
@@ -1538,7 +1653,8 @@ class ServingEngine:
         # decode emits >= 1, hence remaining-1) or the request's page
         # reservation / context window.
         drafts: Dict[int, np.ndarray] = {}
-        if self.drafter is not None and decode_idx:
+        if (self.drafter is not None and decode_idx
+                and not (_ovl is not None and _ovl.drafts_paused)):
             leftover = (self.token_budget - len(decode_idx)
                         - sum(c for _, c in chunks))
             if leftover > 0:

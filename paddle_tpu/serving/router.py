@@ -151,7 +151,14 @@ class Router:
     rolling version isolation: with a shared model every replica flips to
     the new weights at the first restore)."""
 
-    def __init__(self):
+    def __init__(self, retry_budget=None):
+        """``retry_budget`` (an :class:`~.overload.RetryBudget`) gates
+        failover requeue/migration placements per model so an incident
+        storm can't amplify load — each placement spends one token,
+        :meth:`step` refills, and a dry bucket retires the request
+        ``"unavailable"`` immediately (fail fast, never a retry loop).
+        None (the default) keeps retries unmetered."""
+        self._retry_budget = retry_budget
         self._models: Dict[str, List[EngineHandle]] = {}
         self._handles: Dict[str, EngineHandle] = {}
         self._rr: Dict[str, int] = {}          # per-model tie-break cursor
@@ -214,6 +221,12 @@ class Router:
             "paddle_tpu_router_engine_state",
             "Router gate state per engine: 0 healthy, 1 degraded, "
             "2 draining, 3 down", labels=("engine_id", "model_id"))
+        self._m_budget_exhausted = reg.counter(
+            "paddle_tpu_router_retry_budget_exhausted_total",
+            "Failover placements refused because the model's retry "
+            "budget was dry (the request retired \"unavailable\" "
+            "instead of joining a requeue/migration storm)",
+            labels=("model_id",))
 
     # ------------------------------------------------------------- topology
     def add_model(self, model_id: str, model, replicas: int = 1,
@@ -530,6 +543,15 @@ class Router:
         move (no healthy engine, target refused, already moved) retires
         ``"unavailable"`` — never dropped, never duplicated."""
         for req in reqs:
+            if (self._retry_budget is not None
+                    and not self._retry_budget.try_take(h.model_id)):
+                # retry budget dry: an incident storm is re-dispatching
+                # faster than the bucket refills — fail fast instead of
+                # amplifying the overload with another placement
+                self._m_budget_exhausted.labels(
+                    model_id=h.model_id).inc()
+                self._retire_unavailable(h, req)
+                continue
             target: Optional[EngineHandle] = None
             if req.req_id not in self._requeued:
                 try:
@@ -621,6 +643,8 @@ class Router:
         requeue and its in-flight requests migrate by token journal,
         and the sweep continues with the next engine. A single engine
         death is invisible to every other tenant of the fleet."""
+        if self._retry_budget is not None:
+            self._retry_budget.refill()  # one sweep's worth of tokens
         self._refresh_health()
         for h in list(self._handles.values()):
             if h.state == DOWN:

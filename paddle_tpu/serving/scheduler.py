@@ -203,7 +203,8 @@ class RequestOutput:
     token_ids: List[int]            # generated tokens (incl. eos if hit)
     # "stop" (eos) | "length" | "timeout" | "cancelled" | "nan"
     # (quarantined) | "error" | "unavailable" (router requeue impossible)
-    # — docs/SERVING.md has the full table
+    # | "expired" (deadline lapsed while still queued — pages never
+    # allocated) — docs/SERVING.md has the full table
     finish_reason: str
     n_gen: int = 0
     error: Optional[str] = None     # diagnostic for finish_reason="error"
@@ -360,7 +361,8 @@ class FCFSScheduler:
         the queue half of ``ServingEngine.load_score``."""
         return self._pending_steps
 
-    def admit(self, free_slots: int, pool) -> List[Request]:
+    def admit(self, free_slots: int, pool,
+              max_priority: Optional[int] = None) -> List[Request]:
         """Pop the (priority, arrival)-ordered prefix that fits this
         step: free decode slots and worst-case page reservations.
 
@@ -369,7 +371,14 @@ class FCFSScheduler:
         budget, so a 10k-token prompt admits the moment a slot and its
         worst-case pages are available, and its TTFT clock starts
         making progress immediately instead of waiting for an idle
-        step."""
+        step.
+
+        ``max_priority`` is the brownout ladder's admission hold: a head
+        whose priority EXCEEDS it stays queued (and, because the queue
+        is priority-sorted, so does everything behind it — no lower tier
+        can overtake a held one). The held work is not retired: it
+        admits when the ladder steps back down, or falls to the deadline
+        sweep."""
         admitted: List[Request] = []
         # pages promised to THIS step's earlier admissions: the pool only
         # records a reservation when the engine parks the request (after
@@ -382,6 +391,8 @@ class FCFSScheduler:
         pending_cached = 0
         while self.waiting and free_slots > 0:
             req = self.waiting[0]
+            if max_priority is not None and req.priority > max_priority:
+                break  # brownout hold: tiers above the cap stay queued
             # matched prefix pages join the block table by refcount, not
             # by a free-list draw (the probe walks the same radix index
             # the admission will match), so the page charge discounts
@@ -434,7 +445,9 @@ class FCFSScheduler:
         return [c[1] for c in eligible]
 
     def plan_chunks(self, n_decode: int,
-                    prefills: Sequence[Tuple[object, int, Request]]
+                    prefills: Sequence[Tuple[object, int, Request]],
+                    batch_cap: Optional[int] = None,
+                    batch_priority: int = 2
                     ) -> List[Tuple[object, int]]:
         """Slice this step's prompt-chunk work under the shared token
         budget. ``n_decode`` decode tokens are charged FIRST —
@@ -447,7 +460,14 @@ class FCFSScheduler:
         ``[(key, chunk_tokens)]`` in service order, chunks >= 1, for as
         many slots as the budget covers this step. Slots left out simply
         wait — decode retirements free budget within a bounded number of
-        steps, so a prefill can lag but never starves forever."""
+        steps, so a prefill can lag but never starves forever.
+
+        ``batch_cap`` (the brownout ``chunks-capped`` action) caps the
+        PER-STEP chunk of any request at priority >= ``batch_priority``
+        — batch-tier prefills trickle slower so the freed budget serves
+        interactive chunks, but still progress >= 1 token/step (capped,
+        never starved). Chunk sizes are planning data, so any cap value
+        leaves the compile surface untouched."""
         left = max(self.token_budget - int(n_decode), 0)
         plan: List[Tuple[object, int]] = []
         if left <= 0 or not prefills:
@@ -458,10 +478,12 @@ class FCFSScheduler:
                            e[2].deadline.remaining()
                            if e[2].deadline is not None else math.inf,
                            e[2].arrival_t))
-        for key, remaining, _req in order:
+        for key, remaining, req in order:
             if left <= 0:
                 break
             chunk = min(int(remaining), left)
+            if batch_cap is not None and req.priority >= batch_priority:
+                chunk = min(chunk, int(batch_cap))
             if chunk <= 0:
                 continue
             plan.append((key, chunk))

@@ -98,8 +98,18 @@ EVENTS: Dict[str, str] = {
                    "engine_id)",
     "req.migrate": "in-flight request migrated to a sibling (label: "
                    "target engine_id)",
+    "req.shed": "refused at admission by the overload controller "
+                "(arg: predicted wait s; label: cause)",
+    "req.preempt": "batch-tier decode slot journaled and requeued by "
+                   "the brownout ladder (arg: journal length; label: "
+                   "engine_id)",
+    "req.expire": "deadline lapsed while still queued — retired "
+                  "\"expired\", pages never allocated (label: "
+                  "engine_id)",
     "step.tokens": "one engine step (req_id: engine_id; arg: tokens "
                    "landed this step)",
+    "brownout.level": "brownout ladder transition (req_id: model_id; "
+                      "arg: new level; label: level name)",
 }
 
 # TTFT attribution buckets (docs/OBSERVABILITY.md "TTFT attribution"):

@@ -141,6 +141,52 @@ def test_bench_load_row_schema_is_stable():
         "committed artifact carries no TTFT attribution at all"
 
 
+def test_bench_chaos_row_schema_is_stable():
+    """The committed BENCH_CHAOS.json (the overload-drill artifact,
+    ISSUE 19) carries exactly the schema tools/bench_load.py pins: ONE
+    row holding TWO runs of the same seed-0 burst + fault schedule —
+    brownout armed vs control. Latencies are host-dependent; the
+    accounting invariants (exactly-once, zero leaks, compile surface
+    pinned) and the drill's headline claim (the armed run protects the
+    interactive tier strictly better than the unprotected control on
+    the identical storm) are properties of the committed artifact and
+    are asserted by value."""
+    bl = _load("bl_chaos_test", "bench_load.py")
+    with open(os.path.join(REPO, "BENCH_CHAOS.json")) as f:
+        row = json.load(f)
+
+    assert set(row) == set(bl.CHAOS_KEYS)
+    assert row["metric"] == "BENCH_CHAOS"
+    assert row["unit"] == "interactive_ttft_attainment"
+    assert {e["kind"] for e in row["faults"]} == {"latency", "kill"}
+    armed, control = row["armed"], row["control"]
+    for run in (armed, control):
+        assert set(run) == set(bl.CHAOS_RUN_KEYS)
+        # the sacred invariants hold WITH the ladder armed and without
+        assert run["exactly_once"] is True and run["violations"] == []
+        assert run["compile_counts_stable"] is True
+        assert run["leaked_pages"] == 0
+        assert sum(run["outcomes"].values()) == row["num_requests"]
+    # the headline: armed attainment is the row's value, >= 0.90, and
+    # strictly better than the control facing the identical trace+faults
+    assert row["value"] == armed["interactive_ttft_attainment"] >= 0.90
+    assert (armed["interactive_ttft_attainment"]
+            > control["interactive_ttft_attainment"])
+    assert row["vs_baseline"] > 1.0
+    # the mechanism showed up: the ladder climbed to slot preemption and
+    # walked fully back down; doomed work was shed at admission and
+    # queued deadline lapses retired "expired" — while the control,
+    # by construction, never shed or expired anything
+    assert armed["brownout_peak_level"] >= 3
+    assert armed["brownout_final_level"] == 0
+    assert armed["outcomes"].get("shed", 0) > 0
+    assert armed["outcomes"].get("expired", 0) > 0
+    assert control["brownout_peak_level"] == 0
+    assert control["brownout_transitions"] == 0
+    assert control["outcomes"].get("shed", 0) == 0
+    assert armed["shed_rate"] > 0.0 and control["shed_rate"] == 0.0
+
+
 def test_bench_kv_row_schema_is_stable():
     """The committed BENCH_KV.json (the KV-memory-economics artifact,
     ISSUE 18) carries exactly the schema tools/bench_decode.py pins.

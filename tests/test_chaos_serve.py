@@ -1,5 +1,5 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-18 — engine resilience, router failover/reload/dispatch, the
+1-19 — engine resilience, router failover/reload/dispatch, the
 kill-engine-mid-decode migration drill, the prefix-heavy failover
 drill that asserts migrated requests re-prefill through the adoptive
 sibling's prefix cache, the kill-engine-mid-chunked-prefill drill
@@ -23,7 +23,12 @@ kill-engine-with-offloaded-pages drill that kills an engine whose
 victim stream is PARKED on the int8 host KV tier and asserts the dead
 engine's HostPageStore drains while the equally page-starved sibling
 re-serves both migrants through its own park/unpark cycle with
-streams bit-identical) runs as slow-marked
+streams bit-identical, and the brownout-under-burst drill that replays
+a 16x tiered burst plus a step-latency storm and an engine kill
+against a capacity-capped fleet with the OverloadController armed and
+asserts the ladder climbs to batch-slot preemption, sheds doomed work
+at admission, and returns to level 0 with exactly-once accounting and
+zero leaks) runs as slow-marked
 tests instead of
 only by hand, one test per scenario so a regression names its drill.
 

@@ -367,21 +367,28 @@ class TestServingChaos:
         model = _tiny_llama()
         engine = ServingEngine(model, page_size=4, max_batch_slots=1)
         before = _counter("paddle_tpu_serving_request_timeouts_total")
+        before_exp = _counter("paddle_tpu_serving_expired_total")
         live = engine.add_request(np.arange(1, 5), max_new_tokens=4)
         dead = engine.add_request(np.arange(1, 4), max_new_tokens=4,
                                   deadline_s=0.0)  # expired while queued
         engine.step()
+        # queued lapse retires "expired" (ISSUE 19): the fleet never
+        # touched this work — pages never allocated, no tokens owed
+        assert (_counter("paddle_tpu_serving_expired_total")
+                == before_exp + 1)
         assert (_counter("paddle_tpu_serving_request_timeouts_total")
-                == before + 1)
+                == before)
         # now expire the RUNNING request mid-decode (injected clock state:
-        # an already-elapsed deadline)
+        # an already-elapsed deadline) — admitted work stays "timeout"
         engine.slots[0].req.deadline = faults.Deadline(-1.0)
         outs = engine.run()
-        assert outs[dead].finish_reason == "timeout" and outs[dead].n_gen == 0
+        assert outs[dead].finish_reason == "expired" and outs[dead].n_gen == 0
         assert outs[live].finish_reason == "timeout"
         assert 1 <= outs[live].n_gen < 4  # partial tokens delivered
         assert (_counter("paddle_tpu_serving_request_timeouts_total")
-                == before + 2)  # exactly once per event
+                == before + 1)  # exactly once per event
+        assert (_counter("paddle_tpu_serving_expired_total")
+                == before_exp + 1)
         assert engine.pool.used_pages == 0
 
     def test_cancel_while_queued_and_while_decoding(self):

@@ -50,6 +50,22 @@ TIER_KEYS = ("requests", "ttft_slo_s", "itl_slo_s", "ttft_attainment",
 BREAKDOWN_KEYS = ("queue", "compile", "cold_prefill", "warm_prefill",
                   "decode", "migration", "host_overhead")
 
+# --chaos artifact schema (ISSUE 19): one BENCH_CHAOS row holding TWO
+# runs of the SAME seed-0 burst trace + fault schedule against the same
+# capacity-capped fleet — brownout armed vs brownout-off control — so
+# the attainment delta is the overload controller's measured value.
+# tests/test_bench_tools.py pins these against the committed
+# BENCH_CHAOS.json.
+CHAOS_KEYS = ("metric", "value", "unit", "vs_baseline", "config",
+              "device", "seed", "num_requests", "faults", "armed",
+              "control")
+CHAOS_RUN_KEYS = ("goodput_tok_s", "outcomes", "shed_rate",
+                  "expired_rate", "interactive_ttft_attainment",
+                  "brownout_peak_level", "brownout_final_level",
+                  "brownout_transitions", "retry_budget_exhausted",
+                  "compile_counts_stable", "leaked_pages",
+                  "exactly_once", "violations")
+
 
 def build_row(report_dict: dict, config_label: str, device: str) -> dict:
     """The one BENCH_LOAD row, schema-pinned: headline value is goodput
@@ -121,22 +137,217 @@ def run_drill(seed: int, requests: int, max_engines: int):
     return report, label, str(jax.devices()[0].platform)
 
 
+def _chaos_tiers():
+    """Deadline-bearing tier mix for the chaos drill. The interactive
+    slice is deliberately SMALL (0.15): brownout protects the premium
+    tier by sacrificing the rest, which is only a coherent policy when
+    the premium tier alone fits the fleet's degraded capacity — if
+    interactive work by itself overwhelms the storm-slowed engines, no
+    admission policy can save it. The standard tier carries an
+    engine-enforced deadline, so the expiry sweep and the
+    deadline-aware gate both see real work.
+
+    The interactive TTFT SLO (1.5 s) sits between what a preempting
+    ladder delivers under the storm (max observed ~1.3 s: one chunked
+    prefill behind at most one 70 ms-slowed step) and what a jammed
+    fleet delivers (2 s+: a full long-decode residual) — below the
+    physical floor no policy looks good, above the jam every policy
+    does."""
+    from paddle_tpu import loadgen
+
+    return (
+        loadgen.TierSpec("interactive", priority=0, weight=0.15,
+                         ttft_slo_s=1.5, itl_slo_s=0.5),
+        loadgen.TierSpec("standard", priority=1, weight=0.5185,
+                         deadline_s=6.0, ttft_slo_s=2.0, itl_slo_s=1.0),
+        loadgen.TierSpec("batch", priority=2, weight=0.3315,
+                         ttft_slo_s=10.0, itl_slo_s=5.0),
+    )
+
+
+def run_chaos_drill(seed: int, requests: int, armed: bool) -> dict:
+    """One chaos run: the seed-0 6x burst trace against a CAPACITY-
+    CAPPED 2-engine fleet (no autoscaler — overload must be survived,
+    not scaled away), with a seeded FaultSchedule (one engine kill with
+    timed revival + one injected step-latency burst) riding the replay.
+    ``armed`` attaches the OverloadController; the control run faces
+    the identical trace and faults without it. Resets the metrics
+    registry and tracer first so the two runs score in isolation."""
+    import paddle_tpu as paddle
+    from paddle_tpu import faults, loadgen, metrics
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import (OverloadConfig, OverloadController,
+                                    RetryBudget, Router, tracing)
+
+    metrics.get_registry().reset()
+    tracing.get_tracer().reset()
+    faults.reset()
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64))
+    router = Router(retry_budget=RetryBudget(capacity=16.0,
+                                             refill_per_step=1.0))
+    # host_offload stays OFF: this drill is slots-scarce, not
+    # pages-scarce (128 pages x 4 tokens covers every stream), and
+    # brownout-parking a batch decode would FREEZE its slot for the
+    # storm — the page-pressure tier is proven in tests/chaos instead
+    router.add_model("chaos", model, replicas=2, page_size=4,
+                     num_pages=128, max_batch_slots=8, max_model_len=64,
+                     token_budget=32, min_step_tokens=32, max_queue=128)
+    # warm the one compiled step per engine BEFORE traffic (a
+    # production fleet restores executables from the PR 14 disk cache):
+    # without this, the first interactive arrivals pay the cold compile
+    # and both runs miss the same SLOs for reasons no overload policy
+    # can touch
+    import numpy as np
+    for h in router.handles("chaos"):
+        h.engine.add_request(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+        h.engine.run()
+    cfg = loadgen.TraceConfig(
+        seed=seed, num_requests=requests, vocab_size=128,
+        arrival_rate=8.0, burst_start=0.3, burst_duration=1.5,
+        burst_factor=16.0, num_prompt_families=6, prefix_len=8,
+        # LONG decodes (mean 24 vs BENCH_LOAD's 8): with a queue-jumping
+        # priority tier, interactive TTFT in a jam is the RESIDUAL of
+        # the earliest-finishing in-service stream — queue depth is
+        # irrelevant, hold duration is everything. Long holds are what
+        # the preempting ladder relieves and what buries the control.
+        max_prompt_len=28, output_len_mean=24.0, output_len_sigma=0.5,
+        max_output_len=32,
+        slow_consumer_fraction=0.05, tiers=_chaos_tiers())
+    trace = loadgen.generate_trace(cfg)
+    # the incident, pinned (not drawn) so the artifact is legible: a
+    # step-latency storm covering the whole arrival burst — every
+    # engine step pays +70 ms, so a long decode holds its slot for
+    # ~2 s of wall time and slot contention becomes the fight — plus
+    # one engine kill mid-burst with timed revival (its migrated
+    # streams land on the survivor mid-storm)
+    schedule = loadgen.FaultSchedule([
+        loadgen.FaultEvent(t_s=0.1, kind="latency", delay_s=0.07,
+                           steps=300),
+        loadgen.FaultEvent(t_s=0.6, kind="kill", engine_index=0,
+                           down_s=0.6),
+    ])
+    ctl = None
+    if armed:
+        # asymmetric hysteresis — climb fast, descend slow: at
+        # interactive-only the shed itself empties the queue, and a
+        # symmetric controller would read that as recovery, de-escalate
+        # mid-storm, re-admit the flood, and flap
+        ctl = OverloadController(router, config=OverloadConfig(
+            hot_backlog_s=0.12, cold_backlog_s=0.08, hot_steps=1,
+            cold_steps=6, cooldown_steps=3, batch_chunk_cap=4))
+    # step_dt MUST be fine-grained here: the default (2/arrival_rate =
+    # 0.25 s/sweep) collapses the whole burst into ~6 sweeps, which
+    # both dumps ~10 arrivals per sweep and gives the ladder (one
+    # observe per sweep) no time to climb before the storm has passed
+    report = loadgen.LoadDriver(router, trace, overload=ctl,
+                                fault_schedule=schedule,
+                                step_dt=0.02).run()
+    cc = [h.engine.compile_counts() for h in router.handles("chaos")]
+    leaked = sum(h.engine.pool.used_pages for h in router.handles("chaos"))
+    reg = metrics.get_registry()
+    fam = reg.get("paddle_tpu_router_retry_budget_exhausted_total")
+    exhausted = int(fam.value) if fam is not None else 0
+    inter = report.tiers["interactive"].ttft_attainment
+    return {
+        "goodput_tok_s": round(report.goodput_tok_s, 1),
+        "outcomes": report.outcomes,
+        "shed_rate": round(report.shed_rate, 4),
+        "expired_rate": round(report.expired_rate, 4),
+        "interactive_ttft_attainment": (None if inter is None
+                                        else round(inter, 4)),
+        "brownout_peak_level": (0 if ctl is None else
+                                max([lv for _, lv in ctl.events],
+                                    default=0)),
+        "brownout_final_level": 0 if ctl is None else ctl.level,
+        "brownout_transitions": 0 if ctl is None else len(ctl.events),
+        "retry_budget_exhausted": exhausted,
+        "compile_counts_stable": all(c["step"] == c["step_buckets"]
+                                     for c in cc),
+        "leaked_pages": int(leaked),
+        "exactly_once": report.exactly_once,
+        "violations": report.violations,
+        "_schedule": schedule,   # stripped by build_chaos_row
+    }
+
+
+def build_chaos_row(seed: int, requests: int, armed: dict, control: dict,
+                    device: str) -> dict:
+    """The one BENCH_CHAOS row, schema-pinned: headline value is the
+    ARMED run's interactive TTFT attainment; ``vs_baseline`` is the
+    multiple over the brownout-off control on the identical trace and
+    fault schedule."""
+    schedule = armed.pop("_schedule")
+    control.pop("_schedule", None)
+    a = armed["interactive_ttft_attainment"] or 0.0
+    c = control["interactive_ttft_attainment"] or 0.0
+    return {
+        "metric": "BENCH_CHAOS",
+        "value": round(a, 4),
+        "unit": "interactive_ttft_attainment",
+        "vs_baseline": round(a / c, 2) if c else None,
+        "config": (f"llama-tiny fleet=2 (capped) seed={seed} "
+                   f"n={requests} burst=16x kills=1 latency=1 "
+                   f"brownout-on vs brownout-off"),
+        "device": device,
+        "seed": seed,
+        "num_requests": requests,
+        "faults": [{"t_s": round(e.t_s, 3), "kind": e.kind,
+                    "down_s": e.down_s, "delay_s": e.delay_s,
+                    "steps": e.steps} for e in schedule.events],
+        "armed": armed,
+        "control": control,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get("BENCH_LOAD_SEED", "0")))
     ap.add_argument("--requests", type=int,
                     default=int(os.environ.get("BENCH_LOAD_REQUESTS",
-                                               "32")))
+                                               "0")) or None,
+                    help="trace length (default: 32, or 64 for "
+                         "--chaos)")
     ap.add_argument("--max-engines", type=int,
                     default=int(os.environ.get("BENCH_LOAD_MAX_ENGINES",
                                                "3")))
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the ISSUE 19 chaos drill instead: the "
+                         "same seed-0 burst trace + seeded fault "
+                         "schedule twice (brownout armed vs off) "
+                         "against a capacity-capped fleet, emitting a "
+                         "BENCH_CHAOS row")
     ap.add_argument("--out", default=None,
                     help="write the row to this file (e.g. "
                          "BENCH_LOAD.json); stdout always gets it")
     args = ap.parse_args(argv)
+    requests = args.requests or (64 if args.chaos else 32)
 
-    report, label, device = run_drill(args.seed, args.requests,
+    if args.chaos:
+        import jax
+        armed = run_chaos_drill(args.seed, requests, armed=True)
+        control = run_chaos_drill(args.seed, requests, armed=False)
+        row = build_chaos_row(args.seed, requests, armed, control,
+                              str(jax.devices()[0].platform))
+        print(json.dumps(row, indent=2, sort_keys=True))
+        ok = (row["armed"]["exactly_once"]
+              and row["control"]["exactly_once"])
+        if not ok:
+            print(f"ACCOUNTING VIOLATIONS: "
+                  f"{row['armed']['violations']} / "
+                  f"{row['control']['violations']}", file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(row, f, indent=2, sort_keys=True)
+                f.write("\n")
+        return 0
+
+    report, label, device = run_drill(args.seed, requests,
                                       args.max_engines)
     row = build_row(report.to_dict(), label, device)
     print(json.dumps(row, indent=2, sort_keys=True))

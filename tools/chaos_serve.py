@@ -63,6 +63,14 @@ stream PARKED (its pages in host RAM) at the kill — containment must
 drain the dead engine's HostPageStore, the adoptive (equally starved)
 sibling must re-serve both migrants through its own park/unpark cycle,
 and the streams stay bit-identical with chunks exactly-once.
+Scenario 19 drills OVERLOAD as a first-class failure mode (ISSUE 19): a
+16x tiered burst against a capacity-capped fleet under a step-latency
+storm plus an engine kill, with the OverloadController armed — the
+brownout ladder must climb to batch-slot preemption (journal + requeue,
+the migration move turned inward), the deadline-aware gate must shed
+doomed work at admission, and afterwards the ladder must return to
+level 0 with every request accounted exactly-once, zero leaked pages,
+and the one compiled step untouched.
 Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
@@ -192,9 +200,12 @@ def scenario_compile_retry(model):
 
 def scenario_deadline_and_cancel(model):
     """Deadline expiry and cancel() retire with their own reasons and
-    counters; pages free immediately."""
+    counters; pages free immediately. A deadline that lapses while still
+    QUEUED retires ``"expired"`` (pages never allocated) — only admitted
+    work can ``"timeout"`` (ISSUE 19)."""
     eng = ServingEngine(model, page_size=4, max_batch_slots=1)
     t_before = _counter("paddle_tpu_serving_request_timeouts_total")
+    e_before = _counter("paddle_tpu_serving_expired_total")
     c_before = _counter("paddle_tpu_serving_cancellations_total")
     running = eng.add_request(P4, max_new_tokens=6)
     late = eng.add_request(P3, max_new_tokens=6, deadline_s=0.0)
@@ -203,15 +214,17 @@ def scenario_deadline_and_cancel(model):
     eng.cancel(cancelled)
     eng.slots[0].req.deadline = faults.Deadline(-1.0)  # force mid-decode
     outs = eng.run()
-    _check(outs[late].finish_reason == "timeout", "queued timeout")
+    _check(outs[late].finish_reason == "expired", "queued expiry")
     _check(outs[running].finish_reason == "timeout", "mid-decode timeout")
     _check(outs[cancelled].finish_reason == "cancelled", "cancel")
     _check(_counter("paddle_tpu_serving_request_timeouts_total")
-           == t_before + 2, "timeout counter != exactly 2")
+           == t_before + 1, "timeout counter != exactly 1")
+    _check(_counter("paddle_tpu_serving_expired_total")
+           == e_before + 1, "expired counter != exactly 1")
     _check(_counter("paddle_tpu_serving_cancellations_total")
            == c_before + 1, "cancel counter != exactly 1")
     _check(eng.pool.used_pages == 0, "pages leaked")
-    return "2 timeouts + 1 cancel, each counted exactly once"
+    return "1 expiry + 1 timeout + 1 cancel, each counted exactly once"
 
 
 def scenario_backpressure(model):
@@ -1268,6 +1281,95 @@ def scenario_kill_engine_with_offloaded_pages(model):
             "streams bit-identical, chunks exactly-once")
 
 
+def scenario_brownout_under_burst(model):
+    """Scenario 19 (ISSUE 19): overload survived by POLICY, not
+    capacity. A 16x-burst tiered trace replays against a capacity-CAPPED
+    2-engine fleet (no autoscaler) under a pinned fault schedule — a
+    step-latency storm covering the burst plus an engine kill with timed
+    revival — with the OverloadController armed. The brownout ladder
+    must CLIMB to slot preemption (level >= 3: batch-tier decodes are
+    journaled and requeued, the migration move turned inward), the
+    deadline-aware gate must shed doomed standard work at admission with
+    honest retry hints, and after the storm the ladder must walk fully
+    BACK DOWN: final level 0, every preempted stream re-served, zero
+    leaked pages, zero move-once marks, the one compiled step never
+    recompiled, and every one of the trace's requests accounted
+    exactly-once across admitted/shed/expired outcomes."""
+    from paddle_tpu import loadgen
+    from paddle_tpu.serving import (OverloadConfig, OverloadController,
+                                    RetryBudget, tracing)
+
+    r = Router(retry_budget=RetryBudget(capacity=16.0,
+                                        refill_per_step=1.0))
+    r.add_model("m", model, replicas=2, page_size=4, num_pages=128,
+                max_batch_slots=8, max_model_len=64, token_budget=32,
+                min_step_tokens=32, max_queue=128)
+    for h in r.handles("m"):
+        h.engine.add_request(P4, max_new_tokens=2)
+        h.engine.run()
+    tiers = (
+        loadgen.TierSpec("interactive", priority=0, weight=0.15,
+                         ttft_slo_s=1.5, itl_slo_s=0.5),
+        loadgen.TierSpec("standard", priority=1, weight=0.5185,
+                         deadline_s=6.0, ttft_slo_s=2.0, itl_slo_s=1.0),
+        loadgen.TierSpec("batch", priority=2, weight=0.3315,
+                         ttft_slo_s=10.0, itl_slo_s=5.0),
+    )
+    cfg = loadgen.TraceConfig(
+        seed=SEED, num_requests=64, vocab_size=128,
+        arrival_rate=8.0, burst_start=0.3, burst_duration=1.5,
+        burst_factor=16.0, num_prompt_families=6, prefix_len=8,
+        max_prompt_len=28, output_len_mean=24.0, output_len_sigma=0.5,
+        max_output_len=32, slow_consumer_fraction=0.05, tiers=tiers)
+    trace = loadgen.generate_trace(cfg)
+    schedule = loadgen.FaultSchedule([
+        loadgen.FaultEvent(t_s=0.1, kind="latency", delay_s=0.07,
+                           steps=300),
+        loadgen.FaultEvent(t_s=0.6, kind="kill", engine_index=0,
+                           down_s=0.6),
+    ])
+    ctl = OverloadController(r, config=OverloadConfig(
+        hot_backlog_s=0.12, cold_backlog_s=0.08, hot_steps=1,
+        cold_steps=6, cooldown_steps=3, batch_chunk_cap=4))
+    rep = loadgen.LoadDriver(r, trace, overload=ctl,
+                             fault_schedule=schedule, step_dt=0.02).run()
+    _check(rep.exactly_once,
+           f"completion accounting violated: {rep.violations[:3]}")
+    peak = max([lv for _, lv in ctl.events], default=0)
+    _check(peak >= 3, f"ladder never reached preemption (peak={peak})")
+    _check(ctl.level == 0,
+           f"ladder did not walk back down (final={ctl.level})")
+    _check(rep.outcomes.get("shed", 0) > 0,
+           "the admission gate never shed doomed work")
+    _check(_counter("paddle_tpu_serving_requests_total",
+                    event="preempted") > 0,
+           "no batch-tier slot was ever preempted")
+    evs = {e["name"] for e in tracing.get_tracer().events()}
+    _check({"req.shed", "req.preempt", "brownout.level"} <= evs,
+           f"overload trace events missing: {evs}")
+    bad = {k: v for k, v in rep.outcomes.items()
+           if k not in ("stop", "length", "shed", "expired", "timeout",
+                        "unavailable")}
+    _check(not bad, f"unknown outcomes: {bad}")
+    _check(sum(rep.outcomes.values()) == cfg.num_requests,
+           "outcome count != trace size")
+    inter = rep.tiers["interactive"].ttft_attainment
+    _check(inter is not None and inter >= 0.75,
+           f"interactive tier missed its TTFT SLO in the storm "
+           f"({inter}) — the ladder exists to prevent exactly this")
+    _check(all(e.pool.used_pages == 0 for e in r.engines("m")),
+           "pages leaked")
+    _check(r._requeued == set(), "move-once marks leaked")
+    for e in r.engines("m"):
+        counts = e.compile_counts()
+        _check(counts["step"] == counts["step_buckets"],
+               "brownout action recompiled the step")
+    return (f"ladder 0->{peak}->0 ({len(ctl.events)} transitions), "
+            f"outcomes {dict(sorted(rep.outcomes.items()))}, "
+            f"interactive TTFT attainment {inter:.2f}, "
+            f"0 leaked pages, step compiled once")
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -1290,6 +1392,7 @@ SCENARIOS = [
     ("flight-recorder-on-crash", scenario_flight_recorder_on_crash),
     ("kill-engine-with-offloaded-pages",
      scenario_kill_engine_with_offloaded_pages),
+    ("brownout-under-burst", scenario_brownout_under_burst),
 ]
 
 

@@ -74,6 +74,7 @@ def _demo_registry():
         engine.run()
     _demo_train_sentinel()
     _demo_loadgen()
+    _demo_overload()
     _demo_adapters_grammar()
     _demo_tracing()
     return metrics.get_registry()
@@ -174,6 +175,55 @@ def _demo_loadgen():
             scale_down_depth=0.25, hot_steps=2, cold_steps=4,
             cooldown_steps=4))
     loadgen.LoadDriver(router, trace, autoscaler=scaler).run()
+
+
+def _demo_overload():
+    """Miniature overload drill (ISSUE 19): a tiered burst with a
+    step-latency storm against a capacity-capped 1-engine fleet with
+    the OverloadController armed and a router retry budget attached,
+    so every overload series (paddle_tpu_overload_brownout_level /
+    _transitions_total / _decisions_total / _shed_total /
+    _backlog_seconds, paddle_tpu_serving_expired_total,
+    paddle_tpu_router_retry_budget_exhausted_total) is live in the
+    --demo snapshot."""
+    import paddle_tpu as paddle
+    from paddle_tpu import loadgen
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import (OverloadConfig, OverloadController,
+                                    RetryBudget, Router)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32))
+    router = Router(retry_budget=RetryBudget(capacity=4.0,
+                                             refill_per_step=0.5))
+    router.add_model("overload-demo", model, replicas=1, page_size=4,
+                     num_pages=64, max_batch_slots=2, max_model_len=32,
+                     token_budget=16, min_step_tokens=16, max_queue=64)
+    tiers = (
+        loadgen.TierSpec("interactive", priority=0, weight=0.2,
+                         ttft_slo_s=1.5, itl_slo_s=0.5),
+        loadgen.TierSpec("standard", priority=1, weight=0.5,
+                         deadline_s=1.0, ttft_slo_s=2.0, itl_slo_s=1.0),
+        loadgen.TierSpec("batch", priority=2, weight=0.3,
+                         ttft_slo_s=10.0, itl_slo_s=5.0),
+    )
+    trace = loadgen.generate_trace(loadgen.TraceConfig(
+        seed=0, num_requests=24, vocab_size=64, arrival_rate=10.0,
+        burst_start=0.1, burst_duration=0.8, burst_factor=12.0,
+        prefix_len=5, max_prompt_len=16, output_len_mean=10.0,
+        output_len_sigma=0.5, max_output_len=12,
+        slow_consumer_fraction=0.1, tiers=tiers))
+    schedule = loadgen.FaultSchedule([
+        loadgen.FaultEvent(t_s=0.05, kind="latency", delay_s=0.03,
+                           steps=200),
+    ])
+    ctl = OverloadController(router, config=OverloadConfig(
+        hot_backlog_s=0.06, cold_backlog_s=0.04, hot_steps=1,
+        cold_steps=4, cooldown_steps=2, batch_chunk_cap=4))
+    loadgen.LoadDriver(router, trace, overload=ctl,
+                       fault_schedule=schedule, step_dt=0.02).run()
 
 
 def _demo_train_sentinel():
