@@ -535,7 +535,6 @@ class CheckpointManager:
         must not hang the handler). Returns a scope usable as a context
         manager; ``scope.uninstall()`` (or scope exit) restores the old
         handlers. Main-thread only, like any Python signal handler."""
-        scope = _SignalScope({})
 
         def _handler(signum, frame):
             self.preempted = True
@@ -554,27 +553,11 @@ class CheckpointManager:
             if exit_on_save:
                 sys.exit(0)
 
-        for sig in signals:
-            scope._prev[sig] = _signal.signal(sig, _handler)
+        scope = faults.install_signal_handler(_handler, signals=signals)
         return scope
 
 
-class _SignalScope:
-    """Uninstaller for save_on_signal handlers (idempotent)."""
-
-    def __init__(self, prev: Dict):
-        self._prev = prev
-
-    def uninstall(self) -> None:
-        prev, self._prev = self._prev, {}
-        for sig, handler in prev.items():
-            try:
-                _signal.signal(sig, handler)
-            except (ValueError, OSError):  # not main thread / torn down
-                pass
-
-    def __enter__(self) -> "_SignalScope":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.uninstall()
+# The install/uninstall discipline lives in paddle_tpu.faults.signals now
+# (shared with Router.install_signal_handlers); the old private name stays
+# importable for callers that annotate against it.
+_SignalScope = faults.SignalScope

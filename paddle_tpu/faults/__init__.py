@@ -46,6 +46,7 @@ from .injection import (CallbackError, FaultInjected, FaultSpec,
                         inject, known_points, point, reset)
 from .retry import backoff_delays, retry
 from .sanitizer import LockSanitizer, LockViolation
+from .signals import SignalScope, install_signal_handler
 from .sentinel import (Action, SentinelAbort, SentinelConfig, StepReport,
                        TrainSentinel)
 from .watchdog import StepWatchdog
@@ -53,8 +54,8 @@ from .watchdog import StepWatchdog
 __all__ = [
     "Action", "CallbackError", "Deadline", "DeadlineExceeded",
     "FaultInjected", "FaultSpec", "LockSanitizer", "LockViolation",
-    "ResourceExhausted", "SentinelAbort", "SentinelConfig", "StepReport",
-    "StepWatchdog", "TrainSentinel",
+    "ResourceExhausted", "SentinelAbort", "SentinelConfig", "SignalScope",
+    "StepReport", "StepWatchdog", "TrainSentinel",
     "active_faults", "backoff_delays", "declare_point", "inject",
-    "known_points", "point", "reset", "retry",
+    "install_signal_handler", "known_points", "point", "reset", "retry",
 ]

@@ -5,7 +5,7 @@ item 5): where ``tools/chaos_serve.py`` proves correctness under
 faults, loadgen measures behavior under production-shaped load — and
 closes the elasticity loop.
 
-Four pieces (one module each):
+Five pieces (one module each):
 
 - :mod:`~paddle_tpu.loadgen.trace` — seeded, deterministic request
   streams: Zipf-shared prompt prefixes (exercises the radix prefix
@@ -25,6 +25,13 @@ Four pieces (one module each):
   (engine kills with timed revival, injected step latency) riding the
   trace replay on the same virtual clock, so ``LoadReport`` scores
   goodput-under-chaos deterministically (ISSUE 19).
+- :mod:`~paddle_tpu.loadgen.restart` — the kill-the-PROCESS drill
+  (ISSUE 20): a WAL-armed child fleet serves a seeded trace, the parent
+  SIGKILLs it mid-decode and restarts it with a different replica
+  count; :func:`run_restart_drill` returns pre/post chunk streams vs an
+  uninterrupted reference for the exactly-once, bit-identical asserts
+  (tools/chaos_serve.py scenario 20, ``tools/bench_load.py
+  --restart``).
 
 Quick drill::
 
@@ -48,6 +55,7 @@ the scaling state machine; docs/OBSERVABILITY.md catalogs the
 from .autoscaler import AutoscalerConfig, QueueDepthAutoscaler
 from .chaos import FaultEvent, FaultSchedule
 from .driver import LoadDriver, LoadReport, TierReport
+from .restart import run_restart_drill, streams_by_index
 from .trace import (DEFAULT_TIERS, TierSpec, Trace, TraceConfig,
                     TraceRequest, VirtualClock, generate_trace, zipf_pmf)
 
@@ -57,4 +65,5 @@ __all__ = [
     "LoadDriver", "LoadReport", "TierReport",
     "DEFAULT_TIERS", "TierSpec", "Trace", "TraceConfig", "TraceRequest",
     "VirtualClock", "generate_trace", "zipf_pmf",
+    "run_restart_drill", "streams_by_index",
 ]

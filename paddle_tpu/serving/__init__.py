@@ -18,7 +18,13 @@ lifecycle counters, page-pool gauges — lands in ``paddle_tpu.metrics``
 ``paddle_tpu.faults``: per-request deadlines and ``cancel()``, a bounded
 queue that rejects with a ``retry_after_s`` hint (BackpressureError),
 NaN-logit quarantine that never poisons batch-mates, isolated stream
-callbacks, and a step watchdog surfaced through ``/healthz``.
+callbacks, and a step watchdog surfaced through ``/healthz``. Durability
+is opt-in (wal.py): ``Router(wal_dir=...)`` journals every admission and
+committed token batch to a CRC-framed write-ahead log under ONE
+group-commit fsync per step, and ``Router.recover()`` replays it after a
+process death — unfinished requests re-admit through the journaled
+re-prefill path and streams complete bit-identical with exactly-once
+chunk delivery (docs/RESILIENCE.md "Durability").
 Multi-tenancy rides the ONE compiled step as data: batched multi-LoRA
 adapters (adapters.py — hot-loaded fleet-wide with zero recompiles,
 routed by ``(model_id, adapter_id)``) and token-level constrained
@@ -50,6 +56,7 @@ from .scheduler import (BackpressureError, FCFSScheduler, Request,
 from .spec import NGramDrafter
 from .tracing import (TTFT_BUCKETS, RequestTracer, attribute_ttft,
                       get_tracer, set_tracer, validate_events)
+from .wal import RequestWAL, WalRequest, WalState
 
 __all__ = [
     "ServingEngine", "PagedKVCachePool", "PrefixCache", "FCFSScheduler",
@@ -63,4 +70,5 @@ __all__ = [
     "set_tracer", "validate_events",
     "OverloadController", "OverloadConfig", "DrainEstimator",
     "AdmissionShedError", "RetryBudget",
+    "RequestWAL", "WalRequest", "WalState",
 ]

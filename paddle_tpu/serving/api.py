@@ -84,7 +84,8 @@ class CompletionAPI:
                           prefix_cache: bool = True,
                           priority: int = 0,
                           adapter_id: Optional[str] = None,
-                          grammar=None) -> dict:
+                          grammar=None,
+                          resume_after_seq=None) -> dict:
         """Run one or more prompts to completion and return an OpenAI-ish
         response dict. ``prompt`` is a token-id list or a batch of them
         (one ``choices`` entry each, continuous-batched through the
@@ -110,7 +111,15 @@ class CompletionAPI:
         adapter every choice decodes through (on a Router backend,
         placement narrows to engines holding it); ``grammar`` is a
         compiled :class:`~.grammar.GrammarFSM` constraining every
-        choice's tokens (docs/SERVING.md "Constrained decoding")."""
+        choice's tokens (docs/SERVING.md "Constrained decoding").
+        ``resume_after_seq`` is the reconnect half of the exactly-once
+        streaming contract (docs/RESILIENCE.md "Durability"): a client
+        that saw chunks through seq N before losing its connection
+        passes ``resume_after_seq=N`` (an int for every choice, or one
+        per choice) and ``stream_cb`` receives only chunks with
+        ``seq > N`` — re-submitted deterministic requests (same prompt/
+        seed/temperature) regenerate identical tokens, so the suppressed
+        prefix is exactly what the client already holds."""
         t0 = time.perf_counter()
         prompts = self._as_batch(prompt)
         try:
@@ -132,7 +141,15 @@ class CompletionAPI:
             for idx, p in enumerate(prompts):
                 cb = None
                 if stream_cb is not None:
-                    cb = self._chunk_cb(stream_cb, cid, idx, resp_model)
+                    after = -1
+                    if resume_after_seq is not None:
+                        after = int(
+                            resume_after_seq[idx]
+                            if isinstance(resume_after_seq,
+                                          (list, tuple, np.ndarray))
+                            else resume_after_seq)
+                    cb = self._chunk_cb(stream_cb, cid, idx, resp_model,
+                                        after_seq=after)
                 req_ids.append(engine.add_request(
                     p, max_new_tokens=max_tokens, temperature=temperature,
                     eos_token_id=stop_token_id, seed=seed + idx,
@@ -198,8 +215,14 @@ class CompletionAPI:
                       "total_tokens": usage_p + usage_c},
         }
 
-    def _chunk_cb(self, stream_cb, cid, idx, model_name):
+    def _chunk_cb(self, stream_cb, cid, idx, model_name,
+                  after_seq: int = -1):
         def cb(req_id, token, finished, seq):
+            if int(seq) <= after_seq:
+                # reconnect resume: the client already holds this chunk
+                # (resume_after_seq cursor) — suppressing it here keeps
+                # delivery exactly-once without the engine knowing
+                return
             # the engine's terminal callback passes the finish reason
             # (docs/SERVING.md table) as `finished`, so streamed chunks
             # agree with the final response's choices[].finish_reason —

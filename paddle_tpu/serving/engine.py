@@ -809,6 +809,21 @@ class ServingEngine:
             out.append(st.req)
         return out
 
+    def inflight_fsm_states(self) -> Dict[object, Optional[int]]:
+        """``{req_id: local grammar FSM state}`` for every live slot
+        (None for unconstrained requests) — a read-only snapshot, slots
+        untouched. What the router's WAL group commit journals next to
+        each progress record so a restarted process can resume a
+        constrained stream mid-structure without re-walking the DFA
+        (a missing journaled state is recomputed from the token journal
+        at adoption, exactly like a migrated request's)."""
+        out: Dict[object, Optional[int]] = {}
+        for st in self.slots:
+            if st is not None:
+                out[st.req.req_id] = (int(st.fsm_state)
+                                      if st.fsm is not None else None)
+        return out
+
     def adopt_request(self, req: Request) -> None:
         """Enqueue a Request object stolen from ANOTHER engine: req_id,
         arrival time, running deadline, seed, and stream_cb all ride along,

@@ -81,15 +81,19 @@ skipped.
 """
 from __future__ import annotations
 
+import signal as _signal
 import threading
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .. import faults, metrics
 from . import tracing
 from .engine import ServingEngine
+from .grammar import GrammarFSM, toy_tokenizer
 from .scheduler import Request, RequestOutput
+from .wal import RequestWAL, WalRequest
 
 __all__ = ["Router", "EngineHandle", "NoHealthyEngineError",
            "HEALTHY", "DEGRADED", "DRAINING", "DOWN"]
@@ -151,14 +155,36 @@ class Router:
     rolling version isolation: with a shared model every replica flips to
     the new weights at the first restore)."""
 
-    def __init__(self, retry_budget=None):
+    def __init__(self, retry_budget=None, wal_dir: Optional[str] = None,
+                 wal_segment_bytes: int = 1 << 20):
         """``retry_budget`` (an :class:`~.overload.RetryBudget`) gates
         failover requeue/migration placements per model so an incident
         storm can't amplify load — each placement spends one token,
         :meth:`step` refills, and a dry bucket retires the request
         ``"unavailable"`` immediately (fail fast, never a retry loop).
-        None (the default) keeps retries unmetered."""
+        None (the default) keeps retries unmetered.
+
+        ``wal_dir`` opts the router into DURABILITY (serving/wal.py,
+        docs/RESILIENCE.md "Durability"): every :meth:`submit` journals
+        an admission record, every step's committed tokens journal as a
+        progress record, and retirement is journaled — group-committed
+        with ONE fsync per :meth:`step`. Stream chunks are released to
+        client callbacks only AFTER the commit barrier (commit-then-
+        emit), so a client can never have seen a token the log could
+        lose; after a process death, :meth:`recover` on a fresh router
+        pointed at the same directory re-admits every unfinished
+        request and the streams complete bit-identical, chunks
+        exactly-once. None (the default) keeps the old purely
+        in-memory behavior."""
         self._retry_budget = retry_budget
+        self._wal = (None if wal_dir is None else
+                     RequestWAL(wal_dir, segment_bytes=wal_segment_bytes))
+        self._wal_ids: Dict[object, int] = {}    # req_id -> live wal_id
+        self._wal_cursor: Dict[int, int] = {}    # wal_id -> committed toks
+        self._wal_alias: Dict[int, int] = {}     # superseded -> successor
+        self._client_cbs: Dict[int, Callable] = {}
+        self._chunk_buf: List[tuple] = []        # awaiting the commit
+        self._stream_hist: Dict[int, List[tuple]] = {}
         self._models: Dict[str, List[EngineHandle]] = {}
         self._handles: Dict[str, EngineHandle] = {}
         self._rr: Dict[str, int] = {}          # per-model tie-break cursor
@@ -227,6 +253,16 @@ class Router:
             "budget was dry (the request retired \"unavailable\" "
             "instead of joining a requeue/migration storm)",
             labels=("model_id",))
+        self._m_recovered = reg.counter(
+            "paddle_tpu_wal_recovered_requests_total",
+            "Requests Router.recover() replayed out of the WAL after a "
+            "process restart, by outcome: resumed (re-admitted via the "
+            "journaled re-prefill path), completed (journal already "
+            "terminal — only the retire record was torn away), expired "
+            "(deadline lapsed across the death), failed (no engine "
+            "could adopt it)", labels=("outcome",))
+        for oc in ("resumed", "completed", "expired", "failed"):
+            self._m_recovered.labels(outcome=oc)
 
     # ------------------------------------------------------------- topology
     def add_model(self, model_id: str, model, replicas: int = 1,
@@ -445,11 +481,49 @@ class Router:
         to engines holding that adapter. Drive the fleet with
         :meth:`run`."""
         h = self.select(model, adapter_id=request_kwargs.get("adapter_id"))
-        rid = h.engine.add_request(prompt, **request_kwargs)
+        if self._wal is not None:
+            rid = self._submit_durable(h, prompt, request_kwargs)
+        else:
+            rid = h.engine.add_request(prompt, **request_kwargs)
         self._m_dispatch.labels(engine_id=h.engine_id,
                                 model_id=h.model_id).inc()
         self._trace.emit("req.dispatch", rid, label=h.engine_id)
         return rid
+
+    def _submit_durable(self, h: EngineHandle, prompt,
+                        request_kwargs: dict):
+        """WAL-armed admission: swap the client's ``stream_cb`` for the
+        router's buffering wrapper (chunks release only after the next
+        group commit — commit-then-emit) and journal the admission
+        record. The record is framed AFTER ``add_request`` accepts (a
+        backpressure-rejected request must not leave a forever-pending
+        admit in the log) and becomes durable at the next
+        :meth:`step`'s fsync — the group-commit window. The journaled
+        fields come from the ACCEPTED Request object itself
+        (``Request.wal_admission``), so engine-side defaulting and seed
+        canonicalization can never drift from what recovery rebuilds."""
+        wid = self._wal.new_id()
+        kwargs = dict(request_kwargs)
+        client_cb = kwargs.pop("stream_cb", None)
+        kwargs["stream_cb"] = self._durable_cb(wid)
+        rid = h.engine.add_request(prompt, **kwargs)
+        req = next(r for r in h.engine.scheduler.waiting
+                   if r.req_id == rid)
+        self._wal.append("admit",
+                         **req.wal_admission(wid, model=h.model_id))
+        self._wal_ids[rid] = wid
+        self._wal_cursor[wid] = 0
+        if client_cb is not None:
+            self._client_cbs[wid] = client_cb
+        return rid
+
+    def wal_id_of(self, req_id) -> Optional[int]:
+        """The durable id journaled for a live request this process
+        admitted (or recovered) — ``Request.req_id`` is a plain process-
+        local counter and collides across restarts, so the WAL id is
+        what a client must hold to :meth:`attach_stream` after a crash.
+        None when the request is unknown or the router runs WAL-off."""
+        return self._wal_ids.get(req_id)
 
     def _count_dispatch(self, h: EngineHandle) -> None:
         """Dispatch-accounting hook for front doors (CompletionAPI) that
@@ -666,6 +740,8 @@ class Router:
             live = self._live_req_ids()
             if live is not None:
                 self._requeued &= live
+        if self._wal is not None:
+            self._wal_commit_and_flush()
 
     def _live_req_ids(self) -> Optional[set]:
         """Every req_id currently queued or in-flight on any non-down
@@ -767,6 +843,320 @@ class Router:
         door draining the fleet for its own req_ids); they merge into the
         next :meth:`run`'s return."""
         self._stash.update(outputs)
+
+    # ---------------------------------------------------------- durability
+    def _durable_cb(self, wal_id: int) -> Callable:
+        """The stream wrapper every WAL-armed request decodes under:
+        chunks land in the router's buffer instead of the client — the
+        group commit at the end of :meth:`step` journals them and THEN
+        releases them (commit-then-emit). The wrapper itself never
+        raises, so the engine's callback isolation never fires for a
+        durable stream; client exceptions surface at flush time and
+        cost only the attachment, never the request."""
+        def cb(rid, tok, fin, seq):
+            self._chunk_buf.append((wal_id, rid, tok, fin, seq))
+        return cb
+
+    def _inflight_fsm_states(self) -> Dict[object, Optional[int]]:
+        """Fleet-wide ``{req_id: grammar FSM state}`` snapshot for the
+        group commit (guarded per engine: a dead engine's slots were
+        already evacuated, and a raising probe must not block the
+        commit of every other request's tokens)."""
+        out: Dict[object, Optional[int]] = {}
+        for h in list(self._handles.values()):
+            if h.state == DOWN:
+                continue
+            try:
+                out.update(h.engine.inflight_fsm_states())
+            except Exception:
+                pass
+        return out
+
+    def _wal_commit_and_flush(self) -> None:
+        """The group commit closing one :meth:`step`: fold this step's
+        buffered chunks into one ``progress`` record per request (plus
+        ``retire`` for terminals), pay ONE fsync for the whole batch —
+        admits framed by :meth:`submit` since the last barrier ride the
+        same commit — and only then release the chunks to client
+        callbacks. A crash before the fsync loses tokens no client ever
+        saw (deterministic decode regenerates them identically); a crash
+        after it loses only deliveries the client can replay via
+        :meth:`attach_stream` — exactly-once across process death."""
+        buf, self._chunk_buf = self._chunk_buf, []
+        if buf:
+            fsm = self._inflight_fsm_states()
+            per: Dict[int, dict] = {}
+            order: List[int] = []
+            for wid, rid, tok, fin, _seq in buf:
+                rec = per.get(wid)
+                if rec is None:
+                    per[wid] = rec = {"tokens": [], "fin": None,
+                                      "rid": rid}
+                    order.append(wid)
+                if tok is not None:
+                    rec["tokens"].append(int(tok))
+                if fin:
+                    rec["fin"] = str(fin)
+            for wid in order:
+                rec = per[wid]
+                at = self._wal_cursor.get(wid, 0)
+                if rec["tokens"]:
+                    # the end-of-step FSM snapshot corresponds exactly
+                    # to the journal INCLUDING this delta, which is the
+                    # cursor position replay validates it against
+                    self._wal.append("progress", id=wid, at=at,
+                                     tokens=rec["tokens"],
+                                     fsm=fsm.get(rec["rid"]))
+                    self._wal_cursor[wid] = at + len(rec["tokens"])
+                if rec["fin"] is not None:
+                    self._wal.append("retire", id=wid,
+                                     reason=rec["fin"])
+        self._wal.commit()
+        for wid, rid, tok, fin, seq in buf:
+            self._deliver(wid, rid, tok, fin, seq)
+        for wid, rid, _tok, fin, _seq in buf:
+            if fin:
+                # terminal delivered: release the durable-stream state
+                # (the WAL keeps the durable copy; compaction reaps it)
+                self._client_cbs.pop(wid, None)
+                self._stream_hist.pop(wid, None)
+                self._wal_cursor.pop(wid, None)
+                self._wal_ids.pop(rid, None)
+
+    def _deliver(self, wid: int, rid, tok, fin, seq) -> None:
+        """Release one committed chunk: record it in the in-memory
+        stream history (what :meth:`attach_stream` replays) and forward
+        to the attached client, if any. Durable-stream callback
+        isolation: a raising client loses its ATTACHMENT — the chunk is
+        already journaled, so a reattach replays it — never the
+        request (contrast the WAL-off engine path, where a broken
+        callback retires the request ``"error"``: with no journal there
+        is nothing to reattach to)."""
+        self._stream_hist.setdefault(wid, []).append((seq, tok, fin))
+        cb = self._client_cbs.get(wid)
+        if cb is None:
+            return
+        try:
+            cb(rid, tok, fin, seq)
+        except Exception:
+            self._client_cbs.pop(wid, None)
+
+    def attach_stream(self, wal_id: int, stream_cb: Callable,
+                      after_seq: int = -1) -> int:
+        """(Re)attach a client callback to a durable stream by WAL id —
+        the client half of exactly-once across process death: pass the
+        last seq you saw as ``after_seq`` and every chunk after it
+        replays from the journal history, then live chunks follow.
+        Recovery aliases resolve (a request re-admitted by
+        :meth:`recover` answers to its pre-crash id), and the resolved
+        id is returned. Commit-then-emit makes the cursor sound: the
+        client can never have seen a chunk the journal does not hold,
+        so the replay + live handoff has no gap to fall into."""
+        wid = int(wal_id)
+        seen: set = set()
+        while wid in self._wal_alias and wid not in seen:
+            seen.add(wid)
+            wid = self._wal_alias[wid]
+        rid = next((r for r, w in self._wal_ids.items() if w == wid),
+                   None)
+        hist = list(self._stream_hist.get(wid, ()))
+        for seq, tok, fin in hist:
+            if seq > after_seq:
+                try:
+                    stream_cb(rid, tok, fin, seq)
+                except Exception:
+                    return wid          # client broke mid-replay
+        if not (hist and hist[-1][2]):  # stream still live: go live
+            self._client_cbs[wid] = stream_cb
+        return wid
+
+    def recover(self, wal_dir: Optional[str] = None,
+                ckpt_dir: Optional[str] = None,
+                grammar_resolver: Optional[Callable] = None
+                ) -> Dict[int, dict]:
+        """Replay the WAL and re-admit every unfinished request onto
+        whatever engines THIS router has — the process-restart half of
+        the durability contract. Call after ``add_model`` (the restarted
+        fleet may have fewer or more replicas than the one that died;
+        placement is ordinary least-loaded dispatch). ``wal_dir`` arms
+        the WAL if the router was built without one; ``ckpt_dir`` first
+        rolls the newest committed checkpoint into the fleet
+        (:meth:`reload`) so recovered streams decode under the exact
+        weights a deploy intended. ``grammar_resolver(key) -> GrammarFSM``
+        rebuilds constrained requests' DFAs from their journaled spec
+        key ``(pattern, vocab_size, eos_token_id)``; the default lowers
+        through :func:`~.grammar.toy_tokenizer` (every test/bench
+        tokenizer in-repo) — front doors with a real tokenizer supply
+        their own.
+
+        Replay is pure (replay twice ⇒ the same state) and re-admission
+        is idempotent: each re-admitted incarnation journals a
+        ``recover`` record superseding the old id, so a second
+        :meth:`recover` — same process or the next one — finds nothing
+        pending it doesn't already own. Per request the outcome is
+        ``resumed`` (re-admitted through the journaled re-prefill path:
+        prompt + committed tokens re-prefill, decode continues
+        token-identically, emission resumes at the journaled seq),
+        ``completed`` (journal already terminal — only the retire
+        record was torn off the tail), ``expired`` (its deadline lapsed
+        across the death, measured on the WALL clock from the original
+        admission), or ``failed`` (no engine could adopt it) —
+        ``paddle_tpu_wal_recovered_requests_total{outcome}`` counts
+        each. Returns ``{old_wal_id: outcome dict}``."""
+        if self._wal is None:
+            if wal_dir is None:
+                raise ValueError(
+                    "no WAL armed: construct Router(wal_dir=...) or "
+                    "pass recover(wal_dir=...)")
+            self._wal = RequestWAL(wal_dir)
+        if ckpt_dir is not None:
+            self.reload(ckpt_dir)
+        state = self._wal.replay()
+        # rebuild the alias chain from PRIOR incarnations' recover
+        # records, so a client holding a two-crashes-ago id still
+        # resolves to the live stream
+        for wr in state.requests.values():
+            if wr.superseded_by is not None:
+                self._wal_alias[wr.wal_id] = wr.superseded_by
+        live_now = set(self._wal_ids.values())
+        results: Dict[int, dict] = {}
+        for wr in state.pending():
+            if wr.wal_id in live_now:
+                continue    # admitted by THIS process: nothing to do
+            results[wr.wal_id] = self._recover_one(wr, grammar_resolver)
+        self._wal.commit()
+        return results
+
+    def _recover_one(self, wr: WalRequest,
+                     grammar_resolver: Optional[Callable]) -> dict:
+        """Re-admit ONE journaled request (see :meth:`recover`)."""
+        toks = list(wr.tokens)
+        done = None
+        if wr.max_new_tokens and len(toks) >= wr.max_new_tokens:
+            done = "length"
+        elif (wr.eos_token_id is not None and toks
+              and toks[-1] == int(wr.eos_token_id)):
+            done = "stop"
+        if done is not None:
+            # the journal is already terminal — the crash tore away only
+            # the retire record; close it out, no engine needed
+            self._wal.append("retire", id=wr.wal_id, reason=done)
+            self._stream_hist[wr.wal_id] = (
+                [(i, t, None) for i, t in enumerate(toks)]
+                + [(len(toks), None, done)])
+            self._m_recovered.labels(outcome="completed").inc()
+            return {"outcome": "completed", "finish_reason": done,
+                    "tokens": toks, "wal_id": wr.wal_id, "rid": None}
+        remaining = None
+        if wr.deadline_s is not None:
+            remaining = wr.deadline_s - max(
+                0.0, time.time() - wr.admit_walltime)
+            if remaining <= 0:
+                self._wal.append("retire", id=wr.wal_id,
+                                 reason="expired")
+                self._stream_hist[wr.wal_id] = (
+                    [(i, t, None) for i, t in enumerate(toks)]
+                    + [(len(toks), None, "expired")])
+                self._m_recovered.labels(outcome="expired").inc()
+                return {"outcome": "expired", "tokens": toks,
+                        "wal_id": wr.wal_id, "rid": None}
+        try:
+            grammar = None
+            if wr.grammar_key is not None:
+                if grammar_resolver is not None:
+                    grammar = grammar_resolver(wr.grammar_key)
+                else:
+                    pattern, vocab, eos = wr.grammar_key
+                    grammar = GrammarFSM.compile(
+                        pattern, toy_tokenizer(vocab, eos))
+            wid = self._wal.new_id()
+            req = Request(
+                prompt=np.asarray(wr.prompt, np.int32),
+                max_new_tokens=wr.max_new_tokens,
+                temperature=wr.temperature,
+                eos_token_id=wr.eos_token_id, seed=wr.seed,
+                stream_cb=self._durable_cb(wid),
+                deadline_s=remaining, prefix_cache=wr.prefix_cache,
+                priority=wr.priority, resume_tokens=toks,
+                adapter_id=wr.adapter_id, grammar=grammar,
+                resume_fsm_state=wr.fsm_state)
+            target = self.select(wr.model, adapter_id=wr.adapter_id)
+            target.engine.adopt_request(req)
+        except Exception as e:
+            # nothing on the restarted fleet can take it (model not
+            # registered, adapter not loaded, grammar unbuildable, every
+            # engine gated out): retire it deterministically in the LOG
+            # — the caller sees "failed" + the tokens, never a silent
+            # forever-pending record
+            self._wal.append("retire", id=wr.wal_id,
+                             reason="unavailable")
+            self._m_recovered.labels(outcome="failed").inc()
+            return {"outcome": "failed", "error": repr(e),
+                    "tokens": toks, "wal_id": wr.wal_id, "rid": None}
+        # adopted: supersede the old incarnation and journal the new one
+        # WITH its carried journal — the next crash recovers from the
+        # new record alone (original deadline fields ride along so
+        # elapsed time is never double-counted across restarts)
+        self._wal.append("recover", old=wr.wal_id, new=wid)
+        payload = req.wal_admission(wid, model=wr.model,
+                                    walltime=wr.admit_walltime,
+                                    resume_from=wr.wal_id)
+        payload["deadline_s"] = wr.deadline_s
+        self._wal.append("admit", **payload)
+        self._wal_ids[req.req_id] = wid
+        self._wal_cursor[wid] = len(toks)
+        self._wal_alias[wr.wal_id] = wid
+        self._stream_hist[wid] = [(i, t, None)
+                                  for i, t in enumerate(toks)]
+        cb = self._client_cbs.pop(wr.wal_id, None)
+        if cb is not None:
+            self._client_cbs[wid] = cb
+        self._count_dispatch(target)
+        self._trace.emit("req.recover", req.req_id,
+                         arg=float(len(toks)), label=target.engine_id)
+        self._m_recovered.labels(outcome="resumed").inc()
+        return {"outcome": "resumed", "rid": req.req_id, "wal_id": wid,
+                "tokens": toks}
+
+    def shutdown(self, drain: bool = True) -> Dict[object, RequestOutput]:
+        """Graceful shutdown: drain the fleet, group-commit the last
+        window, and SEAL the WAL (a ``seal`` record marks clean exit —
+        the next process's :meth:`recover` finds nothing pending and no
+        torn tail). ``drain=False`` skips the run-to-empty (commits and
+        closes WITHOUT sealing, so pending work correctly reads as
+        recoverable). Returns the final outputs; pair with
+        :meth:`install_signal_handlers` for the SIGTERM →
+        drain → seal → exit-0 path."""
+        if drain:
+            out = self.run()
+        else:
+            out = self.take_outputs()
+        if self._wal is not None:
+            self._wal_commit_and_flush()
+            if not self.has_work:
+                self._wal.seal()
+            self._wal.close()
+            self._wal = None
+        return out
+
+    def install_signal_handlers(self, signals=(_signal.SIGTERM,),
+                                exit_on_shutdown: bool = True):
+        """Arm SIGTERM (by default) to run :meth:`shutdown` — the
+        serving twin of ``checkpoint.save_on_signal``, riding the SAME
+        shared scope (:func:`paddle_tpu.faults.install_signal_handler`):
+        training checkpoints-and-exits, serving drains-seals-and-exits,
+        one signal path. Returns the scope (``uninstall()`` restores the
+        previous handlers; also a context manager)."""
+        def _handler(signum, frame):
+            try:
+                self.shutdown()
+            finally:
+                scope.uninstall()
+            if exit_on_shutdown:
+                import sys
+                sys.exit(0)
+        scope = faults.install_signal_handler(_handler, signals=signals)
+        return scope
 
     # ------------------------------------------------------- manual gating
     def drain(self, engine_id: str) -> None:

@@ -193,6 +193,41 @@ class Request:
         return max(int(self.max_new_tokens)
                    - len(self.resume_tokens or ()), 0)
 
+    def wal_admission(self, wal_id: int, model: Optional[str] = None,
+                      walltime: Optional[float] = None,
+                      resume_from: Optional[int] = None) -> dict:
+        """The JSON-able WAL admission record (serving/wal.py) for this
+        request: every field a RESTARTED process needs to rebuild it
+        exactly — sampling identity (prompt, canonical seed, temperature,
+        eos), SLO identity (priority, original deadline_s + the wall
+        clock it started burning at), tenancy (adapter name, grammar
+        spec KEY — pattern/vocab/eos, rebuildable, never the compiled
+        tables), the prefix_cache opt-out, and the journal carried so
+        far (resume tokens + FSM state) when this admission IS a
+        recovery re-admission (``resume_from`` names the incarnation it
+        supersedes). Living next to the field list keeps the durable
+        record and the dataclass from drifting."""
+        return {
+            "id": int(wal_id), "model": model,
+            "prompt": [int(t) for t in self.prompt],
+            "max_new_tokens": int(self.max_new_tokens),
+            "temperature": float(self.temperature),
+            "eos": (None if self.eos_token_id is None
+                    else int(self.eos_token_id)),
+            "seed": int(self.seed), "priority": int(self.priority),
+            "deadline_s": (None if self.deadline_s is None
+                           else float(self.deadline_s)),
+            "t": time.time() if walltime is None else float(walltime),
+            "adapter_id": self.adapter_id,
+            "grammar": (list(self.grammar.key)
+                        if self.grammar is not None else None),
+            "prefix_cache": bool(self.prefix_cache),
+            "resume_from": resume_from,
+            "tokens": [int(t) for t in (self.resume_tokens or ())],
+            "fsm": (None if self.resume_fsm_state is None
+                    else int(self.resume_fsm_state)),
+        }
+
 
 @dataclass
 class RequestOutput:

@@ -96,6 +96,9 @@ EVENTS: Dict[str, str] = {
                  "length; label: engine_id)",
     "req.requeue": "waiting request moved to a sibling (label: target "
                    "engine_id)",
+    "req.recover": "request re-admitted from the WAL after a process "
+                   "restart (arg: journaled tokens; label: adoptive "
+                   "engine_id)",
     "req.migrate": "in-flight request migrated to a sibling (label: "
                    "target engine_id)",
     "req.shed": "refused at admission by the overload controller "

@@ -187,6 +187,43 @@ def test_bench_chaos_row_schema_is_stable():
     assert armed["shed_rate"] > 0.0 and control["shed_rate"] == 0.0
 
 
+def test_bench_recovery_row_schema_is_stable():
+    """The committed BENCH_RECOVERY.json (the durable-serving artifact,
+    ISSUE 20) carries exactly the schema tools/bench_load.py pins: the
+    cross-process SIGKILL-and-recover drill plus the WAL's steady-state
+    ITL price. Latencies (RTO, p95s) are host-dependent; the contract
+    booleans — streams bit-identical across process death, seqs
+    exactly-once, ZERO fresh compiles during recovery, WAL overhead
+    within the 1.05x gate — are properties of the committed artifact
+    and are asserted by value."""
+    bl = _load("bl_recovery_test", "bench_load.py")
+    with open(os.path.join(REPO, "BENCH_RECOVERY.json")) as f:
+        row = json.load(f)
+
+    assert set(row) == set(bl.RECOVERY_KEYS)
+    assert row["metric"] == "BENCH_RECOVERY"
+    assert row["unit"] == "seconds_rto"
+    drill = row["drill"]
+    assert set(drill) == set(bl.RECOVERY_DRILL_KEYS)
+    # the acceptance gates of the ISSUE, frozen into the artifact
+    assert drill["bit_identical"] is True
+    assert drill["seqs_exactly_once"] is True
+    assert drill["fresh_compiles_recovery"] == 0
+    assert drill["rto_s"] is not None and drill["rto_s"] > 0
+    assert row["value"] == drill["rto_s"]
+    assert drill["replicas_after"] < drill["replicas_before"]
+    assert drill["outcomes"].get("resumed", 0) >= 1
+    assert drill["streams"] == row["num_requests"]
+    overhead = row["overhead"]
+    assert set(overhead) == set(bl.RECOVERY_OVERHEAD_KEYS)
+    assert overhead["wal_on_p95_itl_s"] > 0
+    assert overhead["wal_off_p95_itl_s"] > 0
+    assert row["vs_baseline"] == overhead["itl_overhead_ratio"] <= 1.05
+    # group commit: ~one fsync per router.step (the +1 is shutdown's
+    # final barrier), never one per request or per token
+    assert 0 < overhead["fsyncs_per_step"] <= 1.25
+
+
 def test_bench_kv_row_schema_is_stable():
     """The committed BENCH_KV.json (the KV-memory-economics artifact,
     ISSUE 18) carries exactly the schema tools/bench_decode.py pins.

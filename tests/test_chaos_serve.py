@@ -1,5 +1,5 @@
 """CI wrapper for tools/chaos_serve.py: the full chaos ladder (scenarios
-1-19 — engine resilience, router failover/reload/dispatch, the
+1-20 — engine resilience, router failover/reload/dispatch, the
 kill-engine-mid-decode migration drill, the prefix-heavy failover
 drill that asserts migrated requests re-prefill through the adoptive
 sibling's prefix cache, the kill-engine-mid-chunked-prefill drill
@@ -28,7 +28,11 @@ a 16x tiered burst plus a step-latency storm and an engine kill
 against a capacity-capped fleet with the OverloadController armed and
 asserts the ladder climbs to batch-slot preemption, sheds doomed work
 at admission, and returns to level 0 with exactly-once accounting and
-zero leaks) runs as slow-marked
+zero leaks, and the kill-serving-process-mid-decode drill that
+SIGKILLs a WAL-armed serving fleet in a CHILD process mid-stream,
+restarts it with one engine fewer, and asserts every stream completes
+bit-identical to an uninterrupted reference with exactly-once seqs and
+zero fresh compiles during recovery) runs as slow-marked
 tests instead of
 only by hand, one test per scenario so a regression names its drill.
 

@@ -1,0 +1,541 @@
+"""paddle_tpu.serving.wal: the request WAL + crash-exact restart
+(ISSUE 20, docs/RESILIENCE.md "Durability").
+
+Acceptance gates pinned here: replay is PURE (replaying the same log
+twice — same instance or a fresh open — folds to the same state);
+opening a log with a torn tail or a flipped bit at ANY record boundary
+never crashes, truncates exactly to the last good frame, and counts
+the damage in ``paddle_tpu_wal_corrupt_records_total``; rotation and
+compaction preserve live journals while dropping retired history;
+``seal`` distinguishes a graceful drain from a crash; with a WAL armed
+the router group-commits ONE fsync per step, streams bit-identical to
+a WAL-off run, and after a simulated process death ``recover()`` +
+``attach_stream(after_seq=...)`` resumes every stream exactly-once and
+bit-identical to an uninterrupted reference. The shared signal scope
+(``faults.install_signal_handler``) gets its double-install regression
+test here too — LIFO restore, idempotent uninstall — since both
+``Router.install_signal_handlers`` and
+``CheckpointManager.save_on_signal`` now ride it.
+
+The cross-PROCESS version of the crash drill (real SIGKILL, fewer
+engines on restart) is chaos scenario 20 in tools/chaos_serve.py; this
+file keeps everything in-process so it rides tier-1.
+"""
+import os
+import signal as _signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import faults, metrics
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import RequestWAL, Router
+from paddle_tpu.serving.wal import RECORD_KINDS
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _counter(name, **labels):
+    fam = metrics.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+def _fsync_count():
+    fam = metrics.get_registry().get("paddle_tpu_wal_fsync_seconds")
+    return 0 if fam is None else fam.count
+
+
+def _state_sig(state):
+    """A comparable fold of a WalState — the idempotence witness."""
+    return (state.next_wal_id, state.sealed, sorted(
+        (r.wal_id, r.model, tuple(r.prompt), r.max_new_tokens,
+         r.temperature, r.eos_token_id, r.seed, r.priority, r.deadline_s,
+         r.admit_walltime, r.adapter_id, r.grammar_key, r.prefix_cache,
+         r.resume_from, tuple(r.tokens), r.fsm_state, r.outcome,
+         r.superseded_by)
+        for r in state.requests.values()))
+
+
+def _admit(wal, wid, prompt=(3, 4, 5), max_new=6, **over):
+    rec = dict(id=wid, model="m", prompt=list(prompt),
+               max_new_tokens=max_new, temperature=0.0, eos=None,
+               seed=7, priority=0, deadline_s=None, t=time.time(),
+               adapter_id=None, grammar=None, prefix_cache=True,
+               resume_from=None, tokens=[], fsm=None)
+    rec.update(over)
+    wal.append("admit", **rec)
+
+
+def _fill(wal):
+    """One record of every kind, committed — the fuzzers' corpus."""
+    a, b = wal.new_id(), wal.new_id()
+    _admit(wal, a)
+    _admit(wal, b, prompt=(9, 8), max_new=4, seed=11)
+    wal.append("progress", id=a, at=0, tokens=[1, 2], seq=1, fsm=None)
+    wal.append("progress", id=a, at=2, tokens=[3], seq=2, fsm=5)
+    wal.append("retire", id=b, reason="stop")
+    wal.append("recover", old=b, new=wal.new_id())
+    wal.commit()
+    return a, b
+
+
+# ───────────────────────── framing / replay ─────────────────────────
+
+
+class TestReplay:
+    def test_replay_twice_is_idempotent(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        a, _b = _fill(wal)
+        s1, s2 = wal.replay(), wal.replay()
+        assert _state_sig(s1) == _state_sig(s2)
+        assert s1.requests[a].tokens == [1, 2, 3]
+        assert s1.requests[a].fsm_state == 5
+        # a fresh open of the same directory folds the same state
+        again = RequestWAL(str(tmp_path))
+        assert _state_sig(again.replay()) == _state_sig(s1)
+
+    def test_nothing_durable_before_commit(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        _admit(wal, wal.new_id())
+        assert wal.replay().records == 0       # buffered only
+        assert wal.commit() == 1
+        assert wal.replay().records == 1
+        assert wal.commit() == 0               # empty buffer: free
+
+    def test_record_kind_counters_move(self, tmp_path):
+        before = {k: _counter("paddle_tpu_wal_records_total", kind=k)
+                  for k in RECORD_KINDS}
+        wal = RequestWAL(str(tmp_path))
+        _fill(wal)
+        wal.seal()
+        after = {k: _counter("paddle_tpu_wal_records_total", kind=k)
+                 for k in RECORD_KINDS}
+        delta = {k: after[k] - before[k] for k in RECORD_KINDS}
+        assert delta == {"admit": 2, "progress": 2, "retire": 1,
+                         "recover": 1, "seal": 1}
+
+    def test_progress_overlap_merges_and_gap_drops(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        wid = wal.new_id()
+        _admit(wal, wid)
+        wal.append("progress", id=wid, at=0, tokens=[1, 2], fsm=None)
+        # replayed delta: overlaps the journal, extends only the tail
+        wal.append("progress", id=wid, at=1, tokens=[2, 3], fsm=9)
+        # a gap (hole in the middle of the log) must be dropped
+        wal.append("progress", id=wid, at=9, tokens=[99], fsm=1)
+        wal.commit()
+        r = wal.replay().requests[wid]
+        assert r.tokens == [1, 2, 3]
+        assert r.fsm_state == 9                # valid for exactly tokens
+        # an orphan delta (unknown id) is tolerated, not fatal
+        wal.append("progress", id=12345, at=0, tokens=[1])
+        wal.commit()
+        assert 12345 not in wal.replay().requests
+
+
+class TestTornWrites:
+    """Fuzz the crash surface: truncations and bit-flips at and around
+    EVERY record boundary. Opening the damaged log must never raise,
+    must truncate to the last good frame, and must count the damage."""
+
+    def _corpus(self, tmp_path):
+        src = tmp_path / "src"
+        wal = RequestWAL(str(src))
+        _fill(wal)
+        wal.close()
+        seg = [p for p in os.listdir(src) if p.endswith(".log")]
+        assert len(seg) == 1
+        data = (src / seg[0]).read_bytes()
+        bounds = [end for _rec, end in RequestWAL._iter_frames(data)]
+        assert len(bounds) == 6 and bounds[-1] == len(data)
+        return data, bounds
+
+    @staticmethod
+    def _open_damaged(tmp_path, name, blob):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "wal-00000000.log").write_bytes(blob)
+        return d, RequestWAL(str(d))
+
+    def test_truncation_at_and_inside_every_boundary(self, tmp_path):
+        data, bounds = self._corpus(tmp_path)
+        starts = [0] + bounds[:-1]
+        case = 0
+        for start, end in zip(starts, bounds):
+            # clean cut at the boundary, then torn cuts inside the
+            # frame: mid-header, just past the header, one byte short
+            for cut in (start, start + 2, start + 9, end - 1):
+                before = _counter("paddle_tpu_wal_corrupt_records_total")
+                d, wal = self._open_damaged(
+                    tmp_path, f"t{case}", data[:cut])
+                case += 1
+                state = wal.replay()
+                whole = sum(1 for b in bounds if b <= cut)
+                assert state.records == whole
+                torn = cut not in (0, *bounds)
+                assert (_counter("paddle_tpu_wal_corrupt_records_total")
+                        - before) == (1 if torn else 0)
+                # the torn bytes are GONE from disk, not just skipped
+                size = os.path.getsize(d / "wal-00000000.log")
+                assert size == (bounds[whole - 1] if whole else 0)
+                wal.close()
+
+    def test_bit_flip_in_every_record(self, tmp_path):
+        data, bounds = self._corpus(tmp_path)
+        starts = [0] + bounds[:-1]
+        for i, (start, end) in enumerate(zip(starts, bounds)):
+            for off in (start + 1, start + 4, end - 1):  # len, crc, body
+                blob = bytearray(data)
+                blob[off] ^= 0x40
+                before = _counter("paddle_tpu_wal_corrupt_records_total")
+                d, wal = self._open_damaged(
+                    tmp_path, f"b{i}_{off}", bytes(blob))
+                # nothing after an undecodable frame can be trusted:
+                # the fold stops at record i, the file truncates there
+                assert wal.replay().records == i
+                assert (_counter("paddle_tpu_wal_corrupt_records_total")
+                        - before) >= 1
+                size = os.path.getsize(d / "wal-00000000.log")
+                assert size == (bounds[i - 1] if i else 0)
+                wal.close()
+
+    def test_append_continues_after_torn_tail(self, tmp_path):
+        data, bounds = self._corpus(tmp_path)
+        d, wal = self._open_damaged(tmp_path, "cont", data[:bounds[2] + 5])
+        wid = wal.new_id()
+        _admit(wal, wid, prompt=(1,))
+        wal.commit()
+        state = wal.replay()
+        assert state.records == 4              # 3 survivors + the new one
+        assert wid in state.requests
+        wal.close()
+
+
+# ─────────────────── rotation / compaction / seal ───────────────────
+
+
+class TestSegments:
+    def test_rotation_spans_segments_and_replay_folds_all(self, tmp_path):
+        wal = RequestWAL(str(tmp_path), segment_bytes=256)
+        wids = []
+        for _ in range(12):
+            wid = wal.new_id()
+            _admit(wal, wid)
+            wal.commit()
+            wids.append(wid)
+        segs = [p for p in os.listdir(tmp_path) if p.endswith(".log")]
+        assert len(segs) > 1                   # it actually rotated
+        state = RequestWAL(str(tmp_path)).replay()
+        assert sorted(state.requests) == wids
+        assert state.next_wal_id == wids[-1] + 1
+
+    def test_compact_drops_retired_keeps_live_journal(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        live = wal.new_id()
+        _admit(wal, live)
+        wal.append("progress", id=live, at=0, tokens=[4, 5], fsm=3)
+        for _ in range(3):
+            wid = wal.new_id()
+            _admit(wal, wid)
+            wal.append("retire", id=wid, reason="stop")
+        wal.commit()
+        wal.compact()
+        assert len([p for p in os.listdir(tmp_path)
+                    if p.endswith(".log")]) == 1
+        state = wal.replay()
+        assert list(state.requests) == [live]  # retired history GONE
+        r = state.requests[live]
+        assert r.tokens == [4, 5] and r.fsm_state == 3 and r.live
+
+    def test_rotation_triggers_compaction_past_threshold(self, tmp_path):
+        wal = RequestWAL(str(tmp_path), segment_bytes=256,
+                         compact_retired=2)
+        for _ in range(20):
+            wid = wal.new_id()
+            _admit(wal, wid)
+            wal.append("retire", id=wid, reason="stop")
+            wal.commit()
+        # without compaction 20 admit+retire pairs span many segments;
+        # with it the retired history keeps getting dropped
+        segs = [p for p in os.listdir(tmp_path) if p.endswith(".log")]
+        assert len(segs) <= 2
+        assert wal.replay().pending() == []
+
+    def test_seal_marks_clean_exit_and_new_records_unseal(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        _fill(wal)
+        wal.seal()
+        assert RequestWAL(str(tmp_path)).replay().sealed
+        wal.append("admit", **{"id": wal.new_id(), "prompt": [1],
+                               "max_new_tokens": 1})
+        wal.commit()
+        assert not wal.replay().sealed         # work after the seal
+        wal.close()
+
+    def test_wal_id_allocation_survives_reopen(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        ids = [wal.new_id() for _ in range(3)]
+        _admit(wal, ids[-1])
+        wal.commit()
+        wal.close()
+        again = RequestWAL(str(tmp_path))
+        # only ids that reached an admit record are durable; the next
+        # allocation must land PAST every journaled id
+        assert again.new_id() > ids[-1]
+
+
+# ───────────────── shared signal scope (satellite 1) ─────────────────
+
+
+class TestSignalScope:
+    """The double-install regression promised by faults/signals.py:
+    scopes nest LIFO and uninstall idempotently — the bookkeeping both
+    save_on_signal and Router.install_signal_handlers now share."""
+
+    SIG = _signal.SIGUSR1
+
+    def test_double_install_restores_lifo(self):
+        base = _signal.getsignal(self.SIG)
+        h1 = lambda s, f: None  # noqa: E731
+        h2 = lambda s, f: None  # noqa: E731
+        s1 = faults.install_signal_handler(h1, signals=(self.SIG,))
+        assert _signal.getsignal(self.SIG) is h1
+        s2 = faults.install_signal_handler(h2, signals=(self.SIG,))
+        assert _signal.getsignal(self.SIG) is h2
+        s2.uninstall()
+        assert _signal.getsignal(self.SIG) is h1   # chain intact
+        s1.uninstall()
+        assert _signal.getsignal(self.SIG) == base
+
+    def test_uninstall_is_idempotent(self):
+        base = _signal.getsignal(self.SIG)
+        h1 = lambda s, f: None  # noqa: E731
+        h2 = lambda s, f: None  # noqa: E731
+        s1 = faults.install_signal_handler(h1, signals=(self.SIG,))
+        s2 = faults.install_signal_handler(h2, signals=(self.SIG,))
+        s2.uninstall()
+        s2.uninstall()                         # consumed: must no-op,
+        assert _signal.getsignal(self.SIG) is h1   # not re-install h1
+        s1.uninstall()
+        s1.uninstall()
+        assert _signal.getsignal(self.SIG) == base
+
+    def test_scope_is_a_context_manager(self):
+        base = _signal.getsignal(self.SIG)
+        h = lambda s, f: None  # noqa: E731
+        with faults.install_signal_handler(h, signals=(self.SIG,)):
+            assert _signal.getsignal(self.SIG) is h
+        assert _signal.getsignal(self.SIG) == base
+
+    def test_save_on_signal_rides_the_shared_scope(self, tmp_path):
+        from paddle_tpu.checkpoint import CheckpointManager
+        base = _signal.getsignal(self.SIG)
+        mgr = CheckpointManager(str(tmp_path))
+        scope = mgr.save_on_signal(lambda: (0, {"w": np.zeros(2)}),
+                                   signals=(self.SIG,),
+                                   exit_on_save=False)
+        assert isinstance(scope, faults.SignalScope)
+        assert _signal.getsignal(self.SIG) != base
+        scope.uninstall()
+        assert _signal.getsignal(self.SIG) == base
+
+    def test_router_handlers_ride_the_shared_scope(self):
+        base = _signal.getsignal(self.SIG)
+        scope = Router().install_signal_handlers(signals=(self.SIG,))
+        assert isinstance(scope, faults.SignalScope)
+        assert _signal.getsignal(self.SIG) != base
+        scope.uninstall()
+        assert _signal.getsignal(self.SIG) == base
+
+
+# ──────────────── router integration (in-process) ────────────────
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return LlamaForCausalLM(llama_tiny(
+        vocab_size=32, hidden_size=16, num_layers=1, num_heads=1,
+        num_key_value_heads=1, max_position_embeddings=64))
+
+
+_ENGINE_KW = dict(page_size=4, max_batch_slots=2,
+                  watchdog_stall_s=None)
+
+_RNG = np.random.RandomState(20)
+P5, P6 = (_RNG.randint(1, 32, (n,)) for n in (5, 6))
+
+
+def _collect(store, key):
+    def cb(rid, tok, fin, seq):
+        store.setdefault(key, []).append((int(seq), tok, fin))
+    return cb
+
+
+def _tokens(chunks):
+    return [t for _s, t, _f in chunks if t is not None]
+
+
+def _drain(router, limit=200):
+    steps = 0
+    while router.has_work:
+        router.step()
+        steps += 1
+        assert steps < limit
+    return steps
+
+
+def _reference_streams():
+    """The uninterrupted WAL-off run every durable run must match."""
+    r = Router()
+    r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+    chunks = {}
+    for key, p in (("a", P5), ("b", P6)):
+        r.submit(p, "m", max_new_tokens=8, temperature=0.8, seed=20,
+                 stream_cb=_collect(chunks, key))
+    _drain(r)
+    return chunks
+
+
+class TestRouterDurable:
+    def test_wal_on_streams_bit_identical_one_fsync_per_step(
+            self, tmp_path):
+        ref = _reference_streams()
+        r = Router(wal_dir=str(tmp_path))
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        chunks = {}
+        for key, p in (("a", P5), ("b", P6)):
+            r.submit(p, "m", max_new_tokens=8, temperature=0.8, seed=20,
+                     stream_cb=_collect(chunks, key))
+        before = _fsync_count()
+        steps = _drain(r)
+        # group commit: at most ONE fsync per step (idle steps are free)
+        assert 0 < _fsync_count() - before <= steps
+        r.shutdown()
+        assert chunks == ref                   # durability costs no bits
+        assert RequestWAL(str(tmp_path)).replay().sealed
+
+    def test_crash_recover_resumes_bit_identical_exactly_once(
+            self, tmp_path):
+        ref = _reference_streams()
+        crashed = Router(wal_dir=str(tmp_path))
+        crashed.add_model("m", _model(), replicas=2, **_ENGINE_KW)
+        pre = {}
+        wids = {}
+        for key, p in (("a", P5), ("b", P6)):
+            rid = crashed.submit(p, "m", max_new_tokens=8,
+                                 temperature=0.8, seed=20,
+                                 stream_cb=_collect(pre, key))
+            wids[key] = crashed.wal_id_of(rid)
+        for _ in range(3):                     # die mid-decode
+            crashed.step()
+        assert crashed.has_work                # the crash tore work away
+        del crashed                            # SIGKILL stand-in
+
+        survivor = Router(wal_dir=str(tmp_path))
+        survivor.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        out = survivor.recover()
+        assert {o["outcome"] for o in out.values()} == {"resumed"}
+        post = {}
+        for key in ("a", "b"):
+            last = max((s for s, _t, _f in pre.get(key, ())), default=-1)
+            survivor.attach_stream(wids[key], _collect(post, key),
+                                   after_seq=last)
+        _drain(survivor)
+        survivor.shutdown()
+        for key in ("a", "b"):
+            merged = pre.get(key, []) + post[key]
+            # exactly-once across the death: seqs are 0..n-1, no gap,
+            # no dup, one terminal chunk
+            assert [s for s, _t, _f in merged] == list(range(len(merged)))
+            assert [f for _s, _t, f in merged if f] == [merged[-1][2]]
+            assert _tokens(merged) == _tokens(ref[key])  # bit-identical
+
+    def test_second_recover_is_a_no_op(self, tmp_path):
+        crashed = Router(wal_dir=str(tmp_path))
+        crashed.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        crashed.submit(P5, "m", max_new_tokens=8, seed=20)
+        crashed.step()
+        del crashed
+        survivor = Router(wal_dir=str(tmp_path))
+        survivor.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        first = survivor.recover()
+        assert len(first) == 1
+        assert survivor.recover() == {}        # idempotent re-admission
+        _drain(survivor)
+        survivor.shutdown()
+
+    def test_unsealed_log_reads_as_crash_sealed_as_drain(self, tmp_path):
+        r = Router(wal_dir=str(tmp_path))
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        r.submit(P5, "m", max_new_tokens=4, seed=20)
+        r.step()
+        r.shutdown(drain=False)                # teardown WITHOUT drain
+        state = RequestWAL(str(tmp_path)).replay()
+        assert not state.sealed                # correctly reads as crash
+        assert len(state.pending()) == 1
+
+
+class TestRecoverOutcomes:
+    """The three engine-free dispositions, driven by hand-written
+    journals — no decode needed to pin the recovery state machine."""
+
+    def test_terminal_journal_completes_without_an_engine(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        wid = wal.new_id()
+        _admit(wal, wid, prompt=(3, 4), max_new=3, tokens=[7, 8, 9])
+        wal.commit()
+        wal.close()
+        before = _counter("paddle_tpu_wal_recovered_requests_total",
+                          outcome="completed")
+        r = Router(wal_dir=str(tmp_path))
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        out = r.recover()
+        assert out[wid]["outcome"] == "completed"
+        assert out[wid]["finish_reason"] == "length"
+        assert (_counter("paddle_tpu_wal_recovered_requests_total",
+                         outcome="completed") - before) == 1
+        got = {}
+        r.attach_stream(wid, _collect(got, "x"))
+        assert _tokens(got["x"]) == [7, 8, 9]  # full redelivery
+        assert got["x"][-1][2] == "length"
+        r.shutdown()
+
+    def test_deadline_lapsed_across_death_expires(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        wid = wal.new_id()
+        _admit(wal, wid, deadline_s=0.5, t=time.time() - 10.0)
+        wal.commit()
+        wal.close()
+        r = Router(wal_dir=str(tmp_path))
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        out = r.recover()
+        assert out[wid]["outcome"] == "expired"
+        r.shutdown()
+        # the expiry is journaled: the NEXT process sees it retired
+        state = RequestWAL(str(tmp_path)).replay()
+        assert state.requests[wid].outcome == "expired"
+
+    def test_no_serving_engine_fails_loudly(self, tmp_path):
+        wal = RequestWAL(str(tmp_path))
+        wid = wal.new_id()
+        _admit(wal, wid, model="ghost")        # nobody serves "ghost"
+        wal.commit()
+        wal.close()
+        r = Router(wal_dir=str(tmp_path))
+        r.add_model("m", _model(), replicas=1, **_ENGINE_KW)
+        out = r.recover()
+        assert out[wid]["outcome"] == "failed"
+        r.shutdown()
+        state = RequestWAL(str(tmp_path)).replay()
+        assert state.requests[wid].outcome == "unavailable"

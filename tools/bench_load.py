@@ -20,6 +20,14 @@ the drill; the defaults finish in seconds on CPU.
 The row shape follows tools/bench_decode.py (metric/value/unit/
 vs_baseline/config/device) so BENCH digests treat fleet rows like
 engine rows; the fleet-only evidence lands under ``"report"``.
+
+``--chaos`` emits a BENCH_CHAOS row instead (ISSUE 19: brownout armed
+vs off under the same burst + fault schedule); ``--restart`` emits a
+BENCH_RECOVERY row (ISSUE 20: SIGKILL a WAL-armed child fleet
+mid-decode, restart 2->1 engines, score the RTO, assert zero fresh
+compiles during recovery, and price the WAL's steady-state p95 ITL
+overhead against a WAL-off control — committed as
+``BENCH_RECOVERY.json``, schema-pinned like the others).
 """
 from __future__ import annotations
 
@@ -65,6 +73,23 @@ CHAOS_RUN_KEYS = ("goodput_tok_s", "outcomes", "shed_rate",
                   "brownout_transitions", "retry_budget_exhausted",
                   "compile_counts_stable", "leaked_pages",
                   "exactly_once", "violations")
+
+# --restart artifact schema (ISSUE 20): one BENCH_RECOVERY row from the
+# cross-process kill-and-recover drill (paddle_tpu.loadgen.restart) —
+# headline value is the RTO (SIGKILL instant to first recovered token
+# landing at the client), vs_baseline is the WAL's steady-state cost
+# (WAL-on p95 inter-token latency over WAL-off, same in-process
+# workload). tests/test_bench_tools.py pins these against the
+# committed BENCH_RECOVERY.json.
+RECOVERY_KEYS = ("metric", "value", "unit", "vs_baseline", "config",
+                 "device", "seed", "num_requests", "drill", "overhead")
+RECOVERY_DRILL_KEYS = ("replicas_before", "replicas_after", "streams",
+                       "killed_after_chunks", "bit_identical",
+                       "seqs_exactly_once", "outcomes",
+                       "fresh_compiles_recovery", "recover_s", "rto_s")
+RECOVERY_OVERHEAD_KEYS = ("wal_on_p95_itl_s", "wal_off_p95_itl_s",
+                          "itl_overhead_ratio", "requests",
+                          "fsyncs_per_step")
 
 
 def build_row(report_dict: dict, config_label: str, device: str) -> dict:
@@ -303,6 +328,144 @@ def build_chaos_row(seed: int, requests: int, armed: dict, control: dict,
     }
 
 
+def _measure_itl(wal_dir, requests: int, cache_dir=None) -> dict:
+    """One in-process run of the restart-drill workload on a 1-engine
+    fleet, timing every stream chunk delivery: returns the p95
+    inter-token gap plus the WAL's fsync-per-step evidence (group
+    commit = ONE fsync per ``router.step()`` no matter how many
+    requests landed tokens). ``wal_dir=None`` is the WAL-off control.
+    ``cache_dir`` shares one disk compile cache across runs — without
+    it every run pays its own fresh XLA compiles mid-step (the
+    in-process memory cache does not span routers) and seconds of
+    compile noise drown the microseconds of fsync under measurement."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu import metrics
+    from paddle_tpu.loadgen import restart
+    from paddle_tpu.loadgen.trace import TraceConfig, generate_trace
+
+    router = restart.build_router(wal_dir, replicas=1,
+                                  compile_cache_dir=cache_dir)
+    arrivals: dict = {}
+
+    def _cb(idx):
+        def cb(rid, tok, fin, seq):
+            if tok is not None:
+                arrivals.setdefault(idx, []).append(_time.perf_counter())
+        return cb
+
+    trace = generate_trace(TraceConfig(
+        num_requests=requests, **restart._TRACE_KW))
+    for tr in trace.requests:
+        router.submit(np.asarray(tr.prompt, np.int32),
+                      model=restart.MODEL_ID,
+                      max_new_tokens=tr.max_new_tokens,
+                      temperature=tr.temperature, seed=tr.seed,
+                      priority=tr.priority, stream_cb=_cb(tr.index))
+    fam = metrics.get_registry().get("paddle_tpu_wal_fsync_seconds")
+    fsync0 = fam.count if fam is not None else 0
+    steps = 0
+    while router.has_work:
+        router.step()
+        steps += 1
+    router.shutdown()
+    fam = metrics.get_registry().get("paddle_tpu_wal_fsync_seconds")
+    fsyncs = (fam.count if fam is not None else 0) - fsync0
+    gaps = [b - a for times in arrivals.values()
+            for a, b in zip(times, times[1:])]
+    return {"p95_itl_s": float(np.percentile(gaps, 95)) if gaps else 0.0,
+            "steps": steps, "fsyncs": int(fsyncs)}
+
+
+def run_recovery_drill(seed: int, requests: int) -> dict:
+    """The ISSUE 20 acceptance drill, measured: (1) the cross-process
+    kill-and-recover (child fleet SIGKILLed mid-decode, restarted 2->1
+    engines over a shared disk compile cache) scoring RTO, recovery
+    fresh-compiles, and bit-identical/exactly-once stream checks; (2)
+    the WAL's steady-state overhead — the same in-process workload with
+    the WAL armed vs off, comparing p95 inter-token latency (group
+    commit amortizes ONE fsync per step across the whole batch)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.loadgen import restart
+
+    workdir = tempfile.mkdtemp(prefix="bench-recovery-")
+    try:
+        res = restart.run_restart_drill(
+            workdir, replicas_before=2, replicas_after=1,
+            num_requests=requests, kill_after_chunks=8)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    ref = restart.streams_by_index(res["ref_chunks"])
+    full = restart.streams_by_index(
+        res["pre_chunks"] + res["post_chunks"])
+    bit_identical = full == ref
+    seqs_ok = all(
+        [s for _, _, s in chunks] == list(range(len(chunks)))
+        for chunks in full.values())
+    timing = res["timing"]
+    drill = {
+        "replicas_before": 2, "replicas_after": 1,
+        "streams": len(ref),
+        "killed_after_chunks": res["killed_after"],
+        "bit_identical": bit_identical,
+        "seqs_exactly_once": seqs_ok,
+        "outcomes": timing.get("outcomes", {}),
+        "fresh_compiles_recovery": timing["fresh_compiles"],
+        "recover_s": round(timing["recover_s"], 4),
+        "rto_s": (None if res["rto_s"] is None
+                  else round(res["rto_s"], 4)),
+    }
+    # overhead: one warmup run populates a shared disk compile cache,
+    # then WAL-off and WAL-on measure identical warm workloads — any
+    # residual delta is the WAL's append+fsync, not compile noise
+    scratch = tempfile.mkdtemp(prefix="bench-recovery-itl-")
+    try:
+        cache = os.path.join(scratch, "xla-cache")
+        _measure_itl(None, requests, cache_dir=cache)
+        off = _measure_itl(None, requests, cache_dir=cache)
+        on = _measure_itl(os.path.join(scratch, "wal"), requests,
+                          cache_dir=cache)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    ratio = (on["p95_itl_s"] / off["p95_itl_s"]
+             if off["p95_itl_s"] > 0 else None)
+    overhead = {
+        "wal_on_p95_itl_s": round(on["p95_itl_s"], 6),
+        "wal_off_p95_itl_s": round(off["p95_itl_s"], 6),
+        "itl_overhead_ratio": (None if ratio is None
+                               else round(ratio, 4)),
+        "requests": requests,
+        "fsyncs_per_step": (round(on["fsyncs"] / on["steps"], 4)
+                            if on["steps"] else None),
+    }
+    return {"drill": drill, "overhead": overhead}
+
+
+def build_recovery_row(seed: int, requests: int, measured: dict,
+                       device: str) -> dict:
+    """The one BENCH_RECOVERY row, schema-pinned: headline value is the
+    RTO in seconds (SIGKILL to first recovered token at the client);
+    ``vs_baseline`` is the WAL-on/WAL-off p95 ITL ratio — the price of
+    durability in steady state."""
+    return {
+        "metric": "BENCH_RECOVERY",
+        "value": measured["drill"]["rto_s"],
+        "unit": "seconds_rto",
+        "vs_baseline": measured["overhead"]["itl_overhead_ratio"],
+        "config": (f"llama-tiny wal fleet=2->1 seed={seed} "
+                   f"n={requests} sigkill-mid-decode shared-xla-cache"),
+        "device": device,
+        "seed": seed,
+        "num_requests": requests,
+        "drill": measured["drill"],
+        "overhead": measured["overhead"],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int,
@@ -321,11 +484,43 @@ def main(argv=None) -> int:
                          "schedule twice (brownout armed vs off) "
                          "against a capacity-capped fleet, emitting a "
                          "BENCH_CHAOS row")
+    ap.add_argument("--restart", action="store_true",
+                    help="run the ISSUE 20 recovery drill instead: "
+                         "SIGKILL a WAL-armed child fleet mid-decode, "
+                         "restart it 2->1 engines, score RTO / zero "
+                         "fresh recovery compiles / bit-identical "
+                         "streams plus the WAL-on vs WAL-off p95 ITL "
+                         "overhead, emitting a BENCH_RECOVERY row")
     ap.add_argument("--out", default=None,
                     help="write the row to this file (e.g. "
                          "BENCH_LOAD.json); stdout always gets it")
     args = ap.parse_args(argv)
-    requests = args.requests or (64 if args.chaos else 32)
+    requests = args.requests or (64 if args.chaos else
+                                 6 if args.restart else 32)
+
+    if args.restart:
+        import jax
+        measured = run_recovery_drill(args.seed, requests)
+        row = build_recovery_row(args.seed, requests, measured,
+                                 str(jax.devices()[0].platform))
+        print(json.dumps(row, indent=2, sort_keys=True))
+        d, o = row["drill"], row["overhead"]
+        ok = (d["bit_identical"] and d["seqs_exactly_once"]
+              and d["fresh_compiles_recovery"] == 0
+              and d["rto_s"] is not None)
+        if not ok:
+            print(f"RECOVERY DRILL FAILED: {d}", file=sys.stderr)
+            return 1
+        if (o["itl_overhead_ratio"] is not None
+                and o["itl_overhead_ratio"] > 1.05):
+            print(f"WAL ITL OVERHEAD {o['itl_overhead_ratio']}x > "
+                  f"1.05x gate", file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(row, f, indent=2, sort_keys=True)
+                f.write("\n")
+        return 0
 
     if args.chaos:
         import jax

@@ -71,6 +71,15 @@ the migration move turned inward), the deadline-aware gate must shed
 doomed work at admission, and afterwards the ladder must return to
 level 0 with every request accounted exactly-once, zero leaked pages,
 and the one compiled step untouched.
+Scenario 20 kills the PROCESS, not an engine (ISSUE 20): a WAL-armed
+fleet serves a seeded loadgen trace in a CHILD python, the parent
+SIGKILLs it mid-decode and restarts it with one engine fewer —
+``Router.recover`` must replay the request WAL, re-admit every
+unfinished stream through the journaled re-prefill path, resume
+emission after the exact seq the client's chunk file proves delivered,
+and complete every stream bit-identical to an uninterrupted reference
+run with zero duplicate/missing seqs and ZERO fresh XLA compiles
+during recovery (the shared disk compile cache).
 Each scenario asserts both the behavior
 AND the telemetry (every failure path must move its counter). Exit
 code 0 iff every scenario passes.
@@ -1370,6 +1379,62 @@ def scenario_brownout_under_burst(model):
             f"0 leaked pages, step compiled once")
 
 
+# ── 20. durable serving: SIGKILL the serving PROCESS mid-decode ──────────
+
+
+def scenario_kill_serving_process(model):
+    """ISSUE 20 acceptance: the request WAL survives PROCESS death.
+
+    A child python serves a seeded trace behind ``Router(wal_dir=...)``,
+    journaling admissions + every committed token batch (one fsync per
+    step) and appending each DELIVERED chunk to a file — the file is the
+    client. The parent SIGKILLs it mid-decode, then restarts the fleet
+    with ONE ENGINE FEWER; ``Router.recover`` replays the WAL and
+    resumes every stream after the cursor the chunk file proves
+    delivered. Every completed stream must be bit-identical to an
+    uninterrupted reference run, seqs exactly-once (no dup, no gap),
+    with at least one stream genuinely resumed mid-decode and ZERO
+    fresh XLA compiles paid during recovery (shared disk compile
+    cache)."""
+    from paddle_tpu.loadgen import restart
+
+    workdir = tempfile.mkdtemp(prefix="chaos-wal-")
+    try:
+        res = restart.run_restart_drill(
+            workdir, replicas_before=2, replicas_after=1,
+            num_requests=6, kill_after_chunks=8)
+        ref = restart.streams_by_index(res["ref_chunks"])
+        full = restart.streams_by_index(
+            res["pre_chunks"] + res["post_chunks"])
+        _check(res["killed_after"] < len(res["ref_chunks"]),
+               "SIGKILL landed after the workload drained — not "
+               "mid-decode")
+        _check(set(full) == set(ref), "stream set diverged across the "
+               f"restart: {sorted(full)} vs {sorted(ref)}")
+        for idx, chunks in sorted(ref.items()):
+            _check(full[idx] == chunks,
+                   f"stream {idx} not bit-identical across process "
+                   f"death: {full[idx]} vs {chunks}")
+            seqs = [s for _, _, s in full[idx]]
+            _check(seqs == list(range(len(seqs))),
+                   f"stream {idx} seqs not exactly-once: {seqs}")
+        timing = res["timing"]
+        resumed = timing.get("outcomes", {}).get("resumed", 0)
+        _check(resumed >= 1,
+               f"no stream resumed mid-decode (outcomes "
+               f"{timing.get('outcomes')}) — the drill proved nothing")
+        _check(timing["fresh_compiles"] == 0,
+               f"recovery paid {timing['fresh_compiles']} fresh XLA "
+               "compiles — the disk compile cache was cold")
+        _check(res["rto_s"] is not None, "no recovered token observed")
+        return (f"{len(ref)} streams bit-identical across SIGKILL "
+                f"(killed at chunk {res['killed_after']}, {resumed} "
+                f"resumed on a 2->1 engine fleet), 0 fresh compiles, "
+                f"RTO {res['rto_s']:.2f}s")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 SCENARIOS = [
     ("nan-quarantine-no-poison", scenario_nan_quarantine),
     ("page-pool-exhaustion-drain", scenario_pool_exhaustion),
@@ -1393,6 +1458,7 @@ SCENARIOS = [
     ("kill-engine-with-offloaded-pages",
      scenario_kill_engine_with_offloaded_pages),
     ("brownout-under-burst", scenario_brownout_under_burst),
+    ("kill-serving-process-mid-decode", scenario_kill_serving_process),
 ]
 
 

@@ -77,7 +77,53 @@ def _demo_registry():
     _demo_overload()
     _demo_adapters_grammar()
     _demo_tracing()
+    _demo_wal_recovery()
     return metrics.get_registry()
+
+
+def _demo_wal_recovery():
+    """Kill-and-recover drill (ISSUE 20): serve a couple of requests
+    through a WAL-armed router, ABANDON it mid-decode (no seal — the
+    same registry state a crash leaves), then recover into a second
+    router over the same wal_dir and drain — so the whole durability
+    family set (paddle_tpu_wal_{append,fsync,replay}_seconds,
+    paddle_tpu_wal_records_total{kind}, _corrupt_records_total,
+    paddle_tpu_wal_recovered_requests_total{outcome}) is live in the
+    --demo snapshot."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Router
+
+    def _model():
+        paddle.seed(0)
+        return LlamaForCausalLM(llama_tiny(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            num_key_value_heads=2, max_position_embeddings=32))
+
+    tmp = tempfile.mkdtemp(prefix="metrics_demo_wal_")
+    try:
+        crashed = Router(wal_dir=tmp)
+        crashed.add_model("wal-demo", _model(), replicas=1, page_size=4,
+                          max_batch_slots=2)
+        rng = np.random.default_rng(2)
+        for n in (5, 4):
+            crashed.submit(rng.integers(1, 64, (n,)), model="wal-demo",
+                           max_new_tokens=6)
+        for _ in range(3):
+            crashed.step()      # mid-decode: journaled, unfinished
+        # the "crash": the router is simply abandoned, WAL unsealed
+        survivor = Router(wal_dir=tmp)
+        survivor.add_model("wal-demo", _model(), replicas=1,
+                           page_size=4, max_batch_slots=2)
+        survivor.recover()
+        survivor.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _demo_adapters_grammar():
